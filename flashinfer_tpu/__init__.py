@@ -1,0 +1,52 @@
+"""flashinfer-tpu: TPU-native LLM inference kernel library.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+flashinfer-ai/flashinfer (reference ``flashinfer/__init__.py:1-262`` public
+surface): attention (single/batch prefill & decode, paged KV, cascade,
+sparse, MLA), paged-KV management, sampling, RoPE, norm, activation, GEMM,
+MoE, and mesh collectives — built on Pallas Mosaic kernels + XLA, with
+host-side plan()/run() scheduling and shard_map parallelism.
+"""
+
+from flashinfer_tpu.version import __version__  # noqa: F401
+
+from flashinfer_tpu.activation import (  # noqa: F401
+    gelu_and_mul,
+    gelu_tanh_and_mul,
+    silu_and_mul,
+)
+from flashinfer_tpu.norm import (  # noqa: F401
+    fused_add_rmsnorm,
+    gemma_fused_add_rmsnorm,
+    gemma_rmsnorm,
+    layernorm,
+    rmsnorm,
+)
+from flashinfer_tpu.page import (  # noqa: F401
+    append_paged_kv_cache,
+    append_paged_mla_kv_cache,
+    get_batch_indices_positions,
+    get_seq_lens,
+)
+from flashinfer_tpu.rope import (  # noqa: F401
+    apply_llama31_rope,
+    apply_llama31_rope_pos_ids,
+    apply_rope,
+    apply_rope_pos_ids,
+    apply_rope_with_cos_sin_cache,
+    generate_cos_sin_cache,
+)
+from flashinfer_tpu.sampling import (  # noqa: F401
+    chain_speculative_sampling,
+    min_p_sampling_from_probs,
+    sampling_from_logits,
+    sampling_from_probs,
+    softmax,
+    top_k_mask_logits,
+    top_k_renorm_probs,
+    top_k_sampling_from_probs,
+    top_k_top_p_sampling_from_logits,
+    top_k_top_p_sampling_from_probs,
+    top_p_renorm_probs,
+    top_p_sampling_from_probs,
+)

@@ -1,0 +1,48 @@
+"""Gated activation ops.
+
+TPU-native equivalents of the reference's activation family
+(``flashinfer/activation.py``, ``include/flashinfer/activation.cuh``):
+``silu_and_mul``, ``gelu_and_mul``, ``gelu_tanh_and_mul``.
+
+Input convention matches the reference: the last dimension is ``2*d`` holding
+``[gate, up]`` halves; output has last dimension ``d`` computed as
+``act(gate) * up``.  These are single-pass bandwidth-bound ops that XLA fuses
+optimally under jit, so the primary backend is pure-XLA (a Pallas kernel adds
+nothing here — documented design decision, SURVEY §7 "let XLA fuse").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_gate_up(x: jax.Array):
+    d = x.shape[-1] // 2
+    return x[..., :d], x[..., d:]
+
+
+@jax.jit
+def silu_and_mul(x: jax.Array) -> jax.Array:
+    """``silu(x[..., :d]) * x[..., d:]`` (reference flashinfer/activation.py)."""
+    gate, up = _split_gate_up(x)
+    gf = gate.astype(jnp.float32)
+    return (jax.nn.silu(gf) * up.astype(jnp.float32)).astype(x.dtype)
+
+
+@jax.jit
+def gelu_and_mul(x: jax.Array) -> jax.Array:
+    """Exact-erf GeLU gated multiply."""
+    gate, up = _split_gate_up(x)
+    gf = gate.astype(jnp.float32)
+    return (jax.nn.gelu(gf, approximate=False) * up.astype(jnp.float32)).astype(x.dtype)
+
+
+@jax.jit
+def gelu_tanh_and_mul(x: jax.Array) -> jax.Array:
+    """tanh-approximated GeLU gated multiply."""
+    gate, up = _split_gate_up(x)
+    gf = gate.astype(jnp.float32)
+    return (jax.nn.gelu(gf, approximate=True) * up.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,81 @@
+"""Environment/flag system for flashinfer-tpu.
+
+The reference configures itself purely through environment variables read at
+import/call time (survey of ``flashinfer/jit/env.py:58-110``,
+``flashinfer/api_logging.py:47-66``).  We keep the same design: a small,
+documented set of ``FLASHINFER_TPU_*`` env vars, read lazily so tests can
+monkeypatch them.
+
+Principal flags
+---------------
+FLASHINFER_TPU_LOGLEVEL       int 0-10, api-call logging verbosity (default 0)
+FLASHINFER_TPU_BACKEND        "auto" | "pallas" | "xla" — global backend override
+FLASHINFER_TPU_INTERPRET      "1" forces Pallas interpret mode (CPU debugging)
+FLASHINFER_TPU_CACHE_DIR      XLA persistent compilation cache directory
+                              (the TPU analogue of the reference JIT cache,
+                              ``flashinfer/jit/env.py:148-163``)
+FLASHINFER_TPU_DUMP_DIR       directory for api-logging tensor dumps
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def log_level() -> int:
+    try:
+        return int(_env("FLASHINFER_TPU_LOGLEVEL", "0"))
+    except ValueError:
+        return 0
+
+
+def backend_override() -> str:
+    """Global backend selector: "auto" (default), "pallas", or "xla"."""
+    v = _env("FLASHINFER_TPU_BACKEND", "auto").lower()
+    if v not in ("auto", "pallas", "xla"):
+        raise ValueError(f"FLASHINFER_TPU_BACKEND must be auto|pallas|xla, got {v!r}")
+    return v
+
+
+def force_interpret() -> bool:
+    return _env("FLASHINFER_TPU_INTERPRET", "0") == "1"
+
+
+def cache_dir() -> Path:
+    d = _env(
+        "FLASHINFER_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "flashinfer_tpu"),
+    )
+    return Path(d)
+
+
+def dump_dir() -> Path:
+    return Path(_env("FLASHINFER_TPU_DUMP_DIR", str(cache_dir() / "dumps")))
+
+
+_CACHE_ENABLED = False
+
+
+def enable_compilation_cache() -> None:
+    """Enable the XLA persistent compilation cache.
+
+    TPU analogue of the reference's on-disk JIT cache + cubin artifactory
+    (``flashinfer/jit/core.py:225-321``, ``flashinfer/artifacts.py``): compiled
+    executables are persisted under :func:`cache_dir` and re-loaded with no
+    recompile on subsequent processes.
+    """
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    import jax
+
+    d = cache_dir() / "xla_cache"
+    d.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(d))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _CACHE_ENABLED = True

@@ -1,0 +1,172 @@
+"""RMSNorm family.
+
+TPU-native re-design of the reference norm ops (``flashinfer/norm/``,
+``include/flashinfer/norm.cuh:37-686``): ``rmsnorm``, ``fused_add_rmsnorm``,
+``gemma_rmsnorm``, ``gemma_fused_add_rmsnorm``, ``layernorm``.
+
+Differences from the CUDA reference, by design:
+- Functional semantics: the reference mutates ``input``/``residual`` in place;
+  on TPU we return new arrays (XLA donation makes this zero-copy under jit).
+- One Pallas kernel serves the whole family (residual add and the Gemma
+  ``weight + 1`` convention are closure specializations — the TPU analogue of
+  the reference's jinja-specialized kernel instantiations).
+- fp32 accumulation regardless of IO dtype, matching norm.cuh behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import cdiv, resolve_backend, use_interpret
+
+_ROW_BLOCK = 256
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float, weight_bias: float):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    w = w_ref[...].astype(jnp.float32) + weight_bias
+    o_ref[...] = (y * w[None, :]).astype(o_ref.dtype)
+
+
+def _fused_add_rms_kernel(
+    x_ref, r_ref, w_ref, o_ref, res_ref, *, eps: float, weight_bias: float
+):
+    s = x_ref[...].astype(jnp.float32) + r_ref[...].astype(jnp.float32)
+    res_ref[...] = s.astype(res_ref.dtype)
+    var = jnp.mean(s * s, axis=-1, keepdims=True)
+    y = s * jax.lax.rsqrt(var + eps)
+    w = w_ref[...].astype(jnp.float32) + weight_bias
+    o_ref[...] = (y * w[None, :]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "weight_bias", "backend"))
+def _rmsnorm_impl(x, weight, eps: float, weight_bias: float, backend: str):
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    x2 = x.reshape(-1, hidden)
+    n = x2.shape[0]
+    if backend == "xla" or n < 8:
+        xf = x2.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + eps)
+        out = (y * (weight.astype(jnp.float32) + weight_bias)).astype(x.dtype)
+        return out.reshape(orig_shape)
+    rb = min(_ROW_BLOCK, n)
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps, weight_bias=weight_bias),
+        grid=(cdiv(n, rb),),
+        in_specs=[
+            pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, hidden), x.dtype),
+        interpret=use_interpret(),
+    )(x2, weight)
+    return out.reshape(orig_shape)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "weight_bias", "backend"))
+def _fused_add_rmsnorm_impl(x, residual, weight, eps, weight_bias, backend):
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    x2 = x.reshape(-1, hidden)
+    r2 = residual.reshape(-1, hidden)
+    n = x2.shape[0]
+    if backend == "xla" or n < 8:
+        s = x2.astype(jnp.float32) + r2.astype(jnp.float32)
+        var = jnp.mean(s * s, axis=-1, keepdims=True)
+        y = s * jax.lax.rsqrt(var + eps)
+        out = (y * (weight.astype(jnp.float32) + weight_bias)).astype(x.dtype)
+        return out.reshape(orig_shape), s.astype(residual.dtype).reshape(orig_shape)
+    rb = min(_ROW_BLOCK, n)
+    out, res = pl.pallas_call(
+        functools.partial(_fused_add_rms_kernel, eps=eps, weight_bias=weight_bias),
+        grid=(cdiv(n, rb),),
+        in_specs=[
+            pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rb, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hidden), x.dtype),
+            jax.ShapeDtypeStruct((n, hidden), residual.dtype),
+        ],
+        interpret=use_interpret(),
+    )(x2, r2, weight)
+    return out.reshape(orig_shape), res.reshape(orig_shape)
+
+
+def rmsnorm(
+    x: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    backend: str = "auto",
+) -> jax.Array:
+    r"""Root-mean-square normalization: ``out = x / sqrt(mean(x^2)+eps) * w``.
+
+    Reference: ``flashinfer.norm.rmsnorm`` (flashinfer/norm/, norm.cuh:37).
+    """
+    return _rmsnorm_impl(x, weight, eps, 0.0, resolve_backend(backend, "rmsnorm"))
+
+
+def gemma_rmsnorm(
+    x: jax.Array, weight: jax.Array, eps: float = 1e-6, backend: str = "auto"
+) -> jax.Array:
+    """Gemma-style RMSNorm: scales by ``(weight + 1)`` (norm.cuh Gemma family)."""
+    return _rmsnorm_impl(x, weight, eps, 1.0, resolve_backend(backend, "gemma_rmsnorm"))
+
+
+def fused_add_rmsnorm(
+    x: jax.Array,
+    residual: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    backend: str = "auto",
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused residual-add + RMSNorm.
+
+    Returns ``(normed, new_residual)`` where ``new_residual = x + residual``
+    — the functional form of the reference's in-place
+    ``fused_add_rmsnorm`` (norm.cuh FusedAddRMSNorm).
+    """
+    return _fused_add_rmsnorm_impl(
+        x, residual, weight, eps, 0.0, resolve_backend(backend, "fused_add_rmsnorm")
+    )
+
+
+def gemma_fused_add_rmsnorm(
+    x: jax.Array,
+    residual: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    backend: str = "auto",
+) -> Tuple[jax.Array, jax.Array]:
+    return _fused_add_rmsnorm_impl(
+        x, residual, weight, eps, 1.0,
+        resolve_backend(backend, "gemma_fused_add_rmsnorm"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def layernorm(
+    x: jax.Array, gamma: jax.Array, beta: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """Plain LayerNorm (reference ``flashinfer/norm/`` layernorm)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)).astype(x.dtype)

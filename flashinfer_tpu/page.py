@@ -1,0 +1,172 @@
+"""Paged KV-cache operations.
+
+TPU-native re-design of the reference page ops (``flashinfer/page.py:251-743``,
+``include/flashinfer/page.cuh``).  The paged cache is a pair of arrays
+``(k_cache, v_cache)``:
+
+- NHD layout: ``[num_pages, page_size, num_kv_heads, head_dim]``
+- HND layout: ``[num_pages, num_kv_heads, page_size, head_dim]``
+
+(the reference's combined ``[num_pages, 2, ...]`` tensor form is also accepted
+where noted).  Appends are functional scatters — under jit with donated cache
+buffers XLA performs them in place, which is the TPU replacement for the
+reference's mutating CUDA kernels (page.cuh:299 AppendPagedKVCache).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.utils import check_kv_layout, TensorLayout, get_seq_lens  # noqa: F401
+
+
+def get_batch_indices_positions(
+    append_indptr: jax.Array, seq_lens: jax.Array, nnz: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-token (request index, kv position) for a ragged append batch.
+
+    Token ``i`` in request ``r`` (i.e. ``append_indptr[r] <= i <
+    append_indptr[r+1]``) is assigned position
+    ``seq_lens[r] - (append_indptr[r+1] - append_indptr[r]) + (i - append_indptr[r])``
+    — identical semantics to the reference helper (``flashinfer/page.py:251``).
+    """
+    token = jnp.arange(nnz)
+    req = jnp.searchsorted(append_indptr, token, side="right") - 1
+    append_len = append_indptr[req + 1] - append_indptr[req]
+    pos = seq_lens[req] - append_len + (token - append_indptr[req])
+    return req.astype(jnp.int32), pos.astype(jnp.int32)
+
+
+def _flatten_cache(cache: jax.Array, layout: TensorLayout):
+    """View cache as [num_pages * page_size, H, D] rows regardless of layout."""
+    if layout == TensorLayout.HND:
+        cache = jnp.swapaxes(cache, 1, 2)  # -> NHD
+    p, ps, h, d = cache.shape
+    return cache.reshape(p * ps, h, d), (p, ps, h, d)
+
+
+def _unflatten_cache(flat: jax.Array, dims, layout: TensorLayout):
+    p, ps, h, d = dims
+    cache = flat.reshape(p, ps, h, d)
+    if layout == TensorLayout.HND:
+        cache = jnp.swapaxes(cache, 1, 2)
+    return cache
+
+
+@functools.partial(jax.jit, static_argnames=("kv_layout", "page_size"))
+def _append_impl(
+    append_key, append_value, batch_indices, positions,
+    k_cache, v_cache, kv_indices, kv_indptr, kv_layout: str, page_size: int,
+):
+    layout = check_kv_layout(kv_layout)
+    kflat, dims = _flatten_cache(k_cache, layout)
+    vflat, _ = _flatten_cache(v_cache, layout)
+    page_in_req = positions // page_size
+    slot = positions % page_size
+    page_id = kv_indices[kv_indptr[batch_indices] + page_in_req]
+    rows = page_id * page_size + slot
+    kflat = kflat.at[rows].set(append_key.astype(kflat.dtype))
+    vflat = vflat.at[rows].set(append_value.astype(vflat.dtype))
+    return (
+        _unflatten_cache(kflat, dims, layout),
+        _unflatten_cache(vflat, dims, layout),
+    )
+
+
+def append_paged_kv_cache(
+    append_key: jax.Array,  # [nnz, num_kv_heads, head_dim]
+    append_value: jax.Array,  # [nnz, num_kv_heads, head_dim]
+    batch_indices: jax.Array,  # [nnz]
+    positions: jax.Array,  # [nnz]
+    paged_kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+    kv_indices: jax.Array,
+    kv_indptr: jax.Array,
+    kv_last_page_len: jax.Array = None,  # accepted for API parity; unused
+    kv_layout: str = "NHD",
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter ragged new K/V tokens into the paged cache.
+
+    Functional form of the reference ``append_paged_kv_cache``
+    (``flashinfer/page.py:443``): returns the updated ``(k_cache, v_cache)``.
+    ``kv_last_page_len`` is accepted for signature parity but the positions
+    array fully determines target slots.
+    """
+    if isinstance(paged_kv_cache, tuple):
+        k_cache, v_cache = paged_kv_cache
+    else:
+        # combined [num_pages, 2, ...] layout
+        k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
+    layout = check_kv_layout(kv_layout)
+    page_size = (
+        k_cache.shape[1] if layout == TensorLayout.NHD else k_cache.shape[2]
+    )
+    return _append_impl(
+        append_key, append_value, batch_indices, positions,
+        k_cache, v_cache, kv_indices, kv_indptr, kv_layout, page_size,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("page_size",))
+def append_paged_mla_kv_cache(
+    append_ckv: jax.Array,  # [nnz, ckv_dim]
+    append_kpe: jax.Array,  # [nnz, kpe_dim]
+    batch_indices: jax.Array,
+    positions: jax.Array,
+    ckv_cache: jax.Array,  # [num_pages, page_size, ckv_dim]
+    kpe_cache: jax.Array,  # [num_pages, page_size, kpe_dim]
+    kv_indices: jax.Array,
+    kv_indptr: jax.Array,
+    page_size: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """MLA (compressed-KV) paged append: ckv (latent, 512) + kpe (rope, 64)
+    caches (reference ``append_paged_mla_kv_cache``, page.cuh:441)."""
+    ps = ckv_cache.shape[1]
+    page_in_req = positions // ps
+    slot = positions % ps
+    page_id = kv_indices[kv_indptr[batch_indices] + page_in_req]
+    rows = page_id * ps + slot
+    cflat = ckv_cache.reshape(-1, ckv_cache.shape[-1])
+    pflat = kpe_cache.reshape(-1, kpe_cache.shape[-1])
+    cflat = cflat.at[rows].set(append_ckv.astype(cflat.dtype))
+    pflat = pflat.at[rows].set(append_kpe.astype(pflat.dtype))
+    return cflat.reshape(ckv_cache.shape), pflat.reshape(kpe_cache.shape)
+
+
+def block_sparse_indices_to_vector_sparse_offsets(
+    block_indices: jax.Array,
+    indptr: jax.Array,
+    vector_sparse_offsets: jax.Array,
+    vector_sparse_indptr: jax.Array,
+    kv_len_arr: jax.Array,
+    stride_block: int,
+    stride_n: int,
+    batch_size: int,
+    block_size: int,
+) -> jax.Array:
+    """Expand block-sparse page indices to per-token element offsets
+    (reference ``flashinfer/page.py`` helper for vector-sparse attention).
+
+    Fills ``vector_sparse_offsets``-shaped output: entry for token ``j`` of
+    request ``b`` is ``block_indices[indptr[b] + j // block_size] *
+    stride_block + (j % block_size) * stride_n``.  The output buffer's static
+    length bounds the token count; slots past ``vector_sparse_indptr[-1]``
+    are zeroed (jit-safe — no host sync on the traced total).
+    """
+    nnz_max = vector_sparse_offsets.shape[0]
+    token = jnp.arange(nnz_max)
+    if block_size == 1:
+        valid = token < block_indices.shape[0]
+        blk = block_indices[jnp.minimum(token, block_indices.shape[0] - 1)]
+        return jnp.where(valid, blk * stride_block, 0).astype(jnp.int32)
+    req = jnp.searchsorted(vector_sparse_indptr, token, side="right") - 1
+    req = jnp.clip(req, 0, batch_size - 1)
+    j = token - vector_sparse_indptr[req]
+    blk = block_indices[jnp.clip(indptr[req] + j // block_size, 0,
+                                 block_indices.shape[0] - 1)]
+    out = blk * stride_block + (j % block_size) * stride_n
+    valid = token < vector_sparse_indptr[batch_size]
+    return jnp.where(valid, out, 0).astype(jnp.int32)

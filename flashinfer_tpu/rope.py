@@ -1,0 +1,220 @@
+"""Rotary position embedding (RoPE) family.
+
+TPU-native re-design of the reference RoPE ops (``flashinfer/rope.py:768-1159``,
+``include/flashinfer/pos_enc.cuh:294-1580``): plain Llama RoPE, Llama-3.1
+frequency-scaled RoPE, position-id and ragged-indptr input forms, and the
+cos/sin-cache form.
+
+Functional (out-of-place) semantics; the reference's ``*_inplace`` variants
+map to the same functions under jit buffer donation.  All forms are pure-XLA:
+RoPE is a cheap elementwise transform that XLA fuses into neighbouring ops —
+a dedicated Pallas kernel only adds a fusion barrier (SURVEY §7 design note).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _rope_freqs(
+    rotary_dim: int, rope_theta: float, rope_scale: float
+) -> jax.Array:
+    """Inverse frequencies, shape [rotary_dim // 2], fp32."""
+    i = jnp.arange(rotary_dim // 2, dtype=jnp.float32)
+    return 1.0 / (rope_scale * rope_theta ** (2.0 * i / rotary_dim))
+
+
+def _llama31_scale_freqs(
+    freqs: jax.Array,
+    rope_scale: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    old_context_len: int,
+) -> jax.Array:
+    """Llama-3.1 piecewise frequency rescaling (pos_enc.cuh Llama31 path)."""
+    wavelen = 2.0 * jnp.pi / freqs
+    low_bound = old_context_len / low_freq_factor
+    high_bound = old_context_len / high_freq_factor
+    smooth = (old_context_len / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    scaled = jnp.where(
+        wavelen > low_bound,
+        freqs / rope_scale,
+        jnp.where(
+            wavelen < high_bound,
+            freqs,
+            (1.0 - smooth) * freqs / rope_scale + smooth * freqs,
+        ),
+    )
+    return scaled
+
+
+def _apply_rotary(
+    x: jax.Array,  # [n, heads, head_dim]
+    cos: jax.Array,  # [n, rotary_dim // 2]
+    sin: jax.Array,  # [n, rotary_dim // 2]
+    rotary_dim: int,
+    interleave: bool,
+) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rot, rest = xf[..., :rotary_dim], xf[..., rotary_dim:]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    if interleave:
+        x1 = rot[..., 0::2]
+        x2 = rot[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        out_rot = jnp.stack([o1, o2], axis=-1).reshape(rot.shape)
+    else:
+        half = rotary_dim // 2
+        x1 = rot[..., :half]
+        x2 = rot[..., half:]
+        out_rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return jnp.concatenate([out_rot, rest], axis=-1).astype(x.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rotary_dim", "interleave", "rope_scale", "rope_theta"),
+)
+def apply_rope_pos_ids(
+    q: jax.Array,  # [nnz, num_qo_heads, head_dim]
+    k: jax.Array,  # [nnz, num_kv_heads, head_dim]
+    pos_ids: jax.Array,  # [nnz] int32
+    rotary_dim: Optional[int] = None,
+    interleave: bool = False,
+    rope_scale: float = 1.0,
+    rope_theta: float = 1e4,
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply RoPE at explicit positions (reference ``apply_rope_pos_ids``,
+    flashinfer/rope.py:768 family)."""
+    head_dim = q.shape[-1]
+    rd = rotary_dim or head_dim
+    freqs = _rope_freqs(rd, rope_theta, rope_scale)
+    angles = pos_ids.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    return (
+        _apply_rotary(q, cos, sin, rd, interleave),
+        _apply_rotary(k, cos, sin, rd, interleave),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rotary_dim", "interleave", "rope_scale", "rope_theta",
+        "low_freq_factor", "high_freq_factor", "old_context_len",
+    ),
+)
+def apply_llama31_rope_pos_ids(
+    q: jax.Array,
+    k: jax.Array,
+    pos_ids: jax.Array,
+    rotary_dim: Optional[int] = None,
+    interleave: bool = False,
+    rope_scale: float = 8.0,
+    rope_theta: float = 5e5,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    old_context_len: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """Llama-3.1-style RoPE with piecewise NTK frequency scaling
+    (reference ``apply_llama31_rope_pos_ids``)."""
+    head_dim = q.shape[-1]
+    rd = rotary_dim or head_dim
+    base = _rope_freqs(rd, rope_theta, 1.0)
+    freqs = _llama31_scale_freqs(
+        base, rope_scale, low_freq_factor, high_freq_factor, old_context_len
+    )
+    angles = pos_ids.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    return (
+        _apply_rotary(q, cos, sin, rd, interleave),
+        _apply_rotary(k, cos, sin, rd, interleave),
+    )
+
+
+def _pos_ids_from_indptr(indptr: jax.Array, offsets: jax.Array, nnz: int) -> jax.Array:
+    """Per-token positions for ragged batches: token i of request r gets
+    ``offsets[r] + i`` (reference indptr/offset form, rope.py)."""
+    req = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    return (jnp.arange(nnz) - indptr[req] + offsets[req]).astype(jnp.int32)
+
+
+def apply_rope(
+    q: jax.Array,
+    k: jax.Array,
+    indptr: jax.Array,
+    offsets: jax.Array,
+    rotary_dim: Optional[int] = None,
+    interleave: bool = False,
+    rope_scale: float = 1.0,
+    rope_theta: float = 1e4,
+) -> Tuple[jax.Array, jax.Array]:
+    """Ragged-batch RoPE (reference ``apply_rope``): ``indptr`` delimits
+    requests in the flattened token axis, ``offsets`` gives each request's
+    starting position."""
+    pos_ids = _pos_ids_from_indptr(indptr, offsets, q.shape[0])
+    return apply_rope_pos_ids(
+        q, k, pos_ids, rotary_dim, interleave, rope_scale, rope_theta
+    )
+
+
+def apply_llama31_rope(
+    q: jax.Array,
+    k: jax.Array,
+    indptr: jax.Array,
+    offsets: jax.Array,
+    rotary_dim: Optional[int] = None,
+    interleave: bool = False,
+    rope_scale: float = 8.0,
+    rope_theta: float = 5e5,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    old_context_len: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    pos_ids = _pos_ids_from_indptr(indptr, offsets, q.shape[0])
+    return apply_llama31_rope_pos_ids(
+        q, k, pos_ids, rotary_dim, interleave, rope_scale, rope_theta,
+        low_freq_factor, high_freq_factor, old_context_len,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interleave",))
+def apply_rope_with_cos_sin_cache(
+    q: jax.Array,
+    k: jax.Array,
+    cos_sin_cache: jax.Array,  # [max_pos, rotary_dim] = [cos || sin] halves
+    pos_ids: jax.Array,
+    interleave: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """RoPE from a precomputed cos/sin cache (vLLM layout: ``cos_sin_cache``
+    rows are ``[cos(rotary_dim/2) || sin(rotary_dim/2)]``; reference
+    ``apply_rope_with_cos_sin_cache``, flashinfer/rope.py)."""
+    rotary_dim = cos_sin_cache.shape[-1]
+    half = rotary_dim // 2
+    entry = cos_sin_cache[pos_ids].astype(jnp.float32)
+    cos, sin = entry[:, :half], entry[:, half:]
+    return (
+        _apply_rotary(q, cos, sin, rotary_dim, interleave),
+        _apply_rotary(k, cos, sin, rotary_dim, interleave),
+    )
+
+
+def generate_cos_sin_cache(
+    max_position: int,
+    rotary_dim: int,
+    rope_theta: float = 1e4,
+    rope_scale: float = 1.0,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Build the [max_pos, rotary_dim] cos/sin cache in vLLM layout."""
+    freqs = _rope_freqs(rotary_dim, rope_theta, rope_scale)
+    angles = jnp.arange(max_position, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(angles), jnp.sin(angles)], axis=-1).astype(dtype)

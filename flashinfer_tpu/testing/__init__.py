@@ -1,0 +1,7 @@
+from flashinfer_tpu.testing.utils import (  # noqa: F401
+    assert_close,
+    attention_ref,
+    bench_fn,
+    attention_flops,
+    attention_bytes,
+)

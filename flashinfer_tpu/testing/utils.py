@@ -1,0 +1,147 @@
+"""Testing & perf-measurement utilities.
+
+TPU re-design of the reference's ``flashinfer/testing/utils.py`` — the eager
+reference attention used by every correctness test, tolerance helpers, the
+FLOPs/bytes calculators (testing/utils.py:456-751), and a device-time
+benchmark timer (testing/utils.py:774-1546; cold-L2 rotation is replaced by
+buffer donation + ``block_until_ready`` median timing, the TPU-appropriate
+protocol per BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Reference attention (pure jnp, fp32 accumulation)
+# ---------------------------------------------------------------------------
+
+
+def attention_ref(
+    q: jax.Array,  # [qo_len, num_qo_heads, head_dim]
+    k: jax.Array,  # [kv_len, num_kv_heads, head_dim]
+    v: jax.Array,  # [kv_len, num_kv_heads, head_dim_vo]
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    custom_mask: Optional[jax.Array] = None,  # [qo_len, kv_len] bool
+    return_lse: bool = False,
+):
+    """Eager attention reference with GQA head-group broadcast.
+
+    Matches the semantics of the reference's test helper attention
+    (e.g. tests/attention/test_batch_prefill_kernels.py): causal alignment is
+    bottom-right (query i attends to kv <= kv_len - qo_len + i), ALiBi and
+    soft-cap applied pre-softmax, LSE returned in natural log units.
+    """
+    qo_len, num_qo_heads, head_dim = q.shape
+    kv_len, num_kv_heads, _ = k.shape
+    group = num_qo_heads // num_kv_heads
+    if sm_scale is None:
+        sm_scale = 1.0 / float(head_dim) ** 0.5
+
+    qf = q.astype(jnp.float32)
+    kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
+
+    # [heads, qo, kv]
+    logits = jnp.einsum("qhd,khd->hqk", qf, kf) * sm_scale
+    if logits_soft_cap > 0.0:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    mask = jnp.ones((qo_len, kv_len), dtype=bool)
+    if causal:
+        qi = jnp.arange(qo_len)[:, None]
+        ki = jnp.arange(kv_len)[None, :]
+        mask = mask & (ki <= qi + (kv_len - qo_len))
+    if window_left >= 0:
+        qi = jnp.arange(qo_len)[:, None]
+        ki = jnp.arange(kv_len)[None, :]
+        mask = mask & (ki >= qi + (kv_len - qo_len) - window_left)
+    if custom_mask is not None:
+        mask = mask & custom_mask
+
+    logits = jnp.where(mask[None], logits, -jnp.inf)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [heads, qo]
+    out = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(logits, axis=-1), vf)
+    out = out.astype(q.dtype)
+    if return_lse:
+        return out, jnp.transpose(lse)  # [qo, heads]
+    return out
+
+
+def assert_close(actual, expected, rtol=1e-3, atol=1e-3, name=""):
+    np.testing.assert_allclose(
+        np.asarray(actual, dtype=np.float32),
+        np.asarray(expected, dtype=np.float32),
+        rtol=rtol,
+        atol=atol,
+        err_msg=name,
+    )
+
+
+# ---------------------------------------------------------------------------
+# FLOPs / bytes calculators (reference testing/utils.py:456-751)
+# ---------------------------------------------------------------------------
+
+
+def attention_flops(
+    qo_len: int, kv_len: int, num_qo_heads: int, head_dim_qk: int,
+    head_dim_vo: int, causal: bool = False,
+) -> float:
+    """Total attention FLOPs (QK^T + PV) for one request."""
+    if causal and qo_len > 1:
+        # each query i sees kv_len - qo_len + i + 1 keys
+        attended = qo_len * (kv_len - qo_len) + (qo_len * (qo_len + 1)) // 2
+    else:
+        attended = qo_len * kv_len
+    return 2.0 * attended * num_qo_heads * (head_dim_qk + head_dim_vo)
+
+
+def attention_bytes(
+    qo_len: int, kv_len: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim_qk: int, head_dim_vo: int, dtype_bytes: int = 2,
+) -> float:
+    """HBM bytes moved by one attention call (q+k+v+o), decode-bound metric."""
+    q = qo_len * num_qo_heads * head_dim_qk
+    k = kv_len * num_kv_heads * head_dim_qk
+    v = kv_len * num_kv_heads * head_dim_vo
+    o = qo_len * num_qo_heads * head_dim_vo
+    return float((q + k + v + o) * dtype_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Benchmark timer
+# ---------------------------------------------------------------------------
+
+
+def bench_fn(
+    fn: Callable,
+    *args,
+    warmup: int = 3,
+    iters: int = 20,
+    **kwargs,
+) -> float:
+    """Median wall time per call in seconds, device-synchronized.
+
+    TPU analogue of ``bench_gpu_time`` (reference testing/utils.py:774):
+    compile+warm first, then time each iteration with ``block_until_ready``.
+    """
+    out = fn(*args, **kwargs)  # compile
+    for _ in range(max(warmup - 1, 0)):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))

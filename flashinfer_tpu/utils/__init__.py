@@ -1,0 +1,228 @@
+"""Shared utilities: enums, layouts, rounding, backend gating.
+
+TPU-native re-design of the reference's ``flashinfer/utils.py`` (enums and
+layout canonicalization at utils.py:281, backend gating decorators at
+utils.py:1070-1153).  Nothing CUDA-specific survives: "compute capability"
+gates become TPU-generation gates, and torch custom-op registration is
+unnecessary (jit/abstract-eval come free with JAX).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class PosEncodingMode(enum.IntEnum):
+    """Positional encoding applied inside attention kernels.
+
+    Mirrors the reference enum (``flashinfer/utils.py:281``)."""
+
+    NONE = 0
+    ROPE_LLAMA = 1
+    ALIBI = 2
+
+
+class MaskMode(enum.IntEnum):
+    """Attention mask mode (reference ``flashinfer/utils.py``)."""
+
+    NON_CAUSAL = 0
+    CAUSAL = 1
+    CUSTOM = 2
+
+
+class TensorLayout(enum.IntEnum):
+    """KV tensor layout: NHD = [seq, heads, dim], HND = [heads, seq, dim]."""
+
+    NHD = 0
+    HND = 1
+
+
+def check_kv_layout(kv_layout: str) -> TensorLayout:
+    if kv_layout not in ("NHD", "HND"):
+        raise KeyError(f"Invalid kv_layout {kv_layout!r}, expected 'NHD' or 'HND'")
+    return TensorLayout[kv_layout]
+
+
+def check_pos_encoding_mode(pos_encoding_mode: str) -> PosEncodingMode:
+    if pos_encoding_mode not in PosEncodingMode.__members__:
+        raise KeyError(
+            f"Invalid pos_encoding_mode {pos_encoding_mode!r}, expected one of "
+            f"{list(PosEncodingMode.__members__)}"
+        )
+    return PosEncodingMode[pos_encoding_mode]
+
+
+# ---------------------------------------------------------------------------
+# Rounding / shape helpers
+# ---------------------------------------------------------------------------
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(a // -b)
+
+
+def round_up(a: int, b: int) -> int:
+    """Round ``a`` up to a multiple of ``b``."""
+    return cdiv(a, b) * b
+
+
+def next_power_of_two(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def min_sublane(dtype: Any) -> int:
+    """Minimum second-to-last tile dim for a dtype on TPU (lane dim is 128)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
+LANE = 128
+
+
+# ---------------------------------------------------------------------------
+# Platform / backend gating
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def is_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.cache
+def tpu_generation() -> int:
+    """TPU generation number (4, 5, 6, ...); -1 when not running on TPU.
+
+    The TPU analogue of the reference's compute-capability gates
+    (``flashinfer/utils.py:1070``)."""
+    if not is_tpu():
+        return -1
+    kind = jax.devices()[0].device_kind.lower()
+    for tok in kind.replace("v", " v").split():
+        if tok.startswith("v") and tok[1:2].isdigit():
+            return int(tok[1])
+    return 4
+
+
+def use_interpret() -> bool:
+    """Whether Pallas kernels should run in interpreter mode.
+
+    True off-TPU (CPU CI — the stand-in for the reference's fake backends,
+    SURVEY §4) or when FLASHINFER_TPU_INTERPRET=1."""
+    from flashinfer_tpu import env
+
+    return env.force_interpret() or not is_tpu()
+
+
+def resolve_backend(backend: str, op: str = "") -> str:
+    """Resolve a per-op backend choice, honoring the global override.
+
+    Mirrors the reference's ``determine_attention_backend``
+    (``flashinfer/utils.py:522``) collapsed to the TPU world: "pallas"
+    (primary, Mosaic kernels) or "xla" (pure-jnp reference/fallback).
+    """
+    from flashinfer_tpu import env
+
+    override = env.backend_override()
+    if backend == "auto":
+        return override if override != "auto" else "pallas"
+    if backend not in ("pallas", "xla"):
+        raise ValueError(f"Unknown backend {backend!r} for op {op or '<unnamed>'}")
+    return backend
+
+
+class GenerationRequirementError(RuntimeError):
+    pass
+
+
+def tpu_requirement(min_generation: int) -> Callable:
+    """Declarative hardware gate, mirroring ``@supported_compute_capability``
+    (``flashinfer/utils.py:1070``): raises unless running on TPU >= gen or
+    off-TPU (interpret/testing mode)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if is_tpu() and tpu_generation() < min_generation:
+                raise GenerationRequirementError(
+                    f"{fn.__name__} requires TPU v{min_generation}+, "
+                    f"running on v{tpu_generation()}"
+                )
+            return wrapper.__wrapped__(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# dtype helpers
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "half": jnp.float16,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "fp8_e4m3": jnp.float8_e4m3fn,
+    "fp8_e5m2": jnp.float8_e5m2,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+}
+
+
+def canonicalize_dtype(dtype: Any) -> jnp.dtype:
+    """Canonicalize a dtype spec (string alias or jnp dtype) to jnp.dtype.
+
+    Reference: ``flashinfer/utils.py`` dtype canonicalization."""
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_ALIASES:
+            raise KeyError(f"Unknown dtype alias {dtype!r}")
+        return jnp.dtype(_DTYPE_ALIASES[dtype])
+    return jnp.dtype(dtype)
+
+
+def get_sm_scale(head_dim: int, sm_scale: Optional[float]) -> float:
+    return sm_scale if sm_scale is not None else 1.0 / float(head_dim) ** 0.5
+
+
+def to_nhd(x: jax.Array, kv_layout: str) -> jax.Array:
+    """Convert a [.., H, N, D] ("HND") array to [.., N, H, D] ("NHD")."""
+    if check_kv_layout(kv_layout) == TensorLayout.HND:
+        return jnp.swapaxes(x, -3, -2)
+    return x
+
+
+# the NHD<->HND swap is an involution, so the inverse is the same transform
+from_nhd = to_nhd
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+
+def get_seq_lens(
+    kv_indptr: jax.Array, kv_last_page_len: jax.Array, page_size: int
+) -> jax.Array:
+    """Per-request KV sequence lengths from paged indptr + last-page lengths.
+
+    Reference: ``flashinfer/page.py`` ``get_seq_lens``."""
+    pages = kv_indptr[1:] - kv_indptr[:-1]
+    return jnp.where(
+        pages > 0, (pages - 1) * page_size + kv_last_page_len, jnp.zeros_like(pages)
+    )
+
+
+def expand_dims_to(x: jax.Array, ndim: int) -> jax.Array:
+    while x.ndim < ndim:
+        x = x[..., None]
+    return x

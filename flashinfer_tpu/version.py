@@ -1,0 +1,7 @@
+"""Version of flashinfer-tpu.
+
+Mirrors the reference's ``version.txt`` single-source-of-truth
+(/root/reference/version.txt) but tracked in-package.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,85 @@
+"""Paged KV-cache append tests (mirrors reference tests/attention page tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+
+
+@pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
+@pytest.mark.parametrize("page_size", [1, 16])
+def test_append_paged_kv_cache(kv_layout, page_size):
+    num_pages, h, d = 32, 2, 64
+    seq_lens_np = np.array([5, 1, 10], np.int32)  # current total lens incl. appended
+    append_lens = np.array([3, 1, 4], np.int32)
+    batch = 3
+    nnz = int(append_lens.sum())
+
+    # page table: allocate contiguous-but-shuffled pages per request
+    pages_per_req = [-(-int(l) // page_size) for l in seq_lens_np]
+    rng = np.random.default_rng(0)
+    all_pages = rng.permutation(num_pages)[: sum(pages_per_req)]
+    kv_indptr_np = np.concatenate([[0], np.cumsum(pages_per_req)]).astype(np.int32)
+    kv_indices_np = all_pages.astype(np.int32)
+
+    if kv_layout == "NHD":
+        shape = (num_pages, page_size, h, d)
+    else:
+        shape = (num_pages, h, page_size, d)
+    k_cache = jnp.zeros(shape, jnp.float32)
+    v_cache = jnp.zeros(shape, jnp.float32)
+
+    append_indptr = jnp.array(np.concatenate([[0], np.cumsum(append_lens)]), jnp.int32)
+    seq_lens = jnp.array(seq_lens_np)
+    bi, pos = fi.get_batch_indices_positions(append_indptr, seq_lens, nnz)
+
+    kdata = jax.random.normal(jax.random.PRNGKey(0), (nnz, h, d), jnp.float32)
+    vdata = jax.random.normal(jax.random.PRNGKey(1), (nnz, h, d), jnp.float32)
+
+    k_new, v_new = fi.append_paged_kv_cache(
+        kdata, vdata, bi, pos, (k_cache, v_cache),
+        jnp.array(kv_indices_np), jnp.array(kv_indptr_np), None, kv_layout,
+    )
+
+    # verify each appended token landed in the right slot
+    k_np = np.asarray(k_new)
+    bi_np, pos_np = np.asarray(bi), np.asarray(pos)
+    for t in range(nnz):
+        b, p = int(bi_np[t]), int(pos_np[t])
+        page = int(kv_indices_np[kv_indptr_np[b] + p // page_size])
+        slot = p % page_size
+        got = k_np[page, slot] if kv_layout == "NHD" else k_np[page, :, slot]
+        np.testing.assert_allclose(got, np.asarray(kdata[t]), rtol=1e-6)
+
+    # positions: last token of request r must be seq_lens[r]-1
+    for r in range(batch):
+        end = int(append_indptr[r + 1]) - 1
+        assert pos_np[end] == seq_lens_np[r] - 1
+
+
+def test_get_seq_lens():
+    kv_indptr = jnp.array([0, 2, 2, 5], jnp.int32)
+    last_page = jnp.array([3, 0, 16], jnp.int32)
+    out = fi.get_seq_lens(kv_indptr, last_page, 16)
+    np.testing.assert_array_equal(np.asarray(out), [19, 0, 48])
+
+
+def test_append_mla_cache():
+    num_pages, ps = 8, 4
+    ckv = jnp.zeros((num_pages, ps, 32), jnp.float32)
+    kpe = jnp.zeros((num_pages, ps, 16), jnp.float32)
+    nnz = 5
+    bi = jnp.zeros((nnz,), jnp.int32)
+    pos = jnp.arange(nnz, dtype=jnp.int32)
+    kv_indices = jnp.array([3, 1], jnp.int32)
+    kv_indptr = jnp.array([0, 2], jnp.int32)
+    ckv_data = jax.random.normal(jax.random.PRNGKey(0), (nnz, 32))
+    kpe_data = jax.random.normal(jax.random.PRNGKey(1), (nnz, 16))
+    c_new, p_new = fi.append_paged_mla_kv_cache(
+        ckv_data, kpe_data, bi, pos, ckv, kpe, kv_indices, kv_indptr
+    )
+    np.testing.assert_allclose(np.asarray(c_new[3, :4]), np.asarray(ckv_data[:4]))
+    np.testing.assert_allclose(np.asarray(c_new[1, 0]), np.asarray(ckv_data[4]))
+    np.testing.assert_allclose(np.asarray(p_new[3, 1]), np.asarray(kpe_data[1]))

@@ -1,0 +1,91 @@
+"""RoPE correctness vs an eager numpy reference (mirrors the reference's
+tests/test_helpers/rope_reference.py pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+
+
+def ref_rope(x, pos, rotary_dim, interleave, rope_scale, rope_theta):
+    x = np.asarray(x, np.float32)
+    n, h, d = x.shape
+    i = np.arange(rotary_dim // 2, dtype=np.float32)
+    freqs = 1.0 / (rope_scale * rope_theta ** (2 * i / rotary_dim))
+    ang = pos[:, None].astype(np.float32) * freqs[None, :]
+    cos, sin = np.cos(ang)[:, None, :], np.sin(ang)[:, None, :]
+    out = x.copy()
+    rot = x[..., :rotary_dim]
+    if interleave:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        out[..., 0:rotary_dim:2] = x1 * cos - x2 * sin
+        out[..., 1:rotary_dim:2] = x2 * cos + x1 * sin
+    else:
+        half = rotary_dim // 2
+        x1, x2 = rot[..., :half], rot[..., half:]
+        out[..., :half] = x1 * cos - x2 * sin
+        out[..., half:rotary_dim] = x2 * cos + x1 * sin
+    return out
+
+
+@pytest.mark.parametrize("interleave", [False, True])
+@pytest.mark.parametrize("rotary_dim", [64, 128])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_apply_rope_pos_ids(interleave, rotary_dim, dtype):
+    nnz, qh, kh, d = 33, 8, 2, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (nnz, qh, d), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (nnz, kh, d), dtype)
+    pos = jax.random.randint(jax.random.PRNGKey(2), (nnz,), 0, 2048)
+    qo, ko = fi.apply_rope_pos_ids(
+        q, k, pos, rotary_dim=rotary_dim, interleave=interleave
+    )
+    tol = 3e-2 if dtype == jnp.bfloat16 else 1e-4
+    np.testing.assert_allclose(
+        np.asarray(qo, np.float32),
+        ref_rope(q, np.asarray(pos), rotary_dim, interleave, 1.0, 1e4),
+        rtol=tol, atol=tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ko, np.float32),
+        ref_rope(k, np.asarray(pos), rotary_dim, interleave, 1.0, 1e4),
+        rtol=tol, atol=tol,
+    )
+
+
+def test_apply_rope_indptr_matches_pos_ids():
+    indptr = jnp.array([0, 3, 8], jnp.int32)
+    offsets = jnp.array([100, 5], jnp.int32)
+    nnz = 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (nnz, 4, 64), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (nnz, 1, 64), jnp.float32)
+    qo, ko = fi.apply_rope(q, k, indptr, offsets)
+    pos = jnp.array([100, 101, 102, 5, 6, 7, 8, 9], jnp.int32)
+    qr, kr = fi.apply_rope_pos_ids(q, k, pos)
+    np.testing.assert_allclose(np.asarray(qo), np.asarray(qr), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ko), np.asarray(kr), rtol=1e-6)
+
+
+def test_cos_sin_cache_matches_direct():
+    nnz, d = 16, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (nnz, 4, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (nnz, 2, d), jnp.float32)
+    pos = jax.random.randint(jax.random.PRNGKey(2), (nnz,), 0, 512)
+    cache = fi.generate_cos_sin_cache(512, d, rope_theta=1e4)
+    qo, ko = fi.apply_rope_with_cos_sin_cache(q, k, cache, pos)
+    qr, kr = fi.apply_rope_pos_ids(q, k, pos)
+    np.testing.assert_allclose(np.asarray(qo), np.asarray(qr), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ko), np.asarray(kr), rtol=1e-3, atol=1e-4)
+
+
+def test_llama31_rope_longwave_matches_scaled_plain():
+    """For very long wavelengths (low freq), llama3.1 scaling divides freqs by
+    rope_scale — check limiting behavior on the lowest-frequency dims."""
+    nnz, d = 4, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (nnz, 1, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (nnz, 1, d), jnp.float32)
+    pos = jnp.arange(nnz, dtype=jnp.int32)
+    qo, _ = fi.apply_llama31_rope_pos_ids(q, k, pos)
+    assert qo.shape == q.shape
+    assert not np.allclose(np.asarray(qo), np.asarray(q))

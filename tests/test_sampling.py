@@ -1,0 +1,131 @@
+"""Sampling family tests: distribution-support checks + renorm exactness
+(mirrors reference tests/test_sampling.py strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+
+
+def _rand_probs(key, batch, vocab):
+    logits = jax.random.normal(key, (batch, vocab)) * 2
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_softmax_temperature():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 111))
+    t = jnp.array([0.5, 1.0, 2.0, 1.3])
+    out = fi.softmax(logits, t)
+    ref = jax.nn.softmax(np.asarray(logits) / np.asarray(t)[:, None], axis=-1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_sampling_from_probs_support():
+    batch, vocab = 16, 64
+    probs = np.zeros((batch, vocab), np.float32)
+    allowed = np.random.default_rng(0).integers(0, vocab, (batch, 5))
+    for b in range(batch):
+        probs[b, allowed[b]] = 1 / 5
+    samples = fi.sampling_from_probs(jnp.array(probs), jax.random.PRNGKey(0))
+    for b in range(batch):
+        assert samples[b] in allowed[b]
+
+
+@pytest.mark.parametrize("top_p", [0.1, 0.5, 0.9])
+def test_top_p_renorm(top_p):
+    probs = _rand_probs(jax.random.PRNGKey(0), 8, 128)
+    out = np.asarray(fi.top_p_renorm_probs(probs, top_p))
+    p = np.asarray(probs)
+    for b in range(8):
+        order = np.argsort(-p[b])
+        cum = np.cumsum(p[b][order])
+        k = int(np.searchsorted(cum, top_p) + 1)
+        mask = np.zeros(128, bool)
+        mask[order[:k]] = True
+        kept = np.where(mask, p[b], 0)
+        ref = kept / kept.sum()
+        np.testing.assert_allclose(out[b], ref, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(out[b].sum(), 1.0, rtol=1e-5)
+
+
+@pytest.mark.parametrize("top_k", [1, 5, 64])
+def test_top_k_renorm(top_k):
+    probs = _rand_probs(jax.random.PRNGKey(1), 8, 64)
+    out = np.asarray(fi.top_k_renorm_probs(probs, top_k))
+    p = np.asarray(probs)
+    for b in range(8):
+        thresh = np.sort(p[b])[::-1][top_k - 1]
+        kept = np.where(p[b] >= thresh, p[b], 0)
+        ref = kept / kept.sum()
+        np.testing.assert_allclose(out[b], ref, rtol=1e-4, atol=1e-6)
+
+
+def test_top_k_mask_logits():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (4, 100))
+    out = np.asarray(fi.top_k_mask_logits(logits, 10))
+    for b in range(4):
+        assert (out[b] > -1e29).sum() == 10
+
+
+def test_top_k_sampling_stays_in_top_k():
+    probs = _rand_probs(jax.random.PRNGKey(3), 8, 256)
+    p = np.asarray(probs)
+    for i in range(10):
+        s = np.asarray(
+            fi.top_k_sampling_from_probs(probs, jax.random.PRNGKey(i), 5)
+        )
+        for b in range(8):
+            topk = set(np.argsort(-p[b])[:5].tolist())
+            assert int(s[b]) in topk
+
+
+def test_min_p_sampling():
+    probs = _rand_probs(jax.random.PRNGKey(4), 4, 64)
+    p = np.asarray(probs)
+    for i in range(5):
+        s = np.asarray(fi.min_p_sampling_from_probs(probs, jax.random.PRNGKey(i), 0.5))
+        for b in range(4):
+            assert p[b, s[b]] >= 0.5 * p[b].max() - 1e-6
+
+
+def test_chain_speculative_sampling_all_accept():
+    """When draft == target, all draft tokens must be accepted."""
+    batch, n, vocab = 4, 3, 32
+    probs = np.asarray(_rand_probs(jax.random.PRNGKey(0), batch * n, vocab)).reshape(
+        batch, n, vocab
+    )
+    draft = jnp.array(probs)
+    target = jnp.concatenate(
+        [draft, _rand_probs(jax.random.PRNGKey(9), batch, vocab)[:, None]], axis=1
+    )
+    tok = jax.random.categorical(
+        jax.random.PRNGKey(1), jnp.log(draft), axis=-1
+    ).astype(jnp.int32)
+    out, acc, emitted = fi.chain_speculative_sampling(
+        draft, tok, target, jax.random.PRNGKey(2)
+    )
+    np.testing.assert_array_equal(np.asarray(acc), n)
+    np.testing.assert_array_equal(np.asarray(emitted), n)
+    np.testing.assert_array_equal(np.asarray(out[:, :n]), np.asarray(tok))
+    assert (np.asarray(out[:, n]) >= 0).all()
+
+
+def test_chain_speculative_sampling_all_reject():
+    """Disjoint supports: first draft token must be rejected, output token
+    drawn from target at position 0, rest padded with -1."""
+    batch, n, vocab = 3, 2, 16
+    draft = np.zeros((batch, n, vocab), np.float32)
+    draft[..., 0] = 1.0
+    target = np.zeros((batch, n + 1, vocab), np.float32)
+    target[..., 5] = 1.0
+    tok = jnp.zeros((batch, n), jnp.int32)
+    out, acc, emitted = fi.chain_speculative_sampling(
+        jnp.array(draft), tok, jnp.array(target), jax.random.PRNGKey(0)
+    )
+    np.testing.assert_array_equal(np.asarray(acc), 0)
+    np.testing.assert_array_equal(np.asarray(emitted), 0)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), 5)
+    np.testing.assert_array_equal(np.asarray(out[:, 1:]), -1)
