@@ -10,6 +10,23 @@ host-side plan()/run() scheduling and shard_map parallelism.
 
 from flashinfer_tpu.version import __version__  # noqa: F401
 
+from flashinfer_tpu.cascade import (  # noqa: F401
+    MultiLevelCascadeAttentionWrapper,
+    merge_state,
+    merge_state_in_place,
+    merge_states,
+    variable_length_merge_states,
+)
+from flashinfer_tpu.decode import (  # noqa: F401
+    BatchDecodeWithPagedKVCacheWrapper,
+    single_decode_with_kv_cache,
+)
+from flashinfer_tpu.prefill import (  # noqa: F401
+    BatchPrefillWithPagedKVCacheWrapper,
+    BatchPrefillWithRaggedKVCacheWrapper,
+    single_prefill_with_kv_cache,
+)
+
 from flashinfer_tpu.activation import (  # noqa: F401
     gelu_and_mul,
     gelu_tanh_and_mul,

@@ -1,0 +1,109 @@
+"""Cascade (shared-prefix) attention and state-merge API.
+
+TPU re-design of the reference cascade layer (``flashinfer/cascade.py:226``
+``MultiLevelCascadeAttentionWrapper``; merge ops cascade.py:42-170; math
+``docs/tutorials/recursive_attention.rst``): attention over a multi-level
+shared-prefix KV structure is computed as one attention call per level
+(each level a batch-prefill over that level's pages) and the per-level
+states are combined with the associative merge operator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.ops.merge import (  # noqa: F401  (public re-exports)
+    merge_state,
+    merge_state_in_place,
+    merge_states,
+    variable_length_merge_states,
+)
+from flashinfer_tpu.prefill import BatchPrefillWithPagedKVCacheWrapper
+
+
+class MultiLevelCascadeAttentionWrapper:
+    """Multi-level cascade attention (reference
+    ``MultiLevelCascadeAttentionWrapper``, flashinfer/cascade.py:226).
+
+    Level 0 is the most-shared prefix (e.g. system prompt pages shared by
+    every request); the last level holds per-request suffix pages.  Each
+    level runs as a paged batch prefill with its own (qo_indptr, page table)
+    view, producing (out, lse); levels fold together with ``merge_state`` —
+    composition identical to the reference (cascade.py:343-367)."""
+
+    def __init__(
+        self,
+        num_levels: int,
+        float_workspace_buffer=None,
+        kv_layout: str = "NHD",
+        backend: str = "auto",
+        **_unused,
+    ):
+        self._num_levels = num_levels
+        self._wrappers = [
+            BatchPrefillWithPagedKVCacheWrapper(
+                kv_layout=kv_layout, backend=backend
+            )
+            for _ in range(num_levels)
+        ]
+
+    def plan(
+        self,
+        qo_indptr_arr: Sequence,
+        paged_kv_indptr_arr: Sequence,
+        paged_kv_indices_arr: Sequence,
+        paged_kv_last_page_len_arr: Sequence,
+        num_qo_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int,
+        causal: bool = False,
+        pos_encoding_mode: str = "NONE",
+        window_left: int = -1,
+        logits_soft_cap: Optional[float] = None,
+        sm_scale: Optional[float] = None,
+        q_data_type=jnp.bfloat16,
+        **_unused,
+    ) -> None:
+        """Plan each level.  Causal masking applies only to the last level
+        (a query never attends ahead of itself in its own suffix; shared
+        prefixes are fully visible), matching the reference's usage."""
+        for lvl, w in enumerate(self._wrappers):
+            w.plan(
+                qo_indptr_arr[lvl],
+                paged_kv_indptr_arr[lvl],
+                paged_kv_indices_arr[lvl],
+                paged_kv_last_page_len_arr[lvl],
+                num_qo_heads, num_kv_heads, head_dim, page_size,
+                causal=(causal and lvl == self._num_levels - 1),
+                pos_encoding_mode=pos_encoding_mode,
+                window_left=window_left,
+                logits_soft_cap=logits_soft_cap,
+                sm_scale=sm_scale,
+                q_data_type=q_data_type,
+            )
+
+    def run(
+        self,
+        q: jax.Array,  # [total_q, num_qo_heads, head_dim]
+        paged_kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+    ) -> jax.Array:
+        out, lse = self._wrappers[0].run(q, paged_kv_cache, return_lse=True)
+        for w in self._wrappers[1:]:
+            o_i, lse_i = w.run(q, paged_kv_cache, return_lse=True)
+            out, lse = merge_state(out, lse, o_i, lse_i)
+        return out
+
+    forward = run
+
+
+def merge_state_with_shared_prefix(
+    v_shared: jax.Array, s_shared: jax.Array,
+    v_unique: jax.Array, s_unique: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Two-level convenience merge (reference's batch_attention-with-
+    shared-prefix pattern)."""
+    return merge_state(v_shared, s_shared, v_unique, s_unique)

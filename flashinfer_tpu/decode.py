@@ -1,0 +1,265 @@
+"""Decode attention: stateless single-request op + batch plan/run wrapper.
+
+TPU-native re-design of the reference decode layer (``flashinfer/decode.py``):
+
+- ``single_decode_with_kv_cache`` (reference decode.py:514)
+- ``BatchDecodeWithPagedKVCacheWrapper`` (reference decode.py:710) with the
+  canonical **plan()/run() lifecycle** (SURVEY §3.2): plan() runs host-side
+  once per batch geometry and produces *padded, bucketed* index arrays (the
+  TPU replacement for the reference's int-workspace offset arrays +
+  CUDAGraph frozen shapes); run() is a pure jitted function over those
+  arrays, so step-to-step replay never recompiles as long as the geometry
+  bucket is stable.
+
+Design notes vs the reference:
+- No 128MB float workspace / 8MB int workspace: XLA owns scratch. The
+  ``float_workspace_buffer`` constructor arg is accepted and ignored for
+  API compatibility.
+- No split-KV work estimation (scheduler.cuh:150): a TPU core walks KV
+  sequentially with pipelined DMA; grid starvation doesn't exist here.
+- ``use_tensor_cores`` is accepted and ignored: the Pallas kernel always
+  packs the GQA group onto the MXU (decode.py:1629's tensor-core routing
+  is the default and only path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.ops.flash_attention import flash_attention
+from flashinfer_tpu.ops.paged_decode import paged_decode_attention
+from flashinfer_tpu.ops.xla_ref import xla_paged_decode, xla_ragged_attention
+from flashinfer_tpu.utils import (
+    check_kv_layout,
+    get_sm_scale,
+    next_power_of_two,
+    resolve_backend,
+    round_up,
+    TensorLayout,
+)
+
+
+def single_decode_with_kv_cache(
+    q: jax.Array,  # [num_qo_heads, head_dim]
+    k: jax.Array,  # [kv_len, num_kv_heads, head_dim] (NHD) or HND
+    v: jax.Array,
+    kv_layout: str = "NHD",
+    pos_encoding_mode: str = "NONE",
+    use_tensor_cores: bool = False,
+    sm_scale: Optional[float] = None,
+    rope_scale: Optional[float] = None,
+    rope_theta: Optional[float] = None,
+    window_left: int = -1,
+    logits_soft_cap: Optional[float] = None,
+    return_lse: bool = False,
+    backend: str = "auto",
+):
+    """Single-request decode attention (reference
+    ``single_decode_with_kv_cache``, flashinfer/decode.py:514).
+
+    ``pos_encoding_mode="ROPE_LLAMA"`` applies RoPE to q at position
+    ``kv_len-1`` and to k at positions ``0..kv_len-1`` before attention
+    (the reference's fused-RoPE option, decode.cuh:217)."""
+    if check_kv_layout(kv_layout) == TensorLayout.HND:
+        k = jnp.swapaxes(k, 0, 1)
+        v = jnp.swapaxes(v, 0, 1)
+    kv_len = k.shape[0]
+    head_dim = q.shape[-1]
+    sm_scale = get_sm_scale(head_dim, sm_scale)
+    if pos_encoding_mode == "ROPE_LLAMA":
+        from flashinfer_tpu.rope import apply_rope_pos_ids
+
+        q2, _ = apply_rope_pos_ids(
+            q[None], k[:1], jnp.array([kv_len - 1], jnp.int32),
+            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
+        )
+        _, k = apply_rope_pos_ids(
+            jnp.zeros((kv_len, 1, head_dim), q.dtype), k,
+            jnp.arange(kv_len, dtype=jnp.int32),
+            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
+        )
+        q = q2[0]
+    backend = resolve_backend(backend, "single_decode")
+    fn = flash_attention if backend == "pallas" else xla_ragged_attention
+    qb = q[None]  # [1, H, D]
+    seg_q = jnp.zeros((1,), jnp.int32)
+    seg_kv = jnp.zeros((kv_len,), jnp.int32)
+    out = fn(
+        qb, k, v, seg_q, seg_kv,
+        jnp.array([kv_len - 1], jnp.int32), jnp.arange(kv_len, dtype=jnp.int32),
+        causal=False, sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
+        return_lse=return_lse,
+    )
+    if return_lse:
+        return out[0][0], out[1][0]
+    return out[0]
+
+
+@dataclass(frozen=True)
+class _DecodePlan:
+    """Plan arrays for a batch-decode geometry (the TPU analogue of
+    ``DecodePlanInfo``, scheduler.cuh:366)."""
+
+    page_table: jax.Array  # [B_pad, P_bucket] int32
+    kv_lens: jax.Array  # [B_pad] int32
+    batch_size: int  # actual batch
+    num_qo_heads: int
+    num_kv_heads: int
+    head_dim: int
+    page_size: int
+    sm_scale: float
+    logits_soft_cap: float
+    window_left: int
+
+
+class BatchDecodeWithPagedKVCacheWrapper:
+    """Batched paged-KV decode with plan/run lifecycle (reference
+    ``BatchDecodeWithPagedKVCacheWrapper``, flashinfer/decode.py:710).
+
+    plan() host-side: converts ragged (indptr, indices, last_page_len) into a
+    padded rectangular page table bucketed to powers of two — bounded
+    recompile count replaces CUDAGraph shape freezing."""
+
+    def __init__(
+        self,
+        float_workspace_buffer=None,  # accepted for API parity; unused
+        kv_layout: str = "NHD",
+        use_cuda_graph: bool = False,  # parity; shapes are bucketed regardless
+        use_tensor_cores: bool = False,  # parity; MXU packing is always on
+        backend: str = "auto",
+        **_unused,
+    ):
+        check_kv_layout(kv_layout)
+        self._kv_layout = kv_layout
+        self._backend = backend
+        self._plan: Optional[_DecodePlan] = None
+
+    def plan(
+        self,
+        indptr,  # [B+1] host array: page-table offsets
+        indices,  # [total_pages] host array: page ids
+        last_page_len,  # [B] host array
+        num_qo_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int,
+        pos_encoding_mode: str = "NONE",
+        window_left: int = -1,
+        logits_soft_cap: Optional[float] = None,
+        q_data_type=jnp.bfloat16,
+        kv_data_type=None,
+        data_type=None,
+        sm_scale: Optional[float] = None,
+        rope_scale: Optional[float] = None,
+        rope_theta: Optional[float] = None,
+        non_blocking: bool = True,
+        seq_lens=None,
+    ) -> None:
+        if pos_encoding_mode not in ("NONE",):
+            raise NotImplementedError(
+                "fused RoPE in batch decode: apply flashinfer_tpu.rope first"
+            )
+        indptr = np.asarray(indptr)
+        indices = np.asarray(indices)
+        last_page_len = np.asarray(last_page_len)
+        batch = len(indptr) - 1
+        pages_per_req = indptr[1:] - indptr[:-1]
+        kv_lens = np.where(
+            pages_per_req > 0,
+            (pages_per_req - 1) * page_size + last_page_len,
+            0,
+        ).astype(np.int32)
+
+        # bucketed padding: bounded set of compiled shapes
+        p_bucket = max(next_power_of_two(int(pages_per_req.max(initial=1))), 8)
+        b_bucket = max(next_power_of_two(batch), 8)
+        table = np.zeros((b_bucket, p_bucket), np.int32)
+        for b in range(batch):
+            n = int(pages_per_req[b])
+            table[b, :n] = indices[int(indptr[b]) : int(indptr[b]) + n]
+        kv_lens_pad = np.zeros((b_bucket,), np.int32)
+        kv_lens_pad[:batch] = kv_lens
+
+        self._plan = _DecodePlan(
+            page_table=jnp.asarray(table),
+            kv_lens=jnp.asarray(kv_lens_pad),
+            batch_size=batch,
+            num_qo_heads=num_qo_heads,
+            num_kv_heads=num_kv_heads,
+            head_dim=head_dim,
+            page_size=page_size,
+            sm_scale=get_sm_scale(head_dim, sm_scale),
+            logits_soft_cap=logits_soft_cap or 0.0,
+            window_left=window_left,
+        )
+
+    def run(
+        self,
+        q: jax.Array,  # [batch, num_qo_heads, head_dim]
+        paged_kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+        *,
+        q_scale: Optional[float] = None,
+        k_scale: Optional[float] = None,
+        v_scale: Optional[float] = None,
+        return_lse: bool = False,
+    ):
+        """Run decode attention for the planned geometry (reference
+        ``run``, decode.py:1810).  Scale factors fold into sm_scale / output
+        exactly as the reference does (decode.py:2004)."""
+        plan = self._plan
+        if plan is None:
+            raise RuntimeError("plan() must be called before run()")
+        if isinstance(paged_kv_cache, tuple):
+            k_cache, v_cache = paged_kv_cache
+        else:
+            k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
+        batch = q.shape[0]
+        assert batch == plan.batch_size, (
+            f"q batch {batch} != planned {plan.batch_size}"
+        )
+        sm_scale = plan.sm_scale
+        if q_scale is not None:
+            sm_scale *= q_scale
+        if k_scale is not None:
+            sm_scale *= k_scale
+
+        b_pad = plan.page_table.shape[0]
+        if b_pad != batch:
+            q = jnp.pad(q, ((0, b_pad - batch), (0, 0), (0, 0)))
+
+        backend = resolve_backend(self._backend, "batch_decode")
+        if backend == "pallas":
+            out = paged_decode_attention(
+                q, k_cache, v_cache, plan.page_table, plan.kv_lens,
+                sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, kv_layout=self._kv_layout,
+                return_lse=return_lse,
+            )
+        else:
+            out = xla_paged_decode(
+                q, k_cache, v_cache, plan.page_table, plan.kv_lens,
+                sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, return_lse=return_lse,
+                kv_layout=self._kv_layout,
+            )
+        if return_lse:
+            o, lse = out
+            if v_scale is not None:
+                o = (o.astype(jnp.float32) * v_scale).astype(o.dtype)
+            return o[:batch], lse[:batch]
+        if v_scale is not None:
+            out = (out.astype(jnp.float32) * v_scale).astype(out.dtype)
+        return out[:batch]
+
+    forward = run  # legacy alias kept by the reference
+
+    def end_forward(self) -> None:  # reference legacy no-op
+        pass

@@ -1,0 +1,17 @@
+"""Pallas/Mosaic kernel core + XLA reference implementations.
+
+The TPU-native equivalent of the reference's L0 kernel layer
+(``include/flashinfer/``): pure kernels with host dispatch, no wrapper state.
+"""
+
+from flashinfer_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from flashinfer_tpu.ops.paged_decode import paged_decode_attention  # noqa: F401
+from flashinfer_tpu.ops.merge import (  # noqa: F401
+    merge_state,
+    merge_state_in_place,
+    merge_states,
+)
+from flashinfer_tpu.ops.xla_ref import (  # noqa: F401
+    xla_paged_decode,
+    xla_ragged_attention,
+)

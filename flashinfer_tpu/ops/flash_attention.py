@@ -1,0 +1,225 @@
+"""Segment-ids flash attention Pallas kernel (prefill/append core).
+
+TPU-native re-design of the reference's FA2-style prefill kernels
+(``include/flashinfer/attention/prefill.cuh:2448,2682``).  Instead of the
+reference's per-request CTA work queue, raggedness is expressed the TPU way:
+all requests are flattened onto one token axis and a *segment id* per token
+keeps requests apart, so one dense grid serves single-request, ragged-batch
+and (after a gather) paged-batch prefill.  Masking modes (causal with
+bottom-right alignment, sliding window, custom bitmask via segment trick),
+logits soft-cap, GQA head grouping, and LSE output all live in this one
+kernel — they are closure specializations, the Pallas analogue of the
+reference's jinja-specialized kernel instantiations.
+
+Grid: ``(num_qo_heads, q_blocks, kv_blocks)`` with online-softmax state in
+VMEM scratch carried across the innermost kv dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import cdiv, round_up, use_interpret
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_KV = 512
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    # scalar-prefetch
+    # (none)
+    # inputs
+    q_ref,  # [bq, head_dim]
+    k_ref,  # [bkv, head_dim]
+    v_ref,  # [bkv, head_dim]
+    q_seg_ref,  # [bq, 1] int32
+    kv_seg_ref,  # [1, bkv] int32 (pre-transposed on host: lane-major)
+    q_pos_ref,  # [bq, 1] int32
+    kv_pos_ref,  # [1, bkv] int32
+    # outputs (lse_ref only present when return_lse)
+    *rest,
+    sm_scale: float,
+    causal: bool,
+    logits_soft_cap: float,
+    window_left: int,
+    num_kv_blocks: int,
+    return_lse: bool,
+):
+    if return_lse:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        lse_ref = None
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # native-dtype (bf16) matmul on the MXU, f32 accumulation
+    s = jax.lax.dot_general(
+        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bkv] f32
+    s = s * sm_scale
+    if logits_soft_cap > 0.0:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+
+    q_seg = q_seg_ref[...]  # [bq, 1]
+    kv_seg = kv_seg_ref[...]  # [1, bkv] — already lane-major, no transpose
+    mask = q_seg == kv_seg
+    q_pos = q_pos_ref[...]
+    kv_pos = kv_pos_ref[...]
+    if causal:
+        mask = mask & (kv_pos <= q_pos)
+    if window_left >= 0:
+        mask = mask & (kv_pos >= q_pos - window_left)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[...][:, :1]  # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows: keep exp argument finite
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+    l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kv_idx == num_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        if return_lse:
+            m = m_ref[...][:, :1]
+            lse = jnp.where(l > 0.0, m + jnp.log(l), _NEG_INF)
+            lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "sm_scale", "logits_soft_cap", "window_left",
+        "block_q", "block_kv", "return_lse",
+    ),
+)
+def flash_attention(
+    q: jax.Array,  # [total_q, num_qo_heads, head_dim]
+    k: jax.Array,  # [total_kv, num_kv_heads, head_dim]
+    v: jax.Array,  # [total_kv, num_kv_heads, head_dim_vo]
+    q_seg: jax.Array,  # [total_q] int32 segment ids (-1 = padding)
+    kv_seg: jax.Array,  # [total_kv] int32 segment ids (-2 = padding)
+    q_pos: jax.Array,  # [total_q] int32 in-request absolute positions
+    kv_pos: jax.Array,  # [total_kv] int32
+    *,
+    causal: bool = False,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+    return_lse: bool = False,
+):
+    """Ragged flash attention over flattened token axes.
+
+    GQA is handled by mapping each q head to its kv head (``h // group``) in
+    the kv BlockSpec index map.  Padding tokens must carry distinct negative
+    segment ids on the q/kv sides so they never match.
+    """
+    total_q, num_qo_heads, head_dim = q.shape
+    total_kv, num_kv_heads, head_dim_vo = v.shape[0], v.shape[1], v.shape[2]
+    assert num_qo_heads % num_kv_heads == 0
+    group = num_qo_heads // num_kv_heads
+
+    bq = min(block_q, total_q)
+    bkv = min(block_kv, total_kv)
+    # pad token axes to block multiples: out-of-bounds block tails would
+    # otherwise read undefined memory, and the padded segment ids (-1/-2)
+    # keep padding masked out of every score
+    pq = round_up(total_q, bq) - total_q
+    pkv = round_up(total_kv, bkv) - total_kv
+    if pq:
+        q = jnp.pad(q, ((0, pq), (0, 0), (0, 0)))
+        q_seg = jnp.pad(q_seg, (0, pq), constant_values=-1)
+        q_pos = jnp.pad(q_pos, (0, pq))
+    if pkv:
+        k = jnp.pad(k, ((0, pkv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, pkv), (0, 0), (0, 0)))
+        kv_seg = jnp.pad(kv_seg, (0, pkv), constant_values=-2)
+        kv_pos = jnp.pad(kv_pos, (0, pkv))
+    tq_pad, tkv_pad = total_q + pq, total_kv + pkv
+    nq, nkv = tq_pad // bq, tkv_pad // bkv
+
+    qT = jnp.swapaxes(q, 0, 1)  # [H, Tq, D]
+    kT = jnp.swapaxes(k, 0, 1)  # [Hkv, Tkv, D]
+    vT = jnp.swapaxes(v, 0, 1)
+
+    q_seg2 = q_seg.astype(jnp.int32).reshape(-1, 1)
+    kv_seg2 = kv_seg.astype(jnp.int32).reshape(1, -1)
+    q_pos2 = q_pos.astype(jnp.int32).reshape(-1, 1)
+    kv_pos2 = kv_pos.astype(jnp.int32).reshape(1, -1)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        logits_soft_cap=logits_soft_cap,
+        window_left=window_left,
+        num_kv_blocks=nkv,
+        return_lse=return_lse,
+    )
+
+    out_specs = [pl.BlockSpec((None, bq, head_dim_vo), lambda h, i, j: (h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((num_qo_heads, tq_pad, head_dim_vo), q.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((None, bq, 128), lambda h, i, j: (h, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((num_qo_heads, tq_pad, 128), jnp.float32)
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        grid=(num_qo_heads, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((None, bq, head_dim), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((None, bkv, head_dim), lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((None, bkv, head_dim_vo), lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
+            pl.BlockSpec((1, bkv), lambda h, i, j: (0, j)),
+            pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
+            pl.BlockSpec((1, bkv), lambda h, i, j: (0, j)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, head_dim_vo), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=use_interpret(),
+    )(qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)
+
+    out = jnp.swapaxes(results[0], 0, 1)[:total_q]  # [Tq, H, D]
+    if return_lse:
+        return out, jnp.swapaxes(results[1][..., 0], 0, 1)[:total_q]
+    return out

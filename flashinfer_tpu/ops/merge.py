@@ -1,0 +1,114 @@
+"""Attention-state merge operators.
+
+TPU re-design of the reference cascade-merge kernels
+(``include/flashinfer/attention/cascade.cuh:45-471``; math in
+``docs/tutorials/recursive_attention.rst``): an attention *state* is
+``(V, s)`` where ``V`` is the softmax-weighted value partial and ``s`` the
+log-sum-exp; states over disjoint KV sets merge associatively:
+
+    merge((Va, sa), (Vb, sb)) = ((Va*e^sa + Vb*e^sb)/(e^sa+e^sb), log(e^sa+e^sb))
+
+This is the algebra underlying split-KV decode, cascade/shared-prefix
+attention, and ring attention (SURVEY §5 long-context note).  These are
+small bandwidth-light ops, implemented in pure XLA (fuses into callers).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@jax.jit
+def merge_state(
+    v_a: jax.Array,  # [seq, heads, dim]
+    s_a: jax.Array,  # [seq, heads] lse (natural log)
+    v_b: jax.Array,
+    s_b: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge two attention states (reference ``merge_state``,
+    flashinfer/cascade.py:42)."""
+    sa = s_a.astype(jnp.float32)
+    sb = s_b.astype(jnp.float32)
+    m = jnp.maximum(sa, sb)
+    # guard all-masked states
+    m_safe = jnp.where(m > _NEG_INF / 2, m, 0.0)
+    wa = jnp.where(sa > _NEG_INF / 2, jnp.exp(sa - m_safe), 0.0)
+    wb = jnp.where(sb > _NEG_INF / 2, jnp.exp(sb - m_safe), 0.0)
+    tot = wa + wb
+    tot_safe = jnp.where(tot > 0, tot, 1.0)
+    v = (
+        v_a.astype(jnp.float32) * (wa / tot_safe)[..., None]
+        + v_b.astype(jnp.float32) * (wb / tot_safe)[..., None]
+    )
+    s = jnp.where(tot > 0, m_safe + jnp.log(tot), _NEG_INF)
+    return v.astype(v_a.dtype), s
+
+
+def merge_state_in_place(
+    v: jax.Array, s: jax.Array, v_other: jax.Array, s_other: jax.Array,
+    mask: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Functional form of the reference's in-place merge
+    (``merge_state_in_place``, cascade.py:42-170); optional per-seq bool mask
+    selects which rows merge (others pass through)."""
+    vm, sm = merge_state(v, s, v_other, s_other)
+    if mask is not None:
+        keep = mask.reshape(-1, *([1] * (v.ndim - 1)))
+        vm = jnp.where(keep, vm, v)
+        sm = jnp.where(mask.reshape(-1, *([1] * (s.ndim - 1))), sm, s)
+    return vm, sm
+
+
+@jax.jit
+def merge_states(
+    v: jax.Array,  # [seq, num_states, heads, dim]
+    s: jax.Array,  # [seq, num_states, heads]
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge N states per position (reference ``merge_states``,
+    cascade.cuh:214 MergeStates kernel)."""
+    sf = s.astype(jnp.float32)
+    m = jnp.max(sf, axis=1, keepdims=True)
+    m_safe = jnp.where(m > _NEG_INF / 2, m, 0.0)
+    w = jnp.where(sf > _NEG_INF / 2, jnp.exp(sf - m_safe), 0.0)
+    tot = jnp.sum(w, axis=1)  # [seq, heads]
+    tot_safe = jnp.where(tot > 0, tot, 1.0)
+    vm = jnp.einsum(
+        "snh,snhd->shd", w, v.astype(jnp.float32)
+    ) / tot_safe[..., None]
+    sm = jnp.where(tot > 0, m_safe[:, 0] + jnp.log(tot), _NEG_INF)
+    return vm.astype(v.dtype), sm
+
+
+@functools.partial(jax.jit, static_argnames=("n_out",))
+def variable_length_merge_states(
+    v: jax.Array,  # [total_chunks, heads, dim] partial outputs
+    s: jax.Array,  # [total_chunks, heads]
+    merge_indptr: jax.Array,  # [n_out + 1]: chunks i of output r in [indptr[r], indptr[r+1])
+    n_out: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Segment-merge of variable chunk counts per output position — the TPU
+    equivalent of ``VariableLengthMergeStates`` (cascade.cuh:368) used by
+    split-KV scheduling.  Implemented with segment max/sum (XLA scatter)."""
+    total = v.shape[0]
+    seg = jnp.searchsorted(
+        merge_indptr, jnp.arange(total), side="right"
+    ) - 1  # [total_chunks]
+    seg = jnp.clip(seg, 0, n_out - 1)
+    sf = s.astype(jnp.float32)
+    m = jnp.full((n_out,) + s.shape[1:], _NEG_INF, jnp.float32)
+    m = m.at[seg].max(sf)
+    m_safe = jnp.where(m > _NEG_INF / 2, m, 0.0)
+    w = jnp.where(sf > _NEG_INF / 2, jnp.exp(sf - m_safe[seg]), 0.0)
+    tot = jnp.zeros((n_out,) + s.shape[1:], jnp.float32).at[seg].add(w)
+    tot_safe = jnp.where(tot > 0, tot, 1.0)
+    vw = v.astype(jnp.float32) * w[..., None]
+    vm = jnp.zeros((n_out,) + v.shape[1:], jnp.float32).at[seg].add(vw)
+    vm = vm / tot_safe[..., None]
+    sm = jnp.where(tot > 0, m_safe + jnp.log(tot), _NEG_INF)
+    return vm.astype(v.dtype), sm

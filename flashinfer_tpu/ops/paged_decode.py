@@ -1,0 +1,240 @@
+"""Paged GQA decode attention Pallas kernel.
+
+TPU-native re-design of the reference batch-decode path
+(``include/flashinfer/attention/decode.cuh:613`` +
+``scheduler.cuh:426 DecodePlan``).  Key design departures, per SURVEY §7:
+
+- The KV page table is a *scalar-prefetch* operand; KV pages are gathered
+  HBM→VMEM with double-buffered async DMAs inside the kernel (the Pallas
+  paged-attention pattern), instead of the reference's ``paged_kv_t`` global
+  loads.
+- GQA "use_tensor_cores" trick maps to MXU-shaped q packing: the q heads of
+  one KV head are processed together as an [group_padded, head_dim] tile.
+- No split-KV grid balancing: a TPU core runs the grid sequentially with
+  pipelined DMA, so one kernel instance walks a request's whole KV range;
+  the reference's split-KV-then-merge machinery (needed to fill idle SMs)
+  is unnecessary.  LSE output is still available for cascade/DCP merging.
+
+Cache layouts: "HND" ``[num_pages, num_kv_heads, page_size, head_dim]``
+(TPU-preferred: one page+head slice is a contiguous [page_size, head_dim]
+DMA) or "NHD" ``[num_pages, page_size, num_kv_heads, head_dim]``
+(reference default; strided DMA).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import cdiv, round_up, use_interpret
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pages_ref,  # [B, P] int32 page table (padded with a valid page id)
+    kvlen_ref,  # [B] int32
+    # inputs
+    q_ref,  # [Gp, D] (block of [B, Hkv, Gp, D])
+    k_hbm,  # full cache in ANY/HBM
+    v_hbm,
+    # outputs
+    o_ref,  # [Gp, D]
+    lse_ref,  # [Gp, 128]
+    # scratch
+    k_buf,  # [2, chunk_tokens, D]
+    v_buf,  # [2, chunk_tokens, D]
+    sem,  # DMA sems [2, 2, ppc]
+    *,
+    page_size: int,
+    ppc: int,  # pages per chunk
+    max_chunks: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    nhd_cache: bool,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    kv_len = kvlen_ref[b]
+    chunk_tokens = ppc * page_size
+    num_chunks = pl.cdiv(kv_len, chunk_tokens)
+
+    def page_dmas(chunk_idx, slot):
+        dmas = []
+        for j in range(ppc):
+            page = pages_ref[b, chunk_idx * ppc + j]
+            if nhd_cache:
+                k_src = k_hbm.at[page, :, h, :]
+                v_src = v_hbm.at[page, :, h, :]
+            else:
+                k_src = k_hbm.at[page, h]
+                v_src = v_hbm.at[page, h]
+            dst = pl.ds(j * page_size, page_size)
+            dmas.append(
+                pltpu.make_async_copy(k_src, k_buf.at[slot, dst, :], sem.at[slot, 0, j])
+            )
+            dmas.append(
+                pltpu.make_async_copy(v_src, v_buf.at[slot, dst, :], sem.at[slot, 1, j])
+            )
+        return dmas
+
+    def start_chunk(chunk_idx, slot):
+        for dma in page_dmas(chunk_idx, slot):
+            dma.start()
+
+    def wait_chunk(chunk_idx, slot):
+        for dma in page_dmas(chunk_idx, slot):
+            dma.wait()
+
+    @pl.when(num_chunks > 0)
+    def _warmup():
+        start_chunk(0, 0)
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale  # [Gp, D]
+    gp = q.shape[0]
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < num_chunks)
+        def _prefetch():
+            start_chunk(i + 1, jax.lax.rem(i + 1, 2))
+
+        wait_chunk(i, slot)
+        k = k_buf[slot].astype(jnp.float32)  # [chunk_tokens, D]
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Gp, chunk_tokens]
+        if logits_soft_cap > 0.0:
+            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+        tok = i * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_tokens), 1
+        )
+        valid = tok < kv_len
+        if window_left >= 0:
+            valid = valid & (tok >= kv_len - 1 - window_left)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((gp, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((gp, 1), jnp.float32)
+    acc0 = jnp.zeros_like(q)
+    m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
+    lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "sm_scale", "logits_soft_cap", "window_left", "kv_layout",
+        "pages_per_chunk", "return_lse",
+    ),
+)
+def paged_decode_attention(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim]
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, max_pages] int32, padded with valid ids
+    kv_lens: jax.Array,  # [batch] int32
+    *,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    kv_layout: str = "HND",
+    pages_per_chunk: Optional[int] = None,
+    return_lse: bool = False,
+):
+    """Batched paged decode attention over a padded page table.
+
+    ``page_table``/``kv_lens`` are the plan arrays produced by
+    ``BatchDecodeWithPagedKVCacheWrapper.plan`` (padded-rectangular page
+    table replaces the reference's ragged indptr + CUDAGraph buffer pinning).
+    """
+    batch, num_qo_heads, head_dim = q.shape
+    if kv_layout == "HND":
+        num_pages, num_kv_heads, page_size, _ = k_cache.shape
+    else:
+        num_pages, page_size, num_kv_heads, _ = k_cache.shape
+    assert num_qo_heads % num_kv_heads == 0
+    group = num_qo_heads // num_kv_heads
+    gp = round_up(group, 8)
+
+    if pages_per_chunk is None:
+        pages_per_chunk = max(1, min(512 // page_size, 16))
+    max_pages = page_table.shape[1]
+    # pad page table columns to a multiple of pages-per-chunk
+    p_padded = round_up(max_pages, pages_per_chunk)
+    if p_padded != max_pages:
+        page_table = jnp.pad(page_table, ((0, 0), (0, p_padded - max_pages)))
+    max_chunks = p_padded // pages_per_chunk
+
+    # [B, Hq, D] -> [B, Hkv, Gp, D] with zero padding in the group dim
+    qg = q.reshape(batch, num_kv_heads, group, head_dim)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        ppc=pages_per_chunk,
+        max_chunks=max_chunks,
+        sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap,
+        window_left=window_left,
+        nhd_cache=(kv_layout == "NHD"),
+    )
+    chunk_tokens = pages_per_chunk * page_size
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, num_kv_heads),
+        in_specs=[
+            pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, gp, 128), lambda b, h, *_: (b, h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_tokens, head_dim), k_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, head_dim), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
+
+    out = out[:, :, :group, :].reshape(batch, num_qo_heads, head_dim)
+    if return_lse:
+        return out, lse[:, :, :group, 0].reshape(batch, num_qo_heads)
+    return out

@@ -1,0 +1,124 @@
+"""Pure-XLA reference/fallback attention implementations.
+
+The TPU analogue of the reference's multi-backend design
+(``determine_attention_backend``, flashinfer/utils.py:522): every Pallas
+kernel has an "xla" twin with identical semantics, used as the correctness
+oracle in tests and as the fallback backend off-TPU or for exotic shapes.
+These are dense (padded) computations — O(total_q * total_kv) — so they are
+for correctness, not speed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "logits_soft_cap", "window_left",
+                     "return_lse"),
+)
+def xla_ragged_attention(
+    q: jax.Array,  # [total_q, num_qo_heads, head_dim]
+    k: jax.Array,  # [total_kv, num_kv_heads, head_dim]
+    v: jax.Array,  # [total_kv, num_kv_heads, head_dim_vo]
+    q_seg: jax.Array,
+    kv_seg: jax.Array,
+    q_pos: jax.Array,
+    kv_pos: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    return_lse: bool = False,
+):
+    """Same contract as ops.flash_attention.flash_attention."""
+    num_qo_heads = q.shape[1]
+    num_kv_heads = k.shape[1]
+    group = num_qo_heads // num_kv_heads
+    qf = q.astype(jnp.float32)
+    kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * sm_scale
+    if logits_soft_cap > 0.0:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+    mask = q_seg[:, None] == kv_seg[None, :]
+    if causal:
+        mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+    if window_left >= 0:
+        mask = mask & (kv_pos[None, :] >= q_pos[:, None] - window_left)
+    s = jnp.where(mask[None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask[None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("hqk,khd->qhd", p / jnp.where(l > 0, l, 1.0), vf)
+    out = out.astype(q.dtype)
+    if return_lse:
+        lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
+        return out, jnp.swapaxes(lse, 0, 1)  # [total_q, H]
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "logits_soft_cap", "window_left", "return_lse",
+                     "kv_layout"),
+)
+def xla_paged_decode(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim]
+    k_cache: jax.Array,  # paged cache
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, max_pages] int32 (padded with any valid id)
+    kv_lens: jax.Array,  # [batch] int32
+    *,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    return_lse: bool = False,
+    kv_layout: str = "NHD",
+):
+    """Dense-gather paged decode reference: gathers the page table into a
+    padded [batch, max_kv, Hkv, D] tensor, then masked attention."""
+    if kv_layout == "HND":
+        k_cache = jnp.swapaxes(k_cache, 1, 2)
+        v_cache = jnp.swapaxes(v_cache, 1, 2)
+    batch, num_qo_heads, head_dim = q.shape
+    page_size = k_cache.shape[1]
+    num_kv_heads = k_cache.shape[2]
+    group = num_qo_heads // num_kv_heads
+    max_pages = page_table.shape[1]
+    max_kv = max_pages * page_size
+
+    kg = k_cache[page_table]  # [batch, max_pages, page_size, Hkv, D]
+    vg = v_cache[page_table]
+    kg = kg.reshape(batch, max_kv, num_kv_heads, -1)
+    vg = vg.reshape(batch, max_kv, num_kv_heads, -1)
+    kg = jnp.repeat(kg.astype(jnp.float32), group, axis=2)
+    vg = jnp.repeat(vg.astype(jnp.float32), group, axis=2)
+
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kg) * sm_scale
+    if logits_soft_cap > 0.0:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+    pos = jnp.arange(max_kv)[None, :]
+    mask = pos < kv_lens[:, None]
+    if window_left >= 0:
+        mask = mask & (pos >= kv_lens[:, None] - 1 - window_left)
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask[:, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vg)
+    out = out.astype(q.dtype)
+    if return_lse:
+        lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
+        return out, lse
+    return out

@@ -1,0 +1,351 @@
+"""Prefill/append attention: stateless single op + batch plan/run wrappers.
+
+TPU-native re-design of the reference prefill layer
+(``flashinfer/prefill.py:1117,1492,2947``; kernels prefill.cuh:2448-4057;
+plan ``PrefillPlan``/``PrefillSplitQOKVIndptr`` scheduler.cuh:545-897).
+
+The reference's plan bin-packs (request, qo-tile, kv-chunk) work units onto
+CTAs.  The TPU design replaces that with *flattened token axes + segment
+ids*: plan() lays all requests end-to-end on one padded token axis and
+emits per-token segment/position arrays; the one flash kernel
+(ops/flash_attention.py) then serves single, ragged-batch and paged-batch
+prefill.  Padding is bucketed (powers of two) to bound recompiles.
+
+For the paged case, plan() precomputes the flat cache-row gather index for
+every kv token, so run() is gather + flash kernel — prefill is
+compute-bound, so the one extra HBM pass is cheap relative to the matmuls
+(documented trade-off; a fused paged-prefill kernel is a later
+optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.ops.flash_attention import flash_attention
+from flashinfer_tpu.ops.xla_ref import xla_ragged_attention
+from flashinfer_tpu.utils import (
+    check_kv_layout,
+    get_sm_scale,
+    next_power_of_two,
+    resolve_backend,
+    TensorLayout,
+)
+
+_Q_PAD_SEG = -1
+_KV_PAD_SEG = -2
+
+
+def single_prefill_with_kv_cache(
+    q: jax.Array,  # [qo_len, num_qo_heads, head_dim]
+    k: jax.Array,  # [kv_len, num_kv_heads, head_dim] (NHD) or HND
+    v: jax.Array,
+    custom_mask: Optional[jax.Array] = None,
+    packed_custom_mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    kv_layout: str = "NHD",
+    pos_encoding_mode: str = "NONE",
+    sm_scale: Optional[float] = None,
+    window_left: int = -1,
+    logits_soft_cap: Optional[float] = None,
+    return_lse: bool = False,
+    backend: str = "auto",
+):
+    """Single-request prefill/append attention (reference
+    ``single_prefill_with_kv_cache``, flashinfer/prefill.py:1117).
+
+    Causal alignment is bottom-right: query ``i`` attends to kv positions
+    ``<= kv_len - qo_len + i`` (matching the reference's append semantics).
+    """
+    if custom_mask is not None or packed_custom_mask is not None:
+        raise NotImplementedError(
+            "custom masks land with the sparse-attention wrappers"
+        )
+    if pos_encoding_mode != "NONE":
+        raise NotImplementedError(
+            "apply flashinfer_tpu.rope explicitly before attention"
+        )
+    if check_kv_layout(kv_layout) == TensorLayout.HND:
+        k = jnp.swapaxes(k, 0, 1)
+        v = jnp.swapaxes(v, 0, 1)
+    qo_len, _, head_dim = q.shape
+    kv_len = k.shape[0]
+    sm_scale = get_sm_scale(head_dim, sm_scale)
+    backend = resolve_backend(backend, "single_prefill")
+    fn = flash_attention if backend == "pallas" else xla_ragged_attention
+    return fn(
+        q, k, v,
+        jnp.zeros((qo_len,), jnp.int32), jnp.zeros((kv_len,), jnp.int32),
+        jnp.arange(qo_len, dtype=jnp.int32) + (kv_len - qo_len),
+        jnp.arange(kv_len, dtype=jnp.int32),
+        causal=causal, sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap or 0.0,
+        window_left=window_left, return_lse=return_lse,
+    )
+
+
+@dataclass(frozen=True)
+class _PrefillPlan:
+    q_seg: jax.Array  # [Tq_pad] int32 (-1 pad)
+    q_pos: jax.Array  # [Tq_pad]
+    kv_seg: jax.Array  # [Tkv_pad] int32 (-2 pad)
+    kv_pos: jax.Array  # [Tkv_pad]
+    kv_gather_rows: Optional[jax.Array]  # [Tkv_pad] flat cache rows (paged)
+    out_scatter: jax.Array  # [Tq_pad] original token index (for unpad)
+    total_q: int
+    total_kv: int
+    tq_pad: int
+    tkv_pad: int
+    batch_size: int
+    num_qo_heads: int
+    num_kv_heads: int
+    head_dim: int
+    page_size: int
+    causal: bool
+    sm_scale: float
+    logits_soft_cap: float
+    window_left: int
+
+
+def _build_token_axis(
+    indptr: np.ndarray, pad_to: int, pad_seg: int, pos_offset: np.ndarray
+):
+    """Flatten ragged requests to one token axis: returns (seg, pos)."""
+    total = int(indptr[-1])
+    seg = np.full((pad_to,), pad_seg, np.int32)
+    pos = np.zeros((pad_to,), np.int32)
+    for r in range(len(indptr) - 1):
+        s, e = int(indptr[r]), int(indptr[r + 1])
+        seg[s:e] = r
+        pos[s:e] = np.arange(e - s) + int(pos_offset[r])
+    return seg, pos, total
+
+
+class BatchPrefillWithRaggedKVCacheWrapper:
+    """Ragged-KV batch prefill (reference
+    ``BatchPrefillWithRaggedKVCacheWrapper``, flashinfer/prefill.py:2947)."""
+
+    def __init__(
+        self,
+        float_workspace_buffer=None,
+        kv_layout: str = "NHD",
+        use_cuda_graph: bool = False,
+        backend: str = "auto",
+        **_unused,
+    ):
+        check_kv_layout(kv_layout)
+        self._kv_layout = kv_layout
+        self._backend = backend
+        self._plan: Optional[_PrefillPlan] = None
+
+    def plan(
+        self,
+        qo_indptr,
+        kv_indptr,
+        num_qo_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        causal: bool = False,
+        pos_encoding_mode: str = "NONE",
+        window_left: int = -1,
+        logits_soft_cap: Optional[float] = None,
+        sm_scale: Optional[float] = None,
+        q_data_type=jnp.bfloat16,
+        kv_data_type=None,
+        **_unused,
+    ) -> None:
+        qo_indptr = np.asarray(qo_indptr)
+        kv_indptr = np.asarray(kv_indptr)
+        batch = len(qo_indptr) - 1
+        qo_lens = qo_indptr[1:] - qo_indptr[:-1]
+        kv_lens = kv_indptr[1:] - kv_indptr[:-1]
+        tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
+        tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
+        # bottom-right causal alignment: q token i of request r sits at
+        # absolute position kv_len_r - qo_len_r + i
+        q_seg, q_pos, total_q = _build_token_axis(
+            qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
+        )
+        kv_seg, kv_pos, total_kv = _build_token_axis(
+            kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
+        )
+        self._plan = _PrefillPlan(
+            q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
+            kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
+            kv_gather_rows=None,
+            out_scatter=jnp.arange(tq_pad, dtype=jnp.int32),
+            total_q=total_q, total_kv=total_kv,
+            tq_pad=tq_pad, tkv_pad=tkv_pad, batch_size=batch,
+            num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
+            head_dim=head_dim, page_size=0,
+            causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
+            logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
+        )
+
+    def run(
+        self,
+        q: jax.Array,  # [total_q, num_qo_heads, head_dim]
+        k: jax.Array,  # [total_kv, num_kv_heads, head_dim]
+        v: jax.Array,
+        *,
+        return_lse: bool = False,
+    ):
+        plan = self._plan
+        if plan is None:
+            raise RuntimeError("plan() must be called before run()")
+        tq, tkv = plan.tq_pad, plan.tkv_pad
+        if q.shape[0] != tq:
+            q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
+        if k.shape[0] != tkv:
+            k = jnp.pad(k, ((0, tkv - k.shape[0]), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, tkv - v.shape[0]), (0, 0), (0, 0)))
+        backend = resolve_backend(self._backend, "batch_prefill_ragged")
+        fn = flash_attention if backend == "pallas" else xla_ragged_attention
+        out = fn(
+            q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+            causal=plan.causal, sm_scale=plan.sm_scale,
+            logits_soft_cap=plan.logits_soft_cap,
+            window_left=plan.window_left, return_lse=return_lse,
+        )
+        if return_lse:
+            return out[0][: plan.total_q], out[1][: plan.total_q]
+        return out[: plan.total_q]
+
+    forward = run
+
+    def end_forward(self) -> None:
+        pass
+
+
+class BatchPrefillWithPagedKVCacheWrapper:
+    """Paged-KV batch prefill/append (reference
+    ``BatchPrefillWithPagedKVCacheWrapper``, flashinfer/prefill.py:1492).
+
+    plan() precomputes flat gather rows for every kv token of every request;
+    run() gathers the paged cache into the flattened ragged KV axis and
+    invokes the segment flash kernel."""
+
+    def __init__(
+        self,
+        float_workspace_buffer=None,
+        kv_layout: str = "NHD",
+        use_cuda_graph: bool = False,
+        backend: str = "auto",
+        **_unused,
+    ):
+        check_kv_layout(kv_layout)
+        self._kv_layout = kv_layout
+        self._backend = backend
+        self._plan: Optional[_PrefillPlan] = None
+
+    def plan(
+        self,
+        qo_indptr,
+        paged_kv_indptr,
+        paged_kv_indices,
+        paged_kv_last_page_len,
+        num_qo_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int,
+        causal: bool = False,
+        pos_encoding_mode: str = "NONE",
+        window_left: int = -1,
+        logits_soft_cap: Optional[float] = None,
+        sm_scale: Optional[float] = None,
+        q_data_type=jnp.bfloat16,
+        kv_data_type=None,
+        **_unused,
+    ) -> None:
+        qo_indptr = np.asarray(qo_indptr)
+        kv_indptr_pages = np.asarray(paged_kv_indptr)
+        kv_indices = np.asarray(paged_kv_indices)
+        last_page_len = np.asarray(paged_kv_last_page_len)
+        batch = len(qo_indptr) - 1
+        pages_per_req = kv_indptr_pages[1:] - kv_indptr_pages[:-1]
+        kv_lens = np.where(
+            pages_per_req > 0,
+            (pages_per_req - 1) * page_size + last_page_len,
+            0,
+        ).astype(np.int64)
+        kv_indptr = np.concatenate([[0], np.cumsum(kv_lens)])
+        qo_lens = qo_indptr[1:] - qo_indptr[:-1]
+
+        tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
+        tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
+        q_seg, q_pos, total_q = _build_token_axis(
+            qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
+        )
+        kv_seg, kv_pos, total_kv = _build_token_axis(
+            kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
+        )
+        # flat cache-row id for each flattened kv token
+        rows = np.zeros((tkv_pad,), np.int64)
+        for r in range(batch):
+            s = int(kv_indptr[r])
+            n = int(kv_lens[r])
+            pages = kv_indices[
+                int(kv_indptr_pages[r]) : int(kv_indptr_pages[r + 1])
+            ]
+            tok = np.arange(n)
+            rows[s : s + n] = pages[tok // page_size] * page_size + tok % page_size
+        self._plan = _PrefillPlan(
+            q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
+            kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
+            kv_gather_rows=jnp.asarray(rows, dtype=jnp.int32),
+            out_scatter=jnp.arange(tq_pad, dtype=jnp.int32),
+            total_q=total_q, total_kv=total_kv,
+            tq_pad=tq_pad, tkv_pad=tkv_pad, batch_size=batch,
+            num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
+            head_dim=head_dim, page_size=page_size,
+            causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
+            logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
+        )
+
+    def run(
+        self,
+        q: jax.Array,  # [total_q, num_qo_heads, head_dim]
+        paged_kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+        *,
+        return_lse: bool = False,
+    ):
+        plan = self._plan
+        if plan is None:
+            raise RuntimeError("plan() must be called before run()")
+        if isinstance(paged_kv_cache, tuple):
+            k_cache, v_cache = paged_kv_cache
+        else:
+            k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
+        if check_kv_layout(self._kv_layout) == TensorLayout.HND:
+            k_cache = jnp.swapaxes(k_cache, 1, 2)
+            v_cache = jnp.swapaxes(v_cache, 1, 2)
+        # [num_pages, page_size, Hkv, D] -> row gather
+        kflat = k_cache.reshape(-1, *k_cache.shape[2:])
+        vflat = v_cache.reshape(-1, *v_cache.shape[2:])
+        k = kflat[plan.kv_gather_rows]
+        v = vflat[plan.kv_gather_rows]
+        tq = plan.tq_pad
+        if q.shape[0] != tq:
+            q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
+        backend = resolve_backend(self._backend, "batch_prefill_paged")
+        fn = flash_attention if backend == "pallas" else xla_ragged_attention
+        out = fn(
+            q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+            causal=plan.causal, sm_scale=plan.sm_scale,
+            logits_soft_cap=plan.logits_soft_cap,
+            window_left=plan.window_left, return_lse=return_lse,
+        )
+        if return_lse:
+            return out[0][: plan.total_q], out[1][: plan.total_q]
+        return out[: plan.total_q]
+
+    forward = run
+
+    def end_forward(self) -> None:
+        pass

@@ -85,8 +85,10 @@ def top_p_renorm_probs(probs: jax.Array, top_p) -> jax.Array:
     tp = _as_batch_param(top_p, p.shape[0]).astype(jnp.float32)[:, None]
     sorted_p = jnp.sort(p, axis=-1)[:, ::-1]
     cum = jnp.cumsum(sorted_p, axis=-1)
-    # keep entries whose preceding cumulative mass is < top_p
-    keep_sorted = (cum - sorted_p) < tp
+    # keep entries whose preceding cumulative mass is < top_p; always keep
+    # the top-1 token (top_p=0 means greedy, matching the reference kernels)
+    rank0 = jnp.arange(p.shape[-1])[None, :] == 0
+    keep_sorted = ((cum - sorted_p) < tp) | rank0
     # threshold = smallest kept probability
     thresh = jnp.min(
         jnp.where(keep_sorted, sorted_p, jnp.inf), axis=-1, keepdims=True
@@ -176,17 +178,20 @@ def _top_k_top_p_filter(probs: jax.Array, top_k, top_p, joint: bool) -> jax.Arra
     tp = _as_batch_param(top_p, batch).astype(jnp.float32)[:, None]
     sorted_p = jnp.sort(p, axis=-1)[:, ::-1]
     rank = jnp.arange(vocab)[None, :]
-    topk_mask_sorted = rank < k
+    # always keep at least the top-1 token (top_k=0 / top_p=0 mean greedy)
+    topk_mask_sorted = (rank < k) | (rank == 0)
     cum = jnp.cumsum(sorted_p, axis=-1)
     if joint:
-        topp_mask_sorted = (cum - sorted_p) < tp
+        topp_mask_sorted = ((cum - sorted_p) < tp) | (rank == 0)
     else:
         topk_mass = jnp.sum(jnp.where(topk_mask_sorted, sorted_p, 0.0), axis=-1,
                             keepdims=True)
         cum_renormed = jnp.cumsum(
             jnp.where(topk_mask_sorted, sorted_p, 0.0), axis=-1
         ) / jnp.maximum(topk_mass, 1e-30)
-        topp_mask_sorted = (cum_renormed - sorted_p / jnp.maximum(topk_mass, 1e-30)) < tp
+        topp_mask_sorted = (
+            (cum_renormed - sorted_p / jnp.maximum(topk_mass, 1e-30)) < tp
+        ) | (rank == 0)
     keep_sorted = topk_mask_sorted & topp_mask_sorted
     thresh = jnp.min(
         jnp.where(keep_sorted, sorted_p, jnp.inf), axis=-1, keepdims=True

@@ -1,0 +1,141 @@
+"""Attention correctness: single prefill/decode ops, flash kernel features,
+and merge operators — vs the eager reference (mirrors the reference's
+tests/attention/ strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.ops import flash_attention, merge_state, merge_states
+from flashinfer_tpu.ops.merge import variable_length_merge_states
+from flashinfer_tpu.testing import attention_ref
+
+
+@pytest.mark.parametrize("qo_len,kv_len", [(1, 64), (64, 64), (17, 99), (128, 256)])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_single_prefill(qo_len, kv_len, causal, backend):
+    H, KVH, D = 4, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo_len, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv_len, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv_len, KVH, D), jnp.float32)
+    out = fi.single_prefill_with_kv_cache(q, k, v, causal=causal, backend=backend)
+    ref = attention_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("window_left", [-1, 16])
+@pytest.mark.parametrize("soft_cap", [0.0, 30.0])
+def test_single_prefill_features(window_left, soft_cap):
+    qo_len, kv_len, H, KVH, D = 32, 128, 2, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo_len, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv_len, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv_len, KVH, D), jnp.float32)
+    out = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=True, window_left=window_left,
+        logits_soft_cap=soft_cap, backend="pallas",
+    )
+    ref = attention_ref(
+        q, k, v, causal=True, window_left=window_left, logits_soft_cap=soft_cap
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
+def test_single_decode(kv_layout):
+    H, KVH, D, S = 8, 2, 64, 133
+    q = jax.random.normal(jax.random.PRNGKey(0), (H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (S, KVH, D), jnp.float32)
+    kk = jnp.swapaxes(k, 0, 1) if kv_layout == "HND" else k
+    vv = jnp.swapaxes(v, 0, 1) if kv_layout == "HND" else v
+    out = fi.single_decode_with_kv_cache(q, kk, vv, kv_layout=kv_layout)
+    ref = attention_ref(q[None], k, v)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_single_decode_lse():
+    H, KVH, D, S = 4, 4, 64, 77
+    q = jax.random.normal(jax.random.PRNGKey(0), (H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (S, KVH, D), jnp.float32)
+    out, lse = fi.single_decode_with_kv_cache(q, k, v, return_lse=True)
+    ref, lse_ref = attention_ref(q[None], k, v, return_lse=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref[0]), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref[0]), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_ragged_segments():
+    """Two requests flattened on one axis must not attend across segments."""
+    H, KVH, D = 2, 2, 64
+    lens = [48, 80]
+    T = sum(lens)
+    q = jax.random.normal(jax.random.PRNGKey(0), (T, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (T, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (T, KVH, D), jnp.float32)
+    seg = jnp.array([0] * 48 + [1] * 80, jnp.int32)
+    pos = jnp.concatenate([jnp.arange(48), jnp.arange(80)]).astype(jnp.int32)
+    out = flash_attention(
+        q, k, v, seg, seg, pos, pos, causal=True, sm_scale=0.125,
+        block_q=64, block_kv=64,
+    )
+    # per-request reference
+    o0 = attention_ref(q[:48], k[:48], v[:48], causal=True, sm_scale=0.125)
+    o1 = attention_ref(q[48:], k[48:], v[48:], causal=True, sm_scale=0.125)
+    np.testing.assert_allclose(np.asarray(out[:48]), np.asarray(o0), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out[48:]), np.asarray(o1), rtol=2e-3, atol=2e-3)
+
+
+def test_merge_state_identity():
+    """Merging a state with itself keeps V, adds log(2) to LSE."""
+    v = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 64))
+    s = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+    vm, sm = merge_state(v, s, v, s)
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(v), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sm), np.asarray(s) + np.log(2), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_merge_matches_full_attention():
+    """Split-KV invariant: attention over [K1;K2] == merge(attn(K1), attn(K2))."""
+    H, D, S = 4, 64, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (8, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (S, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (S, H, D), jnp.float32)
+    full, _ = attention_ref(q, k, v, return_lse=True)
+    o1, s1 = attention_ref(q, k[: S // 2], v[: S // 2], return_lse=True)
+    o2, s2 = attention_ref(q, k[S // 2 :], v[S // 2 :], return_lse=True)
+    om, _ = merge_state(o1, s1, o2, s2)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(full), rtol=1e-4, atol=1e-4)
+
+
+def test_merge_states_n():
+    n = 4
+    H, D = 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (8, H, D), jnp.float32)
+    ks = [jax.random.normal(jax.random.PRNGKey(10 + i), (32, H, D)) for i in range(n)]
+    vs = [jax.random.normal(jax.random.PRNGKey(20 + i), (32, H, D)) for i in range(n)]
+    full, _ = attention_ref(q, jnp.concatenate(ks), jnp.concatenate(vs), return_lse=True)
+    parts = [attention_ref(q, ks[i], vs[i], return_lse=True) for i in range(n)]
+    vstack = jnp.stack([p[0] for p in parts], axis=1)  # [seq, n, H, D]
+    sstack = jnp.stack([p[1] for p in parts], axis=1)
+    vm, _ = merge_states(vstack, sstack)
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(full), rtol=1e-4, atol=1e-4)
+
+
+def test_variable_length_merge_states():
+    H, D = 2, 32
+    # 3 outputs with 2, 1, 3 chunks
+    merge_indptr = jnp.array([0, 2, 3, 6], jnp.int32)
+    v = jax.random.normal(jax.random.PRNGKey(0), (6, H, D), jnp.float32)
+    s = jax.random.normal(jax.random.PRNGKey(1), (6, H), jnp.float32)
+    vm, sm = variable_length_merge_states(v, s, merge_indptr, 3)
+    # row 1 has a single chunk: passthrough
+    np.testing.assert_allclose(np.asarray(vm[1]), np.asarray(v[2]), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sm[1]), np.asarray(s[2]), rtol=1e-5)
+    # row 0 = merge of chunks 0,1
+    v01, s01 = merge_state(v[0:1], s[0:1], v[1:2], s[1:2])
+    np.testing.assert_allclose(np.asarray(vm[0]), np.asarray(v01[0]), rtol=1e-5, atol=1e-5)

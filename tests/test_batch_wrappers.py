@@ -1,0 +1,188 @@
+"""Plan/run wrapper tests: batch decode + batch prefill (paged & ragged) +
+cascade, vs per-request eager references (mirrors reference
+tests/attention/test_batch_prefill_kernels.py / test_batch_decode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.testing import attention_ref
+
+
+def _make_paged_cache(key, num_pages, page_size, kvh, d, kv_layout, dtype=jnp.float32):
+    shape = (
+        (num_pages, page_size, kvh, d)
+        if kv_layout == "NHD"
+        else (num_pages, kvh, page_size, d)
+    )
+    k = jax.random.normal(key, shape, dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 1), shape, dtype)
+    return k, v
+
+
+def _cache_rows(cache, kv_layout):
+    """[pages, ...] -> [pages*page_size, kvh, d] row view."""
+    if kv_layout == "HND":
+        cache = jnp.swapaxes(cache, 1, 2)
+    return cache.reshape(-1, cache.shape[2], cache.shape[3])
+
+
+def _ragged_kv_for_request(cache_rows, pages, page_size, kv_len):
+    rows = []
+    for t in range(kv_len):
+        rows.append(cache_rows[pages[t // page_size] * page_size + t % page_size])
+    return jnp.stack(rows)
+
+
+@pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_batch_decode_wrapper(kv_layout, backend):
+    B, HQ, HKV, D, PS = 5, 8, 2, 64, 8
+    kv_lens = [37, 8, 1, 64, 100]
+    num_pages = 64
+    rng = np.random.default_rng(0)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: indptr[-1]].astype(np.int32)
+    last_page = np.array([l - (p - 1) * PS for l, p in zip(kv_lens, pages_per)], np.int32)
+
+    kc, vc = _make_paged_cache(jax.random.PRNGKey(0), num_pages, PS, HKV, D, kv_layout)
+    q = jax.random.normal(jax.random.PRNGKey(1), (B, HQ, D), jnp.float32)
+
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout=kv_layout, backend=backend)
+    w.plan(indptr, indices, last_page, HQ, HKV, D, PS)
+    out, lse = w.run(q, (kc, vc), return_lse=True)
+
+    rows = _cache_rows(kc, kv_layout)
+    vrows = _cache_rows(vc, kv_layout)
+    for b in range(B):
+        pages = indices[indptr[b] : indptr[b + 1]]
+        kb = _ragged_kv_for_request(rows, pages, PS, kv_lens[b])
+        vb = _ragged_kv_for_request(vrows, pages, PS, kv_lens[b])
+        ref, lse_ref = attention_ref(q[b : b + 1], kb, vb, return_lse=True)
+        np.testing.assert_allclose(
+            np.asarray(out[b]), np.asarray(ref[0]), rtol=2e-3, atol=2e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse[b]), np.asarray(lse_ref[0]), rtol=1e-3, atol=1e-3
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_batch_prefill_ragged_wrapper(causal, backend):
+    HQ, HKV, D = 4, 2, 64
+    qo_lens = [17, 64, 3]
+    kv_lens = [40, 64, 30]
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    kv_indptr = np.concatenate([[0], np.cumsum(kv_lens)])
+    q = jax.random.normal(jax.random.PRNGKey(0), (int(qo_indptr[-1]), HQ, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (int(kv_indptr[-1]), HKV, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (int(kv_indptr[-1]), HKV, D), jnp.float32)
+
+    w = fi.BatchPrefillWithRaggedKVCacheWrapper(backend=backend)
+    w.plan(qo_indptr, kv_indptr, HQ, HKV, D, causal=causal)
+    out = w.run(q, k, v)
+    assert out.shape == q.shape
+    for r in range(3):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        ks, ke = kv_indptr[r], kv_indptr[r + 1]
+        ref = attention_ref(q[qs:qe], k[ks:ke], v[ks:ke], causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )
+
+
+@pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
+def test_batch_prefill_paged_wrapper(kv_layout):
+    HQ, HKV, D, PS = 4, 2, 64, 8
+    qo_lens = [5, 33]
+    kv_lens = [21, 60]
+    num_pages = 32
+    rng = np.random.default_rng(1)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    kv_indptr_pages = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: kv_indptr_pages[-1]].astype(np.int32)
+    last_page = np.array(
+        [l - (p - 1) * PS for l, p in zip(kv_lens, pages_per)], np.int32
+    )
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int32)
+
+    kc, vc = _make_paged_cache(jax.random.PRNGKey(3), num_pages, PS, HKV, D, kv_layout)
+    q = jax.random.normal(jax.random.PRNGKey(4), (int(qo_indptr[-1]), HQ, D), jnp.float32)
+
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout=kv_layout)
+    w.plan(qo_indptr, kv_indptr_pages, indices, last_page, HQ, HKV, D, PS, causal=True)
+    out = w.run(q, (kc, vc))
+
+    rows = _cache_rows(kc, kv_layout)
+    vrows = _cache_rows(vc, kv_layout)
+    for r in range(2):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        pages = indices[kv_indptr_pages[r] : kv_indptr_pages[r + 1]]
+        kb = _ragged_kv_for_request(rows, pages, PS, kv_lens[r])
+        vb = _ragged_kv_for_request(vrows, pages, PS, kv_lens[r])
+        ref = attention_ref(q[qs:qe], kb, vb, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )
+
+
+def test_cascade_two_level_matches_flat():
+    """Shared prefix + unique suffix via cascade == flat attention over the
+    concatenated KV (the recursive-attention invariant)."""
+    HQ, HKV, D, PS = 4, 2, 64, 8
+    shared_len, unique_lens, qo_lens = 32, [16, 24], [8, 16]
+    B = 2
+    num_pages = 32
+    shared_pages = list(range(shared_len // PS))
+    next_page = len(shared_pages)
+    uniq_pages = []
+    for ul in unique_lens:
+        n = -(-ul // PS)
+        uniq_pages.append(list(range(next_page, next_page + n)))
+        next_page += n
+
+    kc = jax.random.normal(jax.random.PRNGKey(0), (num_pages, PS, HKV, D), jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(1), (num_pages, PS, HKV, D), jnp.float32)
+    total_q = sum(qo_lens)
+    q = jax.random.normal(jax.random.PRNGKey(2), (total_q, HQ, D), jnp.float32)
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int32)
+
+    # level 0: every request sees the shared pages; level 1: unique pages
+    lvl0_indptr = np.array([0, len(shared_pages), 2 * len(shared_pages)], np.int32)
+    lvl0_indices = np.array(shared_pages * B, np.int32)
+    lvl0_last = np.array([PS, PS], np.int32)
+    lvl1_indptr = np.concatenate([[0], np.cumsum([len(p) for p in uniq_pages])]).astype(np.int32)
+    lvl1_indices = np.array(sum(uniq_pages, []), np.int32)
+    lvl1_last = np.array(
+        [ul - (len(p) - 1) * PS for ul, p in zip(unique_lens, uniq_pages)], np.int32
+    )
+
+    w = fi.MultiLevelCascadeAttentionWrapper(2)
+    w.plan(
+        [qo_indptr, qo_indptr],
+        [lvl0_indptr, lvl1_indptr],
+        [lvl0_indices, lvl1_indices],
+        [lvl0_last, lvl1_last],
+        HQ, HKV, D, PS, causal=True,
+    )
+    out = w.run(q, (kc, vc))
+
+    rows = kc.reshape(-1, HKV, D)
+    vrows = vc.reshape(-1, HKV, D)
+    for r in range(B):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        pages = shared_pages + uniq_pages[r]
+        kv_len = shared_len + unique_lens[r]
+        kb = _ragged_kv_for_request(rows, np.array(pages), PS, kv_len)
+        vb = _ragged_kv_for_request(vrows, np.array(pages), PS, kv_len)
+        ref = attention_ref(q[qs:qe], kb, vb, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )
