@@ -1,0 +1,94 @@
+"""Headline benchmark: batched paged-KV decode attention on one TPU chip.
+
+Ports the reference's ``benchmarks/bench_batch_decode.py`` headline config
+(Llama-3 GQA 32/8 heads, head_dim 128, page 16; see BASELINE.md metric #2)
+and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: achieved HBM bandwidth (TB/s) of ``BatchDecodeWithPagedKVCacheWrapper``
+at bs=64, ctx=4096 — decode attention is bandwidth-bound, so TB/s is the
+hardware-honest throughput number (testing/utils.py attention_tb_per_sec
+equivalent).  ``vs_baseline`` = fraction of this chip's HBM peak (v5e ~0.82
+TB/s, v5p ~2.76 TB/s), i.e. roofline efficiency — the reference publishes
+no absolute numbers (BASELINE.md), so roofline fraction is the comparable.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+HBM_PEAK_TBPS = {
+    "v5e": 0.819,
+    "v5": 0.819,  # v5 lite
+    "v5p": 2.765,
+    "v4": 1.228,
+    "v6e": 1.64,
+}
+
+
+def chip_peak_tbps() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in sorted(HBM_PEAK_TBPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind.replace(" ", ""):
+            return val
+    return 0.819
+
+
+def main():
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import bench_fn, attention_bytes
+
+    batch, ctx, page_size = 64, 4096, 16
+    num_qo_heads, num_kv_heads, head_dim = 32, 8, 128
+    dtype = jnp.bfloat16
+
+    pages_per_req = ctx // page_size
+    num_pages = batch * pages_per_req
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(num_pages).astype(np.int32)
+    indptr = np.arange(batch + 1, dtype=np.int32) * pages_per_req
+    last_page = np.full((batch,), page_size, np.int32)
+
+    key = jax.random.PRNGKey(0)
+    # HND cache layout (TPU-preferred contiguous page DMA)
+    kc = jax.random.normal(
+        key, (num_pages, num_kv_heads, page_size, head_dim), dtype
+    )
+    vc = jax.random.normal(
+        jax.random.fold_in(key, 1), (num_pages, num_kv_heads, page_size, head_dim),
+        dtype,
+    )
+    q = jax.random.normal(
+        jax.random.fold_in(key, 2), (batch, num_qo_heads, head_dim), dtype
+    )
+
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads, head_dim, page_size)
+
+    t = bench_fn(lambda: w.run(q, (kc, vc)), warmup=5, iters=30)
+
+    total_bytes = sum(
+        attention_bytes(1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2)
+        for _ in range(batch)
+    )
+    tbps = total_bytes / t / 1e12
+    peak = chip_peak_tbps()
+    print(
+        json.dumps(
+            {
+                "metric": "batch_decode_attention_bandwidth_bs64_ctx4k",
+                "value": round(tbps, 4),
+                "unit": "TB/s",
+                "vs_baseline": round(tbps / peak, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,33 @@ from flashinfer_tpu.prefill import (  # noqa: F401
     BatchPrefillWithRaggedKVCacheWrapper,
     single_prefill_with_kv_cache,
 )
+from flashinfer_tpu.gemm import (  # noqa: F401
+    SegmentGEMMWrapper,
+    bmm_bf16,
+    bmm_fp8,
+    grouped_gemm,
+    mm_bf16,
+    mm_fp8,
+    mm_int8,
+)
+from flashinfer_tpu.quantization import (  # noqa: F401
+    dequantize_fp8,
+    packbits,
+    quantize_fp8_per_channel,
+    quantize_fp8_per_tensor,
+    quantize_int8,
+    segment_packbits,
+)
+from flashinfer_tpu.sparse import (  # noqa: F401
+    BlockSparseAttentionWrapper,
+    VariableBlockSparseAttentionWrapper,
+)
+from flashinfer_tpu.topk import (  # noqa: F401
+    top_k_indices,
+    top_k_mask,
+    top_k_page_table_transform,
+    top_k_values_indices,
+)
 
 from flashinfer_tpu.activation import (  # noqa: F401
     gelu_and_mul,

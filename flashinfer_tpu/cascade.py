@@ -71,6 +71,12 @@ class MultiLevelCascadeAttentionWrapper:
         """Plan each level.  Causal masking applies only to the last level
         (a query never attends ahead of itself in its own suffix; shared
         prefixes are fully visible), matching the reference's usage."""
+        if window_left >= 0 and self._num_levels > 1:
+            # prefix levels use level-local positions, so a sliding window
+            # would be misaligned across levels; needs global-position plumb
+            raise NotImplementedError(
+                "sliding window across cascade levels is not supported yet"
+            )
         for lvl, w in enumerate(self._wrappers):
             w.plan(
                 qo_indptr_arr[lvl],

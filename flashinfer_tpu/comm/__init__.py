@@ -1,0 +1,26 @@
+"""Communication & parallelism layer.
+
+TPU-native re-design of the reference comm layer (``flashinfer/comm/``,
+SURVEY §2.4).  The reference hand-rolls device-side collectives over CUDA
+IPC / NVLink / MNNVL / NVSHMEM with MPI/torch.distributed bootstrap; the
+TPU equivalents are XLA collectives over ICI/DCN inside ``shard_map`` —
+one-shot vs two-shot strategy selection, IPC workspaces, Lamport buffers
+and fabric-handle exchange all disappear into the compiler.  What remains
+(and lives here) is:
+
+- ``Mapping``: rank topology math (tp/pp/cp/dp/moe_tp/moe_ep) — same
+  bookkeeping role as ``flashinfer/comm/mapping.py:21``.
+- ``allreduce`` facade: ``allreduce`` / ``allreduce_fusion`` (residual +
+  RMSNorm [+ quant] epilogues) mirroring the reference's unified API
+  (``flashinfer/comm/allreduce.py``), implemented as jit-fusable psum
+  compositions to be used inside shard_map.
+- ``all_to_all`` helpers for EP dispatch/combine and DCP decode.
+"""
+
+from flashinfer_tpu.comm.mapping import Mapping  # noqa: F401
+from flashinfer_tpu.comm.allreduce import (  # noqa: F401
+    allreduce,
+    allreduce_fusion,
+    allgather,
+    reducescatter,
+)

@@ -1,0 +1,76 @@
+"""Unified allreduce / fused-allreduce API over mesh axes.
+
+TPU re-design of the reference's unified allreduce surface
+(``flashinfer/comm/allreduce.py:107-525`` facade over TRTLLM/MNNVL IPC
+kernels, fusion patterns ``AllReduceFusionPattern`` trtllm_ar.py:68-100).
+
+On TPU there is no workspace creation, no one-shot/two-shot strategy choice
+and no Lamport buffers: ``jax.lax.psum`` inside ``shard_map`` compiles to
+the optimal ICI collective.  What this module preserves is the *fusion
+surface*: allreduce + residual-add + RMSNorm (+ FP8 quantize) as one jitted
+composition so XLA fuses the epilogue into the collective's output pass —
+the same latency motivation as the reference's fused kernels.
+
+All functions here must be called **inside shard_map** with the named axis
+present.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def allreduce(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Plain sum-allreduce over a mesh axis (reference
+    ``allreduce``/trtllm_custom_all_reduce)."""
+    return jax.lax.psum(x, axis)
+
+
+def allgather(x: jax.Array, axis: str = "tp", *, tiled: bool = True) -> jax.Array:
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reducescatter(x: jax.Array, axis: str = "tp") -> jax.Array:
+    return jax.lax.psum_scatter(x, axis, tiled=True)
+
+
+def allreduce_fusion(
+    x: jax.Array,  # [tokens, hidden] partial sums (e.g. o_proj shard output)
+    residual: Optional[jax.Array] = None,  # [tokens, hidden]
+    rms_weight: Optional[jax.Array] = None,  # [hidden]
+    eps: float = 1e-6,
+    axis: str = "tp",
+    quant_dtype=None,  # e.g. jnp.float8_e4m3fn for AR+norm+quant patterns
+) -> Tuple[jax.Array, ...]:
+    """Allreduce with fused residual-add + RMSNorm (+ quantize) epilogue.
+
+    Pattern table mirrors ``AllReduceFusionPattern`` (trtllm_ar.py:68):
+    - residual=None, rms_weight=None    -> kAllReduce: returns (sum,)
+    - residual, rms_weight              -> kARResidualRMSNorm:
+          returns (normed, new_residual)
+    - + quant_dtype                     -> kARResidualRMSNormFP8Quant:
+          returns (quantized, scale, new_residual)
+    """
+    s = jax.lax.psum(x, axis)
+    if residual is None and rms_weight is None:
+        return (s,)
+    sf = s.astype(jnp.float32)
+    if residual is not None:
+        sf = sf + residual.astype(jnp.float32)
+    new_residual = sf.astype(x.dtype)
+    if rms_weight is None:
+        return (new_residual,)
+    var = jnp.mean(sf * sf, axis=-1, keepdims=True)
+    normed = sf * jax.lax.rsqrt(var + eps) * rms_weight.astype(jnp.float32)
+    if quant_dtype is None:
+        return normed.astype(x.dtype), new_residual
+    amax = jnp.max(jnp.abs(normed))
+    finfo = jnp.finfo(quant_dtype)
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(normed / scale, float(finfo.min), float(finfo.max)).astype(
+        quant_dtype
+    )
+    return q, scale.astype(jnp.float32), new_residual

@@ -1,0 +1,126 @@
+"""Rank topology math: the TPU ``Mapping``.
+
+Re-design of the reference ``Mapping`` (``flashinfer/comm/mapping.py:21-461``):
+the same tp/pp/cp/dp/moe_tp/moe_ep bookkeeping, but instead of deriving
+*process group rank lists* for NCCL it derives **mesh axis layouts** for
+``jax.sharding.Mesh`` — on TPU the collectives are compiled, not brokered,
+so the Mapping's job is to build the mesh and name the axes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Mapping:
+    """Topology descriptor over ``world_size`` devices.
+
+    Axes (any may be 1): ``dp`` (data/batch), ``cp`` (context/sequence),
+    ``tp`` (tensor), ``pp`` (pipeline); MoE sub-axes ``moe_tp``/``moe_ep``
+    factor the tp axis for expert layers (reference mapping.py moe_cluster
+    semantics collapse into this factoring).
+    """
+
+    world_size: int = 1
+    tp_size: int = 1
+    pp_size: int = 1
+    cp_size: int = 1
+    dp_size: int = 1
+    moe_tp_size: int = 1
+    moe_ep_size: int = 1
+
+    def __post_init__(self):
+        if self.dp_size * self.cp_size * self.tp_size * self.pp_size != self.world_size:
+            raise ValueError(
+                f"dp*cp*tp*pp = "
+                f"{self.dp_size * self.cp_size * self.tp_size * self.pp_size}"
+                f" != world_size {self.world_size}"
+            )
+        if self.moe_tp_size * self.moe_ep_size not in (1, self.tp_size):
+            raise ValueError(
+                "moe_tp_size * moe_ep_size must equal tp_size (or both be 1): "
+                f"{self.moe_tp_size}*{self.moe_ep_size} vs tp {self.tp_size}"
+            )
+
+    # ---- axis names -------------------------------------------------------
+    AXIS_DP = "dp"
+    AXIS_CP = "cp"
+    AXIS_TP = "tp"
+    AXIS_PP = "pp"
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return (self.AXIS_DP, self.AXIS_CP, self.AXIS_TP, self.AXIS_PP)
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp_size, self.cp_size, self.tp_size, self.pp_size)
+
+    def make_mesh(self, devices: Optional[Sequence] = None):
+        """Build the ``jax.sharding.Mesh`` for this topology."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) < self.world_size:
+            raise ValueError(
+                f"need {self.world_size} devices, have {len(devices)}"
+            )
+        arr = np.array(devices[: self.world_size]).reshape(self.axis_sizes)
+        return Mesh(arr, self.axis_names)
+
+    # ---- rank coordinate math (parity with reference rank accessors) ------
+    def coords(self, rank: int) -> Tuple[int, int, int, int]:
+        """(dp, cp, tp, pp) coordinates of a flat rank."""
+        sizes = self.axis_sizes
+        out = []
+        rem = rank
+        for s in sizes[::-1]:
+            out.append(rem % s)
+            rem //= s
+        return tuple(out[::-1])
+
+    def tp_rank(self, rank: int) -> int:
+        return self.coords(rank)[2]
+
+    def pp_rank(self, rank: int) -> int:
+        return self.coords(rank)[3]
+
+    def cp_rank(self, rank: int) -> int:
+        return self.coords(rank)[1]
+
+    def dp_rank(self, rank: int) -> int:
+        return self.coords(rank)[0]
+
+    def moe_ep_rank(self, rank: int) -> int:
+        return self.tp_rank(rank) % self.moe_ep_size
+
+    def moe_tp_rank(self, rank: int) -> int:
+        return self.tp_rank(rank) // self.moe_ep_size
+
+    def pp_layers(self, num_layers: int) -> List[List[int]]:
+        """Contiguous layer partition per pipeline stage (reference
+        ``Mapping.pp_layers``, mapping.py:442)."""
+        base = num_layers // self.pp_size
+        extra = num_layers % self.pp_size
+        out, start = [], 0
+        for s in range(self.pp_size):
+            n = base + (1 if s < extra else 0)
+            out.append(list(range(start, start + n)))
+            start += n
+        return out
+
+    def ep_experts(self, num_experts: int) -> List[List[int]]:
+        """Expert partition per EP rank (reference ``Mapping.ep_experts``)."""
+        base = num_experts // self.moe_ep_size
+        extra = num_experts % self.moe_ep_size
+        out, start = [], 0
+        for s in range(self.moe_ep_size):
+            n = base + (1 if s < extra else 0)
+            out.append(list(range(start, start + n)))
+            start += n
+        return out

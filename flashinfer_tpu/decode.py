@@ -24,8 +24,6 @@ Design notes vs the reference:
 
 from __future__ import annotations
 
-import functools
-import math
 from dataclasses import dataclass
 from typing import Optional, Tuple, Union
 
@@ -41,7 +39,6 @@ from flashinfer_tpu.utils import (
     get_sm_scale,
     next_power_of_two,
     resolve_backend,
-    round_up,
     TensorLayout,
 )
 

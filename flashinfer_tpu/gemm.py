@@ -1,0 +1,143 @@
+"""GEMM family: dense, batched, low-precision, segment/grouped.
+
+TPU re-design of the reference GEMM layer (``flashinfer/gemm/gemm_base.py``
+mm_bf16:542 / bmm_fp8:806 / mm_fp8:1419; SegmentGEMMWrapper gemm_base.py:1943;
+grouped_mm core.py).  Backend collapse per SURVEY §7: cublas/cutlass/trtllm/
+cute-dsl tactic selection disappears — XLA's matmul emitter owns tiling on
+the MXU, and ``jax.lax.ragged_dot`` is the native grouped/segment GEMM
+(megablox-style) for LoRA-batch and MoE shapes.
+
+Low-precision mapping (documented capability gate, SURVEY §7 "FP8/FP4"):
+v5e/v5p have no FP8 MXU mode, so fp8 inputs are stored as fp8 (HBM savings
+preserved) and upcast to bf16 in-register for the MXU; int8 uses the native
+int8 MXU path.  ``mm_fp4`` maps NVFP4 to int4-per-block storage — later
+round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.utils import canonicalize_dtype
+
+
+def _scaled(x, scale):
+    if scale is None:
+        return x
+    return x * scale
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def mm_bf16(a: jax.Array, b: jax.Array, out_dtype=jnp.bfloat16) -> jax.Array:
+    """Dense bf16 matmul with f32 accumulation (reference ``mm_bf16``)."""
+    return jnp.dot(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def bmm_bf16(a: jax.Array, b: jax.Array, out_dtype=jnp.bfloat16) -> jax.Array:
+    return jnp.einsum(
+        "bmk,bkn->bmn", a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def mm_fp8(
+    a: jax.Array,  # fp8 [m, k]
+    b: jax.Array,  # fp8 [k, n]
+    a_scale: Optional[jax.Array] = None,
+    b_scale: Optional[jax.Array] = None,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """FP8-stored matmul (reference ``mm_fp8``): fp8 operands dequantized
+    in-register (bf16 MXU — no native fp8 matmul on v5)."""
+    af = _scaled(a.astype(jnp.float32), a_scale).astype(jnp.bfloat16)
+    bf = _scaled(b.astype(jnp.float32), b_scale).astype(jnp.bfloat16)
+    return jnp.dot(af, bf, preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def bmm_fp8(
+    a: jax.Array,  # fp8 [b, m, k]
+    b: jax.Array,  # fp8 [b, k, n]
+    a_scale: Optional[jax.Array] = None,
+    b_scale: Optional[jax.Array] = None,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Batched fp8 matmul (reference ``bmm_fp8``, gemm_base.py:806)."""
+    af = _scaled(a.astype(jnp.float32), a_scale).astype(jnp.bfloat16)
+    bf = _scaled(b.astype(jnp.float32), b_scale).astype(jnp.bfloat16)
+    return jnp.einsum(
+        "bmk,bkn->bmn", af, bf, preferred_element_type=jnp.float32
+    ).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def mm_int8(
+    a: jax.Array,  # int8 [m, k]
+    b: jax.Array,  # int8 [k, n]
+    a_scale: Optional[jax.Array] = None,
+    b_scale: Optional[jax.Array] = None,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """int8 x int8 -> int32 on the native int8 MXU path, then rescale."""
+    acc = jnp.dot(a, b, preferred_element_type=jnp.int32).astype(jnp.float32)
+    if a_scale is not None:
+        acc = acc * jnp.asarray(a_scale, jnp.float32)
+    if b_scale is not None:
+        acc = acc * jnp.asarray(b_scale, jnp.float32)
+    return acc.astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def grouped_gemm(
+    x: jax.Array,  # [total_m, k] ragged rows
+    weights: jax.Array,  # [num_groups, k, n]
+    group_sizes: jax.Array,  # [num_groups] int32, sum == total_m
+) -> jax.Array:
+    """Ragged grouped matmul — row-segment i multiplies weights[i].
+
+    The TPU-native megablox equivalent (``jax.lax.ragged_dot`` lowers to a
+    grouped MXU kernel); serves the reference's grouped/segment GEMM and the
+    MoE expert GEMMs (group_gemm.cuh, fused MoE grouped stages)."""
+    return jax.lax.ragged_dot(x, weights, group_sizes.astype(jnp.int32))
+
+
+class SegmentGEMMWrapper:
+    """LoRA-style segment GEMM (reference ``SegmentGEMMWrapper``,
+    gemm_base.py:1943): per-segment weight selection over ragged batches,
+    with optional ``weight_indices`` indirection."""
+
+    def __init__(self, float_workspace_buffer=None, backend: str = "auto",
+                 **_unused):
+        pass
+
+    def run(
+        self,
+        x: jax.Array,  # [total_m, k]
+        weights: jax.Array,  # [num_weights, k, n] ("NK" layout transposed)
+        batch_size: int,
+        weight_column_major: bool = False,
+        seg_lens: Optional[jax.Array] = None,
+        seg_indptr: Optional[jax.Array] = None,
+        weight_indices: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        if weight_column_major:
+            weights = jnp.swapaxes(weights, 1, 2)
+        if seg_lens is None:
+            if seg_indptr is None:
+                raise ValueError("need seg_lens or seg_indptr")
+            seg_lens = seg_indptr[1:] - seg_indptr[:-1]
+        seg_lens = seg_lens.astype(jnp.int32)
+        if weight_indices is not None:
+            weights = weights[weight_indices.astype(jnp.int32)]
+        return grouped_gemm(x, weights, seg_lens)
+
+    forward = run

@@ -1,0 +1,19 @@
+"""Declarative logits-processing pipeline compiler.
+
+TPU re-design of ``flashinfer/logits_processor/`` (LogitsPipe pipeline.py,
+compile/legalize/fuse compiler.py + fusion_rules.py + legalization.py): a
+declarative chain of processors (Temperature/TopK/TopP/MinP/Softmax/Sample)
+is validated (logits-vs-probs type flow), legalized (each op picks its
+logits- or probs-domain kernel), and compiled into ONE jitted function —
+the XLA analogue of the reference's fused-kernel selection.
+"""
+
+from flashinfer_tpu.logits_processor.pipeline import (  # noqa: F401
+    LogitsPipe,
+    MinP,
+    Sample,
+    Softmax,
+    Temperature,
+    TopK,
+    TopP,
+)

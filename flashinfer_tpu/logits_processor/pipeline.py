@@ -1,0 +1,169 @@
+"""LogitsPipe: validate -> legalize -> fuse -> jit.
+
+Mirrors the reference pipeline semantics (``flashinfer/logits_processor``):
+
+- Type flow: the stream starts as LOGITS; ``Softmax`` moves it to PROBS;
+  ``Sample`` consumes either and ends the pipe.
+- Legalization: ``TopK`` on LOGITS -> mask-logits kernel, on PROBS ->
+  renorm-probs kernel; ``TopP``/``MinP`` are PROBS-only (validation error on
+  logits, matching the reference's legalization rules).
+- Fusion: the chain is composed into one Python function and jitted whole —
+  XLA fuses the sort/cumsum/mask chain the way the reference fuses CUDA
+  kernels via its fusion rules.
+
+Runtime parameters (temperature, top_k, top_p, min_p, key) are call-time
+arguments, so one compiled pipe serves any parameter values.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu import sampling as S
+
+LOGITS, PROBS, TOKENS = "logits", "probs", "tokens"
+
+
+class _Op:
+    name: str = "op"
+    needs: Sequence[str] = (LOGITS, PROBS)
+    params: Sequence[str] = ()
+
+    def apply(self, state: str, x, params: Dict[str, Any], key):
+        raise NotImplementedError
+
+    def out_state(self, state: str) -> str:
+        return state
+
+
+class Temperature(_Op):
+    name = "temperature"
+    needs = (LOGITS,)
+    params = ("temperature",)
+
+    def apply(self, state, x, params, key):
+        t = jnp.asarray(params["temperature"], jnp.float32)
+        t = jnp.maximum(t, 1e-6)
+        if t.ndim == 1:
+            t = t[:, None]
+        return x / t
+
+
+class Softmax(_Op):
+    name = "softmax"
+    needs = (LOGITS,)
+
+    def apply(self, state, x, params, key):
+        return jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+
+    def out_state(self, state):
+        return PROBS
+
+
+class TopK(_Op):
+    name = "top_k"
+    needs = (LOGITS, PROBS)
+    params = ("top_k",)
+
+    def apply(self, state, x, params, key):
+        if state == LOGITS:
+            return S.top_k_mask_logits(x, params["top_k"])
+        return S.top_k_renorm_probs(x, params["top_k"])
+
+
+class TopP(_Op):
+    name = "top_p"
+    needs = (PROBS,)
+    params = ("top_p",)
+
+    def apply(self, state, x, params, key):
+        return S.top_p_renorm_probs(x, params["top_p"])
+
+
+class MinP(_Op):
+    name = "min_p"
+    needs = (PROBS,)
+    params = ("min_p",)
+
+    def apply(self, state, x, params, key):
+        p = x.astype(jnp.float32)
+        mp = jnp.asarray(params["min_p"], jnp.float32)
+        if mp.ndim == 1:
+            mp = mp[:, None]
+        thresh = mp * jnp.max(p, axis=-1, keepdims=True)
+        kept = jnp.where(p >= thresh, p, 0.0)
+        return kept / jnp.sum(kept, axis=-1, keepdims=True)
+
+
+class Sample(_Op):
+    name = "sample"
+    needs = (LOGITS, PROBS)
+    params = ()
+
+    def apply(self, state, x, params, key):
+        if key is None:
+            raise ValueError("Sample requires a PRNG key at call time")
+        if state == LOGITS:
+            return S.sampling_from_logits(x, key)
+        return S.sampling_from_probs(x, key)
+
+    def out_state(self, state):
+        return TOKENS
+
+
+class LogitsPipe:
+    """Compile a processor chain into one jitted function.
+
+    >>> pipe = LogitsPipe([Temperature(), Softmax(), TopP(), Sample()])
+    >>> tokens = pipe(logits, temperature=0.8, top_p=0.9, key=key)
+    """
+
+    def __init__(self, ops: Sequence[_Op]):
+        self.ops = list(ops)
+        self._validate()
+        self._param_names = [p for op in self.ops for p in op.params]
+        self._compiled = None
+
+    def _validate(self) -> None:
+        state = LOGITS
+        for i, op in enumerate(self.ops):
+            if state == TOKENS:
+                raise ValueError(
+                    f"op {op.name!r} at position {i} after Sample — the pipe "
+                    "already ended"
+                )
+            if state not in op.needs:
+                raise ValueError(
+                    f"op {op.name!r} at position {i} requires "
+                    f"{'/'.join(op.needs)} input but the stream is {state} "
+                    f"(insert Softmax() before it?)"
+                )
+            state = op.out_state(state)
+        self.final_state = state
+
+    def _run(self, x, key, **params):
+        state = LOGITS
+        for op in self.ops:
+            x = op.apply(state, x, params, key)
+            state = op.out_state(state)
+        return x
+
+    def __call__(self, logits: jax.Array, key: Optional[jax.Array] = None,
+                 **params):
+        missing = [p for p in self._param_names if p not in params]
+        if missing:
+            raise ValueError(f"missing runtime params: {missing}")
+        extra = [p for p in params if p not in self._param_names]
+        if extra:
+            raise ValueError(
+                f"unknown params {extra}; this pipe takes {self._param_names}"
+            )
+        if self._compiled is None:
+            self._compiled = jax.jit(
+                functools.partial(self._run)
+            )
+        return self._compiled(logits, key, **params)

@@ -1,0 +1,6 @@
+from flashinfer_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_llama_params,
+    llama_decode_step,
+    make_sharded_decode_step,
+)

@@ -1,0 +1,248 @@
+"""Flagship integration model: Llama-3-style decoder wired to the library.
+
+The reference keeps models in its consumers and ships integration blocks
+(``examples/pytorch/flashinfer_modules.py`` — FlashInferAttentionDispatcher /
+Linear / RMSNorm / FFN); this module is the TPU equivalent *and* the
+end-to-end proof for the minimum slice (SURVEY §7 step 2): a paged-KV batch
+decode step built entirely from flashinfer_tpu ops:
+
+    rmsnorm -> qkv proj -> RoPE -> append_paged_kv_cache ->
+    paged_decode_attention -> o proj -> fused allreduce+add+rmsnorm (TP) ->
+    silu_and_mul MLP -> logits
+
+Two entry points: ``llama_decode_step`` (single device, jittable) and
+``make_sharded_decode_step`` (shard_map over a Mapping mesh with dp x tp
+sharding; TP allreduces ride ICI via the comm layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flashinfer_tpu.activation import silu_and_mul
+from flashinfer_tpu.comm.allreduce import allreduce_fusion
+from flashinfer_tpu.comm.mapping import Mapping
+from flashinfer_tpu.norm import rmsnorm
+from flashinfer_tpu.ops.paged_decode import paged_decode_attention
+from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+from flashinfer_tpu.rope import apply_rope_pos_ids
+from flashinfer_tpu.utils import is_tpu
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_qo_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 5e5
+    rms_eps: float = 1e-5
+    dtype: object = jnp.bfloat16
+
+    @staticmethod
+    def llama3_8b(**over) -> "LlamaConfig":
+        return LlamaConfig(**over)
+
+    @staticmethod
+    def tiny(**over) -> "LlamaConfig":
+        """Small config for tests/dryruns."""
+        d = dict(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_layers=2, num_qo_heads=8, num_kv_heads=4, head_dim=32,
+        )
+        d.update(over)
+        return LlamaConfig(**d)
+
+
+def init_llama_params(key: jax.Array, cfg: LlamaConfig) -> Dict:
+    """Random-initialized parameter pytree (layout mirrors HF llama naming)."""
+    h, qh, kvh, hd = cfg.hidden_size, cfg.num_qo_heads, cfg.num_kv_heads, cfg.head_dim
+    keys = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
+
+    def w(shape, scale=0.02):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    layers = []
+    for _ in range(cfg.num_layers):
+        layers.append(
+            dict(
+                input_norm=jnp.ones((h,), cfg.dtype),
+                q_proj=w((h, qh * hd)),
+                k_proj=w((h, kvh * hd)),
+                v_proj=w((h, kvh * hd)),
+                o_proj=w((qh * hd, h)),
+                post_norm=jnp.ones((h,), cfg.dtype),
+                gate_proj=w((h, cfg.intermediate_size)),
+                up_proj=w((h, cfg.intermediate_size)),
+                down_proj=w((cfg.intermediate_size, h)),
+            )
+        )
+    return dict(
+        embed=w((cfg.vocab_size, h)),
+        final_norm=jnp.ones((h,), cfg.dtype),
+        lm_head=w((h, cfg.vocab_size)),
+        layers=layers,
+    )
+
+
+def _attn_decode(
+    x, layer, cfg: LlamaConfig, kv_cache, page_table, kv_lens, positions,
+    num_qo_heads: int, num_kv_heads: int, use_pallas: bool,
+):
+    """One decode-attention sublayer over local (possibly TP-sharded) heads.
+
+    Returns (o_partial [B, qh*hd], updated kv_cache).  Cache layout HND:
+    [num_pages, kvh, page_size, hd] (TPU-preferred, ops/paged_decode.py)."""
+    B = x.shape[0]
+    hd = cfg.head_dim
+    q = (x @ layer["q_proj"]).reshape(B, num_qo_heads, hd)
+    k = (x @ layer["k_proj"]).reshape(B, num_kv_heads, hd)
+    v = (x @ layer["v_proj"]).reshape(B, num_kv_heads, hd)
+    q, k = apply_rope_pos_ids(q, k, positions, rope_theta=cfg.rope_theta)
+
+    # append this step's K/V: page_table row lookup at the write position
+    k_cache, v_cache = kv_cache
+    page_size = k_cache.shape[2]
+    page_in_req = positions // page_size
+    slot = positions % page_size
+    page_id = page_table[jnp.arange(B), page_in_req]
+    # scatter [B, kvh, hd] rows into [pages, kvh, page_size, hd]
+    k_cache = k_cache.at[page_id, :, slot, :].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[page_id, :, slot, :].set(v.astype(v_cache.dtype))
+
+    kv_lens_inc = jnp.maximum(kv_lens, positions + 1)
+    sm_scale = 1.0 / float(hd) ** 0.5
+    if use_pallas:
+        o = paged_decode_attention(
+            q, k_cache, v_cache, page_table, kv_lens_inc,
+            sm_scale=sm_scale, kv_layout="HND",
+        )
+    else:
+        o = xla_paged_decode(
+            q, k_cache, v_cache, page_table, kv_lens_inc,
+            sm_scale=sm_scale, kv_layout="HND",
+        )
+    return o.reshape(B, num_qo_heads * hd), (k_cache, v_cache)
+
+
+def llama_decode_step(
+    params: Dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 (position of the new token)
+    kv_caches: List[Tuple[jax.Array, jax.Array]],  # per layer, HND paged
+    page_table: jax.Array,  # [B, P]
+    kv_lens: jax.Array,  # [B] lens BEFORE this step
+    use_pallas: bool = True,
+) -> Tuple[jax.Array, List[Tuple[jax.Array, jax.Array]]]:
+    """Single-device batched decode step -> (logits [B, vocab], new caches)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+        attn, cache = _attn_decode(
+            h, layer, cfg, kv_caches[li], page_table, kv_lens, positions,
+            cfg.num_qo_heads, cfg.num_kv_heads, use_pallas,
+        )
+        new_caches.append(cache)
+        x = x + (attn @ layer["o_proj"]).astype(cfg.dtype)
+        h = rmsnorm(x, layer["post_norm"], cfg.rms_eps)
+        mlp_in = jnp.concatenate([h @ layer["gate_proj"], h @ layer["up_proj"]], -1)
+        x = x + (silu_and_mul(mlp_in) @ layer["down_proj"]).astype(cfg.dtype)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_caches
+
+
+def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
+    """Build a jitted dp x tp sharded decode step via shard_map.
+
+    Weight sharding: q/k/v/gate/up column-sharded over tp, o/down
+    row-sharded; attention runs on local kv heads; the o_proj and down_proj
+    partial sums are combined with the fused allreduce(+residual+RMSNorm)
+    from the comm layer — the reference's AR+norm fusion pattern
+    (trtllm_allreduce_fusion) expressed as a compiled ICI collective.
+
+    Returns (step_fn, mesh, specs) where specs maps each argument to its
+    PartitionSpec."""
+    mesh = mesh or mapping.make_mesh()
+    tp, dp = Mapping.AXIS_TP, Mapping.AXIS_DP
+    assert cfg.num_kv_heads % mapping.tp_size == 0
+    qh_l = cfg.num_qo_heads // mapping.tp_size
+    kvh_l = cfg.num_kv_heads // mapping.tp_size
+
+    param_specs = dict(
+        embed=P(None, None),
+        final_norm=P(None),
+        lm_head=P(None, tp),
+        layers=[
+            dict(
+                input_norm=P(None),
+                q_proj=P(None, tp), k_proj=P(None, tp), v_proj=P(None, tp),
+                o_proj=P(tp, None),
+                post_norm=P(None),
+                gate_proj=P(None, tp), up_proj=P(None, tp),
+                down_proj=P(tp, None),
+            )
+            for _ in range(cfg.num_layers)
+        ],
+    )
+    cache_spec = [(P(dp, None, tp, None, None), P(dp, None, tp, None, None))
+                  for _ in range(cfg.num_layers)]
+    in_specs = (
+        param_specs,
+        P(dp),  # tokens [B]
+        P(dp),  # positions [B]
+        cache_spec,  # per layer (k, v): [dp, pages, kvh, page_size, hd]
+        P(dp, None),  # page_table [B, P]
+        P(dp),  # kv_lens [B]
+    )
+    out_specs = (P(dp, tp), cache_spec)
+
+    def step(params, tokens, positions, kv_caches, page_table, kv_lens):
+        page_table_l = page_table
+        kv_lens_l = kv_lens
+        x = params["embed"][tokens].astype(cfg.dtype)
+        new_caches = []
+        use_pallas = is_tpu()
+        for li, layer in enumerate(params["layers"]):
+            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+            attn, cache = _attn_decode(
+                h, layer, cfg, (kv_caches[li][0][0], kv_caches[li][1][0]),
+                page_table_l, kv_lens_l, positions, qh_l, kvh_l, use_pallas,
+            )
+            new_caches.append((cache[0][None], cache[1][None]))
+            # fused AR + residual-add + post-attention RMSNorm
+            o_partial = attn @ layer["o_proj"]
+            h, x = allreduce_fusion(
+                o_partial, residual=x, rms_weight=layer["post_norm"],
+                eps=cfg.rms_eps, axis=tp,
+            )
+            h = h.astype(cfg.dtype)
+            mlp_in = jnp.concatenate(
+                [h @ layer["gate_proj"], h @ layer["up_proj"]], -1
+            )
+            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            # MLP residual uses plain AR + add (next layer norms it)
+            (x,) = allreduce_fusion(d_partial, residual=x, axis=tp)
+        x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)  # [B, vocab/tp]
+        return logits, new_caches
+
+    sharded = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    return sharded, mesh, dict(params=param_specs, cache=cache_spec)

@@ -1,0 +1,133 @@
+"""Block-sparse (BSR) attention Pallas kernel.
+
+TPU re-design of the reference's block-sparse path
+(``flashinfer/sparse.py:195`` BlockSparseAttentionWrapper, which reuses the
+prefill kernels with sparse gather indices inside prefill.cuh).  The TPU
+translation is direct and kernel-native: the BSR column-index array is a
+*scalar-prefetch* operand and the KV BlockSpec's ``index_map`` reads it, so
+the Pallas pipeline DMA-gathers exactly the nonzero KV blocks — sparsity
+lives in the index map, not in gather ops.
+
+Grid: ``(num_qo_heads, q_blocks, max_blocks_per_row)``; rows with fewer
+nonzero blocks skip compute via the prefetched indptr.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import cdiv, use_interpret
+
+_NEG_INF = -1e30
+
+
+def _bsr_kernel(
+    indptr_ref,  # [MB+1] scalar prefetch
+    cols_ref,  # [MB * max_nnz] padded column ids (scalar prefetch)
+    q_ref,  # [R, D]
+    k_ref,  # [C, D]  (block selected by index map)
+    v_ref,  # [C, D]
+    o_ref,  # [R, D]
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    max_nnz: int,
+    sm_scale: float,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    row_nnz = indptr_ref[i + 1] - indptr_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j < row_nnz)
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == max_nnz - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_row", "block_col", "max_nnz", "sm_scale")
+)
+def bsr_attention(
+    q: jax.Array,  # [M, num_qo_heads, head_dim]
+    k: jax.Array,  # [N, num_kv_heads, head_dim]
+    v: jax.Array,
+    indptr: jax.Array,  # [MB + 1] int32
+    cols_padded: jax.Array,  # [MB * max_nnz] int32, padded with 0
+    *,
+    block_row: int,
+    block_col: int,
+    max_nnz: int,
+    sm_scale: float = 1.0,
+):
+    M, H, D = q.shape
+    N, HKV, _ = k.shape
+    group = H // HKV
+    MB = M // block_row
+    qT = jnp.swapaxes(q, 0, 1)
+    kT = jnp.swapaxes(k, 0, 1)
+    vT = jnp.swapaxes(v, 0, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(H, MB, max_nnz),
+        in_specs=[
+            pl.BlockSpec((None, block_row, D), lambda h, i, j, *_: (h, i, 0)),
+            pl.BlockSpec(
+                (None, block_col, D),
+                lambda h, i, j, ip, cols: (h // group, cols[i * max_nnz + j], 0),
+            ),
+            pl.BlockSpec(
+                (None, block_col, D),
+                lambda h, i, j, ip, cols: (h // group, cols[i * max_nnz + j], 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((None, block_row, D), lambda h, i, j, *_: (h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_row, D), jnp.float32),
+            pltpu.VMEM((block_row, 128), jnp.float32),
+            pltpu.VMEM((block_row, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_bsr_kernel, max_nnz=max_nnz, sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, M, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        ),
+        interpret=use_interpret(),
+    )(indptr.astype(jnp.int32), cols_padded.astype(jnp.int32), qT, kT, vT)
+    return jnp.swapaxes(out, 0, 1)

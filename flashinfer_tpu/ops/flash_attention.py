@@ -18,14 +18,12 @@ VMEM scratch carried across the innermost kv dimension.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from flashinfer_tpu.utils import cdiv, round_up, use_interpret
+from flashinfer_tpu.utils import round_up, use_interpret
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_KV = 512
@@ -40,9 +38,9 @@ def _flash_kernel(
     k_ref,  # [bkv, head_dim]
     v_ref,  # [bkv, head_dim]
     q_seg_ref,  # [bq, 1] int32
-    kv_seg_ref,  # [1, bkv] int32 (pre-transposed on host: lane-major)
+    kv_seg_ref,  # [bkv] int32 (1D: lives on lanes, no relayout needed)
     q_pos_ref,  # [bq, 1] int32
-    kv_pos_ref,  # [1, bkv] int32
+    kv_pos_ref,  # [bkv] int32
     # outputs (lse_ref only present when return_lse)
     *rest,
     sm_scale: float,
@@ -75,10 +73,10 @@ def _flash_kernel(
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
 
     q_seg = q_seg_ref[...]  # [bq, 1]
-    kv_seg = kv_seg_ref[...]  # [1, bkv] — already lane-major, no transpose
+    kv_seg = kv_seg_ref[...][None, :]  # [1, bkv] — lane broadcast, free
     mask = q_seg == kv_seg
     q_pos = q_pos_ref[...]
-    kv_pos = kv_pos_ref[...]
+    kv_pos = kv_pos_ref[...][None, :]
     if causal:
         mask = mask & (kv_pos <= q_pos)
     if window_left >= 0:
@@ -171,9 +169,9 @@ def flash_attention(
     vT = jnp.swapaxes(v, 0, 1)
 
     q_seg2 = q_seg.astype(jnp.int32).reshape(-1, 1)
-    kv_seg2 = kv_seg.astype(jnp.int32).reshape(1, -1)
+    kv_seg2 = kv_seg.astype(jnp.int32)
     q_pos2 = q_pos.astype(jnp.int32).reshape(-1, 1)
-    kv_pos2 = kv_pos.astype(jnp.int32).reshape(1, -1)
+    kv_pos2 = kv_pos.astype(jnp.int32)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -201,9 +199,9 @@ def flash_attention(
             pl.BlockSpec((None, bkv, head_dim), lambda h, i, j: (h // group, j, 0)),
             pl.BlockSpec((None, bkv, head_dim_vo), lambda h, i, j: (h // group, j, 0)),
             pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
-            pl.BlockSpec((1, bkv), lambda h, i, j: (0, j)),
+            pl.BlockSpec((bkv,), lambda h, i, j: (j,)),
             pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
-            pl.BlockSpec((1, bkv), lambda h, i, j: (0, j)),
+            pl.BlockSpec((bkv,), lambda h, i, j: (j,)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -212,9 +210,12 @@ def flash_attention(
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+        # NOTE: dimension_semantics=("parallel","parallel","arbitrary") would
+        # enable megacore grid partitioning on dual-core chips (v4/v5p), but
+        # is a suspect in a Mosaic compile hang under investigation on v5e;
+        # reintroduce once cleared.
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024,
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=use_interpret(),
     )(qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)

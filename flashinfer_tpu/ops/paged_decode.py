@@ -54,7 +54,6 @@ def _decode_kernel(
     *,
     page_size: int,
     ppc: int,  # pages per chunk
-    max_chunks: int,
     sm_scale: float,
     logits_soft_cap: float,
     window_left: int,
@@ -187,7 +186,6 @@ def paged_decode_attention(
     p_padded = round_up(max_pages, pages_per_chunk)
     if p_padded != max_pages:
         page_table = jnp.pad(page_table, ((0, 0), (0, p_padded - max_pages)))
-    max_chunks = p_padded // pages_per_chunk
 
     # [B, Hq, D] -> [B, Hkv, Gp, D] with zero padding in the group dim
     qg = q.reshape(batch, num_kv_heads, group, head_dim)
@@ -198,7 +196,6 @@ def paged_decode_attention(
         _decode_kernel,
         page_size=page_size,
         ppc=pages_per_chunk,
-        max_chunks=max_chunks,
         sm_scale=sm_scale,
         logits_soft_cap=logits_soft_cap,
         window_left=window_left,
@@ -211,8 +208,8 @@ def paged_decode_attention(
         grid=(batch, num_kv_heads),
         in_specs=[
             pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),

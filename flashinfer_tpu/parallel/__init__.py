@@ -1,0 +1,14 @@
+"""Sequence/context parallelism: Ulysses and Ring attention.
+
+TPU-native re-design of the reference's ``flashinfer/parallel_attention/``
+(ParallelAttention parallel_attention.py:12-62; all-to-all wrapper
+parallel_wrapper.py:10; ring P2P parallel_wrapper.py:216-242) and of the
+decode-context-parallel path (``flashinfer/comm/dcp_alltoall.py``).
+"""
+
+from flashinfer_tpu.parallel.attention import (  # noqa: F401
+    ParallelAttention,
+    ring_attention,
+    ulysses_attention,
+)
+from flashinfer_tpu.parallel.dcp import dcp_decode  # noqa: F401

@@ -1,0 +1,50 @@
+"""Decode context parallelism (DCP): KV sharded across ranks at decode time.
+
+Re-design of the reference DCP path (``flashinfer/comm/dcp_alltoall.py:67-227``
++ ``csrc/trtllm_dcp_alltoall.cu``): each rank holds a shard of every
+request's KV pages, computes a partial decode attention with LSE, and the
+partials are combined.  The reference exchanges partials with a custom
+all-to-all over MNNVL; here the combine is an ``all_gather`` of the
+(state, lse) pair over the cp axis followed by the merge-states reduction —
+XLA turns this into one fused ICI collective + elementwise pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.ops.merge import merge_states
+from flashinfer_tpu.ops.paged_decode import paged_decode_attention
+from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+from flashinfer_tpu.utils import get_sm_scale, is_tpu
+
+
+def dcp_decode(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim] (replicated over cp)
+    k_cache: jax.Array,  # this rank's page shard
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, P_local] this rank's pages per request
+    kv_lens: jax.Array,  # [batch] this rank's share of each request's kv len
+    axis: str = "cp",
+    *,
+    sm_scale: Optional[float] = None,
+    kv_layout: str = "HND",
+) -> jax.Array:
+    """Per-shard decode + cross-rank LSE merge (call inside shard_map)."""
+    sm_scale = get_sm_scale(q.shape[-1], sm_scale)
+    fn = paged_decode_attention if is_tpu() else xla_paged_decode
+    out, lse = fn(
+        q, k_cache, v_cache, page_table, kv_lens,
+        sm_scale=sm_scale, kv_layout=kv_layout, return_lse=True,
+    )
+    # gather all ranks' partial states: [cp, batch, H, D] / [cp, batch, H]
+    outs = jax.lax.all_gather(out, axis)
+    lses = jax.lax.all_gather(lse, axis)
+    # merge over the cp axis per (batch, head)
+    merged, _ = merge_states(
+        jnp.moveaxis(outs, 0, 1), jnp.moveaxis(lses, 0, 1)
+    )
+    return merged.astype(q.dtype)

@@ -1,0 +1,97 @@
+"""Quantization ops: packbits + fp8/int8 quantize/dequantize.
+
+TPU re-design of the reference quantization layer
+(``flashinfer/quantization/`` packbits.py + fp8_quantization.py;
+``include/flashinfer/quantization.cuh``).  NVFP4/MXFP4 block formats have no
+v5 hardware path; the supported low-precision surface here is fp8 (storage)
+and int8 (storage + native MXU), with per-tensor and per-channel scaling.
+Block-scaled int4 packing mirrors the NVFP4 role and lands in a later round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("bitorder",))
+def packbits(x: jax.Array, bitorder: str = "big") -> jax.Array:
+    """Pack a boolean/0-1 int array into uint8, 8 elements per byte
+    (reference ``flashinfer.quantization.packbits``, quantization.cuh)."""
+    x = x.reshape(-1).astype(jnp.uint8)
+    pad = (-x.shape[0]) % 8
+    x = jnp.pad(x, (0, pad))
+    x = x.reshape(-1, 8)
+    if bitorder == "big":
+        weights = jnp.array([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    else:
+        weights = jnp.array([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+    return jnp.sum(x * weights[None, :], axis=1).astype(jnp.uint8)
+
+
+def segment_packbits(
+    x: jax.Array, indptr: jax.Array, bitorder: str = "big"
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-segment packbits (reference ``segment_packbits``): each segment
+    is packed independently so segment boundaries stay byte-aligned.
+    Returns (packed, new_indptr)."""
+    import numpy as np
+
+    indptr_np = np.asarray(indptr)
+    segs = []
+    new_indptr = [0]
+    for r in range(len(indptr_np) - 1):
+        seg = x[int(indptr_np[r]) : int(indptr_np[r + 1])]
+        packed = packbits(seg, bitorder)
+        segs.append(packed)
+        new_indptr.append(new_indptr[-1] + packed.shape[0])
+    return jnp.concatenate(segs), jnp.asarray(new_indptr, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def quantize_fp8_per_tensor(
+    x: jax.Array, dtype=jnp.float8_e4m3fn
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-tensor fp8 quantization -> (values, scale) with
+    ``x ~= values * scale``."""
+    finfo = jnp.finfo(dtype)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(
+        x.astype(jnp.float32) / scale, float(finfo.min), float(finfo.max)
+    ).astype(dtype)
+    return q, scale.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype", "axis"))
+def quantize_fp8_per_channel(
+    x: jax.Array, dtype=jnp.float8_e4m3fn, axis: int = -1
+) -> Tuple[jax.Array, jax.Array]:
+    finfo = jnp.finfo(dtype)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(
+        x.astype(jnp.float32) / scale, float(finfo.min), float(finfo.max)
+    ).astype(dtype)
+    return q, scale.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def dequantize_fp8(q: jax.Array, scale: jax.Array, out_dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def quantize_int8(
+    x: jax.Array, axis: int = -1
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8 quantization -> (values, scale)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return q, scale.astype(jnp.float32)

@@ -1,0 +1,57 @@
+"""Top-k selection ops.
+
+TPU re-design of ``flashinfer/topk.py`` (radix/clusters-exact top-k +
+fused page-table transforms used by sparse-MLA index selection).  XLA's
+``jax.lax.top_k`` is the hardware-native exact top-k on TPU; the value-add
+here is the fused transform forms that feed sparse attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_values_indices(scores: jax.Array, k: int):
+    """Exact top-k -> (values, indices) (reference ``topk.topk``)."""
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_indices(scores: jax.Array, k: int) -> jax.Array:
+    return jax.lax.top_k(scores, k)[1].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_mask(scores: jax.Array, k: int) -> jax.Array:
+    """Boolean mask of the top-k entries per row."""
+    kth = jax.lax.top_k(scores, k)[0][..., -1:]
+    return scores >= kth
+
+
+@functools.partial(jax.jit, static_argnames=("k", "page_size"))
+def top_k_page_table_transform(
+    scores: jax.Array,  # [batch, max_kv] per-token selection scores
+    page_table: jax.Array,  # [batch, max_pages]
+    kv_lens: jax.Array,  # [batch]
+    k: int,
+    page_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select top-k kv tokens per request and emit their flat cache rows —
+    the fused top-k + page-table transform used by sparse-MLA index
+    selection (reference topk.py fused transforms).
+
+    Returns (rows [batch, k] flat cache-row ids, valid [batch, k])."""
+    masked = jnp.where(
+        jnp.arange(scores.shape[1])[None, :] < kv_lens[:, None],
+        scores.astype(jnp.float32),
+        -jnp.inf,
+    )
+    vals, tok = jax.lax.top_k(masked, k)  # token positions within request
+    page = jnp.take_along_axis(page_table, tok // page_size, axis=1)
+    rows = page * page_size + tok % page_size
+    return rows.astype(jnp.int32), jnp.isfinite(vals)

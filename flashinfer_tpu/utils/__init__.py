@@ -133,7 +133,11 @@ def resolve_backend(backend: str, op: str = "") -> str:
 
     override = env.backend_override()
     if backend == "auto":
-        return override if override != "auto" else "pallas"
+        if override != "auto":
+            return override
+        # off-TPU, interpret-mode Pallas is a debugger, not a backend:
+        # auto picks the compiled XLA path there
+        return "pallas" if is_tpu() else "xla"
     if backend not in ("pallas", "xla"):
         raise ValueError(f"Unknown backend {backend!r} for op {op or '<unnamed>'}")
     return backend
