@@ -1,0 +1,126 @@
+// Native host-side plan schedulers for flashinfer-tpu.
+//
+// TPU re-design of the reference's C++ plan layer
+// (include/flashinfer/attention/scheduler.cuh: DecodePlan :426,
+// PrefillPlan :897, TwoStageHolisticPlan :1241).  The reference plans
+// split-KV work onto CTAs; the TPU plans build padded/bucketed index
+// arrays consumed by jitted kernels.  These loops run once per batch
+// geometry on the host serving path (every scheduler tick), so they are
+// native for the same reason the reference's are: Python-loop overhead at
+// batch sizes of hundreds of requests is real latency on the decode path.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 dependency).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// Ragged (indptr, indices, last_page_len) -> padded rectangular page table.
+//
+// table:   [b_bucket, p_bucket] zero-initialized by caller
+// kv_lens: [b_bucket] zero-initialized by caller
+// Returns 0 on success, -1 on bounds violation.
+int decode_plan(
+    const int32_t* indptr,          // [batch + 1]
+    const int32_t* indices,         // [indices_len]
+    const int32_t* last_page_len,   // [batch]
+    int32_t batch,
+    int32_t indices_len,
+    int32_t page_size,
+    int32_t b_bucket,
+    int32_t p_bucket,
+    int32_t* table,                 // out [b_bucket * p_bucket]
+    int32_t* kv_lens                // out [b_bucket]
+) {
+    if (batch > b_bucket) return -1;
+    for (int32_t b = 0; b < batch; ++b) {
+        const int32_t beg = indptr[b], end = indptr[b + 1];
+        const int32_t n = end - beg;
+        if (n < 0 || n > p_bucket) return -1;
+        if (beg < 0 || end > indices_len) return -2;  // indices OOB
+        std::memcpy(table + (size_t)b * p_bucket, indices + beg,
+                    (size_t)n * sizeof(int32_t));
+        kv_lens[b] = n > 0 ? (n - 1) * page_size + last_page_len[b] : 0;
+    }
+    return 0;
+}
+
+// Flatten ragged requests onto one padded token axis:
+// seg[i] = request id (pad_seg for padding), pos[i] = pos_offset[r] + i_local.
+int token_axis_plan(
+    const int64_t* indptr,      // [batch + 1]
+    const int64_t* pos_offset,  // [batch]
+    int32_t batch,
+    int32_t pad_to,
+    int32_t pad_seg,
+    int32_t* seg,               // out [pad_to]
+    int32_t* pos                // out [pad_to]
+) {
+    const int64_t total = indptr[batch];
+    if (total > pad_to) return -1;
+    for (int32_t i = 0; i < pad_to; ++i) { seg[i] = pad_seg; pos[i] = 0; }
+    for (int32_t r = 0; r < batch; ++r) {
+        const int64_t s = indptr[r], e = indptr[r + 1];
+        const int64_t off = pos_offset[r];
+        for (int64_t i = s; i < e; ++i) {
+            seg[i] = r;
+            pos[i] = (int32_t)(off + (i - s));
+        }
+    }
+    return 0;
+}
+
+// Per-token flat cache-row gather indices for paged prefill:
+// rows[kv_tok_indptr[r] + t] = pages[r][t / page_size] * page_size + t % page_size
+int paged_gather_plan(
+    const int64_t* kv_tok_indptr,   // [batch + 1] token offsets
+    const int32_t* page_indptr,     // [batch + 1] page offsets
+    const int32_t* page_indices,    // [page_indices_len]
+    int32_t batch,
+    int32_t page_indices_len,
+    int32_t page_size,
+    int32_t pad_to,
+    int32_t* rows                   // out [pad_to], zero-filled by caller
+) {
+    if (kv_tok_indptr[batch] > pad_to) return -1;
+    for (int32_t r = 0; r < batch; ++r) {
+        const int64_t s = kv_tok_indptr[r];
+        const int64_t n = kv_tok_indptr[r + 1] - s;
+        if (n < 0 || s < 0) return -2;
+        const int32_t pbeg = page_indptr[r], pend = page_indptr[r + 1];
+        // token count must fit the request's page list (catches
+        // last_page_len > page_size and short indices arrays)
+        const int64_t npages_needed = n > 0 ? (n - 1) / page_size + 1 : 0;
+        if (pbeg < 0 || pend > page_indices_len ||
+            npages_needed > (int64_t)(pend - pbeg)) return -2;
+        const int32_t* pages = page_indices + pbeg;
+        for (int64_t t = 0; t < n; ++t) {
+            rows[s + t] =
+                pages[t / page_size] * page_size + (int32_t)(t % page_size);
+        }
+    }
+    return 0;
+}
+
+// BSR plan: pad per-row column lists to max_nnz (cols zero-padded).
+int bsr_plan(
+    const int32_t* indptr,    // [mb + 1]
+    const int32_t* indices,   // [indices_len]
+    int32_t mb,
+    int32_t indices_len,
+    int32_t max_nnz,
+    int32_t* cols_padded      // out [mb * max_nnz], zero-filled by caller
+) {
+    for (int32_t i = 0; i < mb; ++i) {
+        const int32_t n = indptr[i + 1] - indptr[i];
+        if (n < 0 || n > max_nnz) return -1;
+        if (indptr[i] < 0 || indptr[i + 1] > indices_len) return -2;
+        std::memcpy(cols_padded + (size_t)i * max_nnz, indices + indptr[i],
+                    (size_t)n * sizeof(int32_t));
+    }
+    return 0;
+}
+
+}  // extern "C"

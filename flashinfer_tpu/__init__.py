@@ -47,6 +47,13 @@ from flashinfer_tpu.sparse import (  # noqa: F401
     BlockSparseAttentionWrapper,
     VariableBlockSparseAttentionWrapper,
 )
+from flashinfer_tpu.attention import (  # noqa: F401
+    BatchAttention,
+    BatchAttentionWithAttentionSinkWrapper,
+    PODWithPagedKVCacheWrapper,
+    apply_attention_sink,
+)
+from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper  # noqa: F401
 from flashinfer_tpu.topk import (  # noqa: F401
     top_k_indices,
     top_k_mask,

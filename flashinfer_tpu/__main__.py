@@ -1,0 +1,119 @@
+"""CLI: ``python -m flashinfer_tpu <cmd>``.
+
+TPU re-design of the reference CLI (``flashinfer/__main__.py:63-462``).
+Command mapping: cubin/jit-cache management collapses into the XLA
+persistent compilation cache + native-planner cache under
+``FLASHINFER_TPU_CACHE_DIR``.
+
+Commands: collect-env | show-config | clear-cache | module-status |
+list-modules | tuner-status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+
+
+def cmd_collect_env(_args) -> int:
+    from flashinfer_tpu.collect_env import main as ce
+
+    ce()
+    return 0
+
+
+def cmd_show_config(_args) -> int:
+    from flashinfer_tpu import env
+
+    print(f"cache_dir        : {env.cache_dir()}")
+    print(f"dump_dir         : {env.dump_dir()}")
+    print(f"log_level        : {env.log_level()}")
+    print(f"backend_override : {env.backend_override()}")
+    print(f"force_interpret  : {env.force_interpret()}")
+    d = env.cache_dir()
+    if d.exists():
+        n = sum(1 for _ in d.rglob("*") if _.is_file())
+        sz = sum(f.stat().st_size for f in d.rglob("*") if f.is_file())
+        print(f"cache contents   : {n} files, {sz / 1e6:.1f} MB")
+    return 0
+
+
+def cmd_clear_cache(_args) -> int:
+    from flashinfer_tpu import env
+
+    d = env.cache_dir()
+    if d.exists():
+        shutil.rmtree(d)
+        print(f"cleared {d}")
+    else:
+        print(f"nothing to clear at {d}")
+    return 0
+
+
+def cmd_module_status(_args) -> int:
+    from flashinfer_tpu import native
+
+    lib = native.get_lib()
+    print(f"native planner  : {'built+loaded' if lib else 'numpy fallback'}")
+    from flashinfer_tpu import env
+
+    xc = env.cache_dir() / "xla_cache"
+    n = sum(1 for _ in xc.rglob("*") if _.is_file()) if xc.exists() else 0
+    print(f"xla compile cache: {n} entries ({xc})")
+    return 0
+
+
+def cmd_list_modules(_args) -> int:
+    mods = [
+        "decode (single + BatchDecodeWithPagedKVCacheWrapper)",
+        "prefill (single + BatchPrefill{Paged,Ragged}KVCacheWrapper)",
+        "attention (BatchAttention holistic, POD, attention sinks)",
+        "mla (BatchMLAPagedAttentionWrapper)",
+        "cascade (MultiLevelCascadeAttentionWrapper, merge ops)",
+        "sparse (BlockSparse, VariableBlockSparse)",
+        "page (append_paged_kv_cache, MLA append)",
+        "rope / norm / activation",
+        "sampling + logits_processor pipeline",
+        "gemm (mm/bmm bf16/fp8/int8, grouped, SegmentGEMMWrapper)",
+        "quantization (packbits, fp8/int8)",
+        "fused_moe (routing, fused_moe, EP)",
+        "comm (Mapping, allreduce fusion) / parallel (ulysses, ring, dcp)",
+        "topk",
+    ]
+    for m in mods:
+        print(f"  {m}")
+    return 0
+
+
+def cmd_tuner_status(_args) -> int:
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    t = AutoTuner.get()
+    t._load()
+    print(f"cache file : {t._cache_path()}")
+    print(f"entries    : {len(t._cache)}")
+    for k, v in sorted(t._cache.items()):
+        print(f"  {k} -> {v}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="flashinfer_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name, fn in [
+        ("collect-env", cmd_collect_env),
+        ("show-config", cmd_show_config),
+        ("clear-cache", cmd_clear_cache),
+        ("module-status", cmd_module_status),
+        ("list-modules", cmd_list_modules),
+        ("tuner-status", cmd_tuner_status),
+    ]:
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

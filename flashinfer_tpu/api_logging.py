@@ -1,0 +1,94 @@
+"""API-call logging/tracing decorator.
+
+TPU re-design of the reference's ``@flashinfer_api``
+(``flashinfer/api_logging.py:34-90``): leveled logging driven by
+``FLASHINFER_TPU_LOGLEVEL`` (0 = off — zero overhead, the decorator is a
+pass-through; 1+ = call names; 3+ = arg/shape/dtype summaries; 10 = full
+tensor dumps to ``FLASHINFER_TPU_DUMP_DIR`` as .npy).  The reference's
+CUDAGraph-awareness is unnecessary (nothing mutates under trace); dumps
+use host transfers and are for debugging only.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import logging
+import time
+from typing import Any, Callable
+
+from flashinfer_tpu import env
+
+logger = logging.getLogger("flashinfer_tpu")
+_call_counter = itertools.count()
+
+
+def _summarize(x: Any) -> str:
+    try:
+        import jax
+
+        if isinstance(x, jax.Array):
+            return f"Array{tuple(x.shape)}:{x.dtype}"
+    except Exception:
+        pass
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return f"ndarray{tuple(x.shape)}:{x.dtype}"
+    if isinstance(x, (list, tuple)) and len(x) > 4:
+        return f"{type(x).__name__}[{len(x)}]"
+    return repr(x)[:80]
+
+
+def _dump(name: str, idx: int, args, kwargs) -> None:
+    import numpy as np
+
+    d = env.dump_dir() / f"{name}_{idx}"
+    d.mkdir(parents=True, exist_ok=True)
+    for i, a in enumerate(args):
+        try:
+            np.save(d / f"arg{i}.npy", np.asarray(a))
+        except Exception:
+            pass
+    for k, v in kwargs.items():
+        try:
+            np.save(d / f"kw_{k}.npy", np.asarray(v))
+        except Exception:
+            pass
+
+
+def flashinfer_api(fn: Callable = None, *, name: str = None) -> Callable:
+    """Decorator adding leveled call logging to a public API function."""
+
+    def deco(f):
+        api_name = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            level = env.log_level()
+            if level <= 0:
+                return f(*args, **kwargs)
+            idx = next(_call_counter)
+            if level >= 3:
+                arg_s = ", ".join(_summarize(a) for a in args)
+                kw_s = ", ".join(f"{k}={_summarize(v)}" for k, v in kwargs.items())
+                logger.info("[%d] %s(%s%s%s)", idx, api_name, arg_s,
+                            ", " if kw_s and arg_s else "", kw_s)
+            else:
+                logger.info("[%d] %s", idx, api_name)
+            if level >= 10:
+                _dump(api_name, idx, args, kwargs)
+            t0 = time.perf_counter()
+            out = f(*args, **kwargs)
+            if level >= 5:
+                logger.info(
+                    "[%d] %s done in %.3f ms (host)", idx, api_name,
+                    (time.perf_counter() - t0) * 1e3,
+                )
+            return out
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco

@@ -1,0 +1,126 @@
+"""Holistic mixed-batch attention, POD, and attention sinks.
+
+TPU re-design of the reference's unified-attention layer:
+
+- ``BatchAttention`` (reference ``flashinfer/attention/_core.py:44``): one
+  wrapper serving a mixed prefill+decode batch.  The reference needs a
+  two-stage cost-balanced plan (``TwoStageHolisticPlan`` scheduler.cuh:1241
+  with a MinHeap) and a persistent kernel (persistent.cuh:682) to keep SMs
+  busy; on TPU the segment flash kernel already *is* holistic — all
+  requests (1-token decodes and long prefills alike) live on one flattened
+  token axis, and a decode-heavy batch degenerates to "one q block reads
+  each kv block once", which is the bandwidth-optimal schedule.  So this
+  wrapper is the paged-prefill plan/run surface under the holistic name.
+
+- ``PODWithPagedKVCacheWrapper`` (reference pod.py:61): Prefill-On-Decode
+  fuses prefill and decode CTAs into one kernel for the same reason; on TPU
+  it aliases the holistic path (documented design decision, SURVEY §7
+  step 3).
+
+- Attention sinks (reference ``BatchAttentionWithAttentionSinkWrapper``,
+  attention/_core.py:330; StreamingLLM): a per-head learnable sink logit
+  joins the softmax denominator.  With the (out, lse) pair this is a pure
+  epilogue: ``out * exp(lse) / (exp(lse) + exp(sink))`` — the LSE algebra
+  again, no kernel change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.prefill import BatchPrefillWithPagedKVCacheWrapper
+
+
+class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
+    """Holistic mixed prefill+decode attention (reference
+    flashinfer/attention/_core.py:44).  plan() takes the same geometry as
+    the reference: per-request qo lens may mix 1 (decode) and many
+    (prefill/append)."""
+
+    def plan(
+        self,
+        qo_indptr,
+        kv_indptr,
+        kv_indices,
+        kv_len_arr,
+        num_qo_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int,
+        causal: bool = True,
+        sm_scale: Optional[float] = None,
+        logits_soft_cap: Optional[float] = None,
+        window_left: int = -1,
+        q_data_type=jnp.bfloat16,
+        kv_data_type=None,
+        use_profiler: bool = False,
+        **_unused,
+    ) -> None:
+        import numpy as np
+
+        kv_len_arr = np.asarray(kv_len_arr)
+        kv_indptr = np.asarray(kv_indptr)
+        pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
+        # reconstruct last_page_len from token lengths
+        last = kv_len_arr - (np.maximum(pages_per_req, 1) - 1) * page_size
+        super().plan(
+            qo_indptr, kv_indptr, kv_indices, last.astype(np.int32),
+            num_qo_heads, num_kv_heads, head_dim, page_size,
+            causal=causal, sm_scale=sm_scale,
+            logits_soft_cap=logits_soft_cap, window_left=window_left,
+            q_data_type=q_data_type, kv_data_type=kv_data_type,
+        )
+
+    def run(self, q, paged_kv_cache, *, out=None, lse=None, return_lse=False,
+            **kw):
+        return super().run(q, paged_kv_cache, return_lse=return_lse, **kw)
+
+
+class PODWithPagedKVCacheWrapper(BatchAttention):
+    """Prefill-On-Decode (reference flashinfer/pod.py:61).  On TPU the
+    holistic segment kernel already co-schedules prefill and decode work;
+    this class exists for API parity and routes to BatchAttention."""
+
+
+@jax.jit
+def apply_attention_sink(
+    out: jax.Array,  # [total_q, num_heads, head_dim]
+    lse: jax.Array,  # [total_q, num_heads] natural-log LSE
+    sink: jax.Array,  # [num_heads] per-head sink logits
+) -> jax.Array:
+    """Renormalize attention output as if a zero-value sink token with logit
+    ``sink[h]`` participated in the softmax (StreamingLLM epilogue)."""
+    lse32 = lse.astype(jnp.float32)
+    sink32 = sink.astype(jnp.float32)[None, :]
+    m = jnp.maximum(lse32, sink32)
+    denom = jnp.exp(lse32 - m) + jnp.exp(sink32 - m)
+    scale = jnp.exp(lse32 - m) / denom
+    return (out.astype(jnp.float32) * scale[..., None]).astype(out.dtype)
+
+
+class BatchAttentionWithAttentionSinkWrapper(BatchAttention):
+    """Holistic attention + sink epilogue (reference attention/_core.py:330)."""
+
+    def __init__(self, *args, sink: Optional[jax.Array] = None, **kw):
+        super().__init__(*args, **kw)
+        self._sink = sink
+
+    def set_sink(self, sink: jax.Array) -> None:
+        self._sink = sink
+
+    def run(self, q, paged_kv_cache, *, sink: Optional[jax.Array] = None,
+            return_lse: bool = False, **kw):
+        s = sink if sink is not None else self._sink
+        if s is None:
+            raise ValueError("attention sink logits not provided")
+        out, lse = super().run(q, paged_kv_cache, return_lse=True, **kw)
+        out = apply_attention_sink(out, lse, s)
+        if return_lse:
+            # combined lse includes the sink term
+            lse_new = jnp.logaddexp(lse, jnp.broadcast_to(
+                s.astype(jnp.float32)[None, :], lse.shape))
+            return out, lse_new
+        return out

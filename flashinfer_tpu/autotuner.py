@@ -1,0 +1,132 @@
+"""Runtime kernel-parameter autotuner.
+
+TPU re-design of the reference autotuner (``flashinfer/autotuner/
+autotuner.py:560-1419`` — TunableRunner interface, ``autotune()`` context,
+profiling cache with hardware/version metadata validation).  GPU "tactics"
+(kernel template choices) map to Pallas launch parameters: block sizes for
+the flash kernel, pages-per-chunk for the decode kernels.  Outside an
+``autotune()`` context, cached or default parameters are used with zero
+profiling overhead; inside, every new (op, bucketed-shape) key is profiled
+once across its candidate set and persisted to a JSON cache keyed by
+device kind + library version (invalid on mismatch, like the reference's
+metadata validation, autotuner.py:297-382).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from flashinfer_tpu import env
+from flashinfer_tpu.version import __version__
+
+
+class AutoTuner:
+    _instance: Optional["AutoTuner"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._cache: Dict[str, Any] = {}
+        self._loaded = False
+        self._tuning_enabled = False
+
+    @classmethod
+    def get(cls) -> "AutoTuner":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = AutoTuner()
+            return cls._instance
+
+    # ---- persistence -----------------------------------------------------
+    def _meta(self) -> Dict[str, str]:
+        import jax
+
+        return {
+            "version": __version__,
+            "device": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+        }
+
+    def _cache_path(self) -> Path:
+        return env.cache_dir() / "autotuner" / "tactics.json"
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        p = self._cache_path()
+        try:
+            data = json.loads(p.read_text())
+            if data.get("meta") == self._meta():
+                self._cache = data.get("tactics", {})
+        except Exception:
+            pass
+
+    def _save(self) -> None:
+        p = self._cache_path()
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(
+            json.dumps({"meta": self._meta(), "tactics": self._cache}, indent=1)
+        )
+
+    # ---- tuning ----------------------------------------------------------
+    def choose_one(
+        self,
+        op_name: str,
+        shape_key: Sequence,
+        candidates: Sequence[Any],
+        runner: Callable[[Any], Callable[[], Any]],
+        default: Any = None,
+    ) -> Any:
+        """Pick the best candidate for (op, shape_key).
+
+        ``runner(candidate)`` returns a nullary callable executing the op
+        with that candidate; it is timed with ``block_until_ready``.
+        Mirrors ``AutoTuner.choose_one`` (reference autotuner.py:1419)."""
+        self._load()
+        key = f"{op_name}|{'_'.join(map(str, shape_key))}"
+        if key in self._cache:
+            val = self._cache[key]
+            return tuple(val) if isinstance(val, list) else val
+        if not self._tuning_enabled:
+            return default if default is not None else candidates[0]
+
+        import jax
+
+        best, best_t = None, float("inf")
+        for cand in candidates:
+            try:
+                f = runner(cand)
+                out = f()
+                jax.block_until_ready(out)  # compile+warm
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = f()
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / 5
+            except Exception:
+                continue
+            if dt < best_t:
+                best, best_t = cand, dt
+        if best is None:
+            best = default if default is not None else candidates[0]
+        self._cache[key] = list(best) if isinstance(best, tuple) else best
+        self._save()
+        return best
+
+
+@contextlib.contextmanager
+def autotune(enable: bool = True):
+    """Enable profiling-based tactic selection inside the context
+    (reference ``with autotune():`` surface)."""
+    t = AutoTuner.get()
+    prev = t._tuning_enabled
+    t._tuning_enabled = enable
+    try:
+        yield t
+    finally:
+        t._tuning_enabled = prev

@@ -1,0 +1,48 @@
+"""Environment report (reference ``flashinfer/collect_env.py``)."""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+from typing import Dict
+
+
+def collect_env() -> Dict[str, str]:
+    info = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        try:
+            info["backend"] = jax.default_backend()
+            devs = jax.devices()
+            info["devices"] = f"{len(devs)} x {devs[0].device_kind}"
+        except Exception as e:  # device init can fail off-accelerator
+            info["devices"] = f"<unavailable: {type(e).__name__}>"
+    except ImportError:
+        info["jax"] = "<not installed>"
+    for mod in ("jaxlib", "flax", "numpy"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            info[mod] = "<not installed>"
+    from flashinfer_tpu.version import __version__
+
+    info["flashinfer_tpu"] = __version__
+    for k, v in os.environ.items():
+        if k.startswith("FLASHINFER_TPU_") or k in ("JAX_PLATFORMS", "XLA_FLAGS"):
+            info[f"env:{k}"] = v
+    return info
+
+
+def main() -> None:
+    for k, v in collect_env().items():
+        print(f"{k:>24}: {v}")
+
+
+if __name__ == "__main__":
+    main()

@@ -164,26 +164,21 @@ class BatchDecodeWithPagedKVCacheWrapper:
             raise NotImplementedError(
                 "fused RoPE in batch decode: apply flashinfer_tpu.rope first"
             )
+        from flashinfer_tpu import native
+
         indptr = np.asarray(indptr)
         indices = np.asarray(indices)
         last_page_len = np.asarray(last_page_len)
         batch = len(indptr) - 1
         pages_per_req = indptr[1:] - indptr[:-1]
-        kv_lens = np.where(
-            pages_per_req > 0,
-            (pages_per_req - 1) * page_size + last_page_len,
-            0,
-        ).astype(np.int32)
 
-        # bucketed padding: bounded set of compiled shapes
+        # bucketed padding: bounded set of compiled shapes; table build in
+        # the native planner (csrc/planner.cpp decode_plan)
         p_bucket = max(next_power_of_two(int(pages_per_req.max(initial=1))), 8)
         b_bucket = max(next_power_of_two(batch), 8)
-        table = np.zeros((b_bucket, p_bucket), np.int32)
-        for b in range(batch):
-            n = int(pages_per_req[b])
-            table[b, :n] = indices[int(indptr[b]) : int(indptr[b]) + n]
-        kv_lens_pad = np.zeros((b_bucket,), np.int32)
-        kv_lens_pad[:batch] = kv_lens
+        table, kv_lens_pad = native.decode_plan(
+            indptr, indices, last_page_len, page_size, b_bucket, p_bucket
+        )
 
         self._plan = _DecodePlan(
             page_table=jnp.asarray(table),

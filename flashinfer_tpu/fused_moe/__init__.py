@@ -1,0 +1,24 @@
+"""Fused Mixture-of-Experts subsystem.
+
+TPU re-design of the reference MoE stack (``flashinfer/fused_moe/``,
+SURVEY §2.3): routing methods (core RoutingMethodType surface), the fused
+permute -> grouped-GEMM -> activation -> grouped-GEMM -> finalize pipeline
+(``cutlass_fused_moe`` core.py:873), and expert parallelism (moe_ep).
+
+TPU mapping: token permutation is an argsort, the grouped GEMMs are
+``jax.lax.ragged_dot`` (megablox-style MXU grouped matmul), and EP
+dispatch/combine are mesh collectives inside shard_map — the reference's
+NCCL/NIXL device channels collapse into compiled ICI collectives.
+"""
+
+from flashinfer_tpu.fused_moe.routing import (  # noqa: F401
+    RoutingMethodType,
+    route_deepseek_v3,
+    route_llama4,
+    route_renormalize,
+    route_topk,
+)
+from flashinfer_tpu.fused_moe.core import (  # noqa: F401
+    fused_moe,
+    fused_moe_ep,
+)

@@ -1,0 +1,110 @@
+"""Fused MoE pipeline: permute -> grouped GEMM -> act -> grouped GEMM -> finalize.
+
+Re-design of ``cutlass_fused_moe`` (reference fused_moe/core.py:873): the
+five CUDA stages map to
+
+1. permute: stable argsort of the flattened (token, expert-choice) pairs by
+   expert id (the reference's expert-major permutation);
+2/4. grouped GEMMs: ``jax.lax.ragged_dot`` over the expert-sorted rows
+   (megablox-style — group offsets come from a bincount, no capacity
+   padding, no wasted MXU work on empty experts);
+3. activation: silu_and_mul on the gate|up halves;
+5. finalize: inverse-permute + weighted sum over each token's k choices.
+
+Weight layout: ``w_gate_up [E, hidden, 2*inter]`` ([gate | up] columns),
+``w_down [E, inter, hidden]`` — the reference's reorder_rows_for_gated_act
+shuffling (core.py:245) is unnecessary because XLA owns the layout.
+
+Expert parallelism (``fused_moe_ep``): the reference's moe_ep subsystem
+(SURVEY §2.3 — NCCL-EP / NIXL-RDMA dispatch+combine) maps to the
+allgather-dispatch / psum-combine pattern over a mesh axis: every rank
+computes its local experts for the full (gathered) token set and the
+partial outputs sum over the axis.  An all_to_all dispatch variant is a
+later optimization for large EP degrees.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.activation import silu_and_mul
+
+
+@functools.partial(jax.jit, static_argnames=("num_experts", "activation"))
+def fused_moe(
+    hidden: jax.Array,  # [T, hidden]
+    w_gate_up: jax.Array,  # [E, hidden, 2*inter]
+    w_down: jax.Array,  # [E, inter, hidden]
+    topk_weights: jax.Array,  # [T, K] f32
+    topk_ids: jax.Array,  # [T, K] int32
+    num_experts: int,
+    activation: str = "silu",
+) -> jax.Array:
+    """Single-device fused MoE forward -> [T, hidden]."""
+    T, K = topk_ids.shape
+    dtype = hidden.dtype
+
+    flat_expert = topk_ids.reshape(-1)  # [T*K]
+    order = jnp.argsort(flat_expert, stable=True)
+    inv_token = order // K  # source token of each sorted row
+    x_sorted = hidden[inv_token]  # [T*K, hidden]
+    group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
+
+    h1 = jax.lax.ragged_dot(x_sorted, w_gate_up, group_sizes)  # [T*K, 2I]
+    if activation == "silu":
+        a = silu_and_mul(h1)
+    elif activation == "gelu":
+        d = h1.shape[-1] // 2
+        a = (
+            jax.nn.gelu(h1[..., :d].astype(jnp.float32))
+            * h1[..., d:].astype(jnp.float32)
+        ).astype(h1.dtype)
+    else:
+        raise ValueError(f"unknown activation {activation!r}")
+    h2 = jax.lax.ragged_dot(a, w_down, group_sizes)  # [T*K, hidden]
+
+    # finalize: route each sorted row back to (token, choice) and weight-sum
+    w_sorted = topk_weights.reshape(-1)[order].astype(jnp.float32)
+    contrib = h2.astype(jnp.float32) * w_sorted[:, None]
+    out = jnp.zeros((T, hidden.shape[1]), jnp.float32).at[inv_token].add(contrib)
+    return out.astype(dtype)
+
+
+def fused_moe_ep(
+    hidden: jax.Array,  # [T_local, hidden] (this rank's tokens)
+    w_gate_up: jax.Array,  # [E_local, hidden, 2*inter] (this rank's experts)
+    w_down: jax.Array,  # [E_local, inter, hidden]
+    topk_weights: jax.Array,  # [T_local, K]
+    topk_ids: jax.Array,  # [T_local, K] GLOBAL expert ids
+    num_experts: int,
+    axis: str = "tp",
+    activation: str = "silu",
+) -> jax.Array:
+    """Expert-parallel fused MoE (call inside shard_map).
+
+    Experts are contiguously sharded over ``axis`` (rank r owns
+    ``[r*E_local, (r+1)*E_local)``, the Mapping.ep_experts partition).
+    Dispatch = all_gather of tokens+routing; combine = psum of partials."""
+    ep = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    e_local = w_gate_up.shape[0]
+
+    xg = jax.lax.all_gather(hidden, axis, tiled=True)  # [T_global, hidden]
+    wg = jax.lax.all_gather(topk_weights, axis, tiled=True)
+    idg = jax.lax.all_gather(topk_ids, axis, tiled=True)
+
+    lo = rank * e_local
+    local = (idg >= lo) & (idg < lo + e_local)
+    # non-local choices route to a local dummy slot with zero weight
+    ids_local = jnp.where(local, idg - lo, 0).astype(jnp.int32)
+    w_local = jnp.where(local, wg, 0.0)
+
+    partial = fused_moe(
+        xg, w_gate_up, w_down, w_local, ids_local, e_local, activation
+    )
+    # combine: sum partials, then take this rank's token slice
+    return jax.lax.psum_scatter(partial, axis, tiled=True)

@@ -1,0 +1,83 @@
+"""MoE routing methods.
+
+Re-design of the reference routing kernels (``flashinfer/fused_moe/
+fused_routing_dsv3.py``, ``csrc/fused_moe/noAuxTcKernels.cu``,
+RoutingMethodType in ``flashinfer/tllm_enums.py``): pure-XLA fused
+softmax/sigmoid + top-k selections; each returns
+``(topk_weights [T, K] f32, topk_ids [T, K] int32)``.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RoutingMethodType(enum.IntEnum):
+    """Mirrors the reference enum (tllm_enums.py RoutingMethodType)."""
+
+    Default = 0  # softmax -> topk
+    Renormalize = 1  # topk -> softmax over the k
+    DeepSeekV3 = 2  # sigmoid + bias, grouped top-k, renorm, scale
+    Llama4 = 3  # top-1 sigmoid
+    RenormalizeNaive = 4
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def route_topk(logits: jax.Array, top_k: int) -> Tuple[jax.Array, jax.Array]:
+    """Default: softmax over all experts, then top-k."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, ids = jax.lax.top_k(probs, top_k)
+    return w, ids.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def route_renormalize(logits: jax.Array, top_k: int) -> Tuple[jax.Array, jax.Array]:
+    """Renormalize: top-k over logits, softmax over the selected k."""
+    v, ids = jax.lax.top_k(logits.astype(jnp.float32), top_k)
+    return jax.nn.softmax(v, axis=-1), ids.astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("top_k", "n_group", "topk_group", "routed_scaling_factor"),
+)
+def route_deepseek_v3(
+    logits: jax.Array,  # [T, E]
+    bias: jax.Array,  # [E] e_score_correction_bias
+    top_k: int,
+    n_group: int,
+    topk_group: int,
+    routed_scaling_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """DeepSeek-V3 no-aux-loss routing (reference noAuxTcKernels.cu):
+    sigmoid scores + correction bias; experts partitioned into ``n_group``
+    groups; only the best ``topk_group`` groups (by sum of their top-2
+    member scores) are eligible; final top-k over eligible experts; weights
+    are the *unbiased* sigmoid scores renormalized and scaled."""
+    T, E = logits.shape
+    scores = jax.nn.sigmoid(logits.astype(jnp.float32))
+    biased = scores + bias.astype(jnp.float32)[None, :]
+    g = biased.reshape(T, n_group, E // n_group)
+    # group score = sum of top-2 member scores
+    top2 = jax.lax.top_k(g, 2)[0].sum(-1)  # [T, n_group]
+    grp_kth = jax.lax.top_k(top2, topk_group)[0][:, -1:]
+    grp_mask = top2 >= grp_kth  # [T, n_group]
+    eligible = jnp.where(
+        jnp.repeat(grp_mask, E // n_group, axis=-1), biased, -jnp.inf
+    )
+    _, ids = jax.lax.top_k(eligible, top_k)
+    w = jnp.take_along_axis(scores, ids, axis=-1)
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+    return w * routed_scaling_factor, ids.astype(jnp.int32)
+
+
+@jax.jit
+def route_llama4(logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Llama-4: top-1 expert, sigmoid gate weight."""
+    v, ids = jax.lax.top_k(logits.astype(jnp.float32), 1)
+    return jax.nn.sigmoid(v), ids.astype(jnp.int32)

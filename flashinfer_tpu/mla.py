@@ -1,0 +1,196 @@
+"""MLA (DeepSeek Multi-Latent Attention) wrapper.
+
+TPU re-design of ``flashinfer/mla/_core.py:1397``
+(``BatchMLAPagedAttentionWrapper``, plan :1568 / run :1742): paged attention
+over compressed KV (ckv head_dim 512 + kpe head_dim 64) with MQA-shaped
+sharing across query heads.
+
+Two execution paths, selected by the planned qo lengths:
+- all qo_len == 1 -> the MLA decode Pallas kernel (ops/mla_decode.py);
+- otherwise (speculative multi-token / chunked prefill) -> gather the
+  planned pages into flattened ragged K/V and run the segment flash kernel
+  with q = [q_nope | q_pe], k = [ckv | kpe], v = ckv (prefill is
+  compute-bound; the gather pass is the documented v1 trade-off, as for
+  paged batch prefill).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.ops.flash_attention import flash_attention
+from flashinfer_tpu.ops.mla_decode import (
+    mla_paged_decode_attention,
+    xla_mla_paged_decode,
+)
+from flashinfer_tpu.ops.xla_ref import xla_ragged_attention
+from flashinfer_tpu.utils import next_power_of_two, resolve_backend
+
+
+@dataclass(frozen=True)
+class _MLAPlan:
+    decode_mode: bool
+    causal: bool
+    sm_scale: float
+    num_heads: int
+    head_dim_ckv: int
+    head_dim_kpe: int
+    page_size: int
+    batch_size: int
+    # decode-mode arrays
+    page_table: Optional[jax.Array] = None
+    kv_lens: Optional[jax.Array] = None
+    # ragged-mode arrays
+    q_seg: Optional[jax.Array] = None
+    q_pos: Optional[jax.Array] = None
+    kv_seg: Optional[jax.Array] = None
+    kv_pos: Optional[jax.Array] = None
+    kv_rows: Optional[jax.Array] = None
+    total_q: int = 0
+    tq_pad: int = 0
+
+
+class BatchMLAPagedAttentionWrapper:
+    """plan/run MLA attention (reference mla/_core.py:1397)."""
+
+    def __init__(self, float_workspace_buffer=None, backend: str = "auto",
+                 **_unused):
+        self._backend = backend
+        self._plan: Optional[_MLAPlan] = None
+
+    def plan(
+        self,
+        qo_indptr,  # [B+1]
+        kv_indptr,  # [B+1] page-table offsets
+        kv_indices,  # [total_pages]
+        kv_len_arr,  # [B] kv token lengths
+        num_heads: int,
+        head_dim_ckv: int,
+        head_dim_kpe: int,
+        page_size: int,
+        causal: bool = False,
+        sm_scale: Optional[float] = None,
+        q_data_type=jnp.bfloat16,
+        kv_data_type=None,
+        use_profiler: bool = False,
+        **_unused,
+    ) -> None:
+        qo_indptr = np.asarray(qo_indptr)
+        kv_indptr = np.asarray(kv_indptr)
+        kv_indices = np.asarray(kv_indices)
+        kv_len = np.asarray(kv_len_arr).astype(np.int64)
+        batch = len(qo_indptr) - 1
+        qo_lens = qo_indptr[1:] - qo_indptr[:-1]
+        if sm_scale is None:
+            sm_scale = 1.0 / float(head_dim_ckv + head_dim_kpe) ** 0.5
+
+        if (qo_lens == 1).all():
+            pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
+            p_bucket = max(next_power_of_two(int(pages_per_req.max(initial=1))), 8)
+            b_bucket = max(next_power_of_two(batch), 8)
+            table = np.zeros((b_bucket, p_bucket), np.int32)
+            for b in range(batch):
+                n = int(pages_per_req[b])
+                table[b, :n] = kv_indices[int(kv_indptr[b]) : int(kv_indptr[b]) + n]
+            lens = np.zeros((b_bucket,), np.int32)
+            lens[:batch] = kv_len
+            self._plan = _MLAPlan(
+                decode_mode=True, causal=causal, sm_scale=float(sm_scale),
+                num_heads=num_heads, head_dim_ckv=head_dim_ckv,
+                head_dim_kpe=head_dim_kpe, page_size=page_size,
+                batch_size=batch,
+                page_table=jnp.asarray(table), kv_lens=jnp.asarray(lens),
+            )
+            return
+
+        # ragged mode: flatten tokens with segments (same scheme as prefill)
+        total_q = int(qo_indptr[-1])
+        kv_tok_indptr = np.concatenate([[0], np.cumsum(kv_len)])
+        total_kv = int(kv_tok_indptr[-1])
+        tq_pad = max(next_power_of_two(total_q), 128)
+        tkv_pad = max(next_power_of_two(total_kv), 128)
+        q_seg = np.full((tq_pad,), -1, np.int32)
+        q_pos = np.zeros((tq_pad,), np.int32)
+        kv_seg = np.full((tkv_pad,), -2, np.int32)
+        kv_pos = np.zeros((tkv_pad,), np.int32)
+        rows = np.zeros((tkv_pad,), np.int64)
+        for r in range(batch):
+            qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+            q_seg[qs:qe] = r
+            q_pos[qs:qe] = np.arange(qe - qs) + int(kv_len[r]) - (qe - qs)
+            ks, n = int(kv_tok_indptr[r]), int(kv_len[r])
+            kv_seg[ks : ks + n] = r
+            kv_pos[ks : ks + n] = np.arange(n)
+            pages = kv_indices[int(kv_indptr[r]) : int(kv_indptr[r + 1])]
+            tok = np.arange(n)
+            rows[ks : ks + n] = pages[tok // page_size] * page_size + tok % page_size
+        self._plan = _MLAPlan(
+            decode_mode=False, causal=causal, sm_scale=float(sm_scale),
+            num_heads=num_heads, head_dim_ckv=head_dim_ckv,
+            head_dim_kpe=head_dim_kpe, page_size=page_size, batch_size=batch,
+            q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
+            kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
+            kv_rows=jnp.asarray(rows, dtype=jnp.int32),
+            total_q=total_q, tq_pad=tq_pad,
+        )
+
+    def run(
+        self,
+        q_nope: jax.Array,  # [total_q, num_heads, head_dim_ckv]
+        q_pe: jax.Array,  # [total_q, num_heads, head_dim_kpe]
+        ckv_cache: jax.Array,  # [num_pages, page_size, head_dim_ckv]
+        kpe_cache: jax.Array,  # [num_pages, page_size, head_dim_kpe]
+        *,
+        return_lse: bool = False,
+    ):
+        plan = self._plan
+        if plan is None:
+            raise RuntimeError("plan() must be called before run()")
+        backend = resolve_backend(self._backend, "batch_mla")
+        if plan.decode_mode:
+            b_pad = plan.page_table.shape[0]
+            if q_nope.shape[0] != b_pad:
+                pad = b_pad - q_nope.shape[0]
+                q_nope = jnp.pad(q_nope, ((0, pad), (0, 0), (0, 0)))
+                q_pe = jnp.pad(q_pe, ((0, pad), (0, 0), (0, 0)))
+            fn = (
+                mla_paged_decode_attention
+                if backend == "pallas"
+                else xla_mla_paged_decode
+            )
+            out = fn(
+                q_nope, q_pe, ckv_cache, kpe_cache, plan.page_table,
+                plan.kv_lens, sm_scale=plan.sm_scale, return_lse=return_lse,
+            )
+            if return_lse:
+                return out[0][: plan.batch_size], out[1][: plan.batch_size]
+            return out[: plan.batch_size]
+
+        # ragged path: gather + segment flash with asymmetric head dims
+        ckv_rows = ckv_cache.reshape(-1, plan.head_dim_ckv)[plan.kv_rows]
+        kpe_rows = kpe_cache.reshape(-1, plan.head_dim_kpe)[plan.kv_rows]
+        k = jnp.concatenate([ckv_rows, kpe_rows], axis=-1)[:, None, :]  # MQA
+        v = ckv_rows[:, None, :]
+        q = jnp.concatenate(
+            [q_nope.astype(jnp.float32), q_pe.astype(jnp.float32)], axis=-1
+        ).astype(q_nope.dtype)
+        if q.shape[0] != plan.tq_pad:
+            q = jnp.pad(q, ((0, plan.tq_pad - q.shape[0]), (0, 0), (0, 0)))
+        fn = flash_attention if backend == "pallas" else xla_ragged_attention
+        out = fn(
+            q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+            causal=plan.causal, sm_scale=plan.sm_scale, return_lse=return_lse,
+        )
+        if return_lse:
+            return out[0][: plan.total_q], out[1][: plan.total_q]
+        return out[: plan.total_q]
+
+    forward = run
+
+    def end_forward(self) -> None:
+        pass

@@ -1,0 +1,190 @@
+"""Native planner loader: builds csrc/planner.cpp on first use (the TPU
+analogue of the reference's JIT build layer, flashinfer/jit/core.py:225 —
+cached .so, file lock, graceful Python fallback when a toolchain is
+missing)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from flashinfer_tpu import env
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = Path(__file__).resolve().parent.parent / "csrc" / "planner.cpp"
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    import logging
+
+    cache = env.cache_dir() / "native"
+    cache.mkdir(parents=True, exist_ok=True)
+    so = cache / "libfi_planner.so"
+    try:
+        if (not so.exists()) or so.stat().st_mtime < _SRC.stat().st_mtime:
+            # pid-unique tmp: concurrent cold-start builds each write their
+            # own file; os.replace is atomic so whichever finishes last wins
+            # with a complete .so
+            tmp = so.with_suffix(f".so.tmp.{os.getpid()}")
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 str(_SRC), "-o", str(tmp)],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(str(so))
+        lib.decode_plan.restype = ctypes.c_int
+        lib.token_axis_plan.restype = ctypes.c_int
+        lib.paged_gather_plan.restype = ctypes.c_int
+        lib.bsr_plan.restype = ctypes.c_int
+        return lib
+    except subprocess.CalledProcessError as e:
+        logging.getLogger("flashinfer_tpu").warning(
+            "native planner build failed (falling back to Python loops): %s",
+            (e.stderr or b"").decode(errors="replace")[:500],
+        )
+        return None
+    except Exception as e:
+        logging.getLogger("flashinfer_tpu").warning(
+            "native planner unavailable (falling back to Python loops): %r", e
+        )
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native planner library, or None (callers fall back to numpy)."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _build_and_load()
+            _TRIED = True
+    return _LIB
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def decode_plan(
+    indptr: np.ndarray, indices: np.ndarray, last_page_len: np.ndarray,
+    page_size: int, b_bucket: int, p_bucket: int,
+):
+    """Padded page-table build; native when available, numpy otherwise.
+    Returns (table [b_bucket, p_bucket] i32, kv_lens [b_bucket] i32)."""
+    batch = len(indptr) - 1
+    indptr = np.ascontiguousarray(indptr, np.int32)
+    indices = np.ascontiguousarray(indices, np.int32)
+    last_page_len = np.ascontiguousarray(last_page_len, np.int32)
+    table = np.zeros((b_bucket, p_bucket), np.int32)
+    kv_lens = np.zeros((b_bucket,), np.int32)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.decode_plan(
+            _ptr(indptr), _ptr(indices), _ptr(last_page_len),
+            batch, len(indices), page_size, b_bucket, p_bucket,
+            _ptr(table), _ptr(kv_lens),
+        )
+        if rc == 0:
+            return table, kv_lens
+        if rc == -2:
+            raise ValueError("decode_plan: indptr inconsistent with indices")
+        raise ValueError(
+            f"decode_plan: geometry exceeds buckets "
+            f"(batch {batch} > {b_bucket} or pages > {p_bucket})"
+        )
+    for b in range(batch):
+        n = int(indptr[b + 1] - indptr[b])
+        table[b, :n] = indices[int(indptr[b]) : int(indptr[b]) + n]
+        kv_lens[b] = (n - 1) * page_size + int(last_page_len[b]) if n else 0
+    return table, kv_lens
+
+
+def token_axis_plan(
+    indptr: np.ndarray, pos_offset: np.ndarray, pad_to: int, pad_seg: int,
+):
+    """Flatten ragged requests onto a padded token axis -> (seg, pos)."""
+    batch = len(indptr) - 1
+    indptr64 = np.ascontiguousarray(indptr, np.int64)
+    off64 = np.ascontiguousarray(pos_offset, np.int64)
+    seg = np.empty((pad_to,), np.int32)
+    pos = np.empty((pad_to,), np.int32)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.token_axis_plan(
+            _ptr(indptr64), _ptr(off64), batch, pad_to, pad_seg,
+            _ptr(seg), _ptr(pos),
+        )
+        if rc == 0:
+            return seg, pos
+        raise ValueError(f"token_axis_plan: {indptr64[-1]} tokens > pad {pad_to}")
+    seg.fill(pad_seg)
+    pos.fill(0)
+    for r in range(batch):
+        s, e = int(indptr64[r]), int(indptr64[r + 1])
+        seg[s:e] = r
+        pos[s:e] = np.arange(e - s) + int(off64[r])
+    return seg, pos
+
+
+def paged_gather_plan(
+    kv_tok_indptr: np.ndarray, page_indptr: np.ndarray,
+    page_indices: np.ndarray, page_size: int, pad_to: int,
+):
+    """Flat cache-row ids per kv token -> rows [pad_to] i32."""
+    batch = len(page_indptr) - 1
+    tok64 = np.ascontiguousarray(kv_tok_indptr, np.int64)
+    pip = np.ascontiguousarray(page_indptr, np.int32)
+    pidx = np.ascontiguousarray(page_indices, np.int32)
+    rows = np.zeros((pad_to,), np.int32)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.paged_gather_plan(
+            _ptr(tok64), _ptr(pip), _ptr(pidx), batch, len(pidx), page_size,
+            pad_to, _ptr(rows),
+        )
+        if rc == 0:
+            return rows
+        if rc == -2:
+            raise ValueError(
+                "paged_gather_plan: kv lengths inconsistent with page lists"
+            )
+        raise ValueError("paged_gather_plan: tokens exceed pad")
+    for r in range(batch):
+        s = int(tok64[r])
+        n = int(tok64[r + 1] - s)
+        pages = pidx[int(pip[r]) : int(pip[r + 1])]
+        tok = np.arange(n)
+        rows[s : s + n] = pages[tok // page_size] * page_size + tok % page_size
+    return rows
+
+
+def bsr_plan(indptr: np.ndarray, indices: np.ndarray, max_nnz: int):
+    """Pad BSR per-row column lists -> cols [MB * max_nnz] i32."""
+    mb = len(indptr) - 1
+    ip = np.ascontiguousarray(indptr, np.int32)
+    idx = np.ascontiguousarray(indices, np.int32)
+    cols = np.zeros((mb * max_nnz,), np.int32)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.bsr_plan(_ptr(ip), _ptr(idx), mb, len(idx), max_nnz, _ptr(cols))
+        if rc == 0:
+            return cols
+        raise ValueError(
+            "bsr_plan: invalid BSR structure (non-monotonic indptr, nnz > "
+            "max_nnz, or indices out of bounds)"
+        )
+    for i in range(mb):
+        n = int(ip[i + 1] - ip[i])
+        cols[i * max_nnz : i * max_nnz + n] = idx[int(ip[i]) : int(ip[i]) + n]
+    return cols

@@ -116,15 +116,12 @@ class _PrefillPlan:
 def _build_token_axis(
     indptr: np.ndarray, pad_to: int, pad_seg: int, pos_offset: np.ndarray
 ):
-    """Flatten ragged requests to one token axis: returns (seg, pos)."""
-    total = int(indptr[-1])
-    seg = np.full((pad_to,), pad_seg, np.int32)
-    pos = np.zeros((pad_to,), np.int32)
-    for r in range(len(indptr) - 1):
-        s, e = int(indptr[r]), int(indptr[r + 1])
-        seg[s:e] = r
-        pos[s:e] = np.arange(e - s) + int(pos_offset[r])
-    return seg, pos, total
+    """Flatten ragged requests to one token axis: returns (seg, pos, total).
+    Hot host loop -> native planner (csrc/planner.cpp token_axis_plan)."""
+    from flashinfer_tpu import native
+
+    seg, pos = native.token_axis_plan(indptr, pos_offset, pad_to, pad_seg)
+    return seg, pos, int(indptr[-1])
 
 
 class BatchPrefillWithRaggedKVCacheWrapper:
@@ -285,16 +282,12 @@ class BatchPrefillWithPagedKVCacheWrapper:
         kv_seg, kv_pos, total_kv = _build_token_axis(
             kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
         )
-        # flat cache-row id for each flattened kv token
-        rows = np.zeros((tkv_pad,), np.int64)
-        for r in range(batch):
-            s = int(kv_indptr[r])
-            n = int(kv_lens[r])
-            pages = kv_indices[
-                int(kv_indptr_pages[r]) : int(kv_indptr_pages[r + 1])
-            ]
-            tok = np.arange(n)
-            rows[s : s + n] = pages[tok // page_size] * page_size + tok % page_size
+        # flat cache-row id for each flattened kv token (native planner)
+        from flashinfer_tpu import native
+
+        rows = native.paged_gather_plan(
+            kv_indptr, kv_indptr_pages, kv_indices, page_size, tkv_pad
+        )
         self._plan = _PrefillPlan(
             q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
             kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),

@@ -53,17 +53,14 @@ class BlockSparseAttentionWrapper:
             raise NotImplementedError("per-block bitmasks: later round")
         if M % R or N % C:
             raise ValueError("M/N must be multiples of R/C")
+        from flashinfer_tpu import native
+
         indptr = np.asarray(indptr)
         indices = np.asarray(indices)
         MB = M // R
         nnz_per_row = indptr[1:] - indptr[:-1]
         max_nnz = max(next_power_of_two(int(nnz_per_row.max(initial=1))), 1)
-        cols = np.zeros((MB * max_nnz,), np.int32)
-        for i in range(MB):
-            n = int(nnz_per_row[i])
-            cols[i * max_nnz : i * max_nnz + n] = indices[
-                int(indptr[i]) : int(indptr[i]) + n
-            ]
+        cols = native.bsr_plan(indptr, indices, max_nnz)
         self._plan = dict(
             indptr=jnp.asarray(indptr, dtype=jnp.int32),
             cols=jnp.asarray(cols),

@@ -1,0 +1,143 @@
+"""BatchAttention (holistic mixed batch), POD alias, attention sinks, and
+native-planner parity tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.testing import attention_ref
+
+
+def _mixed_setup(seed=0):
+    """3 requests: 1-token decode, 16-token prefill-append, 1-token decode."""
+    HQ, HKV, D, PS = 4, 2, 64, 8
+    qo_lens = [1, 16, 1]
+    kv_lens = [40, 32, 9]
+    num_pages = 32
+    rng = np.random.default_rng(seed)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    kv_indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: kv_indptr[-1]].astype(np.int32)
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int32)
+    kc = jax.random.normal(jax.random.PRNGKey(seed), (num_pages, PS, HKV, D), jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(seed + 1), (num_pages, PS, HKV, D), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(seed + 2), (int(qo_indptr[-1]), HQ, D), jnp.float32)
+    return (HQ, HKV, D, PS, qo_lens, kv_lens, qo_indptr, kv_indptr, indices,
+            kc, vc, q)
+
+
+def _ref_per_request(q, kc, vc, qo_indptr, kv_indptr, indices, kv_lens, PS,
+                     causal=True):
+    rows = np.asarray(kc).reshape(-1, kc.shape[2], kc.shape[3])
+    vrows = np.asarray(vc).reshape(-1, vc.shape[2], vc.shape[3])
+    outs = []
+    for r in range(len(kv_lens)):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        pages = indices[kv_indptr[r] : kv_indptr[r + 1]]
+        tok = np.arange(kv_lens[r])
+        rr = pages[tok // PS] * PS + tok % PS
+        outs.append(
+            attention_ref(q[qs:qe], jnp.asarray(rows[rr]), jnp.asarray(vrows[rr]),
+                          causal=causal)
+        )
+    return jnp.concatenate(outs)
+
+
+@pytest.mark.parametrize("cls", [fi.BatchAttention, fi.PODWithPagedKVCacheWrapper])
+def test_holistic_mixed_batch(cls):
+    (HQ, HKV, D, PS, qo_lens, kv_lens, qo_indptr, kv_indptr, indices,
+     kc, vc, q) = _mixed_setup()
+    w = cls(kv_layout="NHD")
+    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
+           causal=True)
+    out = w.run(q, (kc, vc))
+    ref = _ref_per_request(q, kc, vc, qo_indptr, kv_indptr, indices, kv_lens, PS)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_attention_sink_epilogue():
+    """sink == -inf must be a no-op; large sink shrinks the output."""
+    out = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 32))
+    lse = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+    no_sink = fi.apply_attention_sink(out, lse, jnp.full((4,), -1e30))
+    np.testing.assert_allclose(np.asarray(no_sink), np.asarray(out), rtol=1e-5, atol=1e-6)
+    big_sink = fi.apply_attention_sink(out, lse, jnp.full((4,), 50.0))
+    assert float(jnp.max(jnp.abs(big_sink))) < 1e-6
+    # exact math: scale = exp(lse) / (exp(lse) + exp(s))
+    s = jnp.array([0.5, -1.0, 2.0, 0.0])
+    got = fi.apply_attention_sink(out, lse, s)
+    scale = np.exp(np.asarray(lse)) / (np.exp(np.asarray(lse)) + np.exp(np.asarray(s))[None])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(out) * scale[..., None], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_sink_wrapper():
+    (HQ, HKV, D, PS, qo_lens, kv_lens, qo_indptr, kv_indptr, indices,
+     kc, vc, q) = _mixed_setup(3)
+    sink = jnp.array([0.0, 1.0, -2.0, 0.5])
+    w = fi.BatchAttentionWithAttentionSinkWrapper(kv_layout="NHD", sink=sink)
+    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
+           causal=True)
+    out = w.run(q, (kc, vc))
+    base = fi.BatchAttention(kv_layout="NHD")
+    base.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
+              causal=True)
+    o, lse = base.run(q, (kc, vc), return_lse=True)
+    ref = fi.apply_attention_sink(o, lse, sink)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_native_planner_matches_numpy_fallback():
+    from flashinfer_tpu import native
+
+    rng = np.random.default_rng(0)
+    indptr = np.array([0, 3, 3, 7], np.int32)
+    indices = rng.integers(0, 100, 7).astype(np.int32)
+    last = np.array([5, 0, 2], np.int32)
+    t1, l1 = native.decode_plan(indptr, indices, last, 16, 8, 8)
+    lib_save = native._LIB
+    native._LIB = None  # force numpy fallback
+    try:
+        t2, l2 = native.decode_plan(indptr, indices, last, 16, 8, 8)
+    finally:
+        native._LIB = lib_save
+    np.testing.assert_array_equal(t1, t2)
+    np.testing.assert_array_equal(l1, l2)
+
+    s1, p1 = native.token_axis_plan(np.array([0, 2, 6]), np.array([4, 0]), 8, -1)
+    native._LIB = None
+    try:
+        s2, p2 = native.token_axis_plan(np.array([0, 2, 6]), np.array([4, 0]), 8, -1)
+    finally:
+        native._LIB = lib_save
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(p1, p2)
+
+    r1 = native.paged_gather_plan(
+        np.array([0, 5, 12]), np.array([0, 1, 3]),
+        np.array([4, 0, 2], np.int32), 8, 16,
+    )
+    native._LIB = None
+    try:
+        r2 = native.paged_gather_plan(
+            np.array([0, 5, 12]), np.array([0, 1, 3]),
+            np.array([4, 0, 2], np.int32), 8, 16,
+        )
+    finally:
+        native._LIB = lib_save
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_native_planner_bounds_errors():
+    from flashinfer_tpu import native
+
+    if native.get_lib() is None:
+        pytest.skip("native planner not built")
+    with pytest.raises(ValueError, match="exceeds buckets"):
+        native.decode_plan(
+            np.array([0, 20]), np.arange(20, dtype=np.int32),
+            np.array([1], np.int32), 16, 8, 8,
+        )

@@ -1,0 +1,138 @@
+"""MLA attention tests vs an eager compressed-KV reference (mirrors
+reference tests/attention/test_deepseek_mla.py strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper
+
+
+def _mla_ref(q_nope, q_pe, ckv, kpe, sm_scale, causal=False, qo_len=None):
+    """Eager MLA for one request: ckv/kpe [kv_len, d]; q [qo, H, d]."""
+    qn = np.asarray(q_nope, np.float32)
+    qp = np.asarray(q_pe, np.float32)
+    c = np.asarray(ckv, np.float32)
+    p = np.asarray(kpe, np.float32)
+    s = (np.einsum("qhd,kd->hqk", qn, c) + np.einsum("qhd,kd->hqk", qp, p)) * sm_scale
+    qo, kv = qn.shape[0], c.shape[0]
+    if causal:
+        mask = np.arange(kv)[None, :] <= np.arange(qo)[:, None] + (kv - qo)
+        s = np.where(mask[None], s, -1e30)
+    m = s.max(-1, keepdims=True)
+    e = np.exp(s - m)
+    if causal:
+        e = np.where(mask[None], e, 0)
+    out = np.einsum("hqk,kd->qhd", e / e.sum(-1, keepdims=True), c)
+    return out
+
+
+def _setup_cache(key, num_pages, ps, d_ckv, d_kpe, dtype=jnp.float32):
+    ckv = jax.random.normal(key, (num_pages, ps, d_ckv), dtype)
+    kpe = jax.random.normal(jax.random.fold_in(key, 1), (num_pages, ps, d_kpe), dtype)
+    return ckv, kpe
+
+
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_mla_decode(backend):
+    B, H, d_ckv, d_kpe, PS = 3, 16, 128, 64, 8
+    kv_lens = [19, 40, 3]
+    num_pages = 32
+    sm = 1 / np.sqrt(d_ckv + d_kpe)
+    rng = np.random.default_rng(0)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    kv_indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: kv_indptr[-1]].astype(np.int32)
+    qo_indptr = np.arange(B + 1, dtype=np.int32)
+
+    ckv, kpe = _setup_cache(jax.random.PRNGKey(0), num_pages, PS, d_ckv, d_kpe)
+    q_nope = jax.random.normal(jax.random.PRNGKey(1), (B, H, d_ckv), jnp.float32)
+    q_pe = jax.random.normal(jax.random.PRNGKey(2), (B, H, d_kpe), jnp.float32)
+
+    w = BatchMLAPagedAttentionWrapper(backend=backend)
+    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), H, d_ckv, d_kpe, PS)
+    out, lse = w.run(q_nope, q_pe, ckv, kpe, return_lse=True)
+
+    crows = np.asarray(ckv).reshape(-1, d_ckv)
+    prows = np.asarray(kpe).reshape(-1, d_kpe)
+    for b in range(B):
+        pages = indices[kv_indptr[b] : kv_indptr[b + 1]]
+        tok = np.arange(kv_lens[b])
+        rows = pages[tok // PS] * PS + tok % PS
+        ref = _mla_ref(q_nope[b : b + 1], q_pe[b : b + 1], crows[rows], prows[rows], sm)
+        np.testing.assert_allclose(
+            np.asarray(out[b]), ref[0], rtol=2e-3, atol=2e-3, err_msg=f"req {b}"
+        )
+
+
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_mla_ragged_multitoken(backend):
+    """Speculative multi-token qo (qo_len 3) exercises the ragged path."""
+    B, H, d_ckv, d_kpe, PS = 2, 8, 64, 32, 8
+    kv_lens = [24, 17]
+    qo_lens = [3, 3]
+    num_pages = 16
+    sm = 1 / np.sqrt(d_ckv + d_kpe)
+    rng = np.random.default_rng(1)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    kv_indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: kv_indptr[-1]].astype(np.int32)
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int32)
+
+    ckv, kpe = _setup_cache(jax.random.PRNGKey(3), num_pages, PS, d_ckv, d_kpe)
+    tq = int(qo_indptr[-1])
+    q_nope = jax.random.normal(jax.random.PRNGKey(4), (tq, H, d_ckv), jnp.float32)
+    q_pe = jax.random.normal(jax.random.PRNGKey(5), (tq, H, d_kpe), jnp.float32)
+
+    w = BatchMLAPagedAttentionWrapper(backend=backend)
+    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), H, d_ckv, d_kpe,
+           PS, causal=True)
+    out = w.run(q_nope, q_pe, ckv, kpe)
+
+    crows = np.asarray(ckv).reshape(-1, d_ckv)
+    prows = np.asarray(kpe).reshape(-1, d_kpe)
+    for b in range(B):
+        qs, qe = qo_indptr[b], qo_indptr[b + 1]
+        pages = indices[kv_indptr[b] : kv_indptr[b + 1]]
+        tok = np.arange(kv_lens[b])
+        rows = pages[tok // PS] * PS + tok % PS
+        ref = _mla_ref(
+            q_nope[qs:qe], q_pe[qs:qe], crows[rows], prows[rows], sm, causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), ref, rtol=2e-3, atol=2e-3, err_msg=f"req {b}"
+        )
+
+
+def test_mla_append_cache_roundtrip():
+    """append_paged_mla_kv_cache -> wrapper decode consistency."""
+    B, H, d_ckv, d_kpe, PS = 2, 4, 32, 16, 4
+    num_pages = 8
+    ckv = jnp.zeros((num_pages, PS, d_ckv))
+    kpe = jnp.zeros((num_pages, PS, d_kpe))
+    kv_lens = np.array([5, 3], np.int32)
+    kv_indptr = np.array([0, 2, 3], np.int32)
+    indices = np.array([4, 1, 6], np.int32)
+    nnz = int(kv_lens.sum())
+    append_indptr = jnp.array([0, 5, 8], jnp.int32)
+    bi, pos = fi.get_batch_indices_positions(
+        append_indptr, jnp.asarray(kv_lens), nnz
+    )
+    ckv_data = jax.random.normal(jax.random.PRNGKey(0), (nnz, d_ckv))
+    kpe_data = jax.random.normal(jax.random.PRNGKey(1), (nnz, d_kpe))
+    ckv, kpe = fi.append_paged_mla_kv_cache(
+        ckv_data, kpe_data, bi, pos, ckv, kpe, jnp.asarray(indices),
+        jnp.asarray(kv_indptr),
+    )
+    q_nope = jax.random.normal(jax.random.PRNGKey(2), (B, H, d_ckv))
+    q_pe = jax.random.normal(jax.random.PRNGKey(3), (B, H, d_kpe))
+    w = BatchMLAPagedAttentionWrapper(backend="xla")
+    w.plan(np.arange(B + 1), kv_indptr, indices, kv_lens, H, d_ckv, d_kpe, PS)
+    out = w.run(q_nope, q_pe, ckv, kpe)
+    sm = 1 / np.sqrt(d_ckv + d_kpe)
+    ref0 = _mla_ref(
+        q_nope[0:1], q_pe[0:1], np.asarray(ckv_data[:5]), np.asarray(kpe_data[:5]), sm
+    )
+    np.testing.assert_allclose(np.asarray(out[0]), ref0[0], rtol=2e-3, atol=2e-3)

@@ -1,0 +1,133 @@
+"""Tooling tests: CLI commands, api_logging levels, autotuner cache
+(mirrors reference tests/cli + tests/utils/test_logging_replay +
+tests/autotuner strategy)."""
+
+import json
+import logging
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _run_cli(*args, env_extra=None):
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop the axon sitecustomize (PYTHONPATH) so the subprocess honors
+    # JAX_PLATFORMS=cpu instead of dialing the tunneled TPU
+    env.pop("PYTHONPATH", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "flashinfer_tpu", *args],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+
+
+def test_cli_show_config_and_modules(tmp_path):
+    r = _run_cli("show-config",
+                 env_extra={"FLASHINFER_TPU_CACHE_DIR": str(tmp_path)})
+    assert r.returncode == 0, r.stderr
+    assert "cache_dir" in r.stdout and str(tmp_path) in r.stdout
+    r = _run_cli("list-modules")
+    assert r.returncode == 0
+    assert "BatchDecodeWithPagedKVCacheWrapper" in r.stdout
+    r = _run_cli("module-status",
+                 env_extra={"FLASHINFER_TPU_CACHE_DIR": str(tmp_path)})
+    assert r.returncode == 0
+    assert "planner" in r.stdout
+
+
+def test_cli_collect_env():
+    r = _run_cli("collect-env")
+    assert r.returncode == 0, r.stderr
+    assert "jax" in r.stdout and "flashinfer_tpu" in r.stdout
+
+
+def test_cli_clear_cache(tmp_path):
+    d = tmp_path / "c"
+    (d / "sub").mkdir(parents=True)
+    (d / "sub" / "x.bin").write_bytes(b"abc")
+    r = _run_cli("clear-cache", env_extra={"FLASHINFER_TPU_CACHE_DIR": str(d)})
+    assert r.returncode == 0
+    assert not d.exists()
+
+
+def test_api_logging_levels(monkeypatch, caplog):
+    from flashinfer_tpu.api_logging import flashinfer_api
+
+    calls = []
+
+    @flashinfer_api(name="demo_op")
+    def demo(x, flag=True):
+        calls.append(1)
+        return x * 2
+
+    # level 0: passthrough, no records
+    monkeypatch.setenv("FLASHINFER_TPU_LOGLEVEL", "0")
+    with caplog.at_level(logging.INFO, logger="flashinfer_tpu"):
+        demo(jnp.ones((2, 2)))
+    assert not [r for r in caplog.records if "demo_op" in r.message]
+
+    monkeypatch.setenv("FLASHINFER_TPU_LOGLEVEL", "3")
+    with caplog.at_level(logging.INFO, logger="flashinfer_tpu"):
+        demo(jnp.ones((2, 2)), flag=False)
+    recs = [r for r in caplog.records if "demo_op" in r.message]
+    assert recs and "Array(2, 2)" in recs[0].message
+    assert len(calls) == 2
+
+
+def test_api_logging_dump(monkeypatch, tmp_path):
+    from flashinfer_tpu.api_logging import flashinfer_api
+
+    monkeypatch.setenv("FLASHINFER_TPU_LOGLEVEL", "10")
+    monkeypatch.setenv("FLASHINFER_TPU_DUMP_DIR", str(tmp_path))
+
+    @flashinfer_api(name="dumped_op")
+    def op(x):
+        return x + 1
+
+    op(jnp.arange(4.0))
+    dumps = list(tmp_path.glob("dumped_op_*/arg0.npy"))
+    assert len(dumps) == 1
+    np.testing.assert_allclose(np.load(dumps[0]), np.arange(4.0))
+
+
+def test_autotuner_cache_and_context(monkeypatch, tmp_path):
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    import flashinfer_tpu.autotuner as at
+
+    at.AutoTuner._instance = None  # fresh singleton for the temp cache
+    tuner = at.AutoTuner.get()
+
+    # outside autotune(): default, no profiling
+    probed = []
+
+    def runner(c):
+        def f():
+            probed.append(c)
+            return jnp.zeros(())
+        return f
+
+    got = tuner.choose_one("op", (128,), [(64,), (128,)], runner, default=(128,))
+    assert got == (128,) and not probed
+
+    # inside autotune(): profiles all candidates, persists
+    with at.autotune():
+        got = tuner.choose_one("op", (128,), [(64,), (128,)], runner)
+    assert set(probed) == {(64,), (128,)}
+    data = json.loads((tmp_path / "autotuner" / "tactics.json").read_text())
+    assert "op|128" in data["tactics"]
+    assert data["meta"]["device"]
+
+    # cached: no re-profiling even inside autotune()
+    probed.clear()
+    with at.autotune():
+        got2 = tuner.choose_one("op", (128,), [(64,), (128,)], runner)
+    assert got2 == got and not probed
+    at.AutoTuner._instance = None
