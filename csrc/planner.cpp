@@ -63,6 +63,8 @@ int token_axis_plan(
     for (int32_t i = 0; i < pad_to; ++i) { seg[i] = pad_seg; pos[i] = 0; }
     for (int32_t r = 0; r < batch; ++r) {
         const int64_t s = indptr[r], e = indptr[r + 1];
+        // per-request bounds: catches non-monotonic/negative indptr
+        if (s < 0 || e < s || e > pad_to) return -2;
         const int64_t off = pos_offset[r];
         for (int64_t i = s; i < e; ++i) {
             seg[i] = r;
@@ -88,7 +90,7 @@ int paged_gather_plan(
     for (int32_t r = 0; r < batch; ++r) {
         const int64_t s = kv_tok_indptr[r];
         const int64_t n = kv_tok_indptr[r + 1] - s;
-        if (n < 0 || s < 0) return -2;
+        if (n < 0 || s < 0 || s + n > pad_to) return -2;
         const int32_t pbeg = page_indptr[r], pend = page_indptr[r + 1];
         // token count must fit the request's page list (catches
         // last_page_len > page_size and short indices arrays)

@@ -68,10 +68,27 @@ from flashinfer_tpu.activation import (  # noqa: F401
 )
 from flashinfer_tpu.norm import (  # noqa: F401
     fused_add_rmsnorm,
+    gate_residual,
     gemma_fused_add_rmsnorm,
     gemma_rmsnorm,
     layernorm,
+    layernorm_scale_shift,
+    qk_rmsnorm,
     rmsnorm,
+    rmsnorm_silu,
+)
+from flashinfer_tpu.concat_ops import concat_mla_k, concat_mla_q  # noqa: F401
+from flashinfer_tpu.gdn import (  # noqa: F401
+    gdn_decode_step,
+    gdn_prefill,
+    kda_decode_step,
+    kda_prefill,
+)
+from flashinfer_tpu.mamba import selective_scan, selective_state_update  # noqa: F401
+from flashinfer_tpu.mhc import (  # noqa: F401
+    mhc_dynamic_weights,
+    mhc_post_mix,
+    mhc_pre_mix,
 )
 from flashinfer_tpu.page import (  # noqa: F401
     append_paged_kv_cache,

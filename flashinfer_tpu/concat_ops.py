@@ -1,0 +1,26 @@
+"""Concat helper ops (reference ``flashinfer/concat_ops.py`` +
+``csrc/concat_mla.cu``): MLA-specific head assembly concats.  Pure-XLA —
+these exist as named ops for API parity; jit fuses them into neighbors."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def concat_mla_q(q_nope: jax.Array, q_pe: jax.Array) -> jax.Array:
+    """[T, H, d_ckv] + [T, H, d_kpe] -> [T, H, d_ckv + d_kpe]."""
+    return jnp.concatenate([q_nope, q_pe.astype(q_nope.dtype)], axis=-1)
+
+
+@jax.jit
+def concat_mla_k(
+    k_nope: jax.Array,  # [T, H, d] per-head decompressed keys
+    k_pe: jax.Array,  # [T, d_kpe] shared rope keys
+) -> jax.Array:
+    """Broadcast the shared k_pe across heads and concat (reference
+    concat_mla.cu semantics for MLA prefill head assembly)."""
+    T, H, _ = k_nope.shape
+    pe = jnp.broadcast_to(k_pe[:, None, :], (T, H, k_pe.shape[-1]))
+    return jnp.concatenate([k_nope, pe.astype(k_nope.dtype)], axis=-1)

@@ -29,6 +29,8 @@ from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+from flashinfer_tpu.api_logging import flashinfer_api
 import numpy as np
 
 from flashinfer_tpu.ops.flash_attention import flash_attention
@@ -43,6 +45,7 @@ from flashinfer_tpu.utils import (
 )
 
 
+@flashinfer_api
 def single_decode_with_kv_cache(
     q: jax.Array,  # [num_qo_heads, head_dim]
     k: jax.Array,  # [kv_len, num_kv_heads, head_dim] (NHD) or HND

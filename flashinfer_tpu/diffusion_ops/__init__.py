@@ -1,0 +1,10 @@
+"""Diffusion-transformer (DiT) op namespace (reference
+``flashinfer/diffusion_ops/__init__.py``)."""
+
+from flashinfer_tpu.norm import (  # noqa: F401
+    gate_residual,
+    layernorm,
+    layernorm_scale_shift,
+    qk_rmsnorm,
+)
+from flashinfer_tpu.rope import apply_rope_pos_ids  # noqa: F401

@@ -1,0 +1,18 @@
+"""DeepSeek-V3 op namespace (reference ``flashinfer/dsv3_ops/__init__.py``):
+re-exports the DSv3-relevant ops under one roof."""
+
+from flashinfer_tpu.concat_ops import concat_mla_k, concat_mla_q  # noqa: F401
+from flashinfer_tpu.fused_moe import fused_moe, route_deepseek_v3  # noqa: F401
+from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper  # noqa: F401
+from flashinfer_tpu.ops.mla_decode import (  # noqa: F401
+    mla_paged_decode_attention,
+)
+from flashinfer_tpu.page import append_paged_mla_kv_cache  # noqa: F401
+
+
+def router_gemm(hidden, router_weight):
+    """DSv3 router GEMM (reference csrc/dsv3_router_gemm.cu): small-N
+    latency-bound matmul; XLA's matmul emitter handles small N natively."""
+    import jax.numpy as jnp
+
+    return jnp.dot(hidden, router_weight, preferred_element_type=jnp.float32)

@@ -1,0 +1,124 @@
+"""Gated Delta Net (GDN) and Kimi Delta Attention (KDA) recurrences.
+
+TPU re-design of the reference's linear-attention families:
+- GDN (Qwen3-Next; reference ``flashinfer/gdn_decode.py`` /
+  ``gdn_prefill.py`` / ``gdn_kernels/``): gated delta rule over a matrix
+  state ``S [dk, dv]`` per head:
+      S_t = alpha_t * S_{t-1} + beta_t * k_t (v_t - S_{t-1}^T k_t)^T
+      o_t = S_t^T q_t
+  with scalar-per-head decay ``alpha`` and update gate ``beta``.
+- KDA (Kimi; reference ``flashinfer/kda_decode.py`` /
+  ``kda_kernels/recurrent_kda.py``): same delta rule with *per-channel*
+  decay ``alpha_t [dk]`` (finer-grained forgetting).
+
+Decode-step ops + lax.scan prefill forms; the reference's chunked
+Blackwell-DSL kernels map to a future Pallas chunked scan — these are the
+semantics oracles and the serving decode path (one small einsum per step,
+XLA-fused).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def gdn_decode_step(
+    state: jax.Array,  # [B, H, dk, dv]
+    q: jax.Array,  # [B, H, dk]
+    k: jax.Array,  # [B, H, dk]
+    v: jax.Array,  # [B, H, dv]
+    alpha: jax.Array,  # [B, H] decay gate in [0, 1]
+    beta: jax.Array,  # [B, H] update gate
+) -> Tuple[jax.Array, jax.Array]:
+    """One GDN decode step -> (o [B, H, dv], new_state)."""
+    s = state.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    a = alpha.astype(jnp.float32)[..., None, None]
+    b = beta.astype(jnp.float32)[..., None, None]
+    s = a * s
+    # delta rule: write (v - S^T k) at key k
+    pred = jnp.einsum("bhkv,bhk->bhv", s, kf)
+    s = s + b * jnp.einsum("bhk,bhv->bhkv", kf, vf - pred)
+    o = jnp.einsum("bhkv,bhk->bhv", s, q.astype(jnp.float32))
+    return o.astype(q.dtype), s.astype(state.dtype)
+
+
+@jax.jit
+def gdn_prefill(
+    q: jax.Array,  # [B, L, H, dk]
+    k: jax.Array,  # [B, L, H, dk]
+    v: jax.Array,  # [B, L, H, dv]
+    alpha: jax.Array,  # [B, L, H]
+    beta: jax.Array,  # [B, L, H]
+    initial_state: Optional[jax.Array] = None,  # [B, H, dk, dv]
+) -> Tuple[jax.Array, jax.Array]:
+    """Sequential GDN scan -> (o [B, L, H, dv], final_state)."""
+    B, L, H, dk = q.shape
+    dv = v.shape[-1]
+    if initial_state is None:
+        initial_state = jnp.zeros((B, H, dk, dv), jnp.float32)
+
+    def step(s, inp):
+        qt, kt, vt, at, bt = inp
+        o, s = gdn_decode_step(s, qt, kt, vt, at, bt)
+        return s, o
+
+    final, ys = jax.lax.scan(
+        step, initial_state.astype(jnp.float32),
+        tuple(jnp.moveaxis(t, 1, 0) for t in (q, k, v, alpha, beta)),
+    )
+    return jnp.moveaxis(ys, 0, 1), final
+
+
+@jax.jit
+def kda_decode_step(
+    state: jax.Array,  # [B, H, dk, dv]
+    q: jax.Array,  # [B, H, dk]
+    k: jax.Array,  # [B, H, dk]
+    v: jax.Array,  # [B, H, dv]
+    alpha: jax.Array,  # [B, H, dk] per-channel decay
+    beta: jax.Array,  # [B, H] update gate
+) -> Tuple[jax.Array, jax.Array]:
+    """One KDA decode step (per-channel decay delta rule)."""
+    s = state.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    a = alpha.astype(jnp.float32)[..., None]  # [B, H, dk, 1]
+    b = beta.astype(jnp.float32)[..., None, None]
+    s = a * s
+    pred = jnp.einsum("bhkv,bhk->bhv", s, kf)
+    s = s + b * jnp.einsum("bhk,bhv->bhkv", kf, vf - pred)
+    o = jnp.einsum("bhkv,bhk->bhv", s, q.astype(jnp.float32))
+    return o.astype(q.dtype), s.astype(state.dtype)
+
+
+@jax.jit
+def kda_prefill(
+    q: jax.Array,  # [B, L, H, dk]
+    k: jax.Array,
+    v: jax.Array,  # [B, L, H, dv]
+    alpha: jax.Array,  # [B, L, H, dk]
+    beta: jax.Array,  # [B, L, H]
+    initial_state: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    B, L, H, dk = q.shape
+    dv = v.shape[-1]
+    if initial_state is None:
+        initial_state = jnp.zeros((B, H, dk, dv), jnp.float32)
+
+    def step(s, inp):
+        qt, kt, vt, at, bt = inp
+        o, s = kda_decode_step(s, qt, kt, vt, at, bt)
+        return s, o
+
+    final, ys = jax.lax.scan(
+        step, initial_state.astype(jnp.float32),
+        tuple(jnp.moveaxis(t, 1, 0) for t in (q, k, v, alpha, beta)),
+    )
+    return jnp.moveaxis(ys, 0, 1), final

@@ -1,0 +1,114 @@
+"""Mamba/SSM ops: selective state update (decode) + selective scan (prefill).
+
+TPU re-design of the reference Mamba family (``flashinfer/mamba/``,
+``csrc/selective_state_update.cu``, ``include/flashinfer/mamba/``):
+
+- ``selective_state_update``: one-token SSM state recurrence used at decode
+  time (supports GQA-style head broadcast of B/C groups, dt bias/softplus,
+  D skip and z gating — the reference kernel's surface).
+- ``selective_scan``: sequential prefill scan (lax.scan over time — XLA
+  keeps the recurrence on-chip; the reference's chunked SSD kernel is a
+  planned optimization, the semantics here are the oracle).
+
+Functional: state tensors are returned, not mutated (donation makes this
+in-place under jit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _softplus(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("dt_softplus",))
+def selective_state_update(
+    state: jax.Array,  # [B, H, dim, dstate]
+    x: jax.Array,  # [B, H, dim]
+    dt: jax.Array,  # [B, H, dim]
+    A: jax.Array,  # [H, dim, dstate]
+    B: jax.Array,  # [B, G, dstate]  (G divides H)
+    C: jax.Array,  # [B, G, dstate]
+    D: Optional[jax.Array] = None,  # [H, dim]
+    z: Optional[jax.Array] = None,  # [B, H, dim]
+    dt_bias: Optional[jax.Array] = None,  # [H, dim]
+    dt_softplus: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """One SSM decode step -> (y [B, H, dim], new_state).
+
+    Recurrence (reference selective_state_update.cu):
+        dt' = softplus(dt + dt_bias)              (if enabled)
+        state' = state * exp(dt' * A) + dt' * x (outer) B
+        y = (state' . C) + D * x, gated by silu(z).
+    """
+    Bsz, H, dim = x.shape
+    G = B.shape[1]
+    rep = H // G
+    dtf = dt.astype(jnp.float32)
+    if dt_bias is not None:
+        dtf = dtf + dt_bias.astype(jnp.float32)[None]
+    if dt_softplus:
+        dtf = _softplus(dtf)
+    xf = x.astype(jnp.float32)
+    Af = A.astype(jnp.float32)[None]  # [1, H, dim, dstate]
+    Bf = jnp.repeat(B.astype(jnp.float32), rep, axis=1)  # [B, H, dstate]
+    Cf = jnp.repeat(C.astype(jnp.float32), rep, axis=1)
+    dA = jnp.exp(dtf[..., None] * Af)  # [B, H, dim, dstate]
+    dBx = (dtf * xf)[..., None] * Bf[:, :, None, :]  # [B, H, dim, dstate]
+    new_state = state.astype(jnp.float32) * dA + dBx
+    y = jnp.einsum("bhds,bhs->bhd", new_state, Cf)
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None] * xf
+    if z is not None:
+        y = y * jax.nn.silu(z.astype(jnp.float32))
+    return y.astype(x.dtype), new_state.astype(state.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("dt_softplus",))
+def selective_scan(
+    x: jax.Array,  # [B, L, H, dim]
+    dt: jax.Array,  # [B, L, H, dim]
+    A: jax.Array,  # [H, dim, dstate]
+    B: jax.Array,  # [B, L, G, dstate]
+    C: jax.Array,  # [B, L, G, dstate]
+    D: Optional[jax.Array] = None,
+    z: Optional[jax.Array] = None,  # [B, L, H, dim]
+    dt_bias: Optional[jax.Array] = None,
+    dt_softplus: bool = False,
+    initial_state: Optional[jax.Array] = None,  # [B, H, dim, dstate]
+) -> Tuple[jax.Array, jax.Array]:
+    """Prefill scan -> (y [B, L, H, dim], final_state)."""
+    Bsz, L, H, dim = x.shape
+    dstate = A.shape[-1]
+    if initial_state is None:
+        initial_state = jnp.zeros((Bsz, H, dim, dstate), jnp.float32)
+
+    def step(state, inp):
+        xt, dtt, Bt, Ct, zt = inp
+        y, state = selective_state_update(
+            state, xt, dtt, A, Bt, Ct, D,
+            zt if z is not None else None,
+            dt_bias, dt_softplus,
+        )
+        return state, y
+
+    zs = (
+        jnp.moveaxis(z, 1, 0)
+        if z is not None
+        else jnp.zeros((L,) + x.shape[:1] + x.shape[2:], x.dtype)
+    )
+    final, ys = jax.lax.scan(
+        step,
+        initial_state.astype(jnp.float32),
+        (
+            jnp.moveaxis(x, 1, 0), jnp.moveaxis(dt, 1, 0),
+            jnp.moveaxis(B, 1, 0), jnp.moveaxis(C, 1, 0), zs,
+        ),
+    )
+    return jnp.moveaxis(ys, 0, 1), final.astype(jnp.float32)

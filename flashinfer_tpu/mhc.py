@@ -1,0 +1,76 @@
+"""Manifold hyper-connections (mHC) mixing ops.
+
+TPU re-design of the reference mHC ops (``flashinfer/mhc.py``,
+``csrc/mhc/`` — HC=4 hyper-connection pre/post mixes): the model keeps
+``n`` parallel residual streams; each layer reads a weighted combination
+(pre-mix) and writes back through a depth gate + a stream-mixing matrix
+(post-mix).  Dynamic variants derive the mix weights from the input via a
+small projection.  Pure-XLA: these are small fused einsums.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mhc_pre_mix(
+    streams: jax.Array,  # [tokens, n, hidden]
+    w_pre: jax.Array,  # [n] static or [tokens, n] dynamic weights
+) -> jax.Array:
+    """Combine the n residual streams into the layer input."""
+    wf = w_pre.astype(jnp.float32)
+    sf = streams.astype(jnp.float32)
+    if wf.ndim == 1:
+        out = jnp.einsum("tnh,n->th", sf, wf)
+    else:
+        out = jnp.einsum("tnh,tn->th", sf, wf)
+    return out.astype(streams.dtype)
+
+
+@jax.jit
+def mhc_post_mix(
+    streams: jax.Array,  # [tokens, n, hidden]
+    layer_out: jax.Array,  # [tokens, hidden]
+    w_depth: jax.Array,  # [n] or [tokens, n]: how much layer_out each stream gets
+    w_width: jax.Array,  # [n, n] or [tokens, n, n]: stream mixing matrix
+) -> jax.Array:
+    """streams' = w_width @ streams + w_depth (outer) layer_out."""
+    sf = streams.astype(jnp.float32)
+    of = layer_out.astype(jnp.float32)
+    dd = w_depth.astype(jnp.float32)
+    ww = w_width.astype(jnp.float32)
+    mixed = (
+        jnp.einsum("nm,tmh->tnh", ww, sf)
+        if ww.ndim == 2
+        else jnp.einsum("tnm,tmh->tnh", ww, sf)
+    )
+    inject = (
+        dd[None, :, None] * of[:, None, :]
+        if dd.ndim == 1
+        else dd[:, :, None] * of[:, None, :]
+    )
+    return (mixed + inject).astype(streams.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def mhc_dynamic_weights(
+    x: jax.Array,  # [tokens, hidden] pre-mix input source (e.g. stream mean)
+    w_proj: jax.Array,  # [hidden, n + n + n*n]
+    b_proj: Optional[jax.Array] = None,
+    n: int = 4,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Project x to dynamic (w_pre [t,n], w_depth [t,n], w_width [t,n,n]);
+    width matrix passes through tanh for stability (mHC convention)."""
+    h = x.astype(jnp.float32) @ w_proj.astype(jnp.float32)
+    if b_proj is not None:
+        h = h + b_proj.astype(jnp.float32)
+    t = x.shape[0]
+    w_pre = h[:, :n]
+    w_depth = h[:, n : 2 * n]
+    w_width = jnp.tanh(h[:, 2 * n :].reshape(t, n, n))
+    return w_pre, w_depth, w_width

@@ -90,15 +90,19 @@ class BatchMLAPagedAttentionWrapper:
             sm_scale = 1.0 / float(head_dim_ckv + head_dim_kpe) ** 0.5
 
         if (qo_lens == 1).all():
+            from flashinfer_tpu import native
+
             pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
             p_bucket = max(next_power_of_two(int(pages_per_req.max(initial=1))), 8)
             b_bucket = max(next_power_of_two(batch), 8)
-            table = np.zeros((b_bucket, p_bucket), np.int32)
-            for b in range(batch):
-                n = int(pages_per_req[b])
-                table[b, :n] = kv_indices[int(kv_indptr[b]) : int(kv_indptr[b]) + n]
-            lens = np.zeros((b_bucket,), np.int32)
-            lens[:batch] = kv_len
+            last_page_len = (
+                kv_len - (np.maximum(pages_per_req, 1) - 1) * page_size
+            ).astype(np.int32)
+            table, lens = native.decode_plan(
+                kv_indptr, kv_indices, last_page_len, page_size,
+                b_bucket, p_bucket,
+            )
+            lens[:batch] = kv_len  # exact token lengths from the caller
             self._plan = _MLAPlan(
                 decode_mode=True, causal=causal, sm_scale=float(sm_scale),
                 num_heads=num_heads, head_dim_ckv=head_dim_ckv,
@@ -108,27 +112,24 @@ class BatchMLAPagedAttentionWrapper:
             )
             return
 
-        # ragged mode: flatten tokens with segments (same scheme as prefill)
+        # ragged mode: flatten tokens with segments (same scheme as prefill),
+        # built by the native planner
+        from flashinfer_tpu import native
+
         total_q = int(qo_indptr[-1])
         kv_tok_indptr = np.concatenate([[0], np.cumsum(kv_len)])
         total_kv = int(kv_tok_indptr[-1])
         tq_pad = max(next_power_of_two(total_q), 128)
         tkv_pad = max(next_power_of_two(total_kv), 128)
-        q_seg = np.full((tq_pad,), -1, np.int32)
-        q_pos = np.zeros((tq_pad,), np.int32)
-        kv_seg = np.full((tkv_pad,), -2, np.int32)
-        kv_pos = np.zeros((tkv_pad,), np.int32)
-        rows = np.zeros((tkv_pad,), np.int64)
-        for r in range(batch):
-            qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
-            q_seg[qs:qe] = r
-            q_pos[qs:qe] = np.arange(qe - qs) + int(kv_len[r]) - (qe - qs)
-            ks, n = int(kv_tok_indptr[r]), int(kv_len[r])
-            kv_seg[ks : ks + n] = r
-            kv_pos[ks : ks + n] = np.arange(n)
-            pages = kv_indices[int(kv_indptr[r]) : int(kv_indptr[r + 1])]
-            tok = np.arange(n)
-            rows[ks : ks + n] = pages[tok // page_size] * page_size + tok % page_size
+        q_seg, q_pos = native.token_axis_plan(
+            qo_indptr, kv_len - qo_lens, tq_pad, -1
+        )
+        kv_seg, kv_pos = native.token_axis_plan(
+            kv_tok_indptr, np.zeros(batch, np.int64), tkv_pad, -2
+        )
+        rows = native.paged_gather_plan(
+            kv_tok_indptr, kv_indptr, kv_indices, page_size, tkv_pad
+        )
         self._plan = _MLAPlan(
             decode_mode=False, causal=causal, sm_scale=float(sm_scale),
             num_heads=num_heads, head_dim_ckv=head_dim_ckv,

@@ -127,11 +127,15 @@ def token_axis_plan(
         )
         if rc == 0:
             return seg, pos
+        if rc == -2:
+            raise ValueError("token_axis_plan: non-monotonic or negative indptr")
         raise ValueError(f"token_axis_plan: {indptr64[-1]} tokens > pad {pad_to}")
     seg.fill(pad_seg)
     pos.fill(0)
     for r in range(batch):
         s, e = int(indptr64[r]), int(indptr64[r + 1])
+        if s < 0 or e < s or e > pad_to:
+            raise ValueError("token_axis_plan: non-monotonic or negative indptr")
         seg[s:e] = r
         pos[s:e] = np.arange(e - s) + int(off64[r])
     return seg, pos

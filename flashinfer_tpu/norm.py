@@ -20,6 +20,8 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from flashinfer_tpu.api_logging import flashinfer_api
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -109,6 +111,7 @@ def _fused_add_rmsnorm_impl(x, residual, weight, eps, weight_bias, backend):
     return out.reshape(orig_shape), res.reshape(orig_shape)
 
 
+@flashinfer_api
 def rmsnorm(
     x: jax.Array,
     weight: jax.Array,
@@ -122,6 +125,7 @@ def rmsnorm(
     return _rmsnorm_impl(x, weight, eps, 0.0, resolve_backend(backend, "rmsnorm"))
 
 
+@flashinfer_api
 def gemma_rmsnorm(
     x: jax.Array, weight: jax.Array, eps: float = 1e-6, backend: str = "auto"
 ) -> jax.Array:
@@ -129,6 +133,7 @@ def gemma_rmsnorm(
     return _rmsnorm_impl(x, weight, eps, 1.0, resolve_backend(backend, "gemma_rmsnorm"))
 
 
+@flashinfer_api
 def fused_add_rmsnorm(
     x: jax.Array,
     residual: jax.Array,
@@ -147,6 +152,7 @@ def fused_add_rmsnorm(
     )
 
 
+@flashinfer_api
 def gemma_fused_add_rmsnorm(
     x: jax.Array,
     residual: jax.Array,
@@ -170,3 +176,69 @@ def layernorm(
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
     return (y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def qk_rmsnorm(
+    q: jax.Array,  # [..., num_q_heads, head_dim]
+    k: jax.Array,  # [..., num_k_heads, head_dim]
+    q_weight: jax.Array,  # [head_dim]
+    k_weight: jax.Array,  # [head_dim]
+    eps: float = 1e-6,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-head RMSNorm of q and k over head_dim (reference QK-RMSNorm
+    family, flashinfer/norm/ — used by Qwen3/Gemma-style attention)."""
+
+    def _norm(x, w):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+            x.dtype
+        )
+
+    return _norm(q, q_weight), _norm(k, k_weight)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def rmsnorm_silu(
+    x: jax.Array, weight: jax.Array, gate: jax.Array, eps: float = 1e-6
+) -> jax.Array:
+    """Fused RMSNorm + SiLU gating: ``rmsnorm(x) * silu(gate)`` (reference
+    ``csrc/rmsnorm_silu.cu``)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)
+    return (y * jax.nn.silu(gate.astype(jnp.float32))).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def layernorm_scale_shift(
+    x: jax.Array,  # [tokens, hidden]
+    scale: jax.Array,  # [hidden] or [tokens, hidden] adaLN modulation
+    shift: jax.Array,
+    eps: float = 1e-6,
+) -> jax.Array:
+    """DiT adaLN: ``layernorm(x, affine=False) * (1 + scale) + shift``
+    (reference DiT layernorm family, flashinfer/norm/)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    s = scale.astype(jnp.float32)
+    t = shift.astype(jnp.float32)
+    if s.ndim == 1:
+        s, t = s[None], t[None]
+    return (y * (1.0 + s) + t).astype(x.dtype)
+
+
+@jax.jit
+def gate_residual(
+    residual: jax.Array, gate: jax.Array, x: jax.Array
+) -> jax.Array:
+    """DiT gated residual add: ``residual + gate * x``."""
+    g = gate.astype(jnp.float32)
+    if g.ndim == 1:
+        g = g[None]
+    return (residual.astype(jnp.float32) + g * x.astype(jnp.float32)).astype(
+        residual.dtype
+    )

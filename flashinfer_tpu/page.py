@@ -21,6 +21,8 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from flashinfer_tpu.api_logging import flashinfer_api
+
 from flashinfer_tpu.utils import check_kv_layout, TensorLayout, get_seq_lens  # noqa: F401
 
 
@@ -77,6 +79,7 @@ def _append_impl(
     )
 
 
+@flashinfer_api
 def append_paged_kv_cache(
     append_key: jax.Array,  # [nnz, num_kv_heads, head_dim]
     append_value: jax.Array,  # [nnz, num_kv_heads, head_dim]

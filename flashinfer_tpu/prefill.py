@@ -26,6 +26,8 @@ from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+from flashinfer_tpu.api_logging import flashinfer_api
 import numpy as np
 
 from flashinfer_tpu.ops.flash_attention import flash_attention
@@ -42,6 +44,7 @@ _Q_PAD_SEG = -1
 _KV_PAD_SEG = -2
 
 
+@flashinfer_api
 def single_prefill_with_kv_cache(
     q: jax.Array,  # [qo_len, num_qo_heads, head_dim]
     k: jax.Array,  # [kv_len, num_kv_heads, head_dim] (NHD) or HND

@@ -1,0 +1,39 @@
+"""Kernel-level profiling.
+
+TPU re-design of the reference profiler (``include/flashinfer/
+profiler.cuh:33-80`` device event buffer -> Perfetto,
+``flashinfer/profiler/__init__.py:33-95``): on TPU the runtime already
+emits a full device-side timeline — ``jax.profiler`` captures XLA/Mosaic
+kernel spans to a Perfetto/TensorBoard trace, so the in-kernel tag
+machinery collapses into this context manager plus named annotations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def kernel_profiler(log_dir: str = "/tmp/flashinfer_tpu_trace") -> Iterator[str]:
+    """Capture a device trace for the enclosed region.
+
+    View with Perfetto (ui.perfetto.dev) or TensorBoard's profile plugin —
+    the analogue of the reference's Perfetto export (profiler/__init__.py).
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span in the device trace (reference profiler event tags)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from flashinfer_tpu.api_logging import flashinfer_api
+
 
 def _rope_freqs(
     rotary_dim: int, rope_theta: float, rope_scale: float
@@ -147,6 +149,7 @@ def _pos_ids_from_indptr(indptr: jax.Array, offsets: jax.Array, nnz: int) -> jax
     return (jnp.arange(nnz) - indptr[req] + offsets[req]).astype(jnp.int32)
 
 
+@flashinfer_api
 def apply_rope(
     q: jax.Array,
     k: jax.Array,
@@ -166,6 +169,7 @@ def apply_rope(
     )
 
 
+@flashinfer_api
 def apply_llama31_rope(
     q: jax.Array,
     k: jax.Array,

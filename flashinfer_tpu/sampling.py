@@ -20,6 +20,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from flashinfer_tpu.api_logging import flashinfer_api
+
 _NEG_INF = jnp.float32(-1e30)
 
 
@@ -38,6 +40,7 @@ def softmax(
     return jax.nn.softmax(x, axis=-1)
 
 
+@flashinfer_api
 def sampling_from_probs(
     probs: jax.Array,  # [batch, vocab]
     key: jax.Array,
@@ -53,6 +56,7 @@ def sampling_from_probs(
     return jax.random.categorical(key, logp, axis=-1).astype(jnp.int32)
 
 
+@flashinfer_api
 def sampling_from_logits(
     logits: jax.Array, key: jax.Array, indices: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -129,6 +133,7 @@ def top_k_mask_logits(logits: jax.Array, top_k) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+@flashinfer_api
 def top_p_sampling_from_probs(
     probs: jax.Array, key: jax.Array, top_p, indices: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -138,6 +143,7 @@ def top_p_sampling_from_probs(
     return sampling_from_probs(top_p_renorm_probs(probs, top_p), key)
 
 
+@flashinfer_api
 def top_k_sampling_from_probs(
     probs: jax.Array, key: jax.Array, top_k, indices: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -147,6 +153,7 @@ def top_k_sampling_from_probs(
     return sampling_from_probs(top_k_renorm_probs(probs, top_k), key)
 
 
+@flashinfer_api
 def min_p_sampling_from_probs(
     probs: jax.Array, key: jax.Array, min_p, indices: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -209,6 +216,7 @@ def _check_filter_order(filter_apply_order: str) -> bool:
     return filter_apply_order == "joint"
 
 
+@flashinfer_api
 def top_k_top_p_sampling_from_probs(
     probs: jax.Array, key: jax.Array, top_k, top_p,
     indices: Optional[jax.Array] = None, deterministic: bool = True,

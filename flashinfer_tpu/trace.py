@@ -1,0 +1,102 @@
+"""Workload trace capture + zero-code kernel substitution.
+
+TPU re-design of the reference's fi_trace / trace_apply pair
+(``flashinfer/fi_trace.py:15-75`` TraceTemplate -> flashinfer-bench JSON;
+``flashinfer/trace_apply/apply.py:15-28`` monkey-patch substitution):
+
+- ``FLASHINFER_TPU_TRACE_DUMP=1``: every ``@traced_api`` call appends a
+  JSON definition line (op, shapes, dtypes, static params) to
+  ``<dump_dir>/trace.jsonl`` — the workload-capture format benchmark
+  tooling consumes.
+- ``register_solution(op, match, fn)`` + ``FLASHINFER_TPU_TRACE_APPLY=1``:
+  calls whose static axes match a registered solution are routed to the
+  substitute implementation, without touching call sites (the reference's
+  tuned-kernel swap-in mechanism).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flashinfer_tpu import env
+
+_lock = threading.Lock()
+_solutions: Dict[str, List[Tuple[Dict[str, Any], Callable]]] = {}
+
+
+def _trace_enabled() -> bool:
+    return os.environ.get("FLASHINFER_TPU_TRACE_DUMP", "0") == "1"
+
+
+def _apply_enabled() -> bool:
+    return os.environ.get("FLASHINFER_TPU_TRACE_APPLY", "0") == "1"
+
+
+def _axes_of(args, kwargs) -> Dict[str, Any]:
+    axes: Dict[str, Any] = {}
+    for i, a in enumerate(args):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            axes[f"arg{i}"] = {"shape": list(a.shape), "dtype": str(a.dtype)}
+        elif isinstance(a, (int, float, str, bool)):
+            axes[f"arg{i}"] = a
+    for k, v in kwargs.items():
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            axes[k] = {"shape": list(v.shape), "dtype": str(v.dtype)}
+        elif isinstance(v, (int, float, str, bool)):
+            axes[k] = v
+    return axes
+
+
+def _dump_trace(op: str, axes: Dict[str, Any]) -> None:
+    d = env.dump_dir()
+    d.mkdir(parents=True, exist_ok=True)
+    with _lock, open(d / "trace.jsonl", "a") as f:
+        f.write(json.dumps({"op": op, "axes": axes}) + "\n")
+
+
+def register_solution(op: str, match: Dict[str, Any], fn: Callable) -> None:
+    """Register a substitute implementation for ``op`` when the call's
+    static axes contain ``match`` (subset match, reference const-axes
+    semantics)."""
+    _solutions.setdefault(op, []).append((match, fn))
+
+
+def clear_solutions() -> None:
+    _solutions.clear()
+
+
+def _find_solution(op: str, axes: Dict[str, Any]) -> Optional[Callable]:
+    for match, fn in _solutions.get(op, []):
+        if all(axes.get(k) == v for k, v in match.items()):
+            return fn
+    return None
+
+
+def traced_api(fn: Callable = None, *, name: str = None) -> Callable:
+    """Decorator adding trace-dump and solution-substitution hooks."""
+
+    def deco(f):
+        op = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            if not (_trace_enabled() or _apply_enabled()):
+                return f(*args, **kwargs)
+            axes = _axes_of(args, kwargs)
+            if _trace_enabled():
+                _dump_trace(op, axes)
+            if _apply_enabled():
+                sub = _find_solution(op, axes)
+                if sub is not None:
+                    return sub(*args, **kwargs)
+            return f(*args, **kwargs)
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco

@@ -1,0 +1,78 @@
+"""Trace capture / apply + profiler + namespace tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_trace_dump(monkeypatch, tmp_path):
+    from flashinfer_tpu.trace import traced_api
+
+    monkeypatch.setenv("FLASHINFER_TPU_TRACE_DUMP", "1")
+    monkeypatch.setenv("FLASHINFER_TPU_DUMP_DIR", str(tmp_path))
+
+    @traced_api(name="my_op")
+    def op(x, k=3):
+        return x * k
+
+    op(jnp.ones((2, 4)), k=5)
+    lines = (tmp_path / "trace.jsonl").read_text().strip().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["op"] == "my_op"
+    assert rec["axes"]["arg0"] == {"shape": [2, 4], "dtype": "float32"}
+    assert rec["axes"]["k"] == 5
+
+
+def test_trace_apply_substitution(monkeypatch):
+    from flashinfer_tpu import trace
+
+    monkeypatch.setenv("FLASHINFER_TPU_TRACE_APPLY", "1")
+    trace.clear_solutions()
+
+    @trace.traced_api(name="sub_op")
+    def op(x, mode="a"):
+        return x + 1
+
+    # solution only for mode="b"
+    trace.register_solution("sub_op", {"mode": "b"}, lambda x, mode="b": x + 100)
+    np.testing.assert_allclose(np.asarray(op(jnp.zeros(2))), 1)
+    np.testing.assert_allclose(np.asarray(op(jnp.zeros(2), mode="b")), 100)
+    trace.clear_solutions()
+    np.testing.assert_allclose(np.asarray(op(jnp.zeros(2), mode="b")), 1)
+
+
+def test_trace_disabled_zero_overhead(monkeypatch):
+    from flashinfer_tpu.trace import traced_api
+
+    monkeypatch.delenv("FLASHINFER_TPU_TRACE_DUMP", raising=False)
+    monkeypatch.delenv("FLASHINFER_TPU_TRACE_APPLY", raising=False)
+    calls = []
+
+    @traced_api(name="plain")
+    def op(x):
+        calls.append(1)
+        return x
+
+    op(jnp.ones(1))
+    assert calls == [1]
+
+
+def test_profiler_annotate_runs():
+    from flashinfer_tpu.profiler import annotate
+
+    with annotate("test_span"):
+        out = jnp.sum(jnp.ones((8, 8)))
+    assert float(out) == 64.0
+
+
+def test_namespaces():
+    from flashinfer_tpu import dsv3_ops, diffusion_ops
+
+    assert hasattr(dsv3_ops, "BatchMLAPagedAttentionWrapper")
+    assert hasattr(dsv3_ops, "route_deepseek_v3")
+    out = dsv3_ops.router_gemm(jnp.ones((4, 8)), jnp.ones((8, 16)))
+    assert out.shape == (4, 16)
+    assert hasattr(diffusion_ops, "layernorm_scale_shift")
