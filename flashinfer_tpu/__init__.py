@@ -32,12 +32,15 @@ from flashinfer_tpu.gemm import (  # noqa: F401
     bmm_fp8,
     grouped_gemm,
     mm_bf16,
+    mm_fp4,
     mm_fp8,
     mm_int8,
 )
 from flashinfer_tpu.quantization import (  # noqa: F401
+    dequantize_fp4,
     dequantize_fp8,
     packbits,
+    quantize_fp4,
     quantize_fp8_per_channel,
     quantize_fp8_per_tensor,
     quantize_int8,
@@ -65,6 +68,19 @@ from flashinfer_tpu.activation import (  # noqa: F401
     gelu_and_mul,
     gelu_tanh_and_mul,
     silu_and_mul,
+    silu_and_mul_quant_fp8,
+)
+from flashinfer_tpu.aliases import (  # noqa: F401
+    cudnn_batch_decode_with_kv_cache,
+    fast_decode_plan,
+    trtllm_batch_context_with_kv_cache,
+    trtllm_batch_decode_with_kv_cache,
+    xqa_batch_decode_with_kv_cache,
+)
+from flashinfer_tpu.msa_ops import (  # noqa: F401
+    msa_proxy_score,
+    msa_sparse_attention,
+    msa_topk_select,
 )
 from flashinfer_tpu.norm import (  # noqa: F401
     fused_add_rmsnorm,

@@ -46,3 +46,17 @@ def gelu_tanh_and_mul(x: jax.Array) -> jax.Array:
     gate, up = _split_gate_up(x)
     gf = gate.astype(jnp.float32)
     return (jax.nn.gelu(gf, approximate=True) * up.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("quant_dtype",))
+def silu_and_mul_quant_fp8(x: jax.Array, quant_dtype=jnp.float8_e4m3fn):
+    """Fused gated-SiLU + per-tensor fp8 quantize (reference's
+    SiLU-fused quantizing activation variants, flashinfer/quantization/).
+    Returns (values, scale)."""
+    gate, up = _split_gate_up(x)
+    y = jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)
+    finfo = jnp.finfo(quant_dtype)
+    amax = jnp.max(jnp.abs(y))
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(y / scale, float(finfo.min), float(finfo.max)).astype(quant_dtype)
+    return q, scale.astype(jnp.float32)

@@ -1,0 +1,117 @@
+"""Reference-named convenience entry points.
+
+The reference exposes several backend-branded functions
+(``trtllm_batch_decode_with_kv_cache`` decode.py:3005,
+``trtllm_batch_context_with_kv_cache`` prefill.py:4669,
+``xqa_batch_decode_with_kv_cache`` decode.py:3522, ``cudnn_batch_*``).
+On TPU those backends collapse into the Pallas/XLA dispatch, but the entry
+points survive as one-shot conveniences (plan+run in a single call) so
+engine integrations keyed to these names keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.decode import BatchDecodeWithPagedKVCacheWrapper
+from flashinfer_tpu.prefill import BatchPrefillWithPagedKVCacheWrapper
+
+
+def trtllm_batch_decode_with_kv_cache(
+    query: jax.Array,  # [batch, num_qo_heads, head_dim]
+    kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+    workspace_buffer=None,
+    block_tables: jax.Array = None,  # [batch, max_pages] padded page table
+    seq_lens: jax.Array = None,  # [batch]
+    max_seq_len: int = None,
+    kv_layout: str = "HND",
+    window_left: int = -1,
+    sm_scale: Optional[float] = None,
+    **_unused,
+):
+    """One-shot padded-page-table batch decode (reference
+    ``trtllm_batch_decode_with_kv_cache`` shape: block_tables + seq_lens
+    instead of ragged indptr)."""
+    from flashinfer_tpu.ops.paged_decode import paged_decode_attention
+    from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+    from flashinfer_tpu.utils import get_sm_scale, resolve_backend
+
+    if isinstance(kv_cache, tuple):
+        k_cache, v_cache = kv_cache
+    else:
+        k_cache, v_cache = kv_cache[:, 0], kv_cache[:, 1]
+    sm = get_sm_scale(query.shape[-1], sm_scale)
+    fn = (
+        paged_decode_attention
+        if resolve_backend("auto", "trtllm_batch_decode") == "pallas"
+        else xla_paged_decode
+    )
+    return fn(
+        query, k_cache, v_cache, block_tables, seq_lens,
+        sm_scale=sm, window_left=window_left, kv_layout=kv_layout,
+    )
+
+
+def trtllm_batch_context_with_kv_cache(
+    query: jax.Array,  # [total_q, num_qo_heads, head_dim]
+    kv_cache,
+    workspace_buffer=None,
+    block_tables=None,
+    seq_lens=None,
+    max_q_len: int = None,
+    max_kv_len: int = None,
+    cum_seq_lens_q=None,  # [batch+1] qo_indptr
+    cum_seq_lens_kv=None,
+    kv_layout: str = "HND",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    **_unused,
+):
+    """One-shot paged context/prefill attention (reference
+    ``trtllm_batch_context_with_kv_cache``)."""
+    seq_lens = np.asarray(seq_lens)
+    block_tables = np.asarray(block_tables)
+    batch = len(seq_lens)
+    page_size = (
+        kv_cache[0].shape[2] if kv_layout == "HND" else kv_cache[0].shape[1]
+    ) if isinstance(kv_cache, tuple) else kv_cache.shape[3 if kv_layout == "HND" else 2]
+    pages_per_req = -(-seq_lens // page_size)
+    kv_indptr = np.concatenate([[0], np.cumsum(pages_per_req)]).astype(np.int32)
+    indices = np.concatenate(
+        [block_tables[b, : pages_per_req[b]] for b in range(batch)]
+    ).astype(np.int32)
+    last = (seq_lens - (np.maximum(pages_per_req, 1) - 1) * page_size).astype(
+        np.int32
+    )
+    if isinstance(kv_cache, tuple):
+        k_cache, v_cache = kv_cache
+    else:
+        k_cache, v_cache = kv_cache[:, 0], kv_cache[:, 1]
+    num_kv_heads = k_cache.shape[1] if kv_layout == "HND" else k_cache.shape[2]
+    w = BatchPrefillWithPagedKVCacheWrapper(kv_layout=kv_layout)
+    w.plan(
+        np.asarray(cum_seq_lens_q), kv_indptr, indices, last,
+        query.shape[1], num_kv_heads, query.shape[2], page_size,
+        causal=causal, sm_scale=sm_scale,
+    )
+    return w.run(query, (k_cache, v_cache))
+
+
+# XQA decode: TRT-LLM's GQA decode kernels; on TPU this IS the paged decode
+# kernel (MXU group packing).  Alias for engine integrations.
+xqa_batch_decode_with_kv_cache = trtllm_batch_decode_with_kv_cache
+
+# cudnn-named entry points collapse the same way.
+cudnn_batch_decode_with_kv_cache = trtllm_batch_decode_with_kv_cache
+
+
+def fast_decode_plan(wrapper: BatchDecodeWithPagedKVCacheWrapper, *args, **kw):
+    """Trimmed replanning entry for engines that replan every step
+    (reference ``fast_decode_plan``, decode.py:3700 — skips host validation).
+    The TPU plan is already a thin native-planner call, so this simply
+    forwards; the name exists for drop-in compatibility."""
+    return wrapper.plan(*args, **kw)

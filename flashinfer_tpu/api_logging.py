@@ -58,14 +58,29 @@ def _dump(name: str, idx: int, args, kwargs) -> None:
 
 
 def flashinfer_api(fn: Callable = None, *, name: str = None) -> Callable:
-    """Decorator adding leveled call logging to a public API function."""
+    """Decorator adding leveled call logging + trace-capture/substitution
+    hooks to a public API function (the trace hooks are flashinfer_tpu.trace's
+    FLASHINFER_TPU_TRACE_DUMP / FLASHINFER_TPU_TRACE_APPLY surface)."""
 
     def deco(f):
         api_name = name or f.__qualname__
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
+            from flashinfer_tpu import trace as _trace
+
             level = env.log_level()
+            tracing = _trace._trace_enabled() or _trace._apply_enabled()
+            if level <= 0 and not tracing:
+                return f(*args, **kwargs)
+            if tracing:
+                t_axes = _trace._axes_of(args, kwargs)
+                if _trace._trace_enabled():
+                    _trace._dump_trace(api_name, t_axes)
+                if _trace._apply_enabled():
+                    sub = _trace._find_solution(api_name, t_axes)
+                    if sub is not None:
+                        return sub(*args, **kwargs)
             if level <= 0:
                 return f(*args, **kwargs)
             idx = next(_call_counter)

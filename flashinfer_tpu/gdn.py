@@ -3,9 +3,11 @@
 TPU re-design of the reference's linear-attention families:
 - GDN (Qwen3-Next; reference ``flashinfer/gdn_decode.py`` /
   ``gdn_prefill.py`` / ``gdn_kernels/``): gated delta rule over a matrix
-  state ``S [dk, dv]`` per head:
-      S_t = alpha_t * S_{t-1} + beta_t * k_t (v_t - S_{t-1}^T k_t)^T
-      o_t = S_t^T q_t
+  state ``S [dk, dv]`` per head — decay first, then delta-correct against
+  the *decayed* state (standard Gated DeltaNet form):
+      S~   = alpha_t * S_{t-1}
+      S_t  = S~ + beta_t * k_t (v_t - S~^T k_t)^T
+      o_t  = S_t^T q_t
   with scalar-per-head decay ``alpha`` and update gate ``beta``.
 - KDA (Kimi; reference ``flashinfer/kda_decode.py`` /
   ``kda_kernels/recurrent_kda.py``): same delta rule with *per-channel*

@@ -96,6 +96,29 @@ def mm_int8(
     return acc.astype(out_dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
+def mm_fp4(
+    a_packed: jax.Array,  # [m, k//2] int8 nibbles
+    a_scale: jax.Array,  # [m, k//block] f32
+    b_packed: jax.Array,  # [k//2, n] int8, packed along k
+    b_scale: jax.Array,
+    block_size: int = 16,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Block-int4 ("fp4-class") matmul (reference ``mm_fp4``): operands
+    stored packed (0.5 B/elem + block scales), dequantized in-register to
+    bf16 for the MXU.  ``b`` is packed along its FIRST axis (k)."""
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    a = dequantize_fp4(a_packed, a_scale, block_size)
+    # b packed along k: transpose to pack-last, dequant, transpose back
+    b = dequantize_fp4(
+        jnp.swapaxes(b_packed, 0, 1), jnp.swapaxes(b_scale, 0, 1), block_size
+    )
+    b = jnp.swapaxes(b, 0, 1)
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def grouped_gemm(
     x: jax.Array,  # [total_m, k] ragged rows

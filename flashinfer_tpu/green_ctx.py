@@ -1,0 +1,28 @@
+"""Green-context SM partitioning — intentionally absent on TPU.
+
+The reference's ``flashinfer/green_ctx.py`` (split_device_green_ctx,
+green_ctx.py:126) carves a GPU's SMs into partitions to colocate prefill
+with decode or compute with communication.  A TPU core has no SM pool to
+partition: concurrency between compute and DMA/collectives is handled by
+the compiler's async scheduling, and prefill/decode colocation is achieved
+by the holistic mixed-batch kernel (flashinfer_tpu.attention.BatchAttention)
+instead of spatial partitioning.  These stubs document the mapping and
+fail loudly rather than silently no-op.
+"""
+
+from __future__ import annotations
+
+
+def split_device_green_ctx(*args, **kwargs):
+    raise NotImplementedError(
+        "Green contexts are CUDA SM partitioning; on TPU use "
+        "flashinfer_tpu.attention.BatchAttention (holistic mixed batches) — "
+        "compute/communication overlap is compiler-scheduled."
+    )
+
+
+def split_device_green_ctx_by_sm_count(*args, **kwargs):
+    raise NotImplementedError(
+        "Green contexts are CUDA SM partitioning; no TPU equivalent. "
+        "See flashinfer_tpu.green_ctx module docstring for the mapping."
+    )

@@ -57,7 +57,7 @@ def mhc_post_mix(
     return (mixed + inject).astype(streams.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("n",))
 def mhc_dynamic_weights(
     x: jax.Array,  # [tokens, hidden] pre-mix input source (e.g. stream mean)
     w_proj: jax.Array,  # [hidden, n + n + n*n]

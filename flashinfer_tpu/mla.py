@@ -95,14 +95,14 @@ class BatchMLAPagedAttentionWrapper:
             pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
             p_bucket = max(next_power_of_two(int(pages_per_req.max(initial=1))), 8)
             b_bucket = max(next_power_of_two(batch), 8)
-            last_page_len = (
-                kv_len - (np.maximum(pages_per_req, 1) - 1) * page_size
-            ).astype(np.int32)
+            # decode_plan builds the padded table; token lengths come from
+            # the caller's kv_len_arr directly (last_page_len arg unused for
+            # lengths here, so pass a valid placeholder)
             table, lens = native.decode_plan(
-                kv_indptr, kv_indices, last_page_len, page_size,
+                kv_indptr, kv_indices, np.ones(batch, np.int32), page_size,
                 b_bucket, p_bucket,
             )
-            lens[:batch] = kv_len  # exact token lengths from the caller
+            lens[:batch] = kv_len
             self._plan = _MLAPlan(
                 decode_mode=True, causal=causal, sm_scale=float(sm_scale),
                 num_heads=num_heads, head_dim_ckv=head_dim_ckv,

@@ -1,0 +1,94 @@
+"""MSA sparse attention ops (MiniMax-style).
+
+TPU re-design of the reference's ``flashinfer/msa_ops/`` family: dynamic
+sparse attention where each query block attends only the top-k KV blocks
+ranked by a cheap *proxy score* (mean-pooled keys).  Pipeline:
+
+1. ``msa_proxy_score``: block-mean keys vs block-mean queries -> [QB, KB]
+   score matrix (the reference's proxy-score kernel);
+2. ``msa_topk_select``: per-query-block top-k KV block ids (+ always the
+   diagonal/local block for causal integrity);
+3. ``msa_sparse_attention``: BSR attention over the selected blocks via
+   the scalar-prefetch Pallas kernel (ops/block_sparse.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.utils import get_sm_scale
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_kv"))
+def msa_proxy_score(
+    q: jax.Array,  # [M, H, D]
+    k: jax.Array,  # [N, Hkv, D]
+    block_q: int = 64,
+    block_kv: int = 64,
+) -> jax.Array:
+    """Head-summed block-pooled attention proxy -> [M//bq, N//bkv] f32."""
+    M, H, D = q.shape
+    N = k.shape[0]
+    qb = q.astype(jnp.float32).reshape(M // block_q, block_q, H, D).mean(1)
+    kb = k.astype(jnp.float32).reshape(N // block_kv, block_kv, -1, D).mean(1)
+    group = H // kb.shape[1]
+    kb = jnp.repeat(kb, group, axis=1)
+    return jnp.einsum("ihd,jhd->ij", qb, kb)
+
+
+def msa_topk_select(
+    scores: jax.Array,  # [QB, KB]
+    top_k: int,
+    causal: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side BSR structure from proxy scores: per row, the top-k blocks
+    (restricted to j <= i when causal, diagonal always kept).
+    Returns (indptr [QB+1], indices [nnz]) numpy arrays for plan()."""
+    s = np.asarray(scores, np.float32)
+    QB, KB = s.shape
+    indptr = [0]
+    indices = []
+    for i in range(QB):
+        row = s[i].copy()
+        if causal:
+            row[i + 1 :] = -np.inf
+        k_eff = min(top_k, i + 1 if causal else KB)
+        sel = set(np.argsort(-row)[:k_eff].tolist())
+        sel.add(min(i, KB - 1))  # local block
+        cols = sorted(sel)
+        indices.extend(cols)
+        indptr.append(len(indices))
+    return np.asarray(indptr, np.int32), np.asarray(indices, np.int32)
+
+
+def msa_sparse_attention(
+    q: jax.Array,  # [M, H, D]
+    k: jax.Array,  # [N, Hkv, D]
+    v: jax.Array,
+    top_k: int = 8,
+    block_q: int = 64,
+    block_kv: int = 64,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    backend: str = "auto",
+) -> jax.Array:
+    """End-to-end MSA sparse attention: proxy -> select -> BSR attention.
+
+    Note: block-granular sparsity — within selected blocks attention is
+    dense (no intra-block causal mask), matching the proxy-sparse design."""
+    from flashinfer_tpu.sparse import BlockSparseAttentionWrapper
+
+    scores = msa_proxy_score(q, k, block_q, block_kv)
+    indptr, indices = msa_topk_select(scores, top_k, causal)
+    w = BlockSparseAttentionWrapper(backend=backend)
+    w.plan(
+        indptr, indices, q.shape[0], k.shape[0], block_q, block_kv,
+        q.shape[1], k.shape[1], q.shape[2],
+        sm_scale=get_sm_scale(q.shape[2], sm_scale),
+    )
+    return w.run(q, k, v)

@@ -167,7 +167,17 @@ def paged_gather_plan(
     for r in range(batch):
         s = int(tok64[r])
         n = int(tok64[r + 1] - s)
+        # mirror the native path's per-request validation
+        if n < 0 or s < 0 or s + n > pad_to:
+            raise ValueError(
+                "paged_gather_plan: kv lengths inconsistent with page lists"
+            )
         pages = pidx[int(pip[r]) : int(pip[r + 1])]
+        npages_needed = (n - 1) // page_size + 1 if n > 0 else 0
+        if npages_needed > len(pages):
+            raise ValueError(
+                "paged_gather_plan: kv lengths inconsistent with page lists"
+            )
         tok = np.arange(n)
         rows[s : s + n] = pages[tok // page_size] * page_size + tok % page_size
     return rows

@@ -95,3 +95,52 @@ def quantize_int8(
         jnp.int8
     )
     return q, scale.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Block-int4 ("fp4-class") format: the TPU mapping of NVFP4/MXFP4
+# (reference flashinfer/quantization/fp4_quantization.py).  4-bit symmetric
+# values in 16-element blocks with an fp32 scale per block, two nibbles
+# packed per int8 byte — same storage footprint as NVFP4 (0.5 B/elem +
+# scales), dequantized in-register to bf16 for the MXU.
+# ---------------------------------------------------------------------------
+
+FP4_BLOCK = 16
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def quantize_fp4(
+    x: jax.Array,  # [..., K] with K % (2 and block_size) == 0
+    block_size: int = FP4_BLOCK,
+) -> Tuple[jax.Array, jax.Array]:
+    """Block-scaled 4-bit quantization -> (packed [..., K//2] int8,
+    scales [..., K//block_size] f32)."""
+    shape = x.shape
+    K = shape[-1]
+    xf = x.astype(jnp.float32).reshape(*shape[:-1], K // block_size, block_size)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int8)
+    q = q.reshape(*shape[:-1], K)
+    lo = q[..., 0::2] & 0x0F
+    hi = (q[..., 1::2] & 0x0F) << 4
+    packed = (lo | hi).astype(jnp.int8)
+    return packed, scale[..., 0].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
+def dequantize_fp4(
+    packed: jax.Array,  # [..., K//2] int8
+    scales: jax.Array,  # [..., K//block_size] f32
+    block_size: int = FP4_BLOCK,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    lo = (packed << 4) >> 4  # sign-extend low nibble (arithmetic shift)
+    hi = packed >> 4
+    K = packed.shape[-1] * 2
+    q = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], K)
+    qf = q.astype(jnp.float32).reshape(
+        *packed.shape[:-1], K // block_size, block_size
+    )
+    out = qf * scales[..., None]
+    return out.reshape(*packed.shape[:-1], K).astype(out_dtype)

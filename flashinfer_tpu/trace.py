@@ -4,14 +4,19 @@ TPU re-design of the reference's fi_trace / trace_apply pair
 (``flashinfer/fi_trace.py:15-75`` TraceTemplate -> flashinfer-bench JSON;
 ``flashinfer/trace_apply/apply.py:15-28`` monkey-patch substitution):
 
-- ``FLASHINFER_TPU_TRACE_DUMP=1``: every ``@traced_api`` call appends a
-  JSON definition line (op, shapes, dtypes, static params) to
+- ``FLASHINFER_TPU_TRACE_DUMP=1``: every decorated public-API call appends
+  a JSON definition line (op, shapes, dtypes, static params) to
   ``<dump_dir>/trace.jsonl`` — the workload-capture format benchmark
   tooling consumes.
 - ``register_solution(op, match, fn)`` + ``FLASHINFER_TPU_TRACE_APPLY=1``:
   calls whose static axes match a registered solution are routed to the
   substitute implementation, without touching call sites (the reference's
   tuned-kernel swap-in mechanism).
+
+These hooks ride the ``@flashinfer_api`` decorator (api_logging.py) that
+already wraps the public APIs — op names in traces/solutions are the public
+function names (e.g. ``"rmsnorm"``).  ``@traced_api`` remains for adding
+the hooks to functions outside the logged API surface.
 """
 
 from __future__ import annotations

@@ -1,0 +1,95 @@
+"""Tests for fp4 storage, fused quant activation, aliases, MSA ops,
+green_ctx stubs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+
+
+def test_fp4_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64))
+    packed, scales = fi.quantize_fp4(x)
+    assert packed.shape == (8, 32) and packed.dtype == jnp.int8
+    assert scales.shape == (8, 4)
+    back = fi.dequantize_fp4(packed, scales, out_dtype=jnp.float32)
+    # int4 blocks: max error = half a step = scale/2 <= amax/14 per block
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    blocks = np.asarray(x).reshape(8, 4, 16)
+    bound = np.abs(blocks).max(-1) / 14 + 1e-6
+    assert (err.reshape(8, 4, 16) <= bound[..., None] + 1e-5).all()
+
+
+def test_mm_fp4():
+    a = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
+    b = jax.random.normal(jax.random.PRNGKey(1), (64, 32))
+    ap, asc = fi.quantize_fp4(a)
+    bp, bsc = fi.quantize_fp4(jnp.swapaxes(b, 0, 1))
+    out = fi.mm_fp4(ap, asc, jnp.swapaxes(bp, 0, 1), jnp.swapaxes(bsc, 0, 1),
+                    out_dtype=jnp.float32)
+    ref = np.asarray(a) @ np.asarray(b)
+    # 4-bit: loose tolerance, but correlation must be high
+    corr = np.corrcoef(np.asarray(out).ravel(), ref.ravel())[0, 1]
+    assert corr > 0.98, corr
+
+
+def test_silu_mul_quant_fp8():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 128))
+    q, scale = fi.silu_and_mul_quant_fp8(x)
+    assert q.dtype == jnp.float8_e4m3fn and q.shape == (8, 64)
+    ref = np.asarray(fi.silu_and_mul(x), np.float32)
+    back = np.asarray(q, np.float32) * float(scale)
+    np.testing.assert_allclose(back, ref, rtol=0.2, atol=0.1)
+
+
+def test_trtllm_alias_decode():
+    B, HQ, HKV, D, PS, P = 3, 8, 2, 64, 8, 4
+    kc = jax.random.normal(jax.random.PRNGKey(0), (16, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (16, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    tables = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+    lens = jnp.array([10, 25, 32], jnp.int32)
+    out = fi.trtllm_batch_decode_with_kv_cache(
+        q, (kc, vc), block_tables=tables, seq_lens=lens, kv_layout="HND"
+    )
+    from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+
+    ref = xla_paged_decode(
+        q, jnp.swapaxes(kc, 1, 2), jnp.swapaxes(vc, 1, 2), tables, lens,
+        sm_scale=1 / np.sqrt(D),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    # xqa / cudnn aliases are the same callable
+    assert fi.xqa_batch_decode_with_kv_cache is fi.trtllm_batch_decode_with_kv_cache
+
+
+def test_msa_sparse_attention_dense_limit():
+    """With top_k >= all blocks and causal=False, MSA == dense attention."""
+    from flashinfer_tpu.testing import attention_ref
+
+    M, H, D = 128, 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (M, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (M, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (M, H, D), jnp.float32)
+    out = fi.msa_sparse_attention(q, k, v, top_k=100, block_q=32, block_kv=32,
+                                  causal=False)
+    ref = attention_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_msa_topk_select_causal_structure():
+    scores = jnp.asarray(np.random.default_rng(0).normal(size=(4, 4)))
+    indptr, indices = fi.msa_topk_select(scores, top_k=2, causal=True)
+    for i in range(4):
+        cols = indices[indptr[i] : indptr[i + 1]]
+        assert (cols <= i).all()  # causal: no future blocks
+        assert i in cols  # local block always present
+
+
+def test_green_ctx_raises():
+    from flashinfer_tpu import green_ctx
+
+    with pytest.raises(NotImplementedError, match="BatchAttention"):
+        green_ctx.split_device_green_ctx(None)
