@@ -83,28 +83,104 @@ def fused_moe_ep(
     num_experts: int,
     axis: str = "tp",
     activation: str = "silu",
+    dispatch: str = "allgather",
+    capacity_factor: float = 2.0,
 ) -> jax.Array:
     """Expert-parallel fused MoE (call inside shard_map).
 
     Experts are contiguously sharded over ``axis`` (rank r owns
     ``[r*E_local, (r+1)*E_local)``, the Mapping.ep_experts partition).
-    Dispatch = all_gather of tokens+routing; combine = psum of partials."""
+
+    Two dispatch modes mirroring the reference moe_ep design space:
+    - ``"allgather"``: all_gather tokens + psum_scatter combine — minimal
+      latency at small world sizes, bandwidth O(T_global * hidden);
+    - ``"alltoall"``: capacity-bucketed token exchange (the reference's
+      split-mode NCCL/NIXL dispatch+combine as ``lax.all_to_all``) —
+      bandwidth O(T_local * K * hidden), the scalable mode.  Tokens beyond
+      ``capacity_factor * T_local * K / ep`` per destination are dropped
+      (standard capacity semantics).
+    """
+    if dispatch == "allgather":
+        ep = jax.lax.axis_size(axis)
+        rank = jax.lax.axis_index(axis)
+        e_local = w_gate_up.shape[0]
+
+        xg = jax.lax.all_gather(hidden, axis, tiled=True)  # [T_global, hidden]
+        wg = jax.lax.all_gather(topk_weights, axis, tiled=True)
+        idg = jax.lax.all_gather(topk_ids, axis, tiled=True)
+
+        lo = rank * e_local
+        local = (idg >= lo) & (idg < lo + e_local)
+        # non-local choices route to a local dummy slot with zero weight
+        ids_local = jnp.where(local, idg - lo, 0).astype(jnp.int32)
+        w_local = jnp.where(local, wg, 0.0)
+
+        partial = fused_moe(
+            xg, w_gate_up, w_down, w_local, ids_local, e_local, activation
+        )
+        # combine: sum partials, then take this rank's token slice
+        return jax.lax.psum_scatter(partial, axis, tiled=True)
+    if dispatch == "alltoall":
+        return _fused_moe_ep_alltoall(
+            hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
+            axis, activation, capacity_factor,
+        )
+    raise ValueError(f"unknown dispatch {dispatch!r}")
+
+
+def _fused_moe_ep_alltoall(
+    hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
+    axis, activation, capacity_factor,
+):
     ep = jax.lax.axis_size(axis)
-    rank = jax.lax.axis_index(axis)
     e_local = w_gate_up.shape[0]
+    T, K = topk_ids.shape
+    H = hidden.shape[1]
+    TK = T * K
+    import math
 
-    xg = jax.lax.all_gather(hidden, axis, tiled=True)  # [T_global, hidden]
-    wg = jax.lax.all_gather(topk_weights, axis, tiled=True)
-    idg = jax.lax.all_gather(topk_ids, axis, tiled=True)
+    cap = max(1, int(math.ceil(TK / ep * capacity_factor)))
 
-    lo = rank * e_local
-    local = (idg >= lo) & (idg < lo + e_local)
-    # non-local choices route to a local dummy slot with zero weight
-    ids_local = jnp.where(local, idg - lo, 0).astype(jnp.int32)
-    w_local = jnp.where(local, wg, 0.0)
+    flat_ids = topk_ids.reshape(-1)
+    dst = (flat_ids // e_local).astype(jnp.int32)
+    order = jnp.argsort(dst, stable=True)
+    sd = dst[order]  # sorted destinations
+    stok = order // K  # source token of each sorted entry
+    # index within each destination bucket
+    first = jnp.searchsorted(sd, sd, side="left")
+    within = jnp.arange(TK) - first
 
-    partial = fused_moe(
-        xg, w_gate_up, w_down, w_local, ids_local, e_local, activation
+    # capacity-bucketed send buffers; overflow (within >= cap) drops
+    send_x = jnp.zeros((ep, cap, H), hidden.dtype).at[sd, within].set(
+        hidden[stok], mode="drop"
     )
-    # combine: sum partials, then take this rank's token slice
-    return jax.lax.psum_scatter(partial, axis, tiled=True)
+    send_eid = jnp.zeros((ep, cap), jnp.int32).at[sd, within].set(
+        (flat_ids[order] % e_local).astype(jnp.int32), mode="drop"
+    )
+    send_valid = jnp.zeros((ep, cap), jnp.float32).at[sd, within].set(
+        1.0, mode="drop"
+    )
+
+    # dispatch: entry j of the received buffer came from rank j
+    recv_x = jax.lax.all_to_all(send_x, axis, 0, 0)
+    recv_eid = jax.lax.all_to_all(send_eid, axis, 0, 0)
+    recv_valid = jax.lax.all_to_all(send_valid, axis, 0, 0)
+
+    out = fused_moe(
+        recv_x.reshape(ep * cap, H), w_gate_up, w_down,
+        recv_valid.reshape(ep * cap, 1),  # weight 1 for valid, 0 for empty
+        recv_eid.reshape(ep * cap, 1), e_local, activation,
+    )
+
+    # combine: send results back along the same routes
+    back = jax.lax.all_to_all(out.reshape(ep, cap, H), axis, 0, 0)
+    kept = (within < cap)[:, None].astype(jnp.float32)
+    gathered = back[sd, jnp.minimum(within, cap - 1)] * kept  # sorted order
+    contrib = jnp.zeros((TK, H), jnp.float32).at[order].set(
+        gathered.astype(jnp.float32)
+    )
+    combined = (
+        contrib.reshape(T, K, H)
+        * topk_weights.astype(jnp.float32)[..., None]
+    ).sum(1)
+    return combined.astype(hidden.dtype)

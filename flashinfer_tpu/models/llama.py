@@ -246,3 +246,126 @@ def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         )
     )
     return sharded, mesh, dict(params=param_specs, cache=cache_spec)
+
+
+def stack_layer_params(params: Dict) -> Dict:
+    """Stack the per-layer weight dicts into leading-layer-dim arrays
+    (required for pipeline sharding: the layer dim shards over pp)."""
+    layers = params["layers"]
+    stacked = {
+        k: jnp.stack([l[k] for l in layers]) for k in layers[0]
+    }
+    out = dict(params)
+    out["layers"] = stacked
+    return out
+
+
+def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
+    """dp x tp x pp sharded decode step.
+
+    Pipeline parallelism the TPU way: layers stack along a leading dim
+    sharded over the ``pp`` mesh axis (Mapping.pp_layers partition);
+    activations traverse stages via ``lax.ppermute`` ring hops, and the
+    final stage's logits are broadcast back with a masked psum.  Single
+    token-batch decode runs the stages sequentially (microbatch overlap is
+    a scheduling refinement on top of the same wiring).  TP within each
+    stage works exactly as in make_sharded_decode_step (fused AR+norm).
+
+    Expects ``stack_layer_params``-formatted params and per-layer-stacked
+    caches ``(k, v) [L, dp, pages, kvh, ps, hd]``.
+    """
+    mesh = mesh or mapping.make_mesh()
+    tp, dp, pp = Mapping.AXIS_TP, Mapping.AXIS_DP, Mapping.AXIS_PP
+    assert cfg.num_layers % mapping.pp_size == 0
+    assert cfg.num_kv_heads % mapping.tp_size == 0
+    qh_l = cfg.num_qo_heads // mapping.tp_size
+    kvh_l = cfg.num_kv_heads // mapping.tp_size
+    pp_size = mapping.pp_size
+
+    layer_specs = dict(
+        input_norm=P(pp, None),
+        q_proj=P(pp, None, tp), k_proj=P(pp, None, tp), v_proj=P(pp, None, tp),
+        o_proj=P(pp, tp, None),
+        post_norm=P(pp, None),
+        gate_proj=P(pp, None, tp), up_proj=P(pp, None, tp),
+        down_proj=P(pp, tp, None),
+    )
+    param_specs = dict(
+        embed=P(None, None), final_norm=P(None), lm_head=P(None, tp),
+        layers=layer_specs,
+    )
+    cache_spec = (
+        P(pp, dp, None, tp, None, None),
+        P(pp, dp, None, tp, None, None),
+    )
+    in_specs = (param_specs, P(dp), P(dp), cache_spec, P(dp, None), P(dp))
+    out_specs = (P(dp, tp), cache_spec)
+
+    def run_local_layers(layers, x, caches, page_table, kv_lens, positions):
+        """Scan this stage's layers over the activation."""
+        use_pallas = is_tpu()
+
+        def body(x, inp):
+            layer, kc, vc = inp
+            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+            attn, (kc2, vc2) = _attn_decode(
+                h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
+                qh_l, kvh_l, use_pallas,
+            )
+            o_partial = attn @ layer["o_proj"]
+            h2, x2 = allreduce_fusion(
+                o_partial, residual=x, rms_weight=layer["post_norm"],
+                eps=cfg.rms_eps, axis=tp,
+            )
+            h2 = h2.astype(cfg.dtype)
+            mlp_in = jnp.concatenate(
+                [h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1
+            )
+            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
+            return x3, (kc2, vc2)
+
+        kcs, vcs = caches
+        x, (kcs2, vcs2) = jax.lax.scan(body, x, (layers, kcs, vcs))
+        return x, (kcs2, vcs2)
+
+    def step(params, tokens, positions, kv_caches, page_table, kv_lens):
+        my_stage = jax.lax.axis_index(pp)
+        x = params["embed"][tokens].astype(cfg.dtype)
+        # drop the sharded leading dims: layers [L_local, ...], cache
+        # [L_local, 1(dp), pages, kvh_l, ps, hd]
+        kcs = kv_caches[0][:, 0]
+        vcs = kv_caches[1][:, 0]
+        perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
+
+        def stage_iter(s, carry):
+            x, kcs, vcs = carry
+            is_mine = my_stage == s
+            x2, (kcs2, vcs2) = run_local_layers(
+                params["layers"], x, (kcs, vcs), page_table, kv_lens, positions
+            )
+            # only the active stage advances the activation/caches
+            x = jnp.where(is_mine, x2, x)
+            kcs = jnp.where(is_mine, kcs2, kcs)
+            vcs = jnp.where(is_mine, vcs2, vcs)
+            # hand the activation to the next stage
+            x = jax.lax.ppermute(x, pp, perm)
+            return (x, kcs, vcs)
+
+        x, kcs, vcs = jax.lax.fori_loop(
+            0, pp_size, stage_iter, (x, kcs, vcs)
+        )
+        # after pp_size ring hops the fully-processed activation is back at
+        # every rank in turn; it now sits on stage 0 — broadcast via psum
+        x = jax.lax.psum(jnp.where(my_stage == 0, x, 0.0), pp)
+        x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        return logits, (kcs[:, None], vcs[:, None])
+
+    sharded = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    return sharded, mesh, dict(params=param_specs, cache=cache_spec)

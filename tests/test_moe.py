@@ -98,6 +98,38 @@ def test_fused_moe_empty_expert():
 
 
 @pytest.mark.devices_8
+def test_fused_moe_ep_alltoall_matches_single_device():
+    ep = 4
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("tp",))
+    T, E, K, h, inter = 16, 8, 2, 32, 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h), jnp.float32)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (E, h, 2 * inter)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h)) * 0.1
+    logits = jax.random.normal(jax.random.PRNGKey(3), (T, E))
+    weights, ids = moe.route_renormalize(logits, K)
+    single = moe.fused_moe(x, w1, w2, weights, ids, E)
+
+    def fn(x, w1, w2, wts, ids):
+        # generous capacity: no drops -> exact match with single device
+        return moe.fused_moe_ep(
+            x, w1, w2, wts, ids, E, axis="tp", dispatch="alltoall",
+            capacity_factor=float(ep),  # cap = T_local*K: cannot overflow
+        )
+
+    out = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp")),
+            out_specs=P("tp"),
+            check_vma=False,
+        )
+    )(x, w1, w2, weights, ids)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(single), rtol=2e-3, atol=2e-3
+    )
+
+
+@pytest.mark.devices_8
 def test_fused_moe_ep_matches_single_device():
     ep = 4
     mesh = Mesh(np.array(jax.devices()[:ep]), ("tp",))
