@@ -22,3 +22,10 @@ from flashinfer_tpu.fused_moe.core import (  # noqa: F401
     fused_moe,
     fused_moe_ep,
 )
+from flashinfer_tpu.fused_moe.api import (  # noqa: F401
+    MoE,
+    MoEConfig,
+    QuantConfig,
+    QuantVariant,
+    RoutingConfig,
+)

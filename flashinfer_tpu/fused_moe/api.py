@@ -1,0 +1,152 @@
+"""Unified MoE configuration API.
+
+Re-design of the reference's frozen-dataclass MoE config surface
+(``flashinfer/fused_moe/api.py:1-133`` — explicitly called out in SURVEY
+§2.3 as the pattern to mirror): decouples MoE callers from the kernels'
+many positional arguments.  A ``MoE`` layer object holds the config +
+weights and exposes one ``__call__``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.fused_moe.core import fused_moe, fused_moe_ep
+from flashinfer_tpu.fused_moe.routing import (
+    RoutingMethodType,
+    route_deepseek_v3,
+    route_llama4,
+    route_renormalize,
+    route_topk,
+)
+
+
+class QuantVariant(enum.Enum):
+    """Weight/activation precision variants (reference QuantVariant).
+    TPU mapping: BF16 native; FP8/INT8 = stored low-precision, bf16/int8
+    MXU compute (gemm.py docs)."""
+
+    BF16 = "bf16"
+    FP8 = "fp8"
+    INT8 = "int8"
+
+
+@dataclass(frozen=True)
+class RoutingConfig:
+    """Routing configuration (reference RoutingConfig)."""
+
+    method: RoutingMethodType = RoutingMethodType.Renormalize
+    top_k: int = 2
+    # DeepSeek-V3 extras
+    n_group: int = 1
+    topk_group: int = 1
+    routed_scaling_factor: float = 1.0
+
+    def __call__(self, logits: jax.Array, bias: Optional[jax.Array] = None):
+        m = self.method
+        if m == RoutingMethodType.Default:
+            return route_topk(logits, self.top_k)
+        if m in (RoutingMethodType.Renormalize, RoutingMethodType.RenormalizeNaive):
+            return route_renormalize(logits, self.top_k)
+        if m == RoutingMethodType.DeepSeekV3:
+            if bias is None:
+                bias = jnp.zeros((logits.shape[-1],), jnp.float32)
+            return route_deepseek_v3(
+                logits, bias, self.top_k, self.n_group, self.topk_group,
+                self.routed_scaling_factor,
+            )
+        if m == RoutingMethodType.Llama4:
+            return route_llama4(logits)
+        raise ValueError(f"unsupported routing method {m}")
+
+
+@dataclass(frozen=True)
+class QuantConfig:
+    """Quantization configuration (reference QuantConfig)."""
+
+    variant: QuantVariant = QuantVariant.BF16
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
+    quant: QuantConfig = field(default_factory=QuantConfig)
+    activation: str = "silu"
+    # expert parallelism
+    ep_axis: Optional[str] = None  # mesh axis when called inside shard_map
+    ep_dispatch: str = "allgather"
+    ep_capacity_factor: float = 2.0  # alltoall mode token-drop threshold
+
+
+class MoE:
+    """Config-driven MoE layer (reference unified ``MoE`` layer API).
+
+    >>> layer = MoE(cfg, router_weight, w_gate_up, w_down)
+    >>> out = layer(x)          # route + fused expert compute
+    """
+
+    def __init__(
+        self,
+        config: MoEConfig,
+        router_weight: jax.Array,  # [hidden, num_experts]
+        w_gate_up: jax.Array,  # [E(_local), hidden, 2*inter]
+        w_down: jax.Array,  # [E(_local), inter, hidden]
+        router_bias: Optional[jax.Array] = None,
+    ):
+        self.config = config
+        self.router_weight = router_weight
+        self.router_bias = router_bias
+        # honor the quant variant at weight-storage level (the TPU mapping:
+        # low-precision HBM storage, bf16/int8-adjacent MXU compute)
+        v = config.quant.variant
+        if v == QuantVariant.BF16:
+            self._wq1, self._ws1 = w_gate_up, None
+            self._wq2, self._ws2 = w_down, None
+        elif v == QuantVariant.FP8:
+            from flashinfer_tpu.quantization import quantize_fp8_per_channel
+
+            self._wq1, self._ws1 = quantize_fp8_per_channel(w_gate_up, axis=1)
+            self._wq2, self._ws2 = quantize_fp8_per_channel(w_down, axis=1)
+        elif v == QuantVariant.INT8:
+            from flashinfer_tpu.quantization import quantize_int8
+
+            self._wq1, self._ws1 = quantize_int8(w_gate_up, axis=1)
+            self._wq2, self._ws2 = quantize_int8(w_down, axis=1)
+        else:
+            raise ValueError(f"unsupported quant variant {v}")
+
+    def _weights(self):
+        if self._ws1 is None:
+            return self._wq1, self._wq2
+        w1 = (self._wq1.astype(jnp.float32) * self._ws1).astype(jnp.bfloat16)
+        w2 = (self._wq2.astype(jnp.float32) * self._ws2).astype(jnp.bfloat16)
+        return w1, w2
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        # routing precision follows the input dtype (fp32 stays fp32 — bf16
+        # rounding can flip near-tied expert selections)
+        logits = jnp.dot(
+            x, self.router_weight.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        weights, ids = cfg.routing(logits, self.router_bias)
+        w1, w2 = self._weights()
+        if cfg.ep_axis is None:
+            return fused_moe(
+                x, w1, w2, weights, ids, cfg.num_experts, cfg.activation
+            )
+        return fused_moe_ep(
+            x, w1, w2, weights, ids, cfg.num_experts,
+            axis=cfg.ep_axis, activation=cfg.activation,
+            dispatch=cfg.ep_dispatch,
+            capacity_factor=cfg.ep_capacity_factor,
+        )

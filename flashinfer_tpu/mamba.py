@@ -112,3 +112,99 @@ def selective_scan(
         ),
     )
     return jnp.moveaxis(ys, 0, 1), final.astype(jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "dt_softplus")
+)
+def mamba_chunk_scan_combined(
+    x: jax.Array,  # [B, L, H, dim]
+    dt: jax.Array,  # [B, L, H]  (scalar per head/step — Mamba-2/SSD form)
+    A: jax.Array,  # [H] negative decay rates
+    B: jax.Array,  # [B, L, G, dstate]
+    C: jax.Array,  # [B, L, G, dstate]
+    chunk_size: int = 64,
+    D: Optional[jax.Array] = None,  # [H]
+    z: Optional[jax.Array] = None,  # [B, L, H, dim]
+    dt_bias: Optional[jax.Array] = None,  # [H]
+    dt_softplus: bool = False,  # matches selective_scan + reference default
+    initial_state: Optional[jax.Array] = None,  # [B, H, dim, dstate]
+) -> Tuple[jax.Array, jax.Array]:
+    """Chunked SSD scan (Mamba-2; reference ``mamba_chunk_scan_combined``
+    family, flashinfer/mamba/ SSD combined/chunked scan).
+
+    The sequence splits into chunks of ``chunk_size``; within a chunk the
+    recurrence unrolls into an attention-like matmul (MXU work:
+    ``scores[i,j] = (C_i . B_j) * exp(Acum_i - Acum_j) * dt_j``), and chunk
+    boundary states pass through one lax.scan — O(L * chunk) FLOPs with
+    O(L / chunk) sequential depth instead of O(L).
+
+    Requires ``L % chunk_size == 0`` (pad upstream).  Returns
+    ``(y [B, L, H, dim], final_state [B, H, dim, dstate])``.
+    """
+    Bsz, L, H, dim = x.shape
+    G, ds = B.shape[2], B.shape[3]
+    assert L % chunk_size == 0, "pad L to a chunk multiple"
+    nC = L // chunk_size
+    rep = H // G
+
+    dtf = dt.astype(jnp.float32)
+    if dt_bias is not None:
+        dtf = dtf + dt_bias.astype(jnp.float32)[None, None]
+    if dt_softplus:
+        dtf = _softplus(dtf)
+
+    xf = x.astype(jnp.float32).reshape(Bsz, nC, chunk_size, H, dim)
+    dtc = dtf.reshape(Bsz, nC, chunk_size, H)
+    Bf = jnp.repeat(B.astype(jnp.float32), rep, axis=2).reshape(
+        Bsz, nC, chunk_size, H, ds
+    )
+    Cf = jnp.repeat(C.astype(jnp.float32), rep, axis=2).reshape(
+        Bsz, nC, chunk_size, H, ds
+    )
+    a = dtc * A.astype(jnp.float32)[None, None, None, :]  # [B,nC,Q,H] log-decay
+    acum = jnp.cumsum(a, axis=2)  # inclusive cumulative decay in-chunk
+    a_total = acum[:, :, -1]  # [B, nC, H]
+
+    # intra-chunk quadratic part
+    li = acum[:, :, :, None, :]  # [B,nC,Q(i),1,H]
+    lj = acum[:, :, None, :, :]  # [B,nC,1,Q(j),H]
+    causal = jnp.tril(jnp.ones((chunk_size, chunk_size), bool))
+    decay = jnp.where(
+        causal[None, None, :, :, None], jnp.exp(li - lj), 0.0
+    )  # [B,nC,Q,Q,H]
+    cb = jnp.einsum("bnihs,bnjhs->bnijh", Cf, Bf)  # [B,nC,Q,Q,H]
+    scores = cb * decay * dtc[:, :, None, :, :]  # weight dt_j
+    y = jnp.einsum("bnijh,bnjhd->bnihd", scores, xf)
+
+    # chunk-final states: S_c = sum_j exp(a_total - acum_j) dt_j B_j x_j^T
+    w = jnp.exp(a_total[:, :, None, :] - acum) * dtc  # [B,nC,Q,H]
+    S_chunk = jnp.einsum("bnjh,bnjhs,bnjhd->bnhds", w, Bf, xf)
+
+    # inter-chunk scan over boundary states
+    if initial_state is None:
+        initial_state = jnp.zeros((Bsz, H, dim, ds), jnp.float32)
+
+    def scan_body(S, inp):
+        S_c, a_tot = inp  # [B,H,dim,ds], [B,H]
+        S_prev = S
+        S = jnp.exp(a_tot)[:, :, None, None] * S + S_c
+        return S, S_prev
+
+    final, S_prevs = jax.lax.scan(
+        scan_body,
+        initial_state.astype(jnp.float32),
+        (jnp.moveaxis(S_chunk, 1, 0), jnp.moveaxis(a_total, 1, 0)),
+    )
+    S_prevs = jnp.moveaxis(S_prevs, 0, 1)  # [B,nC,H,dim,ds]: state entering chunk
+
+    # inter-chunk contribution: y_inter[i] = exp(acum_i) * C_i . S_prev
+    y_inter = jnp.einsum(
+        "bnihs,bnhds->bnihd", Cf * jnp.exp(acum)[..., None], S_prevs
+    )
+    y = (y + y_inter).reshape(Bsz, L, H, dim)
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None, :, None] * x.astype(jnp.float32)
+    if z is not None:
+        y = y * jax.nn.silu(z.astype(jnp.float32))
+    return y.astype(x.dtype), final

@@ -16,6 +16,7 @@ Two execution paths, selected by the planned qo lengths:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -191,7 +192,61 @@ class BatchMLAPagedAttentionWrapper:
             return out[0][: plan.total_q], out[1][: plan.total_q]
         return out[: plan.total_q]
 
+    def run_sparse(
+        self,
+        q_nope: jax.Array,  # [batch, num_heads, head_dim_ckv]
+        q_pe: jax.Array,  # [batch, num_heads, head_dim_kpe]
+        ckv_cache: jax.Array,
+        kpe_cache: jax.Array,
+        sparse_rows: jax.Array,  # [batch, k] flat cache rows (from
+        # topk.top_k_page_table_transform), padded entries < 0
+        *,
+        sm_scale: Optional[float] = None,
+        return_lse: bool = False,
+    ):
+        """Top-k sparse MLA decode (the DSv3.2 sparse-MLA path, reference
+        ``flashinfer/mla/_sparse_mla_sm120.py`` + sparse_mla bindings):
+        attention restricted to the top-k selected KV tokens per request.
+        Selection comes from ``flashinfer_tpu.topk.top_k_page_table_transform``
+        over per-token proxy scores; rows < 0 are masked padding."""
+        d_ckv = ckv_cache.shape[-1]
+        if sm_scale is None:
+            sm_scale = 1.0 / float(d_ckv + kpe_cache.shape[-1]) ** 0.5
+        return _sparse_mla_decode(
+            q_nope, q_pe, ckv_cache, kpe_cache, sparse_rows,
+            sm_scale=float(sm_scale), return_lse=return_lse,
+        )
+
     forward = run
 
     def end_forward(self) -> None:
         pass
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "return_lse"))
+def _sparse_mla_decode(
+    q_nope, q_pe, ckv_cache, kpe_cache, sparse_rows,
+    *, sm_scale: float, return_lse: bool,
+):
+    """Gather the selected latent rows and run dense MQA attention over the
+    k tokens — with k in the hundreds this is one small MXU matmul per
+    request, the shape the sparse path exists to produce."""
+    batch, H, d_ckv = q_nope.shape
+    rows = jnp.maximum(sparse_rows, 0)
+    valid = sparse_rows >= 0  # [batch, k]
+    ckv = ckv_cache.reshape(-1, d_ckv)[rows].astype(jnp.float32)  # [B,k,d]
+    kpe = kpe_cache.reshape(-1, kpe_cache.shape[-1])[rows].astype(jnp.float32)
+    s = (
+        jnp.einsum("bhd,bkd->bhk", q_nope.astype(jnp.float32), ckv)
+        + jnp.einsum("bhd,bkd->bhk", q_pe.astype(jnp.float32), kpe)
+    ) * sm_scale
+    s = jnp.where(valid[:, None], s, -1e30)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(valid[:, None], jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, -1, keepdims=True)
+    out = jnp.einsum("bhk,bkd->bhd", p / jnp.where(l > 0, l, 1.0), ckv)
+    out = out.astype(q_nope.dtype)
+    if return_lse:
+        lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), -1e30)
+        return out, lse
+    return out

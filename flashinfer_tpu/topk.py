@@ -45,7 +45,10 @@ def top_k_page_table_transform(
     the fused top-k + page-table transform used by sparse-MLA index
     selection (reference topk.py fused transforms).
 
-    Returns (rows [batch, k] flat cache-row ids, valid [batch, k])."""
+    Returns (rows [batch, k], valid [batch, k]); entries beyond a request's
+    ``kv_len`` hold ``-1`` (the padding convention the sparse-MLA consumer
+    ``BatchMLAPagedAttentionWrapper.run_sparse`` masks on), so ``rows`` can
+    be fed forward directly."""
     masked = jnp.where(
         jnp.arange(scores.shape[1])[None, :] < kv_lens[:, None],
         scores.astype(jnp.float32),
@@ -54,4 +57,5 @@ def top_k_page_table_transform(
     vals, tok = jax.lax.top_k(masked, k)  # token positions within request
     page = jnp.take_along_axis(page_table, tok // page_size, axis=1)
     rows = page * page_size + tok % page_size
-    return rows.astype(jnp.int32), jnp.isfinite(vals)
+    valid = jnp.isfinite(vals)
+    return jnp.where(valid, rows, -1).astype(jnp.int32), valid

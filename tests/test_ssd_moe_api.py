@@ -1,0 +1,129 @@
+"""Chunked SSD scan vs sequential oracle; MoE config API; sparse MLA."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.fused_moe import (
+    MoE, MoEConfig, RoutingConfig, RoutingMethodType, fused_moe,
+)
+from flashinfer_tpu.mamba import mamba_chunk_scan_combined, selective_scan
+
+
+def test_chunked_ssd_matches_sequential():
+    B, L, H, dim, ds, G, Q = 2, 128, 2, 4, 8, 1, 32
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, L, H, dim)).astype(np.float32))
+    dt = jnp.asarray(rng.uniform(0.1, 0.9, (B, L, H)).astype(np.float32))
+    A = jnp.asarray(-rng.uniform(0.5, 2.0, (H,)).astype(np.float32))
+    Bm = jnp.asarray(rng.normal(size=(B, L, G, ds)).astype(np.float32))
+    C = jnp.asarray(rng.normal(size=(B, L, G, ds)).astype(np.float32))
+    D = jnp.asarray(rng.normal(size=(H,)).astype(np.float32))
+
+    y, final = mamba_chunk_scan_combined(
+        x, dt, A, Bm, C, chunk_size=Q, D=D, dt_softplus=False
+    )
+    # oracle: sequential scan with A broadcast to [H, dim, ds], scalar dt
+    # broadcast to [B, L, H, dim], D broadcast over dim
+    A_full = jnp.broadcast_to(A[:, None, None], (H, dim, ds))
+    dt_full = jnp.broadcast_to(dt[..., None], (B, L, H, dim))
+    D_full = jnp.broadcast_to(D[:, None], (H, dim))
+    y_ref, final_ref = selective_scan(
+        x, dt_full, A_full, Bm, C, D=D_full, dt_softplus=False
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(final), np.asarray(final_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_chunked_ssd_initial_state_and_gate():
+    B, L, H, dim, ds, Q = 1, 64, 2, 4, 4, 16
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(B, L, H, dim)).astype(np.float32))
+    dt = jnp.asarray(rng.uniform(0.1, 0.5, (B, L, H)).astype(np.float32))
+    A = jnp.asarray(np.array([-1.0, -0.3], np.float32))
+    Bm = jnp.asarray(rng.normal(size=(B, L, 2, ds)).astype(np.float32))
+    C = jnp.asarray(rng.normal(size=(B, L, 2, ds)).astype(np.float32))
+    z = jnp.asarray(rng.normal(size=(B, L, H, dim)).astype(np.float32))
+    s0 = jnp.asarray(rng.normal(size=(B, H, dim, ds)).astype(np.float32))
+    y, _ = mamba_chunk_scan_combined(
+        x, dt, A, Bm, C, chunk_size=Q, z=z, dt_softplus=True, initial_state=s0
+    )
+    A_full = jnp.broadcast_to(A[:, None, None], (H, dim, ds))
+    dt_full = jnp.broadcast_to(dt[..., None], (B, L, H, dim))
+    y_ref, _ = selective_scan(
+        x, dt_full, A_full, Bm, C, z=z, dt_softplus=True, initial_state=s0
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-3, atol=2e-3)
+
+
+def test_moe_config_api():
+    T, E, h, inter, K = 8, 8, 32, 64, 2
+    rng = np.random.default_rng(0)
+    router_w = jnp.asarray(rng.normal(size=(h, E)).astype(np.float32) * 0.1)
+    w1 = jnp.asarray(rng.normal(size=(E, h, 2 * inter)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.normal(size=(E, inter, h)).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.normal(size=(T, h)).astype(np.float32))
+    cfg = MoEConfig(
+        num_experts=E, hidden_size=h, intermediate_size=inter,
+        routing=RoutingConfig(method=RoutingMethodType.Renormalize, top_k=K),
+    )
+    layer = MoE(cfg, router_w, w1, w2)
+    out = layer(x)
+    # manual: route + fused
+    from flashinfer_tpu.fused_moe import route_renormalize
+
+    logits = jnp.dot(x, router_w, preferred_element_type=jnp.float32)
+    wts, ids = route_renormalize(logits, K)
+    ref = fused_moe(x, w1, w2, wts, ids, E)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_mla_matches_dense_on_selected():
+    from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper
+
+    B, H, d_ckv, d_kpe, PS = 2, 4, 32, 16, 4
+    num_pages = 16
+    ckv = jax.random.normal(jax.random.PRNGKey(0), (num_pages, PS, d_ckv))
+    kpe = jax.random.normal(jax.random.PRNGKey(1), (num_pages, PS, d_kpe))
+    q_nope = jax.random.normal(jax.random.PRNGKey(2), (B, H, d_ckv))
+    q_pe = jax.random.normal(jax.random.PRNGKey(3), (B, H, d_kpe))
+    # select 6 specific rows per request (one padded)
+    rows = jnp.array([[3, 9, 17, 22, 40, -1], [0, 1, 2, 3, 4, 5]], jnp.int32)
+    w = BatchMLAPagedAttentionWrapper()
+    out = w.run_sparse(q_nope, q_pe, ckv, kpe, rows)
+    sm = 1 / np.sqrt(d_ckv + d_kpe)
+    crows = np.asarray(ckv).reshape(-1, d_ckv)
+    prows = np.asarray(kpe).reshape(-1, d_kpe)
+    for b in range(B):
+        sel = [int(r) for r in rows[b] if r >= 0]
+        c, p = crows[sel], prows[sel]
+        s = (
+            np.einsum("hd,kd->hk", np.asarray(q_nope[b]), c)
+            + np.einsum("hd,kd->hk", np.asarray(q_pe[b]), p)
+        ) * sm
+        e = np.exp(s - s.max(-1, keepdims=True))
+        ref = np.einsum("hk,kd->hd", e / e.sum(-1, keepdims=True), c)
+        np.testing.assert_allclose(np.asarray(out[b]), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_sparse_mla_from_topk_transform():
+    """End-to-end: proxy scores -> top_k_page_table_transform -> run_sparse."""
+    from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper
+
+    B, H, d_ckv, d_kpe, PS, P = 2, 2, 16, 8, 4, 4
+    ckv = jax.random.normal(jax.random.PRNGKey(0), (16, PS, d_ckv))
+    kpe = jax.random.normal(jax.random.PRNGKey(1), (16, PS, d_kpe))
+    table = jnp.array([[3, 1, 2, 0], [7, 6, 5, 4]], jnp.int32)
+    kv_lens = jnp.array([13, 16], jnp.int32)
+    scores = jax.random.normal(jax.random.PRNGKey(2), (B, P * PS))
+    rows, valid = fi.top_k_page_table_transform(scores, table, kv_lens, 8, PS)
+    rows = jnp.where(valid, rows, -1)
+    q_nope = jax.random.normal(jax.random.PRNGKey(3), (B, H, d_ckv))
+    q_pe = jax.random.normal(jax.random.PRNGKey(4), (B, H, d_kpe))
+    out = BatchMLAPagedAttentionWrapper().run_sparse(q_nope, q_pe, ckv, kpe, rows)
+    assert out.shape == (B, H, d_ckv)
+    assert np.isfinite(np.asarray(out)).all()
