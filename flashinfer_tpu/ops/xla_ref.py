@@ -38,8 +38,11 @@ def xla_ragged_attention(
     logits_soft_cap: float = 0.0,
     window_left: int = -1,
     return_lse: bool = False,
+    custom_mask: Optional[jax.Array] = None,  # [total_q, total_kv] bool
 ):
-    """Same contract as ops.flash_attention.flash_attention."""
+    """Same contract as ops.flash_attention.flash_attention, plus an
+    optional dense custom mask (the xla backend serves the reference's
+    custom-mask modes; the Pallas kernel handles the structured masks)."""
     num_qo_heads = q.shape[1]
     num_kv_heads = k.shape[1]
     group = num_qo_heads // num_kv_heads
@@ -54,6 +57,8 @@ def xla_ragged_attention(
         mask = mask & (kv_pos[None, :] <= q_pos[:, None])
     if window_left >= 0:
         mask = mask & (kv_pos[None, :] >= q_pos[:, None] - window_left)
+    if custom_mask is not None:
+        mask = mask & custom_mask
     s = jnp.where(mask[None], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)

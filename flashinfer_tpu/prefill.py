@@ -65,11 +65,9 @@ def single_prefill_with_kv_cache(
 
     Causal alignment is bottom-right: query ``i`` attends to kv positions
     ``<= kv_len - qo_len + i`` (matching the reference's append semantics).
-    """
-    if custom_mask is not None or packed_custom_mask is not None:
-        raise NotImplementedError(
-            "custom masks land with the sparse-attention wrappers"
-        )
+    ``custom_mask`` ([qo_len, kv_len] bool) / ``packed_custom_mask``
+    (packbits form) route through the xla backend (dense mask — the
+    reference's MaskMode::kCustom)."""
     if pos_encoding_mode != "NONE":
         raise NotImplementedError(
             "apply flashinfer_tpu.rope explicitly before attention"
@@ -80,14 +78,32 @@ def single_prefill_with_kv_cache(
     qo_len, _, head_dim = q.shape
     kv_len = k.shape[0]
     sm_scale = get_sm_scale(head_dim, sm_scale)
+    if packed_custom_mask is not None and custom_mask is None:
+        # reference mask-bit convention is LSB-first within each byte
+        # (flashinfer packbits bitorder='little')
+        bits = jnp.unpackbits(
+            packed_custom_mask.view(jnp.uint8), count=qo_len * kv_len,
+            bitorder="little",
+        )
+        custom_mask = bits.reshape(qo_len, kv_len).astype(bool)
     backend = resolve_backend(backend, "single_prefill")
-    fn = flash_attention if backend == "pallas" else xla_ragged_attention
-    return fn(
+    args = (
         q, k, v,
         jnp.zeros((qo_len,), jnp.int32), jnp.zeros((kv_len,), jnp.int32),
         jnp.arange(qo_len, dtype=jnp.int32) + (kv_len - qo_len),
         jnp.arange(kv_len, dtype=jnp.int32),
-        causal=causal, sm_scale=sm_scale,
+    )
+    if custom_mask is not None:
+        # MaskMode::CUSTOM semantics (reference prefill.py): the custom mask
+        # fully defines visibility — causal/window are ignored
+        return xla_ragged_attention(
+            *args, custom_mask=custom_mask, causal=False, window_left=-1,
+            sm_scale=sm_scale, logits_soft_cap=logits_soft_cap or 0.0,
+            return_lse=return_lse,
+        )
+    fn = flash_attention if backend == "pallas" else xla_ragged_attention
+    return fn(
+        *args, causal=causal, sm_scale=sm_scale,
         logits_soft_cap=logits_soft_cap or 0.0,
         window_left=window_left, return_lse=return_lse,
     )

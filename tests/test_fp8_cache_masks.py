@@ -1,0 +1,83 @@
+"""FP8 KV-cache decode + custom-mask prefill tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.testing import attention_ref
+
+
+@pytest.mark.parametrize("backend", ["pallas", "xla"])
+def test_fp8_kv_cache_decode(backend):
+    """Decode over an fp8-stored cache with k/v scales matches fp32 within
+    fp8 tolerance (reference FP8 KV path, decode.py q/k scale folding)."""
+    B, HQ, HKV, D, PS = 3, 8, 2, 64, 8
+    kv_lens = [17, 40, 8]
+    num_pages = 32
+    rng = np.random.default_rng(0)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: indptr[-1]].astype(np.int32)
+    last = np.array([l - (p - 1) * PS for l, p in zip(kv_lens, pages_per)], np.int32)
+
+    kc32 = jax.random.normal(jax.random.PRNGKey(0), (num_pages, PS, HKV, D))
+    vc32 = jax.random.normal(jax.random.PRNGKey(1), (num_pages, PS, HKV, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+
+    # quantize caches to fp8 with one global scale each
+    kq, ks = fi.quantize_fp8_per_tensor(kc32)
+    vq, vs = fi.quantize_fp8_per_tensor(vc32)
+
+    w32 = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD", backend=backend)
+    w32.plan(indptr, indices, last, HQ, HKV, D, PS)
+    ref = w32.run(q, (kc32, vc32))
+
+    w8 = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD", backend=backend)
+    w8.plan(indptr, indices, last, HQ, HKV, D, PS)
+    out = w8.run(q, (kq, vq), k_scale=float(ks), v_scale=float(vs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.1)
+
+
+def test_single_prefill_custom_mask():
+    qo, kv, H, D = 16, 32, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv, H, D))
+    rng = np.random.default_rng(3)
+    mask = rng.random((qo, kv)) < 0.6
+    mask[:, 0] = True  # keep rows non-empty
+    out = fi.single_prefill_with_kv_cache(q, k, v, custom_mask=jnp.asarray(mask))
+    ref = attention_ref(q, k, v, custom_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_single_prefill_packed_custom_mask():
+    qo, kv, H, D = 8, 16, 1, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv, H, D))
+    rng = np.random.default_rng(4)
+    mask = rng.random((qo, kv)) < 0.7
+    mask[:, 0] = True
+    # reference packing convention: LSB-first (bitorder='little')
+    packed = fi.packbits(
+        jnp.asarray(mask.reshape(-1).astype(np.uint8)), bitorder="little"
+    )
+    out = fi.single_prefill_with_kv_cache(q, k, v, packed_custom_mask=packed)
+    ref = attention_ref(q, k, v, custom_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_custom_mask_overrides_causal():
+    """MaskMode::CUSTOM: causal=True is ignored when a custom mask is given
+    (reference contract)."""
+    qo, kv, H, D = 8, 8, 1, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv, H, D))
+    full = jnp.ones((qo, kv), bool)
+    out = fi.single_prefill_with_kv_cache(q, k, v, custom_mask=full, causal=True)
+    ref = attention_ref(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
