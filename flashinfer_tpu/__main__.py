@@ -86,6 +86,14 @@ def cmd_list_modules(_args) -> int:
     return 0
 
 
+def cmd_prewarm(_args) -> int:
+    from flashinfer_tpu.aot import prewarm
+
+    n = prewarm()
+    print(f"prewarmed {n} configs into the persistent compile cache")
+    return 0
+
+
 def cmd_tuner_status(_args) -> int:
     from flashinfer_tpu.autotuner import AutoTuner
 
@@ -108,6 +116,7 @@ def main(argv=None) -> int:
         ("module-status", cmd_module_status),
         ("list-modules", cmd_list_modules),
         ("tuner-status", cmd_tuner_status),
+        ("prewarm", cmd_prewarm),
     ]:
         sp = sub.add_parser(name)
         sp.set_defaults(fn=fn)

@@ -1,0 +1,69 @@
+"""AOT cache pre-warm.
+
+TPU re-design of the reference's AOT batch builder (``flashinfer/aot.py`` —
+enumerate all JitSpecs and build them into the jit-cache wheel): here the
+artifact store is the XLA persistent compilation cache, and pre-warming
+means tracing + compiling the common kernel configurations once so serving
+processes hit the cache cold-start-free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# (num_qo_heads, num_kv_heads, head_dim) families to pre-warm by default —
+# the reference AOT's head-dim/GQA enumeration collapsed to common LLM
+# shapes (MLA kernels are shape-stable and warm on first use)
+DEFAULT_SHAPES = [
+    (32, 8, 128),   # Llama-3-8B/70B
+    (32, 32, 128),  # MHA
+    (64, 8, 128),   # Qwen-72B-ish
+]
+
+
+def prewarm(
+    shapes: Optional[Sequence[Tuple[int, int, int]]] = None,
+    batch_sizes: Sequence[int] = (8, 64),
+    page_size: int = 16,
+    dtype=jnp.bfloat16,
+    verbose: bool = True,
+) -> int:
+    """Compile the core decode/prefill kernels for common configs into the
+    persistent cache.  Returns the number of configs compiled."""
+    from flashinfer_tpu import env
+    from flashinfer_tpu.decode import BatchDecodeWithPagedKVCacheWrapper
+    from flashinfer_tpu.prefill import single_prefill_with_kv_cache
+
+    env.enable_compilation_cache()
+    count = 0
+    for (hq, hkv, hd) in shapes or DEFAULT_SHAPES:
+        if hd <= 0 or hq % max(hkv, 1) != 0:
+            raise ValueError(f"invalid prewarm shape (hq={hq}, hkv={hkv}, hd={hd})")
+        for bs in batch_sizes:
+            pages_per = 64
+            indptr = np.arange(bs + 1, dtype=np.int32) * pages_per
+            indices = np.arange(bs * pages_per, dtype=np.int32)
+            last = np.full((bs,), page_size, np.int32)
+            kc = jnp.zeros((bs * pages_per, hkv, page_size, hd), dtype)
+            vc = jnp.zeros_like(kc)
+            q = jnp.zeros((bs, hq, hd), dtype)
+            w = BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+            w.plan(indptr, indices, last, hq, hkv, hd, page_size)
+            w.run(q, (kc, vc)).block_until_ready()
+            count += 1
+            if verbose:
+                print(f"prewarmed decode hq={hq} hkv={hkv} hd={hd} bs={bs}")
+        # one prefill shape per head config
+        T = 2048
+        q = jnp.zeros((T, hq, hd), dtype)
+        k = jnp.zeros((T, hkv, hd), dtype)
+        single_prefill_with_kv_cache(q, k, k, causal=True).block_until_ready()
+        count += 1
+        if verbose:
+            print(f"prewarmed prefill hq={hq} hkv={hkv} hd={hd} T={T}")
+    return count

@@ -87,11 +87,17 @@ class AutoTuner:
         ``runner(candidate)`` returns a nullary callable executing the op
         with that candidate; it is timed with ``block_until_ready``.
         Mirrors ``AutoTuner.choose_one`` (reference autotuner.py:1419)."""
+        from flashinfer_tpu.tactics_blocklist import blocked, filter_candidates
+
         self._load()
+        candidates = filter_candidates(op_name, list(candidates))
         key = f"{op_name}|{'_'.join(map(str, shape_key))}"
         if key in self._cache:
             val = self._cache[key]
-            return tuple(val) if isinstance(val, list) else val
+            # a later-blocklisted cached tactic must not be served
+            if not blocked(op_name, val):
+                return tuple(val) if isinstance(val, list) else val
+            del self._cache[key]
         if not self._tuning_enabled:
             return default if default is not None else candidates[0]
 

@@ -119,6 +119,37 @@ def mm_fp4(
     return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
+def mm_svdquant(
+    x: jax.Array,  # [m, k]
+    w_packed: jax.Array,  # [k//2, n] int8 block-int4, packed along k
+    w_scale: jax.Array,  # [k//block, n] f32
+    lora_down: jax.Array,  # [k, r] low-rank correction factors
+    lora_up: jax.Array,  # [r, n]
+    block_size: int = 16,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """SVDQuant linear (reference ``gemm_svdquant.py`` /
+    nvfp4_svdquant_gemm): 4-bit weight matmul plus a low-rank (LoRA-style)
+    correction of the quantization error:
+    ``out = x @ dequant(w) + (x @ lora_down) @ lora_up``."""
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    w = dequantize_fp4(
+        jnp.swapaxes(w_packed, 0, 1), jnp.swapaxes(w_scale, 0, 1), block_size
+    )
+    w = jnp.swapaxes(w, 0, 1)
+    main = jnp.dot(
+        x.astype(jnp.bfloat16), w, preferred_element_type=jnp.float32
+    )
+    corr = jnp.dot(
+        jnp.dot(x.astype(jnp.bfloat16), lora_down.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16),
+        lora_up.astype(jnp.bfloat16), preferred_element_type=jnp.float32,
+    )
+    return (main + corr).astype(out_dtype)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def grouped_gemm(
     x: jax.Array,  # [total_m, k] ragged rows

@@ -1,0 +1,91 @@
+"""AOT prewarm, tactics blocklist, SVDQuant GEMM tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+
+
+def test_prewarm_compiles(monkeypatch, tmp_path):
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    from flashinfer_tpu.aot import prewarm
+
+    n = prewarm(shapes=[(8, 2, 64)], batch_sizes=(8,), verbose=False)
+    assert n == 2  # one decode config + one prefill config
+
+
+def test_blocklist(monkeypatch, tmp_path):
+    from flashinfer_tpu import tactics_blocklist as tb
+
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps([{"op": "flash", "tactic": [256, 512]}]))
+    monkeypatch.setenv("FLASHINFER_TPU_TACTICS_BLOCKLIST", str(bl))
+    assert tb.blocked("flash", (256, 512))
+    assert not tb.blocked("flash", (128, 128))
+    assert tb.filter_candidates("flash", [(256, 512), (128, 128)]) == [(128, 128)]
+    # everything blocked -> keep first (never empty)
+    assert tb.filter_candidates("flash", [(256, 512)]) == [(256, 512)]
+
+
+def test_autotuner_respects_blocklist(monkeypatch, tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps([{"op": "myop", "tactic": [64]}]))
+    monkeypatch.setenv("FLASHINFER_TPU_TACTICS_BLOCKLIST", str(bl))
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    import flashinfer_tpu.autotuner as at
+
+    at.AutoTuner._instance = None
+    tuner = at.AutoTuner.get()
+    got = tuner.choose_one("myop", (1,), [(64,), (128,)], lambda c: lambda: None)
+    assert got == (128,)  # blocked default candidate skipped
+    at.AutoTuner._instance = None
+
+
+def test_mm_svdquant_recovers_low_rank_error():
+    """With the LoRA factors set to the SVD of the quant error, svdquant
+    beats plain fp4 matmul accuracy."""
+    rng = np.random.default_rng(0)
+    m, k, n, r = 16, 64, 32, 8
+    x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    wp, ws = fi.quantize_fp4(jnp.asarray(w.T))
+    wp_k, ws_k = jnp.swapaxes(wp, 0, 1), jnp.swapaxes(ws, 0, 1)
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    w_deq = np.asarray(
+        dequantize_fp4(wp, ws, out_dtype=jnp.float32)
+    ).T
+    err = w - w_deq
+    U, S, Vt = np.linalg.svd(err, full_matrices=False)
+    down = jnp.asarray(U[:, :r] * S[:r])
+    up = jnp.asarray(Vt[:r])
+
+    from flashinfer_tpu.gemm import mm_svdquant
+
+    out = mm_svdquant(x, wp_k, ws_k, down, up, out_dtype=jnp.float32)
+    ref = np.asarray(x) @ w
+    plain = np.asarray(x) @ w_deq
+    err_svdq = np.abs(np.asarray(out) - ref).mean()
+    err_plain = np.abs(plain - ref).mean()
+    assert err_svdq < err_plain * 0.9, (err_svdq, err_plain)
+
+
+def test_cli_prewarm(tmp_path):
+    import os, subprocess, sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["FLASHINFER_TPU_CACHE_DIR"] = str(tmp_path)
+    # tiny prewarm via module flag isn't exposed; just check command exists
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from flashinfer_tpu.__main__ import main; import sys; "
+         "sys.exit(0 if callable(main) else 1)"],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
