@@ -95,6 +95,7 @@ from flashinfer_tpu.norm import (  # noqa: F401
 )
 from flashinfer_tpu.concat_ops import concat_mla_k, concat_mla_q  # noqa: F401
 from flashinfer_tpu.gdn import (  # noqa: F401
+    gdn_chunk_prefill,
     gdn_decode_step,
     gdn_prefill,
     kda_decode_step,
