@@ -39,13 +39,10 @@ def chip_peak_tbps() -> float:
     return 0.819
 
 
-def main():
+def _bench_decode(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
+                  head_dim=128, dtype=jnp.bfloat16):
     import flashinfer_tpu as fi
     from flashinfer_tpu.testing import bench_fn, attention_bytes
-
-    batch, ctx, page_size = 64, 4096, 16
-    num_qo_heads, num_kv_heads, head_dim = 32, 8, 128
-    dtype = jnp.bfloat16
 
     pages_per_req = ctx // page_size
     num_pages = batch * pages_per_req
@@ -71,12 +68,30 @@ def main():
     w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads, head_dim, page_size)
 
     t = bench_fn(lambda: w.run(q, (kc, vc)), warmup=5, iters=30)
-
-    total_bytes = sum(
-        attention_bytes(1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2)
-        for _ in range(batch)
+    total_bytes = batch * attention_bytes(
+        1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2
     )
     tbps = total_bytes / t / 1e12
+    toks_per_s = batch / t
+    return t, tbps, toks_per_s
+
+
+def main():
+    sweep = "--sweep" in sys.argv
+    headline = None
+    if sweep:
+        # the reference bench_batch_decode.py sweep grid (bs x seqlen)
+        for bs in (1, 16, 64, 256):
+            for ctx in (512, 2048, 4096, 8192):
+                t, tbps, tps = _bench_decode(bs, ctx)
+                if (bs, ctx) == (64, 4096):
+                    headline = (t, tbps)
+                print(
+                    f"# bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
+                    f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s",
+                    file=sys.stderr,
+                )
+    t, tbps = headline if headline else _bench_decode(64, 4096)[:2]
     peak = chip_peak_tbps()
     print(
         json.dumps(

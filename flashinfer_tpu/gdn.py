@@ -118,8 +118,13 @@ def gdn_chunk_prefill(
     D = jnp.exp(acum)
     Dtot = jnp.exp(acum[:, :, -1])  # [B,nC,H]
 
-    # decay ratio matrix R[i,j] = D_i / D_j (i >= j)
-    R = jnp.exp(acum[:, :, :, None, :] - acum[:, :, None, :, :])  # [B,nC,Q,Q,H]
+    # decay ratio matrix R[i,j] = D_i / D_j (i >= j), computed in log space
+    # (linear-space D_j underflows fp32 for strong decay over long chunks);
+    # the used (lower) triangle has non-positive log-diffs, so clamping at 0
+    # removes the masked upper triangle's overflow (and its NaN under grad)
+    R = jnp.exp(
+        jnp.minimum(acum[:, :, :, None, :] - acum[:, :, None, :, :], 0.0)
+    )  # [B,nC,Q,Q,H]
     kk = jnp.einsum("bnjhd,bnihd->bnijh", kf, kf)  # k_j . k_i at [i,j]
     strict = jnp.tril(jnp.ones((Q, Q), bool), -1)
     C = jnp.where(
@@ -148,11 +153,13 @@ def gdn_chunk_prefill(
     Uv = jnp.moveaxis(Uv, 2, 3)
     Us = jnp.moveaxis(Us, 2, 3)
 
-    # per-chunk constant tensors for the boundary-state scan
-    w = kf / jnp.maximum(D[..., None], 1e-30)  # k_j / D_j
+    # per-chunk constant tensors for the boundary-state scan; the ratio
+    # Dtot/D_j is exp(acum_Q - acum_j) in log space (underflow-safe)
+    ratio = jnp.exp(acum[:, :, -1:, :] - acum)  # [B,nC,Q,H] = Dtot/D_j
+    wk = ratio[..., None] * kf  # (Dtot/D_j) k_j
     # S_chunk_v = sum_j (Dtot/D_j) k_j Uv_j^T ; transition uses Us likewise
-    Sv = jnp.einsum("bnjhd,bnjhe->bnhde", Dtot[:, :, None, :, None] * w, Uv)
-    Sm = jnp.einsum("bnjhd,bnjhe->bnhde", Dtot[:, :, None, :, None] * w, Us)
+    Sv = jnp.einsum("bnjhd,bnjhe->bnhde", wk, Uv)
+    Sm = jnp.einsum("bnjhd,bnjhe->bnhde", wk, Us)
     # q-side attention pieces
     qk = jnp.einsum("bnjhd,bnihd->bnijh", kf, qf)  # k_j . q_i at [i,j]
     causal = jnp.tril(jnp.ones((Q, Q), bool))

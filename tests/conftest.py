@@ -13,15 +13,21 @@ mesh.
 
 import os
 
-# Must happen before jax initializes a backend.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must happen before jax initializes a backend.  Set
+# FLASHINFER_TPU_TEST_ON_TPU=1 to run the suite against real hardware
+# (enables the tpu_only smoke tests; the devices_8 mesh tests then skip).
+_ON_TPU = os.environ.get("FLASHINFER_TPU_TEST_ON_TPU", "0") == "1"
+if not _ON_TPU:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,77 @@
+"""Kernel coverage across head dims, window/soft-cap in paged decode, and
+asymmetric vo dims (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.ops import paged_decode_attention, xla_paged_decode
+from flashinfer_tpu.testing import attention_ref
+
+
+@pytest.mark.parametrize("head_dim", [64, 128, 256])
+def test_flash_head_dims(head_dim):
+    from flashinfer_tpu.ops import flash_attention
+
+    T, H, KVH = 64, 2, 1
+    q = jax.random.normal(jax.random.PRNGKey(0), (T, H, head_dim))
+    k = jax.random.normal(jax.random.PRNGKey(1), (T, KVH, head_dim))
+    v = jax.random.normal(jax.random.PRNGKey(2), (T, KVH, head_dim))
+    seg = jnp.zeros((T,), jnp.int32)
+    pos = jnp.arange(T)
+    sm = 1 / np.sqrt(head_dim)
+    out = flash_attention(q, k, v, seg, seg, pos, pos, causal=True, sm_scale=sm,
+                          block_q=32, block_kv=32)
+    ref = attention_ref(q, k, v, causal=True, sm_scale=sm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_asymmetric_vo_dim():
+    """head_dim_qk != head_dim_vo (the MLA ragged shape)."""
+    from flashinfer_tpu.ops import flash_attention
+
+    T, H = 32, 2
+    q = jax.random.normal(jax.random.PRNGKey(0), (T, H, 96))
+    k = jax.random.normal(jax.random.PRNGKey(1), (T, H, 96))
+    v = jax.random.normal(jax.random.PRNGKey(2), (T, H, 64))
+    seg = jnp.zeros((T,), jnp.int32)
+    pos = jnp.arange(T)
+    out = flash_attention(q, k, v, seg, seg, pos, pos, causal=False, sm_scale=0.1,
+                          block_q=32, block_kv=32)
+    assert out.shape == (T, H, 64)
+    ref = attention_ref(q, k, v, sm_scale=0.1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("window_left,soft_cap", [(16, 0.0), (-1, 20.0), (8, 15.0)])
+def test_paged_decode_window_softcap(window_left, soft_cap):
+    B, HQ, HKV, D, PS, P = 2, 4, 2, 64, 8, 4
+    kc = jax.random.normal(jax.random.PRNGKey(0), (16, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (16, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.arange(8, dtype=jnp.int32).reshape(B, P)
+    lens = jnp.array([30, 25], jnp.int32)
+    o = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, window_left=window_left,
+        logits_soft_cap=soft_cap, kv_layout="HND",
+    )
+    ref = xla_paged_decode(
+        q, jnp.swapaxes(kc, 1, 2), jnp.swapaxes(vc, 1, 2), pt, lens,
+        sm_scale=0.125, window_left=window_left, logits_soft_cap=soft_cap,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_paged_decode_zero_len_request():
+    """kv_len == 0 must produce zeros, not NaN."""
+    B, HQ, HKV, D, PS, P = 2, 4, 2, 64, 8, 2
+    kc = jax.random.normal(jax.random.PRNGKey(0), (8, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (8, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.zeros((B, P), jnp.int32)
+    lens = jnp.array([0, 10], jnp.int32)
+    o = paged_decode_attention(q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND")
+    assert np.isfinite(np.asarray(o)).all()
+    np.testing.assert_allclose(np.asarray(o[0]), 0.0, atol=1e-6)

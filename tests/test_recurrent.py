@@ -103,7 +103,9 @@ def test_gdn_chunk_prefill_matches_sequential():
     mk = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
     q, k, v = mk(B, L, H, dk), mk(B, L, H, dk), mk(B, L, H, dv)
     k = k / jnp.linalg.norm(k, axis=-1, keepdims=True)
-    alpha = jnp.asarray(rng.uniform(0.6, 1.0, (B, L, H)).astype(np.float32))
+    # include strong decay: exercises the log-space ratio path (linear-space
+    # D_j underflows fp32 at alpha~0.2 over a 32-long chunk)
+    alpha = jnp.asarray(rng.uniform(0.15, 1.0, (B, L, H)).astype(np.float32))
     beta = jnp.asarray(rng.uniform(0.1, 0.9, (B, L, H)).astype(np.float32))
     s0 = mk(B, H, dk, dv) * 0.3
     y1, f1 = fi.gdn_prefill(q, k, v, alpha, beta, initial_state=s0)
