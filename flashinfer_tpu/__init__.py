@@ -56,7 +56,10 @@ from flashinfer_tpu.attention import (  # noqa: F401
     PODWithPagedKVCacheWrapper,
     apply_attention_sink,
 )
-from flashinfer_tpu.mla import BatchMLAPagedAttentionWrapper  # noqa: F401
+from flashinfer_tpu.mla import (  # noqa: F401
+    BatchDecodeMlaWithPagedKVCacheWrapper,
+    BatchMLAPagedAttentionWrapper,
+)
 from flashinfer_tpu.topk import (  # noqa: F401
     top_k_indices,
     top_k_mask,

@@ -223,6 +223,10 @@ class BatchMLAPagedAttentionWrapper:
         pass
 
 
+# legacy alias kept by the reference for its earlier MLA API generation
+BatchDecodeMlaWithPagedKVCacheWrapper = BatchMLAPagedAttentionWrapper
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale", "return_lse"))
 def _sparse_mla_decode(
     q_nope, q_pe, ckv_cache, kpe_cache, sparse_rows,
