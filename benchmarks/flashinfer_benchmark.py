@@ -1,0 +1,189 @@
+"""Unified benchmark harness.
+
+TPU re-design of the reference's ``benchmarks/flashinfer_benchmark.py`` +
+``benchmarks/routines/`` (SURVEY §2.7/L5): one CLI spanning the op families,
+emitting CSV rows of latency / TFLOPS / TB/s.
+
+    python benchmarks/flashinfer_benchmark.py --routine decode \
+        --batch 64 --ctx 4096 [--csv out.csv]
+    python benchmarks/flashinfer_benchmark.py --routine all --quick
+
+Routines: decode (paged batch decode), prefill (causal ragged), gemm
+(bf16 square), moe (fused MoE forward), sampling (top-k/top-p over 128k
+vocab).  Runs on whatever backend jax selects (TPU on hardware; CPU with
+the xla backend elsewhere — pass --quick for CI-sized shapes).
+"""
+
+import argparse
+import csv
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _rows_decode(args):
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import attention_bytes, bench_fn
+
+    dtype = jnp.bfloat16
+    hq, hkv, hd, ps = args.num_qo_heads, args.num_kv_heads, args.head_dim, 16
+    for bs in args.batch:
+        for ctx in args.ctx:
+            ppr = ctx // ps
+            npages = bs * ppr
+            indptr = np.arange(bs + 1, dtype=np.int32) * ppr
+            idx = np.random.default_rng(0).permutation(npages).astype(np.int32)
+            last = np.full((bs,), ps, np.int32)
+            kc = jax.random.normal(jax.random.PRNGKey(0), (npages, hkv, ps, hd), dtype)
+            vc = jax.random.normal(jax.random.PRNGKey(1), (npages, hkv, ps, hd), dtype)
+            q = jax.random.normal(jax.random.PRNGKey(2), (bs, hq, hd), dtype)
+            w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+            w.plan(indptr, idx, last, hq, hkv, hd, ps)
+            t = bench_fn(lambda: w.run(q, (kc, vc)), warmup=3, iters=args.iters)
+            tb = bs * attention_bytes(1, ctx, hq, hkv, hd, hd, 2) / t / 1e12
+            yield dict(routine="decode", config=f"bs{bs}_ctx{ctx}",
+                       latency_us=t * 1e6, tbps=tb, tflops="")
+
+
+def _rows_prefill(args):
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import attention_flops, bench_fn
+
+    dtype = jnp.bfloat16
+    hq, hkv, hd = args.num_qo_heads, args.num_kv_heads, args.head_dim
+    for ctx in args.ctx:
+        q = jax.random.normal(jax.random.PRNGKey(0), (ctx, hq, hd), dtype)
+        k = jax.random.normal(jax.random.PRNGKey(1), (ctx, hkv, hd), dtype)
+        v = jax.random.normal(jax.random.PRNGKey(2), (ctx, hkv, hd), dtype)
+        t = bench_fn(
+            lambda: fi.single_prefill_with_kv_cache(q, k, v, causal=True),
+            warmup=3, iters=args.iters,
+        )
+        fl = attention_flops(ctx, ctx, hq, hd, hd, causal=True)
+        yield dict(routine="prefill", config=f"ctx{ctx}",
+                   latency_us=t * 1e6, tbps="", tflops=fl / t / 1e12)
+
+
+def _rows_gemm(args):
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import bench_fn
+
+    for n in args.gemm_sizes:
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+        t = bench_fn(lambda: fi.mm_bf16(a, b), warmup=3, iters=args.iters)
+        yield dict(routine="gemm_bf16", config=f"{n}x{n}x{n}",
+                   latency_us=t * 1e6, tbps="", tflops=2 * n**3 / t / 1e12)
+
+
+def _rows_moe(args):
+    import jax
+    import jax.numpy as jnp
+    from flashinfer_tpu.fused_moe import fused_moe, route_renormalize
+    from flashinfer_tpu.testing import bench_fn
+
+    T, E, K = args.moe_tokens, args.moe_experts, 2
+    h, inter = args.moe_hidden, 4 * args.moe_hidden
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h), jnp.bfloat16)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (E, h, 2 * inter), jnp.bfloat16)
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h), jnp.bfloat16)
+    logits = jax.random.normal(jax.random.PRNGKey(3), (T, E))
+    wts, ids = route_renormalize(logits, K)
+    t = bench_fn(lambda: fused_moe(x, w1, w2, wts, ids, E), warmup=3,
+                 iters=args.iters)
+    fl = 2 * T * K * (h * 2 * inter + inter * h)
+    yield dict(routine="moe", config=f"T{T}_E{E}_h{h}",
+               latency_us=t * 1e6, tbps="", tflops=fl / t / 1e12)
+
+
+def _rows_sampling(args):
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import bench_fn
+
+    bs, vocab = args.sampling_batch, args.vocab
+    logits = jax.random.normal(jax.random.PRNGKey(0), (bs, vocab))
+    key = jax.random.PRNGKey(1)
+    t = bench_fn(
+        lambda: fi.top_k_top_p_sampling_from_logits(logits, key, 40, 0.9),
+        warmup=3, iters=args.iters,
+    )
+    yield dict(routine="sampling_topk_topp", config=f"bs{bs}_v{vocab}",
+               latency_us=t * 1e6, tbps="", tflops="")
+
+
+ROUTINES = {
+    "decode": _rows_decode,
+    "prefill": _rows_prefill,
+    "gemm": _rows_gemm,
+    "moe": _rows_moe,
+    "sampling": _rows_sampling,
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--routine", default="all",
+                   choices=["all"] + sorted(ROUTINES))
+    p.add_argument("--batch", type=int, nargs="+", default=[64])
+    p.add_argument("--ctx", type=int, nargs="+", default=[4096])
+    p.add_argument("--num-qo-heads", type=int, default=32)
+    p.add_argument("--num-kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--gemm-sizes", type=int, nargs="+", default=[4096])
+    p.add_argument("--moe-tokens", type=int, default=512)
+    p.add_argument("--moe-experts", type=int, default=32)
+    p.add_argument("--moe-hidden", type=int, default=1024)
+    p.add_argument("--sampling-batch", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=128256)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--quick", action="store_true",
+                   help="CI-sized shapes (CPU-friendly)")
+    p.add_argument("--csv", default=None)
+    args = p.parse_args(argv)
+    if args.quick:
+        args.batch, args.ctx = [2], [256]
+        args.gemm_sizes = [256]
+        args.moe_tokens, args.moe_experts, args.moe_hidden = 16, 4, 64
+        args.sampling_batch, args.vocab = 4, 1024
+        args.iters = 3
+
+    names = sorted(ROUTINES) if args.routine == "all" else [args.routine]
+    rows = []
+    for name in names:
+        for row in ROUTINES[name](args):
+            rows.append(row)
+            print(
+                f"{row['routine']:>18} {row['config']:>16} "
+                f"{row['latency_us']:10.1f} us"
+                + (f"  {row['tbps']:.3f} TB/s" if row["tbps"] != "" else "")
+                + (f"  {row['tflops']:.2f} TFLOPS" if row["tflops"] != "" else "")
+            )
+    if args.csv:
+        with open(args.csv, "w", newline="") as f:
+            wr = csv.DictWriter(
+                f, fieldnames=["routine", "config", "latency_us", "tbps", "tflops"]
+            )
+            wr.writeheader()
+            wr.writerows(rows)
+        print(f"wrote {len(rows)} rows to {args.csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    import jax
+
+    if jax.default_backend() == "cpu" or "--cpu" in sys.argv:
+        pass
+    sys.exit(main([a for a in sys.argv[1:] if a != "--cpu"]))
