@@ -2,6 +2,7 @@ from flashinfer_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     init_llama_params,
     llama_decode_step,
+    make_cp_prefill_step,
     make_pp_sharded_decode_step,
     make_sharded_decode_step,
     stack_layer_params,

@@ -164,6 +164,33 @@ def llama_decode_step(
     return logits, new_caches
 
 
+
+def _tp_param_specs(cfg: LlamaConfig, tp: str, layer_leading=None):
+    """Shared TP weight-sharding spec table (column-shard q/k/v/gate/up,
+    row-shard o/down); ``layer_leading`` prepends an axis (pp layer stacks)."""
+    def lp(*axes):
+        return P(layer_leading, *axes) if layer_leading else P(*axes)
+
+    layer = dict(
+        input_norm=lp(None),
+        q_proj=lp(None, tp), k_proj=lp(None, tp), v_proj=lp(None, tp),
+        o_proj=lp(tp, None),
+        post_norm=lp(None),
+        gate_proj=lp(None, tp), up_proj=lp(None, tp),
+        down_proj=lp(tp, None),
+    )
+    return layer
+
+
+def _check_head_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
+    assert cfg.num_qo_heads % tp_size == 0, (
+        f"num_qo_heads {cfg.num_qo_heads} not divisible by tp {tp_size}"
+    )
+    assert cfg.num_kv_heads % tp_size == 0, (
+        f"num_kv_heads {cfg.num_kv_heads} not divisible by tp {tp_size}"
+    )
+
+
 def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     """Build a jitted dp x tp sharded decode step via shard_map.
 
@@ -177,7 +204,7 @@ def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     PartitionSpec."""
     mesh = mesh or mapping.make_mesh()
     tp, dp = Mapping.AXIS_TP, Mapping.AXIS_DP
-    assert cfg.num_kv_heads % mapping.tp_size == 0
+    _check_head_divisibility(cfg, mapping.tp_size)
     qh_l = cfg.num_qo_heads // mapping.tp_size
     kvh_l = cfg.num_kv_heads // mapping.tp_size
 
@@ -185,17 +212,7 @@ def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         embed=P(None, None),
         final_norm=P(None),
         lm_head=P(None, tp),
-        layers=[
-            dict(
-                input_norm=P(None),
-                q_proj=P(None, tp), k_proj=P(None, tp), v_proj=P(None, tp),
-                o_proj=P(tp, None),
-                post_norm=P(None),
-                gate_proj=P(None, tp), up_proj=P(None, tp),
-                down_proj=P(tp, None),
-            )
-            for _ in range(cfg.num_layers)
-        ],
+        layers=[_tp_param_specs(cfg, tp) for _ in range(cfg.num_layers)],
     )
     cache_spec = [(P(dp, None, tp, None, None), P(dp, None, tp, None, None))
                   for _ in range(cfg.num_layers)]
@@ -260,6 +277,84 @@ def stack_layer_params(params: Dict) -> Dict:
     return out
 
 
+def make_cp_prefill_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
+    """dp x cp x tp sharded PREFILL step: sequence sharded over the
+    context-parallel axis with **ring attention** (parallel/attention.py),
+    heads sharded over tp with fused-AR collectives — the long-context
+    configuration (SURVEY §5: ring/Ulysses SP is first-class).
+
+    Processes a [B, S] token block; returns sequence-sharded logits and the
+    per-layer K/V (for cache append by the caller).  Causal over the global
+    sequence (ring handles cross-chunk masking via positions).
+    """
+    mesh = mesh or mapping.make_mesh()
+    tp, dp, cp = Mapping.AXIS_TP, Mapping.AXIS_DP, Mapping.AXIS_CP
+    _check_head_divisibility(cfg, mapping.tp_size)
+    qh_l = cfg.num_qo_heads // mapping.tp_size
+    kvh_l = cfg.num_kv_heads // mapping.tp_size
+
+    param_specs = dict(
+        embed=P(None, None),
+        final_norm=P(None),
+        lm_head=P(None, tp),
+        layers=[_tp_param_specs(cfg, tp) for _ in range(cfg.num_layers)],
+    )
+    in_specs = (param_specs, P(dp, cp))  # tokens [B, S] seq-sharded over cp
+    kv_spec = [(P(dp, cp, tp, None), P(dp, cp, tp, None))
+               for _ in range(cfg.num_layers)]
+    out_specs = (P(dp, cp, tp), kv_spec)
+
+    from flashinfer_tpu.parallel.attention import ring_attention
+
+    def step(params, tokens):
+        B, S_local = tokens.shape
+        me = jax.lax.axis_index(cp)
+        pos = (me * S_local + jnp.arange(S_local, dtype=jnp.int32))
+        x = params["embed"][tokens].astype(cfg.dtype)  # [B, S_local, h]
+        kvs = []
+        for layer in params["layers"]:
+            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+            q = (h @ layer["q_proj"]).reshape(B, S_local, qh_l, cfg.head_dim)
+            k = (h @ layer["k_proj"]).reshape(B, S_local, kvh_l, cfg.head_dim)
+            v = (h @ layer["v_proj"]).reshape(B, S_local, kvh_l, cfg.head_dim)
+            qr, kr = jax.vmap(
+                lambda qq, kk: apply_rope_pos_ids(
+                    qq, kk, pos, rope_theta=cfg.rope_theta
+                )
+            )(q, k)
+            attn = jax.vmap(
+                lambda qq, kk, vv: ring_attention(
+                    qq, kk, vv, axis=cp, causal=True,
+                    sm_scale=1.0 / float(cfg.head_dim) ** 0.5,
+                )
+            )(qr, kr, v)
+            kvs.append((kr, v))
+            o_partial = attn.reshape(B, S_local, qh_l * cfg.head_dim) @ layer["o_proj"]
+            h2, x = allreduce_fusion(
+                o_partial, residual=x, rms_weight=layer["post_norm"],
+                eps=cfg.rms_eps, axis=tp,
+            )
+            h2 = h2.astype(cfg.dtype)
+            mlp_in = jnp.concatenate(
+                [h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1
+            )
+            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            (x,) = allreduce_fusion(d_partial, residual=x, axis=tp)
+        x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        return logits, kvs
+
+    sharded = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    return sharded, mesh, dict(
+        params=param_specs, tokens=P(dp, cp), kv=kv_spec
+    )
+
+
 def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     """dp x tp x pp sharded decode step.
 
@@ -277,19 +372,12 @@ def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     mesh = mesh or mapping.make_mesh()
     tp, dp, pp = Mapping.AXIS_TP, Mapping.AXIS_DP, Mapping.AXIS_PP
     assert cfg.num_layers % mapping.pp_size == 0
-    assert cfg.num_kv_heads % mapping.tp_size == 0
+    _check_head_divisibility(cfg, mapping.tp_size)
     qh_l = cfg.num_qo_heads // mapping.tp_size
     kvh_l = cfg.num_kv_heads // mapping.tp_size
     pp_size = mapping.pp_size
 
-    layer_specs = dict(
-        input_norm=P(pp, None),
-        q_proj=P(pp, None, tp), k_proj=P(pp, None, tp), v_proj=P(pp, None, tp),
-        o_proj=P(pp, tp, None),
-        post_norm=P(pp, None),
-        gate_proj=P(pp, None, tp), up_proj=P(pp, None, tp),
-        down_proj=P(pp, tp, None),
-    )
+    layer_specs = _tp_param_specs(cfg, tp, layer_leading=pp)
     param_specs = dict(
         embed=P(None, None), final_norm=P(None), lm_head=P(None, tp),
         layers=layer_specs,
