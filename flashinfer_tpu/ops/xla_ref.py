@@ -127,3 +127,54 @@ def xla_paged_decode(
         lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
         return out, lse
     return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "block_size", "return_lse"),
+)
+def xla_fp4_paged_decode(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim]
+    k_cache_packed: jax.Array,  # [pages, page_size, Hkv, head_dim//2] int8
+    k_scales: jax.Array,  # [pages, page_size, Hkv, head_dim//block] f32
+    v_cache_packed: jax.Array,
+    v_scales: jax.Array,
+    page_table: jax.Array,  # [batch, max_pages]
+    kv_lens: jax.Array,
+    *,
+    sm_scale: float,
+    block_size: int = 16,
+    return_lse: bool = False,
+):
+    """Paged decode over a block-int4 ("fp4-class") KV cache: gathered pages
+    are dequantized in-register to bf16 then attended — the v5 mapping of
+    the reference's NVFP4-KV attention (nvfp4_attention_sm120).  Cache
+    footprint: 0.5 B/elem + scales (4x smaller than bf16)."""
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    kg = dequantize_fp4(
+        k_cache_packed[page_table], k_scales[page_table], block_size
+    )
+    vg = dequantize_fp4(
+        v_cache_packed[page_table], v_scales[page_table], block_size
+    )
+    batch = q.shape[0]
+    kg = kg.reshape(batch, -1, kg.shape[-2], kg.shape[-1])
+    vg = vg.reshape(batch, -1, vg.shape[-2], vg.shape[-1])
+    # dense masked attention over the gathered window
+    num_kv_heads = kg.shape[2]
+    group = q.shape[1] // num_kv_heads
+    kf = jnp.repeat(kg.astype(jnp.float32), group, axis=2)
+    vf = jnp.repeat(vg.astype(jnp.float32), group, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kf) * sm_scale
+    mask = jnp.arange(kf.shape[1])[None, :] < kv_lens[:, None]
+    s = jnp.where(mask[:, None], s, _NEG_INF)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(mask[:, None], jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, -1, keepdims=True)
+    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vf)
+    out = out.astype(q.dtype)
+    if return_lse:
+        lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
+        return out, lse
+    return out

@@ -139,6 +139,44 @@ def append_paged_mla_kv_cache(
     return cflat.reshape(ckv_cache.shape), pflat.reshape(kpe_cache.shape)
 
 
+@functools.partial(jax.jit, static_argnames=("kv_layout",))
+def append_paged_kv_cache_quant_fp8(
+    append_key: jax.Array,  # [nnz, num_kv_heads, head_dim] high precision
+    append_value: jax.Array,
+    batch_indices: jax.Array,
+    positions: jax.Array,
+    paged_kv_cache: Tuple[jax.Array, jax.Array],  # fp8 caches
+    kv_indices: jax.Array,
+    kv_indptr: jax.Array,
+    k_scale: jax.Array,  # scalar f32: high_precision = fp8 * scale
+    v_scale: jax.Array,
+    kv_layout: str = "NHD",
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused quantize-and-append into an fp8 paged cache (the reference's
+    quantizing-append path, fp4_kv_quantization.cu / rope-quantize-append
+    family, mapped to the v5 fp8-storage story): new K/V rows are divided by
+    the running scales, saturating-cast to the cache dtype, and scattered.
+    Decode then folds the same scales back in via run(k_scale=, v_scale=)."""
+    k_cache, v_cache = paged_kv_cache
+    finfo = jnp.finfo(k_cache.dtype)
+    kq = jnp.clip(
+        append_key.astype(jnp.float32) / k_scale, float(finfo.min),
+        float(finfo.max),
+    ).astype(k_cache.dtype)
+    vq = jnp.clip(
+        append_value.astype(jnp.float32) / v_scale, float(finfo.min),
+        float(finfo.max),
+    ).astype(v_cache.dtype)
+    layout = check_kv_layout(kv_layout)
+    page_size = (
+        k_cache.shape[1] if layout == TensorLayout.NHD else k_cache.shape[2]
+    )
+    return _append_impl(
+        kq, vq, batch_indices, positions, k_cache, v_cache,
+        kv_indices, kv_indptr, kv_layout, page_size,
+    )
+
+
 def block_sparse_indices_to_vector_sparse_offsets(
     block_indices: jax.Array,
     indptr: jax.Array,
