@@ -118,6 +118,7 @@ class _DecodePlan:
     sm_scale: float
     logits_soft_cap: float
     window_left: int
+    q_data_type: object = None
 
 
 class BatchDecodeWithPagedKVCacheWrapper:
@@ -154,7 +155,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
         pos_encoding_mode: str = "NONE",
         window_left: int = -1,
         logits_soft_cap: Optional[float] = None,
-        q_data_type=jnp.bfloat16,
+        q_data_type=None,  # when given, run() validates q.dtype against it
         kv_data_type=None,
         data_type=None,
         sm_scale: Optional[float] = None,
@@ -194,6 +195,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
             sm_scale=get_sm_scale(head_dim, sm_scale),
             logits_soft_cap=logits_soft_cap or 0.0,
             window_left=window_left,
+            q_data_type=jnp.dtype(q_data_type) if q_data_type else None,
         )
 
     def run(
@@ -220,6 +222,19 @@ class BatchDecodeWithPagedKVCacheWrapper:
         assert batch == plan.batch_size, (
             f"q batch {batch} != planned {plan.batch_size}"
         )
+        if (
+            plan.num_qo_heads != q.shape[1]
+            or plan.head_dim != q.shape[2]
+        ):
+            raise ValueError(
+                f"q shape {q.shape[1:]} != planned heads/dim "
+                f"({plan.num_qo_heads}, {plan.head_dim})"
+            )
+        if plan.q_data_type is not None and q.dtype != plan.q_data_type:
+            raise ValueError(
+                f"q dtype {q.dtype} != planned q_data_type "
+                f"{plan.q_data_type} (reference decode.py:1916 validation)"
+            )
         sm_scale = plan.sm_scale
         if q_scale is not None:
             sm_scale *= q_scale

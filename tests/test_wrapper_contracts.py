@@ -1,0 +1,66 @@
+"""Wrapper contract tests: bounded recompiles (bucketing) + dtype/shape
+validation — the plan/run lifecycle properties serving engines rely on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.ops import xla_paged_decode
+
+
+def _plan_run(w, kv_lens, HQ=4, HKV=2, D=64, PS=8, q_dtype=jnp.float32):
+    pages_per = [-(-l // PS) for l in kv_lens]
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = np.arange(indptr[-1], dtype=np.int32)
+    last = np.array(
+        [l - (p - 1) * PS for l, p in zip(kv_lens, pages_per)], np.int32
+    )
+    w.plan(indptr, indices, last, HQ, HKV, D, PS)
+    # fixed-size page pool, as in real serving (cache shape must not vary)
+    npages = 64
+    kc = jax.random.normal(jax.random.PRNGKey(0), (npages, PS, HKV, D), q_dtype)
+    vc = jax.random.normal(jax.random.PRNGKey(1), (npages, PS, HKV, D), q_dtype)
+    q = jax.random.normal(jax.random.PRNGKey(2), (len(kv_lens), HQ, D), q_dtype)
+    return w.run(q, (kc, vc))
+
+
+def test_bucketing_bounds_recompiles():
+    """Geometries inside the same power-of-two bucket reuse one executable."""
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(backend="xla")
+    base = xla_paged_decode._cache_size()
+    _plan_run(w, [10, 20, 30])       # batch 3 -> bucket 8, pages -> bucket 4
+    after_first = xla_paged_decode._cache_size()
+    _plan_run(w, [31, 7, 12, 25, 9])  # batch 5 -> same batch bucket 8
+    _plan_run(w, [5, 5, 5, 5, 5, 5])  # batch 6 -> same bucket
+    after_same_bucket = xla_paged_decode._cache_size()
+    assert after_first > base
+    assert after_same_bucket == after_first, "same bucket must not recompile"
+    _plan_run(w, [10] * 12)           # batch 12 -> bucket 16: one new compile
+    assert xla_paged_decode._cache_size() == after_first + 1
+
+
+def test_run_validates_dtype_when_planned():
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(backend="xla")
+    PS, HQ, HKV, D = 8, 4, 2, 64
+    indptr = np.array([0, 1], np.int32)
+    w.plan(indptr, np.array([0], np.int32), np.array([4], np.int32),
+           HQ, HKV, D, PS, q_data_type=jnp.bfloat16)
+    kc = jnp.zeros((1, PS, HKV, D), jnp.bfloat16)
+    q32 = jnp.zeros((1, HQ, D), jnp.float32)
+    with pytest.raises(ValueError, match="q_data_type"):
+        w.run(q32, (kc, kc))
+    # matching dtype passes
+    out = w.run(q32.astype(jnp.bfloat16), (kc, kc))
+    assert out.shape == (1, HQ, D)
+
+
+def test_run_validates_head_shape():
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(backend="xla")
+    PS, HQ, HKV, D = 8, 4, 2, 64
+    w.plan(np.array([0, 1], np.int32), np.array([0], np.int32),
+           np.array([4], np.int32), HQ, HKV, D, PS)
+    kc = jnp.zeros((1, PS, HKV, D), jnp.float32)
+    with pytest.raises(ValueError, match="planned heads"):
+        w.run(jnp.zeros((1, 8, D), jnp.float32), (kc, kc))
