@@ -101,6 +101,7 @@ from flashinfer_tpu.gdn import (  # noqa: F401
     gdn_chunk_prefill,
     gdn_decode_step,
     gdn_prefill,
+    kda_chunk_prefill,
     kda_decode_step,
     kda_prefill,
 )

@@ -115,6 +115,24 @@ def test_gdn_chunk_prefill_matches_sequential():
     np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-4)
 
 
+def test_kda_chunk_prefill_matches_sequential():
+    from flashinfer_tpu.gdn import kda_chunk_prefill
+
+    rng = np.random.default_rng(1)
+    B, L, H, dk, dv, Q = 2, 128, 2, 16, 8, 32
+    mk = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
+    q, k, v = mk(B, L, H, dk), mk(B, L, H, dk), mk(B, L, H, dv)
+    k = k / jnp.linalg.norm(k, axis=-1, keepdims=True)
+    alpha = jnp.asarray(rng.uniform(0.3, 1.0, (B, L, H, dk)).astype(np.float32))
+    beta = jnp.asarray(rng.uniform(0.1, 0.9, (B, L, H)).astype(np.float32))
+    s0 = mk(B, H, dk, dv) * 0.3
+    y1, f1 = fi.kda_prefill(q, k, v, alpha, beta, initial_state=s0)
+    y2, f2 = kda_chunk_prefill(q, k, v, alpha, beta, chunk_size=Q,
+                               initial_state=s0)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-4)
+
+
 def test_kda_per_channel_decay():
     B, H, dk, dv = 1, 1, 4, 4
     state = jnp.ones((B, H, dk, dv))
