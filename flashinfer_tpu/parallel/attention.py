@@ -60,6 +60,13 @@ def ulysses_attention(
 
     Requires num heads divisible by the axis size."""
     cp = jax.lax.axis_size(axis)
+    if q.shape[1] % cp or k.shape[1] % cp:
+        raise ValueError(
+            f"ulysses needs qo heads ({q.shape[1]}) and kv heads "
+            f"({k.shape[1]}) divisible by the {axis!r} axis size {cp}; "
+            "use ring attention (mode='ring') for GQA head counts below "
+            "the axis size"
+        )
     sm_scale = get_sm_scale(q.shape[-1], sm_scale)
     # [seq/cp, H, D] -> [seq, H/cp, D]
     qg = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=0, tiled=True)

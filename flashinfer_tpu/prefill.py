@@ -94,12 +94,12 @@ def single_prefill_with_kv_cache(
         jnp.arange(kv_len, dtype=jnp.int32),
     )
     if custom_mask is not None:
-        # MaskMode::CUSTOM semantics (reference prefill.py): the custom mask
-        # fully defines visibility — causal/window are ignored
+        # MaskMode::CUSTOM semantics (reference variants.cuh LogitsMask):
+        # the custom mask replaces causal, but sliding window still ANDs in
         return xla_ragged_attention(
-            *args, custom_mask=custom_mask, causal=False, window_left=-1,
-            sm_scale=sm_scale, logits_soft_cap=logits_soft_cap or 0.0,
-            return_lse=return_lse,
+            *args, custom_mask=custom_mask, causal=False,
+            window_left=window_left, sm_scale=sm_scale,
+            logits_soft_cap=logits_soft_cap or 0.0, return_lse=return_lse,
         )
     fn = flash_attention if backend == "pallas" else xla_ragged_attention
     return fn(
@@ -130,6 +130,7 @@ class _PrefillPlan:
     sm_scale: float
     logits_soft_cap: float
     window_left: int
+    custom_mask: Optional[jax.Array] = None  # [Tq_pad, Tkv_pad] bool (dense)
 
 
 def _build_token_axis(
@@ -167,6 +168,8 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         num_qo_heads: int,
         num_kv_heads: int,
         head_dim: int,
+        custom_mask=None,  # flat concat of per-request [qo_i*kv_i] bools
+        packed_custom_mask=None,  # packbits(LSB-first) form; takes precedence
         causal: bool = False,
         pos_encoding_mode: str = "NONE",
         window_left: int = -1,
@@ -191,6 +194,36 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_seg, kv_pos, total_kv = _build_token_axis(
             kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
         )
+        dense_mask = None
+        total_bits = int(np.sum(qo_lens * kv_lens))
+        if packed_custom_mask is not None:
+            # reference convention: packed takes precedence, LSB-first bits
+            flat = np.unpackbits(
+                np.asarray(packed_custom_mask).view(np.uint8),
+                bitorder="little",
+            )[:total_bits].astype(bool)
+            custom_mask = flat
+        if custom_mask is not None:
+            # expand the reference's flat per-request mask concat
+            # (MaskMode::CUSTOM: causal is ignored; window still applies)
+            flat = np.asarray(custom_mask).astype(bool).reshape(-1)
+            if flat.size != total_bits:
+                raise ValueError(
+                    f"custom_mask has {flat.size} bits; expected "
+                    f"sum(qo_len*kv_len) = {total_bits} (flat per-request "
+                    "concat, not a dense [total_q, total_kv] mask)"
+                )
+            dense = np.zeros((tq_pad, tkv_pad), bool)
+            off = 0
+            for r in range(batch):
+                qn, kn = int(qo_lens[r]), int(kv_lens[r])
+                dense[
+                    int(qo_indptr[r]) : int(qo_indptr[r]) + qn,
+                    int(kv_indptr[r]) : int(kv_indptr[r]) + kn,
+                ] = flat[off : off + qn * kn].reshape(qn, kn)
+                off += qn * kn
+            dense_mask = jnp.asarray(dense)
+            causal = False  # custom mask overrides causal (only)
         self._plan = _PrefillPlan(
             q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
             kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
@@ -202,6 +235,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             head_dim=head_dim, page_size=0,
             causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
             logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
+            custom_mask=dense_mask,
         )
 
     def run(
@@ -222,13 +256,25 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             k = jnp.pad(k, ((0, tkv - k.shape[0]), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, tkv - v.shape[0]), (0, 0), (0, 0)))
         backend = resolve_backend(self._backend, "batch_prefill_ragged")
-        fn = flash_attention if backend == "pallas" else xla_ragged_attention
-        out = fn(
-            q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
-            causal=plan.causal, sm_scale=plan.sm_scale,
-            logits_soft_cap=plan.logits_soft_cap,
-            window_left=plan.window_left, return_lse=return_lse,
-        )
+        if plan.custom_mask is not None:
+            # custom-mask mode runs on the dense xla backend; sliding window
+            # still ANDs in (reference variants.cuh LogitsMask — only causal
+            # is subsumed by the custom mask)
+            out = xla_ragged_attention(
+                q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+                causal=False, sm_scale=plan.sm_scale,
+                logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left,
+                return_lse=return_lse, custom_mask=plan.custom_mask,
+            )
+        else:
+            fn = flash_attention if backend == "pallas" else xla_ragged_attention
+            out = fn(
+                q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+                causal=plan.causal, sm_scale=plan.sm_scale,
+                logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, return_lse=return_lse,
+            )
         if return_lse:
             return out[0][: plan.total_q], out[1][: plan.total_q]
         return out[: plan.total_q]

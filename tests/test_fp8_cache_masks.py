@@ -70,6 +70,36 @@ def test_single_prefill_packed_custom_mask():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+def test_batch_prefill_custom_mask():
+    """Ragged batch prefill with per-request custom masks (reference
+    batch-prefill MaskMode::CUSTOM: flat concat of per-request masks)."""
+    HQ, HKV, D = 2, 2, 32
+    qo_lens, kv_lens = [4, 6], [8, 5]
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    kv_indptr = np.concatenate([[0], np.cumsum(kv_lens)])
+    rng = np.random.default_rng(0)
+    masks = [rng.random((q_, k_)) < 0.6 for q_, k_ in zip(qo_lens, kv_lens)]
+    for m in masks:
+        m[:, 0] = True
+    flat = np.concatenate([m.reshape(-1) for m in masks])
+    q = jax.random.normal(jax.random.PRNGKey(0), (10, HQ, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (13, HKV, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (13, HKV, D))
+    w = fi.BatchPrefillWithRaggedKVCacheWrapper()
+    w.plan(qo_indptr, kv_indptr, HQ, HKV, D, custom_mask=flat, causal=True)
+    out = w.run(q, k, v)
+    for r in range(2):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        ks, ke = kv_indptr[r], kv_indptr[r + 1]
+        ref = attention_ref(
+            q[qs:qe], k[ks:ke], v[ks:ke], custom_mask=jnp.asarray(masks[r])
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )
+
+
 def test_custom_mask_overrides_causal():
     """MaskMode::CUSTOM: causal=True is ignored when a custom mask is given
     (reference contract)."""
