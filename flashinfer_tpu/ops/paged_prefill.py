@@ -1,0 +1,309 @@
+"""Fused paged-KV batch prefill Pallas kernel (work-unit scheduled).
+
+The TPU translation of the reference's prefill work queue
+(``PrefillPlan``/``PrefillSplitQOKVIndptr``, scheduler.cuh:545-897 +
+``BatchPrefillWithPagedKVCacheDispatched``, prefill.cuh:4057): the plan
+splits every request into (qo-tile, kv-chunk) work units; the kernel walks
+the unit list sequentially, double-buffering the next unit's KV pages while
+computing the current one, and carries the online-softmax accumulator
+across the kv-chunks of each qo tile (reset on first-chunk, write-out on
+last-chunk flags — plan-encoded, no in-kernel scheduling).
+
+Grid is ``(num_kv_heads, num_units)``: each unit computes ALL q heads of
+one KV head's GQA group, so every KV page is fetched from HBM exactly once
+per kv head — the same bandwidth discipline as the decode kernel.
+
+vs the gather+flash path (prefill.py): no extra HBM round trip for KV —
+for chunked prefill (small qo vs large kv) the gather pass costs ~50% of
+the attention time, which this kernel eliminates.
+
+Correctness invariant (relied on by the partial-tile write-back): units
+are ordered by ascending qstart within each kv head, and the unit grid
+dimension executes sequentially — a partial tile's full-block output DMA
+may clobber the next request's rows, which later units then rewrite.
+``build_prefill_work_units`` asserts the ordering; do not mark the unit
+dim "parallel".
+
+Status: interpret-validated; Mosaic hardware validation pending (see
+ROUND_NOTES.md TPU incident).  The wrapper keeps gather+flash as the
+default until then; select with ``backend="pallas_fused"``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up, use_interpret
+
+_NEG_INF = -1e30
+
+
+def build_prefill_work_units(
+    qo_indptr: np.ndarray,  # [B+1] token offsets
+    kv_page_indptr: np.ndarray,  # [B+1] page offsets
+    kv_page_indices: np.ndarray,
+    kv_lens: np.ndarray,  # [B] kv token lengths
+    block_q: int,
+    pages_per_chunk: int,
+    page_size: int,
+):
+    """Host-side plan: flatten (request, qo-tile, kv-chunk) units.
+
+    Returns a dict of numpy arrays padded to a power-of-two unit count
+    (padding units have qlen 0 and last=0 so they neither write nor
+    corrupt), plus the static (block_q, pages_per_chunk) the arrays were
+    built for."""
+    chunk_tokens = pages_per_chunk * page_size
+    units = []  # (qstart, qlen, qpos0, kvstart, kvlen_req, first, last, pages)
+    B = len(qo_indptr) - 1
+    for r in range(B):
+        qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+        kv_len = int(kv_lens[r])
+        pages = kv_page_indices[
+            int(kv_page_indptr[r]) : int(kv_page_indptr[r + 1])
+        ]
+        n_tiles = max(cdiv(qe - qs, block_q), 1) if qe > qs else 0
+        n_chunks = max(cdiv(kv_len, chunk_tokens), 1) if kv_len > 0 else 1
+        for t in range(n_tiles):
+            qstart = qs + t * block_q
+            qlen = min(block_q, qe - qstart)
+            qpos0 = kv_len - (qe - qs) + t * block_q
+            for c in range(n_chunks):
+                pg = pages[c * pages_per_chunk : (c + 1) * pages_per_chunk]
+                pg = np.pad(pg, (0, pages_per_chunk - len(pg)))
+                units.append((
+                    qstart, qlen, qpos0, c * chunk_tokens, kv_len,
+                    1 if c == 0 else 0, 1 if c == n_chunks - 1 else 0, pg,
+                ))
+    # the partial-tile write-back rewrite depends on ascending qstart order
+    starts = [u[0] for u in units]
+    assert starts == sorted(starts), "work units must be qstart-ordered"
+    U = max(next_power_of_two(max(len(units), 1)), 8)
+    # pad units: first=1 (reset, harmless), last=0 (MUST NOT write output)
+    pad_unit = (0, 0, 0, 0, 0, 1, 0, np.zeros(pages_per_chunk, np.int64))
+    while len(units) < U:
+        units.append(pad_unit)
+    arr = lambda i, dt: np.asarray([u[i] for u in units], dt)
+    return dict(
+        qstart=arr(0, np.int32), qlen=arr(1, np.int32), qpos0=arr(2, np.int32),
+        kvstart=arr(3, np.int32), kvlen=arr(4, np.int32),
+        first=arr(5, np.int32), last=arr(6, np.int32),
+        pages=np.stack([u[7] for u in units]).astype(np.int32).reshape(-1),
+        num_units=U,
+        block_q=block_q,
+        pages_per_chunk=pages_per_chunk,
+    )
+
+
+def _fused_prefill_kernel(
+    # scalar prefetch
+    qstart_ref, qlen_ref, qpos0_ref, kvstart_ref, kvlen_ref,
+    first_ref, last_ref, pages_ref,
+    # inputs (ANY)
+    q_hbm,  # [Tq_pad + bq, H, D]
+    k_hbm,  # [pages, Hkv, page_size, D] (HND)
+    v_hbm,
+    # output (ANY)
+    o_hbm,  # [Tq_pad + bq, H, D]
+    # scratch
+    qbuf,  # [bq, group, D]
+    kbuf,  # [2, chunk, D]
+    vbuf,
+    obuf,  # [bq, group, D]
+    acc_ref,  # [group, bq, D] f32
+    m_ref, l_ref,  # [group, bq, 128] f32
+    qsem, ksem, vsem, osem,
+    *,
+    bq: int,
+    ppc: int,
+    page_size: int,
+    group: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    causal: bool,
+    num_units: int,
+):
+    hkv = pl.program_id(0)
+    u = pl.program_id(1)
+    chunk_tokens = ppc * page_size
+
+    def kv_dmas(unit, slot):
+        dmas = []
+        for j in range(ppc):
+            page = pages_ref[unit * ppc + j]
+            dst = pl.ds(j * page_size, page_size)
+            dmas.append(pltpu.make_async_copy(
+                k_hbm.at[page, hkv], kbuf.at[slot, dst, :], ksem.at[slot, j]))
+            dmas.append(pltpu.make_async_copy(
+                v_hbm.at[page, hkv], vbuf.at[slot, dst, :], vsem.at[slot, j]))
+        return dmas
+
+    def q_dma(unit):
+        # all q heads of this kv head's group, one strided DMA
+        return pltpu.make_async_copy(
+            q_hbm.at[
+                pl.ds(qstart_ref[unit], bq),
+                pl.ds(hkv * group, group),
+                :,
+            ],
+            qbuf, qsem,
+        )
+
+    # this unit's q fetch (single buffer: fetched and consumed per step)
+    q_dma(u).start()
+
+    # KV double buffering: unit 0 warm-up, then prefetch u+1 into the
+    # other slot while computing u
+    @pl.when(u == 0)
+    def _():
+        for d in kv_dmas(0, 0):
+            d.start()
+
+    @pl.when(u + 1 < num_units)
+    def _():
+        for d in kv_dmas(u + 1, jax.lax.rem(u + 1, 2)):
+            d.start()
+
+    slot = jax.lax.rem(u, 2)
+    q_dma(u).wait()
+    for d in kv_dmas(u, slot):
+        d.wait()
+
+    @pl.when(first_ref[u] == 1)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, chunk_tokens), 1)
+    q_pos = qpos0_ref[u] + rows
+    kv_pos = kvstart_ref[u] + cols
+    valid = (rows < qlen_ref[u]) & (kv_pos < kvlen_ref[u])
+    if causal:
+        valid = valid & (kv_pos <= q_pos)
+    if window_left >= 0:
+        valid = valid & (kv_pos >= q_pos - window_left)
+
+    k = kbuf[slot]
+    v = vbuf[slot]
+    for g in range(group):  # static unroll over the GQA group
+        s = jax.lax.dot_general(
+            qbuf[:, g, :], k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if logits_soft_cap > 0.0:
+            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[g][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[g, :, :] = jnp.broadcast_to(
+            alpha * l_ref[g][:, :1] + jnp.sum(p, -1, keepdims=True),
+            (bq, 128),
+        )
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[g, :, :] = acc_ref[g] * alpha + pv
+        m_ref[g, :, :] = jnp.broadcast_to(m_new, (bq, 128))
+
+    @pl.when((last_ref[u] == 1) & (qlen_ref[u] > 0))
+    def _():
+        for g in range(group):
+            l = l_ref[g][:, :1]
+            obuf[:, g, :] = (
+                acc_ref[g] / jnp.where(l > 0, l, 1.0)
+            ).astype(obuf.dtype)
+        out_dma = pltpu.make_async_copy(
+            obuf,
+            o_hbm.at[
+                pl.ds(qstart_ref[u], bq), pl.ds(hkv * group, group), :
+            ],
+            osem,
+        )
+        out_dma.start()
+        out_dma.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_units", "block_q", "pages_per_chunk", "sm_scale",
+        "logits_soft_cap", "window_left", "causal",
+    ),
+)
+def fused_paged_prefill(
+    q: jax.Array,  # [tq_pad, H, D] — PRE-PADDED (bucketed) by the caller
+    k_cache: jax.Array,  # [pages, Hkv, page_size, D] (HND)
+    v_cache: jax.Array,
+    plan: dict,  # jnp arrays from build_prefill_work_units
+    *,
+    num_units: int,
+    block_q: int = 128,
+    pages_per_chunk: int = 8,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    causal: bool = True,
+):
+    total_q, H, D = q.shape
+    _, Hkv, page_size, _ = k_cache.shape
+    group = H // Hkv
+    chunk_tokens = pages_per_chunk * page_size
+    # extra block so full-bq tile DMAs at the tail stay in bounds
+    q_pad = jnp.pad(q, ((0, block_q), (0, 0), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(Hkv, num_units),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, group, D), q.dtype),
+            pltpu.VMEM((2, chunk_tokens, D), k_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, D), v_cache.dtype),
+            pltpu.VMEM((block_q, group, D), q.dtype),
+            pltpu.VMEM((group, block_q, D), jnp.float32),
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
+            pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _fused_prefill_kernel,
+            bq=block_q, ppc=pages_per_chunk, page_size=page_size,
+            group=group, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
+            window_left=window_left, causal=causal, num_units=num_units,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((total_q + block_q, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+            has_side_effects=True,
+        ),
+        interpret=use_interpret(),
+    )(
+        plan["qstart"], plan["qlen"], plan["qpos0"], plan["kvstart"],
+        plan["kvlen"], plan["first"], plan["last"], plan["pages"],
+        q_pad, k_cache, v_cache,
+    )
+    return out[:total_q]

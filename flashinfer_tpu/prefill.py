@@ -305,6 +305,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
         self._kv_layout = kv_layout
         self._backend = backend
         self._plan: Optional[_PrefillPlan] = None
+        self._fused_plan = None  # work-unit plan for backend="pallas_fused"
 
     def plan(
         self,
@@ -365,6 +366,24 @@ class BatchPrefillWithPagedKVCacheWrapper:
             causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
             logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
         )
+        if self._backend == "pallas_fused":
+            from flashinfer_tpu.ops.paged_prefill import (
+                build_prefill_work_units,
+            )
+
+            units = build_prefill_work_units(
+                qo_indptr, kv_indptr_pages, kv_indices, kv_lens,
+                block_q=128, pages_per_chunk=max(1, 128 // page_size),
+                page_size=page_size,
+            )
+            statics = dict(
+                num_units=units.pop("num_units"),
+                block_q=units.pop("block_q"),
+                pages_per_chunk=units.pop("pages_per_chunk"),
+            )
+            self._fused_plan = (
+                {k: jnp.asarray(v) for k, v in units.items()}, statics,
+            )
 
     def run(
         self,
@@ -380,6 +399,29 @@ class BatchPrefillWithPagedKVCacheWrapper:
             k_cache, v_cache = paged_kv_cache
         else:
             k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
+        if self._backend == "pallas_fused" and not return_lse:
+            # fused work-unit kernel: KV pages DMA'd straight from the cache
+            from flashinfer_tpu.ops.paged_prefill import fused_paged_prefill
+
+            if check_kv_layout(self._kv_layout) == TensorLayout.NHD:
+                k_hnd = jnp.swapaxes(k_cache, 1, 2)
+                v_hnd = jnp.swapaxes(v_cache, 1, 2)
+            else:
+                k_hnd, v_hnd = k_cache, v_cache
+            unit_plan, statics = self._fused_plan
+            total_q = q.shape[0]
+            # bucketed q padding bounds recompiles (same contract as the
+            # gather path; pad rows are touched by no work unit)
+            if total_q != plan.tq_pad:
+                q = jnp.pad(q, ((0, plan.tq_pad - total_q), (0, 0), (0, 0)))
+            out = fused_paged_prefill(
+                q, k_hnd, v_hnd, unit_plan,
+                sm_scale=plan.sm_scale,
+                logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, causal=plan.causal,
+                **statics,
+            )
+            return out[:total_q]
         if check_kv_layout(self._kv_layout) == TensorLayout.HND:
             k_cache = jnp.swapaxes(k_cache, 1, 2)
             v_cache = jnp.swapaxes(v_cache, 1, 2)
@@ -391,7 +433,10 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq = plan.tq_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
-        backend = resolve_backend(self._backend, "batch_prefill_paged")
+        backend = resolve_backend(
+            "pallas" if self._backend == "pallas_fused" else self._backend,
+            "batch_prefill_paged",
+        )
         fn = flash_attention if backend == "pallas" else xla_ragged_attention
         out = fn(
             q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,

@@ -97,6 +97,45 @@ def test_batch_prefill_ragged_wrapper(causal, backend):
 
 
 @pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
+def test_batch_prefill_paged_fused_backend(kv_layout):
+    """backend='pallas_fused': work-unit kernel vs per-request reference."""
+    HQ, HKV, D, PS = 4, 2, 64, 8
+    # 300-token request exercises the multi-tile (qo > block_q=128) path
+    qo_lens = [40, 300, 1]
+    kv_lens = [64, 300, 33]
+    num_pages = 64
+    rng = np.random.default_rng(7)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    kv_indptr_pages = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    indices = rng.permutation(num_pages)[: kv_indptr_pages[-1]].astype(np.int32)
+    last_page = np.array(
+        [l - (p - 1) * PS for l, p in zip(kv_lens, pages_per)], np.int32
+    )
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int32)
+    kc, vc = _make_paged_cache(jax.random.PRNGKey(3), num_pages, PS, HKV, D, kv_layout)
+    q = jax.random.normal(jax.random.PRNGKey(4), (int(qo_indptr[-1]), HQ, D), jnp.float32)
+
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout=kv_layout,
+                                               backend="pallas_fused")
+    w.plan(qo_indptr, kv_indptr_pages, indices, last_page, HQ, HKV, D, PS,
+           causal=True)
+    out = w.run(q, (kc, vc))
+
+    rows = _cache_rows(kc, kv_layout)
+    vrows = _cache_rows(vc, kv_layout)
+    for r in range(3):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        pages = indices[kv_indptr_pages[r] : kv_indptr_pages[r + 1]]
+        kb = _ragged_kv_for_request(rows, pages, PS, kv_lens[r])
+        vb = _ragged_kv_for_request(vrows, pages, PS, kv_lens[r])
+        ref = attention_ref(q[qs:qe], kb, vb, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )
+
+
+@pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
 def test_batch_prefill_paged_wrapper(kv_layout):
     HQ, HKV, D, PS = 4, 2, 64, 8
     qo_lens = [5, 33]
