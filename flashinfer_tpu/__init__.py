@@ -24,6 +24,7 @@ from flashinfer_tpu.decode import (  # noqa: F401
 from flashinfer_tpu.prefill import (  # noqa: F401
     BatchPrefillWithPagedKVCacheWrapper,
     BatchPrefillWithRaggedKVCacheWrapper,
+    build_multi_item_mask,
     single_prefill_with_kv_cache,
 )
 from flashinfer_tpu.gemm import (  # noqa: F401

@@ -1,0 +1,9 @@
+"""POD (Prefill-On-Decode) attention module.
+
+Module-path parity with the reference (``flashinfer/pod.py:61``); on TPU
+the holistic segment kernel already co-schedules prefill and decode work,
+so POD aliases BatchAttention — see flashinfer_tpu/attention.py for the
+design note.
+"""
+
+from flashinfer_tpu.attention import PODWithPagedKVCacheWrapper  # noqa: F401

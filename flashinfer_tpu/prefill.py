@@ -109,6 +109,46 @@ def single_prefill_with_kv_cache(
     )
 
 
+def build_multi_item_mask(
+    prefix_len: int,
+    item_lens,
+    qo_len: Optional[int] = None,
+) -> jax.Array:
+    """Mask for multi-item scoring (reference prefill.py multi-item params
+    ``prefix_len_ptr``/``token_pos_in_items_ptr``): the sequence is a shared
+    prefix followed by independent items; each item's tokens attend the
+    prefix and their own item causally, never other items — one packed
+    forward scores many candidate continuations (reward-model batching).
+
+    Returns a [qo_len, kv_len] bool mask for the custom-mask path, where
+    ``kv_len = prefix_len + sum(item_lens)`` and q covers the same tokens
+    (pass ``qo_len`` for append-style suffixes covering only the tail).
+    """
+    import numpy as np
+
+    item_lens = [int(x) for x in np.asarray(item_lens).reshape(-1)]
+    kv_len = prefix_len + sum(item_lens)
+    q_len = qo_len if qo_len is not None else kv_len
+    off = kv_len - q_len  # q tokens are the tail of the kv axis
+    mask = np.zeros((q_len, kv_len), bool)
+    # prefix visible to everyone, causal within the prefix rows
+    starts = [prefix_len]
+    for l in item_lens:
+        starts.append(starts[-1] + l)
+    for qi in range(q_len):
+        pos = qi + off
+        if pos < prefix_len:
+            mask[qi, : pos + 1] = True
+            continue
+        # which item does pos belong to?
+        for s, e in zip(starts[:-1], starts[1:]):
+            if s <= pos < e:
+                mask[qi, :prefix_len] = True
+                mask[qi, s : pos + 1] = True
+                break
+    return jnp.asarray(mask)
+
+
 @dataclass(frozen=True)
 class _PrefillPlan:
     q_seg: jax.Array  # [Tq_pad] int32 (-1 pad)

@@ -111,3 +111,38 @@ def test_custom_mask_overrides_causal():
     out = fi.single_prefill_with_kv_cache(q, k, v, custom_mask=full, causal=True)
     ref = attention_ref(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_multi_item_scoring_mask():
+    """Items attend prefix + own item only; cross-item attention masked."""
+    prefix, items = 4, [3, 2]
+    mask = fi.build_multi_item_mask(prefix, items)
+    m = np.asarray(mask)
+    assert m.shape == (9, 9)
+    # item 0 token (pos 5) sees prefix 0..3 and item0 4..5, not item1
+    np.testing.assert_array_equal(
+        m[5], [True] * 4 + [True, True] + [False] * 3
+    )
+    # item 1 token (pos 8) sees prefix + item1 only
+    np.testing.assert_array_equal(
+        m[8], [True] * 4 + [False] * 3 + [True, True]
+    )
+    # prefix row is plain causal
+    np.testing.assert_array_equal(m[2], [True]*3 + [False]*6)
+
+    # end-to-end: scoring both items in one packed forward == scoring each
+    # item separately against the prefix
+    H, D = 2, 32
+    kv = 9
+    q = jax.random.normal(jax.random.PRNGKey(0), (kv, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (kv, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (kv, H, D))
+    out = fi.single_prefill_with_kv_cache(q, k, v, custom_mask=mask)
+    # item 1 separately: prefix + item1 rows
+    sel = np.r_[0:4, 7:9]
+    ref = fi.single_prefill_with_kv_cache(
+        q[7:9], k[sel], v[sel], causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[7:9]), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
