@@ -35,6 +35,7 @@ from flashinfer_tpu.gemm import (  # noqa: F401
     mm_bf16,
     mm_fp4,
     mm_fp8,
+    mm_fp8_groupwise,
     mm_int8,
 )
 from flashinfer_tpu.quantization import (  # noqa: F401
@@ -88,6 +89,7 @@ from flashinfer_tpu.msa_ops import (  # noqa: F401
 )
 from flashinfer_tpu.norm import (  # noqa: F401
     fused_add_rmsnorm,
+    fused_add_rmsnorm_quant_fp8,
     gate_residual,
     gemma_fused_add_rmsnorm,
     gemma_rmsnorm,
@@ -95,6 +97,7 @@ from flashinfer_tpu.norm import (  # noqa: F401
     layernorm_scale_shift,
     qk_rmsnorm,
     rmsnorm,
+    rmsnorm_quant_fp8,
     rmsnorm_silu,
 )
 from flashinfer_tpu.concat_ops import concat_mla_k, concat_mla_q  # noqa: F401

@@ -119,6 +119,31 @@ def mm_fp4(
     return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def mm_fp8_groupwise(
+    a: jax.Array,  # fp8 [m, k]
+    b: jax.Array,  # fp8 [k, n]
+    a_scale: jax.Array,  # [m, k // block_k] per-(row, k-group) scales
+    b_scale: jax.Array,  # [k // block_k, n // block_n] per-tile scales
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Groupwise-scaled fp8 matmul (reference gemm_groupwise_sm100 family:
+    per-k-group activation scales x per-tile weight scales).  Block sizes
+    are inferred from the scale shapes; dequantized in-register to bf16 for
+    the MXU (no native fp8 matmul on v5)."""
+    m, k = a.shape
+    _, n = b.shape
+    block_k = k // a_scale.shape[1]
+    assert k % a_scale.shape[1] == 0 and k // b_scale.shape[0] == block_k
+    block_n = n // b_scale.shape[1]
+    af = a.astype(jnp.float32).reshape(m, k // block_k, block_k)
+    af = (af * a_scale[:, :, None]).reshape(m, k).astype(jnp.bfloat16)
+    bf = b.astype(jnp.float32).reshape(k // block_k, block_k, n // block_n,
+                                       block_n)
+    bf = (bf * b_scale[:, None, :, None]).reshape(k, n).astype(jnp.bfloat16)
+    return jnp.dot(af, bf, preferred_element_type=jnp.float32).astype(out_dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
 def mm_svdquant(
     x: jax.Array,  # [m, k]

@@ -231,6 +231,47 @@ def layernorm_scale_shift(
     return (y * (1.0 + s) + t).astype(x.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("eps", "quant_dtype"))
+def rmsnorm_quant_fp8(
+    x: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    quant_dtype=jnp.float8_e4m3fn,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused RMSNorm + per-tensor fp8 quantize -> (values, scale)
+    (reference quantizing-norm variants, flashinfer/norm/ FP8-out family)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)
+    finfo = jnp.finfo(quant_dtype)
+    amax = jnp.max(jnp.abs(y))
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(y / scale, float(finfo.min), float(finfo.max)).astype(quant_dtype)
+    return q, scale.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "quant_dtype"))
+def fused_add_rmsnorm_quant_fp8(
+    x: jax.Array,
+    residual: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    quant_dtype=jnp.float8_e4m3fn,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused residual-add + RMSNorm + fp8 quantize -> (values, scale,
+    new_residual) — the AR-free half of the reference's
+    AllReduceFusionPattern quantizing epilogues."""
+    s = x.astype(jnp.float32) + residual.astype(jnp.float32)
+    new_residual = s.astype(x.dtype)
+    var = jnp.mean(s * s, axis=-1, keepdims=True)
+    y = s * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)
+    finfo = jnp.finfo(quant_dtype)
+    amax = jnp.max(jnp.abs(y))
+    scale = jnp.maximum(amax / float(finfo.max), 1e-12)
+    q = jnp.clip(y / scale, float(finfo.min), float(finfo.max)).astype(quant_dtype)
+    return q, scale.astype(jnp.float32), new_residual
+
+
 @jax.jit
 def gate_residual(
     residual: jax.Array, gate: jax.Array, x: jax.Array

@@ -139,3 +139,37 @@ def test_logits_pipe_topk_on_logits_matches_probs_domain():
     d1 = p1(logits, top_k=8)
     d2 = p2(logits, top_k=8)
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-4, atol=1e-5)
+
+
+def test_mm_fp8_groupwise():
+    rng = np.random.default_rng(0)
+    m, k, n, bk, bn = 16, 64, 32, 16, 16
+    a32 = rng.normal(size=(m, k)).astype(np.float32)
+    b32 = rng.normal(size=(k, n)).astype(np.float32)
+    # per-group quant
+    a_g = a32.reshape(m, k // bk, bk)
+    a_scale = np.abs(a_g).max(-1) / 448.0 + 1e-12
+    a8 = jnp.asarray((a_g / a_scale[..., None]).reshape(m, k)).astype(jnp.float8_e4m3fn)
+    b_g = b32.reshape(k // bk, bk, n // bn, bn)
+    b_scale = np.abs(b_g).max(axis=(1, 3)) / 448.0 + 1e-12
+    b8 = jnp.asarray((b_g / b_scale[:, None, :, None]).reshape(k, n)).astype(jnp.float8_e4m3fn)
+    out = fi.mm_fp8_groupwise(a8, b8, jnp.asarray(a_scale), jnp.asarray(b_scale),
+                              out_dtype=jnp.float32)
+    ref = a32 @ b32
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=0.15, atol=0.5)
+
+
+def test_quantizing_norms():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64))
+    w = jnp.ones((64,))
+    q, s = fi.rmsnorm_quant_fp8(x, w)
+    back = np.asarray(q, np.float32) * float(s)
+    ref = np.asarray(fi.rmsnorm(x, w, backend="xla"))
+    np.testing.assert_allclose(back, ref, rtol=0.1, atol=0.05)
+    r = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+    q2, s2, new_r = fi.fused_add_rmsnorm_quant_fp8(x, r, w)
+    ref_n, ref_r = fi.fused_add_rmsnorm(x, r, w, backend="xla")
+    np.testing.assert_allclose(np.asarray(new_r), np.asarray(ref_r), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(q2, np.float32) * float(s2), np.asarray(ref_n), rtol=0.1, atol=0.05
+    )
