@@ -57,35 +57,73 @@ def generate(prompt_lens, max_new_tokens=8, seed=0):
     ]
     page_table = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, pages_per_req)
 
-    # ---- prefill: run each prompt's tokens through the decode step one
-    # token at a time is wasteful; here we keep the example small and append
-    # prompt K/V token-by-token via the decode step (a chunked-prefill
-    # variant would use BatchPrefillWithPagedKVCacheWrapper.run)
+    # ---- prefill: the real serving flow — one ragged batch-prefill pass.
+    # Per layer: project the prompt tokens, RoPE, append K/V into the paged
+    # cache, then BatchPrefillWithPagedKVCacheWrapper over the cache.
+    from flashinfer_tpu.models.llama import _tp_param_specs  # noqa: F401
+    from flashinfer_tpu.norm import rmsnorm
+    from flashinfer_tpu.activation import silu_and_mul
+    from flashinfer_tpu.rope import apply_rope_pos_ids
+
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(1, cfg.vocab_size, l).tolist() for l in prompt_lens]
-    kv_lens = jnp.zeros((B,), jnp.int32)
-    tokens = jnp.zeros((B,), jnp.int32)
+    qo_indptr = np.concatenate([[0], np.cumsum(prompt_lens)]).astype(np.int32)
+    total_q = int(qo_indptr[-1])
+    flat_tokens = jnp.asarray(np.concatenate(prompts), jnp.int32)
+    # positions within each request
+    pos = jnp.asarray(
+        np.concatenate([np.arange(l) for l in prompt_lens]), jnp.int32
+    )
+    seq_lens = np.asarray(prompt_lens, np.int32)
+    pages_used = [-(-int(l) // PS) for l in prompt_lens]
+    kv_page_indptr = np.concatenate([[0], np.cumsum(pages_used)]).astype(np.int32)
+    kv_page_indices = np.concatenate(
+        [np.arange(b * pages_per_req, b * pages_per_req + pages_used[b])
+         for b in range(B)]
+    ).astype(np.int32)
+    last_page = np.asarray(
+        [l - (p - 1) * PS for l, p in zip(prompt_lens, pages_used)], np.int32
+    )
+    bi, tok_pos = fi.get_batch_indices_positions(
+        jnp.asarray(qo_indptr), jnp.asarray(seq_lens), total_q
+    )
+    prefill = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+    prefill.plan(
+        qo_indptr, kv_page_indptr, kv_page_indices, last_page,
+        cfg.num_qo_heads, cfg.num_kv_heads, cfg.head_dim, PS, causal=True,
+    )
+
+    x = params["embed"][flat_tokens].astype(cfg.dtype)
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+        qp = (h @ layer["q_proj"]).reshape(total_q, cfg.num_qo_heads, cfg.head_dim)
+        kp = (h @ layer["k_proj"]).reshape(total_q, cfg.num_kv_heads, cfg.head_dim)
+        vp = (h @ layer["v_proj"]).reshape(total_q, cfg.num_kv_heads, cfg.head_dim)
+        qp, kp = apply_rope_pos_ids(qp, kp, pos, rope_theta=cfg.rope_theta)
+        kc, vc = caches[li]
+        # append into the HND paged cache (append op expects NHD views)
+        kc_n, vc_n = fi.append_paged_kv_cache(
+            kp, vp, bi, tok_pos,
+            (jnp.swapaxes(kc, 1, 2), jnp.swapaxes(vc, 1, 2)),
+            jnp.asarray(kv_page_indices), jnp.asarray(kv_page_indptr),
+            None, "NHD",
+        )
+        kc, vc = jnp.swapaxes(kc_n, 1, 2), jnp.swapaxes(vc_n, 1, 2)
+        new_caches.append((kc, vc))
+        attn = prefill.run(qp, (kc, vc))
+        x = x + (attn.reshape(total_q, -1) @ layer["o_proj"]).astype(cfg.dtype)
+        h2 = rmsnorm(x, layer["post_norm"], cfg.rms_eps)
+        mlp = jnp.concatenate([h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1)
+        x = x + (silu_and_mul(mlp) @ layer["down_proj"]).astype(cfg.dtype)
+    caches = new_caches
+    x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    all_logits = (x @ params["lm_head"]).astype(jnp.float32)
+    # decode starts from each request's LAST prompt-token logits
+    last_idx = jnp.asarray(qo_indptr[1:] - 1, jnp.int32)
+    logits = all_logits[last_idx]
+    kv_lens = jnp.asarray(seq_lens)
     out_tokens = [[] for _ in range(B)]
-    max_prompt = max(prompt_lens)
-    # each request's decode starts from the logits of its OWN last prompt
-    # token (shorter prompts would otherwise carry padding-step logits)
-    final_logits = jnp.zeros((B, cfg.vocab_size), jnp.float32)
-    for t in range(max_prompt):
-        tokens = jnp.asarray(
-            [p[t] if t < len(p) else 0 for p in prompts], jnp.int32
-        )
-        step_logits, caches = llama_decode_step(
-            params, cfg, tokens, kv_lens, caches, page_table, kv_lens,
-            use_pallas=use_pallas,
-        )
-        is_last = jnp.asarray(
-            [t == l - 1 for l in prompt_lens], bool
-        )[:, None]
-        final_logits = jnp.where(is_last, step_logits, final_logits)
-        kv_lens = kv_lens + jnp.asarray(
-            [1 if t < l else 0 for l in prompt_lens], jnp.int32
-        )
-    logits = final_logits
 
     # ---- decode loop with sampling pipeline
     pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
