@@ -129,6 +129,7 @@ from flashinfer_tpu.rope import (  # noqa: F401
     apply_rope_with_cos_sin_cache,
     generate_cos_sin_cache,
 )
+from flashinfer_tpu.autotuner import AutoTuner, autotune  # noqa: F401
 from flashinfer_tpu.sampling import (  # noqa: F401
     chain_speculative_sampling,
     min_p_sampling_from_probs,

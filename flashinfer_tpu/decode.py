@@ -247,11 +247,34 @@ class BatchDecodeWithPagedKVCacheWrapper:
 
         backend = resolve_backend(self._backend, "batch_decode")
         if backend == "pallas":
+            # autotuned pages-per-chunk (reference AutoTuner.choose_one role;
+            # zero overhead outside an autotune() context — cached/default)
+            from flashinfer_tpu.autotuner import AutoTuner
+
+            ppc_default = max(1, min(512 // plan.page_size, 16))
+            candidates = sorted({
+                max(1, min(c // plan.page_size, 64))
+                for c in (128, 256, 512, 1024)
+            })
+            ppc = AutoTuner.get().choose_one(
+                "paged_decode.pages_per_chunk",
+                (plan.page_table.shape[0], plan.page_table.shape[1],
+                 plan.num_qo_heads, plan.num_kv_heads, plan.head_dim,
+                 plan.page_size, str(q.dtype)),
+                candidates,
+                lambda c: (lambda: paged_decode_attention(
+                    q, k_cache, v_cache, plan.page_table, plan.kv_lens,
+                    sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
+                    window_left=plan.window_left, kv_layout=self._kv_layout,
+                    pages_per_chunk=c, return_lse=return_lse,
+                )),
+                default=ppc_default,
+            )
             out = paged_decode_attention(
                 q, k_cache, v_cache, plan.page_table, plan.kv_lens,
                 sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left, kv_layout=self._kv_layout,
-                return_lse=return_lse,
+                pages_per_chunk=int(ppc), return_lse=return_lse,
             )
         else:
             out = xla_paged_decode(

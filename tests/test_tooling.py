@@ -147,3 +147,26 @@ def test_autotuner_cache_and_context(monkeypatch, tmp_path):
         got2 = tuner.choose_one("op", (128,), [(64,), (128,)], runner)
     assert got2 == got and not probed
     at.AutoTuner._instance = None
+
+
+def test_decode_autotune_integration(monkeypatch, tmp_path):
+    """autotune() context profiles pages_per_chunk for the decode wrapper
+    and persists the pick; outside the context the default is used."""
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    import flashinfer_tpu as fi
+    import flashinfer_tpu.autotuner as at
+
+    at.AutoTuner._instance = None
+    B, HQ, HKV, D, PS = 2, 4, 2, 64, 8
+    indptr = np.array([0, 2, 4], np.int32)
+    kc = jnp.zeros((8, PS, HKV, D), jnp.float32)
+    q = jnp.zeros((B, HQ, D), jnp.float32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(backend="pallas")
+    w.plan(indptr, np.arange(4, dtype=np.int32), np.array([8, 8], np.int32),
+           HQ, HKV, D, PS)
+    with fi.autotune():
+        w.run(q, (kc, kc))
+    t = at.AutoTuner.get()
+    keys = [k for k in t._cache if k.startswith("paged_decode.pages_per_chunk")]
+    assert keys, t._cache
+    at.AutoTuner._instance = None
