@@ -86,6 +86,46 @@ def cmd_list_modules(_args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """Re-invoke a dumped API call (reference CLI ``replay``,
+    flashinfer/__main__.py:462): loads ``arg*.npy`` / ``kw_*.npy`` from a
+    FLASHINFER_TPU_LOGLEVEL=10 dump directory and calls the op again."""
+    import re
+    from pathlib import Path
+
+    import numpy as np
+
+    import flashinfer_tpu as fi
+
+    d = Path(args.dump_dir)
+    if not d.is_dir():
+        print(f"no such dump dir: {d}")
+        return 1
+    op_name = re.sub(r"_\d+$", "", d.name)
+    fn = getattr(fi, op_name, None)
+    if fn is None:
+        print(f"unknown op {op_name!r} (dir name must be <op>_<callidx>)")
+        return 1
+    pos = {}
+    kws = {}
+    for f in sorted(d.glob("*.npy")):
+        if f.stem.startswith("arg"):
+            pos[int(f.stem[3:])] = np.load(f)
+        elif f.stem.startswith("kw_"):
+            kws[f.stem[3:]] = np.load(f)
+    args_list = [pos[i] for i in sorted(pos)]
+    out = fn(*args_list, **kws)
+    import jax
+
+    jax.block_until_ready(out)
+    leaves = jax.tree_util.tree_leaves(out)
+    print(
+        f"replayed {op_name} with {len(args_list)} args, {len(kws)} kwargs -> "
+        + ", ".join(f"{getattr(l, 'shape', l)}" for l in leaves[:4])
+    )
+    return 0
+
+
 def cmd_prewarm(_args) -> int:
     from flashinfer_tpu.aot import prewarm
 
@@ -120,6 +160,9 @@ def main(argv=None) -> int:
     ]:
         sp = sub.add_parser(name)
         sp.set_defaults(fn=fn)
+    sp = sub.add_parser("replay")
+    sp.add_argument("dump_dir", help="a <op>_<idx> dir from LOGLEVEL=10 dumps")
+    sp.set_defaults(fn=cmd_replay)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -170,3 +170,30 @@ def test_decode_autotune_integration(monkeypatch, tmp_path):
     keys = [k for k in t._cache if k.startswith("paged_decode.pages_per_chunk")]
     assert keys, t._cache
     at.AutoTuner._instance = None
+
+
+def test_cli_replay_roundtrip(tmp_path):
+    """Dump an rmsnorm call at LOGLEVEL=10, replay it via the CLI."""
+    import os, subprocess, sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["FLASHINFER_TPU_LOGLEVEL"] = "10"
+    env["FLASHINFER_TPU_DUMP_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax.numpy as jnp, flashinfer_tpu as fi; "
+         "fi.rmsnorm(jnp.ones((4,128)), jnp.ones((128,)))"],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    dumps = [d for d in tmp_path.iterdir() if d.name.startswith("rmsnorm_")]
+    assert dumps
+    env["FLASHINFER_TPU_LOGLEVEL"] = "0"
+    r = subprocess.run(
+        [sys.executable, "-m", "flashinfer_tpu", "replay", str(dumps[0])],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "replayed rmsnorm" in r.stdout
