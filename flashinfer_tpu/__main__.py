@@ -106,13 +106,36 @@ def cmd_replay(args) -> int:
     if fn is None:
         print(f"unknown op {op_name!r} (dir name must be <op>_<callidx>)")
         return 1
+    import json
+
+    meta = {}
+    meta_f = d / "meta.json"
+    if meta_f.exists():
+        meta = json.loads(meta_f.read_text())
+
+    def load(f: Path):
+        arr = np.load(f)
+        orig = meta.get(f.stem)
+        if orig:  # bf16/fp8 stored as f32 with the original dtype recorded
+            import jax.numpy as jnp
+
+            arr = jnp.asarray(arr).astype(orig)
+        return arr
+
     pos = {}
     kws = {}
     for f in sorted(d.glob("*.npy")):
-        if f.stem.startswith("arg"):
-            pos[int(f.stem[3:])] = np.load(f)
+        m = re.fullmatch(r"arg(\d+)", f.stem)
+        if m:
+            pos[int(m.group(1))] = load(f)
         elif f.stem.startswith("kw_"):
-            kws[f.stem[3:]] = np.load(f)
+            kws[f.stem[3:]] = load(f)
+    if meta.get("skipped"):
+        print(f"cannot replay: args were not dumpable: {meta['skipped']}")
+        return 1
+    if sorted(pos) != list(range(len(pos))):
+        print(f"cannot replay: positional dump gap (have {sorted(pos)})")
+        return 1
     args_list = [pos[i] for i in sorted(pos)]
     out = fn(*args_list, **kws)
     import jax

@@ -41,20 +41,31 @@ def _summarize(x: Any) -> str:
 
 
 def _dump(name: str, idx: int, args, kwargs) -> None:
+    import json
+
     import numpy as np
 
     d = env.dump_dir() / f"{name}_{idx}"
     d.mkdir(parents=True, exist_ok=True)
+    meta = {"skipped": []}
+
+    def save(key: str, a) -> None:
+        try:
+            arr = np.asarray(a)
+            if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
+                # ml_dtypes (bf16/fp8) don't survive np.save: store as f32
+                # and record the original dtype for replay
+                meta[key] = str(getattr(a, "dtype", arr.dtype))
+                arr = np.asarray(a, dtype=np.float32)
+            np.save(d / f"{key}.npy", arr)
+        except Exception:
+            meta["skipped"].append(key)
+
     for i, a in enumerate(args):
-        try:
-            np.save(d / f"arg{i}.npy", np.asarray(a))
-        except Exception:
-            pass
+        save(f"arg{i}", a)
     for k, v in kwargs.items():
-        try:
-            np.save(d / f"kw_{k}.npy", np.asarray(v))
-        except Exception:
-            pass
+        save(f"kw_{k}", v)
+    (d / "meta.json").write_text(json.dumps(meta))
 
 
 def flashinfer_api(fn: Callable = None, *, name: str = None) -> Callable:

@@ -190,10 +190,30 @@ def test_cli_replay_roundtrip(tmp_path):
     assert r.returncode == 0, r.stderr
     dumps = [d for d in tmp_path.iterdir() if d.name.startswith("rmsnorm_")]
     assert dumps
-    env["FLASHINFER_TPU_LOGLEVEL"] = "0"
-    r = subprocess.run(
-        [sys.executable, "-m", "flashinfer_tpu", "replay", str(dumps[0])],
-        capture_output=True, text=True, env=env, timeout=240,
-    )
+    r = _run_cli("replay", str(dumps[0]),
+                 env_extra={"FLASHINFER_TPU_LOGLEVEL": "0"})
     assert r.returncode == 0, r.stderr
     assert "replayed rmsnorm" in r.stdout
+
+
+def test_cli_replay_bf16(tmp_path):
+    """bf16 dumps round-trip through the f32+meta fallback."""
+    import os, subprocess, sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["FLASHINFER_TPU_LOGLEVEL"] = "10"
+    env["FLASHINFER_TPU_DUMP_DIR"] = str(tmp_path)
+    rr = subprocess.run(
+        [sys.executable, "-c",
+         "import jax.numpy as jnp, flashinfer_tpu as fi; "
+         "fi.rmsnorm(jnp.ones((4,128), jnp.bfloat16), jnp.ones((128,), jnp.bfloat16))"],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert rr.returncode == 0, rr.stderr
+    dumps = [d for d in tmp_path.iterdir() if d.name.startswith("rmsnorm_")]
+    assert dumps and (dumps[0] / "meta.json").exists()
+    r2 = _run_cli("replay", str(dumps[0]),
+                  env_extra={"FLASHINFER_TPU_LOGLEVEL": "0"})
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    assert "replayed rmsnorm" in r2.stdout
