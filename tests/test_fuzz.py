@@ -1,0 +1,101 @@
+"""Randomized geometry fuzz tests (mirrors reference
+tests/moe/test_unified_moe_fuzz.py strategy): many random configs vs the
+eager oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+import flashinfer_tpu.fused_moe as moe
+from flashinfer_tpu.testing import attention_ref
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_ragged_prefill_geometries(seed):
+    rng = np.random.default_rng(seed)
+    batch = int(rng.integers(1, 6))
+    qo_lens = rng.integers(1, 70, batch)
+    extra = rng.integers(0, 50, batch)
+    kv_lens = qo_lens + extra  # kv >= qo (append semantics)
+    HQ = int(rng.choice([1, 2, 4, 8]))
+    HKV = int(rng.choice([h for h in (1, 2, 4, 8) if HQ % h == 0]))
+    D = int(rng.choice([32, 64]))
+    causal = bool(rng.integers(0, 2))
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    kv_indptr = np.concatenate([[0], np.cumsum(kv_lens)])
+    q = jax.random.normal(jax.random.PRNGKey(seed), (int(qo_indptr[-1]), HQ, D))
+    k = jax.random.normal(jax.random.PRNGKey(seed + 100), (int(kv_indptr[-1]), HKV, D))
+    v = jax.random.normal(jax.random.PRNGKey(seed + 200), (int(kv_indptr[-1]), HKV, D))
+    w = fi.BatchPrefillWithRaggedKVCacheWrapper()
+    w.plan(qo_indptr, kv_indptr, HQ, HKV, D, causal=causal)
+    out = w.run(q, k, v)
+    for r in range(batch):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        ks, ke = kv_indptr[r], kv_indptr[r + 1]
+        ref = attention_ref(q[qs:qe], k[ks:ke], v[ks:ke], causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=3e-3, atol=3e-3,
+            err_msg=f"seed {seed} req {r} ({qo_lens.tolist()}/{kv_lens.tolist()})",
+        )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_moe_configs(seed):
+    rng = np.random.default_rng(seed + 50)
+    T = int(rng.integers(1, 33))
+    E = int(rng.choice([2, 4, 8, 16]))
+    K = int(rng.integers(1, min(E, 4) + 1))
+    h = int(rng.choice([16, 32]))
+    inter = int(rng.choice([16, 64]))
+    x = jnp.asarray(rng.normal(size=(T, h)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(E, h, 2 * inter)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.normal(size=(E, inter, h)).astype(np.float32) * 0.1)
+    logits = jnp.asarray(rng.normal(size=(T, E)).astype(np.float32))
+    wts, ids = moe.route_renormalize(logits, K)
+    out = moe.fused_moe(x, w1, w2, wts, ids, E)
+    # eager loop oracle
+    ref = np.zeros((T, h), np.float32)
+    xn, w1n, w2n = np.asarray(x), np.asarray(w1), np.asarray(w2)
+    idn, wtn = np.asarray(ids), np.asarray(wts)
+    for t in range(T):
+        for j in range(K):
+            e = int(idn[t, j])
+            hdn = xn[t] @ w1n[e]
+            d = hdn.shape[-1] // 2
+            a = hdn[:d] / (1 + np.exp(-hdn[:d])) * hdn[d:]
+            ref[t] += wtn[t, j] * (a @ w2n[e])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-3, atol=3e-3,
+                               err_msg=f"seed {seed} T{T} E{E} K{K}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_decode_geometries(seed):
+    rng = np.random.default_rng(seed + 99)
+    batch = int(rng.integers(1, 9))
+    PS = int(rng.choice([1, 8, 16]))
+    kv_lens = rng.integers(1, 200, batch)
+    HQ, HKV, D = 8, int(rng.choice([1, 2, 8])), 64
+    pages_per = -(-kv_lens // PS)
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    npages = int(indptr[-1]) + 4
+    indices = rng.permutation(npages)[: indptr[-1]].astype(np.int32)
+    last = (kv_lens - (pages_per - 1) * PS).astype(np.int32)
+    kc = jax.random.normal(jax.random.PRNGKey(seed), (npages, PS, HKV, D))
+    vc = jax.random.normal(jax.random.PRNGKey(seed + 1), (npages, PS, HKV, D))
+    q = jax.random.normal(jax.random.PRNGKey(seed + 2), (batch, HQ, D))
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD")
+    w.plan(indptr, indices, last, HQ, HKV, D, PS)
+    out = w.run(q, (kc, vc))
+    rows = np.asarray(kc).reshape(-1, HKV, D)
+    vrows = np.asarray(vc).reshape(-1, HKV, D)
+    for b in range(batch):
+        pages = indices[indptr[b] : indptr[b + 1]]
+        tok = np.arange(kv_lens[b])
+        rr = pages[tok // PS] * PS + tok % PS
+        ref = attention_ref(q[b : b + 1], jnp.asarray(rows[rr]), jnp.asarray(vrows[rr]))
+        np.testing.assert_allclose(
+            np.asarray(out[b]), np.asarray(ref[0]), rtol=3e-3, atol=3e-3,
+            err_msg=f"seed {seed} b{b} kv{kv_lens[b]} ps{PS}",
+        )
