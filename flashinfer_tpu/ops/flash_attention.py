@@ -32,7 +32,7 @@ _NEG_INF = -1e30
 
 def _flash_kernel(
     # scalar-prefetch
-    # (none)
+    skip_ref,  # [nq * nkv] i32: 1 = block provably all-masked, skip compute
     # inputs
     q_ref,  # [bq, head_dim]
     k_ref,  # [bkv, head_dim]
@@ -55,6 +55,7 @@ def _flash_kernel(
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
         lse_ref = None
+    q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
 
     @pl.when(kv_idx == 0)
@@ -63,41 +64,43 @@ def _flash_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # native-dtype (bf16) matmul on the MXU, f32 accumulation
-    s = jax.lax.dot_general(
-        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [bq, bkv] f32
-    s = s * sm_scale
-    if logits_soft_cap > 0.0:
-        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+    @pl.when(skip_ref[q_idx * num_kv_blocks + kv_idx] == 0)
+    def _compute():
+        # native-dtype (bf16) matmul on the MXU, f32 accumulation
+        s = jax.lax.dot_general(
+            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bkv] f32
+        s = s * sm_scale
+        if logits_soft_cap > 0.0:
+            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
 
-    q_seg = q_seg_ref[...]  # [bq, 1]
-    kv_seg = kv_seg_ref[...][None, :]  # [1, bkv] — lane broadcast, free
-    mask = q_seg == kv_seg
-    q_pos = q_pos_ref[...]
-    kv_pos = kv_pos_ref[...][None, :]
-    if causal:
-        mask = mask & (kv_pos <= q_pos)
-    if window_left >= 0:
-        mask = mask & (kv_pos >= q_pos - window_left)
-    s = jnp.where(mask, s, _NEG_INF)
+        q_seg = q_seg_ref[...]  # [bq, 1]
+        kv_seg = kv_seg_ref[...][None, :]  # [1, bkv] — lane broadcast, free
+        mask = q_seg == kv_seg
+        q_pos = q_pos_ref[...]
+        kv_pos = kv_pos_ref[...][None, :]
+        if causal:
+            mask = mask & (kv_pos <= q_pos)
+        if window_left >= 0:
+            mask = mask & (kv_pos >= q_pos - window_left)
+        s = jnp.where(mask, s, _NEG_INF)
 
-    m_prev = m_ref[...][:, :1]  # [bq, 1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
-    m_new = jnp.maximum(m_prev, m_cur)
-    # guard fully-masked rows: keep exp argument finite
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
-    l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_ref[...] = acc_ref[...] * alpha + pv
-    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_prev = m_ref[...][:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows: keep exp argument finite
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(kv_idx == num_kv_blocks - 1)
     def _finalize():
@@ -173,6 +176,35 @@ def flash_attention(
     q_pos2 = q_pos.astype(jnp.int32).reshape(-1, 1)
     kv_pos2 = kv_pos.astype(jnp.int32)
 
+    # conservative per-(q_blk, kv_blk) skip map: blocks provably all-masked
+    # bypass both matmuls (the causal/segment block-sparsity that the
+    # reference gets from its work-queue plan).  Padding maps to distinct
+    # large sentinels so pad-only blocks fall out via segment disjointness.
+    BIGQ, BIGK = 2**30, 2**30 + 5
+    qss = jnp.where(q_seg2[:, 0] < 0, BIGQ, q_seg2[:, 0]).reshape(nq, bq)
+    kss = jnp.where(kv_seg2 < 0, BIGK, kv_seg2).reshape(nkv, bkv)
+    qmin, qmax = qss.min(1), qss.max(1)
+    kmin, kmax = kss.min(1), kss.max(1)
+    qp = q_pos2[:, 0].reshape(nq, bq)
+    kp = kv_pos2.reshape(nkv, bkv)
+    skip = (kmin[None, :] > qmax[:, None]) | (kmax[None, :] < qmin[:, None])
+    # position rules are only valid when both blocks sit in one common segment
+    single_common = (
+        (qmin[:, None] == qmax[:, None])
+        & (kmin[None, :] == kmax[None, :])
+        & (qmin[:, None] == kmin[None, :])
+    )
+    if causal:
+        skip = skip | (
+            single_common & (kp.min(1)[None, :] > qp.max(1)[:, None])
+        )
+    if window_left >= 0:
+        skip = skip | (
+            single_common
+            & (kp.max(1)[None, :] < qp.min(1)[:, None] - window_left)
+        )
+    skip_map = skip.astype(jnp.int32).reshape(-1)
+
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=sm_scale,
@@ -183,33 +215,46 @@ def flash_attention(
         return_lse=return_lse,
     )
 
-    out_specs = [pl.BlockSpec((None, bq, head_dim_vo), lambda h, i, j: (h, i, 0))]
+    out_specs = [
+        pl.BlockSpec((None, bq, head_dim_vo), lambda h, i, j, *_: (h, i, 0))
+    ]
     out_shape = [jax.ShapeDtypeStruct((num_qo_heads, tq_pad, head_dim_vo), q.dtype)]
     if return_lse:
-        out_specs.append(pl.BlockSpec((None, bq, 128), lambda h, i, j: (h, i, 0)))
+        out_specs.append(
+            pl.BlockSpec((None, bq, 128), lambda h, i, j, *_: (h, i, 0))
+        )
         out_shape.append(
             jax.ShapeDtypeStruct((num_qo_heads, tq_pad, 128), jnp.float32)
         )
 
-    results = pl.pallas_call(
-        kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(num_qo_heads, nq, nkv),
         in_specs=[
-            pl.BlockSpec((None, bq, head_dim), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((None, bkv, head_dim), lambda h, i, j: (h // group, j, 0)),
-            pl.BlockSpec((None, bkv, head_dim_vo), lambda h, i, j: (h // group, j, 0)),
-            pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
-            pl.BlockSpec((bkv,), lambda h, i, j: (j,)),
-            pl.BlockSpec((bq, 1), lambda h, i, j: (i, 0)),
-            pl.BlockSpec((bkv,), lambda h, i, j: (j,)),
+            pl.BlockSpec((None, bq, head_dim), lambda h, i, j, *_: (h, i, 0)),
+            pl.BlockSpec(
+                (None, bkv, head_dim), lambda h, i, j, *_: (h // group, j, 0)
+            ),
+            pl.BlockSpec(
+                (None, bkv, head_dim_vo),
+                lambda h, i, j, *_: (h // group, j, 0),
+            ),
+            pl.BlockSpec((bq, 1), lambda h, i, j, *_: (i, 0)),
+            pl.BlockSpec((bkv,), lambda h, i, j, *_: (j,)),
+            pl.BlockSpec((bq, 1), lambda h, i, j, *_: (i, 0)),
+            pl.BlockSpec((bkv,), lambda h, i, j, *_: (j,)),
         ],
         out_specs=out_specs,
-        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, head_dim_vo), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+    )
+    results = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
         # NOTE: dimension_semantics=("parallel","parallel","arbitrary") would
         # enable megacore grid partitioning on dual-core chips (v4/v5p), but
         # is a suspect in a Mosaic compile hang under investigation on v5e;
@@ -218,7 +263,7 @@ def flash_attention(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=use_interpret(),
-    )(qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)
+    )(skip_map, qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)
 
     out = jnp.swapaxes(results[0], 0, 1)[:total_q]  # [Tq, H, D]
     if return_lse:
