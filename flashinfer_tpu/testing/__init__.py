@@ -2,6 +2,7 @@ from flashinfer_tpu.testing.utils import (  # noqa: F401
     assert_close,
     attention_ref,
     bench_fn,
+    bench_fn_device,
     attention_flops,
     attention_bytes,
 )

@@ -133,6 +133,11 @@ def bench_fn(
 
     TPU analogue of ``bench_gpu_time`` (reference testing/utils.py:774):
     compile+warm first, then time each iteration with ``block_until_ready``.
+
+    .. warning:: Under a remote/tunneled device runtime (e.g. the axon TPU
+       tunnel) ``block_until_ready`` can return before device execution
+       finishes, and per-call dispatch overhead (~ms) dwarfs kernel time.
+       Use :func:`bench_fn_device` for hardware-honest numbers there.
     """
     out = fn(*args, **kwargs)  # compile
     for _ in range(max(warmup - 1, 0)):
@@ -145,3 +150,73 @@ def bench_fn(
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     return float(np.median(times))
+
+
+def bench_fn_device(
+    fn: Callable,
+    x: jax.Array,
+    *rest,
+    iters_low: int = 8,
+    iters_high: int = 40,
+    repeats: int = 3,
+) -> float:
+    """Device-honest per-call time via an in-jit iteration loop + slope fit.
+
+    Runs ``fn`` ``iters`` times inside one jitted ``lax.fori_loop``, with a
+    data-dependency chain that defeats both loop hoisting and dead-code
+    elimination:
+
+    * the input ``x`` is perturbed by ``carry * 1e-30`` so iteration *i*
+      depends on iteration *i-1* (no cross-iteration parallelism / CSE);
+    * the carry is a full-output reduction, so XLA must compute every
+      element of ``fn``'s output (slicing the carry from one element lets
+      XLA dead-code-eliminate the rest of the computation).
+
+    Per-iteration time is the **slope** ``(t(iters_high) - t(iters_low)) /
+    (iters_high - iters_low)``, which cancels fixed dispatch/transfer
+    overhead exactly — required on tunneled devices where per-call overhead
+    is ~4-5 ms and ``block_until_ready`` is not a reliable execution fence.
+    Validated on v5e: 8192-cube bf16 matmul measures 189 TFLOP/s (96% of
+    peak) and a fused streaming read measures 102% of the 819 GB/s HBM spec.
+
+    ``fn`` takes the (perturbed) ``x`` plus ``rest`` and returns an array or
+    pytree.  Every large operand MUST be passed through ``rest`` (or ``x``),
+    never closed over: jit embeds closure-captured device arrays as HLO
+    constants, and a GB-scale KV cache serialized into the HLO blows up the
+    (remote) compile.  Reduction traffic is fused and adds no HBM round-trip
+    for the dominant input reads.
+    """
+    def _loop(n):
+        @jax.jit
+        def loop(x, *rest):
+            def body(i, carry):
+                # cast keeps x's dtype (bf16 + f32 would silently promote
+                # and benchmark an f32 kernel variant)
+                out = fn(x + (carry * 1e-30).astype(x.dtype), *rest)
+                leaves = jax.tree_util.tree_leaves(out)
+                return sum(
+                    jnp.sum(leaf.astype(jnp.float32)) for leaf in leaves
+                ) * 1e-30
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+        return loop
+
+    lo, hi = _loop(iters_low), _loop(iters_high)
+    float(lo(x, *rest))  # compile both before timing
+    float(hi(x, *rest))
+    slopes = []
+    t_hi_min = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(lo(x, *rest))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(hi(x, *rest))
+        t_hi = time.perf_counter() - t0
+        t_hi_min = min(t_hi_min, t_hi)
+        slopes.append((t_hi - t_lo) / (iters_high - iters_low))
+    slope = float(np.median(slopes))
+    if slope <= 0:
+        # kernel faster than dispatch jitter: fall back to the amortized
+        # upper bound rather than reporting nonsense throughput
+        return t_hi_min / iters_high
+    return slope
