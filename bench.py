@@ -70,7 +70,9 @@ def _bench_decode(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
     # Slope-fit in-jit loop timing: the only honest protocol through the
     # axon tunnel, where block_until_ready is not an execution fence and
     # per-dispatch overhead is ~4.5 ms (see bench_fn_device docstring).
-    t = bench_fn_device(lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc)
+    t = bench_fn_device(
+        lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc, repeats=5
+    )
     total_bytes = batch * attention_bytes(
         1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2
     )

@@ -25,11 +25,22 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
+def _bench(args, fn, *operands):
+    """Slope-fit device timing (see testing.bench_fn_device) — the plain
+    per-call timer reports dispatch overhead, not kernel time, through the
+    axon tunnel."""
+    from flashinfer_tpu.testing import bench_fn_device
+
+    hi = max(args.iters, 3)
+    lo = max(hi // 4, 1)
+    return bench_fn_device(fn, *operands, iters_low=lo, iters_high=hi, repeats=2)
+
+
 def _rows_decode(args):
     import jax
     import jax.numpy as jnp
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import attention_bytes, bench_fn
+    from flashinfer_tpu.testing import attention_bytes
 
     dtype = jnp.bfloat16
     hq, hkv, hd, ps = args.num_qo_heads, args.num_kv_heads, args.head_dim, 16
@@ -45,7 +56,7 @@ def _rows_decode(args):
             q = jax.random.normal(jax.random.PRNGKey(2), (bs, hq, hd), dtype)
             w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
             w.plan(indptr, idx, last, hq, hkv, hd, ps)
-            t = bench_fn(lambda: w.run(q, (kc, vc)), warmup=3, iters=args.iters)
+            t = _bench(args, lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc)
             tb = bs * attention_bytes(1, ctx, hq, hkv, hd, hd, 2) / t / 1e12
             yield dict(routine="decode", config=f"bs{bs}_ctx{ctx}",
                        latency_us=t * 1e6, tbps=tb, tflops="")
@@ -55,7 +66,7 @@ def _rows_prefill(args):
     import jax
     import jax.numpy as jnp
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import attention_flops, bench_fn
+    from flashinfer_tpu.testing import attention_flops
 
     dtype = jnp.bfloat16
     hq, hkv, hd = args.num_qo_heads, args.num_kv_heads, args.head_dim
@@ -63,9 +74,10 @@ def _rows_prefill(args):
         q = jax.random.normal(jax.random.PRNGKey(0), (ctx, hq, hd), dtype)
         k = jax.random.normal(jax.random.PRNGKey(1), (ctx, hkv, hd), dtype)
         v = jax.random.normal(jax.random.PRNGKey(2), (ctx, hkv, hd), dtype)
-        t = bench_fn(
-            lambda: fi.single_prefill_with_kv_cache(q, k, v, causal=True),
-            warmup=3, iters=args.iters,
+        t = _bench(
+            args,
+            lambda qq, kk, vv: fi.single_prefill_with_kv_cache(qq, kk, vv, causal=True),
+            q, k, v,
         )
         fl = attention_flops(ctx, ctx, hq, hd, hd, causal=True)
         yield dict(routine="prefill", config=f"ctx{ctx}",
@@ -76,12 +88,11 @@ def _rows_gemm(args):
     import jax
     import jax.numpy as jnp
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import bench_fn
 
     for n in args.gemm_sizes:
         a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
         b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
-        t = bench_fn(lambda: fi.mm_bf16(a, b), warmup=3, iters=args.iters)
+        t = _bench(args, lambda aa, bb: fi.mm_bf16(aa, bb), a, b)
         yield dict(routine="gemm_bf16", config=f"{n}x{n}x{n}",
                    latency_us=t * 1e6, tbps="", tflops=2 * n**3 / t / 1e12)
 
@@ -90,7 +101,6 @@ def _rows_moe(args):
     import jax
     import jax.numpy as jnp
     from flashinfer_tpu.fused_moe import fused_moe, route_renormalize
-    from flashinfer_tpu.testing import bench_fn
 
     T, E, K = args.moe_tokens, args.moe_experts, 2
     h, inter = args.moe_hidden, 4 * args.moe_hidden
@@ -99,8 +109,8 @@ def _rows_moe(args):
     w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h), jnp.bfloat16)
     logits = jax.random.normal(jax.random.PRNGKey(3), (T, E))
     wts, ids = route_renormalize(logits, K)
-    t = bench_fn(lambda: fused_moe(x, w1, w2, wts, ids, E), warmup=3,
-                 iters=args.iters)
+    t = _bench(args, lambda xx, ww1, ww2, wt, ii: fused_moe(xx, ww1, ww2, wt, ii, E),
+               x, w1, w2, wts, ids)
     fl = 2 * T * K * (h * 2 * inter + inter * h)
     yield dict(routine="moe", config=f"T{T}_E{E}_h{h}",
                latency_us=t * 1e6, tbps="", tflops=fl / t / 1e12)
@@ -110,14 +120,14 @@ def _rows_sampling(args):
     import jax
     import jax.numpy as jnp
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import bench_fn
 
     bs, vocab = args.sampling_batch, args.vocab
     logits = jax.random.normal(jax.random.PRNGKey(0), (bs, vocab))
     key = jax.random.PRNGKey(1)
-    t = bench_fn(
-        lambda: fi.top_k_top_p_sampling_from_logits(logits, key, 40, 0.9),
-        warmup=3, iters=args.iters,
+    t = _bench(
+        args,
+        lambda lg, kk: fi.top_k_top_p_sampling_from_logits(lg, kk, 40, 0.9),
+        logits, key,
     )
     yield dict(routine="sampling_topk_topp", config=f"bs{bs}_v{vocab}",
                latency_us=t * 1e6, tbps="", tflops="")

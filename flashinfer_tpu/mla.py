@@ -175,7 +175,10 @@ class BatchMLAPagedAttentionWrapper:
 
         # ragged path: gather + segment flash with asymmetric head dims
         ckv_rows = ckv_cache.reshape(-1, plan.head_dim_ckv)[plan.kv_rows]
-        kpe_rows = kpe_cache.reshape(-1, plan.head_dim_kpe)[plan.kv_rows]
+        # kpe cache may be lane-padded to 128 (TPU-native layout): slice back
+        kpe_rows = kpe_cache.reshape(-1, kpe_cache.shape[-1])[plan.kv_rows][
+            :, : plan.head_dim_kpe
+        ]
         k = jnp.concatenate([ckv_rows, kpe_rows], axis=-1)[:, None, :]  # MQA
         v = ckv_rows[:, None, :]
         q = jnp.concatenate(
@@ -211,7 +214,7 @@ class BatchMLAPagedAttentionWrapper:
         over per-token proxy scores; rows < 0 are masked padding."""
         d_ckv = ckv_cache.shape[-1]
         if sm_scale is None:
-            sm_scale = 1.0 / float(d_ckv + kpe_cache.shape[-1]) ** 0.5
+            sm_scale = 1.0 / float(d_ckv + q_pe.shape[-1]) ** 0.5
         return _sparse_mla_decode(
             q_nope, q_pe, ckv_cache, kpe_cache, sparse_rows,
             sm_scale=float(sm_scale), return_lse=return_lse,
@@ -240,6 +243,7 @@ def _sparse_mla_decode(
     valid = sparse_rows >= 0  # [batch, k]
     ckv = ckv_cache.reshape(-1, d_ckv)[rows].astype(jnp.float32)  # [B,k,d]
     kpe = kpe_cache.reshape(-1, kpe_cache.shape[-1])[rows].astype(jnp.float32)
+    kpe = kpe[..., : q_pe.shape[-1]]  # drop TPU lane padding if present
     s = (
         jnp.einsum("bhd,bkd->bhk", q_nope.astype(jnp.float32), ckv)
         + jnp.einsum("bhd,bkd->bhk", q_pe.astype(jnp.float32), kpe)

@@ -8,10 +8,12 @@ head_dim_kpe=64) — shared across all query heads (MQA-shaped).  Scores are
 ``q_nope . ckv + q_pe . kpe`` and values are the ckv latents themselves.
 
 Kernel consequences vs the GQA decode kernel (ops/paged_decode.py):
-- num_kv_heads == 1; ALL query heads form one MXU tile [H, 576].
-- K chunk = [chunk, 576] assembled from two DMAs (ckv | kpe columns);
-  the V matrix is the ckv half of the SAME buffer — no separate V DMA,
-  which matches the reference's bandwidth trick of reading ckv once.
+- num_kv_heads == 1; ALL query heads form one MXU tile.
+- ckv and kpe stream into separate double-buffered VMEM scratch (Mosaic
+  requires 128-aligned lane slices, so a packed [chunk, 576] buffer is
+  not DMA-addressable for the 64-wide kpe columns); scores are the sum
+  of two MXU dots, and the V matrix is the ckv buffer itself — no
+  separate V DMA, matching the reference's read-ckv-once trick.
 
 Cache layout: ckv ``[num_pages, page_size, head_dim_ckv]``,
 kpe ``[num_pages, page_size, head_dim_kpe]`` (reference MLA page layout).
@@ -35,20 +37,25 @@ _NEG_INF = -1e30
 def _mla_decode_kernel(
     pages_ref,  # [B, P] scalar prefetch
     kvlen_ref,  # [B]
-    q_ref,  # [Hp, 576] (nope | pe), pre-scaled
+    qn_ref,  # [Hp, d_ckv] pre-scaled
+    qp_ref,  # [Hp, d_kpe] pre-scaled
     ckv_hbm,
     kpe_hbm,
     o_ref,  # [Hp, 512]
     lse_ref,  # [Hp, 128]
-    k_buf,  # [2, chunk_tokens, 576]
+    ckv_buf,  # [2, chunk_tokens, d_ckv]
+    kpe_buf,  # [2, chunk_tokens, d_kpe]
     sem,  # [2, 2, ppc]
     *,
     page_size: int,
     ppc: int,
     d_ckv: int,
-    d_kpe: int,
     sm_scale: float,
 ):
+    # ckv and kpe live in SEPARATE scratch buffers: packing them into one
+    # [chunk, 576] buffer needs a 64-lane destination slice for the kpe DMA,
+    # which Mosaic rejects (lane slices must be 128-aligned).  Scores are
+    # the sum of two dots instead — same MXU work, no slicing.
     b = pl.program_id(0)
     kv_len = kvlen_ref[b]
     chunk_tokens = ppc * page_size
@@ -61,13 +68,13 @@ def _mla_decode_kernel(
             dst = pl.ds(j * page_size, page_size)
             dmas.append(
                 pltpu.make_async_copy(
-                    ckv_hbm.at[page], k_buf.at[slot, dst, pl.ds(0, d_ckv)],
+                    ckv_hbm.at[page], ckv_buf.at[slot, dst],
                     sem.at[slot, 0, j],
                 )
             )
             dmas.append(
                 pltpu.make_async_copy(
-                    kpe_hbm.at[page], k_buf.at[slot, dst, pl.ds(d_ckv, d_kpe)],
+                    kpe_hbm.at[page], kpe_buf.at[slot, dst],
                     sem.at[slot, 1, j],
                 )
             )
@@ -85,8 +92,9 @@ def _mla_decode_kernel(
     def _warmup():
         start_chunk(0, 0)
 
-    q = q_ref[...]  # [Hp, 576] in io dtype (pre-scaled by sm_scale on host)
-    hp = q.shape[0]
+    qn = qn_ref[...]  # pre-scaled by sm_scale on host
+    qp = qp_ref[...]
+    hp = qn.shape[0]
 
     def body(i, carry):
         m, l, acc = carry
@@ -97,9 +105,12 @@ def _mla_decode_kernel(
             start_chunk(i + 1, jax.lax.rem(i + 1, 2))
 
         wait_chunk(i, slot)
-        k = k_buf[slot]  # [chunk, 576]
+        ckv = ckv_buf[slot]  # [chunk, d_ckv]
+        kpe = kpe_buf[slot]  # [chunk, d_kpe]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            qn, ckv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            qp, kpe, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Hp, chunk]
         tok = i * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, (1, chunk_tokens), 1
@@ -111,9 +122,9 @@ def _mla_decode_kernel(
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        # V is the ckv half of the K buffer — no second value fetch
+        # V is ckv itself — no second value fetch
         pv = jax.lax.dot_general(
-            p.astype(k.dtype), k[:, :d_ckv], (((1,), (0,)), ((), ())),
+            p.astype(ckv.dtype), ckv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc * alpha + pv
@@ -150,6 +161,18 @@ def mla_paged_decode_attention(
     page_size = ckv_cache.shape[1]
     hp = max(round_up(num_heads, 8), 8)
 
+    # Mosaic page-DMAs need 128-aligned lane widths: the TPU-native kpe
+    # cache layout is lane-padded to 128 (store it that way — e.g. via
+    # page.append_mla_paged_kv_cache — to avoid this copy); q_pe's zero
+    # padding makes the pad columns contribute nothing to the scores.
+    d_kpe_pad = max(round_up(d_kpe, 128), 128)
+    if kpe_cache.shape[-1] != d_kpe_pad:
+        kpe_cache = jnp.pad(
+            kpe_cache, ((0, 0), (0, 0), (0, d_kpe_pad - kpe_cache.shape[-1]))
+        )
+    if q_pe.shape[-1] != d_kpe_pad:
+        q_pe = jnp.pad(q_pe, ((0, 0), (0, 0), (0, d_kpe_pad - d_kpe)))
+
     if pages_per_chunk is None:
         pages_per_chunk = max(1, min(256 // page_size, 16))
     max_pages = page_table.shape[1]
@@ -157,20 +180,20 @@ def mla_paged_decode_attention(
     if p_padded != max_pages:
         page_table = jnp.pad(page_table, ((0, 0), (0, p_padded - max_pages)))
 
-    # fold sm_scale into q (cheap host-side) and pack [nope | pe]
-    q = jnp.concatenate(
-        [q_nope.astype(jnp.float32), q_pe.astype(jnp.float32)], axis=-1
-    ) * sm_scale
-    q = q.astype(ckv_cache.dtype)
+    # fold sm_scale into q halves (cheap host-side)
+    qn = (q_nope.astype(jnp.float32) * sm_scale).astype(ckv_cache.dtype)
+    qp = (q_pe.astype(jnp.float32) * sm_scale).astype(ckv_cache.dtype)
     if hp != num_heads:
-        q = jnp.pad(q, ((0, 0), (0, hp - num_heads), (0, 0)))
+        qn = jnp.pad(qn, ((0, 0), (0, hp - num_heads), (0, 0)))
+        qp = jnp.pad(qp, ((0, 0), (0, hp - num_heads), (0, 0)))
 
     chunk_tokens = pages_per_chunk * page_size
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch,),
         in_specs=[
-            pl.BlockSpec((None, hp, d_ckv + d_kpe), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, hp, d_ckv), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, hp, d_kpe_pad), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -179,7 +202,8 @@ def mla_paged_decode_attention(
             pl.BlockSpec((None, hp, 128), lambda b, *_: (b, 0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, d_ckv + d_kpe), ckv_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, d_ckv), ckv_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, d_kpe_pad), ckv_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
         ],
     )
@@ -189,7 +213,6 @@ def mla_paged_decode_attention(
             page_size=page_size,
             ppc=pages_per_chunk,
             d_ckv=d_ckv,
-            d_kpe=d_kpe,
             sm_scale=sm_scale,
         ),
         grid_spec=grid_spec,
@@ -201,8 +224,8 @@ def mla_paged_decode_attention(
             vmem_limit_bytes=64 * 1024 * 1024
         ),
         interpret=use_interpret(),
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), q, ckv_cache,
-      kpe_cache)
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qn, qp,
+      ckv_cache, kpe_cache)
 
     out = out[:, :num_heads]
     if return_lse:
@@ -221,6 +244,7 @@ def xla_mla_paged_decode(
     max_kv = page_table.shape[1] * page_size
     ckv = ckv_cache[page_table].reshape(batch, max_kv, d_ckv).astype(jnp.float32)
     kpe = kpe_cache[page_table].reshape(batch, max_kv, -1).astype(jnp.float32)
+    kpe = kpe[..., : q_pe.shape[-1]]  # drop TPU lane padding if present
     s = (
         jnp.einsum("bhd,bkd->bhk", q_nope.astype(jnp.float32), ckv)
         + jnp.einsum("bhd,bkd->bhk", q_pe.astype(jnp.float32), kpe)

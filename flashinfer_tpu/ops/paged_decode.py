@@ -57,7 +57,6 @@ def _decode_kernel(
     sm_scale: float,
     logits_soft_cap: float,
     window_left: int,
-    nhd_cache: bool,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -69,12 +68,9 @@ def _decode_kernel(
         dmas = []
         for j in range(ppc):
             page = pages_ref[b, chunk_idx * ppc + j]
-            if nhd_cache:
-                k_src = k_hbm.at[page, :, h, :]
-                v_src = v_hbm.at[page, :, h, :]
-            else:
-                k_src = k_hbm.at[page, h]
-                v_src = v_hbm.at[page, h]
+            # NHD page layout: per-head strided DMA [PS, h, D]
+            k_src = k_hbm.at[page, :, h, :]
+            v_src = v_hbm.at[page, :, h, :]
             dst = pl.ds(j * page_size, page_size)
             dmas.append(
                 pltpu.make_async_copy(k_src, k_buf.at[slot, dst, :], sem.at[slot, 0, j])
@@ -143,11 +139,172 @@ def _decode_kernel(
     lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
+def _decode_kernel_fused_heads(
+    # scalar prefetch
+    pages_ref,  # [B, P] int32 page table (padded with a valid page id)
+    kvlen_ref,  # [B] int32
+    # inputs
+    q_ref,  # [Hkv, Gp, D] (block of [B, Hkv, Gp, D])
+    k_hbm,  # [num_pages, Hkv, PS, D] in ANY/HBM
+    v_hbm,
+    # outputs
+    o_ref,  # [Hkv, Gp, D]
+    lse_ref,  # [Hkv, Gp, 128]
+    # scratch
+    k_buf,  # [2, ppc, Hkv, PS, D]
+    v_buf,
+    sem,  # DMA sems [2, 2, ppc]
+    base_smem,  # [1] int32: slot parity carried across grid steps
+    *,
+    page_size: int,
+    ppc: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    num_kv_heads: int,
+    cross_step_prefetch: bool,
+):
+    """HND fast path: one DMA per whole page serves every KV head.
+
+    The per-(batch, kv_head) grid of ``_decode_kernel`` re-reads each page
+    once per head in 4 KB slices — 8x the DMA transactions the data needs.
+    Here the grid is ``(batch,)``; each 32 KB page ``[Hkv, PS, D]`` is
+    gathered once and all head groups are computed from it, with bf16 MXU
+    dots (f32 accumulate) instead of VPU upcasts.  This is the TPU analogue
+    of the reference's one-CTA-per-request split-KV decode kernel
+    (include/flashinfer/attention/decode.cuh:613) with its per-warp head
+    parallelism collapsed into the head loop of a single core.
+
+    Cross-step pipelining (``cross_step_prefetch``): each step issues the
+    *next* request's first chunk before finishing, carrying the live slot
+    parity across grid steps in SMEM (chunk counts differ per request, so
+    parity is data-dependent).  Measured OFF-by-default on v5e: the
+    dynamic slot indexing it forces costs more than the per-request
+    cold-start stall it hides (0.68 vs 0.75 TB/s at bs=64/ctx=4k) — kept
+    as an autotunable tactic for shapes with many short requests.
+    """
+    b = pl.program_id(0)
+    nb = pl.num_programs(0)
+    kv_len = kvlen_ref[b]
+    chunk_tokens = ppc * page_size
+    num_chunks = pl.cdiv(kv_len, chunk_tokens)
+    if cross_step_prefetch:
+        # kv_len == 0 still walks one (fully masked) chunk: the cross-step
+        # pipeline depends on every step consuming the chunk-0 DMA its
+        # predecessor issued (dangling semaphore signals otherwise)
+        num_chunks = jnp.maximum(num_chunks, 1)
+
+    def page_dmas(bb, chunk_idx, slot):
+        dmas = []
+        for j in range(ppc):
+            page = pages_ref[bb, chunk_idx * ppc + j]
+            dmas.append(
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_buf.at[slot, j], sem.at[slot, 0, j]
+                )
+            )
+            dmas.append(
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_buf.at[slot, j], sem.at[slot, 1, j]
+                )
+            )
+        return dmas
+
+    def start_chunk(bb, chunk_idx, slot):
+        for dma in page_dmas(bb, chunk_idx, slot):
+            dma.start()
+
+    def wait_chunk(bb, chunk_idx, slot):
+        for dma in page_dmas(bb, chunk_idx, slot):
+            dma.wait()
+
+    if cross_step_prefetch:
+        base = jnp.where(b == 0, 0, base_smem[0])
+
+        @pl.when(b == 0)
+        def _warmup():
+            start_chunk(b, 0, 0)
+    else:
+        base = 0
+
+        @pl.when(num_chunks > 0)
+        def _warmup():
+            start_chunk(b, 0, 0)
+
+    q = q_ref[...]  # [Hkv, Gp, D] native dtype
+    gp = q.shape[1]
+    head_dim = q.shape[2]
+
+    def body(i, carry):
+        m, l, acc = carry  # [Hkv, Gp, 1] x2, [Hkv, Gp, D]
+        slot = jax.lax.rem(base + i, 2)
+
+        @pl.when(i + 1 < num_chunks)
+        def _prefetch():
+            start_chunk(b, i + 1, jax.lax.rem(base + i + 1, 2))
+
+        wait_chunk(b, i, slot)
+        tok = i * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_tokens), 1
+        )
+        valid = tok < kv_len
+        if window_left >= 0:
+            valid = valid & (tok >= kv_len - 1 - window_left)
+
+        ss, pvs = [], []
+        for h in range(num_kv_heads):
+            kh = k_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            s = jax.lax.dot_general(
+                q[h], kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # [Gp, chunk_tokens] f32
+            if logits_soft_cap > 0.0:
+                s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+            ss.append(jnp.where(valid, s, _NEG_INF))
+        s_all = jnp.stack(ss)  # [Hkv, Gp, chunk]
+        m_cur = jnp.max(s_all, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p_all = jnp.where(valid[None], jnp.exp(s_all - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p_all, axis=-1, keepdims=True)
+        for h in range(num_kv_heads):
+            vh = v_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            pvs.append(
+                jax.lax.dot_general(
+                    p_all[h].astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        pv = jnp.stack(pvs)  # [Hkv, Gp, D]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((num_kv_heads, gp, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, gp, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, gp, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+
+    if cross_step_prefetch:
+        # hand the free slot to the next request's first chunk before the
+        # epilogue so its gather overlaps the output write + step transition
+        next_base = jax.lax.rem(base + num_chunks, 2)
+
+        @pl.when(b + 1 < nb)
+        def _prefetch_next_request():
+            start_chunk(b + 1, 0, next_base)
+
+        base_smem[0] = next_base
+
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
+    lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "sm_scale", "logits_soft_cap", "window_left", "kv_layout",
-        "pages_per_chunk", "return_lse",
+        "pages_per_chunk", "return_lse", "cross_step_prefetch",
     ),
 )
 def paged_decode_attention(
@@ -163,6 +320,7 @@ def paged_decode_attention(
     kv_layout: str = "HND",
     pages_per_chunk: Optional[int] = None,
     return_lse: bool = False,
+    cross_step_prefetch: bool = False,
 ):
     """Batched paged decode attention over a padded page table.
 
@@ -181,6 +339,12 @@ def paged_decode_attention(
 
     if pages_per_chunk is None:
         pages_per_chunk = max(1, min(512 // page_size, 16))
+        if kv_layout == "HND":
+            # fused-heads scratch scales with num_kv_heads: clamp the
+            # double-buffered K+V footprint (2 slots x 2 bufs x ppc x
+            # Hkv x PS x D) to ~8 MiB so large heads/pages still compile
+            per_page = 4 * num_kv_heads * page_size * head_dim * k_cache.dtype.itemsize
+            pages_per_chunk = max(1, min(pages_per_chunk, (8 << 20) // per_page))
     max_pages = page_table.shape[1]
     # pad page table columns to a multiple of pages-per-chunk
     p_padded = round_up(max_pages, pages_per_chunk)
@@ -192,35 +356,83 @@ def paged_decode_attention(
     if gp != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
 
-    kernel = functools.partial(
-        _decode_kernel,
-        page_size=page_size,
-        ppc=pages_per_chunk,
-        sm_scale=sm_scale,
-        logits_soft_cap=logits_soft_cap,
-        window_left=window_left,
-        nhd_cache=(kv_layout == "NHD"),
-    )
     chunk_tokens = pages_per_chunk * page_size
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(batch, num_kv_heads),
-        in_specs=[
-            pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, gp, 128), lambda b, h, *_: (b, h, 0, 0)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, head_dim), k_cache.dtype),
-            pltpu.VMEM((2, chunk_tokens, head_dim), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
-        ],
-    )
+    if kv_layout == "HND":
+        # head-fused fast path: one 32KB page DMA serves all KV heads
+        kernel = functools.partial(
+            _decode_kernel_fused_heads,
+            page_size=page_size,
+            ppc=pages_per_chunk,
+            sm_scale=sm_scale,
+            logits_soft_cap=logits_soft_cap,
+            window_left=window_left,
+            num_kv_heads=num_kv_heads,
+            cross_step_prefetch=cross_step_prefetch,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch,),
+            in_specs=[
+                pl.BlockSpec(
+                    (None, num_kv_heads, gp, head_dim),
+                    lambda b, *_: (b, 0, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (None, num_kv_heads, gp, head_dim),
+                    lambda b, *_: (b, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (None, num_kv_heads, gp, 128), lambda b, *_: (b, 0, 0, 0)
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                    k_cache.dtype,
+                ),
+                pltpu.VMEM(
+                    (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                    v_cache.dtype,
+                ),
+                pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+                pltpu.SMEM((1,), jnp.int32),
+            ],
+        )
+    else:
+        kernel = functools.partial(
+            _decode_kernel,
+            page_size=page_size,
+            ppc=pages_per_chunk,
+            sm_scale=sm_scale,
+            logits_soft_cap=logits_soft_cap,
+            window_left=window_left,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, num_kv_heads),
+            in_specs=[
+                pl.BlockSpec(
+                    (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
+                ),
+                pl.BlockSpec((None, None, gp, 128), lambda b, h, *_: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk_tokens, head_dim), k_cache.dtype),
+                pltpu.VMEM((2, chunk_tokens, head_dim), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+            ],
+        )
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

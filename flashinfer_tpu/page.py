@@ -126,7 +126,13 @@ def append_paged_mla_kv_cache(
     page_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """MLA (compressed-KV) paged append: ckv (latent, 512) + kpe (rope, 64)
-    caches (reference ``append_paged_mla_kv_cache``, page.cuh:441)."""
+    caches (reference ``append_paged_mla_kv_cache``, page.cuh:441).
+
+    ``kpe_cache`` may be allocated wider than ``append_kpe`` (the TPU-native
+    layout lane-pads kpe to 128 so the decode kernel can page-DMA it without
+    a per-call padded copy — see ops/mla_decode.py); the pad columns are
+    left untouched.
+    """
     ps = ckv_cache.shape[1]
     page_in_req = positions // ps
     slot = positions % ps
@@ -135,7 +141,8 @@ def append_paged_mla_kv_cache(
     cflat = ckv_cache.reshape(-1, ckv_cache.shape[-1])
     pflat = kpe_cache.reshape(-1, kpe_cache.shape[-1])
     cflat = cflat.at[rows].set(append_ckv.astype(cflat.dtype))
-    pflat = pflat.at[rows].set(append_kpe.astype(pflat.dtype))
+    kpe_dim = append_kpe.shape[-1]
+    pflat = pflat.at[rows, :kpe_dim].set(append_kpe.astype(pflat.dtype))
     return cflat.reshape(ckv_cache.shape), pflat.reshape(kpe_cache.shape)
 
 

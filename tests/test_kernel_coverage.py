@@ -75,3 +75,36 @@ def test_paged_decode_zero_len_request():
     o = paged_decode_attention(q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND")
     assert np.isfinite(np.asarray(o)).all()
     np.testing.assert_allclose(np.asarray(o[0]), 0.0, atol=1e-6)
+
+def test_paged_decode_nhd_layout():
+    """NHD cache routes to the per-(batch, head) strided-DMA kernel."""
+    B, HQ, HKV, D, PS, P = 2, 4, 2, 64, 8, 4
+    kc = jax.random.normal(jax.random.PRNGKey(0), (16, PS, HKV, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (16, PS, HKV, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.arange(8, dtype=jnp.int32).reshape(B, P)
+    lens = jnp.array([30, 25], jnp.int32)
+    o = paged_decode_attention(q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="NHD")
+    ref = xla_paged_decode(q, kc, vc, pt, lens, sm_scale=0.125)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("lens", [[30, 25, 60, 1], [0, 17, 64, 33]])
+def test_paged_decode_cross_step_prefetch(lens):
+    """The SMEM slot-parity pipeline must match the plain path for odd/even
+    and zero chunk counts per request."""
+    B, HQ, HKV, D, PS, P = 4, 4, 2, 64, 8, 8
+    kc = jax.random.normal(jax.random.PRNGKey(0), (32, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (32, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.arange(32, dtype=jnp.int32).reshape(B, P)
+    lens = jnp.array(lens, jnp.int32)
+    o = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2, cross_step_prefetch=True,
+    )
+    ref = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)

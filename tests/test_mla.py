@@ -136,3 +136,45 @@ def test_mla_append_cache_roundtrip():
         q_nope[0:1], q_pe[0:1], np.asarray(ckv_data[:5]), np.asarray(kpe_data[:5]), sm
     )
     np.testing.assert_allclose(np.asarray(out[0]), ref0[0], rtol=2e-3, atol=2e-3)
+
+
+def test_mla_padded_kpe_cache_layout():
+    """TPU-native kpe cache (lane-padded to 128): append writes the first 64
+    columns, decode matches the 64-wide reference layout bit-for-bit."""
+    import flashinfer_tpu.page as page
+    from flashinfer_tpu.ops.mla_decode import (
+        mla_paged_decode_attention, xla_mla_paged_decode,
+    )
+
+    B, H, d_ckv, d_kpe, PS = 2, 8, 128, 64, 8
+    n_pages = 8
+    key = jax.random.PRNGKey(0)
+    ckv = jax.random.normal(key, (n_pages, PS, d_ckv), jnp.float32)
+    kpe64 = jax.random.normal(jax.random.fold_in(key, 1), (n_pages, PS, d_kpe))
+    kpe128 = jnp.pad(kpe64, ((0, 0), (0, 0), (0, 128 - d_kpe)))
+    qn = jax.random.normal(jax.random.fold_in(key, 2), (B, H, d_ckv))
+    qp = jax.random.normal(jax.random.fold_in(key, 3), (B, H, d_kpe))
+    pt = jnp.arange(8, dtype=jnp.int32).reshape(B, 4)
+    lens = jnp.array([20, 9], jnp.int32)
+    sm = 1.0 / np.sqrt(d_ckv + d_kpe)
+
+    o64 = mla_paged_decode_attention(qn, qp, ckv, kpe64, pt, lens, sm_scale=sm)
+    o128 = mla_paged_decode_attention(qn, qp, ckv, kpe128, pt, lens, sm_scale=sm)
+    ref = xla_mla_paged_decode(qn, qp, ckv, kpe64, pt, lens, sm_scale=sm)
+    np.testing.assert_allclose(np.asarray(o64), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(o128), np.asarray(o64), rtol=1e-6, atol=1e-6)
+
+    # append into the padded layout touches only the first d_kpe columns
+    nnz = 5
+    new_ckv = jax.random.normal(jax.random.fold_in(key, 4), (nnz, d_ckv))
+    new_kpe = jax.random.normal(jax.random.fold_in(key, 5), (nnz, d_kpe))
+    bi = jnp.zeros((nnz,), jnp.int32)
+    pos = jnp.arange(nnz, dtype=jnp.int32)
+    kv_indices = jnp.arange(8, dtype=jnp.int32)
+    kv_indptr = jnp.array([0, 4, 8], jnp.int32)
+    _, kpe_out = page.append_paged_mla_kv_cache(
+        new_ckv, new_kpe, bi, pos, ckv, kpe128, kv_indices, kv_indptr)
+    assert kpe_out.shape == kpe128.shape
+    np.testing.assert_allclose(
+        np.asarray(kpe_out[0, :nnz, :d_kpe]), np.asarray(new_kpe), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(kpe_out[..., d_kpe:]), 0.0)
