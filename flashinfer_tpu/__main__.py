@@ -130,6 +130,12 @@ def cmd_replay(args) -> int:
             pos[int(m.group(1))] = load(f)
         elif f.stem.startswith("kw_"):
             kws[f.stem[3:]] = load(f)
+    for key, val in meta.get("scalars", {}).items():
+        m = re.fullmatch(r"arg(\d+)", key)
+        if m:
+            pos[int(m.group(1))] = val
+        elif key.startswith("kw_"):
+            kws[key[3:]] = val
     if meta.get("skipped"):
         print(f"cannot replay: args were not dumpable: {meta['skipped']}")
         return 1

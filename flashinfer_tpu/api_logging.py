@@ -47,9 +47,15 @@ def _dump(name: str, idx: int, args, kwargs) -> None:
 
     d = env.dump_dir() / f"{name}_{idx}"
     d.mkdir(parents=True, exist_ok=True)
-    meta = {"skipped": []}
+    meta = {"skipped": [], "scalars": {}}
 
     def save(key: str, a) -> None:
+        if a is None or isinstance(a, (bool, int, float, str)):
+            # static/scalar kwargs (causal flags, sm_scale, layout strings)
+            # must round-trip as native Python values: a 0-d numpy array is
+            # unhashable as a static jit arg and fails string checks
+            meta["scalars"][key] = a
+            return
         try:
             arr = np.asarray(a)
             if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:

@@ -148,8 +148,11 @@ def flash_attention(
     assert num_qo_heads % num_kv_heads == 0
     group = num_qo_heads // num_kv_heads
 
-    bq = min(block_q, total_q)
-    bkv = min(block_kv, total_kv)
+    # block shapes must stay tile-aligned for Mosaic: sublane multiples of
+    # 16 (bf16 tile) on the q axis, lane multiples of 128 on the kv axis
+    # (kv_seg/kv_pos ride the lane dim); padding below absorbs the tail
+    bq = min(block_q, round_up(total_q, 16))
+    bkv = min(block_kv, round_up(total_kv, 128))
     # pad token axes to block multiples: out-of-bounds block tails would
     # otherwise read undefined memory, and the padded segment ids (-1/-2)
     # keep padding masked out of every score

@@ -163,7 +163,7 @@ def mla_paged_decode_attention(
 
     # Mosaic page-DMAs need 128-aligned lane widths: the TPU-native kpe
     # cache layout is lane-padded to 128 (store it that way — e.g. via
-    # page.append_mla_paged_kv_cache — to avoid this copy); q_pe's zero
+    # page.append_paged_mla_kv_cache — to avoid this copy); q_pe's zero
     # padding makes the pad columns contribute nothing to the scores.
     d_kpe_pad = max(round_up(d_kpe, 128), 128)
     if kpe_cache.shape[-1] != d_kpe_pad:

@@ -339,12 +339,14 @@ def paged_decode_attention(
 
     if pages_per_chunk is None:
         pages_per_chunk = max(1, min(512 // page_size, 16))
-        if kv_layout == "HND":
-            # fused-heads scratch scales with num_kv_heads: clamp the
-            # double-buffered K+V footprint (2 slots x 2 bufs x ppc x
-            # Hkv x PS x D) to ~8 MiB so large heads/pages still compile
-            per_page = 4 * num_kv_heads * page_size * head_dim * k_cache.dtype.itemsize
-            pages_per_chunk = max(1, min(pages_per_chunk, (8 << 20) // per_page))
+    if kv_layout == "HND":
+        # fused-heads scratch scales with num_kv_heads: clamp the
+        # double-buffered K+V footprint (2 slots x 2 bufs x ppc x Hkv x
+        # PS x D) to ~8 MiB so large heads/pages still compile — applies
+        # to explicit/autotuned values too, which would otherwise exceed
+        # a v5e core's VMEM at e.g. Hkv=16, PS=16, ppc=64
+        per_page = 4 * num_kv_heads * page_size * head_dim * k_cache.dtype.itemsize
+        pages_per_chunk = max(1, min(pages_per_chunk, (8 << 20) // per_page))
     max_pages = page_table.shape[1]
     # pad page table columns to a multiple of pages-per-chunk
     p_padded = round_up(max_pages, pages_per_chunk)

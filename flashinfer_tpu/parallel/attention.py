@@ -121,10 +121,13 @@ def ring_attention(
         v_nxt = jax.lax.ppermute(v_cur, axis, perm)
         return (k_nxt, v_nxt, acc, lse), None
 
+    # carry the merge accumulator in f32 across all cp steps (casting to
+    # q.dtype per step would re-round to bf16 each rotation and degrade
+    # precision with cp size); single cast on exit
     acc0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((chunk, q.shape[1]), -1e30, jnp.float32)
     (k_f, v_f, acc, lse), _ = jax.lax.scan(
-        step, (k, v, acc0.astype(q.dtype), lse0), jnp.arange(cp)
+        step, (k, v, acc0, lse0), jnp.arange(cp)
     )
     return acc.astype(q.dtype)
 
