@@ -38,9 +38,11 @@ def _flash_kernel(
     k_ref,  # [bkv, head_dim]
     v_ref,  # [bkv, head_dim]
     q_seg_ref,  # [bq, 1] int32
-    kv_seg_ref,  # [bkv] int32 (1D: lives on lanes, no relayout needed)
+    kv_seg_ref,  # [1, bkv] int32 (lane-resident; 2-D because 1-D operands
+    # hit XLA-vs-Mosaic tiling mismatches at large sizes: XLA picks T(1024)
+    # for s32[4096] while Mosaic expects T(bkv))
     q_pos_ref,  # [bq, 1] int32
-    kv_pos_ref,  # [bkv] int32
+    kv_pos_ref,  # [1, bkv] int32
     # outputs (lse_ref only present when return_lse)
     *rest,
     sm_scale: float,
@@ -76,10 +78,10 @@ def _flash_kernel(
             s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
 
         q_seg = q_seg_ref[...]  # [bq, 1]
-        kv_seg = kv_seg_ref[...][None, :]  # [1, bkv] — lane broadcast, free
+        kv_seg = kv_seg_ref[...]  # [1, bkv] — lane broadcast, free
         mask = q_seg == kv_seg
         q_pos = q_pos_ref[...]
-        kv_pos = kv_pos_ref[...][None, :]
+        kv_pos = kv_pos_ref[...]
         if causal:
             mask = mask & (kv_pos <= q_pos)
         if window_left >= 0:
@@ -175,9 +177,9 @@ def flash_attention(
     vT = jnp.swapaxes(v, 0, 1)
 
     q_seg2 = q_seg.astype(jnp.int32).reshape(-1, 1)
-    kv_seg2 = kv_seg.astype(jnp.int32)
+    kv_seg2 = kv_seg.astype(jnp.int32).reshape(1, -1)
     q_pos2 = q_pos.astype(jnp.int32).reshape(-1, 1)
-    kv_pos2 = kv_pos.astype(jnp.int32)
+    kv_pos2 = kv_pos.astype(jnp.int32).reshape(1, -1)
 
     # conservative per-(q_blk, kv_blk) skip map: blocks provably all-masked
     # bypass both matmuls (the causal/segment block-sparsity that the
@@ -185,11 +187,11 @@ def flash_attention(
     # large sentinels so pad-only blocks fall out via segment disjointness.
     BIGQ, BIGK = 2**30, 2**30 + 5
     qss = jnp.where(q_seg2[:, 0] < 0, BIGQ, q_seg2[:, 0]).reshape(nq, bq)
-    kss = jnp.where(kv_seg2 < 0, BIGK, kv_seg2).reshape(nkv, bkv)
+    kss = jnp.where(kv_seg2[0] < 0, BIGK, kv_seg2[0]).reshape(nkv, bkv)
     qmin, qmax = qss.min(1), qss.max(1)
     kmin, kmax = kss.min(1), kss.max(1)
     qp = q_pos2[:, 0].reshape(nq, bq)
-    kp = kv_pos2.reshape(nkv, bkv)
+    kp = kv_pos2[0].reshape(nkv, bkv)
     skip = (kmin[None, :] > qmax[:, None]) | (kmax[None, :] < qmin[:, None])
     # position rules are only valid when both blocks sit in one common segment
     single_common = (
@@ -243,9 +245,9 @@ def flash_attention(
                 lambda h, i, j, *_: (h // group, j, 0),
             ),
             pl.BlockSpec((bq, 1), lambda h, i, j, *_: (i, 0)),
-            pl.BlockSpec((bkv,), lambda h, i, j, *_: (j,)),
+            pl.BlockSpec((1, bkv), lambda h, i, j, *_: (0, j)),
             pl.BlockSpec((bq, 1), lambda h, i, j, *_: (i, 0)),
-            pl.BlockSpec((bkv,), lambda h, i, j, *_: (j,)),
+            pl.BlockSpec((1, bkv), lambda h, i, j, *_: (0, j)),
         ],
         out_specs=out_specs,
         scratch_shapes=[

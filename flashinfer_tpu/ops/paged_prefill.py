@@ -24,9 +24,9 @@ may clobber the next request's rows, which later units then rewrite.
 ``build_prefill_work_units`` asserts the ordering; do not mark the unit
 dim "parallel".
 
-Status: interpret-validated; Mosaic hardware validation pending (see
-ROUND_NOTES.md TPU incident).  The wrapper keeps gather+flash as the
-default until then; select with ``backend="pallas_fused"``.
+Hardware-validated on v5e (tests/test_tpu_hw.py — mixed ragged batch with
+append semantics vs dense oracle) and the default paged-prefill backend
+for HND caches; the GQA group rides one merged [bq*group, chunk] MXU dot.
 """
 
 from __future__ import annotations
@@ -107,18 +107,18 @@ def _fused_prefill_kernel(
     qstart_ref, qlen_ref, qpos0_ref, kvstart_ref, kvlen_ref,
     first_ref, last_ref, pages_ref,
     # inputs (ANY)
-    q_hbm,  # [Tq_pad + bq, H, D]
+    q_hbm,  # [Hkv, Tq_pad + bq, group, D]
     k_hbm,  # [pages, Hkv, page_size, D] (HND)
     v_hbm,
     # output (ANY)
-    o_hbm,  # [Tq_pad + bq, H, D]
+    o_hbm,  # [Hkv, Tq_pad + bq, group, D]
     # scratch
     qbuf,  # [bq, group, D]
     kbuf,  # [2, chunk, D]
     vbuf,
     obuf,  # [bq, group, D]
-    acc_ref,  # [group, bq, D] f32
-    m_ref, l_ref,  # [group, bq, 128] f32
+    acc_ref,  # [bq*group, D] f32
+    m_ref, l_ref,  # [bq*group, 128] f32
     qsem, ksem, vsem, osem,
     *,
     bq: int,
@@ -147,13 +147,12 @@ def _fused_prefill_kernel(
         return dmas
 
     def q_dma(unit):
-        # all q heads of this kv head's group, one strided DMA
+        # all q heads of this kv head's group in one DMA: q is laid out
+        # [Hkv, tq, group, D] by the wrapper so the head dim is a full
+        # index, not a partial sublane slice (Mosaic requires 8-aligned
+        # sublane slices; group can be 4)
         return pltpu.make_async_copy(
-            q_hbm.at[
-                pl.ds(qstart_ref[unit], bq),
-                pl.ds(hkv * group, group),
-                :,
-            ],
+            q_hbm.at[hkv, pl.ds(qstart_ref[unit], bq)],
             qbuf, qsem,
         )
 
@@ -183,11 +182,15 @@ def _fused_prefill_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    # the whole GQA group rides one MXU dot: merged rows r = q_row*group+g,
+    # so the q-row of merged row r is r // group (computed by iota, no
+    # relayout), and [bq*group, D] -> [bq, group, D] is a free reshape
+    bqg = bq * group
+    rows_q = jax.lax.broadcasted_iota(jnp.int32, (bqg, 1), 0) // group
     cols = jax.lax.broadcasted_iota(jnp.int32, (1, chunk_tokens), 1)
-    q_pos = qpos0_ref[u] + rows
+    q_pos = qpos0_ref[u] + rows_q
     kv_pos = kvstart_ref[u] + cols
-    valid = (rows < qlen_ref[u]) & (kv_pos < kvlen_ref[u])
+    valid = (rows_q < qlen_ref[u]) & (kv_pos < kvlen_ref[u])
     if causal:
         valid = valid & (kv_pos <= q_pos)
     if window_left >= 0:
@@ -195,41 +198,37 @@ def _fused_prefill_kernel(
 
     k = kbuf[slot]
     v = vbuf[slot]
-    for g in range(group):  # static unroll over the GQA group
-        s = jax.lax.dot_general(
-            qbuf[:, g, :], k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale
-        if logits_soft_cap > 0.0:
-            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
-        s = jnp.where(valid, s, _NEG_INF)
-        m_prev = m_ref[g][:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[g, :, :] = jnp.broadcast_to(
-            alpha * l_ref[g][:, :1] + jnp.sum(p, -1, keepdims=True),
-            (bq, 128),
-        )
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[g, :, :] = acc_ref[g] * alpha + pv
-        m_ref[g, :, :] = jnp.broadcast_to(m_new, (bq, 128))
+    qm = qbuf[...].reshape(bqg, k.shape[-1])  # [bq*group, D]
+    s = jax.lax.dot_general(
+        qm, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale  # [bq*group, chunk]
+    if logits_soft_cap > 0.0:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+    s = jnp.where(valid, s, _NEG_INF)
+    m_prev = m_ref[...][:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[...] = jnp.broadcast_to(
+        alpha * l_ref[...][:, :1] + jnp.sum(p, -1, keepdims=True),
+        (bqg, 128),
+    )
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, (bqg, 128))
 
     @pl.when((last_ref[u] == 1) & (qlen_ref[u] > 0))
     def _():
-        for g in range(group):
-            l = l_ref[g][:, :1]
-            obuf[:, g, :] = (
-                acc_ref[g] / jnp.where(l > 0, l, 1.0)
-            ).astype(obuf.dtype)
+        l = l_ref[...][:, :1]
+        o = (acc_ref[...] / jnp.where(l > 0, l, 1.0)).astype(obuf.dtype)
+        obuf[...] = o.reshape(obuf.shape)
         out_dma = pltpu.make_async_copy(
             obuf,
-            o_hbm.at[
-                pl.ds(qstart_ref[u], bq), pl.ds(hkv * group, group), :
-            ],
+            o_hbm.at[hkv, pl.ds(qstart_ref[u], bq)],
             osem,
         )
         out_dma.start()
@@ -261,8 +260,13 @@ def fused_paged_prefill(
     _, Hkv, page_size, _ = k_cache.shape
     group = H // Hkv
     chunk_tokens = pages_per_chunk * page_size
-    # extra block so full-bq tile DMAs at the tail stay in bounds
+    # extra block so full-bq tile DMAs at the tail stay in bounds; lay q
+    # out [Hkv, tq, group, D] so the kernel's per-unit q DMA indexes the
+    # kv-head dim instead of slicing a sub-sublane head range
     q_pad = jnp.pad(q, ((0, block_q), (0, 0), (0, 0)))
+    q_pad = jnp.transpose(
+        q_pad.reshape(total_q + block_q, Hkv, group, D), (1, 0, 2, 3)
+    )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=8,
@@ -278,9 +282,9 @@ def fused_paged_prefill(
             pltpu.VMEM((2, chunk_tokens, D), k_cache.dtype),
             pltpu.VMEM((2, chunk_tokens, D), v_cache.dtype),
             pltpu.VMEM((block_q, group, D), q.dtype),
-            pltpu.VMEM((group, block_q, D), jnp.float32),
-            pltpu.VMEM((group, block_q, 128), jnp.float32),
-            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q * group, D), jnp.float32),
+            pltpu.VMEM((block_q * group, 128), jnp.float32),
+            pltpu.VMEM((block_q * group, 128), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
@@ -295,7 +299,9 @@ def fused_paged_prefill(
             window_left=window_left, causal=causal, num_units=num_units,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((total_q + block_q, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (Hkv, total_q + block_q, group, D), q.dtype
+        ),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
             has_side_effects=True,
@@ -306,4 +312,7 @@ def fused_paged_prefill(
         plan["kvlen"], plan["first"], plan["last"], plan["pages"],
         q_pad, k_cache, v_cache,
     )
-    return out[:total_q]
+    # [Hkv, tq_pad, group, D] -> [tq, H, D]
+    return jnp.transpose(out[:, :total_q], (1, 0, 2, 3)).reshape(
+        total_q, H, D
+    )

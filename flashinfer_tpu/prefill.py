@@ -151,12 +151,14 @@ def build_multi_item_mask(
 
 @dataclass(frozen=True)
 class _PrefillPlan:
-    q_seg: jax.Array  # [Tq_pad] int32 (-1 pad)
-    q_pos: jax.Array  # [Tq_pad]
-    kv_seg: jax.Array  # [Tkv_pad] int32 (-2 pad)
-    kv_pos: jax.Array  # [Tkv_pad]
+    # token-axis fields are None in the "light" plan built for the fused
+    # paged backend (deferred to the gather-plan builder on first fallback)
+    q_seg: Optional[jax.Array]  # [Tq_pad] int32 (-1 pad)
+    q_pos: Optional[jax.Array]  # [Tq_pad]
+    kv_seg: Optional[jax.Array]  # [Tkv_pad] int32 (-2 pad)
+    kv_pos: Optional[jax.Array]  # [Tkv_pad]
     kv_gather_rows: Optional[jax.Array]  # [Tkv_pad] flat cache rows (paged)
-    out_scatter: jax.Array  # [Tq_pad] original token index (for unpad)
+    out_scatter: Optional[jax.Array]  # [Tq_pad] original token idx (unpad)
     total_q: int
     total_kv: int
     tq_pad: int
@@ -382,31 +384,48 @@ class BatchPrefillWithPagedKVCacheWrapper:
 
         tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
         tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
-        q_seg, q_pos, total_q = _build_token_axis(
-            qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
-        )
-        kv_seg, kv_pos, total_kv = _build_token_axis(
-            kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
-        )
-        # flat cache-row id for each flattened kv token (native planner)
-        from flashinfer_tpu import native
 
-        rows = native.paged_gather_plan(
-            kv_indptr, kv_indptr_pages, kv_indices, page_size, tkv_pad
+        def build_gather_plan() -> _PrefillPlan:
+            # token axes + flat gather rows — O(tkv_pad) host work that the
+            # fused default never consumes; built lazily on first fallback
+            q_seg, q_pos, total_q = _build_token_axis(
+                qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
+            )
+            kv_seg, kv_pos, total_kv = _build_token_axis(
+                kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
+            )
+            from flashinfer_tpu import native
+
+            rows = native.paged_gather_plan(
+                kv_indptr, kv_indptr_pages, kv_indices, page_size, tkv_pad
+            )
+            return _PrefillPlan(
+                q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
+                kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
+                kv_gather_rows=jnp.asarray(rows, dtype=jnp.int32),
+                out_scatter=jnp.arange(tq_pad, dtype=jnp.int32),
+                total_q=total_q, total_kv=total_kv,
+                tq_pad=tq_pad, tkv_pad=tkv_pad, batch_size=batch,
+                num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
+                head_dim=head_dim, page_size=page_size,
+                causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
+                logits_soft_cap=logits_soft_cap or 0.0,
+                window_left=window_left,
+            )
+
+        self._gather_plan_builder = build_gather_plan
+        use_fused = self._backend == "pallas_fused" or (
+            # hardware-validated default for the TPU-preferred HND layout;
+            # NHD would need a whole-cache transpose per run() to feed the
+            # fused kernel's contiguous page DMAs, so it keeps gather+flash.
+            # resolve_backend gates on is_tpu() and the env override, so
+            # off-TPU auto stays on compiled XLA and FLASHINFER_TPU_BACKEND
+            # =xla can force the fallback on TPU.
+            self._backend == "auto"
+            and check_kv_layout(self._kv_layout) == TensorLayout.HND
+            and resolve_backend("auto", "batch_prefill_paged") == "pallas"
         )
-        self._plan = _PrefillPlan(
-            q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
-            kv_seg=jnp.asarray(kv_seg), kv_pos=jnp.asarray(kv_pos),
-            kv_gather_rows=jnp.asarray(rows, dtype=jnp.int32),
-            out_scatter=jnp.arange(tq_pad, dtype=jnp.int32),
-            total_q=total_q, total_kv=total_kv,
-            tq_pad=tq_pad, tkv_pad=tkv_pad, batch_size=batch,
-            num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
-            head_dim=head_dim, page_size=page_size,
-            causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
-            logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
-        )
-        if self._backend == "pallas_fused":
+        if use_fused:
             from flashinfer_tpu.ops.paged_prefill import (
                 build_prefill_work_units,
             )
@@ -424,6 +443,23 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._fused_plan = (
                 {k: jnp.asarray(v) for k, v in units.items()}, statics,
             )
+            # light plan: config fields only — the heavy gather arrays are
+            # deferred to _gather_plan_builder on first fallback run()
+            self._plan = _PrefillPlan(
+                q_seg=None, q_pos=None, kv_seg=None, kv_pos=None,
+                kv_gather_rows=None,
+                out_scatter=None,
+                total_q=int(qo_indptr[-1]), total_kv=int(kv_indptr[-1]),
+                tq_pad=tq_pad, tkv_pad=tkv_pad, batch_size=batch,
+                num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
+                head_dim=head_dim, page_size=page_size,
+                causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
+                logits_soft_cap=logits_soft_cap or 0.0,
+                window_left=window_left,
+            )
+        else:
+            self._fused_plan = None
+            self._plan = build_gather_plan()
 
     def run(
         self,
@@ -439,7 +475,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             k_cache, v_cache = paged_kv_cache
         else:
             k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
-        if self._backend == "pallas_fused" and not return_lse:
+        if self._fused_plan is not None and not return_lse:
             # fused work-unit kernel: KV pages DMA'd straight from the cache
             from flashinfer_tpu.ops.paged_prefill import fused_paged_prefill
 
@@ -462,6 +498,10 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 **statics,
             )
             return out[:total_q]
+        if plan.kv_gather_rows is None:
+            # fused plan was active but this call needs the gather path
+            # (return_lse): materialize the deferred plan once
+            plan = self._plan = self._gather_plan_builder()
         if check_kv_layout(self._kv_layout) == TensorLayout.HND:
             k_cache = jnp.swapaxes(k_cache, 1, 2)
             v_cache = jnp.swapaxes(v_cache, 1, 2)
