@@ -117,6 +117,8 @@ from flashinfer_tpu.mhc import (  # noqa: F401
 )
 from flashinfer_tpu.page import (  # noqa: F401
     append_paged_kv_cache,
+    append_paged_kv_cache_quant_fp8,
+    append_paged_kv_cache_quant_int8,
     append_paged_mla_kv_cache,
     get_batch_indices_positions,
     get_seq_lens,

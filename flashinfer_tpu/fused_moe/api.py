@@ -139,6 +139,12 @@ class MoE:
             preferred_element_type=jnp.float32,
         )
         weights, ids = cfg.routing(logits, self.router_bias)
+        if cfg.quant.variant == QuantVariant.INT8 and cfg.ep_axis is None:
+            # native int8 MXU grouped GEMMs (no bf16 dequant copy)
+            return fused_moe(
+                x, self._wq1, self._wq2, weights, ids, cfg.num_experts,
+                cfg.activation, w1_scale=self._ws1, w2_scale=self._ws2,
+            )
         w1, w2 = self._weights()
         if cfg.ep_axis is None:
             return fused_moe(

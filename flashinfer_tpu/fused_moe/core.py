@@ -34,17 +34,45 @@ import jax.numpy as jnp
 from flashinfer_tpu.activation import silu_and_mul
 
 
+def _act(h1: jax.Array, activation: str) -> jax.Array:
+    if activation == "silu":
+        return silu_and_mul(h1)
+    if activation == "gelu":
+        d = h1.shape[-1] // 2
+        return (
+            jax.nn.gelu(h1[..., :d].astype(jnp.float32))
+            * h1[..., d:].astype(jnp.float32)
+        ).astype(h1.dtype)
+    raise ValueError(f"unknown activation {activation!r}")
+
+
+def _quant_rows_int8(x: jax.Array):
+    """Dynamic symmetric per-row int8 quantization (activation side)."""
+    from flashinfer_tpu.quantization import quantize_int8
+
+    return quantize_int8(x, axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("num_experts", "activation"))
 def fused_moe(
     hidden: jax.Array,  # [T, hidden]
-    w_gate_up: jax.Array,  # [E, hidden, 2*inter]
+    w_gate_up: jax.Array,  # [E, hidden, 2*inter] bf16 OR int8
     w_down: jax.Array,  # [E, inter, hidden]
     topk_weights: jax.Array,  # [T, K] f32
     topk_ids: jax.Array,  # [T, K] int32
     num_experts: int,
     activation: str = "silu",
+    w1_scale: Optional[jax.Array] = None,  # [E, 1, 2*inter] (int8 weights)
+    w2_scale: Optional[jax.Array] = None,  # [E, 1, hidden]
 ) -> jax.Array:
-    """Single-device fused MoE forward -> [T, hidden]."""
+    """Single-device fused MoE forward -> [T, hidden].
+
+    With int8 weights (+ per-channel scales), both grouped GEMMs run on the
+    native int8 MXU path (int8 x int8 -> int32, the v5e low-precision
+    story; reference analogue: fp8 cutlass_fused_moe, fused_moe/core.py:873)
+    with dynamic per-row activation quantization — weights cross HBM at
+    half width and the MXU runs at its doubled int8 rate.
+    """
     T, K = topk_ids.shape
     dtype = hidden.dtype
 
@@ -54,18 +82,26 @@ def fused_moe(
     x_sorted = hidden[inv_token]  # [T*K, hidden]
     group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
 
-    h1 = jax.lax.ragged_dot(x_sorted, w_gate_up, group_sizes)  # [T*K, 2I]
-    if activation == "silu":
-        a = silu_and_mul(h1)
-    elif activation == "gelu":
-        d = h1.shape[-1] // 2
-        a = (
-            jax.nn.gelu(h1[..., :d].astype(jnp.float32))
-            * h1[..., d:].astype(jnp.float32)
-        ).astype(h1.dtype)
+    if w_gate_up.dtype == jnp.int8:
+        assert w1_scale is not None and w2_scale is not None
+        expert_sorted = flat_expert[order]  # [T*K]
+        xq, xs = _quant_rows_int8(x_sorted)
+        h1i = jax.lax.ragged_dot(
+            xq, w_gate_up, group_sizes, preferred_element_type=jnp.int32
+        )
+        ws1 = w1_scale.reshape(num_experts, -1)[expert_sorted]  # [T*K, 2I]
+        h1 = (h1i.astype(jnp.float32) * xs * ws1).astype(dtype)
+        a = _act(h1, activation)
+        aq, as_ = _quant_rows_int8(a)
+        h2i = jax.lax.ragged_dot(
+            aq, w_down, group_sizes, preferred_element_type=jnp.int32
+        )
+        ws2 = w2_scale.reshape(num_experts, -1)[expert_sorted]  # [T*K, H]
+        h2 = h2i.astype(jnp.float32) * as_ * ws2
     else:
-        raise ValueError(f"unknown activation {activation!r}")
-    h2 = jax.lax.ragged_dot(a, w_down, group_sizes)  # [T*K, hidden]
+        h1 = jax.lax.ragged_dot(x_sorted, w_gate_up, group_sizes)  # [T*K, 2I]
+        a = _act(h1, activation)
+        h2 = jax.lax.ragged_dot(a, w_down, group_sizes)  # [T*K, hidden]
 
     # finalize: route each sorted row back to (token, choice) and weight-sum
     w_sorted = topk_weights.reshape(-1)[order].astype(jnp.float32)

@@ -46,6 +46,12 @@ class LlamaConfig:
     rope_theta: float = 5e5
     rms_eps: float = 1e-5
     dtype: object = jnp.bfloat16
+    # int8 KV serving path: allocate caches as int8 and set the static
+    # dequant scales (high_precision = int8 * scale); halves KV HBM traffic
+    # and benches ~29% faster than bf16 decode on v5e (test_quant_kv.py,
+    # .chip_probe measurements). bf16 caches ignore the scales.
+    kv_k_scale: float = 0.05
+    kv_v_scale: float = 0.05
 
     @staticmethod
     def llama3_8b(**over) -> "LlamaConfig":
@@ -116,22 +122,29 @@ def _attn_decode(
     page_in_req = positions // page_size
     slot = positions % page_size
     page_id = page_table[jnp.arange(B), page_in_req]
+    int8_kv = k_cache.dtype == jnp.int8
+    if int8_kv:
+        from flashinfer_tpu.quantization import quantize_symmetric_int8
+
+        k_w = quantize_symmetric_int8(k, cfg.kv_k_scale)
+        v_w = quantize_symmetric_int8(v, cfg.kv_v_scale)
+    else:
+        k_w, v_w = k.astype(k_cache.dtype), v.astype(v_cache.dtype)
     # scatter [B, kvh, hd] rows into [pages, kvh, page_size, hd]
-    k_cache = k_cache.at[page_id, :, slot, :].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[page_id, :, slot, :].set(v.astype(v_cache.dtype))
+    k_cache = k_cache.at[page_id, :, slot, :].set(k_w)
+    v_cache = v_cache.at[page_id, :, slot, :].set(v_w)
 
     kv_lens_inc = jnp.maximum(kv_lens, positions + 1)
     sm_scale = 1.0 / float(hd) ** 0.5
-    if use_pallas:
-        o = paged_decode_attention(
-            q, k_cache, v_cache, page_table, kv_lens_inc,
-            sm_scale=sm_scale, kv_layout="HND",
-        )
-    else:
-        o = xla_paged_decode(
-            q, k_cache, v_cache, page_table, kv_lens_inc,
-            sm_scale=sm_scale, kv_layout="HND",
-        )
+    if int8_kv:
+        sm_scale = sm_scale * cfg.kv_k_scale
+    fn = paged_decode_attention if use_pallas else xla_paged_decode
+    o = fn(
+        q, k_cache, v_cache, page_table, kv_lens_inc,
+        sm_scale=sm_scale, kv_layout="HND",
+    )
+    if int8_kv:
+        o = (o.astype(jnp.float32) * cfg.kv_v_scale).astype(q.dtype)
     return o.reshape(B, num_qo_heads * hd), (k_cache, v_cache)
 
 

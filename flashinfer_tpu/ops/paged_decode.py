@@ -254,6 +254,12 @@ def _decode_kernel_fused_heads(
         ss, pvs = [], []
         for h in range(num_kv_heads):
             kh = k_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            if kh.dtype != q.dtype:
+                # quantized (fp8/int8) KV: cache bytes cross HBM at half
+                # width, dequant is an in-register cast; the scalar
+                # k_scale/v_scale are folded into sm_scale / output by the
+                # wrapper (reference decode.py:2004 scale folding)
+                kh = kh.astype(q.dtype)
             s = jax.lax.dot_general(
                 q[h], kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -269,6 +275,8 @@ def _decode_kernel_fused_heads(
         l_new = alpha * l + jnp.sum(p_all, axis=-1, keepdims=True)
         for h in range(num_kv_heads):
             vh = v_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            if vh.dtype != q.dtype:
+                vh = vh.astype(q.dtype)
             pvs.append(
                 jax.lax.dot_general(
                     p_all[h].astype(vh.dtype), vh, (((1,), (0,)), ((), ())),

@@ -184,6 +184,40 @@ def append_paged_kv_cache_quant_fp8(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("kv_layout",))
+def append_paged_kv_cache_quant_int8(
+    append_key: jax.Array,  # [nnz, num_kv_heads, head_dim] high precision
+    append_value: jax.Array,
+    batch_indices: jax.Array,
+    positions: jax.Array,
+    paged_kv_cache: Tuple[jax.Array, jax.Array],  # int8 caches
+    kv_indices: jax.Array,
+    kv_indptr: jax.Array,
+    k_scale: jax.Array,  # scalar f32: high_precision = int8 * scale
+    v_scale: jax.Array,
+    kv_layout: str = "NHD",
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantize-and-append — int8 is the low-precision MXU
+    story on v5e/v5p (SURVEY §7: "FP8/FP4 → int8 fallback story"), so this
+    is the serving-path twin of ``append_paged_kv_cache_quant_fp8``.
+    Rows are divided by the running scales, rounded and saturated to
+    [-127, 127]; decode folds the scales back in via run(k_scale=,
+    v_scale=)."""
+    from flashinfer_tpu.quantization import quantize_symmetric_int8
+
+    k_cache, v_cache = paged_kv_cache
+    kq = quantize_symmetric_int8(append_key, k_scale)
+    vq = quantize_symmetric_int8(append_value, v_scale)
+    layout = check_kv_layout(kv_layout)
+    page_size = (
+        k_cache.shape[1] if layout == TensorLayout.NHD else k_cache.shape[2]
+    )
+    return _append_impl(
+        kq, vq, batch_indices, positions, k_cache, v_cache,
+        kv_indices, kv_indptr, kv_layout, page_size,
+    )
+
+
 def block_sparse_indices_to_vector_sparse_offsets(
     block_indices: jax.Array,
     indptr: jax.Array,

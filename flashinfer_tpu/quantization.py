@@ -84,6 +84,16 @@ def dequantize_fp8(q: jax.Array, scale: jax.Array, out_dtype=jnp.bfloat16):
     return (q.astype(jnp.float32) * scale).astype(out_dtype)
 
 
+def quantize_symmetric_int8(x, scale):
+    """Fixed-scale symmetric int8 quantize: round(x/scale) saturated to
+    [-127, 127].  The single definition of the int8 KV-cache value format —
+    quantizing appends and model-level cache writes must all match the
+    decode kernel's dequant (int8 * scale)."""
+    return jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+
+
 @functools.partial(jax.jit, static_argnames=("axis",))
 def quantize_int8(
     x: jax.Array, axis: int = -1

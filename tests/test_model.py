@@ -106,3 +106,45 @@ def test_sharded_decode_step_matches_single_device():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
     )
+
+
+def test_llama_decode_int8_kv_matches_bf16():
+    """int8 KV-cache serving path: per-layer quantizing append + in-kernel
+    dequant decode tracks the bf16-cache logits."""
+    from flashinfer_tpu.models.llama import (
+        LlamaConfig, init_llama_params, llama_decode_step,
+    )
+
+    cfg = LlamaConfig.tiny(kv_k_scale=0.02, kv_v_scale=0.02)
+    key = jax.random.PRNGKey(0)
+    params = init_llama_params(key, cfg)
+    B, P, PS = 2, 4, 16
+    npages = B * P
+    pt = jnp.arange(npages, dtype=jnp.int32).reshape(B, P)
+    tokens = jnp.array([3, 7], jnp.int32)
+
+    def caches(dtype):
+        return [
+            (jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim), dtype),
+             jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim), dtype))
+            for _ in range(cfg.num_layers)
+        ]
+
+    outs = {}
+    for dtype in (jnp.bfloat16, jnp.int8):
+        kv = caches(dtype)
+        kv_lens = jnp.zeros((B,), jnp.int32)
+        for step in range(3):
+            pos = jnp.full((B,), step, jnp.int32)
+            logits, kv = llama_decode_step(
+                params, cfg, tokens, pos, kv, pt, kv_lens)
+            kv_lens = kv_lens + 1
+        outs[str(dtype)] = np.asarray(logits, np.float32)
+    a, b = outs.values()
+    # logits track within quantization noise; the bf16 argmax token stays
+    # within noise of the int8 run's top logit (exact argmax equality is
+    # brittle when two logits are near-tied)
+    np.testing.assert_allclose(a, b, rtol=0.2, atol=0.2)
+    top_a = a.max(-1)
+    b_at_a = np.take_along_axis(b, a.argmax(-1)[:, None], -1)[:, 0]
+    assert (np.abs(b.max(-1) - b_at_a) < 0.1 + 0.05 * np.abs(top_a)).all()

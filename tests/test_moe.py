@@ -157,3 +157,49 @@ def test_fused_moe_ep_matches_single_device():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(single), rtol=2e-3, atol=2e-3
     )
+
+
+def test_fused_moe_int8_matches_bf16():
+    """Native int8 MXU grouped GEMM path vs bf16 within quant tolerance."""
+    from flashinfer_tpu.fused_moe import fused_moe, route_renormalize
+    from flashinfer_tpu.quantization import quantize_int8
+
+    T, E, K, H, I = 32, 4, 2, 64, 128
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (T, H), jnp.bfloat16)
+    w1 = jax.random.normal(jax.random.fold_in(key, 1), (E, H, 2 * I),
+                           jnp.bfloat16) * 0.1
+    w2 = jax.random.normal(jax.random.fold_in(key, 2), (E, I, H),
+                           jnp.bfloat16) * 0.1
+    logits = jax.random.normal(jax.random.fold_in(key, 3), (T, E))
+    wts, ids = route_renormalize(logits, K)
+
+    ref = np.asarray(fused_moe(x, w1, w2, wts, ids, E), np.float32)
+    w1q, w1s = quantize_int8(w1, axis=1)
+    w2q, w2s = quantize_int8(w2, axis=1)
+    out = fused_moe(x, w1q, w2q, wts, ids, E, w1_scale=w1s, w2_scale=w2s)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_moe_layer_int8_variant():
+    from flashinfer_tpu.fused_moe import (
+        MoE, MoEConfig, QuantConfig, QuantVariant, RoutingConfig,
+    )
+
+    T, E, K, H, I = 16, 4, 2, 64, 128
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (T, H), jnp.bfloat16)
+    rw = jax.random.normal(jax.random.fold_in(key, 1), (H, E), jnp.bfloat16)
+    w1 = jax.random.normal(jax.random.fold_in(key, 2), (E, H, 2 * I),
+                           jnp.bfloat16) * 0.1
+    w2 = jax.random.normal(jax.random.fold_in(key, 3), (E, I, H),
+                           jnp.bfloat16) * 0.1
+    cfg_bf = MoEConfig(num_experts=E, hidden_size=H, intermediate_size=I,
+                       routing=RoutingConfig(top_k=K))
+    cfg_i8 = MoEConfig(num_experts=E, hidden_size=H, intermediate_size=I,
+                       routing=RoutingConfig(top_k=K),
+                       quant=QuantConfig(variant=QuantVariant.INT8))
+    ref = np.asarray(MoE(cfg_bf, rw, w1, w2)(x), np.float32)
+    out = np.asarray(MoE(cfg_i8, rw, w1, w2)(x), np.float32)
+    np.testing.assert_allclose(out, ref, rtol=6e-2, atol=6e-2)

@@ -55,3 +55,55 @@ def test_fp4_paged_decode_close_to_fp32():
     np.testing.assert_allclose(
         np.asarray(out4), np.asarray(ref), rtol=0.3, atol=0.3
     )
+
+
+def test_int8_quantizing_append_roundtrip():
+    from flashinfer_tpu.page import append_paged_kv_cache_quant_int8
+
+    HKV, PS, D = 2, 8, 64
+    kc = jnp.zeros((4, PS, HKV, D), jnp.int8)
+    vc = jnp.zeros((4, PS, HKV, D), jnp.int8)
+    key = jax.random.PRNGKey(0)
+    newk = jax.random.normal(key, (3, HKV, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 1), (3, HKV, D))
+    bi = jnp.array([0, 0, 1], jnp.int32)
+    pos = jnp.array([0, 1, 9], jnp.int32)
+    kv_indices = jnp.array([2, 0, 1, 3], jnp.int32)
+    kv_indptr = jnp.array([0, 2, 4], jnp.int32)
+    # scales sized so ±4-sigma unit normals stay inside [-127, 127]
+    ks, vs = jnp.float32(0.035), jnp.float32(0.035)
+    kc2, vc2 = append_paged_kv_cache_quant_int8(
+        newk, newv, bi, pos, (kc, vc), kv_indices, kv_indptr, ks, vs)
+    got = np.asarray(kc2, np.float32)[2, 0] * float(ks)
+    np.testing.assert_allclose(got, np.asarray(newk[0]), atol=0.018)
+    # pos 9 of batch 1 -> page_in_req 1 -> kv_indices[2+1] = page 3, slot 1
+    got_v = np.asarray(vc2, np.float32)[3, 1] * float(vs)
+    np.testing.assert_allclose(got_v, np.asarray(newv[2]), atol=0.018)
+
+
+def test_int8_kv_paged_decode_matches_bf16():
+    """In-register dequant path of the fused HND decode kernel: int8 cache
+    + folded scales vs the bf16 cache result."""
+    from flashinfer_tpu.ops import paged_decode_attention
+
+    B, HQ, HKV, D, PS = 4, 8, 2, 128, 16
+    npages = 16
+    key = jax.random.PRNGKey(0)
+    kc = jax.random.normal(key, (npages, HKV, PS, D), jnp.bfloat16)
+    vc = jax.random.normal(jax.random.fold_in(key, 1), (npages, HKV, PS, D),
+                           jnp.bfloat16)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, HQ, D), jnp.bfloat16)
+    pt = jnp.arange(16, dtype=jnp.int32).reshape(B, 4)
+    lens = jnp.array([64, 17, 33, 1], jnp.int32)
+    sm = D ** -0.5
+    ref = np.asarray(
+        paged_decode_attention(q, kc, vc, pt, lens, sm_scale=sm,
+                               kv_layout="HND"), np.float32)
+    ks = float(np.abs(np.asarray(kc, np.float32)).max() / 127)
+    vs = float(np.abs(np.asarray(vc, np.float32)).max() / 127)
+    kq = jnp.clip(jnp.round(kc.astype(jnp.float32) / ks), -127, 127).astype(jnp.int8)
+    vq = jnp.clip(jnp.round(vc.astype(jnp.float32) / vs), -127, 127).astype(jnp.int8)
+    o = paged_decode_attention(q, kq, vq, pt, lens, sm_scale=sm * ks,
+                               kv_layout="HND")
+    o = np.asarray(o, np.float32) * vs
+    np.testing.assert_allclose(o, ref, rtol=2e-2, atol=2e-2)
