@@ -12,6 +12,7 @@ list-modules | tuner-status.
 from __future__ import annotations
 
 import argparse
+import json
 import shutil
 import sys
 
@@ -175,6 +176,31 @@ def cmd_tuner_status(_args) -> int:
     return 0
 
 
+def cmd_probe(args) -> int:
+    """Chip-health probe: compile a trivial kernel in a subprocess under a
+    timeout (the post-wedge recovery detector)."""
+    from flashinfer_tpu import compile_guard
+
+    r = compile_guard.probe(timeout_s=args.timeout)
+    print(json.dumps(r, indent=1))
+    return 0 if r["healthy"] else 1
+
+
+def cmd_quarantine(args) -> int:
+    from flashinfer_tpu import compile_guard
+
+    if args.clear is not None:
+        n = compile_guard.clear(args.clear or None)
+        print(f"cleared {n} quarantine entries")
+        return 0
+    q = compile_guard._load_qlist()
+    print(f"quarantine file: {compile_guard._qlist_path()}")
+    print(f"entries        : {len(q)}")
+    for fp, info in sorted(q.items()):
+        print(f"  {fp}  op={info.get('op')}  reason={info.get('reason')}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="flashinfer_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -192,6 +218,15 @@ def main(argv=None) -> int:
     sp = sub.add_parser("replay")
     sp.add_argument("dump_dir", help="a <op>_<idx> dir from LOGLEVEL=10 dumps")
     sp.set_defaults(fn=cmd_replay)
+    sp = sub.add_parser("probe")
+    sp.add_argument("--timeout", type=float, default=240.0)
+    sp.set_defaults(fn=cmd_probe)
+    sp = sub.add_parser("quarantine")
+    sp.add_argument(
+        "--clear", nargs="?", const="", default=None,
+        help="clear one fingerprint (or all with no value)",
+    )
+    sp.set_defaults(fn=cmd_quarantine)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,240 @@
+"""Mosaic compile-hang quarantine.
+
+TPU-first operational infrastructure with no direct reference counterpart
+(nearest analogue: the reference's compile-race regression protection,
+``tests/utils/test_load_cubin_compile_race_condition.py``, and its tactics
+blocklist).  On TPU the failure mode that matters is different: a bad
+Mosaic compile can wedge the *chip*, not just the process — after which
+every compile from any process hangs until the chip recovers.  One wedge
+must therefore cost one kernel slot, never a whole session:
+
+- Before the first compile of a kernel variant, a *pending marker*
+  (fingerprint, pid, timestamp) is written to the cache dir; it is removed
+  as soon as the compile+run completes.
+- On startup, a stale marker whose owning process is dead and whose age
+  exceeded the hang threshold is treated as evidence of a wedge: that
+  fingerprint is moved to the persistent quarantine list and subsequent
+  calls raise :class:`KernelQuarantined` (callers fall back to the XLA
+  path) instead of re-wedging the chip.
+- ``python -m flashinfer_tpu probe`` compiles a trivial kernel in a
+  subprocess under a timeout — the recovery detector.
+
+Fingerprints hash the op name, the kernel module's source text, and the
+launch statics, so editing the kernel (the fix) automatically clears its
+quarantine, while the same bad variant stays blocked across processes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import inspect
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+
+from flashinfer_tpu import env
+
+# a compile that survives this long without finishing is presumed wedged
+# when its process is found dead (normal Mosaic compiles take 20-60s)
+HANG_THRESHOLD_S = 180.0
+
+_seen_ok: set = set()
+_source_cache: Dict[str, str] = {}
+
+
+class KernelQuarantined(RuntimeError):
+    """Raised when a kernel variant is quarantined after a suspected
+    compile wedge; callers should fall back to their XLA path."""
+
+
+def _qdir() -> Path:
+    return env.cache_dir() / "quarantine"
+
+
+def _qlist_path() -> Path:
+    return _qdir() / "kernels.json"
+
+
+def _pending_dir() -> Path:
+    return _qdir() / "pending"
+
+
+def _module_source(module: Any) -> str:
+    key = getattr(module, "__name__", str(module))
+    if key not in _source_cache:
+        try:
+            _source_cache[key] = inspect.getsource(module)
+        except Exception:
+            _source_cache[key] = key
+    return _source_cache[key]
+
+
+def fingerprint(op_name: str, statics: Any, module: Any = None) -> str:
+    blob = op_name + "|" + repr(statics)
+    if module is not None:
+        blob += "|" + _module_source(module)
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def _load_qlist() -> Dict[str, dict]:
+    try:
+        return json.loads(_qlist_path().read_text())
+    except Exception:
+        return {}
+
+
+def _save_qlist(q: Dict[str, dict]) -> None:
+    _qdir().mkdir(parents=True, exist_ok=True)
+    _qlist_path().write_text(json.dumps(q, indent=1))
+
+
+def quarantine(fp: str, op_name: str, reason: str) -> None:
+    q = _load_qlist()
+    q[fp] = {"op": op_name, "reason": reason, "ts": time.time()}
+    _save_qlist(q)
+
+
+def clear(fp: Optional[str] = None) -> int:
+    """Remove one fingerprint (or all) from the quarantine list."""
+    q = _load_qlist()
+    n = len(q)
+    if fp is None:
+        q = {}
+    else:
+        q.pop(fp, None)
+    _save_qlist(q)
+    return n - len(q)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _sweep_stale_markers() -> None:
+    """Promote dead-process pending markers older than the hang threshold
+    into the quarantine list (the cross-process wedge detector)."""
+    d = _pending_dir()
+    if not d.is_dir():
+        return
+    now = time.time()
+    for p in d.glob("*.json"):
+        try:
+            info = json.loads(p.read_text())
+        except Exception:
+            p.unlink(missing_ok=True)
+            continue
+        if _pid_alive(int(info.get("pid", -1))):
+            continue
+        if now - float(info.get("ts", now)) >= HANG_THRESHOLD_S:
+            quarantine(
+                p.stem, info.get("op", "?"),
+                "stale compile marker from dead process "
+                f"(pid {info.get('pid')}, started {info.get('ts')})",
+            )
+        p.unlink(missing_ok=True)
+
+
+def _enabled() -> bool:
+    flag = os.environ.get("FLASHINFER_TPU_COMPILE_GUARD")
+    if flag is not None:
+        return flag not in ("0", "false", "")
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def guarded(
+    op_name: str,
+    statics: Any,
+    thunk: Callable[[], Any],
+    module: Any = None,
+):
+    """Run ``thunk`` under the quarantine protocol.
+
+    First sight of a (op, statics, kernel-source) fingerprint: check the
+    quarantine list, sweep stale markers, write a pending marker, run the
+    thunk to completion (``block_until_ready`` so the Mosaic compile is
+    inside the guarded window), then clear the marker.  Later calls with
+    the same fingerprint are zero-overhead pass-throughs."""
+    fp = fingerprint(op_name, statics, module)
+    if fp in _seen_ok or not _enabled():
+        return thunk()
+    _sweep_stale_markers()
+    if fp in _load_qlist():
+        raise KernelQuarantined(
+            f"{op_name} variant {fp} is quarantined after a suspected "
+            "compile wedge; falling back (clear with "
+            f"`python -m flashinfer_tpu quarantine --clear {fp}`)"
+        )
+    d = _pending_dir()
+    d.mkdir(parents=True, exist_ok=True)
+    marker = d / f"{fp}.json"
+    # O_EXCL: when two processes race to first-compile the same variant,
+    # only one owns the marker — the other must not erase it on success
+    # while the owner may still be mid-compile
+    owns_marker = False
+    try:
+        fd = os.open(marker, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        with os.fdopen(fd, "w") as fh:
+            fh.write(json.dumps(
+                {"op": op_name, "pid": os.getpid(), "ts": time.time()}
+            ))
+        owns_marker = True
+    except FileExistsError:
+        pass
+    try:
+        import jax
+
+        out = thunk()
+        jax.block_until_ready(out)
+    finally:
+        # reached on success or a *raising* failure; a hard hang leaves the
+        # marker for the next process's sweep — by design
+        if owns_marker:
+            with contextlib.suppress(OSError):
+                marker.unlink()
+    _seen_ok.add(fp)
+    return out
+
+
+def probe(timeout_s: float = 240.0) -> dict:
+    """Compile a trivial Pallas kernel in a subprocess under a timeout.
+
+    Returns ``{"healthy": bool, "elapsed": s, "detail": str}`` — the
+    recovery detector to run after a wedge before resuming kernel work."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...] * 2.0\n"
+        "x = jnp.ones((8, 128), jnp.float32)\n"
+        "y = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)\n"
+        "jax.block_until_ready(y)\n"
+        "print('PROBE_OK')\n"
+    )
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        ok = "PROBE_OK" in r.stdout
+        detail = r.stdout[-200:] if ok else (r.stderr or r.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        ok, detail = False, f"probe timed out after {timeout_s}s (chip wedged?)"
+    return {"healthy": ok, "elapsed": round(time.time() - t0, 1), "detail": detail}

@@ -270,13 +270,34 @@ class BatchDecodeWithPagedKVCacheWrapper:
                 )),
                 default=ppc_default,
             )
-            out = paged_decode_attention(
-                q, k_cache, v_cache, plan.page_table, plan.kv_lens,
-                sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
-                window_left=plan.window_left, kv_layout=self._kv_layout,
-                pages_per_chunk=int(ppc), return_lse=return_lse,
-            )
-        else:
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_decode as _pd_module
+
+            try:
+                out = compile_guard.guarded(
+                    "paged_decode",
+                    (plan.page_table.shape, plan.num_qo_heads,
+                     plan.num_kv_heads, plan.head_dim, plan.page_size,
+                     str(q.dtype), str(k_cache.dtype), int(ppc),
+                     self._kv_layout, return_lse,
+                     # every jit static that forces a fresh Mosaic compile
+                     # must be in the fingerprint, or the recompile runs
+                     # outside the guarded window
+                     float(sm_scale), float(plan.logits_soft_cap),
+                     int(plan.window_left)),
+                    lambda: paged_decode_attention(
+                        q, k_cache, v_cache, plan.page_table, plan.kv_lens,
+                        sm_scale=sm_scale,
+                        logits_soft_cap=plan.logits_soft_cap,
+                        window_left=plan.window_left,
+                        kv_layout=self._kv_layout,
+                        pages_per_chunk=int(ppc), return_lse=return_lse,
+                    ),
+                    module=_pd_module,
+                )
+            except compile_guard.KernelQuarantined:
+                backend = "xla"
+        if backend != "pallas":
             out = xla_paged_decode(
                 q, k_cache, v_cache, plan.page_table, plan.kv_lens,
                 sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,

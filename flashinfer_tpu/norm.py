@@ -28,6 +28,38 @@ from jax.experimental.pallas import tpu as pltpu
 from flashinfer_tpu.utils import cdiv, resolve_backend, use_interpret
 
 _ROW_BLOCK = 256
+# row-block tactic space: bandwidth-bound kernel, the knob trades grid
+# parallelism against per-invocation DMA size
+_ROW_BLOCK_CANDIDATES = (128, 256, 512, 1024)
+
+
+_row_block_memo: dict = {}
+
+
+def _tuned_row_block(n: int, hidden: int, dtype, op: str, runner) -> int:
+    """Autotuned Pallas row-block (reference tactic selection analogue);
+    shipped-config/default outside an autotune() context.  Resolved values
+    are memoized per (op, shape, dtype): rmsnorm is a microsecond-scale op
+    called once per layer per step, so the hot path must not pay the
+    tuner's lock + key-string + blocklist machinery every call."""
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    memo_key = (op, n, hidden, str(dtype))
+    tuner = AutoTuner.get()
+    if not tuner.tuning_enabled:
+        rb = _row_block_memo.get(memo_key)
+        if rb is not None:
+            return rb
+    rb = tuner.choose_one(
+        f"{op}.row_block",
+        (n, hidden, str(dtype)),
+        [c for c in _ROW_BLOCK_CANDIDATES if c <= max(n, 128)],
+        runner,
+        default=_ROW_BLOCK,
+    )
+    rb = min(int(rb), n)
+    _row_block_memo[memo_key] = rb
+    return rb
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float, weight_bias: float):
@@ -49,8 +81,13 @@ def _fused_add_rms_kernel(
     o_ref[...] = (y * w[None, :]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "weight_bias", "backend"))
-def _rmsnorm_impl(x, weight, eps: float, weight_bias: float, backend: str):
+@functools.partial(
+    jax.jit, static_argnames=("eps", "weight_bias", "backend", "row_block")
+)
+def _rmsnorm_impl(
+    x, weight, eps: float, weight_bias: float, backend: str,
+    row_block: Optional[int] = None,
+):
     orig_shape = x.shape
     hidden = orig_shape[-1]
     x2 = x.reshape(-1, hidden)
@@ -61,7 +98,7 @@ def _rmsnorm_impl(x, weight, eps: float, weight_bias: float, backend: str):
         y = xf * jax.lax.rsqrt(var + eps)
         out = (y * (weight.astype(jnp.float32) + weight_bias)).astype(x.dtype)
         return out.reshape(orig_shape)
-    rb = min(_ROW_BLOCK, n)
+    rb = min(row_block or _ROW_BLOCK, n)
     out = pl.pallas_call(
         functools.partial(_rms_kernel, eps=eps, weight_bias=weight_bias),
         grid=(cdiv(n, rb),),
@@ -76,8 +113,13 @@ def _rmsnorm_impl(x, weight, eps: float, weight_bias: float, backend: str):
     return out.reshape(orig_shape)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "weight_bias", "backend"))
-def _fused_add_rmsnorm_impl(x, residual, weight, eps, weight_bias, backend):
+@functools.partial(
+    jax.jit, static_argnames=("eps", "weight_bias", "backend", "row_block")
+)
+def _fused_add_rmsnorm_impl(
+    x, residual, weight, eps, weight_bias, backend,
+    row_block: Optional[int] = None,
+):
     orig_shape = x.shape
     hidden = orig_shape[-1]
     x2 = x.reshape(-1, hidden)
@@ -89,7 +131,7 @@ def _fused_add_rmsnorm_impl(x, residual, weight, eps, weight_bias, backend):
         y = s * jax.lax.rsqrt(var + eps)
         out = (y * (weight.astype(jnp.float32) + weight_bias)).astype(x.dtype)
         return out.reshape(orig_shape), s.astype(residual.dtype).reshape(orig_shape)
-    rb = min(_ROW_BLOCK, n)
+    rb = min(row_block or _ROW_BLOCK, n)
     out, res = pl.pallas_call(
         functools.partial(_fused_add_rms_kernel, eps=eps, weight_bias=weight_bias),
         grid=(cdiv(n, rb),),
@@ -122,7 +164,12 @@ def rmsnorm(
 
     Reference: ``flashinfer.norm.rmsnorm`` (flashinfer/norm/, norm.cuh:37).
     """
-    return _rmsnorm_impl(x, weight, eps, 0.0, resolve_backend(backend, "rmsnorm"))
+    be = resolve_backend(backend, "rmsnorm")
+    rb = _tuned_row_block(
+        x.size // x.shape[-1], x.shape[-1], x.dtype, "rmsnorm",
+        lambda c: (lambda: _rmsnorm_impl(x, weight, eps, 0.0, be, c)),
+    )
+    return _rmsnorm_impl(x, weight, eps, 0.0, be, rb)
 
 
 @flashinfer_api
@@ -147,9 +194,14 @@ def fused_add_rmsnorm(
     — the functional form of the reference's in-place
     ``fused_add_rmsnorm`` (norm.cuh FusedAddRMSNorm).
     """
-    return _fused_add_rmsnorm_impl(
-        x, residual, weight, eps, 0.0, resolve_backend(backend, "fused_add_rmsnorm")
+    be = resolve_backend(backend, "fused_add_rmsnorm")
+    rb = _tuned_row_block(
+        x.size // x.shape[-1], x.shape[-1], x.dtype, "fused_add_rmsnorm",
+        lambda c: (
+            lambda: _fused_add_rmsnorm_impl(x, residual, weight, eps, 0.0, be, c)
+        ),
     )
+    return _fused_add_rmsnorm_impl(x, residual, weight, eps, 0.0, be, rb)
 
 
 @flashinfer_api

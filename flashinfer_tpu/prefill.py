@@ -43,6 +43,67 @@ from flashinfer_tpu.utils import (
 _Q_PAD_SEG = -1
 _KV_PAD_SEG = -2
 
+# flash-kernel launch-geometry candidates: (block_q, block_kv).  The tactic
+# space the reference explores per-arch via jinja template instantiation
+# (prefill.cuh CTA_TILE_Q x CTA_TILE_KV) collapses on TPU to these two
+# Pallas grid block sizes; VMEM (scratch = bq x D f32 + 2 x bq x 128) and
+# MXU utilization trade off across them.
+_FLASH_BLOCK_CANDIDATES = (
+    (256, 512), (128, 512), (512, 512), (256, 1024), (128, 1024), (256, 256),
+)
+
+
+def _tuned_flash(
+    q, k, v, q_seg, kv_seg, q_pos, kv_pos, *,
+    causal, sm_scale, logits_soft_cap, window_left, return_lse,
+):
+    """flash_attention with autotuned (block_q, block_kv).
+
+    Zero-overhead outside an ``autotune()`` context: shipped v5e/v5p config
+    or defaults are used (reference AutoTuner.choose_one over kernel
+    tactics, autotuner.py:1419)."""
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    kwargs = dict(
+        causal=causal, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
+        window_left=window_left, return_lse=return_lse,
+    )
+    # pow2-bucketed token axes keep the tactic key space finite and make
+    # shipped-config keys hit across nearby lengths
+    key = (
+        next_power_of_two(max(q.shape[0], 16)),
+        next_power_of_two(max(k.shape[0], 128)),
+        q.shape[1], k.shape[1], q.shape[2], str(q.dtype), int(causal),
+    )
+    bq, bkv = AutoTuner.get().choose_one(
+        "flash_attention.blocks", key, _FLASH_BLOCK_CANDIDATES,
+        lambda c: (lambda: flash_attention(
+            q, k, v, q_seg, kv_seg, q_pos, kv_pos,
+            block_q=c[0], block_kv=c[1], **kwargs,
+        )),
+        default=_FLASH_BLOCK_CANDIDATES[0],
+    )
+    from flashinfer_tpu import compile_guard
+    from flashinfer_tpu.ops import flash_attention as _fa_module
+
+    try:
+        return compile_guard.guarded(
+            "flash_attention",
+            # key buckets shapes; the remaining jit statics must also be in
+            # the fingerprint so their recompiles stay inside the guard
+            (key, int(bq), int(bkv), float(sm_scale),
+             float(logits_soft_cap), int(window_left), bool(return_lse)),
+            lambda: flash_attention(
+                q, k, v, q_seg, kv_seg, q_pos, kv_pos,
+                block_q=int(bq), block_kv=int(bkv), **kwargs,
+            ),
+            module=_fa_module,
+        )
+    except compile_guard.KernelQuarantined:
+        return xla_ragged_attention(
+            q, k, v, q_seg, kv_seg, q_pos, kv_pos, **kwargs
+        )
+
 
 @flashinfer_api
 def single_prefill_with_kv_cache(
@@ -101,7 +162,7 @@ def single_prefill_with_kv_cache(
             window_left=window_left, sm_scale=sm_scale,
             logits_soft_cap=logits_soft_cap or 0.0, return_lse=return_lse,
         )
-    fn = flash_attention if backend == "pallas" else xla_ragged_attention
+    fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
     return fn(
         *args, causal=causal, sm_scale=sm_scale,
         logits_soft_cap=logits_soft_cap or 0.0,
@@ -310,7 +371,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
                 return_lse=return_lse, custom_mask=plan.custom_mask,
             )
         else:
-            fn = flash_attention if backend == "pallas" else xla_ragged_attention
+            fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
             out = fn(
                 q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
                 causal=plan.causal, sm_scale=plan.sm_scale,
@@ -429,10 +490,29 @@ class BatchPrefillWithPagedKVCacheWrapper:
             from flashinfer_tpu.ops.paged_prefill import (
                 build_prefill_work_units,
             )
+            from flashinfer_tpu.autotuner import AutoTuner
 
+            # (block_q, pages_per_chunk) comes from the shipped/tuned config;
+            # profiling happens in run() (inside autotune()) where live
+            # tensors exist, then the work-unit plan is rebuilt with the
+            # winner — the raw indptr arrays are kept for that rebuild.
+            fused_key = (
+                batch, tq_pad, num_qo_heads, num_kv_heads, head_dim,
+                page_size,
+            )
+            bq_u, ppc_u = AutoTuner.get().lookup(
+                "fused_prefill.blocks", fused_key,
+                default=(128, max(1, 128 // page_size)),
+            )
+            self._fused_raw = (
+                np.asarray(qo_indptr), np.asarray(kv_indptr_pages),
+                np.asarray(kv_indices), np.asarray(kv_lens), page_size,
+                fused_key,
+            )
+            self._fused_tuned = False
             units = build_prefill_work_units(
                 qo_indptr, kv_indptr_pages, kv_indices, kv_lens,
-                block_q=128, pages_per_chunk=max(1, 128 // page_size),
+                block_q=int(bq_u), pages_per_chunk=int(ppc_u),
                 page_size=page_size,
             )
             statics = dict(
@@ -490,14 +570,74 @@ class BatchPrefillWithPagedKVCacheWrapper:
             # gather path; pad rows are touched by no work unit)
             if total_q != plan.tq_pad:
                 q = jnp.pad(q, ((0, plan.tq_pad - total_q), (0, 0), (0, 0)))
-            out = fused_paged_prefill(
-                q, k_hnd, v_hnd, unit_plan,
-                sm_scale=plan.sm_scale,
-                logits_soft_cap=plan.logits_soft_cap,
-                window_left=plan.window_left, causal=plan.causal,
-                **statics,
-            )
-            return out[:total_q]
+
+            from flashinfer_tpu.autotuner import AutoTuner
+
+            tuner = AutoTuner.get()
+            if tuner.tuning_enabled and not self._fused_tuned:
+                self._fused_tuned = True
+                from flashinfer_tpu.ops.paged_prefill import (
+                    build_prefill_work_units,
+                )
+
+                qo_i, kvp_i, kvi_i, kvl_i, ps, fkey = self._fused_raw
+                cands = sorted({
+                    (bq_c, max(1, ct // ps))
+                    for bq_c in (64, 128, 256) for ct in (128, 256)
+                })
+
+                def _build(c):
+                    u = build_prefill_work_units(
+                        qo_i, kvp_i, kvi_i, kvl_i,
+                        block_q=c[0], pages_per_chunk=c[1], page_size=ps,
+                    )
+                    st = dict(
+                        num_units=u.pop("num_units"),
+                        block_q=u.pop("block_q"),
+                        pages_per_chunk=u.pop("pages_per_chunk"),
+                    )
+                    return {k2: jnp.asarray(v2) for k2, v2 in u.items()}, st
+
+                def _runner(c):
+                    up, st = _build(c)
+                    return lambda: fused_paged_prefill(
+                        q, k_hnd, v_hnd, up,
+                        sm_scale=plan.sm_scale,
+                        logits_soft_cap=plan.logits_soft_cap,
+                        window_left=plan.window_left, causal=plan.causal,
+                        **st,
+                    )
+
+                cur = (statics["block_q"], statics["pages_per_chunk"])
+                best = tuner.choose_one(
+                    "fused_prefill.blocks", fkey, cands, _runner, default=cur
+                )
+                best = (int(best[0]), int(best[1]))
+                if best != cur:
+                    self._fused_plan = _build(best)
+                    unit_plan, statics = self._fused_plan
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_prefill as _pp_module
+
+            try:
+                out = compile_guard.guarded(
+                    "fused_paged_prefill",
+                    (q.shape, k_hnd.shape, str(q.dtype), plan.causal,
+                     plan.window_left, float(plan.sm_scale),
+                     float(plan.logits_soft_cap),
+                     tuple(sorted(statics.items()))),
+                    lambda: fused_paged_prefill(
+                        q, k_hnd, v_hnd, unit_plan,
+                        sm_scale=plan.sm_scale,
+                        logits_soft_cap=plan.logits_soft_cap,
+                        window_left=plan.window_left, causal=plan.causal,
+                        **statics,
+                    ),
+                    module=_pp_module,
+                )
+                return out[:total_q]
+            except compile_guard.KernelQuarantined:
+                pass  # fall through to the gather + flash path below
         if plan.kv_gather_rows is None:
             # fused plan was active but this call needs the gather path
             # (return_lse): materialize the deferred plan once
@@ -517,7 +657,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             "pallas" if self._backend == "pallas_fused" else self._backend,
             "batch_prefill_paged",
         )
-        fn = flash_attention if backend == "pallas" else xla_ragged_attention
+        fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
         out = fn(
             q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
             causal=plan.causal, sm_scale=plan.sm_scale,

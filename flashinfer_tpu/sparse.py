@@ -122,8 +122,19 @@ def _xla_bsr_dense(q, k, v, p):
 
 
 class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
-    """Variable-block-size BSR (reference sparse.py:1075).  v1 routes
-    through the dense-mask xla path after expanding the variable blocks."""
+    """Variable-block-size BSR (reference sparse.py:1075, which lowers to
+    vector-sparse prefill).  TPU-native design: plan() re-tiles the variable
+    block structure onto fixed hardware tiles, emitting a fixed-size BSR
+    (tile indptr/cols) plus a full/partial flag per tile pair; run() feeds
+    the scalar-prefetch Pallas kernel (ops/block_sparse.py
+    ``vbsr_attention``) whose compute and KV DMA scale with the number of
+    overlapped tiles, not O(M*N).  Partially covered tiles reconstruct the
+    exact token mask in-kernel from per-token block ids and the block map.
+    Oversized block maps (VMEM-resident mask table > ~6 MiB) or degenerate
+    row spans fall back to the dense-mask xla path."""
+
+    _TR = 128  # q-tile rows
+    _TC = 128  # kv-tile cols
 
     def plan(
         self,
@@ -137,17 +148,109 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
         q_data_type=jnp.bfloat16,
         **_unused,
     ) -> None:
-        block_mask_map = np.asarray(block_mask_map)
-        rs = np.asarray(block_row_sz)
-        cs = np.asarray(block_col_sz)
-        mask = np.repeat(np.repeat(block_mask_map, rs, axis=0), cs, axis=1)
+        from flashinfer_tpu.utils import round_up
+
+        map_np = np.asarray(block_mask_map, dtype=bool)
+        rs = np.asarray(block_row_sz, dtype=np.int64)
+        cs = np.asarray(block_col_sz, dtype=np.int64)
+        MB, NB = map_np.shape
+        M, N = int(rs.sum()), int(cs.sum())
+        sm = get_sm_scale(head_dim, sm_scale)
+        TR, TC = self._TR, self._TC
+
+        Mpad, Npad = round_up(M, TR), round_up(N, TC)
+        # per-token variable-block ids; padding tokens get the sentinel id
+        # MB/NB whose map row/col is all-zero, so they mask out naturally
+        row_id = np.concatenate(
+            [np.repeat(np.arange(MB), rs), np.full(Mpad - M, MB)]
+        ).astype(np.int32)
+        col_id = np.concatenate(
+            [np.repeat(np.arange(NB), cs), np.full(Npad - N, NB)]
+        ).astype(np.int32)
+
+        MT, NT = Mpad // TR, Npad // TC
+        rb0 = row_id.reshape(MT, TR).min(1)
+        rb1 = row_id.reshape(MT, TR).max(1)
+        cb0 = col_id.reshape(NT, TC).min(1)
+        cb1 = col_id.reshape(NT, TC).max(1)
+        k_span = int(next_power_of_two(int((rb1 - rb0 + 1).max(initial=1))))
+
+        # integral image over the (sentinel-extended) block map gives the
+        # any/full coverage of every (q-tile, kv-tile) span in O(1)
+        ext = np.zeros((MB + 1, NB + 1), np.int64)
+        ext[:MB, :NB] = map_np
+        S = np.zeros((MB + 2, NB + 2), np.int64)
+        S[1:, 1:] = ext.cumsum(0).cumsum(1)
+        r0, r1 = rb0[:, None], rb1[:, None]
+        c0, c1 = cb0[None, :], cb1[None, :]
+        rect = S[r1 + 1, c1 + 1] - S[r0, c1 + 1] - S[r1 + 1, c0] + S[r0, c0]
+        area = (r1 - r0 + 1) * (c1 - c0 + 1)
+        any_t = rect > 0  # [MT, NT]
+        full_t = rect == area
+
+        nnz_per_row = any_t.sum(1)
+        max_nnz = int(next_power_of_two(int(nnz_per_row.max(initial=1))))
+        cols = np.zeros((MT, max_nnz), np.int32)
+        flags = np.zeros((MT, max_nnz), np.int32)
+        for i in range(MT):
+            js = np.nonzero(any_t[i])[0]
+            cols[i, : len(js)] = js
+            flags[i, : len(js)] = np.where(full_t[i, js], 1, 2)
+        indptr = np.concatenate([[0], np.cumsum(nnz_per_row)]).astype(np.int32)
+
+        # VMEM-resident block-map table: sentinel row/col + slack so the
+        # kernel's dynamic k_span row slice never reads out of bounds
+        MBpad = round_up(int(rb0.max(initial=0)) + k_span, 8)
+        MBpad = max(MBpad, round_up(MB + 1, 8))
+        NBpad = round_up(NB + 1, 128)
+        mappad = np.zeros((MBpad, NBpad), np.float32)
+        mappad[:MB, :NB] = map_np
+
+        use_kernel = (MBpad * NBpad * 4 <= 6 << 20) and k_span <= 32
         self._plan = dict(
-            dense_mask=jnp.asarray(mask),
-            sm_scale=get_sm_scale(head_dim, sm_scale),
+            variable=True, use_kernel=use_kernel,
+            M=M, N=N, Mpad=Mpad, Npad=Npad,
+            indptr=jnp.asarray(indptr),
+            cols=jnp.asarray(cols.reshape(-1)),
+            flags=jnp.asarray(flags.reshape(-1)),
+            rb0=jnp.asarray(rb0.astype(np.int32)),
+            row_id=jnp.asarray(row_id),
+            col_id=jnp.asarray(col_id),
+            block_map=jnp.asarray(mappad),
+            max_nnz=max_nnz, k_span=k_span, sm_scale=sm,
+            dense_mask=None,
+            map_np=map_np, rs=rs, cs=cs,
         )
+
+    def _dense_mask(self, p):
+        if p["dense_mask"] is None:
+            p["dense_mask"] = jnp.asarray(
+                np.repeat(np.repeat(p["map_np"], p["rs"], 0), p["cs"], 1)
+            )
+        return p["dense_mask"]
 
     def run(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         p = self._plan
         if p is None:
             raise RuntimeError("plan() must be called before run()")
-        return _dense_masked_attention(q, k, v, p["dense_mask"], p["sm_scale"])
+        backend = resolve_backend(self._backend, "block_sparse")
+        if backend != "pallas" or not p["use_kernel"]:
+            return _dense_masked_attention(
+                q, k, v, self._dense_mask(p), p["sm_scale"]
+            )
+        from flashinfer_tpu.ops.block_sparse import vbsr_attention
+
+        M, N = p["M"], p["N"]
+        if q.shape[0] != p["Mpad"]:
+            q = jnp.pad(q, ((0, p["Mpad"] - M), (0, 0), (0, 0)))
+        if k.shape[0] != p["Npad"]:
+            k = jnp.pad(k, ((0, p["Npad"] - N), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, p["Npad"] - N), (0, 0), (0, 0)))
+        out = vbsr_attention(
+            q, k, v, p["indptr"], p["cols"], p["flags"], p["rb0"],
+            p["row_id"], p["col_id"], p["block_map"],
+            block_row=self._TR, block_col=self._TC,
+            max_nnz=p["max_nnz"], k_span=p["k_span"],
+            sm_scale=p["sm_scale"],
+        )
+        return out[:M]

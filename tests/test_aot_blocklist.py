@@ -89,3 +89,66 @@ def test_cli_prewarm(tmp_path):
         capture_output=True, text=True, env=env, timeout=240,
     )
     assert r.returncode == 0, r.stderr
+
+
+# ---- compile-hang quarantine (compile_guard.py) --------------------------
+
+
+def test_compile_guard_pass_and_quarantine(tmp_path, monkeypatch):
+    import json, os, time
+    from flashinfer_tpu import compile_guard as cg
+
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("FLASHINFER_TPU_COMPILE_GUARD", "1")
+    cg._seen_ok.clear()
+
+    calls = []
+    out = cg.guarded("demo_op", ("k", 1), lambda: calls.append(1) or 7)
+    assert out == 7 and calls == [1]
+    # marker cleared on success, fingerprint remembered
+    assert not list((tmp_path / "quarantine" / "pending").glob("*.json"))
+    fp = cg.fingerprint("demo_op", ("k", 1))
+    assert fp in cg._seen_ok
+
+    # quarantined variant raises without running the thunk
+    cg._seen_ok.clear()
+    cg.quarantine(fp, "demo_op", "test")
+    import pytest as _pytest
+
+    with _pytest.raises(cg.KernelQuarantined):
+        cg.guarded("demo_op", ("k", 1), lambda: calls.append(2))
+    assert calls == [1]
+    # clear() lifts it
+    assert cg.clear(fp) == 1
+    assert cg.guarded("demo_op", ("k", 1), lambda: 9) == 9
+
+
+def test_compile_guard_stale_marker_sweep(tmp_path, monkeypatch):
+    """A pending marker from a dead process older than the hang threshold is
+    promoted to the quarantine list — one wedge costs one kernel slot."""
+    import json, time
+    from flashinfer_tpu import compile_guard as cg
+
+    monkeypatch.setenv("FLASHINFER_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("FLASHINFER_TPU_COMPILE_GUARD", "1")
+    cg._seen_ok.clear()
+
+    fp = cg.fingerprint("wedgy_op", ("shape", 2))
+    d = tmp_path / "quarantine" / "pending"
+    d.mkdir(parents=True)
+    (d / f"{fp}.json").write_text(json.dumps(
+        {"op": "wedgy_op", "pid": 2**22 + 12345,  # certainly dead
+         "ts": time.time() - 2 * cg.HANG_THRESHOLD_S}
+    ))
+    import pytest as _pytest
+
+    with _pytest.raises(cg.KernelQuarantined):
+        cg.guarded("wedgy_op", ("shape", 2), lambda: 1)
+    q = json.loads((tmp_path / "quarantine" / "kernels.json").read_text())
+    assert fp in q
+    # a *young* dead marker is NOT quarantined (interrupted run, not a hang)
+    fp2 = cg.fingerprint("fine_op", ())
+    (d / f"{fp2}.json").write_text(json.dumps(
+        {"op": "fine_op", "pid": 2**22 + 12345, "ts": time.time() - 5}
+    ))
+    assert cg.guarded("fine_op", (), lambda: 3) == 3
