@@ -81,9 +81,55 @@ def _bench_decode(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
     return t, tbps, toks_per_s
 
 
+def _bench_sampling(batch, vocab=128 * 1024, backend="pallas"):
+    """Joint top-k/top-p filtered sampling latency at LLM vocab size
+    (reference bench: sorting-free rejection kernels, sampling.cuh:293).
+    ``backend="pallas"`` = single-pass VMEM threshold-bisection kernel;
+    ``"xla"`` = the sort-based oracle form."""
+    from flashinfer_tpu.sampling import (
+        _top_k_top_p_filter_xla, sampling_from_probs,
+    )
+    from flashinfer_tpu.ops.sampling_kernels import threshold_select
+    from flashinfer_tpu.testing import bench_fn_device
+
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (batch, vocab), jnp.float32) * 4.0
+    probs = jax.nn.softmax(logits, axis=-1)
+    k = jnp.full((batch,), 40.0, jnp.float32)
+    tp = jnp.full((batch,), 0.95, jnp.float32)
+
+    if backend == "pallas":
+        fn = lambda p, kk: sampling_from_probs(
+            threshold_select(p, k, tp, mode="top_k_top_p_seq"), kk
+        )
+    else:
+        fn = lambda p, kk: sampling_from_probs(
+            _top_k_top_p_filter_xla(p, k.astype(jnp.int32), tp, False), kk
+        )
+    t = bench_fn_device(fn, probs, jax.random.PRNGKey(1), repeats=5)
+    return t
+
+
 def main():
     sweep = "--sweep" in sys.argv
     headline = None
+    sampling_us = None
+    try:
+        if sweep:
+            for bs in (1, 16, 64):
+                tk = _bench_sampling(bs, backend="pallas") * 1e6
+                tx = _bench_sampling(bs, backend="xla") * 1e6
+                if bs == 64:
+                    sampling_us = tk  # headline reuses the sweep pass
+                print(
+                    f"# sampling 128k-vocab bs={bs:3d}: kernel {tk:8.1f} us"
+                    f"  xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)",
+                    file=sys.stderr,
+                )
+        else:
+            sampling_us = _bench_sampling(64) * 1e6
+    except Exception as e:  # sampling bench must never sink the headline
+        print(f"# sampling bench failed: {e!r}", file=sys.stderr)
     if sweep:
         # the reference bench_batch_decode.py sweep grid (bs x seqlen)
         for bs in (1, 16, 64, 256):
@@ -98,16 +144,15 @@ def main():
                 )
     t, tbps = headline if headline else _bench_decode(64, 4096)[:2]
     peak = chip_peak_tbps()
-    print(
-        json.dumps(
-            {
-                "metric": "batch_decode_attention_bandwidth_bs64_ctx4k",
-                "value": round(tbps, 4),
-                "unit": "TB/s",
-                "vs_baseline": round(tbps / peak, 4),
-            }
-        )
-    )
+    result = {
+        "metric": "batch_decode_attention_bandwidth_bs64_ctx4k",
+        "value": round(tbps, 4),
+        "unit": "TB/s",
+        "vs_baseline": round(tbps / peak, 4),
+    }
+    if sampling_us is not None:
+        result["sampling_128k_bs64_us"] = round(sampling_us, 1)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

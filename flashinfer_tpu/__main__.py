@@ -62,6 +62,13 @@ def cmd_module_status(_args) -> int:
     xc = env.cache_dir() / "xla_cache"
     n = sum(1 for _ in xc.rglob("*") if _.is_file()) if xc.exists() else 0
     print(f"xla compile cache: {n} entries ({xc})")
+    from flashinfer_tpu import compile_guard
+
+    reg = compile_guard.compile_status()
+    q = compile_guard._load_qlist()
+    print(f"kernel compiles  : {len(reg)} recorded, {len(q)} quarantined")
+    for fp, info in sorted(reg.items(), key=lambda kv: -kv[1].get("ts", 0))[:10]:
+        print(f"  {fp}  {info['op']:<24} {info['compile_s']:7.2f}s  {info['status']}")
     return 0
 
 

@@ -131,6 +131,7 @@ class AutoTuner:
         candidates: Sequence[Any],
         runner: Callable[[Any], Callable[[], Any]],
         default: Any = None,
+        module: Any = None,  # kernel module for wedge-quarantine fingerprints
     ) -> Any:
         """Pick the best candidate for (op, shape_key).
 
@@ -177,8 +178,11 @@ class AutoTuner:
                 # while profiling this tactic blocklists it for the next
                 # process); the extra warm call keeps compile time and
                 # first-run allocator noise out of every timing rep
+                # module-inclusive fingerprint: a kernel edit (the fix for a
+                # wedge) must automatically clear a tuning-time quarantine
                 compile_guard.guarded(
-                    op_name, (tuple(map(str, shape_key)), cand), f
+                    op_name, (tuple(map(str, shape_key)), cand), f,
+                    module=module,
                 )
                 jax.block_until_ready(f())
                 dt = float("inf")

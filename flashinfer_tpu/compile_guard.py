@@ -42,7 +42,9 @@ from flashinfer_tpu import env
 HANG_THRESHOLD_S = 180.0
 
 _seen_ok: set = set()
-_source_cache: Dict[str, str] = {}
+_seen_bad: set = set()  # quarantined fps already reported this process
+_source_digest_cache: Dict[str, str] = {}
+_fp_cache: Dict[tuple, str] = {}
 
 
 class KernelQuarantined(RuntimeError):
@@ -62,21 +64,31 @@ def _pending_dir() -> Path:
     return _qdir() / "pending"
 
 
-def _module_source(module: Any) -> str:
+def _module_source_digest(module: Any) -> str:
     key = getattr(module, "__name__", str(module))
-    if key not in _source_cache:
+    if key not in _source_digest_cache:
         try:
-            _source_cache[key] = inspect.getsource(module)
+            src = inspect.getsource(module)
         except Exception:
-            _source_cache[key] = key
-    return _source_cache[key]
+            src = key
+        _source_digest_cache[key] = hashlib.sha256(src.encode()).hexdigest()
+    return _source_digest_cache[key]
 
 
 def fingerprint(op_name: str, statics: Any, module: Any = None) -> str:
-    blob = op_name + "|" + repr(statics)
-    if module is not None:
-        blob += "|" + _module_source(module)
-    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+    # memoized per (op, statics-repr, module): the steady-state guarded()
+    # pass-through sits on µs-scale decode hot paths and must not re-hash
+    # kernel source text per call
+    mkey = getattr(module, "__name__", None) if module is not None else None
+    ck = (op_name, repr(statics), mkey)
+    fp = _fp_cache.get(ck)
+    if fp is None:
+        blob = ck[0] + "|" + ck[1]
+        if module is not None:
+            blob += "|" + _module_source_digest(module)
+        fp = hashlib.sha256(blob.encode()).hexdigest()[:24]
+        _fp_cache[ck] = fp
+    return fp
 
 
 def _load_qlist() -> Dict[str, dict]:
@@ -103,8 +115,10 @@ def clear(fp: Optional[str] = None) -> int:
     n = len(q)
     if fp is None:
         q = {}
+        _seen_bad.clear()
     else:
         q.pop(fp, None)
+        _seen_bad.discard(fp)
     _save_qlist(q)
     return n - len(q)
 
@@ -171,8 +185,15 @@ def guarded(
     fp = fingerprint(op_name, statics, module)
     if fp in _seen_ok or not _enabled():
         return thunk()
+    if fp in _seen_bad:
+        # quarantined variants sit on per-step fallback paths: one disk
+        # read per process, not per call
+        raise KernelQuarantined(
+            f"{op_name} variant {fp} is quarantined (cached)"
+        )
     _sweep_stale_markers()
     if fp in _load_qlist():
+        _seen_bad.add(fp)
         raise KernelQuarantined(
             f"{op_name} variant {fp} is quarantined after a suspected "
             "compile wedge; falling back (clear with "
@@ -194,6 +215,7 @@ def guarded(
         owns_marker = True
     except FileExistsError:
         pass
+    t0 = time.time()
     try:
         import jax
 
@@ -206,7 +228,38 @@ def guarded(
             with contextlib.suppress(OSError):
                 marker.unlink()
     _seen_ok.add(fp)
+    _record_status(fp, op_name, time.time() - t0)
     return out
+
+
+def _status_path() -> Path:
+    return _qdir() / "compile_status.json"
+
+
+def _record_status(fp: str, op_name: str, duration: float) -> None:
+    """Compile-status registry (reference jit-core's module status role):
+    every first compile that completed under the guard, with its duration —
+    ``python -m flashinfer_tpu module-status`` surfaces it."""
+    try:
+        try:
+            reg = json.loads(_status_path().read_text())
+        except Exception:
+            reg = {}
+        reg[fp] = {
+            "op": op_name, "status": "ok",
+            "compile_s": round(duration, 2), "ts": round(time.time(), 1),
+        }
+        _qdir().mkdir(parents=True, exist_ok=True)
+        _status_path().write_text(json.dumps(reg, indent=1))
+    except Exception:
+        pass  # telemetry must never break the op
+
+
+def compile_status() -> Dict[str, dict]:
+    try:
+        return json.loads(_status_path().read_text())
+    except Exception:
+        return {}
 
 
 def probe(timeout_s: float = 240.0) -> dict:
@@ -228,13 +281,20 @@ def probe(timeout_s: float = 240.0) -> dict:
         "print('PROBE_OK')\n"
     )
     t0 = time.time()
+    # Popen + bounded reaps, not subprocess.run: a wedged compile can leave
+    # the child unkillable (stuck in tunnel I/O), and run()'s internal
+    # post-kill wait() would then hang the *prober* too
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        ok = "PROBE_OK" in r.stdout
-        detail = r.stdout[-200:] if ok else (r.stderr or r.stdout)[-500:]
+        out, err = p.communicate(timeout=timeout_s)
+        ok = "PROBE_OK" in out
+        detail = out[-200:] if ok else (err or out)[-500:]
     except subprocess.TimeoutExpired:
+        p.kill()
+        with contextlib.suppress(Exception):
+            p.communicate(timeout=10)
         ok, detail = False, f"probe timed out after {timeout_s}s (chip wedged?)"
     return {"healthy": ok, "elapsed": round(time.time() - t0, 1), "detail": detail}

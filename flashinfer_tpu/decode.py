@@ -250,6 +250,8 @@ class BatchDecodeWithPagedKVCacheWrapper:
             # autotuned pages-per-chunk (reference AutoTuner.choose_one role;
             # zero overhead outside an autotune() context — cached/default)
             from flashinfer_tpu.autotuner import AutoTuner
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_decode as _pd_module
 
             ppc_default = max(1, min(512 // plan.page_size, 16))
             candidates = sorted({
@@ -269,9 +271,8 @@ class BatchDecodeWithPagedKVCacheWrapper:
                     pages_per_chunk=c, return_lse=return_lse,
                 )),
                 default=ppc_default,
+                module=_pd_module,
             )
-            from flashinfer_tpu import compile_guard
-            from flashinfer_tpu.ops import paged_decode as _pd_module
 
             try:
                 out = compile_guard.guarded(

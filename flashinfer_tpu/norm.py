@@ -50,12 +50,15 @@ def _tuned_row_block(n: int, hidden: int, dtype, op: str, runner) -> int:
         rb = _row_block_memo.get(memo_key)
         if rb is not None:
             return rb
+    import flashinfer_tpu.norm as _norm_module
+
     rb = tuner.choose_one(
         f"{op}.row_block",
         (n, hidden, str(dtype)),
         [c for c in _ROW_BLOCK_CANDIDATES if c <= max(n, 128)],
         runner,
         default=_ROW_BLOCK,
+        module=_norm_module,
     )
     rb = min(int(rb), n)
     _row_block_memo[memo_key] = rb

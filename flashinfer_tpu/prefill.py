@@ -64,6 +64,8 @@ def _tuned_flash(
     tactics, autotuner.py:1419)."""
     from flashinfer_tpu.autotuner import AutoTuner
 
+    from flashinfer_tpu.ops import flash_attention as _fa_module
+
     kwargs = dict(
         causal=causal, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
         window_left=window_left, return_lse=return_lse,
@@ -82,9 +84,9 @@ def _tuned_flash(
             block_q=c[0], block_kv=c[1], **kwargs,
         )),
         default=_FLASH_BLOCK_CANDIDATES[0],
+        module=_fa_module,
     )
     from flashinfer_tpu import compile_guard
-    from flashinfer_tpu.ops import flash_attention as _fa_module
 
     try:
         return compile_guard.guarded(
@@ -557,6 +559,8 @@ class BatchPrefillWithPagedKVCacheWrapper:
             k_cache, v_cache = paged_kv_cache[:, 0], paged_kv_cache[:, 1]
         if self._fused_plan is not None and not return_lse:
             # fused work-unit kernel: KV pages DMA'd straight from the cache
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_prefill as _pp_module
             from flashinfer_tpu.ops.paged_prefill import fused_paged_prefill
 
             if check_kv_layout(self._kv_layout) == TensorLayout.NHD:
@@ -610,14 +614,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
 
                 cur = (statics["block_q"], statics["pages_per_chunk"])
                 best = tuner.choose_one(
-                    "fused_prefill.blocks", fkey, cands, _runner, default=cur
+                    "fused_prefill.blocks", fkey, cands, _runner, default=cur,
+                    module=_pp_module,
                 )
                 best = (int(best[0]), int(best[1]))
                 if best != cur:
                     self._fused_plan = _build(best)
                     unit_plan, statics = self._fused_plan
-            from flashinfer_tpu import compile_guard
-            from flashinfer_tpu.ops import paged_prefill as _pp_module
 
             try:
                 out = compile_guard.guarded(

@@ -6,10 +6,17 @@ TPU-native re-design of the reference sampling family
 API mapping notes:
 - JAX is functional: every sampling op takes an explicit PRNG ``key`` instead
   of the reference's implicit ``generator``/``philox`` state.
-- The reference's sorting-free dual-pivot rejection kernels exist to avoid
-  GPU-global sorts; on TPU we use XLA's native ``top_k``/``sort`` (efficient
-  on v5p) for the renorm/mask family and Gumbel-argmax for sampling — same
-  distributions, hardware-appropriate algorithms.  fp32 throughout.
+- The reference's sorting-free dual-pivot rejection kernels
+  (sampling.cuh:293-1519) exist to avoid GPU-global sorts.  The TPU
+  equivalent is the single-HBM-pass VMEM-resident threshold-bisection
+  kernel (``ops/sampling_kernels.py``) — the default (``backend="pallas"``)
+  for the renorm/mask/filter family on TPU.  The sort-based XLA forms
+  remain as the ``backend="xla"`` oracle.  Sampling itself is
+  Gumbel-argmax (``jax.random.categorical``) — already sort-free.
+  fp32 throughout.
+- Threshold tie semantics: like the reference kernels, *all* tokens tied
+  at the cut value are kept (a sort's arbitrary tie-cut differs only on
+  exactly-equal probabilities).
 """
 
 from __future__ import annotations
@@ -21,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from flashinfer_tpu.api_logging import flashinfer_api
+from flashinfer_tpu.utils import resolve_backend
 
 _NEG_INF = jnp.float32(-1e30)
 
@@ -80,11 +88,20 @@ def _as_batch_param(p, batch: int) -> jax.Array:
     return p
 
 
-@jax.jit
-def top_p_renorm_probs(probs: jax.Array, top_p) -> jax.Array:
-    """Renormalize to the smallest prefix of descending-sorted probs whose
-    mass reaches ``top_p``; everything else zeroed (reference
+def top_p_renorm_probs(probs: jax.Array, top_p, backend: str = "auto") -> jax.Array:
+    """Renormalize to the smallest threshold set of probs whose mass
+    reaches ``top_p``; everything else zeroed (reference
     ``top_p_renorm_probs``)."""
+    if resolve_backend(backend, "top_p_renorm_probs") == "pallas":
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        tp = _as_batch_param(top_p, probs.shape[0]).astype(jnp.float32)
+        return threshold_select(probs, tp, tp, mode="top_p")
+    return _top_p_renorm_probs_xla(probs, top_p)
+
+
+@jax.jit
+def _top_p_renorm_probs_xla(probs: jax.Array, top_p) -> jax.Array:
     p = probs.astype(jnp.float32)
     tp = _as_batch_param(top_p, p.shape[0]).astype(jnp.float32)[:, None]
     sorted_p = jnp.sort(p, axis=-1)[:, ::-1]
@@ -101,9 +118,18 @@ def top_p_renorm_probs(probs: jax.Array, top_p) -> jax.Array:
     return kept / jnp.sum(kept, axis=-1, keepdims=True)
 
 
-@jax.jit
-def top_k_renorm_probs(probs: jax.Array, top_k) -> jax.Array:
+def top_k_renorm_probs(probs: jax.Array, top_k, backend: str = "auto") -> jax.Array:
     """Keep the top-k probs and renormalize (reference ``top_k_renorm_probs``)."""
+    if resolve_backend(backend, "top_k_renorm_probs") == "pallas":
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        k = _as_batch_param(top_k, probs.shape[0]).astype(jnp.float32)
+        return threshold_select(probs, k, k, mode="top_k")
+    return _top_k_renorm_probs_xla(probs, top_k)
+
+
+@jax.jit
+def _top_k_renorm_probs_xla(probs: jax.Array, top_k) -> jax.Array:
     p = probs.astype(jnp.float32)
     batch, vocab = p.shape
     k = _as_batch_param(top_k, batch).astype(jnp.int32)
@@ -115,9 +141,18 @@ def top_k_renorm_probs(probs: jax.Array, top_k) -> jax.Array:
     return kept / jnp.sum(kept, axis=-1, keepdims=True)
 
 
-@jax.jit
-def top_k_mask_logits(logits: jax.Array, top_k) -> jax.Array:
+def top_k_mask_logits(logits: jax.Array, top_k, backend: str = "auto") -> jax.Array:
     """Mask all but the top-k logits to -inf (reference ``top_k_mask_logits``)."""
+    if resolve_backend(backend, "top_k_mask_logits") == "pallas":
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        k = _as_batch_param(top_k, logits.shape[0]).astype(jnp.float32)
+        return threshold_select(logits, k, k, mode="top_k_logits")
+    return _top_k_mask_logits_xla(logits, top_k)
+
+
+@jax.jit
+def _top_k_mask_logits_xla(logits: jax.Array, top_k) -> jax.Array:
     x = logits.astype(jnp.float32)
     batch, vocab = x.shape
     k = _as_batch_param(top_k, batch).astype(jnp.int32)
@@ -170,15 +205,28 @@ def min_p_sampling_from_probs(
     return sampling_from_probs(kept, key)
 
 
-@functools.partial(jax.jit, static_argnames=("joint",))
 def _top_k_top_p_filter(probs: jax.Array, top_k, top_p, joint: bool) -> jax.Array:
-    """Apply top-k and top-p filters with one shared sort.
+    """Apply top-k and top-p filters.
 
     ``joint=False`` ("top_k_first", reference default): top-k renorm first,
     then top-p measured on the *renormalized* distribution.  ``joint=True``:
     both filters measured on the original distribution (reference
-    flashinfer/sampling.py joint branch).
+    flashinfer/sampling.py joint branch).  On TPU this runs the
+    single-pass threshold kernel; off-TPU the one-shared-sort XLA form.
     """
+    if resolve_backend("auto", "top_k_top_p_filter") == "pallas":
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        batch = probs.shape[0]
+        k = _as_batch_param(top_k, batch).astype(jnp.float32)
+        tp = _as_batch_param(top_p, batch).astype(jnp.float32)
+        mode = "top_k_top_p_joint" if joint else "top_k_top_p_seq"
+        return threshold_select(probs, k, tp, mode=mode)
+    return _top_k_top_p_filter_xla(probs, top_k, top_p, joint)
+
+
+@functools.partial(jax.jit, static_argnames=("joint",))
+def _top_k_top_p_filter_xla(probs, top_k, top_p, joint: bool) -> jax.Array:
     p = probs.astype(jnp.float32)
     batch, vocab = p.shape
     k = _as_batch_param(top_k, batch).astype(jnp.int32)[:, None]

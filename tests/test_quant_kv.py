@@ -107,3 +107,59 @@ def test_int8_kv_paged_decode_matches_bf16():
                                kv_layout="HND")
     o = np.asarray(o, np.float32) * vs
     np.testing.assert_allclose(o, ref, rtol=2e-2, atol=2e-2)
+
+
+# ---- fused token-pair int4 decode kernel (ops/paged_decode_fp4.py) -------
+
+
+def test_int4_paged_quant_roundtrip():
+    from flashinfer_tpu.ops.paged_decode_fp4 import (
+        quantize_kv_int4_paged, dequantize_kv_int4_paged,
+    )
+
+    rng = np.random.default_rng(0)
+    kc = jnp.asarray(rng.standard_normal((5, 8, 16, 128)), jnp.float32)
+    k4, ksc = quantize_kv_int4_paged(kc)
+    assert k4.shape == (5, 8, 8, 128) and ksc.shape == (5, 128)
+    kd = dequantize_kv_int4_paged(k4, ksc)
+    # int4 symmetric: |err| <= scale/2 = amax/14 per (page, head, token)
+    amax = np.abs(np.asarray(kc)).max(-1)
+    bound = amax / 14 + 1e-6
+    err = np.abs(np.asarray(kd) - np.asarray(kc)).max(-1)
+    assert (err <= bound).all()
+
+
+@pytest.mark.parametrize("ppc", [2, 4])
+def test_fp4_fused_decode_vs_oracle(ppc):
+    """Fused int4 decode kernel (interpret) vs the dequantized-cache XLA
+    decode — the kernel itself must be numerically exact given the same
+    quantized cache (ragged lengths exercise the permuted validity mask)."""
+    from flashinfer_tpu.ops.paged_decode_fp4 import (
+        fp4_paged_decode_attention, quantize_kv_int4_paged,
+        dequantize_kv_int4_paged,
+    )
+    from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+
+    rng = np.random.default_rng(1)
+    B, HQ, HKV, D, PS, ctx = 3, 8, 2, 128, 16, 256
+    ppr = ctx // PS
+    P = B * ppr + 1
+    kc = jnp.asarray(rng.standard_normal((P, HKV, PS, D)) / 4, jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((P, HKV, PS, D)) / 4, jnp.float32)
+    k4, ksc = quantize_kv_int4_paged(kc)
+    v4, vsc = quantize_kv_int4_paged(vc)
+    table = jnp.arange(B * ppr, dtype=jnp.int32).reshape(B, ppr)
+    kv_lens = jnp.asarray([256, 130, 17], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, HQ, D)) / 4, jnp.float32)
+
+    out = fp4_paged_decode_attention(
+        q, k4, ksc, v4, vsc, table, kv_lens,
+        sm_scale=0.0883, pages_per_chunk=ppc,
+    )
+    ref = xla_paged_decode(
+        q, dequantize_kv_int4_paged(k4, ksc), dequantize_kv_int4_paged(v4, vsc),
+        table, kv_lens, sm_scale=0.0883, kv_layout="HND",
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )

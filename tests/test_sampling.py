@@ -129,3 +129,86 @@ def test_chain_speculative_sampling_all_reject():
     np.testing.assert_array_equal(np.asarray(emitted), 0)
     np.testing.assert_array_equal(np.asarray(out[:, 0]), 5)
     np.testing.assert_array_equal(np.asarray(out[:, 1:]), -1)
+
+
+# ---- sorting-free threshold kernel (ops/sampling_kernels.py) -------------
+
+
+class TestThresholdSelect:
+    """Single-pass VMEM bisection kernel vs the sort-based XLA oracles.
+
+    With continuous random inputs ties are measure-zero, so kept sets (and
+    hence outputs) must agree up to fp tolerance."""
+
+    def _probs(self, seed, batch=4, vocab=1000):
+        rng = np.random.default_rng(seed)
+        p = rng.random((batch, vocab)).astype(np.float32) ** 3
+        return jnp.asarray(p / p.sum(-1, keepdims=True))
+
+    def test_top_k_renorm(self):
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+        from flashinfer_tpu.sampling import _top_k_renorm_probs_xla
+
+        p = self._probs(0)
+        k = jnp.asarray([1, 7, 40, 999], jnp.float32)
+        out = threshold_select(p, k, k, mode="top_k")
+        ref = _top_k_renorm_probs_xla(p, k.astype(jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_top_p_renorm(self):
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+        from flashinfer_tpu.sampling import _top_p_renorm_probs_xla
+
+        p = self._probs(1)
+        tp = jnp.asarray([0.1, 0.5, 0.9, 1.0], jnp.float32)
+        out = threshold_select(p, tp, tp, mode="top_p")
+        ref = _top_p_renorm_probs_xla(p, tp)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_top_k_logits_mask(self):
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+        from flashinfer_tpu.sampling import _top_k_mask_logits_xla
+
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((3, 777)) * 4, jnp.float32)
+        k = jnp.asarray([1, 10, 200], jnp.float32)
+        out = threshold_select(x, k, k, mode="top_k_logits")
+        ref = _top_k_mask_logits_xla(x, k.astype(jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("joint", [False, True])
+    def test_top_k_top_p(self, joint):
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+        from flashinfer_tpu.sampling import _top_k_top_p_filter_xla
+
+        p = self._probs(3)
+        k = jnp.asarray([5, 50, 400, 1000], jnp.float32)
+        tp = jnp.asarray([0.3, 0.8, 0.95, 1.0], jnp.float32)
+        mode = "top_k_top_p_joint" if joint else "top_k_top_p_seq"
+        out = threshold_select(p, k, tp, mode=mode)
+        ref = _top_k_top_p_filter_xla(p, k.astype(jnp.int32), tp, joint)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_greedy_edges(self):
+        """top_k=0 / top_p=0 mean greedy (reference edge semantics)."""
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        p = self._probs(4, batch=2, vocab=300)
+        z = jnp.zeros((2,), jnp.float32)
+        for mode in ("top_k", "top_p"):
+            out = np.asarray(threshold_select(p, z, z, mode=mode))
+            assert (out > 0).sum(-1).tolist() == [1, 1]
+            np.testing.assert_array_equal(out.argmax(-1), np.asarray(p).argmax(-1))
+
+    def test_public_api_backend_param(self):
+        import flashinfer_tpu as fi
+
+        p = self._probs(5)
+        out_p = fi.top_k_renorm_probs(p, 10, backend="pallas")
+        out_x = fi.top_k_renorm_probs(p, 10, backend="xla")
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
+                                   rtol=1e-5, atol=1e-7)
