@@ -133,12 +133,59 @@ def _rows_sampling(args):
                latency_us=t * 1e6, tbps="", tflops="")
 
 
+def _rows_mamba(args):
+    """SSM routines (reference bench: mamba/SSD kernels): chunked SSD
+    prefill (Mamba-2 shapes) + the bandwidth-bound selective-state decode
+    step at serving batch."""
+    import jax
+    import jax.numpy as jnp
+    from flashinfer_tpu.mamba import (
+        mamba_chunk_scan_combined, selective_state_update,
+    )
+
+    B, L = args.mamba_batch, args.mamba_seqlen
+    H, dim, G, dstate = args.mamba_heads, 64, 1, 128
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (B, L, H, dim), jnp.bfloat16)
+    dt = jax.nn.softplus(
+        jax.random.normal(jax.random.fold_in(key, 1), (B, L, H)) - 4
+    )
+    A = -jnp.exp(jax.random.normal(jax.random.fold_in(key, 2), (H,)))
+    Bm = jax.random.normal(jax.random.fold_in(key, 3), (B, L, G, dstate),
+                           jnp.bfloat16)
+    Cm = jax.random.normal(jax.random.fold_in(key, 4), (B, L, G, dstate),
+                           jnp.bfloat16)
+    t = _bench(
+        args,
+        lambda xx, dd, bb, cc: mamba_chunk_scan_combined(xx, dd, A, bb, cc)[0],
+        x, dt, Bm, Cm,
+    )
+    fl = 6 * B * L * H * dim * dstate  # score + state matmul pairs
+    yield dict(routine="mamba_prefill", config=f"B{B}_L{L}_H{H}",
+               latency_us=t * 1e6, tbps="", tflops=fl / t / 1e12)
+
+    state = jax.random.normal(key, (B, H, dim, dstate), jnp.float32)
+    xd = jax.random.normal(jax.random.fold_in(key, 5), (B, H, dim), jnp.bfloat16)
+    dtd = jnp.ones((B, H, dim), jnp.float32) * 0.1
+    Ad = -jnp.ones((H, dim, dstate), jnp.float32)
+    Bd = jax.random.normal(jax.random.fold_in(key, 6), (B, G, dstate), jnp.bfloat16)
+    td = _bench(
+        args,
+        lambda ss, xx, bb, cc: selective_state_update(ss, xx, dtd, Ad, bb, cc)[0],
+        state, xd, Bd, Cm[:, 0],
+    )
+    state_bytes = 2 * B * H * dim * dstate * 4  # read + write f32 state
+    yield dict(routine="mamba_decode", config=f"B{B}_H{H}",
+               latency_us=td * 1e6, tbps=state_bytes / td / 1e12, tflops="")
+
+
 ROUTINES = {
     "decode": _rows_decode,
     "prefill": _rows_prefill,
     "gemm": _rows_gemm,
     "moe": _rows_moe,
     "sampling": _rows_sampling,
+    "mamba": _rows_mamba,
 }
 
 
@@ -157,6 +204,9 @@ def main(argv=None):
     p.add_argument("--moe-hidden", type=int, default=1024)
     p.add_argument("--sampling-batch", type=int, default=64)
     p.add_argument("--vocab", type=int, default=128256)
+    p.add_argument("--mamba-batch", type=int, default=8)
+    p.add_argument("--mamba-seqlen", type=int, default=4096)
+    p.add_argument("--mamba-heads", type=int, default=24)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--quick", action="store_true",
                    help="CI-sized shapes (CPU-friendly)")
@@ -167,6 +217,7 @@ def main(argv=None):
         args.gemm_sizes = [256]
         args.moe_tokens, args.moe_experts, args.moe_hidden = 16, 4, 64
         args.sampling_batch, args.vocab = 4, 1024
+        args.mamba_batch, args.mamba_seqlen, args.mamba_heads = 1, 128, 2
         args.iters = 3
 
     names = sorted(ROUTINES) if args.routine == "all" else [args.routine]

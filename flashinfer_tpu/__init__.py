@@ -31,6 +31,9 @@ from flashinfer_tpu.gemm import (  # noqa: F401
     SegmentGEMMWrapper,
     bmm_bf16,
     bmm_fp8,
+    group_gemm_fp4,
+    group_gemm_fp8_nt_groupwise,
+    group_gemm_int8,
     grouped_gemm,
     mm_bf16,
     mm_fp4,
@@ -84,8 +87,10 @@ from flashinfer_tpu.aliases import (  # noqa: F401
 )
 from flashinfer_tpu.msa_ops import (  # noqa: F401
     msa_proxy_score,
+    msa_proxy_score_per_token,
     msa_sparse_attention,
     msa_topk_select,
+    msa_topk_select_per_token,
 )
 from flashinfer_tpu.norm import (  # noqa: F401
     fused_add_rmsnorm,
@@ -132,6 +137,13 @@ from flashinfer_tpu.rope import (  # noqa: F401
     generate_cos_sin_cache,
 )
 from flashinfer_tpu.autotuner import AutoTuner, autotune  # noqa: F401
+from flashinfer_tpu.profiler import (  # noqa: F401
+    annotate,
+    kernel_profiler,
+    start_timeline,
+    stop_timeline,
+    timeline,
+)
 from flashinfer_tpu.sampling import (  # noqa: F401
     chain_speculative_sampling,
     min_p_sampling_from_probs,

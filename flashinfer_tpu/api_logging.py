@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 import itertools
 import logging
+import os
 import time
 from typing import Any, Callable
 
@@ -84,6 +85,23 @@ def flashinfer_api(fn: Callable = None, *, name: str = None) -> Callable:
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
+            from flashinfer_tpu import profiler as _prof
+
+            # timeline recording wraps the whole wrapper (including any
+            # trace-apply substitution) so the profiled run executes the
+            # SAME configuration as production, not a bypassed one
+            if _prof._timeline_events is not None:
+                t0 = time.perf_counter()
+                out = _dispatch(*args, **kwargs)
+                if os.environ.get("FLASHINFER_TPU_TIMELINE_SYNC") == "1":
+                    import jax
+
+                    jax.block_until_ready(out)
+                _prof.record_event(api_name, t0, time.perf_counter())
+                return out
+            return _dispatch(*args, **kwargs)
+
+        def _dispatch(*args, **kwargs):
             from flashinfer_tpu import trace as _trace
 
             level = env.log_level()

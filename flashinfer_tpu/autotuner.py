@@ -102,10 +102,11 @@ class AutoTuner:
             pass
 
     def _save(self) -> None:
-        p = self._cache_path()
-        p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(
-            json.dumps({"meta": self._meta(), "tactics": self._cache}, indent=1)
+        from flashinfer_tpu.utils import atomic_write_text
+
+        atomic_write_text(
+            self._cache_path(),
+            json.dumps({"meta": self._meta(), "tactics": self._cache}, indent=1),
         )
 
     # ---- tuning ----------------------------------------------------------

@@ -42,7 +42,11 @@ from flashinfer_tpu import env
 HANG_THRESHOLD_S = 180.0
 
 _seen_ok: set = set()
-_seen_bad: set = set()  # quarantined fps already reported this process
+# quarantined fps seen this process -> last disk check time: the negative
+# cache keeps disk I/O off per-step fallback paths, but expires so an
+# operator's external `quarantine --clear` takes effect within a minute
+_seen_bad: Dict[str, float] = {}
+_SEEN_BAD_TTL_S = 60.0
 _source_digest_cache: Dict[str, str] = {}
 _fp_cache: Dict[tuple, str] = {}
 
@@ -99,8 +103,9 @@ def _load_qlist() -> Dict[str, dict]:
 
 
 def _save_qlist(q: Dict[str, dict]) -> None:
-    _qdir().mkdir(parents=True, exist_ok=True)
-    _qlist_path().write_text(json.dumps(q, indent=1))
+    from flashinfer_tpu.utils import atomic_write_text
+
+    atomic_write_text(_qlist_path(), json.dumps(q, indent=1))
 
 
 def quarantine(fp: str, op_name: str, reason: str) -> None:
@@ -118,7 +123,7 @@ def clear(fp: Optional[str] = None) -> int:
         _seen_bad.clear()
     else:
         q.pop(fp, None)
-        _seen_bad.discard(fp)
+        _seen_bad.pop(fp, None)
     _save_qlist(q)
     return n - len(q)
 
@@ -185,15 +190,17 @@ def guarded(
     fp = fingerprint(op_name, statics, module)
     if fp in _seen_ok or not _enabled():
         return thunk()
-    if fp in _seen_bad:
-        # quarantined variants sit on per-step fallback paths: one disk
-        # read per process, not per call
+    last = _seen_bad.get(fp)
+    if last is not None and time.time() - last < _SEEN_BAD_TTL_S:
         raise KernelQuarantined(
-            f"{op_name} variant {fp} is quarantined (cached)"
+            f"{op_name} variant {fp} is quarantined (clear with "
+            f"`python -m flashinfer_tpu quarantine --clear {fp}`; an "
+            f"external clear takes effect within {int(_SEEN_BAD_TTL_S)}s)"
         )
+    _seen_bad.pop(fp, None)
     _sweep_stale_markers()
     if fp in _load_qlist():
-        _seen_bad.add(fp)
+        _seen_bad[fp] = time.time()
         raise KernelQuarantined(
             f"{op_name} variant {fp} is quarantined after a suspected "
             "compile wedge; falling back (clear with "
@@ -249,8 +256,9 @@ def _record_status(fp: str, op_name: str, duration: float) -> None:
             "op": op_name, "status": "ok",
             "compile_s": round(duration, 2), "ts": round(time.time(), 1),
         }
-        _qdir().mkdir(parents=True, exist_ok=True)
-        _status_path().write_text(json.dumps(reg, indent=1))
+        from flashinfer_tpu.utils import atomic_write_text
+
+        atomic_write_text(_status_path(), json.dumps(reg, indent=1))
     except Exception:
         pass  # telemetry must never break the op
 

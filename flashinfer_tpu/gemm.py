@@ -189,6 +189,84 @@ def grouped_gemm(
     return jax.lax.ragged_dot(x, weights, group_sizes.astype(jnp.int32))
 
 
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def group_gemm_int8(
+    x: jax.Array,  # [total_m, k] bf16/f32 activations (quantized per-row here)
+    weights: jax.Array,  # [num_groups, k, n] int8
+    w_scale: jax.Array,  # [num_groups, n] (or [num_groups, 1, n]) per-channel
+    group_sizes: jax.Array,  # [num_groups] int32
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Grouped matmul on the native int8 MXU path (the v5e low-precision
+    story; reference grouped-quantized GEMMs, group_gemm_fp8_nt_groupwise
+    family).  Activations are dynamically quantized per row, weights carry
+    per-(group, out-channel) scales; int8 x int8 -> int32 accumulate."""
+    from flashinfer_tpu.quantization import quantize_int8
+
+    xq, xs = quantize_int8(x, axis=-1)  # [total_m, k] int8, [total_m, 1]
+    acc = jax.lax.ragged_dot(
+        xq, weights, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.int32,
+    )  # [total_m, n] int32
+    # per-row group id -> per-row weight scale row
+    gid = jnp.repeat(
+        jnp.arange(weights.shape[0]), group_sizes, total_repeat_length=x.shape[0]
+    )
+    ws = w_scale.reshape(weights.shape[0], -1)[gid]  # [total_m, n]
+    return (acc.astype(jnp.float32) * xs * ws).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def group_gemm_fp8_nt_groupwise(
+    a: jax.Array,  # [total_m, k] fp8
+    b: jax.Array,  # [num_groups, n, k] fp8 ("nt": row-major n-by-k)
+    a_scale: jax.Array,  # [total_m, k // block_k]
+    b_scale: jax.Array,  # [num_groups, k // block_k, n // block_n]
+    group_sizes: jax.Array,  # [num_groups] int32
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Groupwise-scaled fp8 grouped GEMM (reference
+    ``group_gemm_fp8_nt_groupwise``): per-k-group activation scales x
+    per-tile weight scales, one ragged MXU matmul over the expert-sorted
+    rows.  fp8 storage, bf16 MXU compute (no native fp8 matmul on v5)."""
+    g, n, k = b.shape
+    block_k = k // a_scale.shape[1]
+    block_n = n // b_scale.shape[2]
+    af = a.astype(jnp.float32).reshape(a.shape[0], k // block_k, block_k)
+    af = (af * a_scale[:, :, None]).reshape(a.shape[0], k).astype(jnp.bfloat16)
+    bf = b.astype(jnp.float32).reshape(g, n // block_n, block_n,
+                                       k // block_k, block_k)
+    bf = (bf * jnp.swapaxes(b_scale, 1, 2)[:, :, None, :, None]).reshape(g, n, k)
+    bw = jnp.swapaxes(bf, 1, 2).astype(jnp.bfloat16)  # [g, k, n]
+    return jax.lax.ragged_dot(
+        af, bw, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
+def group_gemm_fp4(
+    x: jax.Array,  # [total_m, k] bf16/f32
+    w_packed: jax.Array,  # [num_groups, k//2, n] int8 block-int4 packed on k
+    w_scale: jax.Array,  # [num_groups, k//block, n]
+    group_sizes: jax.Array,
+    block_size: int = 16,
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Grouped 4-bit-weight matmul (reference mxfp4/nvfp4 grouped GEMMs):
+    block-int4 expert weights dequantized in-register, ragged MXU dot."""
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    w = dequantize_fp4(
+        jnp.swapaxes(w_packed, 1, 2), jnp.swapaxes(w_scale, 1, 2), block_size
+    )  # [g, n, k]
+    w = jnp.swapaxes(w, 1, 2)  # [g, k, n] bf16
+    return jax.lax.ragged_dot(
+        x.astype(jnp.bfloat16), w, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
 class SegmentGEMMWrapper:
     """LoRA-style segment GEMM (reference ``SegmentGEMMWrapper``,
     gemm_base.py:1943): per-segment weight selection over ragged batches,

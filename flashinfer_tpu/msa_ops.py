@@ -66,6 +66,75 @@ def msa_topk_select(
     return np.asarray(indptr, np.int32), np.asarray(indices, np.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("block_kv",))
+def msa_proxy_score_per_token(
+    q: jax.Array,  # [M, H, D]
+    k: jax.Array,  # [N, Hkv, D]
+    block_kv: int = 64,
+) -> jax.Array:
+    """Per-*token* proxy: every query token vs block-mean-pooled keys ->
+    [M, N//bkv] f32 (the reference MSA ranking granularity, where each
+    token keeps its own top-k KV blocks)."""
+    M, H, D = q.shape
+    N = k.shape[0]
+    kb = k.astype(jnp.float32).reshape(N // block_kv, block_kv, -1, D).mean(1)
+    group = H // kb.shape[1]
+    kb = jnp.repeat(kb, group, axis=1)
+    return jnp.einsum("mhd,jhd->mj", q.astype(jnp.float32), kb)
+
+
+def msa_topk_select_per_token(
+    scores: jax.Array,  # [M, KB] per-token block scores
+    top_k: int,
+    block_q: int,
+    block_kv: int,
+    causal: bool = True,
+):
+    """Token-granular selection -> (union BSR structure per q row-block,
+    per-token selection bitmap padded to 128 lanes).
+
+    Every token keeps its top-k blocks (restricted to blocks at or before
+    its own position when causal; its local block always kept); the BSR
+    cols of a q row-block are the union over its tokens, and the bitmap
+    resolves per-token membership inside the kernel."""
+    from flashinfer_tpu.utils import round_up
+
+    s = np.asarray(scores, np.float32)
+    M, KB = s.shape
+    if causal:
+        if M != KB * block_kv:
+            raise ValueError(
+                "causal token-granular MSA assumes self-attention "
+                f"(M == N): got M={M}, N={KB * block_kv}"
+            )
+        tok_blk = np.arange(M) // block_kv  # kv-block of each token's pos
+        mask = np.arange(KB)[None, :] > tok_blk[:, None]
+        s = np.where(mask, -np.inf, s)
+    k_eff = min(top_k, KB)
+    top = np.argpartition(-s, min(k_eff, KB - 1), axis=1)[:, :k_eff]
+    bitmap = np.zeros((M, KB), bool)
+    np.put_along_axis(bitmap, top, True, axis=1)
+    if causal:
+        bitmap &= ~mask
+        bitmap[np.arange(M), np.minimum(np.arange(M) // block_kv, KB - 1)] = True
+    MB = M // block_q
+    per_row = bitmap.reshape(MB, block_q, KB).any(1)  # union per q block
+    indptr = [0]
+    indices = []
+    for i in range(MB):
+        cols = np.nonzero(per_row[i])[0]
+        indices.extend(cols.tolist())
+        indptr.append(len(indices))
+    kb_pad = round_up(KB, 128)
+    bitmap_pad = np.zeros((M, kb_pad), np.float32)
+    bitmap_pad[:, :KB] = bitmap
+    return (
+        np.asarray(indptr, np.int32),
+        np.asarray(indices, np.int32),
+        bitmap_pad,
+    )
+
+
 def msa_sparse_attention(
     q: jax.Array,  # [M, H, D]
     k: jax.Array,  # [N, Hkv, D]
@@ -76,11 +145,52 @@ def msa_sparse_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     backend: str = "auto",
+    granularity: str = "token",
 ) -> jax.Array:
-    """End-to-end MSA sparse attention: proxy -> select -> BSR attention.
+    """End-to-end MSA sparse attention: proxy -> select -> sparse kernel.
 
-    Note: block-granular sparsity — within selected blocks attention is
-    dense (no intra-block causal mask), matching the proxy-sparse design."""
+    ``granularity="token"`` (default, the reference semantics): every query
+    token ranks KV blocks by its own proxy score and keeps its top-k, with
+    token-level causal masking — runs on the per-token-selection BSR kernel
+    (ops/block_sparse.py bsr_attention_token_select).
+    ``granularity="block"``: the coarser v1 design — one selection per
+    query *block*, dense within selected blocks, no intra-block causal."""
+    sm = get_sm_scale(q.shape[2], sm_scale)
+    if granularity == "token":
+        from flashinfer_tpu.ops.block_sparse import bsr_attention_token_select
+        from flashinfer_tpu.utils import next_power_of_two, resolve_backend
+
+        scores = msa_proxy_score_per_token(q, k, block_kv)
+        indptr, indices, bitmap = msa_topk_select_per_token(
+            scores, top_k, block_q, block_kv, causal
+        )
+        if resolve_backend(backend, "msa_sparse_attention") != "pallas":
+            # xla fallback: dense attention under the selection mask
+            from flashinfer_tpu.sparse import _dense_masked_attention
+
+            KB = k.shape[0] // block_kv
+            tok_mask = np.repeat(
+                np.asarray(bitmap[:, :KB], bool), block_kv, axis=1
+            )
+            if causal:
+                M = q.shape[0]
+                tok_mask &= np.arange(M)[None, :] <= np.arange(M)[:, None]
+            return _dense_masked_attention(q, k, v, jnp.asarray(tok_mask), sm)
+        nnz_per_row = indptr[1:] - indptr[:-1]
+        max_nnz = max(int(next_power_of_two(int(nnz_per_row.max(initial=1)))), 1)
+        MB = q.shape[0] // block_q
+        cols = np.zeros((MB, max_nnz), np.int32)
+        for i in range(MB):
+            row = indices[indptr[i]:indptr[i + 1]]
+            cols[i, : len(row)] = row
+        return bsr_attention_token_select(
+            q, k, v, jnp.asarray(indptr), jnp.asarray(cols.reshape(-1)),
+            jnp.asarray(bitmap),
+            block_row=block_q, block_col=block_kv, max_nnz=max_nnz,
+            causal=causal, sm_scale=sm,
+        )
+    if granularity != "block":
+        raise ValueError(f"unknown granularity {granularity!r}")
     from flashinfer_tpu.sparse import BlockSparseAttentionWrapper
 
     scores = msa_proxy_score(q, k, block_q, block_kv)
@@ -89,6 +199,6 @@ def msa_sparse_attention(
     w.plan(
         indptr, indices, q.shape[0], k.shape[0], block_q, block_kv,
         q.shape[1], k.shape[1], q.shape[2],
-        sm_scale=get_sm_scale(q.shape[2], sm_scale),
+        sm_scale=sm,
     )
     return w.run(q, k, v)

@@ -133,6 +133,157 @@ def bsr_attention(
     return jnp.swapaxes(out, 0, 1)
 
 
+def _bsr_token_select_kernel(
+    indptr_ref,  # [MB+1] scalar prefetch
+    cols_ref,  # [MB * max_nnz] padded column-block ids
+    q_ref,  # [R, D]
+    k_ref,  # [C, D]
+    v_ref,  # [C, D]
+    sel_ref,  # [R, KBpad] f32 per-token block-selection bitmap
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    max_nnz: int,
+    kb_pad: int,
+    block_row: int,
+    block_col: int,
+    causal: bool,
+    sm_scale: float,
+):
+    """BSR attention with *per-token* column-block selection (the reference
+    MSA semantics, flashinfer/msa_ops/: every query token ranks KV blocks
+    by proxy score and keeps its own top-k).  The kernel walks the union
+    BSR structure per row-block; each tile extracts its selection column
+    from the VMEM-resident bitmap with one skinny one-hot matmul, plus
+    token-level causal masking for the boundary blocks."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    row_nnz = indptr_ref[i + 1] - indptr_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j < row_nnz)
+    def _compute():
+        c = cols_ref[i * max_nnz + j]
+        s = jax.lax.dot_general(
+            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [R, C]
+        # sel_col[r] = bitmap[r, c]: lane-extract via one-hot matmul
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (kb_pad, 1), 0) == c
+        ).astype(jnp.float32)
+        sel_col = jax.lax.dot_general(
+            sel_ref[...], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, 1]
+        mask = sel_col > 0.5
+        if causal:
+            q_pos = i * block_row + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            kv_pos = c * block_col + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            mask = mask & (kv_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == max_nnz - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block_row", "block_col", "max_nnz", "causal", "sm_scale"
+    ),
+)
+def bsr_attention_token_select(
+    q: jax.Array,  # [M, num_qo_heads, head_dim]
+    k: jax.Array,  # [N, num_kv_heads, head_dim]
+    v: jax.Array,
+    indptr: jax.Array,  # [MB+1] int32 union-BSR structure
+    cols_padded: jax.Array,  # [MB * max_nnz] int32
+    sel_bitmap: jax.Array,  # [M, KBpad] f32/bool per-token block selection
+    *,
+    block_row: int,
+    block_col: int,
+    max_nnz: int,
+    causal: bool = False,
+    sm_scale: float = 1.0,
+):
+    M, H, D = q.shape
+    group = H // k.shape[1]
+    MB = M // block_row
+    kb_pad = sel_bitmap.shape[1]
+    qT = jnp.swapaxes(q, 0, 1)
+    kT = jnp.swapaxes(k, 0, 1)
+    vT = jnp.swapaxes(v, 0, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(H, MB, max_nnz),
+        in_specs=[
+            pl.BlockSpec((None, block_row, D), lambda h, i, j, *_: (h, i, 0)),
+            pl.BlockSpec(
+                (None, block_col, D),
+                lambda h, i, j, ip, cols: (h // group, cols[i * max_nnz + j], 0),
+            ),
+            pl.BlockSpec(
+                (None, block_col, D),
+                lambda h, i, j, ip, cols: (h // group, cols[i * max_nnz + j], 0),
+            ),
+            pl.BlockSpec((block_row, kb_pad), lambda h, i, j, *_: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, block_row, D), lambda h, i, j, *_: (h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_row, D), jnp.float32),
+            pltpu.VMEM((block_row, 128), jnp.float32),
+            pltpu.VMEM((block_row, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _bsr_token_select_kernel,
+            max_nnz=max_nnz, kb_pad=kb_pad, block_row=block_row,
+            block_col=block_col, causal=causal, sm_scale=sm_scale,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, M, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        ),
+        interpret=use_interpret(),
+    )(
+        indptr.astype(jnp.int32), cols_padded.astype(jnp.int32),
+        qT, kT, vT, sel_bitmap.astype(jnp.float32),
+    )
+    return jnp.swapaxes(out, 0, 1)
+
+
 def _vbsr_kernel(
     # scalar prefetch
     indptr_ref,  # [MT+1] per-q-tile nnz offsets

@@ -33,6 +33,11 @@ from flashinfer_tpu.utils import round_up, use_interpret
 
 _BISECT_ITERS = 32
 _NEG_INF = -1e30
+# values at or below this are treated as masked-out (-inf class): they can
+# never be selected, and letting them into the bisection range would either
+# poison it (lo0 = -inf -> mid stays -inf forever) or stretch it so wide
+# (1e30) that 32 halvings leave ~1e20 resolution
+_FINITE_FLOOR = -1e20
 
 
 def _bisect(p, valid, target_fn, lo, hi):
@@ -62,10 +67,14 @@ def _threshold_kernel(
     p = p_ref[...]
     valid = (
         jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) < vocab
-    )
+    ) & (p > _FINITE_FLOOR)  # pre-masked (-inf class) tokens never selected
     pv = jnp.where(valid, p, 0.0)
     lo0 = jnp.min(jnp.where(valid, p, jnp.inf), axis=1, keepdims=True) - 1e-6
     hi0 = jnp.max(jnp.where(valid, p, -jnp.inf), axis=1, keepdims=True)
+    # all-masked row: collapse to an empty kept set instead of nan/inf math
+    any_valid = jnp.isfinite(hi0)
+    lo0 = jnp.where(any_valid, lo0, 0.0)
+    hi0 = jnp.where(any_valid, hi0, 1.0)
     a = a_ref[...]
 
     def count_ge(ge):

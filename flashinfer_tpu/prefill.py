@@ -212,6 +212,38 @@ def build_multi_item_mask(
     return jnp.asarray(mask)
 
 
+def _expand_flat_mask(
+    qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
+    custom_mask, packed_custom_mask,
+):
+    """Expand the reference's flat per-request mask concat (MaskMode::CUSTOM,
+    packed LSB-first takes precedence) into the dense [tq_pad, tkv_pad] mask
+    the flattened-token-axis kernels consume.  Returns None if no mask."""
+    total_bits = int(np.sum(qo_lens * kv_lens))
+    if packed_custom_mask is not None:
+        custom_mask = np.unpackbits(
+            np.asarray(packed_custom_mask).view(np.uint8), bitorder="little"
+        )[:total_bits].astype(bool)
+    if custom_mask is None:
+        return None
+    flat = np.asarray(custom_mask).astype(bool).reshape(-1)
+    if flat.size != total_bits:
+        raise ValueError(
+            f"custom_mask has {flat.size} bits; expected sum(qo_len*kv_len) "
+            f"= {total_bits} (flat per-request concat, not a dense mask)"
+        )
+    dense = np.zeros((tq_pad, tkv_pad), bool)
+    off = 0
+    for r in range(len(qo_lens)):
+        qn, kn = int(qo_lens[r]), int(kv_lens[r])
+        dense[
+            int(qo_indptr[r]) : int(qo_indptr[r]) + qn,
+            int(kv_indptr[r]) : int(kv_indptr[r]) + kn,
+        ] = flat[off : off + qn * kn].reshape(qn, kn)
+        off += qn * kn
+    return jnp.asarray(dense)
+
+
 @dataclass(frozen=True)
 class _PrefillPlan:
     # token-axis fields are None in the "light" plan built for the fused
@@ -299,35 +331,12 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_seg, kv_pos, total_kv = _build_token_axis(
             kv_indptr, tkv_pad, _KV_PAD_SEG, np.zeros(batch, np.int64)
         )
-        dense_mask = None
-        total_bits = int(np.sum(qo_lens * kv_lens))
-        if packed_custom_mask is not None:
-            # reference convention: packed takes precedence, LSB-first bits
-            flat = np.unpackbits(
-                np.asarray(packed_custom_mask).view(np.uint8),
-                bitorder="little",
-            )[:total_bits].astype(bool)
-            custom_mask = flat
-        if custom_mask is not None:
-            # expand the reference's flat per-request mask concat
-            # (MaskMode::CUSTOM: causal is ignored; window still applies)
-            flat = np.asarray(custom_mask).astype(bool).reshape(-1)
-            if flat.size != total_bits:
-                raise ValueError(
-                    f"custom_mask has {flat.size} bits; expected "
-                    f"sum(qo_len*kv_len) = {total_bits} (flat per-request "
-                    "concat, not a dense [total_q, total_kv] mask)"
-                )
-            dense = np.zeros((tq_pad, tkv_pad), bool)
-            off = 0
-            for r in range(batch):
-                qn, kn = int(qo_lens[r]), int(kv_lens[r])
-                dense[
-                    int(qo_indptr[r]) : int(qo_indptr[r]) + qn,
-                    int(kv_indptr[r]) : int(kv_indptr[r]) + kn,
-                ] = flat[off : off + qn * kn].reshape(qn, kn)
-                off += qn * kn
-            dense_mask = jnp.asarray(dense)
+        # MaskMode::CUSTOM: causal is ignored; window still applies
+        dense_mask = _expand_flat_mask(
+            qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
+            custom_mask, packed_custom_mask,
+        )
+        if dense_mask is not None:
             causal = False  # custom mask overrides causal (only)
         self._plan = _PrefillPlan(
             q_seg=jnp.asarray(q_seg), q_pos=jnp.asarray(q_pos),
@@ -423,6 +432,8 @@ class BatchPrefillWithPagedKVCacheWrapper:
         head_dim: int,
         page_size: int,
         causal: bool = False,
+        custom_mask=None,  # flat concat of per-request [qo_i*kv_i] bools
+        packed_custom_mask=None,  # packbits(LSB-first) form; takes precedence
         pos_encoding_mode: str = "NONE",
         window_left: int = -1,
         logits_soft_cap: Optional[float] = None,
@@ -447,6 +458,17 @@ class BatchPrefillWithPagedKVCacheWrapper:
 
         tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
         tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
+
+        # paged-batch MaskMode::CUSTOM (reference prefill.py:1117-2947): the
+        # gathered-KV token axis is the per-request concat, so the same
+        # flat-mask expansion as the ragged wrapper applies; masks route to
+        # the gather path (the fused work-unit kernel has no mask operand)
+        dense_mask = _expand_flat_mask(
+            qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
+            custom_mask, packed_custom_mask,
+        )
+        if dense_mask is not None:
+            causal = False  # custom mask overrides causal (only)
 
         def build_gather_plan() -> _PrefillPlan:
             # token axes + flat gather rows — O(tkv_pad) host work that the
@@ -474,10 +496,12 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
                 logits_soft_cap=logits_soft_cap or 0.0,
                 window_left=window_left,
+                custom_mask=dense_mask,
             )
 
         self._gather_plan_builder = build_gather_plan
-        use_fused = self._backend == "pallas_fused" or (
+        use_fused = dense_mask is None and (
+            self._backend == "pallas_fused" or (
             # hardware-validated default for the TPU-preferred HND layout;
             # NHD would need a whole-cache transpose per run() to feed the
             # fused kernel's contiguous page DMAs, so it keeps gather+flash.
@@ -487,7 +511,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._backend == "auto"
             and check_kv_layout(self._kv_layout) == TensorLayout.HND
             and resolve_backend("auto", "batch_prefill_paged") == "pallas"
-        )
+        ))
         if use_fused:
             from flashinfer_tpu.ops.paged_prefill import (
                 build_prefill_work_units,
@@ -656,17 +680,28 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq = plan.tq_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
-        backend = resolve_backend(
-            "pallas" if self._backend == "pallas_fused" else self._backend,
-            "batch_prefill_paged",
-        )
-        fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
-        out = fn(
-            q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
-            causal=plan.causal, sm_scale=plan.sm_scale,
-            logits_soft_cap=plan.logits_soft_cap,
-            window_left=plan.window_left, return_lse=return_lse,
-        )
+        if plan.custom_mask is not None:
+            # paged-batch MaskMode::CUSTOM runs on the dense xla backend
+            # over the gathered KV (same contract as the ragged wrapper)
+            out = xla_ragged_attention(
+                q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+                causal=False, sm_scale=plan.sm_scale,
+                logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, return_lse=return_lse,
+                custom_mask=plan.custom_mask,
+            )
+        else:
+            backend = resolve_backend(
+                "pallas" if self._backend == "pallas_fused" else self._backend,
+                "batch_prefill_paged",
+            )
+            fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
+            out = fn(
+                q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
+                causal=plan.causal, sm_scale=plan.sm_scale,
+                logits_soft_cap=plan.logits_soft_cap,
+                window_left=plan.window_left, return_lse=return_lse,
+            )
         if return_lse:
             return out[0][: plan.total_q], out[1][: plan.total_q]
         return out[: plan.total_q]

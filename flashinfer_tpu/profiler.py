@@ -37,3 +37,65 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+# ---------------------------------------------------------------------------
+# Op-level event timeline (reference profiler.cuh event-buffer analogue):
+# every @flashinfer_api call between start_timeline()/stop_timeline() is
+# recorded and exportable as chrome://tracing JSON.  Host-side spans by
+# default (dispatch cost); set FLASHINFER_TPU_TIMELINE_SYNC=1 to
+# block_until_ready each op for true wall durations.
+# ---------------------------------------------------------------------------
+
+_timeline_events: Optional[list] = None
+
+
+def timeline_active() -> bool:
+    return _timeline_events is not None
+
+
+def start_timeline() -> None:
+    global _timeline_events
+    _timeline_events = []
+
+
+def record_event(name: str, t0: float, t1: float) -> None:
+    if _timeline_events is not None:
+        _timeline_events.append({"name": name, "ts": t0, "dur": t1 - t0})
+
+
+def stop_timeline(path: Optional[str] = None) -> list:
+    """Stop recording; return the events and optionally write a
+    chrome://tracing / Perfetto-loadable JSON file."""
+    global _timeline_events
+    events = _timeline_events or []
+    _timeline_events = None
+    if path is not None:
+        import json
+        import os
+
+        trace = {
+            "traceEvents": [
+                {
+                    "name": e["name"], "ph": "X", "pid": os.getpid(), "tid": 0,
+                    "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+                    "cat": "flashinfer_tpu",
+                }
+                for e in events
+            ]
+        }
+        from flashinfer_tpu.utils import atomic_write_text
+
+        atomic_write_text(path, json.dumps(trace))
+    return events
+
+
+@contextlib.contextmanager
+def timeline(path: Optional[str] = None) -> Iterator[None]:
+    """``with timeline("trace.json"):`` — record every flashinfer_tpu API
+    call in the region to a chrome://tracing file."""
+    start_timeline()
+    try:
+        yield
+    finally:
+        stop_timeline(path)

@@ -42,6 +42,30 @@ class TensorLayout(enum.IntEnum):
     HND = 1
 
 
+def atomic_write_text(path, text: str) -> None:
+    """Write-then-rename so concurrent readers of shared cache files
+    (autotuner tactics, quarantine list, compile-status registry) never see
+    a torn write — the TPU-side analogue of the reference's compile-cache
+    race protections (tests/utils/test_load_cubin_compile_race_condition.py)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    import contextlib
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
 def check_kv_layout(kv_layout: str) -> TensorLayout:
     if kv_layout not in ("NHD", "HND"):
         raise KeyError(f"Invalid kv_layout {kv_layout!r}, expected 'NHD' or 'HND'")

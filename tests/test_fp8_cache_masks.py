@@ -146,3 +146,58 @@ def test_multi_item_scoring_mask():
     np.testing.assert_allclose(
         np.asarray(out[7:9]), np.asarray(ref), rtol=2e-3, atol=2e-3
     )
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_batch_prefill_paged_custom_mask(packed):
+    """Paged batch prefill with per-request custom masks (reference paged
+    MaskMode::CUSTOM, flashinfer/prefill.py:1492): flat per-request concat
+    expanded over the gathered KV axis; fused kernel path is bypassed."""
+    HQ, HKV, D, PS = 2, 2, 32, 4
+    qo_lens = [4, 6]
+    kv_lens = [8, 5]  # second request has a partial last page
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    pages_per_req = [(l + PS - 1) // PS for l in kv_lens]
+    kv_indptr_pages = np.concatenate([[0], np.cumsum(pages_per_req)])
+    last_page_len = [l - (p - 1) * PS for l, p in zip(kv_lens, pages_per_req)]
+    n_pages = int(kv_indptr_pages[-1])
+    kv_indices = np.arange(n_pages)
+
+    rng = np.random.default_rng(0)
+    masks = [rng.random((q_, k_)) < 0.6 for q_, k_ in zip(qo_lens, kv_lens)]
+    for m in masks:
+        m[:, 0] = True
+    flat = np.concatenate([m.reshape(-1) for m in masks])
+    mask_arg = {}
+    if packed:
+        mask_arg["packed_custom_mask"] = np.packbits(
+            flat.astype(np.uint8), bitorder="little"
+        )
+    else:
+        mask_arg["custom_mask"] = flat
+
+    # NHD cache [pages, PS, HKV, D], pages laid out in request order
+    kc = jax.random.normal(jax.random.PRNGKey(1), (n_pages, PS, HKV, D))
+    vc = jax.random.normal(jax.random.PRNGKey(2), (n_pages, PS, HKV, D))
+    q = jax.random.normal(jax.random.PRNGKey(0), (sum(qo_lens), HQ, D))
+
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="NHD")
+    w.plan(
+        qo_indptr, kv_indptr_pages, kv_indices, last_page_len,
+        HQ, HKV, D, PS, causal=True, **mask_arg,
+    )
+    out = w.run(q, (kc, vc))
+
+    kflat = np.asarray(kc).reshape(-1, HKV, D)
+    vflat = np.asarray(vc).reshape(-1, HKV, D)
+    for r in range(2):
+        qs, qe = qo_indptr[r], qo_indptr[r + 1]
+        rows = np.arange(kv_lens[r]) + kv_indptr_pages[r] * PS
+        ref = attention_ref(
+            q[qs:qe], jnp.asarray(kflat[rows]), jnp.asarray(vflat[rows]),
+            custom_mask=jnp.asarray(masks[r]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"request {r}",
+        )

@@ -173,3 +173,83 @@ def test_quantizing_norms():
     np.testing.assert_allclose(
         np.asarray(q2, np.float32) * float(s2), np.asarray(ref_n), rtol=0.1, atol=0.05
     )
+
+
+# ---- grouped-quantized GEMM variants -------------------------------------
+
+
+def _ragged_ref(x, w, sizes):
+    out = []
+    off = 0
+    for g, s in enumerate(sizes):
+        out.append(np.asarray(x[off:off + s], np.float32) @ np.asarray(w[g], np.float32))
+        off += s
+    return np.concatenate(out) if out else np.zeros((0, w.shape[-1]))
+
+
+def test_group_gemm_int8():
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.quantization import quantize_int8
+
+    rng = np.random.default_rng(0)
+    G, k, n = 3, 64, 48
+    sizes = [5, 0, 9]
+    x = jnp.asarray(rng.standard_normal((sum(sizes), k)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((G, k, n)), jnp.float32)
+    wq, ws = quantize_int8(w, axis=1)  # per-(group, out-channel)
+    out = fi.group_gemm_int8(
+        x, wq, ws.reshape(G, n), jnp.asarray(sizes, jnp.int32),
+        out_dtype=jnp.float32,
+    )
+    ref = _ragged_ref(x, np.asarray(wq, np.float32) * np.asarray(ws), sizes)
+    # int8 activation quantization dominates the error budget
+    rel = np.abs(np.asarray(out) - ref) / (np.abs(ref).max() + 1e-6)
+    assert rel.max() < 2e-2, rel.max()
+
+
+def test_group_gemm_fp8_nt_groupwise():
+    import flashinfer_tpu as fi
+
+    rng = np.random.default_rng(1)
+    G, k, n = 2, 64, 64
+    blk = 32
+    sizes = [4, 7]
+    a = jnp.asarray(rng.standard_normal((sum(sizes), k)), jnp.float8_e4m3fn)
+    b = jnp.asarray(rng.standard_normal((G, n, k)), jnp.float8_e4m3fn)
+    a_scale = jnp.asarray(rng.random((sum(sizes), k // blk)) + 0.5, jnp.float32)
+    b_scale = jnp.asarray(rng.random((G, k // blk, n // blk)) + 0.5, jnp.float32)
+    out = fi.group_gemm_fp8_nt_groupwise(
+        a, b, a_scale, b_scale, jnp.asarray(sizes, jnp.int32),
+        out_dtype=jnp.float32,
+    )
+    # reference: dequantize then ragged matmul
+    af = np.asarray(a, np.float32).reshape(-1, k // blk, blk)
+    af = (af * np.asarray(a_scale)[:, :, None]).reshape(-1, k)
+    bf = np.asarray(b, np.float32).reshape(G, n // blk, blk, k // blk, blk)
+    bf = bf * np.swapaxes(np.asarray(b_scale), 1, 2)[:, :, None, :, None]
+    bw = np.swapaxes(bf.reshape(G, n, k), 1, 2)
+    ref = _ragged_ref(jnp.asarray(af), jnp.asarray(bw), sizes)
+    # kernel computes in bf16 after dequant (no native fp8 MXU on v5):
+    # ~0.4% per-operand rounding accumulates over k=64 products
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-2, atol=0.3)
+
+
+def test_group_gemm_fp4():
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.quantization import quantize_fp4, dequantize_fp4
+
+    rng = np.random.default_rng(2)
+    G, k, n = 2, 64, 32
+    sizes = [6, 3]
+    x = jnp.asarray(rng.standard_normal((sum(sizes), k)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((G, n, k)), jnp.float32)  # pack on k
+    wp, ws = quantize_fp4(w)  # [G, n, k//2], [G, n, k//16]
+    wp_t = jnp.swapaxes(wp, 1, 2)  # [G, k//2, n]
+    ws_t = jnp.swapaxes(ws, 1, 2)  # [G, k//16, n]
+    out = fi.group_gemm_fp4(
+        x, wp_t, ws_t, jnp.asarray(sizes, jnp.int32), out_dtype=jnp.float32
+    )
+    wd = np.asarray(dequantize_fp4(wp, ws, out_dtype=jnp.float32))  # [G, n, k]
+    ref = _ragged_ref(x, np.swapaxes(wd, 1, 2), sizes)
+    # x and dequantized w round to bf16 inside the kernel
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-2, atol=0.3)

@@ -93,3 +93,47 @@ def test_green_ctx_raises():
 
     with pytest.raises(NotImplementedError, match="BatchAttention"):
         green_ctx.split_device_green_ctx(None)
+
+
+def test_msa_token_granular_vs_dense_ref():
+    """Token-granular MSA (reference semantics): each token's own top-k
+    block selection + token-level causal, checked against a dense masked
+    reference built from the same selection bitmap."""
+    from flashinfer_tpu.msa_ops import (
+        msa_proxy_score_per_token, msa_topk_select_per_token,
+    )
+    from flashinfer_tpu.sparse import _dense_masked_attention
+
+    M, H, D, BQ, BKV = 128, 2, 32, 32, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (M, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (M, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (M, H, D), jnp.float32)
+
+    out = fi.msa_sparse_attention(
+        q, k, v, top_k=2, block_q=BQ, block_kv=BKV, causal=True,
+        granularity="token",
+    )
+
+    scores = msa_proxy_score_per_token(q, k, BKV)
+    _, _, bitmap = msa_topk_select_per_token(scores, 2, BQ, BKV, causal=True)
+    KB = M // BKV
+    tok_mask = np.repeat(bitmap[:, :KB].astype(bool), BKV, axis=1)  # [M, N]
+    tok_mask &= np.arange(M)[None, :] <= np.arange(M)[:, None]  # causal
+    ref = _dense_masked_attention(
+        q, k, v, jnp.asarray(tok_mask), 1 / np.sqrt(D)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_msa_token_granular_rows_differ():
+    """Two tokens in the same q block can select different KV blocks —
+    the property the block-granular v1 cannot express."""
+    from flashinfer_tpu.msa_ops import msa_topk_select_per_token
+
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=(64, 8)).astype(np.float32)
+    _, _, bitmap = msa_topk_select_per_token(scores, 2, 32, 8, causal=False)
+    rows = bitmap[:32, :8].astype(bool)
+    assert any((rows[i] != rows[j]).any() for i in range(8) for j in range(8))

@@ -204,6 +204,31 @@ class TestThresholdSelect:
             assert (out > 0).sum(-1).tolist() == [1, 1]
             np.testing.assert_array_equal(out.argmax(-1), np.asarray(p).argmax(-1))
 
+    def test_top_k_logits_with_neg_inf_masked_tokens(self):
+        """Pre-masked (-inf / -1e30 sentinel) logits must not poison the
+        bisection range: banned tokens stay excluded, k finite survivors."""
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 256)).astype(np.float32) * 4
+        x[0, 50:] = -np.inf  # structured-decoding ban pattern
+        x[1, 100:] = -1e30  # this module's own sentinel (chained calls)
+        k = jnp.asarray([5, 7], jnp.float32)
+        out = np.asarray(
+            threshold_select(jnp.asarray(x), k, k, mode="top_k_logits")
+        )
+        kept = out > -1e20
+        assert kept[0].sum() == 5 and kept[1].sum() == 7
+        # the kept sets are the finite top-k
+        assert set(np.nonzero(kept[0])[0]) == set(np.argsort(-x[0])[:5])
+        assert set(np.nonzero(kept[1])[0]) == set(np.argsort(-x[1])[:7])
+        # fully-masked row: nothing kept, no nan
+        x2 = np.full((1, 128), -np.inf, np.float32)
+        out2 = np.asarray(threshold_select(
+            jnp.asarray(x2), jnp.ones((1,)), jnp.ones((1,)), mode="top_k_logits"
+        ))
+        assert (out2 <= -1e20).all() and not np.isnan(out2).any()
+
     def test_public_api_backend_param(self):
         import flashinfer_tpu as fi
 
