@@ -1,8 +1,21 @@
 """Headline benchmark: batched paged-KV decode attention on one TPU chip.
 
+Wedge-proof orchestration (the round-2 lesson: a wedged chip must yield a
+parseable JSON line with partial results, never rc=124):
+
+* Default invocation is an **orchestrator** that never touches the TPU
+  itself.  It (1) probes chip health in a subprocess under a timeout,
+  (2) runs each bench *phase* in its own subprocess with its own timeout,
+  (3) parses ``ROW {json}`` lines incrementally so a mid-phase hang still
+  salvages every measurement that landed, and (4) always prints ONE JSON
+  line — with ``"wedged": true`` and whatever partial results exist if
+  anything hung.
+* Every first compile inside a phase goes through
+  ``compile_guard.guarded`` (quarantine protocol), closing the unguarded
+  ad-hoc-bench hole that wedged round 2.
+
 Ports the reference's ``benchmarks/bench_batch_decode.py`` headline config
-(Llama-3 GQA 32/8 heads, head_dim 128, page 16; see BASELINE.md metric #2)
-and prints ONE JSON line:
+(Llama-3 GQA 32/8 heads, head_dim 128, page 16; see BASELINE.md metric #2):
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
@@ -10,17 +23,20 @@ Metric: achieved HBM bandwidth (TB/s) of ``BatchDecodeWithPagedKVCacheWrapper``
 at bs=64, ctx=4096 — decode attention is bandwidth-bound, so TB/s is the
 hardware-honest throughput number (testing/utils.py attention_tb_per_sec
 equivalent).  ``vs_baseline`` = fraction of this chip's HBM peak (v5e ~0.82
-TB/s, v5p ~2.76 TB/s), i.e. roofline efficiency — the reference publishes
-no absolute numbers (BASELINE.md), so roofline fraction is the comparable.
+TB/s), i.e. roofline efficiency — the reference publishes no absolute
+numbers (BASELINE.md), so roofline fraction is the comparable.
+
+``--bank`` appends the full run record (configs + timestamps + rows) to
+``BENCH_BANKED.md`` so numbers survive a later wedge.
 """
 
+import argparse
+import datetime
 import json
+import os
+import subprocess
 import sys
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
+import time
 
 HBM_PEAK_TBPS = {
     "v5e": 0.819,
@@ -29,131 +45,295 @@ HBM_PEAK_TBPS = {
     "v4": 1.228,
     "v6e": 1.64,
 }
+DEFAULT_PEAK = 0.819
+
+PROBE_TIMEOUT_S = 330.0
+PHASE_TIMEOUT_S = {
+    # generous: each cell may include a fresh Mosaic compile (20-60s via the
+    # axon tunnel); sweep decode has 16 cells
+    "sampling": 1200.0,
+    "decode": 1500.0,
+    "decode_sweep": 3600.0,
+}
 
 
 def chip_peak_tbps() -> float:
+    import jax
+
     kind = jax.devices()[0].device_kind.lower()
     for key, val in sorted(HBM_PEAK_TBPS.items(), key=lambda kv: -len(kv[0])):
         if key in kind.replace(" ", ""):
             return val
-    return 0.819
+    return DEFAULT_PEAK
 
 
-def _bench_decode(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
-                  head_dim=128, dtype=jnp.bfloat16):
+def _emit_row(**kw):
+    """Phase-side: one measurement, parseable by the orchestrator."""
+    print("ROW " + json.dumps(kw), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Phases (run in subprocesses; each initializes the TPU backend itself)
+# --------------------------------------------------------------------------
+
+
+def _guard(name, statics, thunk):
+    from flashinfer_tpu import compile_guard
+
+    return compile_guard.guarded(name, statics, thunk)
+
+
+def phase_decode(sweep: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import bench_fn_device, attention_bytes
+    from flashinfer_tpu.testing import attention_bytes, bench_fn_device
 
-    pages_per_req = ctx // page_size
-    num_pages = batch * pages_per_req
-    rng = np.random.default_rng(0)
-    perm = rng.permutation(num_pages).astype(np.int32)
-    indptr = np.arange(batch + 1, dtype=np.int32) * pages_per_req
-    last_page = np.full((batch,), page_size, np.int32)
+    peak = chip_peak_tbps()
 
-    key = jax.random.PRNGKey(0)
-    # HND cache layout (TPU-preferred contiguous page DMA)
-    kc = jax.random.normal(
-        key, (num_pages, num_kv_heads, page_size, head_dim), dtype
-    )
-    vc = jax.random.normal(
-        jax.random.fold_in(key, 1), (num_pages, num_kv_heads, page_size, head_dim),
-        dtype,
-    )
-    q = jax.random.normal(
-        jax.random.fold_in(key, 2), (batch, num_qo_heads, head_dim), dtype
-    )
+    def bench_one(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
+                  head_dim=128, dtype=jnp.bfloat16):
+        pages_per_req = ctx // page_size
+        num_pages = batch * pages_per_req
+        rng = np.random.default_rng(0)
+        perm = rng.permutation(num_pages).astype(np.int32)
+        indptr = np.arange(batch + 1, dtype=np.int32) * pages_per_req
+        last_page = np.full((batch,), page_size, np.int32)
 
-    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
-    w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads, head_dim, page_size)
+        key = jax.random.PRNGKey(0)
+        # HND cache layout (TPU-preferred contiguous page DMA)
+        kc = jax.random.normal(
+            key, (num_pages, num_kv_heads, page_size, head_dim), dtype
+        )
+        vc = jax.random.normal(
+            jax.random.fold_in(key, 1),
+            (num_pages, num_kv_heads, page_size, head_dim), dtype,
+        )
+        q = jax.random.normal(
+            jax.random.fold_in(key, 2), (batch, num_qo_heads, head_dim), dtype
+        )
 
-    # Slope-fit in-jit loop timing: the only honest protocol through the
-    # axon tunnel, where block_until_ready is not an execution fence and
-    # per-dispatch overhead is ~4.5 ms (see bench_fn_device docstring).
-    t = bench_fn_device(
-        lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc, repeats=5
-    )
-    total_bytes = batch * attention_bytes(
-        1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2
-    )
-    tbps = total_bytes / t / 1e12
-    toks_per_s = batch / t
-    return t, tbps, toks_per_s
+        w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads,
+               head_dim, page_size)
+
+        # Slope-fit in-jit loop timing (bench_fn_device docstring): the only
+        # honest protocol through the axon tunnel.  The whole first call —
+        # including the Mosaic compile of the loop body — runs guarded.
+        t = _guard(
+            "bench.decode", (batch, ctx, page_size, num_qo_heads,
+                             num_kv_heads, head_dim, str(dtype)),
+            lambda: bench_fn_device(
+                lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc, repeats=5
+            ),
+        )
+        total_bytes = batch * attention_bytes(
+            1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2
+        )
+        return t, total_bytes / t / 1e12, batch / t
+
+    grid = ([(1, 512), (1, 2048), (1, 4096), (1, 8192),
+             (16, 512), (16, 2048), (16, 4096), (16, 8192),
+             (64, 512), (64, 2048), (64, 4096), (64, 8192),
+             (256, 512), (256, 2048), (256, 4096), (256, 8192)]
+            if sweep else [(64, 4096)])
+    # headline config first: if the phase dies mid-sweep, the deliverable
+    # number is already banked
+    grid.sort(key=lambda bc: bc != (64, 4096))
+    for bs, ctx in grid:
+        t, tbps, tps = bench_one(bs, ctx)
+        _emit_row(phase="decode", bs=bs, ctx=ctx, us=round(t * 1e6, 1),
+                  tbps=round(tbps, 4), tok_s=round(tps, 0), peak=peak)
+        print(f"# decode bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
+              f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s", file=sys.stderr)
 
 
-def _bench_sampling(batch, vocab=128 * 1024, backend="pallas"):
-    """Joint top-k/top-p filtered sampling latency at LLM vocab size
-    (reference bench: sorting-free rejection kernels, sampling.cuh:293).
-    ``backend="pallas"`` = single-pass VMEM threshold-bisection kernel;
-    ``"xla"`` = the sort-based oracle form."""
+def phase_sampling(sweep: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu.ops.sampling_kernels import threshold_select
     from flashinfer_tpu.sampling import (
         _top_k_top_p_filter_xla, sampling_from_probs,
     )
-    from flashinfer_tpu.ops.sampling_kernels import threshold_select
     from flashinfer_tpu.testing import bench_fn_device
 
-    key = jax.random.PRNGKey(0)
-    logits = jax.random.normal(key, (batch, vocab), jnp.float32) * 4.0
-    probs = jax.nn.softmax(logits, axis=-1)
-    k = jnp.full((batch,), 40.0, jnp.float32)
-    tp = jnp.full((batch,), 0.95, jnp.float32)
-
-    if backend == "pallas":
-        fn = lambda p, kk: sampling_from_probs(
-            threshold_select(p, k, tp, mode="top_k_top_p_seq"), kk
-        )
-    else:
-        fn = lambda p, kk: sampling_from_probs(
-            _top_k_top_p_filter_xla(p, k.astype(jnp.int32), tp, False), kk
-        )
-    t = bench_fn_device(fn, probs, jax.random.PRNGKey(1), repeats=5)
-    return t
-
-
-def main():
-    sweep = "--sweep" in sys.argv
-    headline = None
-    sampling_us = None
-    try:
-        if sweep:
-            for bs in (1, 16, 64):
-                tk = _bench_sampling(bs, backend="pallas") * 1e6
-                tx = _bench_sampling(bs, backend="xla") * 1e6
-                if bs == 64:
-                    sampling_us = tk  # headline reuses the sweep pass
-                print(
-                    f"# sampling 128k-vocab bs={bs:3d}: kernel {tk:8.1f} us"
-                    f"  xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)",
-                    file=sys.stderr,
-                )
+    def bench_one(batch, vocab, backend):
+        key = jax.random.PRNGKey(0)
+        logits = jax.random.normal(key, (batch, vocab), jnp.float32) * 4.0
+        probs = jax.nn.softmax(logits, axis=-1)
+        k = jnp.full((batch,), 40.0, jnp.float32)
+        tp = jnp.full((batch,), 0.95, jnp.float32)
+        if backend == "pallas":
+            fn = lambda p, kk: sampling_from_probs(
+                threshold_select(p, k, tp, mode="top_k_top_p_seq"), kk
+            )
         else:
-            sampling_us = _bench_sampling(64) * 1e6
-    except Exception as e:  # sampling bench must never sink the headline
-        print(f"# sampling bench failed: {e!r}", file=sys.stderr)
+            fn = lambda p, kk: sampling_from_probs(
+                _top_k_top_p_filter_xla(p, k.astype(jnp.int32), tp, False), kk
+            )
+        return _guard(
+            "bench.sampling", (batch, vocab, backend),
+            lambda: bench_fn_device(fn, probs, jax.random.PRNGKey(1),
+                                    repeats=5),
+        )
+
+    vocab = 128 * 1024
+    for bs in ((64, 1, 16) if sweep else (64,)):
+        tk = bench_one(bs, vocab, "pallas") * 1e6
+        tx = bench_one(bs, vocab, "xla") * 1e6
+        _emit_row(phase="sampling", bs=bs, vocab=vocab,
+                  kernel_us=round(tk, 1), xla_us=round(tx, 1),
+                  speedup=round(tx / tk, 2))
+        print(f"# sampling 128k-vocab bs={bs:3d}: kernel {tk:8.1f} us  "
+              f"xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)", file=sys.stderr)
+
+
+def phase_selftest(sweep: bool):
+    """Orchestration self-test: emits rows then hangs (no TPU touched) —
+    lets CI assert that a hung phase still yields its landed rows."""
+    _emit_row(phase="selftest", n=1)
+    _emit_row(phase="selftest", n=2)
+    if os.environ.get("BENCH_SELFTEST_HANG"):
+        time.sleep(600)
+
+
+PHASES = {
+    "decode": phase_decode,
+    "sampling": phase_sampling,
+    "selftest": phase_selftest,
+}
+# selftest is CI-only (reachable via --only); production runs must not
+# spawn the stub or bank its rows
+DEFAULT_PHASES = ["decode", "sampling"]
+
+
+# --------------------------------------------------------------------------
+# Orchestrator
+# --------------------------------------------------------------------------
+
+
+def _run_phase(name: str, sweep: bool, timeout_s: float):
+    """Run one phase in a subprocess; return (rows, ok, detail)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", name]
     if sweep:
-        # the reference bench_batch_decode.py sweep grid (bs x seqlen)
-        for bs in (1, 16, 64, 256):
-            for ctx in (512, 2048, 4096, 8192):
-                t, tbps, tps = _bench_decode(bs, ctx)
-                if (bs, ctx) == (64, 4096):
-                    headline = (t, tbps)
-                print(
-                    f"# bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
-                    f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s",
-                    file=sys.stderr,
-                )
-    t, tbps = headline if headline else _bench_decode(64, 4096)[:2]
-    peak = chip_peak_tbps()
+        cmd.append("--sweep")
+    rows, ok, detail = [], False, ""
+    t0 = time.time()
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    try:
+        # incremental read: rows printed before a hang are kept
+        import threading
+
+        def pump():
+            for line in p.stdout:
+                if line.startswith("ROW "):
+                    try:
+                        rows.append(json.loads(line[4:]))
+                    except json.JSONDecodeError:
+                        pass
+
+        def pump_err():
+            for line in p.stderr:
+                sys.stderr.write(line)
+
+        th = threading.Thread(target=pump, daemon=True)
+        te = threading.Thread(target=pump_err, daemon=True)
+        th.start()
+        te.start()
+        p.wait(timeout=timeout_s)
+        th.join(timeout=10)
+        te.join(timeout=10)
+        ok = p.returncode == 0
+        detail = f"rc={p.returncode}"
+    except subprocess.TimeoutExpired:
+        p.kill()
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+        # after kill the pipe EOFs: a short join drains ROW lines that were
+        # buffered when the phase hung — the salvage guarantee
+        th.join(timeout=10)
+        te.join(timeout=10)
+        detail = f"timed out after {timeout_s:.0f}s (chip wedged?)"
+    print(f"# phase {name}: {len(rows)} rows, {detail}, "
+          f"{time.time() - t0:.0f}s", file=sys.stderr)
+    return rows, ok, detail
+
+
+def _bank(record: dict) -> None:
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    lines = [f"\n## {stamp} — bench.py run\n", "```json"]
+    lines.append(json.dumps(record, indent=1))
+    lines.append("```")
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_BANKED.md"), "a") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def orchestrate(sweep: bool, bank: bool, phases=None) -> int:
+    from flashinfer_tpu import compile_guard
+
+    wedged = False
+    all_rows = []
+    probe = compile_guard.probe(timeout_s=PROBE_TIMEOUT_S)
+    print(f"# probe: {probe}", file=sys.stderr)
+    if probe["healthy"]:
+        for name in (phases or DEFAULT_PHASES):
+            key = f"{name}_sweep" if sweep else name
+            timeout = PHASE_TIMEOUT_S.get(key, PHASE_TIMEOUT_S.get(name, 900))
+            rows, ok, detail = _run_phase(name, sweep, timeout)
+            all_rows.extend(rows)
+            if not ok:
+                wedged = wedged or "timed out" in detail
+    else:
+        wedged = True
+
+    headline = next(
+        (r for r in all_rows
+         if r.get("phase") == "decode" and (r["bs"], r["ctx"]) == (64, 4096)),
+        None,
+    )
+    peak = (headline or {}).get("peak", DEFAULT_PEAK)
+    tbps = (headline or {}).get("tbps", 0.0)
     result = {
         "metric": "batch_decode_attention_bandwidth_bs64_ctx4k",
         "value": round(tbps, 4),
         "unit": "TB/s",
         "vs_baseline": round(tbps / peak, 4),
     }
-    if sampling_us is not None:
-        result["sampling_128k_bs64_us"] = round(sampling_us, 1)
+    sampling = next((r for r in all_rows
+                     if r.get("phase") == "sampling" and r["bs"] == 64), None)
+    if sampling:
+        result["sampling_128k_bs64_us"] = sampling["kernel_us"]
+    if wedged:
+        result["wedged"] = True
+    if bank:
+        _bank({"result": result, "rows": all_rows, "probe": probe,
+               "sweep": sweep})
     print(json.dumps(result))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--bank", action="store_true",
+                    help="append full run record to BENCH_BANKED.md")
+    ap.add_argument("--phase", choices=sorted(PHASES),
+                    help="internal: run one phase in-process")
+    ap.add_argument("--only", action="append",
+                    help="orchestrate only these phases")
+    args = ap.parse_args()
+    if args.phase:
+        PHASES[args.phase](args.sweep)
+        return 0
+    return orchestrate(args.sweep, args.bank, phases=args.only)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -28,12 +28,29 @@ import numpy as np
 def _bench(args, fn, *operands):
     """Slope-fit device timing (see testing.bench_fn_device) — the plain
     per-call timer reports dispatch overhead, not kernel time, through the
-    axon tunnel."""
+    axon tunnel.  The whole first timing call (which contains the Mosaic
+    compile) runs under ``compile_guard.guarded`` so an ad-hoc routine can
+    never wedge the chip outside the quarantine protocol (the round-2
+    escape path)."""
+    from flashinfer_tpu import compile_guard
     from flashinfer_tpu.testing import bench_fn_device
 
     hi = max(args.iters, 3)
     lo = max(hi // 4, 1)
-    return bench_fn_device(fn, *operands, iters_low=lo, iters_high=hi, repeats=2)
+    # fingerprint by the bench fn's source location + operand signature:
+    # stable across reruns/routine subsets (a call-order counter would make
+    # persisted quarantine entries miss on any differently-ordered rerun)
+    code = getattr(fn, "__code__", None)
+    fn_id = (f"{getattr(code, 'co_filename', '?')}:"
+             f"{getattr(code, 'co_firstlineno', 0)}")
+    statics = (fn_id, args.routine,
+               tuple((getattr(o, "shape", None), str(getattr(o, "dtype", "")))
+                     for o in operands))
+    return compile_guard.guarded(
+        f"flashinfer_benchmark.{args.routine}", statics,
+        lambda: bench_fn_device(fn, *operands, iters_low=lo, iters_high=hi,
+                                repeats=2),
+    )
 
 
 def _rows_decode(args):

@@ -158,16 +158,13 @@ class AutoTuner:
 
         import jax
 
-        try:
-            from jax.core import trace_state_clean
+        from flashinfer_tpu.compile_guard import trace_state_clean
 
-            # called under a jit trace (op embedded in a user model):
-            # wall-clock profiling is meaningless there and must not
-            # poison the persistent cache
-            if not trace_state_clean():
-                return default if default is not None else candidates[0]
-        except ImportError:
-            pass
+        # called under a jit trace (op embedded in a user model):
+        # wall-clock profiling is meaningless there and must not
+        # poison the persistent cache
+        if not trace_state_clean():
+            return default if default is not None else candidates[0]
 
         from flashinfer_tpu import compile_guard
 

@@ -30,7 +30,10 @@ import jax.numpy as jnp
 from flashinfer_tpu.api_logging import flashinfer_api
 from flashinfer_tpu.utils import resolve_backend
 
-_NEG_INF = jnp.float32(-1e30)
+# plain float, not jnp.float32(): a module-level jnp scalar dispatches a
+# device op at import time, which initializes the backend — and hangs the
+# *import* when the tunneled chip is wedged (observed round 3)
+_NEG_INF = -1e30
 
 
 @functools.partial(jax.jit, static_argnames=())
