@@ -25,6 +25,12 @@ Page DMA shrinks from 32 KB (bf16, D=128/PS=16/Hkv=8) to 8 KB + 512 B —
 a ~3.8x cut on the op where HBM bytes are everything.  Structure mirrors
 ``ops/paged_decode.py:_decode_kernel_fused_heads`` (grid step per request,
 whole-page DMAs serving all KV heads, double buffering).
+
+Round-3 restructure (the ppc=16 wedge fix): the per-page DMA loops are
+rolled ``fori_loop``s and the per-row dequant scales come from ONE
+selector dot per (head, tensor) instead of ``2*ppc`` small dots, so the
+kernel's unrolled op count no longer scales with ``pages_per_chunk`` —
+the shape that hung the Mosaic compiler (repo memory ``tpu-wedge-history``).
 """
 
 from __future__ import annotations
@@ -106,23 +112,30 @@ def _fp4_decode_kernel(
     half_ps = page_size // 2
     num_chunks = pl.cdiv(kv_len, chunk_tokens)
 
-    def page_dmas(chunk_idx, slot):
-        dmas = []
-        for j in range(ppc):
+    def _chunk_dmas(chunk_idx, slot, action):
+        """Start or wait the chunk's 4*ppc page DMAs via a ROLLED loop.
+
+        The round-2 wedge culprit was this kernel's fully-unrolled body at
+        ppc=16 (hundreds of unrolled small ops hung the Mosaic compiler);
+        rolling the per-page loop keeps the op count independent of ppc."""
+
+        def body(j, _):
             page = pages_ref[b, chunk_idx * ppc + j]
             for src, dst, ch in (
                 (k4_hbm, k_buf, 0), (ksc_hbm, ksc_buf, 1),
                 (v4_hbm, v_buf, 2), (vsc_hbm, vsc_buf, 3),
             ):
-                dmas.append(pltpu.make_async_copy(
+                dma = pltpu.make_async_copy(
                     src.at[page], dst.at[slot, j], sem.at[slot, ch, j]
-                ))
-        return dmas
+                )
+                dma.start() if action == "start" else dma.wait()
+            return 0
+
+        jax.lax.fori_loop(0, ppc, body, 0)
 
     @pl.when(num_chunks > 0)
     def _warmup():
-        for dma in page_dmas(0, 0):
-            dma.start()
+        _chunk_dmas(0, 0, "start")
 
     q = q_ref[...]
     gp, head_dim = q.shape[1], q.shape[2]
@@ -137,21 +150,35 @@ def _fp4_decode_kernel(
     tt = jax.lax.rem(within, half_ps)
     tok_in_chunk = pg * page_size + 2 * tt + parity  # [1, chunk]
 
+    # constant row-index decomposition for the scale-selection dot below:
+    # row r (unpacked order) = (parity, page, token-pair)
+    r_sub = jax.lax.broadcasted_iota(jnp.int32, (chunk_tokens, 128), 0)
+    par_c = (r_sub >= half).astype(jnp.int32)
+    within_c = jax.lax.rem(r_sub, half)
+    pg_c = within_c // half_ps
+    tt_c = jax.lax.rem(within_c, half_ps)
+    lane_c = jax.lax.broadcasted_iota(jnp.int32, (chunk_tokens, 128), 1)
+
     def row_scales(sc_buf, slot, h):
-        """[chunk, 1] per-row dequant scale, in unpacked row order."""
-        parts = []
-        for par in range(2):
-            # G[tt, c] = 1 iff lane c holds (head h, token 2*tt + par)
-            lane = jax.lax.broadcasted_iota(jnp.int32, (half_ps, 128), 1)
-            sub = jax.lax.broadcasted_iota(jnp.int32, (half_ps, 128), 0)
-            G = (lane == h * page_size + 2 * sub + par).astype(jnp.float32)
-            for p in range(ppc):
-                srow = sc_buf[slot, p].reshape(1, 128)
-                parts.append(jax.lax.dot_general(
-                    G, srow, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ))  # [half_ps, 1]
-        return jnp.concatenate(parts, axis=0)  # [chunk, 1]
+        """[chunk, 1] per-row dequant scale, in unpacked row order.
+
+        ONE selector dot per (head, tensor) — G[r, c] = 1 iff lane c of the
+        scale row holds (head h, token of unpacked row r); M1 = G @ sc^T
+        gives the candidate scale from every page, and a constant page-match
+        mask picks row r's own page.  Replaces the former 2*ppc-small-dots
+        unroll whose op count scaled with ppc (the wedge vector)."""
+        G = (lane_c == h * page_size + 2 * tt_c + par_c).astype(jnp.float32)
+        m1 = jax.lax.dot_general(
+            G, sc_buf[slot], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [chunk, ppc]
+        r_p = jax.lax.broadcasted_iota(jnp.int32, (chunk_tokens, ppc), 0)
+        own_pg = jax.lax.rem(r_p, half) // half_ps
+        pmask = (
+            jax.lax.broadcasted_iota(jnp.int32, (chunk_tokens, ppc), 1)
+            == own_pg
+        ).astype(jnp.float32)
+        return jnp.sum(m1 * pmask, axis=1, keepdims=True)  # [chunk, 1]
 
     def unpack(buf, slot, h):
         pk = buf[slot, :, h].reshape(ppc * half_ps, head_dim)
@@ -166,11 +193,9 @@ def _fp4_decode_kernel(
 
         @pl.when(i + 1 < num_chunks)
         def _prefetch():
-            for dma in page_dmas(i + 1, jax.lax.rem(i + 1, 2)):
-                dma.start()
+            _chunk_dmas(i + 1, jax.lax.rem(i + 1, 2), "start")
 
-        for dma in page_dmas(i, slot):
-            dma.wait()
+        _chunk_dmas(i, slot, "wait")
 
         tok = i * chunk_tokens + tok_in_chunk
         valid = tok < kv_len
@@ -268,10 +293,10 @@ def fp4_paged_decode_attention(
             pl.BlockSpec(
                 (None, num_kv_heads, gp, head_dim), lambda b, *_: (b, 0, 0, 0)
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec(

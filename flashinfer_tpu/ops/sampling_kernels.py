@@ -125,6 +125,19 @@ def threshold_select(
     mode: str,
     block_rows: int = 8,
 ):
+    """Threshold-based top-k/top-p filtering (see module docstring for modes).
+
+    Epsilon-tie semantics: the value-space bisection runs in f32, so the
+    threshold resolves to at best ``~range * 2**-_BISECT_ITERS`` (f32 also
+    caps effective resolution near ``range * 2**-24``).  Every token within
+    float resolution of the cut is treated as tied and KEPT — on
+    near-uniform tails (e.g. flat logits at 128k vocab) the kept set can
+    therefore exceed k (or the top-p mass) beyond true exact ties, where a
+    sort-based oracle would cut arbitrarily among equals.  This is the
+    library's documented tie contract (reference threshold kernels share
+    it, ``sampling.cuh:293``); callers needing strict-k must post-trim.
+    ``tests/test_sampling.py::test_threshold_near_uniform_ties`` bounds the
+    deviation."""
     x = probs_or_logits.astype(jnp.float32)
     batch, vocab = x.shape
     vpad = round_up(vocab, 128)

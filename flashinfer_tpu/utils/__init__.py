@@ -53,8 +53,18 @@ def atomic_write_text(path, text: str) -> None:
 
     import contextlib
 
+    import time
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    # opportunistic sweep: a SIGKILL between mkstemp and os.replace leaks
+    # the temp file; age-gate so a concurrent writer's live temp survives
+    with contextlib.suppress(OSError):
+        cutoff = time.time() - 3600.0
+        for stale in path.parent.glob(path.name + ".tmp*"):
+            with contextlib.suppress(OSError):
+                if stale.stat().st_mtime < cutoff:
+                    stale.unlink()
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".tmp")
     try:
         with os.fdopen(fd, "w") as fh:

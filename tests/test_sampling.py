@@ -237,3 +237,44 @@ class TestThresholdSelect:
         out_x = fi.top_k_renorm_probs(p, 10, backend="xla")
         np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
                                    rtol=1e-5, atol=1e-7)
+
+
+    def test_threshold_near_uniform_ties(self):
+        """Epsilon-tie contract at LLM vocab scale (ADVICE r2): on a
+        near-uniform distribution every token within f32 bisection
+        resolution of the cut is kept, so the kept count may exceed k —
+        but only by the tied band, and never below k, and the kept set
+        must still contain the true top-k."""
+        from flashinfer_tpu.ops.sampling_kernels import threshold_select
+
+        rng = np.random.default_rng(7)
+        vocab = 128 * 1024
+        # near-uniform: probs differ only in the ~1e-7 relative range where
+        # the f32 threshold can no longer separate neighbors
+        base = np.full((2, vocab), 1.0, np.float32)
+        jitter = rng.random((2, vocab)).astype(np.float32) * 1e-5
+        p = base + jitter
+        p = p / p.sum(-1, keepdims=True)
+        k = 40
+        out = np.asarray(threshold_select(
+            jnp.asarray(p), jnp.full((2,), float(k), jnp.float32),
+            jnp.full((2,), 1.0, jnp.float32), mode="top_k",
+        ))
+        kept = out > 0
+        for row in range(2):
+            n_kept = int(kept[row].sum())
+            assert n_kept >= k, f"kept {n_kept} < k={k}"
+            # tied-band bound: threshold error <= range * 2^-32 of the
+            # bisection span; count tokens within one f32 ulp-band of the
+            # k-th value and require kept <= k + that band
+            kth = np.sort(p[row])[::-1][k - 1]
+            band = np.abs(p[row] - kth) <= np.spacing(kth) * 4
+            assert n_kept <= k + int(band.sum()), (
+                f"kept {n_kept} exceeds k + tie band {k}+{int(band.sum())}"
+            )
+            # the true top-k values are all kept (no false drops)
+            top_idx = np.argsort(-p[row])[:k]
+            strict_top = p[row][top_idx] > kth + np.spacing(kth) * 4
+            assert kept[row][top_idx[strict_top]].all()
+        # renormalized output still sums to 1
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-3)
