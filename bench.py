@@ -54,6 +54,8 @@ PHASE_TIMEOUT_S = {
     "sampling": 1200.0,
     "decode": 1500.0,
     "decode_sweep": 3600.0,
+    "moe": 1500.0,
+    "moe_sweep": 2400.0,
 }
 
 
@@ -191,6 +193,66 @@ def phase_sampling(sweep: bool):
               f"xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)", file=sys.stderr)
 
 
+def phase_moe(sweep: bool):
+    """Fused MoE: Pallas gather-GMM pipeline vs ragged_dot (VERDICT r2 #4).
+
+    Mixtral-8x7B shape (E=8, H=4096, I=14336, K=2) — weights fit v5e HBM
+    in bf16; int8 variant also measured (native int8 MXU path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu import fused_moe as moe_pkg
+    from flashinfer_tpu.quantization import quantize_int8
+    from flashinfer_tpu.testing import bench_fn_device
+
+    if os.environ.get("BENCH_SMALL"):  # CPU smoke of the phase plumbing
+        E, H, I, K = 4, 256, 512, 2
+        token_counts = {False: (64,), True: (32, 64)}
+    else:
+        E, H, I, K = 8, 4096, 14336, 2
+        token_counts = {False: (1024,), True: (256, 1024)}
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (E, H, 2 * I), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(jax.random.fold_in(key, 1), (E, I, H),
+                           jnp.bfloat16) * 0.02
+    w1q, w1s = quantize_int8(w1, axis=1)
+    w2q, w2s = quantize_int8(w2, axis=1)
+
+    for T in token_counts[sweep]:
+        x = jax.random.normal(jax.random.fold_in(key, 2), (T, H),
+                              jnp.bfloat16)
+        logits = jax.random.normal(jax.random.fold_in(key, 3), (T, E),
+                                   jnp.float32)
+        wts, ids = moe_pkg.route_renormalize(logits, K)
+        flops = 2 * T * K * (H * 2 * I + I * H)  # madd=2 flops, both GEMMs
+        # weights ride as operands — bench_fn_device forbids closing over
+        # large arrays (they'd embed as HLO constants)
+        def bf16_fn(backend):
+            return lambda xx, ww, ii, a, b: moe_pkg.fused_moe(
+                xx, a, b, ww, ii, E, backend=backend)
+
+        def int8_fn(backend):
+            return lambda xx, ww, ii, a, b, sa, sb: moe_pkg.fused_moe(
+                xx, a, b, ww, ii, E, w1_scale=sa, w2_scale=sb,
+                backend=backend)
+
+        for name, fn, ops in (
+            ("ragged_bf16", bf16_fn("ragged"), (w1, w2)),
+            ("gmm_bf16", bf16_fn("gmm"), (w1, w2)),
+            ("ragged_int8", int8_fn("ragged"), (w1q, w2q, w1s, w2s)),
+            ("gmm_int8", int8_fn("gmm"), (w1q, w2q, w1s, w2s)),
+        ):
+            t = _guard(
+                f"bench.moe.{name}", (T, E, H, I, K),
+                lambda: bench_fn_device(fn, x, wts, ids, *ops, repeats=3),
+            )
+            _emit_row(phase="moe", variant=name, tokens=T,
+                      us=round(t * 1e6, 1),
+                      tflops=round(flops / t / 1e12, 2))
+            print(f"# moe {name:12s} T={T:5d}: {t*1e6:9.1f} us  "
+                  f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -203,11 +265,12 @@ def phase_selftest(sweep: bool):
 PHASES = {
     "decode": phase_decode,
     "sampling": phase_sampling,
+    "moe": phase_moe,
     "selftest": phase_selftest,
 }
 # selftest is CI-only (reachable via --only); production runs must not
 # spawn the stub or bank its rows
-DEFAULT_PHASES = ["decode", "sampling"]
+DEFAULT_PHASES = ["decode", "sampling", "moe"]
 
 
 # --------------------------------------------------------------------------
@@ -275,12 +338,15 @@ def _bank(record: dict) -> None:
         fh.write("\n".join(lines) + "\n")
 
 
-def orchestrate(sweep: bool, bank: bool, phases=None) -> int:
+def orchestrate(sweep: bool, bank: bool, phases=None, no_probe=False) -> int:
     from flashinfer_tpu import compile_guard
 
     wedged = False
     all_rows = []
-    probe = compile_guard.probe(timeout_s=PROBE_TIMEOUT_S)
+    if no_probe:
+        probe = {"healthy": True, "detail": "skipped (--no-probe)"}
+    else:
+        probe = compile_guard.probe(timeout_s=PROBE_TIMEOUT_S)
     print(f"# probe: {probe}", file=sys.stderr)
     if probe["healthy"]:
         for name in (phases or DEFAULT_PHASES):
@@ -328,11 +394,17 @@ def main():
                     help="internal: run one phase in-process")
     ap.add_argument("--only", action="append",
                     help="orchestrate only these phases")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the chip-health preamble (CPU smoke runs)")
     args = ap.parse_args()
     if args.phase:
+        from flashinfer_tpu.env import apply_platform_from_env
+
+        apply_platform_from_env()
         PHASES[args.phase](args.sweep)
         return 0
-    return orchestrate(args.sweep, args.bank, phases=args.only)
+    return orchestrate(args.sweep, args.bank, phases=args.only,
+                       no_probe=args.no_probe)
 
 
 if __name__ == "__main__":

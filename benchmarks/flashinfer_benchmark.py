@@ -262,6 +262,9 @@ def main(argv=None):
 if __name__ == "__main__":
     import jax
 
-    if jax.default_backend() == "cpu" or "--cpu" in sys.argv:
-        pass
+    from flashinfer_tpu.env import apply_platform_from_env
+
+    if "--cpu" in sys.argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    apply_platform_from_env()
     sys.exit(main([a for a in sys.argv[1:] if a != "--cpu"]))

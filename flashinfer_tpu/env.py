@@ -79,3 +79,22 @@ def enable_compilation_cache() -> None:
     jax.config.update("jax_compilation_cache_dir", str(d))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     _CACHE_ENABLED = True
+
+
+def apply_platform_from_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even under the axon sitecustomize.
+
+    The axon image pre-registers its PJRT plugin at interpreter start, so
+    the env var alone does not move a script off the TPU tunnel (repo
+    memory ``axon-env-gotchas``) — standalone scripts that want CPU must
+    force it via config BEFORE any device use.  CPU also implies Pallas
+    interpret mode (Mosaic cannot target CPU).
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    if plat == "cpu":
+        os.environ.setdefault("FLASHINFER_TPU_INTERPRET", "1")

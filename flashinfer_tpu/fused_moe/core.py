@@ -53,8 +53,69 @@ def _quant_rows_int8(x: jax.Array):
     return quantize_int8(x, axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("num_experts", "activation"))
+def _gmm_tileable(hidden_dim: int, inter2: int) -> bool:
+    # gmm picks tk as the largest power-of-two divisor >= 128, so 128
+    # alignment of every contraction/output dim is the whole requirement
+    return hidden_dim % 128 == 0 and inter2 % 128 == 0 and inter2 // 2 % 128 == 0
+
+
 def fused_moe(
+    hidden: jax.Array,
+    w_gate_up: jax.Array,
+    w_down: jax.Array,
+    topk_weights: jax.Array,
+    topk_ids: jax.Array,
+    num_experts: int,
+    activation: str = "silu",
+    w1_scale: Optional[jax.Array] = None,
+    w2_scale: Optional[jax.Array] = None,
+    backend: str = "auto",
+) -> jax.Array:
+    """Single-device fused MoE forward -> [T, hidden].
+
+    Backends (reference analogue: cutlass vs trtllm-gen backend dispatch,
+    fused_moe/core.py:873):
+
+    - ``"gmm"``: Pallas grouped-matmul pipeline (``ops/moe_gmm.py``) — the
+      first GEMM gathers token rows straight from the unsorted ``hidden``
+      (no ``[T*K, hidden]`` sorted copy in HBM), the second runs over the
+      already-grouped activation rows; int8 variants quantize per-token
+      BEFORE routing (T rows, not T*K) and fold all scales into the store
+      epilogues.
+    - ``"ragged"``: ``jax.lax.ragged_dot`` over materialized sorted rows
+      (the XLA fallback, and the oracle for tests).
+    - ``"auto"``: env ``FLASHINFER_TPU_MOE_BACKEND`` if set, else
+      ``"ragged"`` until the banked bench says otherwise, with shape
+      gating (gmm needs 128-aligned hidden/inter dims).
+
+    Backend resolution happens outside the jitted body so the env var is
+    re-read on every *eager* call; a caller that wraps fused_moe in its own
+    jax.jit pins the trace-time value in that outer cache.
+    """
+    tileable = _gmm_tileable(hidden.shape[1], w_gate_up.shape[2])
+    if backend == "auto":
+        import os
+
+        backend = os.environ.get("FLASHINFER_TPU_MOE_BACKEND", "ragged")
+        if backend == "gmm" and not tileable:
+            backend = "ragged"  # auto falls back; explicit "gmm" raises
+    if backend not in ("gmm", "ragged"):
+        raise ValueError(f"unknown fused_moe backend {backend!r}")
+    if backend == "gmm" and not tileable:
+        raise ValueError(
+            "gmm backend requires 128-aligned hidden/inter dims, got "
+            f"hidden={hidden.shape[1]} 2*inter={w_gate_up.shape[2]}"
+        )
+    return _fused_moe_impl(
+        hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
+        activation, w1_scale, w2_scale, backend,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_experts", "activation", "backend")
+)
+def _fused_moe_impl(
     hidden: jax.Array,  # [T, hidden]
     w_gate_up: jax.Array,  # [E, hidden, 2*inter] bf16 OR int8
     w_down: jax.Array,  # [E, inter, hidden]
@@ -64,26 +125,47 @@ def fused_moe(
     activation: str = "silu",
     w1_scale: Optional[jax.Array] = None,  # [E, 1, 2*inter] (int8 weights)
     w2_scale: Optional[jax.Array] = None,  # [E, 1, hidden]
+    backend: str = "ragged",
 ) -> jax.Array:
-    """Single-device fused MoE forward -> [T, hidden].
+    """Jitted body of :func:`fused_moe` (backend already resolved).
 
     With int8 weights (+ per-channel scales), both grouped GEMMs run on the
     native int8 MXU path (int8 x int8 -> int32, the v5e low-precision
-    story; reference analogue: fp8 cutlass_fused_moe, fused_moe/core.py:873)
-    with dynamic per-row activation quantization — weights cross HBM at
-    half width and the MXU runs at its doubled int8 rate.
+    story) with dynamic per-row activation quantization — weights cross
+    HBM at half width and the MXU runs at its doubled int8 rate.
     """
     T, K = topk_ids.shape
     dtype = hidden.dtype
+    quantized = w_gate_up.dtype == jnp.int8
 
     flat_expert = topk_ids.reshape(-1)  # [T*K]
     order = jnp.argsort(flat_expert, stable=True)
     inv_token = order // K  # source token of each sorted row
-    x_sorted = hidden[inv_token]  # [T*K, hidden]
     group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
 
-    if w_gate_up.dtype == jnp.int8:
+    if backend == "gmm":
+        from flashinfer_tpu.ops.moe_gmm import gather_gmm, gmm
+
+        if quantized:
+            assert w1_scale is not None and w2_scale is not None
+            xq, xs = _quant_rows_int8(hidden)  # per-TOKEN: T rows, not T*K
+            h1 = gather_gmm(
+                xq, inv_token, w_gate_up, group_sizes,
+                xs[:, 0], w1_scale.reshape(num_experts, -1),
+            ).astype(dtype)
+            a = _act(h1, activation)
+            aq, as_ = _quant_rows_int8(a)
+            h2 = gmm(
+                aq, w_down, group_sizes,
+                as_[:, 0], w2_scale.reshape(num_experts, -1),
+            )
+        else:
+            h1 = gather_gmm(hidden, inv_token, w_gate_up, group_sizes)
+            a = _act(h1, activation)
+            h2 = gmm(a, w_down, group_sizes)
+    elif quantized:
         assert w1_scale is not None and w2_scale is not None
+        x_sorted = hidden[inv_token]  # [T*K, hidden]
         expert_sorted = flat_expert[order]  # [T*K]
         xq, xs = _quant_rows_int8(x_sorted)
         h1i = jax.lax.ragged_dot(
@@ -99,6 +181,7 @@ def fused_moe(
         ws2 = w2_scale.reshape(num_experts, -1)[expert_sorted]  # [T*K, H]
         h2 = h2i.astype(jnp.float32) * as_ * ws2
     else:
+        x_sorted = hidden[inv_token]  # [T*K, hidden]
         h1 = jax.lax.ragged_dot(x_sorted, w_gate_up, group_sizes)  # [T*K, 2I]
         a = _act(h1, activation)
         h2 = jax.lax.ragged_dot(a, w_down, group_sizes)  # [T*K, hidden]

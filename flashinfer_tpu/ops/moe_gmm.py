@@ -1,0 +1,354 @@
+"""Grouped matmul (megablox-style) + fused-gather variant for MoE.
+
+TPU re-design of the reference's CUTLASS fused-MoE grouped GEMMs
+(``/root/reference/flashinfer/fused_moe/core.py:873``,
+``csrc/fused_moe/cutlass_backend/``): tokens sorted by expert feed one
+grouped GEMM per layer half.  On TPU the grouped GEMM is a single Pallas
+kernel over group-offset metadata (the public megablox/gmm pattern —
+jax.experimental.pallas.ops.tpu.megablox — re-implemented here so we can
+fuse what the stock op cannot):
+
+- ``gmm(lhs, rhs, group_sizes)``: expert-blocked matmul where m-tiles that
+  straddle a group boundary are visited once per group with masked stores
+  (no capacity padding, no wasted MXU work on empty experts).
+- ``gather_gmm(x, row_ids, rhs, group_sizes)``: the first MoE GEMM without
+  ever materializing the ``[T*K, hidden]`` expert-sorted copy of the
+  activations — the kernel DMAs each tile's rows directly from the
+  *unsorted* token array by index (VERDICT r2 item 4: that copy cost 2x
+  activation HBM traffic on the serving-critical path).
+- both take int8 operands with per-row (activation) and per-col (weight)
+  scales folded into the store epilogue — the native-int8-MXU analogue of
+  the reference's fp8 cutlass path.
+
+Grid layout (n, tile, k), k innermost, n outermost: output blocks are
+revisited only consecutively (boundary tiles), so partial stores stay in
+VMEM; the f32/int32 accumulator lives in scratch across the k sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashinfer_tpu.utils import round_up, use_interpret
+
+
+def _pick_tk(tk: int, k: int) -> int:
+    """Largest tile <= tk that divides k; e.g. k=11008 with tk=512
+    resolves to 256.  Callers must pass 128-aligned k (checked)."""
+    if k % 128:
+        raise ValueError(
+            f"gmm requires 128-aligned contraction dim, got k={k}"
+        )
+    tk = min(tk, k)
+    while k % tk:
+        tk //= 2
+    return tk
+
+
+def make_tile_metadata(group_sizes: jax.Array, m: int, tm: int):
+    """Logical-tile schedule for a grouped matmul.
+
+    Every m-tile is owned by the group of its first row; a group whose
+    rows begin mid-tile additionally revisits that boundary tile.  Returns
+    ``(offsets [E+1], tile_group [LT], tile_m [LT], num_tiles)`` with
+    ``LT = m//tm + E - 1`` (static worst case) and ``num_tiles`` the traced
+    count of tiles that actually run (the kernel grid is dynamic).
+    """
+    num_groups = group_sizes.shape[0]
+    assert m % tm == 0, "pad m to a tile multiple before calling"
+    tiles_m = m // tm
+    sizes = group_sizes.astype(jnp.int32)
+    ends = jnp.cumsum(sizes)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), ends]
+    ).astype(jnp.int32)
+    starts = offsets[:-1]
+    # tiles each group computes: its row span widened to tile boundaries
+    span = (ends + tm - 1) // tm - starts // tm
+    group_tiles = jnp.where(sizes > 0, span, 0).astype(jnp.int32)
+    lt = tiles_m + num_groups - 1
+    tile_group = jnp.repeat(
+        jnp.arange(num_groups, dtype=jnp.int32), group_tiles,
+        total_repeat_length=lt,
+    )
+    # visits per m-tile = 1 (its owner) + one per group starting mid-tile
+    starts_mid = (starts % tm != 0) & (sizes > 0)
+    mid_tile = jnp.where(starts_mid, starts // tm, tiles_m)
+    visits = (
+        jnp.zeros((tiles_m,), jnp.int32).at[mid_tile].add(1, mode="drop") + 1
+    )
+    tile_m = jnp.repeat(
+        jnp.arange(tiles_m, dtype=jnp.int32), visits, total_repeat_length=lt
+    )
+    return offsets, tile_group, tile_m, group_tiles.sum()
+
+
+def _store(acc, out_ref, offsets_s, g, row0, *, tm, scale=None):
+    rows = row0 + jax.lax.broadcasted_iota(
+        jnp.int32, (tm, out_ref.shape[-1]), 0
+    )
+    mask = (rows >= offsets_s[g]) & (rows < offsets_s[g + 1])
+    val = acc if scale is None else acc * scale
+    out_ref[...] = jnp.where(
+        mask, val.astype(out_ref.dtype), out_ref[...]
+    )
+
+
+def _gmm_kernel(
+    offsets_s, tile_group_s, tile_m_s,
+    lhs_ref, rhs_ref, *rest,
+    tm, tiles_k, quantized,
+):
+    # scale operands exist only on the int8 path (no dead per-tile DMAs
+    # streaming zero arrays on the bf16 path)
+    if quantized:
+        ls_ref, ws_ref, out_ref, acc_ref = rest
+    else:
+        out_ref, acc_ref = rest
+    k_i = pl.program_id(2)
+    t = pl.program_id(1)
+
+    @pl.when(k_i == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        lhs_ref[...], rhs_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_ref.dtype,
+    )
+
+    @pl.when(k_i == tiles_k - 1)
+    def _epilogue():
+        g = tile_group_s[t]
+        acc = acc_ref[...].astype(jnp.float32)
+        scale = (ls_ref[...] * ws_ref[...]) if quantized else None
+        _store(acc, out_ref, offsets_s, g, tile_m_s[t] * tm, tm=tm,
+               scale=scale)
+
+
+def _gather_gmm_kernel(
+    offsets_s, tile_group_s, tile_m_s, row_ids_s,
+    x_hbm, rhs_ref, *rest,
+    tm, tk, tiles_k, quantized,
+):
+    if quantized:
+        ls_ref, ws_ref, out_ref, acc_ref, xb_ref, sem = rest
+    else:
+        out_ref, acc_ref, xb_ref, sem = rest
+    k_i = pl.program_id(2)
+    t = pl.program_id(1)
+    row0 = tile_m_s[t] * tm
+
+    # gather this tile's rows straight from the unsorted token array —
+    # per-row k-slice DMAs (minor dim tk is 128-aligned), started together
+    # then waited together so they overlap each other
+    def _dma(j):
+        src = row_ids_s[row0 + j]
+        return pltpu.make_async_copy(
+            x_hbm.at[src, pl.ds(k_i * tk, tk)], xb_ref.at[j], sem.at[j]
+        )
+
+    def _start(j, _):
+        _dma(j).start()
+        return 0
+
+    def _wait(j, _):
+        _dma(j).wait()
+        return 0
+
+    jax.lax.fori_loop(0, tm, _start, 0)
+
+    @pl.when(k_i == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    jax.lax.fori_loop(0, tm, _wait, 0)
+
+    acc_ref[...] += jax.lax.dot_general(
+        xb_ref[...], rhs_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_ref.dtype,
+    )
+
+    @pl.when(k_i == tiles_k - 1)
+    def _epilogue():
+        g = tile_group_s[t]
+        acc = acc_ref[...].astype(jnp.float32)
+        scale = (ls_ref[...] * ws_ref[...]) if quantized else None
+        _store(acc, out_ref, offsets_s, g, row0, tm=tm, scale=scale)
+
+
+def _common(rhs, tn, tk):
+    num_groups, k, n = rhs.shape
+    if n % tn:
+        raise ValueError(f"gmm requires tn-aligned output dim, got n={n}")
+    return num_groups, k, n, k // tk, n // tn
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tm", "tn", "tk", "out_dtype")
+)
+def gmm(
+    lhs: jax.Array,  # [M, K] bf16 or int8 (expert-sorted rows)
+    rhs: jax.Array,  # [E, K, N] same class
+    group_sizes: jax.Array,  # [E] int32, sum <= M
+    lhs_scale: Optional[jax.Array] = None,  # [M] f32 (int8 per-row)
+    rhs_scale: Optional[jax.Array] = None,  # [E, N] f32 (int8 per-col)
+    *,
+    tm: int = 128,
+    tn: int = 128,
+    tk: int = 512,
+    out_dtype=None,
+):
+    """Grouped matmul over expert-sorted rows -> [M, N].
+
+    Rows beyond ``sum(group_sizes)`` (padding) are left unspecified —
+    callers slice to the true row count.
+    """
+    m, k = lhs.shape
+    quantized = lhs.dtype == jnp.int8
+    out_dtype = out_dtype or (jnp.float32 if quantized else lhs.dtype)
+    tk = _pick_tk(tk, k)
+    num_groups, _, n, tiles_k, tiles_n = _common(rhs, tn, tk)
+    m_pad = round_up(m, tm)
+    if m_pad != m:
+        lhs = jnp.pad(lhs, ((0, m_pad - m), (0, 0)))
+    offsets, tile_group, tile_m, num_tiles = make_tile_metadata(
+        group_sizes, m_pad, tm
+    )
+    in_specs = [
+        pl.BlockSpec((tm, tk), lambda n, t, ki, os, tg, tmi: (tmi[t], ki)),
+        pl.BlockSpec(
+            (None, tk, tn), lambda n, t, ki, os, tg, tmi: (tg[t], ki, n)
+        ),
+    ]
+    operands = [lhs, rhs]
+    if quantized:
+        assert lhs_scale is not None and rhs_scale is not None
+        in_specs += [
+            pl.BlockSpec((tm, 1), lambda n, t, ki, os, tg, tmi: (tmi[t], 0)),
+            pl.BlockSpec(
+                (None, 1, tn), lambda n, t, ki, os, tg, tmi: (tg[t], 0, n)
+            ),
+        ]
+        operands += [
+            jnp.pad(
+                lhs_scale.astype(jnp.float32).reshape(-1, 1),
+                ((0, m_pad - m), (0, 0)),
+            ),
+            rhs_scale.astype(jnp.float32).reshape(num_groups, 1, n),
+        ]
+
+    kernel = functools.partial(
+        _gmm_kernel, tm=tm, tiles_k=tiles_k, quantized=quantized
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(tiles_n, num_tiles, tiles_k),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (tm, tn), lambda n, t, ki, os, tg, tmi: (tmi[t], n)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((tm, tn), jnp.int32 if quantized else jnp.float32)
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        interpret=use_interpret(),
+    )(offsets, tile_group, tile_m, *operands)
+    return out[:m]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tm", "tn", "tk", "out_dtype")
+)
+def gather_gmm(
+    x: jax.Array,  # [T, K] UNSORTED token activations, bf16 or int8
+    row_ids: jax.Array,  # [M] int32: source row in x for sorted row i
+    rhs: jax.Array,  # [E, K, N]
+    group_sizes: jax.Array,  # [E] int32
+    x_scale: Optional[jax.Array] = None,  # [T] f32 per-row (int8)
+    rhs_scale: Optional[jax.Array] = None,  # [E, N] f32
+    *,
+    tm: int = 128,
+    tn: int = 128,
+    tk: int = 512,
+    out_dtype=None,
+):
+    """Fused gather + grouped matmul: ``gmm(x[row_ids], ...)`` without the
+    ``[M, K]`` sorted copy ever touching HBM."""
+    t_rows, k = x.shape
+    m = row_ids.shape[0]
+    quantized = x.dtype == jnp.int8
+    out_dtype = out_dtype or (jnp.float32 if quantized else x.dtype)
+    tk = _pick_tk(tk, k)
+    num_groups, _, n, tiles_k, tiles_n = _common(rhs, tn, tk)
+    m_pad = round_up(m, tm)
+    ids = jnp.pad(row_ids.astype(jnp.int32), (0, m_pad - m))
+    offsets, tile_group, tile_m, num_tiles = make_tile_metadata(
+        group_sizes, m_pad, tm
+    )
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),  # x stays in HBM
+        pl.BlockSpec(
+            (None, tk, tn),
+            lambda n, t, ki, os, tg, tmi, ri: (tg[t], ki, n),
+        ),
+    ]
+    operands = [x, rhs]
+    if quantized:
+        assert x_scale is not None and rhs_scale is not None
+        in_specs += [
+            pl.BlockSpec(
+                (tm, 1), lambda n, t, ki, os, tg, tmi, ri: (tmi[t], 0)
+            ),
+            pl.BlockSpec(
+                (None, 1, tn),
+                lambda n, t, ki, os, tg, tmi, ri: (tg[t], 0, n),
+            ),
+        ]
+        operands += [
+            # the per-row scale is gathered in XLA (an [M] vector, cheap)
+            jnp.pad(
+                x_scale.astype(jnp.float32)[row_ids].reshape(-1, 1),
+                ((0, m_pad - m), (0, 0)),
+            ),
+            rhs_scale.astype(jnp.float32).reshape(num_groups, 1, n),
+        ]
+
+    kernel = functools.partial(
+        _gather_gmm_kernel, tm=tm, tk=tk, tiles_k=tiles_k,
+        quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(tiles_n, num_tiles, tiles_k),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (tm, tn), lambda n, t, ki, os, tg, tmi, ri: (tmi[t], n)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((tm, tn), jnp.int32 if quantized else jnp.float32),
+                pltpu.VMEM((tm, tk), x.dtype),
+                pltpu.SemaphoreType.DMA((tm,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        interpret=use_interpret(),
+    )(offsets, tile_group, tile_m, ids, *operands)
+    return out[:m]

@@ -203,3 +203,52 @@ def test_moe_layer_int8_variant():
     ref = np.asarray(MoE(cfg_bf, rw, w1, w2)(x), np.float32)
     out = np.asarray(MoE(cfg_i8, rw, w1, w2)(x), np.float32)
     np.testing.assert_allclose(out, ref, rtol=6e-2, atol=6e-2)
+
+
+def test_fused_moe_gmm_backend_matches_ragged():
+    """Pallas gather-GMM pipeline vs the ragged_dot oracle (bf16)."""
+    from flashinfer_tpu import fused_moe as moe
+
+    rng = np.random.default_rng(5)
+    T, E, K, h, inter = 48, 6, 2, 128, 128
+    x = jnp.asarray(rng.standard_normal((T, h)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((E, h, 2 * inter)) / np.sqrt(h),
+                     jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((E, inter, h)) / np.sqrt(inter),
+                     jnp.bfloat16)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    wts, ids = moe.route_renormalize(logits, K)
+    ref = moe.fused_moe(x, w1, w2, wts, ids, E, backend="ragged")
+    out = moe.fused_moe(x, w1, w2, wts, ids, E, backend="gmm")
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_fused_moe_gmm_backend_int8():
+    """int8 gmm path (per-token quant before routing) vs int8 ragged path."""
+    from flashinfer_tpu import fused_moe as moe
+    from flashinfer_tpu.quantization import quantize_int8
+
+    rng = np.random.default_rng(9)
+    T, E, K, h, inter = 32, 4, 2, 128, 128
+    x = jnp.asarray(rng.standard_normal((T, h)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((E, h, 2 * inter)) / np.sqrt(h),
+                     jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((E, inter, h)) / np.sqrt(inter),
+                     jnp.bfloat16)
+    w1q, w1s = quantize_int8(w1, axis=1)
+    w2q, w2s = quantize_int8(w2, axis=1)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    wts, ids = moe.route_renormalize(logits, K)
+    ref = moe.fused_moe(x, w1q, w2q, wts, ids, E, w1_scale=w1s,
+                        w2_scale=w2s, backend="ragged")
+    out = moe.fused_moe(x, w1q, w2q, wts, ids, E, w1_scale=w1s,
+                        w2_scale=w2s, backend="gmm")
+    # both are int8 pipelines but quantize activations at different points
+    # (per-token vs per-sorted-row); tolerances cover the requant delta
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=8e-2, atol=8e-2,
+    )
