@@ -56,6 +56,7 @@ PHASE_TIMEOUT_S = {
     "decode_sweep": 3600.0,
     "moe": 1500.0,
     "moe_sweep": 2400.0,
+    "topk": 1200.0,
 }
 
 
@@ -253,6 +254,35 @@ def phase_moe(sweep: bool):
                   f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
 
 
+def phase_topk(sweep: bool):
+    """Exact top-k at 128k vocab: threshold-bisection kernel vs XLA sort
+    (VERDICT r2 #7) — the sparse-MLA selection feeder."""
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu import topk as topk_mod
+    from flashinfer_tpu.testing import bench_fn_device
+
+    if os.environ.get("BENCH_SMALL"):
+        bs, vocab, ks = 8, 2048, (16,)
+    else:
+        bs, vocab, ks = 64, 128 * 1024, (40, 2048)
+    key = jax.random.PRNGKey(0)
+    scores = jax.random.normal(key, (bs, vocab), jnp.float32) * 4.0
+
+    for k in ks:
+        for backend in ("xla", "threshold"):
+            fn = lambda s: topk_mod.top_k_values_indices(s, k, backend)[1]
+            t = _guard(
+                f"bench.topk.{backend}", (bs, vocab, k),
+                lambda: bench_fn_device(fn, scores, repeats=5),
+            )
+            _emit_row(phase="topk", backend=backend, bs=bs, vocab=vocab,
+                      k=k, us=round(t * 1e6, 1))
+            print(f"# topk {backend:10s} k={k:5d}: {t*1e6:9.1f} us",
+                  file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -266,11 +296,12 @@ PHASES = {
     "decode": phase_decode,
     "sampling": phase_sampling,
     "moe": phase_moe,
+    "topk": phase_topk,
     "selftest": phase_selftest,
 }
 # selftest is CI-only (reachable via --only); production runs must not
 # spawn the stub or bank its rows
-DEFAULT_PHASES = ["decode", "sampling", "moe"]
+DEFAULT_PHASES = ["decode", "sampling", "moe", "topk"]
 
 
 # --------------------------------------------------------------------------

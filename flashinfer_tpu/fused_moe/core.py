@@ -204,7 +204,8 @@ def fused_moe_ep(
     activation: str = "silu",
     dispatch: str = "allgather",
     capacity_factor: float = 2.0,
-) -> jax.Array:
+    return_dropped: bool = False,
+):
     """Expert-parallel fused MoE (call inside shard_map).
 
     Experts are contiguously sharded over ``axis`` (rank r owns
@@ -217,7 +218,17 @@ def fused_moe_ep(
       split-mode NCCL/NIXL dispatch+combine as ``lax.all_to_all``) —
       bandwidth O(T_local * K * hidden), the scalable mode.  Tokens beyond
       ``capacity_factor * T_local * K / ep`` per destination are dropped
-      (standard capacity semantics).
+      (standard capacity semantics): a dropped (token, choice) route
+      contributes ZERO to that token's output, so under-capacity routing
+      silently degrades quality rather than erroring.
+
+    With ``return_dropped=True`` returns ``(out, dropped)`` where
+    ``dropped`` is a shape-``[1]`` int32 count of this rank's (token,
+    choice) routes that exceeded a destination bucket — the observability
+    hook for the capacity-drop semantics (reference analogue: per-split
+    token accounting, moe_ep/modes/split_layer.py:52).  Shaped ``[1]`` so
+    a shard_map ``out_specs=P(axis)`` concatenates it into per-rank
+    counts.  Always 0 for ``"allgather"`` (that mode never drops).
     """
     if dispatch == "allgather":
         ep = jax.lax.axis_size(axis)
@@ -238,12 +249,14 @@ def fused_moe_ep(
             xg, w_gate_up, w_down, w_local, ids_local, e_local, activation
         )
         # combine: sum partials, then take this rank's token slice
-        return jax.lax.psum_scatter(partial, axis, tiled=True)
+        out = jax.lax.psum_scatter(partial, axis, tiled=True)
+        return (out, jnp.zeros((1,), jnp.int32)) if return_dropped else out
     if dispatch == "alltoall":
-        return _fused_moe_ep_alltoall(
+        out, dropped = _fused_moe_ep_alltoall(
             hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
             axis, activation, capacity_factor,
         )
+        return (out, dropped) if return_dropped else out
     raise ValueError(f"unknown dispatch {dispatch!r}")
 
 
@@ -302,4 +315,5 @@ def _fused_moe_ep_alltoall(
         contrib.reshape(T, K, H)
         * topk_weights.astype(jnp.float32)[..., None]
     ).sum(1)
-    return combined.astype(hidden.dtype)
+    dropped = jnp.sum((within >= cap).astype(jnp.int32)).reshape(1)
+    return combined.astype(hidden.dtype), dropped

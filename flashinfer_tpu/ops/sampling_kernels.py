@@ -55,6 +55,29 @@ def _bisect(p, valid, target_fn, lo, hi):
     return lo
 
 
+def _bisect_prologue(p, vocab):
+    """Shared range setup for the bisection kernels: the valid mask
+    (in-vocab, not pre-masked to the -inf class), the (lo0, hi0) search
+    range, and the all-masked-row collapse (see _FINITE_FLOOR note)."""
+    valid = (
+        jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) < vocab
+    ) & (p > _FINITE_FLOOR)  # pre-masked (-inf class) tokens never selected
+    lo0 = jnp.min(jnp.where(valid, p, jnp.inf), axis=1, keepdims=True) - 1e-6
+    hi0 = jnp.max(jnp.where(valid, p, -jnp.inf), axis=1, keepdims=True)
+    # all-masked row: collapse to an empty kept set instead of nan/inf math
+    any_valid = jnp.isfinite(hi0)
+    lo0 = jnp.where(any_valid, lo0, 0.0)
+    hi0 = jnp.where(any_valid, hi0, 1.0)
+    return valid, lo0, hi0, any_valid
+
+
+def _count_ge_target(a):
+    def count_ge(ge):
+        return jnp.sum(ge.astype(jnp.float32), axis=1, keepdims=True) >= a
+
+    return count_ge
+
+
 def _threshold_kernel(
     p_ref,  # [rb, Vpad] f32
     a_ref,  # [rb, 1] f32 (k as float, or top_p)
@@ -65,20 +88,10 @@ def _threshold_kernel(
     mode: str,
 ):
     p = p_ref[...]
-    valid = (
-        jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) < vocab
-    ) & (p > _FINITE_FLOOR)  # pre-masked (-inf class) tokens never selected
+    valid, lo0, hi0, _ = _bisect_prologue(p, vocab)
     pv = jnp.where(valid, p, 0.0)
-    lo0 = jnp.min(jnp.where(valid, p, jnp.inf), axis=1, keepdims=True) - 1e-6
-    hi0 = jnp.max(jnp.where(valid, p, -jnp.inf), axis=1, keepdims=True)
-    # all-masked row: collapse to an empty kept set instead of nan/inf math
-    any_valid = jnp.isfinite(hi0)
-    lo0 = jnp.where(any_valid, lo0, 0.0)
-    hi0 = jnp.where(any_valid, hi0, 1.0)
     a = a_ref[...]
-
-    def count_ge(ge):
-        return jnp.sum(ge.astype(jnp.float32), axis=1, keepdims=True) >= a
+    count_ge = _count_ge_target(a)
 
     def mass_ge_target(target):
         def f(ge):
@@ -116,6 +129,127 @@ def _threshold_kernel(
         o_ref[...] = kept / jnp.maximum(s, 1e-30)
 
 
+def _f32_sort_key(p):
+    """Order-isomorphic int32 key of an f32 array (the radix-sort float
+    transform): key comparisons == value comparisons, including -0.0/+0.0
+    adjacency and +/-inf extremes."""
+    i = jax.lax.bitcast_convert_type(p, jnp.int32)
+    return i ^ ((i >> 31) & jnp.int32(0x7FFFFFFF))
+
+
+def _key_to_f32(key):
+    i = jnp.where(key >= 0, key, key ^ jnp.int32(0x7FFFFFFF))
+    return jax.lax.bitcast_convert_type(i, jnp.float32)
+
+
+def _threshold_only_kernel(
+    p_ref,  # [rb, Vpad] f32
+    a_ref,  # [rb, 1] f32 (k as float)
+    o_ref,  # [rb, 128] f32 (threshold, lane-broadcast)
+    *,
+    vocab: int,
+):
+    """EXACT k-th-largest threshold via bit-space bisection.
+
+    Value-space bisection (``_bisect``) cannot converge over wide dynamic
+    ranges — one ``-1e15`` "effectively -inf" entry leaves the interval
+    ~1e15 * 2^-32 wide after 32 halvings, misranking thousands of entries.
+    Bisecting on the order-isomorphic int32 KEY instead (the same trick as
+    the reference's radix top-k, ``include/flashinfer/topk.cuh``) halves
+    an integer interval < 2^32 wide, so 32 iterations pin the threshold to
+    the exact k-th value regardless of magnitudes."""
+    p = p_ref[...]
+    valid, _, _, any_valid = _bisect_prologue(p, vocab)
+    keys = _f32_sort_key(p)
+    imax = jnp.int32(0x7FFFFFFF)
+    lo = jnp.min(jnp.where(valid, keys, imax), axis=1, keepdims=True)
+    hi = jnp.max(jnp.where(valid, keys, -imax - 1), axis=1, keepdims=True)
+    a = a_ref[...]
+
+    def body(_, carry):
+        lo, hi = carry
+        # overflow-safe midpoint of two int32s (lo+hi can exceed int32)
+        mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+        mid = jnp.maximum(mid, lo + 1)  # progress when hi == lo + 1
+        ge = valid & (keys >= mid)
+        ok = jnp.sum(ge.astype(jnp.float32), axis=1, keepdims=True) >= a
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    # 33, not 32: the key interval can span up to ~2^32 (negative to
+    # positive keys), and ceil-halving a >2^31 interval 32 times can end
+    # with hi - lo == 1 and hi untested (reviewer-simulated: 53/4000 rows
+    # one ULP low at 32 iters, 0/4000 at 33)
+    lo, hi = jax.lax.fori_loop(0, 33, body, (lo, hi))
+    # lo is the exact key of the k-th largest (or the row min when the row
+    # has fewer than k valid entries — keeps everything, short-row rule)
+    t = _key_to_f32(lo)[:, :1]
+    t = jnp.where(any_valid, t, jnp.inf)  # all-masked row keeps nothing
+    o_ref[...] = jnp.broadcast_to(t, o_ref.shape)
+
+
+def _launch_bisect(kernel, x, scalars, out_cols, block_rows):
+    """Shared pad-and-launch scaffold for the row-wise bisection kernels:
+    f32 cast, 128-lane vocab pad, row pad to the block, per-row scalar
+    operands padded with a harmless 1.0, one grid dim over row blocks.
+    ``out_cols=None`` means a full-width [rpad, vpad] output."""
+    x = x.astype(jnp.float32)
+    batch, vocab = x.shape
+    vpad = round_up(vocab, 128)
+    rpad = round_up(batch, block_rows)
+    if vpad != vocab or rpad != batch:
+        x = jnp.pad(x, ((0, rpad - batch), (0, vpad - vocab)))
+    ops = [x] + [
+        jnp.pad(
+            jnp.asarray(s, jnp.float32).reshape(-1, 1),
+            ((0, rpad - batch), (0, 0)), constant_values=1.0,
+        )
+        for s in scalars
+    ]
+    oc = vpad if out_cols is None else out_cols
+    out = pl.pallas_call(
+        kernel,
+        grid=(rpad // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, vpad), lambda i: (i, 0))]
+        + [
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0))
+            for _ in scalars
+        ],
+        out_specs=pl.BlockSpec((block_rows, oc), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rpad, oc), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=use_interpret(),
+    )(*ops)
+    return out, batch, vocab
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows",))
+def top_k_thresholds(
+    scores: jax.Array,  # [batch, vocab] f32 (logits or probs)
+    k: jax.Array,  # [batch] int/float per-row k
+    *,
+    block_rows: int = 8,
+) -> jax.Array:
+    """Per-row EXACT k-th-largest value via bit-space bisection -> [batch].
+
+    The index-free half of the sorting-free top-k (reference
+    ``include/flashinfer/topk.cuh`` radix threshold pass, re-designed for
+    VMEM residency): one HBM read of the row and a [rows, 1] write —
+    2x less traffic than :func:`threshold_select`, which writes the
+    filtered row back.  The returned threshold is the exact k-th-largest
+    value (bit-space bisection, see kernel docstring), so
+    ``scores >= t`` keeps >= k entries where the excess is exactly the
+    equality tie class at t; callers trim ties to exactly k
+    (``flashinfer_tpu.topk``).  Rows with fewer than k selectable entries
+    get their row minimum (keep-all); all-masked rows get +inf."""
+    out, batch, _ = _launch_bisect(
+        functools.partial(_threshold_only_kernel, vocab=scores.shape[1]),
+        scores, [k], 128, block_rows,
+    )
+    return out[:batch, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("mode", "block_rows"))
 def threshold_select(
     probs_or_logits: jax.Array,  # [batch, vocab] f32
@@ -138,33 +272,10 @@ def threshold_select(
     it, ``sampling.cuh:293``); callers needing strict-k must post-trim.
     ``tests/test_sampling.py::test_threshold_near_uniform_ties`` bounds the
     deviation."""
-    x = probs_or_logits.astype(jnp.float32)
-    batch, vocab = x.shape
-    vpad = round_up(vocab, 128)
-    rpad = round_up(batch, block_rows)
-    if vpad != vocab or rpad != batch:
-        x = jnp.pad(x, ((0, rpad - batch), (0, vpad - vocab)))
-    a2 = jnp.pad(
-        jnp.asarray(a, jnp.float32).reshape(-1, 1), ((0, rpad - batch), (0, 0)),
-        constant_values=1.0,
-    )
-    b2 = jnp.pad(
-        jnp.asarray(b, jnp.float32).reshape(-1, 1), ((0, rpad - batch), (0, 0)),
-        constant_values=1.0,
-    )
-    out = pl.pallas_call(
-        functools.partial(_threshold_kernel, vocab=vocab, mode=mode),
-        grid=(rpad // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, vpad), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, vpad), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rpad, vpad), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024,
+    out, batch, vocab = _launch_bisect(
+        functools.partial(
+            _threshold_kernel, vocab=probs_or_logits.shape[1], mode=mode
         ),
-        interpret=use_interpret(),
-    )(x, a2, b2)
+        probs_or_logits, [a, b], None, block_rows,
+    )
     return out[:batch, :vocab]

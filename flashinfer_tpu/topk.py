@@ -1,45 +1,135 @@
 """Top-k selection ops.
 
 TPU re-design of ``flashinfer/topk.py`` (radix/clusters-exact top-k +
-fused page-table transforms used by sparse-MLA index selection).  XLA's
-``jax.lax.top_k`` is the hardware-native exact top-k on TPU; the value-add
-here is the fused transform forms that feed sparse attention.
+fused page-table transforms used by sparse-MLA index selection).  Two
+backends:
+
+- ``"xla"``: ``jax.lax.top_k`` — exact, returns entries sorted by value.
+- ``"threshold"``: the sorting-free design (reference
+  ``include/flashinfer/topk.cuh`` / ``fast_topk_clusters_exact.cuh``
+  re-imagined for VMEM): a Pallas bit-space bisection kernel finds the
+  EXACT k-th-largest value in one HBM pass
+  (``ops/sampling_kernels.top_k_thresholds``), then XLA cumsum+scatter
+  extracts exactly k indices (not value-sorted: strictly-above-threshold
+  entries in index order, then threshold ties in index order).  The kept
+  SET matches the sort oracle except among entries exactly equal to the
+  k-th value — that tie class is cut by lowest index where a sort cuts
+  arbitrarily.
+- ``"auto"``: env ``FLASHINFER_TPU_TOPK_BACKEND`` if set, else ``"xla"``
+  until the banked bench says otherwise.
+
+Consumers that treat the result as a SET (sparse-MLA page selection,
+masks) can use either backend; order-sensitive consumers need ``"xla"``.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
+def _resolve_backend(backend: str) -> str:
+    if backend == "auto":
+        backend = os.environ.get("FLASHINFER_TPU_TOPK_BACKEND", "xla")
+    if backend not in ("xla", "threshold"):
+        raise ValueError(f"unknown topk backend {backend!r}")
+    return backend
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
-def top_k_values_indices(scores: jax.Array, k: int):
-    """Exact top-k -> (values, indices) (reference ``topk.topk``)."""
+def _threshold_topk(scores: jax.Array, k: int):
+    """Sorting-free exact-count top-k -> (values, indices).
+
+    Two-tier trim: entries STRICTLY above the bisection threshold are all
+    kept (they are genuinely top-k up to float resolution of the
+    threshold); the remaining slots fill with threshold-tie entries in
+    index order.  Trimming the whole kept set by index instead would let
+    a large tie class below the cut (e.g. many zeros in masked/ReLU
+    scores) evict strictly-larger values.  Output order: strict entries
+    in index order, then ties in index order.  Indices beyond a row's
+    valid count (all--inf rows) are -1."""
+    from flashinfer_tpu.ops.sampling_kernels import top_k_thresholds
+
+    batch, vocab = scores.shape
+    t = top_k_thresholds(scores, jnp.full((batch,), k, jnp.float32))
+    keep = scores >= t[:, None]  # >= k entries (epsilon ties kept)
+    strict = scores > t[:, None]  # < k entries (up to float resolution)
+    tie = keep & ~strict
+    n_strict = jnp.sum(strict.astype(jnp.int32), axis=1, keepdims=True)
+    pos_strict = jnp.cumsum(strict.astype(jnp.int32), axis=1) - 1
+    pos_tie = n_strict + jnp.cumsum(tie.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(strict, pos_strict, pos_tie)
+    sel = keep & (pos < k)
+    # scatter column ids into their kept-rank slot; k-th slot absorbs drops
+    rows = jnp.broadcast_to(jnp.arange(batch)[:, None], (batch, vocab))
+    slot = jnp.where(sel, pos, k)
+    idx = jnp.full((batch, k + 1), -1, jnp.int32).at[rows, slot].set(
+        jnp.broadcast_to(jnp.arange(vocab, dtype=jnp.int32), (batch, vocab)),
+        mode="drop",
+    )[:, :k]
+    vals = jnp.take_along_axis(
+        scores, jnp.maximum(idx, 0), axis=1
+    )
+    vals = jnp.where(idx >= 0, vals, -jnp.inf)
+    return vals, idx
+
+
+def top_k_values_indices(scores: jax.Array, k: int, backend: str = "auto"):
+    """Exact top-k -> (values, indices) (reference ``topk.topk``).
+
+    ``"xla"`` returns value-sorted entries; ``"threshold"`` returns the
+    same set in index order (see module docstring).  Backend resolution
+    happens outside the jitted bodies so the env var is re-read on every
+    eager call (an in-trace read would be pinned by the jit cache)."""
+    if _resolve_backend(backend) == "threshold":
+        return _threshold_topk(scores, k)
+    return _xla_topk(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _xla_topk(scores: jax.Array, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def top_k_indices(scores: jax.Array, k: int) -> jax.Array:
-    return jax.lax.top_k(scores, k)[1].astype(jnp.int32)
+def top_k_indices(
+    scores: jax.Array, k: int, backend: str = "auto"
+) -> jax.Array:
+    return top_k_values_indices(scores, k, backend)[1].astype(jnp.int32)
+
+
+def top_k_mask(scores: jax.Array, k: int, backend: str = "auto") -> jax.Array:
+    """Boolean mask of the top-k entries per row (epsilon-tie note: the
+    threshold backend may mark a few extra tie-band entries)."""
+    if _resolve_backend(backend) == "threshold":
+        return _threshold_mask(scores, k)
+    return _xla_mask(scores, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def top_k_mask(scores: jax.Array, k: int) -> jax.Array:
-    """Boolean mask of the top-k entries per row."""
+def _threshold_mask(scores: jax.Array, k: int) -> jax.Array:
+    from flashinfer_tpu.ops.sampling_kernels import top_k_thresholds
+
+    t = top_k_thresholds(scores, jnp.full((scores.shape[0],), k, jnp.float32))
+    return scores >= t[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _xla_mask(scores: jax.Array, k: int) -> jax.Array:
     kth = jax.lax.top_k(scores, k)[0][..., -1:]
     return scores >= kth
 
 
-@functools.partial(jax.jit, static_argnames=("k", "page_size"))
 def top_k_page_table_transform(
     scores: jax.Array,  # [batch, max_kv] per-token selection scores
     page_table: jax.Array,  # [batch, max_pages]
     kv_lens: jax.Array,  # [batch]
     k: int,
     page_size: int,
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Select top-k kv tokens per request and emit their flat cache rows —
     the fused top-k + page-table transform used by sparse-MLA index
@@ -49,13 +139,23 @@ def top_k_page_table_transform(
     ``kv_len`` hold ``-1`` (the padding convention the sparse-MLA consumer
     ``BatchMLAPagedAttentionWrapper.run_sparse`` masks on), so ``rows`` can
     be fed forward directly."""
+    return _page_transform_impl(
+        scores, page_table, kv_lens, k, page_size, _resolve_backend(backend)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k", "page_size", "backend"))
+def _page_transform_impl(scores, page_table, kv_lens, k, page_size, backend):
     masked = jnp.where(
         jnp.arange(scores.shape[1])[None, :] < kv_lens[:, None],
         scores.astype(jnp.float32),
         -jnp.inf,
     )
-    vals, tok = jax.lax.top_k(masked, k)  # token positions within request
+    # the consumer (run_sparse) treats rows as a SET, so the threshold
+    # backend's index-ordered result is equivalent
+    vals, tok = top_k_values_indices(masked, k, backend)
+    valid = jnp.isfinite(vals) & (tok >= 0)
+    tok = jnp.maximum(tok, 0)
     page = jnp.take_along_axis(page_table, tok // page_size, axis=1)
     rows = page * page_size + tok % page_size
-    valid = jnp.isfinite(vals)
     return jnp.where(valid, rows, -1).astype(jnp.int32), valid

@@ -252,3 +252,69 @@ def test_fused_moe_gmm_backend_int8():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=8e-2, atol=8e-2,
     )
+
+
+@pytest.mark.devices_8
+def test_fused_moe_ep_alltoall_capacity_drops():
+    """Forced overflow (capacity_factor=0.5): dropped routes contribute
+    zero, the dropped count surfaces, and kept routes stay exact."""
+    ep = 4
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("tp",))
+    T, E, K, h, inter = 16, 8, 2, 32, 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h), jnp.float32)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (E, h, 2 * inter)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h)) * 0.1
+    # adversarial routing: every token's top choice is expert 0 -> rank 0's
+    # bucket overflows on every source rank at capacity_factor=0.5
+    ids = jnp.stack(
+        [jnp.zeros((T,), jnp.int32),
+         jnp.arange(T, dtype=jnp.int32) % E],
+        axis=1,
+    )
+    weights = jnp.full((T, K), 0.5, jnp.float32)
+    cf = 0.5
+
+    def fn(x, w1, w2, wts, ids):
+        return moe.fused_moe_ep(
+            x, w1, w2, wts, ids, E, axis="tp", dispatch="alltoall",
+            capacity_factor=cf, return_dropped=True,
+        )
+
+    out, dropped = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp")),
+            out_specs=(P("tp"), P("tp")),
+            check_vma=False,
+        )
+    )(x, w1, w2, weights, ids)
+
+    # host oracle replicating the kernel's drop rule per source rank:
+    # stable argsort by destination rank, bucket index >= cap drops
+    t_local = T // ep
+    e_local = E // ep
+    cap = max(1, int(np.ceil(t_local * K / ep * cf)))
+    kept_mask = np.zeros((T, K), bool)
+    ids_np = np.asarray(ids)
+    for r in range(ep):
+        flat = ids_np[r * t_local:(r + 1) * t_local].reshape(-1)
+        dst = flat // e_local
+        order = np.argsort(dst, kind="stable")
+        within = np.arange(len(order)) - np.searchsorted(
+            dst[order], dst[order], side="left"
+        )
+        kept_sorted = within < cap
+        kept_flat = np.zeros(len(order), bool)
+        kept_flat[order] = kept_sorted
+        kept_mask[r * t_local:(r + 1) * t_local] = kept_flat.reshape(
+            t_local, K
+        )
+    total_dropped = int((~kept_mask).sum())
+    assert total_dropped > 0, "test must actually force overflow"
+    assert int(np.asarray(dropped).sum()) == total_dropped
+
+    ref = _moe_ref(
+        np.asarray(x), np.asarray(w1), np.asarray(w2),
+        np.asarray(weights) * kept_mask, ids_np,
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)

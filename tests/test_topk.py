@@ -1,0 +1,130 @@
+"""Top-k backends: sorting-free threshold kernel vs the XLA sort oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashinfer_tpu import topk
+
+
+def _sets(idx):
+    return [set(int(i) for i in row if i >= 0) for row in np.asarray(idx)]
+
+
+def test_threshold_topk_matches_xla_set():
+    """Well-separated values: identical kept set, exactly k indices."""
+    rng = np.random.default_rng(0)
+    scores = jnp.asarray(rng.standard_normal((16, 4096)) * 4, jnp.float32)
+    k = 40
+    _, ix = topk.top_k_values_indices(scores, k, backend="xla")
+    vt, it = topk.top_k_values_indices(scores, k, backend="threshold")
+    assert it.shape == (16, k)
+    for sx, st in zip(_sets(ix), _sets(it)):
+        assert sx == st
+    # values line up with their indices
+    np.testing.assert_allclose(
+        np.asarray(vt),
+        np.take_along_axis(np.asarray(scores), np.asarray(it), axis=1),
+    )
+
+
+def test_threshold_topk_tie_class_below_cut():
+    """A large tie class at/below the threshold must NOT evict strictly
+    larger values (regression: index-order trim dropped the true top
+    entries when masked/ReLU-style zeros inflated the kept set)."""
+    V, k = 256, 40
+    scores = np.zeros((2, V), np.float32)
+    big_idx = np.arange(V - 10, V)  # 10 large values at the highest indices
+    scores[:, big_idx] = np.arange(10, dtype=np.float32) + 5.0
+    _, it = topk.top_k_values_indices(
+        jnp.asarray(scores), k, backend="threshold"
+    )
+    for row in _sets(it):
+        assert set(int(i) for i in big_idx) <= row  # all big values kept
+        assert len(row) == k  # filled up with zero-ties
+
+
+def test_threshold_topk_short_row():
+    """Rows with fewer than k selectable entries pad indices with -1."""
+    scores = jnp.full((2, 256), -jnp.inf).at[:, :5].set(
+        jnp.arange(5, dtype=jnp.float32)
+    )
+    vals, idx = topk.top_k_values_indices(scores, 8, backend="threshold")
+    idx = np.asarray(idx)
+    assert [sorted(r) for r in idx[:, :5]] == [list(range(5))] * 2
+    assert (idx[:, 5:] == -1).all()
+    assert not np.isfinite(np.asarray(vals)[:, 5:]).any()
+
+
+def test_top_k_mask_threshold_backend():
+    rng = np.random.default_rng(2)
+    scores = jnp.asarray(rng.standard_normal((8, 1024)) * 3, jnp.float32)
+    mx = topk.top_k_mask(scores, 32, backend="xla")
+    mt = topk.top_k_mask(scores, 32, backend="threshold")
+    np.testing.assert_array_equal(np.asarray(mx), np.asarray(mt))
+
+
+def test_page_table_transform_threshold_matches_xla():
+    """Sparse-MLA selection path: same row SET from both backends."""
+    rng = np.random.default_rng(3)
+    B, max_kv, PS, k = 4, 512, 16, 64
+    scores = jnp.asarray(rng.standard_normal((B, max_kv)) * 4, jnp.float32)
+    table = jnp.asarray(
+        rng.permutation(B * (max_kv // PS)).reshape(B, -1), jnp.int32
+    )
+    kv_lens = jnp.asarray([512, 300, 64, 17], jnp.int32)
+    rx, vx = topk.top_k_page_table_transform(
+        scores, table, kv_lens, k, PS, backend="xla"
+    )
+    rt, vt = topk.top_k_page_table_transform(
+        scores, table, kv_lens, k, PS, backend="threshold"
+    )
+    assert int(vx.sum()) == int(vt.sum())
+    for sx, st in zip(_sets(rx), _sets(rt)):
+        assert sx == st
+
+
+def test_topk_backend_env_auto(monkeypatch):
+    rng = np.random.default_rng(4)
+    scores = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+    monkeypatch.setenv("FLASHINFER_TPU_TOPK_BACKEND", "threshold")
+    _, it = topk.top_k_values_indices(scores, 8, backend="auto")
+    _, ix = topk.top_k_values_indices(scores, 8, backend="xla")
+    for sa, sx in zip(_sets(it), _sets(ix)):
+        assert sa == sx  # env flipped auto to the threshold backend
+    monkeypatch.setenv("FLASHINFER_TPU_TOPK_BACKEND", "bogus")
+    with pytest.raises(ValueError):
+        topk.top_k_values_indices(scores, 8, backend="auto")
+
+
+def test_threshold_topk_large_vocab_near_uniform():
+    """128k near-uniform logits: kept set deviates from the sort oracle
+    only within the bisection's float resolution of the k-th value."""
+    rng = np.random.default_rng(5)
+    V, k = 128 * 1024, 256
+    scores = jnp.asarray(rng.uniform(0, 1, (2, V)), jnp.float32)
+    vx, _ = topk.top_k_values_indices(scores, k, backend="xla")
+    vt, it = topk.top_k_values_indices(scores, k, backend="threshold")
+    assert it.shape == (2, k)
+    kth = np.asarray(vx)[:, -1:]
+    # every selected value is >= (k-th value - epsilon band)
+    eps = 1.0 * 2.0 ** -22  # range * bisection resolution, with slack
+    assert (np.asarray(vt) >= kth - eps).all()
+
+
+def test_threshold_topk_wide_dynamic_range():
+    """A -1e15 'effectively -inf' entry (above _FINITE_FLOOR) must not
+    break convergence: bit-space bisection pins the exact k-th value."""
+    rng = np.random.default_rng(7)
+    scores = np.asarray(rng.standard_normal((4, 4096)), np.float32)
+    scores[:, 0] = -1e15
+    k = 8
+    _, ix = topk.top_k_values_indices(jnp.asarray(scores), k, backend="xla")
+    _, it = topk.top_k_values_indices(
+        jnp.asarray(scores), k, backend="threshold"
+    )
+    for sx, st in zip(_sets(ix), _sets(it)):
+        assert sx == st
+    mt = topk.top_k_mask(jnp.asarray(scores), k, backend="threshold")
+    assert (np.asarray(mt).sum(1) == k).all()
