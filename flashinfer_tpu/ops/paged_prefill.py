@@ -45,6 +45,12 @@ from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up, use_interpre
 _NEG_INF = -1e30
 
 
+def mask_lane_bytes(chunk_tokens: int) -> int:
+    """Lane width of the per-unit packed-mask bitmap (>= 128 for Mosaic
+    VMEM blocks)."""
+    return max(round_up(cdiv(chunk_tokens, 8), 128), 128)
+
+
 def build_prefill_work_units(
     qo_indptr: np.ndarray,  # [B+1] token offsets
     kv_page_indptr: np.ndarray,  # [B+1] page offsets
@@ -53,15 +59,37 @@ def build_prefill_work_units(
     block_q: int,
     pages_per_chunk: int,
     page_size: int,
+    mask_flat: Optional[np.ndarray] = None,  # concat per-request [qo*kv] bools
 ):
     """Host-side plan: flatten (request, qo-tile, kv-chunk) units.
 
     Returns a dict of numpy arrays padded to a power-of-two unit count
     (padding units have qlen 0 and last=0 so they neither write nor
     corrupt), plus the static (block_q, pages_per_chunk) the arrays were
-    built for."""
+    built for.
+
+    With ``mask_flat`` (MaskMode::CUSTOM, the reference's flat
+    per-request mask concat, prefill.py:1492), each unit additionally
+    gets its window of the mask re-packed as a little-endian byte bitmap
+    ``mask_bytes [num_units, block_q, mask_lane_bytes(chunk)]``, shaped
+    for a direct per-unit VMEM fetch; the kernel expands bits in-register
+    (selector dot + shifts), so no dense [qo, kv] array ever exists on
+    device (reference analogue: packed_custom_mask consumed inside the
+    kernel, prefill.cuh:2682).  Byte budget per unit is
+    ``block_q * max(128, chunk/8)`` — the 128-lane Mosaic floor means the
+    bit-packing only wins HBM bytes over a dense bool tile at
+    chunk_tokens > 1024; at the default chunk of 128-256 the win is the
+    in-kernel consumption (no [tq_pad, tkv_pad] dense mask built or
+    shipped), not the packing."""
     chunk_tokens = pages_per_chunk * page_size
     units = []  # (qstart, qlen, qpos0, kvstart, kvlen_req, first, last, pages)
+    unit_masks = []  # [block_q, chunk] bool per unit (when mask_flat)
+    mask_offsets = np.concatenate(
+        [[0], np.cumsum(
+            (qo_indptr[1:] - qo_indptr[:-1]).astype(np.int64)
+            * np.asarray(kv_lens, np.int64)
+        )]
+    ) if mask_flat is not None else None
     B = len(qo_indptr) - 1
     for r in range(B):
         qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
@@ -69,6 +97,12 @@ def build_prefill_work_units(
         pages = kv_page_indices[
             int(kv_page_indptr[r]) : int(kv_page_indptr[r + 1])
         ]
+        if mask_flat is not None and qe > qs and kv_len > 0:
+            req_mask = np.asarray(
+                mask_flat[mask_offsets[r] : mask_offsets[r + 1]], bool
+            ).reshape(qe - qs, kv_len)
+        else:
+            req_mask = None
         n_tiles = max(cdiv(qe - qs, block_q), 1) if qe > qs else 0
         n_chunks = max(cdiv(kv_len, chunk_tokens), 1) if kv_len > 0 else 1
         for t in range(n_tiles):
@@ -82,6 +116,21 @@ def build_prefill_work_units(
                     qstart, qlen, qpos0, c * chunk_tokens, kv_len,
                     1 if c == 0 else 0, 1 if c == n_chunks - 1 else 0, pg,
                 ))
+                if mask_flat is not None:
+                    tile = np.zeros((block_q, chunk_tokens), bool)
+                    if req_mask is not None:
+                        r0 = qstart - qs
+                        c0 = c * chunk_tokens
+                        w = min(chunk_tokens, kv_len - c0)
+                        tile[:qlen, :w] = req_mask[
+                            r0 : r0 + qlen, c0 : c0 + w
+                        ]
+                    # pack per tile: keeps transient host memory at the
+                    # packed size instead of 8x unpacked bools for the
+                    # whole unit list (matters at 64k+ units)
+                    unit_masks.append(
+                        np.packbits(tile, axis=-1, bitorder="little")
+                    )
     # the partial-tile write-back rewrite depends on ascending qstart order
     starts = [u[0] for u in units]
     assert starts == sorted(starts), "work units must be qstart-ordered"
@@ -90,8 +139,12 @@ def build_prefill_work_units(
     pad_unit = (0, 0, 0, 0, 0, 1, 0, np.zeros(pages_per_chunk, np.int64))
     while len(units) < U:
         units.append(pad_unit)
+        if mask_flat is not None:
+            unit_masks.append(
+                np.zeros((block_q, cdiv(chunk_tokens, 8)), np.uint8)
+            )
     arr = lambda i, dt: np.asarray([u[i] for u in units], dt)
-    return dict(
+    plan = dict(
         qstart=arr(0, np.int32), qlen=arr(1, np.int32), qpos0=arr(2, np.int32),
         kvstart=arr(3, np.int32), kvlen=arr(4, np.int32),
         first=arr(5, np.int32), last=arr(6, np.int32),
@@ -100,27 +153,22 @@ def build_prefill_work_units(
         block_q=block_q,
         pages_per_chunk=pages_per_chunk,
     )
+    if mask_flat is not None:
+        packed = np.stack(unit_masks)  # [U, block_q, ceil(chunk/8)]
+        mb = mask_lane_bytes(chunk_tokens)
+        plan["mask_bytes"] = np.pad(
+            packed, ((0, 0), (0, 0), (0, mb - packed.shape[-1]))
+        )
+    return plan
 
 
 def _fused_prefill_kernel(
     # scalar prefetch
     qstart_ref, qlen_ref, qpos0_ref, kvstart_ref, kvlen_ref,
     first_ref, last_ref, pages_ref,
-    # inputs (ANY)
-    q_hbm,  # [Hkv, Tq_pad + bq, group, D]
-    k_hbm,  # [pages, Hkv, page_size, D] (HND)
-    v_hbm,
-    # output (ANY)
-    o_hbm,  # [Hkv, Tq_pad + bq, group, D]
-    # scratch
-    qbuf,  # [bq, group, D]
-    kbuf,  # [2, chunk, D]
-    vbuf,
-    obuf,  # [bq, group, D]
-    acc_ref,  # [bq*group, D] f32
-    m_ref, l_ref,  # [bq*group, 128] f32
-    qsem, ksem, vsem, osem,
-    *,
+    # inputs: q/k/v in ANY (manual DMA); with has_mask, a pipelined
+    # per-unit packed-mask block [bq, mask_lane_bytes] uint8 follows
+    *refs,
     bq: int,
     ppc: int,
     page_size: int,
@@ -130,7 +178,17 @@ def _fused_prefill_kernel(
     window_left: int,
     causal: bool,
     num_units: int,
+    has_mask: bool,
 ):
+    if has_mask:
+        (q_hbm, k_hbm, v_hbm, mask_ref, o_hbm,
+         qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
+         qsem, ksem, vsem, osem) = refs
+    else:
+        (q_hbm, k_hbm, v_hbm, o_hbm,
+         qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
+         qsem, ksem, vsem, osem) = refs
+        mask_ref = None
     hkv = pl.program_id(0)
     u = pl.program_id(1)
     chunk_tokens = ppc * page_size
@@ -195,6 +253,32 @@ def _fused_prefill_kernel(
         valid = valid & (kv_pos <= q_pos)
     if window_left >= 0:
         valid = valid & (kv_pos >= q_pos - window_left)
+    if has_mask:
+        # expand the packed per-unit bitmap in-register.  Lane-dim
+        # byte->column expansion is an unsupported Mosaic shape cast, so
+        # it rides a constant selector-matrix MXU dot (byte values <= 255
+        # are exact in f32); the bit extract is VPU shifts.
+        mb = mask_ref.shape[-1]
+        bytes_f = mask_ref[...].astype(jnp.float32)  # [bq, mb]
+        sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (mb, chunk_tokens), 1) // 8
+            == jax.lax.broadcasted_iota(jnp.int32, (mb, chunk_tokens), 0)
+        ).astype(jnp.float32)
+        byte_col = jax.lax.dot_general(
+            bytes_f, sel, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, chunk]: the byte holding each column's bit
+        shift = jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_tokens), 1
+        ) % 8
+        bit = (byte_col.astype(jnp.int32) >> shift) & 1  # [bq, chunk]
+        # q-row -> merged GQA rows: sublane-side broadcast + free
+        # leading-dim reshape (lane dim untouched)
+        bit_g = jnp.broadcast_to(
+            (bit > 0).reshape(bq, 1, chunk_tokens),
+            (bq, group, chunk_tokens),
+        ).reshape(bqg, chunk_tokens)
+        valid = valid & bit_g
 
     k = kbuf[slot]
     v = vbuf[slot]
@@ -260,6 +344,13 @@ def fused_paged_prefill(
     _, Hkv, page_size, _ = k_cache.shape
     group = H // Hkv
     chunk_tokens = pages_per_chunk * page_size
+    # packed custom mask rides in the plan ([U, bq, mb] from
+    # build_prefill_work_units(mask_flat=...)); presence changes the jit
+    # pytree structure, so the masked/unmasked variants compile separately
+    mask_bytes = plan.get("mask_bytes")
+    has_mask = mask_bytes is not None
+    if has_mask:
+        causal = False  # MaskMode::CUSTOM replaces causal (window still ANDs)
     # extra block so full-bq tile DMAs at the tail stay in bounds; lay q
     # out [Hkv, tq, group, D] so the kernel's per-unit q DMA indexes the
     # kv-head dim instead of slicing a sub-sublane head range
@@ -268,14 +359,23 @@ def fused_paged_prefill(
         q_pad.reshape(total_q + block_q, Hkv, group, D), (1, 0, 2, 3)
     )
 
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    if has_mask:
+        mb = mask_bytes.shape[-1]
+        in_specs.append(
+            pl.BlockSpec(
+                (None, block_q, mb),
+                lambda h, u, *prefetch: (u, 0, 0),
+            )
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=8,
         grid=(Hkv, num_units),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.VMEM((block_q, group, D), q.dtype),
@@ -291,12 +391,16 @@ def fused_paged_prefill(
             pltpu.SemaphoreType.DMA(()),
         ],
     )
+    operands = [q_pad, k_cache, v_cache]
+    if has_mask:
+        operands.append(mask_bytes)
     out = pl.pallas_call(
         functools.partial(
             _fused_prefill_kernel,
             bq=block_q, ppc=pages_per_chunk, page_size=page_size,
             group=group, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
             window_left=window_left, causal=causal, num_units=num_units,
+            has_mask=has_mask,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
@@ -310,7 +414,7 @@ def fused_paged_prefill(
     )(
         plan["qstart"], plan["qlen"], plan["qpos0"], plan["kvstart"],
         plan["kvlen"], plan["first"], plan["last"], plan["pages"],
-        q_pad, k_cache, v_cache,
+        *operands,
     )
     # [Hkv, tq_pad, group, D] -> [tq, H, D]
     return jnp.transpose(out[:, :total_q], (1, 0, 2, 3)).reshape(

@@ -212,13 +212,10 @@ def build_multi_item_mask(
     return jnp.asarray(mask)
 
 
-def _expand_flat_mask(
-    qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
-    custom_mask, packed_custom_mask,
-):
-    """Expand the reference's flat per-request mask concat (MaskMode::CUSTOM,
-    packed LSB-first takes precedence) into the dense [tq_pad, tkv_pad] mask
-    the flattened-token-axis kernels consume.  Returns None if no mask."""
+def _flat_mask_bits(qo_lens, kv_lens, custom_mask, packed_custom_mask):
+    """Validate and normalize the reference's flat per-request mask concat
+    (MaskMode::CUSTOM, packed LSB-first takes precedence) to a flat bool
+    array of ``sum(qo_len*kv_len)`` bits.  Returns None if no mask."""
     total_bits = int(np.sum(qo_lens * kv_lens))
     if packed_custom_mask is not None:
         custom_mask = np.unpackbits(
@@ -232,6 +229,18 @@ def _expand_flat_mask(
             f"custom_mask has {flat.size} bits; expected sum(qo_len*kv_len) "
             f"= {total_bits} (flat per-request concat, not a dense mask)"
         )
+    return flat
+
+
+def _expand_flat_mask(
+    qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
+    custom_mask, packed_custom_mask,
+):
+    """Expand the flat mask into the dense [tq_pad, tkv_pad] mask the
+    flattened-token-axis XLA backend consumes.  Returns None if no mask."""
+    flat = _flat_mask_bits(qo_lens, kv_lens, custom_mask, packed_custom_mask)
+    if flat is None:
+        return None
     dense = np.zeros((tq_pad, tkv_pad), bool)
     off = 0
     for r in range(len(qo_lens)):
@@ -459,20 +468,24 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
         tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
 
-        # paged-batch MaskMode::CUSTOM (reference prefill.py:1117-2947): the
-        # gathered-KV token axis is the per-request concat, so the same
-        # flat-mask expansion as the ragged wrapper applies; masks route to
-        # the gather path (the fused work-unit kernel has no mask operand)
-        dense_mask = _expand_flat_mask(
-            qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
-            custom_mask, packed_custom_mask,
+        # paged-batch MaskMode::CUSTOM (reference prefill.py:1117-2947):
+        # the fused work-unit kernel consumes the packed mask directly
+        # (per-unit byte bitmaps, no dense [qo, kv] materialization —
+        # reference analogue prefill.cuh:2682); the gather fallback
+        # expands the same flat bits densely, lazily
+        mask_flat = _flat_mask_bits(
+            qo_lens, kv_lens, custom_mask, packed_custom_mask
         )
-        if dense_mask is not None:
+        if mask_flat is not None:
             causal = False  # custom mask overrides causal (only)
 
         def build_gather_plan() -> _PrefillPlan:
             # token axes + flat gather rows — O(tkv_pad) host work that the
             # fused default never consumes; built lazily on first fallback
+            dense_mask = _expand_flat_mask(
+                qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
+                mask_flat, None,
+            )
             q_seg, q_pos, total_q = _build_token_axis(
                 qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
             )
@@ -500,7 +513,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             )
 
         self._gather_plan_builder = build_gather_plan
-        use_fused = dense_mask is None and (
+        use_fused = (
             self._backend == "pallas_fused" or (
             # hardware-validated default for the TPU-preferred HND layout;
             # NHD would need a whole-cache transpose per run() to feed the
@@ -533,13 +546,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._fused_raw = (
                 np.asarray(qo_indptr), np.asarray(kv_indptr_pages),
                 np.asarray(kv_indices), np.asarray(kv_lens), page_size,
-                fused_key,
+                fused_key, mask_flat,
             )
             self._fused_tuned = False
             units = build_prefill_work_units(
                 qo_indptr, kv_indptr_pages, kv_indices, kv_lens,
                 block_q=int(bq_u), pages_per_chunk=int(ppc_u),
-                page_size=page_size,
+                page_size=page_size, mask_flat=mask_flat,
             )
             statics = dict(
                 num_units=units.pop("num_units"),
@@ -608,7 +621,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                     build_prefill_work_units,
                 )
 
-                qo_i, kvp_i, kvi_i, kvl_i, ps, fkey = self._fused_raw
+                qo_i, kvp_i, kvi_i, kvl_i, ps, fkey, mflat = self._fused_raw
                 cands = sorted({
                     (bq_c, max(1, ct // ps))
                     for bq_c in (64, 128, 256) for ct in (128, 256)
@@ -618,6 +631,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                     u = build_prefill_work_units(
                         qo_i, kvp_i, kvi_i, kvl_i,
                         block_q=c[0], pages_per_chunk=c[1], page_size=ps,
+                        mask_flat=mflat,
                     )
                     st = dict(
                         num_units=u.pop("num_units"),
@@ -652,6 +666,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                     (q.shape, k_hnd.shape, str(q.dtype), plan.causal,
                      plan.window_left, float(plan.sm_scale),
                      float(plan.logits_soft_cap),
+                     "mask_bytes" in unit_plan,  # masked kernel variant
                      tuple(sorted(statics.items()))),
                     lambda: fused_paged_prefill(
                         q, k_hnd, v_hnd, unit_plan,
