@@ -57,6 +57,8 @@ PHASE_TIMEOUT_S = {
     "moe": 1500.0,
     "moe_sweep": 2400.0,
     "topk": 1200.0,
+    "scans": 1500.0,
+    "serving": 2400.0,
 }
 
 
@@ -183,14 +185,17 @@ def phase_sampling(sweep: bool):
                                     repeats=5),
         )
 
-    vocab = 128 * 1024
-    for bs in ((64, 1, 16) if sweep else (64,)):
+    if os.environ.get("BENCH_SMALL"):  # CPU smoke: interpret-mode kernel
+        vocab, sizes = 1024, (8,)       # at 128k vocab takes minutes/row
+    else:
+        vocab, sizes = 128 * 1024, ((64, 1, 16) if sweep else (64,))
+    for bs in sizes:
         tk = bench_one(bs, vocab, "pallas") * 1e6
         tx = bench_one(bs, vocab, "xla") * 1e6
         _emit_row(phase="sampling", bs=bs, vocab=vocab,
                   kernel_us=round(tk, 1), xla_us=round(tx, 1),
                   speedup=round(tx / tk, 2))
-        print(f"# sampling 128k-vocab bs={bs:3d}: kernel {tk:8.1f} us  "
+        print(f"# sampling vocab={vocab} bs={bs:3d}: kernel {tk:8.1f} us  "
               f"xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)", file=sys.stderr)
 
 
@@ -254,6 +259,95 @@ def phase_moe(sweep: bool):
                   f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
 
 
+def phase_scans(sweep: bool):
+    """Linear-attention/SSM family: chunked prefill + decode step latency
+    (VERDICT r2 #6) — pure-XLA paths measured against roofline before any
+    Pallas kernel is justified.  Mamba-2 SSD at 2.7B-ish shapes; GDN/KDA
+    at 16 heads x 128x128 state."""
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu import gdn as gdn_mod
+    from flashinfer_tpu import mamba as mamba_mod
+    from flashinfer_tpu.testing import bench_fn_device
+
+    if os.environ.get("BENCH_SMALL"):
+        B, L, H, dim, ds, G = 1, 256, 2, 16, 16, 1
+        Hg, dk, dv = 2, 32, 32
+    else:
+        B, L, H, dim, ds, G = 4, 4096, 24, 64, 128, 1
+        Hg, dk, dv = 16, 128, 128
+    key = jax.random.PRNGKey(0)
+
+    # --- mamba chunked SSD prefill ---
+    x = jax.random.normal(key, (B, L, H, dim), jnp.float32) * 0.5
+    dt = jax.nn.softplus(jax.random.normal(jax.random.fold_in(key, 1),
+                                           (B, L, H)))
+    A = -jnp.abs(jax.random.normal(jax.random.fold_in(key, 2), (H,)))
+    Bm = jax.random.normal(jax.random.fold_in(key, 3), (B, L, G, ds))
+    Cm = jax.random.normal(jax.random.fold_in(key, 4), (B, L, G, ds))
+    t = _guard(
+        "bench.scans.mamba_prefill", (B, L, H, dim, ds),
+        lambda: bench_fn_device(
+            lambda *a: mamba_mod.mamba_chunk_scan_combined(*a)[0],
+            x, dt, A, Bm, Cm, repeats=3,
+        ),
+    )
+    # SSD flops: per chunk Q=64, scores [Q,Q] via C.B (ds) + out [Q,dim]
+    Q = 64
+    flops = 2 * B * L * Q * H * (ds + dim) + 2 * B * L * H * dim * ds
+    _emit_row(phase="scans", op="mamba_prefill", B=B, L=L,
+              us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+    print(f"# scans mamba_prefill: {t*1e6:9.1f} us", file=sys.stderr)
+
+    # --- mamba decode step (bandwidth-bound: state RMW) ---
+    st = jax.random.normal(key, (B, H, dim, ds), jnp.float32)
+    xd = jax.random.normal(jax.random.fold_in(key, 5), (B, H, dim))
+    dtd = jax.random.normal(jax.random.fold_in(key, 6), (B, H, dim))
+    Ad = -jnp.abs(jax.random.normal(jax.random.fold_in(key, 7),
+                                    (H, dim, ds)))
+    Bd = jax.random.normal(jax.random.fold_in(key, 8), (B, G, ds))
+    Cd = jax.random.normal(jax.random.fold_in(key, 9), (B, G, ds))
+    t = _guard(
+        "bench.scans.mamba_decode", (B, H, dim, ds),
+        lambda: bench_fn_device(
+            lambda *a: mamba_mod.selective_state_update(*a)[1],
+            st, xd, dtd, Ad, Bd, Cd, repeats=5,
+        ),
+    )
+    state_bytes = 2 * B * H * dim * ds * 4  # read + write f32 state
+    _emit_row(phase="scans", op="mamba_decode", B=B,
+              us=round(t * 1e6, 1), gbps=round(state_bytes / t / 1e9, 1))
+    print(f"# scans mamba_decode:  {t*1e6:9.1f} us", file=sys.stderr)
+
+    # --- GDN / KDA chunked prefill ---
+    q = jax.random.normal(key, (B, L, Hg, dk), jnp.float32) * 0.3
+    k = jax.random.normal(jax.random.fold_in(key, 10),
+                          (B, L, Hg, dk)) * 0.3
+    v = jax.random.normal(jax.random.fold_in(key, 11), (B, L, Hg, dv))
+    beta = jax.nn.sigmoid(
+        jax.random.normal(jax.random.fold_in(key, 12), (B, L, Hg))
+    )
+    alpha_g = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 13), (B, L, Hg)))
+    alpha_k = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 14), (B, L, Hg, dk)))
+    for name, fn, aa in (
+        ("gdn_prefill",
+         lambda *a: gdn_mod.gdn_chunk_prefill(*a)[0], alpha_g),
+        ("kda_prefill",
+         lambda *a: gdn_mod.kda_chunk_prefill(*a)[0], alpha_k),
+    ):
+        t = _guard(
+            f"bench.scans.{name}", (B, L, Hg, dk, dv),
+            lambda: bench_fn_device(fn, q, k, v, aa, beta, repeats=3),
+        )
+        flops = 2 * B * L * Hg * (dk * dv * 2)  # state in/out matmuls
+        _emit_row(phase="scans", op=name, B=B, L=L,
+                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        print(f"# scans {name}: {t*1e6:9.1f} us", file=sys.stderr)
+
+
 def phase_topk(sweep: bool):
     """Exact top-k at 128k vocab: threshold-bisection kernel vs XLA sort
     (VERDICT r2 #7) — the sparse-MLA selection feeder."""
@@ -283,6 +377,147 @@ def phase_topk(sweep: bool):
                   file=sys.stderr)
 
 
+def phase_serving(sweep: bool):
+    """North-star serving number (BASELINE.md): Llama-3-70B batch decode,
+    bs=64, ctx=4k, tokens/sec/chip.
+
+    One v5e chip holds the tp=8 PER-CHIP SHARD of the 70B (8 q heads /
+    1 kv head / inter 3584 per chip), int8 weights + int8 KV (the v5e
+    low-precision serving story; a bf16 70B shard does not fit 16 GB).
+    The decode step is the real op pipeline — rmsnorm -> fused-int8 qkv
+    -> RoPE -> fused int8-KV paged decode attention -> o/mlp int8 GEMMs
+    -> lm_head shard — measured at TWO layer depths; the per-layer slope
+    extrapolates to 80 layers (the two-point fit also validates
+    linearity, printed as a sanity row).  EXCLUDED: the 2 per-layer ICI
+    all-reduces (no second chip on this tunnel) and per-step KV appends
+    (~64 tokens x 256 B, noise vs the 14 GB/step HBM sweep).
+
+    Scale conventions (sm_scale*k_scale folding, output *v_scale) follow
+    the models/llama.py int8-KV contract and tests/test_quant_kv.py; the
+    pipeline is inlined rather than driving models/llama.py because the
+    model runs full-width bf16 layers with mesh collectives — the
+    per-chip int8-weight shard benched here is a different program.  If
+    models/llama.py ever grows an int8-weight mode, fold this into it.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu.gemm import mm_int8
+    from flashinfer_tpu.norm import rmsnorm
+    from flashinfer_tpu.activation import silu_and_mul
+    from flashinfer_tpu.ops import paged_decode_attention
+    from flashinfer_tpu.quantization import quantize_int8
+    from flashinfer_tpu.rope import apply_rope_pos_ids
+    from flashinfer_tpu.testing import bench_fn_device
+
+    if os.environ.get("BENCH_SMALL"):
+        bs, ctx, PS = 4, 128, 16
+        hidden, hq, hkv, hd, inter, vocab_shard = 512, 4, 1, 128, 1024, 1024
+        depths, full_layers = (2, 4), 8
+    else:
+        bs, ctx, PS = 64, 4096, 16
+        hidden, hq, hkv, hd, inter, vocab_shard = 8192, 8, 1, 128, 3584, 16032
+        depths, full_layers = (8, 16), 80
+    pages_per_req = ctx // PS
+    num_pages = bs * pages_per_req
+    qdim, kvdim = hq * hd, hkv * hd
+    key = jax.random.PRNGKey(0)
+
+    def qw(k, shape, axis=0):
+        w = jax.random.normal(k, shape, jnp.float32) / np.sqrt(shape[0])
+        wq, ws = quantize_int8(w, axis=axis)
+        return wq, ws.reshape(1, -1)
+
+    def build(L):
+        ks = jax.random.split(jax.random.fold_in(key, L), 6 * L + 2)
+        stack = lambda parts: tuple(jnp.stack(p) for p in zip(*parts))
+        layers = stack([
+            (
+                *qw(ks[6 * i], (hidden, qdim + 2 * kvdim)),
+                *qw(ks[6 * i + 1], (qdim, hidden)),
+                *qw(ks[6 * i + 2], (hidden, 2 * inter)),
+                *qw(ks[6 * i + 3], (inter, hidden)),
+                jax.random.normal(ks[6 * i + 4], (hidden,)) * 0.02 + 1.0,
+                jax.random.normal(ks[6 * i + 5], (hidden,)) * 0.02 + 1.0,
+            )
+            for i in range(L)
+        ])
+        kc = jax.random.randint(
+            ks[-2], (L, num_pages, hkv, PS, hd), -127, 127, jnp.int8
+        )
+        vc = jax.random.randint(
+            ks[-1], (L, num_pages, hkv, PS, hd), -127, 127, jnp.int8
+        )
+        head, head_s = qw(jax.random.fold_in(key, 999), (hidden, vocab_shard))
+        return layers, kc, vc, head, head_s
+
+    pt = jnp.asarray(
+        np.random.default_rng(0).permutation(num_pages)
+        .reshape(bs, pages_per_req).astype(np.int32)
+    )
+    lens = jnp.full((bs,), ctx - 1, jnp.int32)
+    x0 = jax.random.normal(jax.random.fold_in(key, 7), (bs, hidden),
+                           jnp.bfloat16)
+    kscale = vscale = 0.05
+    sm = hd ** -0.5
+
+    def step(x, layers, kc, vc, head, head_s, pt, lens):
+        def layer(x, w, kcl, vcl):
+            wqkv, sqkv, wo, so, wgu, sgu, wd, sd, n1, n2 = w
+            h = rmsnorm(x, n1.astype(x.dtype))
+            hq8, hs = quantize_int8(h)
+            qkv = mm_int8(hq8, wqkv, hs, sqkv)
+            q = qkv[:, :qdim].reshape(bs, hq, hd)
+            k = qkv[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
+            q, k = apply_rope_pos_ids(q, k, lens)
+            attn = paged_decode_attention(
+                q.astype(jnp.bfloat16), kcl, vcl, pt, lens,
+                sm_scale=sm * kscale, kv_layout="HND",
+            ) * vscale
+            a8, as_ = quantize_int8(attn.reshape(bs, qdim))
+            x = x + mm_int8(a8, wo, as_, so)
+            h2 = rmsnorm(x, n2.astype(x.dtype))
+            g8, gs = quantize_int8(h2)
+            mlp = silu_and_mul(mm_int8(g8, wgu, gs, sgu))
+            m8, ms = quantize_int8(mlp)
+            return (x + mm_int8(m8, wd, ms, sd)).astype(x.dtype)
+
+        # scan over layers: weights + per-layer caches ride the xs axis
+        def body(carry, w):
+            *weights, kcl, vcl = w
+            return layer(carry, tuple(weights), kcl, vcl), None
+
+        x, _ = jax.lax.scan(body, x, (*layers, kc, vc))
+        hq8, hs = quantize_int8(rmsnorm(x, jnp.ones((hidden,), x.dtype)))
+        return mm_int8(hq8, head, hs, head_s, out_dtype=jnp.float32)
+
+    times = {}
+    for L in depths:
+        layers, kc, vc, head, head_s = build(L)
+        t = _guard(
+            "bench.serving70b", (bs, ctx, L, hidden),
+            lambda: bench_fn_device(
+                step, x0, layers, kc, vc, head, head_s, pt, lens, repeats=3
+            ),
+        )
+        times[L] = t
+        print(f"# serving L={L}: {t*1e6:9.1f} us/step", file=sys.stderr)
+    l1, l2 = depths
+    per_layer = (times[l2] - times[l1]) / (l2 - l1)
+    fixed = max(times[l1] - l1 * per_layer, 0.0)
+    t_full = fixed + full_layers * per_layer
+    toks = bs / t_full
+    _emit_row(phase="serving", model="llama70b_tp8shard_int8", bs=bs,
+              ctx=ctx, layers_measured=list(depths),
+              us_per_layer=round(per_layer * 1e6, 1),
+              us_step_80l=round(t_full * 1e6, 1),
+              tok_s_per_chip=round(toks, 1),
+              linearity=round(times[l2] / times[l1], 3))
+    print(f"# serving 70B extrapolated: {t_full*1e3:.2f} ms/step, "
+          f"{toks:.0f} tok/s/chip", file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -297,11 +532,16 @@ PHASES = {
     "sampling": phase_sampling,
     "moe": phase_moe,
     "topk": phase_topk,
+    "scans": phase_scans,
+    "serving": phase_serving,
     "selftest": phase_selftest,
 }
 # selftest is CI-only (reachable via --only); production runs must not
 # spawn the stub or bank its rows
-DEFAULT_PHASES = ["decode", "sampling", "moe", "topk"]
+#   decode first (the official headline metric), serving second (the
+#   BASELINE.md north star) — a mid-run wedge in a later phase must not
+#   cost either deliverable
+DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans"]
 
 
 # --------------------------------------------------------------------------
@@ -407,6 +647,10 @@ def orchestrate(sweep: bool, bank: bool, phases=None, no_probe=False) -> int:
                      if r.get("phase") == "sampling" and r["bs"] == 64), None)
     if sampling:
         result["sampling_128k_bs64_us"] = sampling["kernel_us"]
+    serving = next((r for r in all_rows if r.get("phase") == "serving"), None)
+    if serving:
+        # BASELINE.md north star: tokens/sec/chip, 70B bs=64 ctx=4k
+        result["serving_tok_s_per_chip"] = serving["tok_s_per_chip"]
     if wedged:
         result["wedged"] = True
     if bank:
