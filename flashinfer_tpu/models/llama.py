@@ -101,6 +101,64 @@ def init_llama_params(key: jax.Array, cfg: LlamaConfig) -> Dict:
     )
 
 
+_LINEAR_NAMES = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+def quantize_llama_weights(params: Dict, include_lm_head: bool = True) -> Dict:
+    """Per-output-channel symmetric int8 quantization of every linear
+    weight -> params pytree with int8 weights + ``<name>_scale`` entries.
+
+    The int8-weight serving mode (reference analogue: the fp8/int8 weight
+    paths of trtllm-gen GEMMs): weights cross HBM at half width and every
+    projection runs on the native int8 MXU (``gemm.mm_int8``) with dynamic
+    per-row activation quantization.  Embedding stays high-precision (it
+    is a gather, not a GEMM)."""
+    from flashinfer_tpu.quantization import quantize_int8
+
+    def q(w):
+        wq, ws = quantize_int8(w.astype(jnp.float32), axis=0)  # [1, out]
+        return wq, ws
+
+    out = dict(params)
+    new_layers = []
+    for layer in params["layers"]:
+        nl = dict(layer)
+        for name in _LINEAR_NAMES:
+            nl[name], nl[name + "_scale"] = q(layer[name])
+        new_layers.append(nl)
+    out["layers"] = new_layers
+    if include_lm_head:
+        out["lm_head"], out["lm_head_scale"] = q(params["lm_head"])
+    return out
+
+
+def _pre_quant(x, store, name="q_proj"):
+    """Quantize an activation ONCE for reuse across the projections that
+    share it (q/k/v, gate/up) — returns None on the bf16 path."""
+    if store[name].dtype != jnp.int8:
+        return None
+    from flashinfer_tpu.quantization import quantize_int8
+
+    return quantize_int8(x)
+
+
+def _mm(x, store, name, pre=None):
+    """Linear projection dispatching on the stored weight dtype: bf16
+    einsum, or int8 MXU with folded activation/weight scales.  ``pre``
+    is an optional pre-quantized ``(xq, xs)`` of ``x`` (``_pre_quant``)."""
+    w = store[name]
+    if w.dtype == jnp.int8:
+        from flashinfer_tpu.gemm import mm_int8
+        from flashinfer_tpu.quantization import quantize_int8
+
+        xq, xs = pre if pre is not None else quantize_int8(x)
+        return mm_int8(xq, w, xs, store[name + "_scale"], out_dtype=x.dtype)
+    return x @ w
+
+
 def _attn_decode(
     x, layer, cfg: LlamaConfig, kv_cache, page_table, kv_lens, positions,
     num_qo_heads: int, num_kv_heads: int, use_pallas: bool,
@@ -111,9 +169,10 @@ def _attn_decode(
     [num_pages, kvh, page_size, hd] (TPU-preferred, ops/paged_decode.py)."""
     B = x.shape[0]
     hd = cfg.head_dim
-    q = (x @ layer["q_proj"]).reshape(B, num_qo_heads, hd)
-    k = (x @ layer["k_proj"]).reshape(B, num_kv_heads, hd)
-    v = (x @ layer["v_proj"]).reshape(B, num_kv_heads, hd)
+    pre = _pre_quant(x, layer)
+    q = _mm(x, layer, "q_proj", pre).reshape(B, num_qo_heads, hd)
+    k = _mm(x, layer, "k_proj", pre).reshape(B, num_kv_heads, hd)
+    v = _mm(x, layer, "v_proj", pre).reshape(B, num_kv_heads, hd)
     q, k = apply_rope_pos_ids(q, k, positions, rope_theta=cfg.rope_theta)
 
     # append this step's K/V: page_table row lookup at the write position
@@ -168,19 +227,27 @@ def llama_decode_step(
             cfg.num_qo_heads, cfg.num_kv_heads, use_pallas,
         )
         new_caches.append(cache)
-        x = x + (attn @ layer["o_proj"]).astype(cfg.dtype)
+        x = x + _mm(attn, layer, "o_proj").astype(cfg.dtype)
         h = rmsnorm(x, layer["post_norm"], cfg.rms_eps)
-        mlp_in = jnp.concatenate([h @ layer["gate_proj"], h @ layer["up_proj"]], -1)
-        x = x + (silu_and_mul(mlp_in) @ layer["down_proj"]).astype(cfg.dtype)
+        pre2 = _pre_quant(h, layer, "gate_proj")
+        mlp_in = jnp.concatenate(
+            [_mm(h, layer, "gate_proj", pre2),
+             _mm(h, layer, "up_proj", pre2)], -1
+        )
+        x = x + _mm(silu_and_mul(mlp_in), layer, "down_proj").astype(cfg.dtype)
     x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x, params, "lm_head").astype(jnp.float32)
     return logits, new_caches
 
 
 
-def _tp_param_specs(cfg: LlamaConfig, tp: str, layer_leading=None):
+def _tp_param_specs(cfg: LlamaConfig, tp: str, layer_leading=None,
+                    quantized: bool = False):
     """Shared TP weight-sharding spec table (column-shard q/k/v/gate/up,
-    row-shard o/down); ``layer_leading`` prepends an axis (pp layer stacks)."""
+    row-shard o/down); ``layer_leading`` prepends an axis (pp layer stacks).
+    With ``quantized``, each linear's [1, out] scale shards with the
+    weight's out axis (tp for column-sharded, replicated for row-sharded
+    whose out dim is full-width)."""
     def lp(*axes):
         return P(layer_leading, *axes) if layer_leading else P(*axes)
 
@@ -192,6 +259,11 @@ def _tp_param_specs(cfg: LlamaConfig, tp: str, layer_leading=None):
         gate_proj=lp(None, tp), up_proj=lp(None, tp),
         down_proj=lp(tp, None),
     )
+    if quantized:
+        for name in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"):
+            layer[name + "_scale"] = lp(None, tp)
+        for name in ("o_proj", "down_proj"):
+            layer[name + "_scale"] = lp(None, None)
     return layer
 
 
@@ -204,7 +276,8 @@ def _check_head_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
     )
 
 
-def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
+def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None,
+                             quantized: bool = False):
     """Build a jitted dp x tp sharded decode step via shard_map.
 
     Weight sharding: q/k/v/gate/up column-sharded over tp, o/down
@@ -225,8 +298,13 @@ def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         embed=P(None, None),
         final_norm=P(None),
         lm_head=P(None, tp),
-        layers=[_tp_param_specs(cfg, tp) for _ in range(cfg.num_layers)],
+        layers=[
+            _tp_param_specs(cfg, tp, quantized=quantized)
+            for _ in range(cfg.num_layers)
+        ],
     )
+    if quantized:
+        param_specs["lm_head_scale"] = P(None, tp)
     cache_spec = [(P(dp, None, tp, None, None), P(dp, None, tp, None, None))
                   for _ in range(cfg.num_layers)]
     in_specs = (
@@ -253,20 +331,22 @@ def make_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
             )
             new_caches.append((cache[0][None], cache[1][None]))
             # fused AR + residual-add + post-attention RMSNorm
-            o_partial = attn @ layer["o_proj"]
+            o_partial = _mm(attn, layer, "o_proj")
             h, x = allreduce_fusion(
                 o_partial, residual=x, rms_weight=layer["post_norm"],
                 eps=cfg.rms_eps, axis=tp,
             )
             h = h.astype(cfg.dtype)
+            pre2 = _pre_quant(h, layer, "gate_proj")
             mlp_in = jnp.concatenate(
-                [h @ layer["gate_proj"], h @ layer["up_proj"]], -1
+                [_mm(h, layer, "gate_proj", pre2),
+                 _mm(h, layer, "up_proj", pre2)], -1
             )
-            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
             # MLP residual uses plain AR + add (next layer norms it)
             (x,) = allreduce_fusion(d_partial, residual=x, axis=tp)
         x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
-        logits = (x @ params["lm_head"]).astype(jnp.float32)  # [B, vocab/tp]
+        logits = _mm(x, params, "lm_head").astype(jnp.float32)  # [B, vocab/tp]
         return logits, new_caches
 
     sharded = jax.jit(
@@ -327,9 +407,10 @@ def make_cp_prefill_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         kvs = []
         for layer in params["layers"]:
             h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
-            q = (h @ layer["q_proj"]).reshape(B, S_local, qh_l, cfg.head_dim)
-            k = (h @ layer["k_proj"]).reshape(B, S_local, kvh_l, cfg.head_dim)
-            v = (h @ layer["v_proj"]).reshape(B, S_local, kvh_l, cfg.head_dim)
+            pre = _pre_quant(h, layer)
+            q = _mm(h, layer, "q_proj", pre).reshape(B, S_local, qh_l, cfg.head_dim)
+            k = _mm(h, layer, "k_proj", pre).reshape(B, S_local, kvh_l, cfg.head_dim)
+            v = _mm(h, layer, "v_proj", pre).reshape(B, S_local, kvh_l, cfg.head_dim)
             qr, kr = jax.vmap(
                 lambda qq, kk: apply_rope_pos_ids(
                     qq, kk, pos, rope_theta=cfg.rope_theta
@@ -342,19 +423,21 @@ def make_cp_prefill_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
                 )
             )(qr, kr, v)
             kvs.append((kr, v))
-            o_partial = attn.reshape(B, S_local, qh_l * cfg.head_dim) @ layer["o_proj"]
+            o_partial = _mm(attn.reshape(B, S_local, qh_l * cfg.head_dim), layer, "o_proj")
             h2, x = allreduce_fusion(
                 o_partial, residual=x, rms_weight=layer["post_norm"],
                 eps=cfg.rms_eps, axis=tp,
             )
             h2 = h2.astype(cfg.dtype)
+            _pq2 = _pre_quant(h2, layer, "gate_proj")
             mlp_in = jnp.concatenate(
-                [h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1
+                [_mm(h2, layer, "gate_proj", _pq2),
+                 _mm(h2, layer, "up_proj", _pq2)], -1
             )
-            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
             (x,) = allreduce_fusion(d_partial, residual=x, axis=tp)
         x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
-        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        logits = _mm(x, params, "lm_head").astype(jnp.float32)
         return logits, kvs
 
     sharded = jax.jit(
@@ -413,16 +496,18 @@ def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
                 h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
                 qh_l, kvh_l, use_pallas,
             )
-            o_partial = attn @ layer["o_proj"]
+            o_partial = _mm(attn, layer, "o_proj")
             h2, x2 = allreduce_fusion(
                 o_partial, residual=x, rms_weight=layer["post_norm"],
                 eps=cfg.rms_eps, axis=tp,
             )
             h2 = h2.astype(cfg.dtype)
+            _pq2 = _pre_quant(h2, layer, "gate_proj")
             mlp_in = jnp.concatenate(
-                [h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1
+                [_mm(h2, layer, "gate_proj", _pq2),
+                 _mm(h2, layer, "up_proj", _pq2)], -1
             )
-            d_partial = silu_and_mul(mlp_in) @ layer["down_proj"]
+            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
             (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
             return x3, (kc2, vc2)
 
@@ -460,7 +545,7 @@ def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         # every rank in turn; it now sits on stage 0 — broadcast via psum
         x = jax.lax.psum(jnp.where(my_stage == 0, x, 0.0), pp)
         x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
-        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        logits = _mm(x, params, "lm_head").astype(jnp.float32)
         return logits, (kcs[:, None], vcs[:, None])
 
     sharded = jax.jit(

@@ -27,7 +27,9 @@ def bench_mod():
 
 def test_phase_rows_survive_timeout(bench_mod, monkeypatch):
     monkeypatch.setenv("BENCH_SELFTEST_HANG", "1")
-    rows, ok, detail = bench_mod._run_phase("selftest", False, timeout_s=5)
+    # window must cover phase-subprocess startup (apply_platform_from_env
+    # imports jax, ~2-5s) before the rows land and the hang begins
+    rows, ok, detail = bench_mod._run_phase("selftest", False, timeout_s=20)
     assert not ok
     assert "timed out" in detail
     assert [r["n"] for r in rows] == [1, 2]
