@@ -5,5 +5,12 @@ from flashinfer_tpu.models.llama import (  # noqa: F401
     make_cp_prefill_step,
     make_pp_sharded_decode_step,
     make_sharded_decode_step,
+    quantize_llama_weights,
     stack_layer_params,
+)
+from flashinfer_tpu.models.mixtral import (  # noqa: F401
+    MixtralConfig,
+    init_mixtral_params,
+    make_ep_sharded_decode_step,
+    mixtral_decode_step,
 )
