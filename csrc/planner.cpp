@@ -106,6 +106,80 @@ int paged_gather_plan(
     return 0;
 }
 
+// Per-work-unit packed custom-mask bitmaps for the fused paged-prefill
+// kernel (MaskMode::CUSTOM).  Source: the reference's flat per-request
+// mask concat, LSB-first packed (sum of qo_i * kv_i bits).  Output: for
+// each (request, qo-tile, kv-chunk) unit in request-major order — the
+// exact order build_prefill_work_units emits — a [block_q, mb] LSB-first
+// byte bitmap of the unit's mask window.  Bit (j, c) of unit
+// (r, tile t, chunk cchunk) = mask[r][t*block_q + j][cchunk*chunk + c].
+// This loop touches every mask bit of every tile, so it is the hottest
+// host-plan loop in the library; the inner copy stitches unaligned source
+// bytes with two shifts per output byte.
+int prefill_mask_plan(
+    const uint8_t* mask_bits,   // [ceil(total_bits / 8)] LSB-first
+    const int64_t* qo_indptr,   // [batch + 1]
+    const int64_t* kv_lens,     // [batch]
+    int32_t batch,
+    int32_t block_q,
+    int32_t chunk_tokens,
+    int32_t mb,                 // out lane bytes >= ceil(chunk_tokens / 8)
+    int64_t mask_bits_len,      // total bits in mask_bits
+    int64_t out_units,          // capacity of `out` in units
+    uint8_t* out                // [out_units * block_q * mb] zero-filled
+) {
+    if (mb * 8 < chunk_tokens) return -1;
+    // read bits [s, s+8) of the source (clamped to mask_bits_len)
+    auto read8 = [&](int64_t s) -> uint8_t {
+        if (s >= mask_bits_len) return 0;
+        const int64_t byte = s >> 3;
+        const int sh = (int)(s & 7);
+        const int64_t last_byte = (mask_bits_len - 1) >> 3;
+        uint8_t v = (uint8_t)(mask_bits[byte] >> sh);
+        if (sh && byte + 1 <= last_byte)
+            v |= (uint8_t)(mask_bits[byte + 1] << (8 - sh));
+        // mask off bits past the end of the source
+        const int64_t avail = mask_bits_len - s;
+        if (avail < 8) v &= (uint8_t)((1u << avail) - 1);
+        return v;
+    };
+    int64_t off = 0;   // bit offset of request r's mask block
+    int64_t u = 0;     // unit index
+    for (int32_t r = 0; r < batch; ++r) {
+        const int64_t qn = qo_indptr[r + 1] - qo_indptr[r];
+        const int64_t kn = kv_lens[r];
+        if (qn < 0 || kn < 0) return -2;
+        if (qn == 0) { off += qn * kn; continue; }
+        const int64_t n_tiles = (qn + block_q - 1) / block_q;
+        const int64_t n_chunks =
+            kn > 0 ? (kn + chunk_tokens - 1) / chunk_tokens : 1;
+        for (int64_t t = 0; t < n_tiles; ++t) {
+            const int64_t r0 = t * block_q;
+            const int64_t qlen = std::min<int64_t>(block_q, qn - r0);
+            for (int64_t c = 0; c < n_chunks; ++c, ++u) {
+                if (u >= out_units) return -3;
+                if (kn == 0) continue;  // zero mask (unit exists for shape)
+                const int64_t c0 = c * chunk_tokens;
+                const int64_t w = std::min<int64_t>(chunk_tokens, kn - c0);
+                uint8_t* unit_out = out + (size_t)u * block_q * mb;
+                for (int64_t j = 0; j < qlen; ++j) {
+                    const int64_t src = off + (r0 + j) * kn + c0;
+                    uint8_t* row = unit_out + (size_t)j * mb;
+                    const int64_t wbytes = (w + 7) >> 3;
+                    for (int64_t b = 0; b < wbytes; ++b) {
+                        uint8_t v = read8(src + b * 8);
+                        const int64_t rem = w - b * 8;
+                        if (rem < 8) v &= (uint8_t)((1u << rem) - 1);
+                        row[b] = v;
+                    }
+                }
+            }
+        }
+        off += qn * kn;
+    }
+    return 0;
+}
+
 // BSR plan: pad per-row column lists to max_nnz (cols zero-padded).
 int bsr_plan(
     const int32_t* indptr,    // [mb + 1]

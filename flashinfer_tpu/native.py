@@ -46,6 +46,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         lib.token_axis_plan.restype = ctypes.c_int
         lib.paged_gather_plan.restype = ctypes.c_int
         lib.bsr_plan.restype = ctypes.c_int
+        lib.prefill_mask_plan.restype = ctypes.c_int
         return lib
     except subprocess.CalledProcessError as e:
         logging.getLogger("flashinfer_tpu").warning(
@@ -202,3 +203,53 @@ def bsr_plan(indptr: np.ndarray, indices: np.ndarray, max_nnz: int):
         n = int(ip[i + 1] - ip[i])
         cols[i * max_nnz : i * max_nnz + n] = idx[int(ip[i]) : int(ip[i]) + n]
     return cols
+
+
+def prefill_mask_plan(
+    mask_bits: np.ndarray,  # bool flat bits OR uint8 LSB-first packed bytes
+    total_bits: int,
+    qo_indptr: np.ndarray,  # [B+1]
+    kv_lens: np.ndarray,  # [B]
+    block_q: int,
+    chunk_tokens: int,
+    mb: int,
+    num_units: int,
+) -> np.ndarray:
+    """Per-unit packed custom-mask bitmaps for the fused prefill kernel
+    -> uint8 [num_units, block_q, mb].
+
+    ``mask_bits`` may be the raw LSB-first packed bytes straight from the
+    caller's ``packed_custom_mask`` (no unpack/repack round trip on the
+    hottest host-plan loop) or a flat bool array.  Raises when the native
+    library is unavailable — callers gate on :func:`get_lib` and keep
+    their numpy loop for the fallback (unlike the other wrappers here,
+    the fallback logic lives with the unit builder, so a silent None
+    would risk a mask-less plan)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "prefill_mask_plan: native planner unavailable "
+            "(gate on native.get_lib() and use the numpy path)"
+        )
+    if mask_bits.dtype == np.uint8:
+        bits = np.ascontiguousarray(mask_bits.reshape(-1))
+    else:
+        bits = np.packbits(
+            np.ascontiguousarray(mask_bits, bool), bitorder="little"
+        )
+    if bits.size * 8 < total_bits:
+        raise ValueError(
+            f"prefill_mask_plan: {bits.size * 8} packed bits < {total_bits}"
+        )
+    qip = np.ascontiguousarray(qo_indptr, np.int64)
+    kvl = np.ascontiguousarray(kv_lens, np.int64)
+    out = np.zeros((num_units, block_q, mb), np.uint8)
+    rc = lib.prefill_mask_plan(
+        _ptr(bits), _ptr(qip), _ptr(kvl), len(qip) - 1,
+        block_q, chunk_tokens, mb,
+        ctypes.c_int64(int(total_bits)), ctypes.c_int64(num_units),
+        _ptr(out),
+    )
+    if rc == 0:
+        return out
+    raise ValueError(f"prefill_mask_plan: rc={rc} (geometry mismatch)")

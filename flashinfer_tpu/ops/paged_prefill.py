@@ -59,7 +59,9 @@ def build_prefill_work_units(
     block_q: int,
     pages_per_chunk: int,
     page_size: int,
-    mask_flat: Optional[np.ndarray] = None,  # concat per-request [qo*kv] bools
+    mask_flat: Optional[np.ndarray] = None,  # concat per-request [qo*kv]:
+    #   bool bits, or uint8 LSB-first packed bytes (+ mask_total_bits)
+    mask_total_bits: Optional[int] = None,
 ):
     """Host-side plan: flatten (request, qo-tile, kv-chunk) units.
 
@@ -83,13 +85,33 @@ def build_prefill_work_units(
     shipped), not the packing."""
     chunk_tokens = pages_per_chunk * page_size
     units = []  # (qstart, qlen, qpos0, kvstart, kvlen_req, first, last, pages)
-    unit_masks = []  # [block_q, chunk] bool per unit (when mask_flat)
-    mask_offsets = np.concatenate(
-        [[0], np.cumsum(
-            (qo_indptr[1:] - qo_indptr[:-1]).astype(np.int64)
-            * np.asarray(kv_lens, np.int64)
-        )]
-    ) if mask_flat is not None else None
+    unit_masks = []  # packed [block_q, ceil(chunk/8)] per unit (numpy path)
+    use_native_mask = False
+    mask_offsets = None
+    if mask_flat is not None:
+        from flashinfer_tpu import native
+
+        if mask_total_bits is None:
+            assert mask_flat.dtype != np.uint8, (
+                "packed mask bytes require mask_total_bits"
+            )
+            mask_total_bits = int(mask_flat.size)
+        # the per-unit re-pack touches every mask bit of every tile — the
+        # hottest host-plan loop; the C++ planner does it with two shifts
+        # per output byte straight from the packed bytes (numpy per-tile
+        # packbits is the fallback, which needs the unpacked bool form)
+        use_native_mask = native.get_lib() is not None
+        if not use_native_mask:
+            if mask_flat.dtype == np.uint8:
+                mask_flat = np.unpackbits(
+                    mask_flat.reshape(-1), bitorder="little"
+                )[:mask_total_bits].astype(bool)
+            mask_offsets = np.concatenate(
+                [[0], np.cumsum(
+                    (qo_indptr[1:] - qo_indptr[:-1]).astype(np.int64)
+                    * np.asarray(kv_lens, np.int64)
+                )]
+            )
     B = len(qo_indptr) - 1
     for r in range(B):
         qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
@@ -97,7 +119,8 @@ def build_prefill_work_units(
         pages = kv_page_indices[
             int(kv_page_indptr[r]) : int(kv_page_indptr[r + 1])
         ]
-        if mask_flat is not None and qe > qs and kv_len > 0:
+        if (mask_flat is not None and not use_native_mask
+                and qe > qs and kv_len > 0):
             req_mask = np.asarray(
                 mask_flat[mask_offsets[r] : mask_offsets[r + 1]], bool
             ).reshape(qe - qs, kv_len)
@@ -116,7 +139,7 @@ def build_prefill_work_units(
                     qstart, qlen, qpos0, c * chunk_tokens, kv_len,
                     1 if c == 0 else 0, 1 if c == n_chunks - 1 else 0, pg,
                 ))
-                if mask_flat is not None:
+                if mask_flat is not None and not use_native_mask:
                     tile = np.zeros((block_q, chunk_tokens), bool)
                     if req_mask is not None:
                         r0 = qstart - qs
@@ -139,7 +162,7 @@ def build_prefill_work_units(
     pad_unit = (0, 0, 0, 0, 0, 1, 0, np.zeros(pages_per_chunk, np.int64))
     while len(units) < U:
         units.append(pad_unit)
-        if mask_flat is not None:
+        if mask_flat is not None and not use_native_mask:
             unit_masks.append(
                 np.zeros((block_q, cdiv(chunk_tokens, 8)), np.uint8)
             )
@@ -154,11 +177,18 @@ def build_prefill_work_units(
         pages_per_chunk=pages_per_chunk,
     )
     if mask_flat is not None:
-        packed = np.stack(unit_masks)  # [U, block_q, ceil(chunk/8)]
         mb = mask_lane_bytes(chunk_tokens)
-        plan["mask_bytes"] = np.pad(
-            packed, ((0, 0), (0, 0), (0, mb - packed.shape[-1]))
-        )
+        if use_native_mask:
+            plan["mask_bytes"] = native.prefill_mask_plan(
+                mask_flat, mask_total_bits,
+                qo_indptr, np.asarray(kv_lens, np.int64),
+                block_q, chunk_tokens, mb, U,
+            )
+        else:
+            packed = np.stack(unit_masks)  # [U, block_q, ceil(chunk/8)]
+            plan["mask_bytes"] = np.pad(
+                packed, ((0, 0), (0, 0), (0, mb - packed.shape[-1]))
+            )
     return plan
 
 

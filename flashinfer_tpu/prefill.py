@@ -471,11 +471,22 @@ class BatchPrefillWithPagedKVCacheWrapper:
         # paged-batch MaskMode::CUSTOM (reference prefill.py:1117-2947):
         # the fused work-unit kernel consumes the packed mask directly
         # (per-unit byte bitmaps, no dense [qo, kv] materialization —
-        # reference analogue prefill.cuh:2682); the gather fallback
-        # expands the same flat bits densely, lazily
-        mask_flat = _flat_mask_bits(
-            qo_lens, kv_lens, custom_mask, packed_custom_mask
-        )
+        # reference analogue prefill.cuh:2682).  Packed input stays
+        # packed end-to-end on the fused path (the native planner reads
+        # LSB-first bytes); bool input is validated here; the gather
+        # fallback expands densely, lazily, from the original args.
+        mask_total_bits = int(np.sum(qo_lens * kv_lens))
+        if packed_custom_mask is not None:
+            mask_flat = np.asarray(packed_custom_mask).view(
+                np.uint8
+            ).reshape(-1)
+            if mask_flat.size * 8 < mask_total_bits:
+                raise ValueError(
+                    f"packed_custom_mask has {mask_flat.size * 8} bits; "
+                    f"expected sum(qo_len*kv_len) = {mask_total_bits}"
+                )
+        else:
+            mask_flat = _flat_mask_bits(qo_lens, kv_lens, custom_mask, None)
         if mask_flat is not None:
             causal = False  # custom mask overrides causal (only)
 
@@ -484,7 +495,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             # fused default never consumes; built lazily on first fallback
             dense_mask = _expand_flat_mask(
                 qo_indptr, kv_indptr, qo_lens, kv_lens, tq_pad, tkv_pad,
-                mask_flat, None,
+                custom_mask, packed_custom_mask,
             )
             q_seg, q_pos, total_q = _build_token_axis(
                 qo_indptr, tq_pad, _Q_PAD_SEG, kv_lens - qo_lens
@@ -546,13 +557,14 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._fused_raw = (
                 np.asarray(qo_indptr), np.asarray(kv_indptr_pages),
                 np.asarray(kv_indices), np.asarray(kv_lens), page_size,
-                fused_key, mask_flat,
+                fused_key, mask_flat, mask_total_bits,
             )
             self._fused_tuned = False
             units = build_prefill_work_units(
                 qo_indptr, kv_indptr_pages, kv_indices, kv_lens,
                 block_q=int(bq_u), pages_per_chunk=int(ppc_u),
                 page_size=page_size, mask_flat=mask_flat,
+                mask_total_bits=mask_total_bits,
             )
             statics = dict(
                 num_units=units.pop("num_units"),
@@ -621,7 +633,8 @@ class BatchPrefillWithPagedKVCacheWrapper:
                     build_prefill_work_units,
                 )
 
-                qo_i, kvp_i, kvi_i, kvl_i, ps, fkey, mflat = self._fused_raw
+                (qo_i, kvp_i, kvi_i, kvl_i, ps, fkey, mflat,
+                 mbits) = self._fused_raw
                 cands = sorted({
                     (bq_c, max(1, ct // ps))
                     for bq_c in (64, 128, 256) for ct in (128, 256)
@@ -631,7 +644,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                     u = build_prefill_work_units(
                         qo_i, kvp_i, kvi_i, kvl_i,
                         block_q=c[0], pages_per_chunk=c[1], page_size=ps,
-                        mask_flat=mflat,
+                        mask_flat=mflat, mask_total_bits=mbits,
                     )
                     st = dict(
                         num_units=u.pop("num_units"),

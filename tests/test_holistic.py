@@ -141,3 +141,41 @@ def test_native_planner_bounds_errors():
             np.array([0, 20]), np.arange(20, dtype=np.int32),
             np.array([1], np.int32), 16, 8, 8,
         )
+
+
+def test_native_mask_plan_matches_numpy_fallback():
+    """C++ per-unit mask bitmap == the numpy per-tile packbits path, on
+    ragged geometry with partial tiles/chunks and a zero-kv request."""
+    from flashinfer_tpu import native
+    from flashinfer_tpu.ops.paged_prefill import build_prefill_work_units
+
+    rng = np.random.default_rng(3)
+    qo_lens = [130, 40, 7, 0, 65]
+    kv_lens = np.array([200, 150, 3, 90, 0], np.int64)
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    PS, ppc, bq = 16, 4, 64
+    pages_per = [max(-(-int(l) // PS), 0) for l in kv_lens]
+    kv_page_indptr = np.concatenate([[0], np.cumsum(pages_per)])
+    kv_indices = np.arange(int(kv_page_indptr[-1]), dtype=np.int32)
+    mask_flat = rng.random(
+        int(np.sum(np.asarray(qo_lens) * np.asarray(kv_lens)))
+    ) < 0.5
+
+    def build():
+        plan = build_prefill_work_units(
+            qo_indptr, kv_page_indptr, kv_indices, kv_lens,
+            block_q=bq, pages_per_chunk=ppc, page_size=PS,
+            mask_flat=mask_flat,
+        )
+        return plan["mask_bytes"]
+
+    if native.get_lib() is None:
+        pytest.skip("native planner unavailable")
+    m_native = build()
+    lib_save = native._LIB
+    native._LIB = None  # force numpy fallback
+    try:
+        m_numpy = build()
+    finally:
+        native._LIB = lib_save
+    np.testing.assert_array_equal(m_native, m_numpy)
