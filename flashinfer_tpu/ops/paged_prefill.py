@@ -92,9 +92,11 @@ def build_prefill_work_units(
         from flashinfer_tpu import native
 
         if mask_total_bits is None:
-            assert mask_flat.dtype != np.uint8, (
-                "packed mask bytes require mask_total_bits"
-            )
+            if mask_flat.dtype == np.uint8:
+                raise ValueError(
+                    "packed mask bytes require mask_total_bits (the byte "
+                    "count is 8x short and would truncate the mask)"
+                )
             mask_total_bits = int(mask_flat.size)
         # the per-unit re-pack touches every mask bit of every tile — the
         # hottest host-plan loop; the C++ planner does it with two shifts
