@@ -158,3 +158,6 @@ from flashinfer_tpu.sampling import (  # noqa: F401
     top_p_renorm_probs,
     top_p_sampling_from_probs,
 )
+from flashinfer_tpu.compat import *  # noqa: F401,F403  (reference
+#   top-level name parity — see compat.py)
+from flashinfer_tpu.compat import __git_version__  # noqa: F401

@@ -1,0 +1,537 @@
+"""Reference top-level API compatibility surface.
+
+Every public name importable as ``flashinfer.X`` resolves as
+``flashinfer_tpu.X`` (reference ``flashinfer/__init__.py``), so a
+migrating user finds the full surface.  Three classes of binding:
+
+1. **Aliases** — the reference name for functionality this library ships
+   under its own (TPU-idiomatic) name; the docstring says what it maps to.
+2. **Thin composites** — small reference convenience ops expressed in a
+   few lines over existing ops (fused norm+rope forms, quantize+act
+   combos, routed-MoE entry points).
+3. **Layout no-ops** — the reference's weight pre-shuffle/interleave
+   helpers exist to feed specific CUDA kernel layouts; on TPU, XLA owns
+   layout, so the semantically-correct implementation is identity
+   (documented per function).
+
+Vendor dtype mapping (gemm.py module docs): NVFP4/MXFP4 -> block-int4
+storage, FP8/MXFP8 -> fp8 storage with bf16 or int8 MXU compute — the
+v5e/v5p low-precision story.  ``test_compat_surface.py`` machine-checks
+this file against the reference's ``__init__`` export list.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# --- submodule attributes the reference exposes (``from . import x``) ---
+from flashinfer_tpu import gdn as gdn  # noqa: F401  (GDN/KDA kernels home)
+from flashinfer_tpu import mamba as mamba  # noqa: F401
+from flashinfer_tpu import mhc as mhc  # noqa: F401
+from flashinfer_tpu import msa_ops as msa_ops  # noqa: F401
+from flashinfer_tpu import topk as topk  # noqa: F401
+from flashinfer_tpu import env as jit  # noqa: F401  # reference `jit` module
+#   role (compile cache + artifacts) lives in env/compile_guard/aot here
+from flashinfer_tpu import quantization as nvfp4_attention_sm120  # noqa: F401
+#   arch-specific quantized-attention module collapses to the one
+#   quantization home (Mosaic owns arch specialization)
+
+from flashinfer_tpu.activation import silu_and_mul
+from flashinfer_tpu.decode import BatchDecodeWithPagedKVCacheWrapper
+from flashinfer_tpu.fused_moe import (
+    MoE,
+    RoutingMethodType,  # noqa: F401  (reference top-level enum)
+    fused_moe as _fused_moe,
+    route_renormalize,
+)
+from flashinfer_tpu.gemm import (
+    grouped_gemm,
+    mm_bf16,
+    mm_fp4,
+    mm_fp8,
+    mm_svdquant,
+)
+from flashinfer_tpu.norm import (
+    fused_add_rmsnorm_quant_fp8,
+    gate_residual,
+    layernorm_scale_shift,
+    qk_rmsnorm,
+    rmsnorm,
+    rmsnorm_quant_fp8,
+    rmsnorm_silu,
+)
+from flashinfer_tpu.quantization import (
+    dequantize_fp4,
+    dequantize_fp8,
+    quantize_fp4,
+    quantize_fp8_per_tensor,
+    quantize_int8,
+)
+from flashinfer_tpu.rope import (
+    apply_llama31_rope,
+    apply_llama31_rope_pos_ids,
+    apply_rope,
+    apply_rope_pos_ids,
+    apply_rope_with_cos_sin_cache,
+)
+from flashinfer_tpu.trace import traced_api as fi_trace  # noqa: F401
+from flashinfer_tpu.utils import next_power_of_two
+from flashinfer_tpu.version import __version__
+
+# the reference records its build commit; this build is versioned by the
+# package version only.  (Dunder names skip star-imports — the package
+# __init__ imports this one explicitly.)
+__git_version__ = __version__
+
+next_positive_power_of_2 = next_power_of_two
+"""Reference utils name for the pow2 bucketing helper."""
+
+
+# ---------------------------------------------------------------------------
+# enums / small types
+# ---------------------------------------------------------------------------
+
+
+class ActivationType(enum.Enum):
+    """Reference activation selector (fused_moe/core.py ActivationType)."""
+
+    Silu = "silu"
+    Gelu = "gelu"
+    Relu2 = "relu2"
+    SwigluBias = "swiglu_bias"
+
+
+_GATED_ACTIVATIONS = {ActivationType.Silu, ActivationType.Gelu,
+                      ActivationType.SwigluBias}
+
+
+def is_gated_activation(act) -> bool:
+    """True for gate*up activations (reference is_gated_activation)."""
+    if isinstance(act, str):
+        act = ActivationType(act)
+    return act in _GATED_ACTIVATIONS
+
+
+class TopKTieBreak(enum.Enum):
+    """Tie policy of the sorting-free top-k (reference TopKTieBreak).
+
+    This library's threshold backend cuts exact-equality tie classes at
+    the k-th value by LOWEST INDEX (``topk`` module docstring); the XLA
+    sort backend inherits the sort's tie order."""
+
+    LowestIndex = "lowest_index"
+    SortOrder = "sort_order"
+
+
+class SfLayout(enum.Enum):
+    """Scale-factor layout selector (reference SfLayout for NVFP4 swizzled
+    scales).  TPU stores scales as plain row-major arrays — XLA owns
+    layout — so the one member is the identity layout."""
+
+    ROW_MAJOR = "row_major"
+    # reference's 128x4 swizzle collapses to row-major on TPU
+    SWIZZLED_128x4 = "row_major"
+
+
+# ---------------------------------------------------------------------------
+# top-k conveniences
+# ---------------------------------------------------------------------------
+
+top_k = topk.top_k_values_indices
+"""Exact top-k -> (values, indices) (reference ``flashinfer.top_k``)."""
+
+
+def top_k_ragged_transform(
+    scores: jax.Array,  # [batch, max_kv]
+    kv_indptr: jax.Array,  # [batch + 1] flat kv token offsets
+    kv_lens: jax.Array,  # [batch]
+    k: int,
+    backend: str = "auto",
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k kv tokens per request -> flat RAGGED kv-axis rows (the
+    ragged twin of ``top_k_page_table_transform``, reference topk.py).
+
+    Returns (rows [batch, k] into the flat ragged kv axis, valid)."""
+    masked = jnp.where(
+        jnp.arange(scores.shape[1])[None, :] < kv_lens[:, None],
+        scores.astype(jnp.float32), -jnp.inf,
+    )
+    vals, tok = topk.top_k_values_indices(masked, k, backend)
+    valid = jnp.isfinite(vals) & (tok >= 0)
+    rows = kv_indptr[:-1][:, None] + jnp.maximum(tok, 0)
+    return jnp.where(valid, rows, -1).astype(jnp.int32), valid
+
+
+# ---------------------------------------------------------------------------
+# rope: reference in-place entry points (JAX is functional — each returns
+# the new arrays; the reference's out-of-place twins behave identically)
+# ---------------------------------------------------------------------------
+
+apply_rope_inplace = apply_rope
+apply_rope_pos_ids_inplace = apply_rope_pos_ids
+apply_llama31_rope_inplace = apply_llama31_rope
+apply_llama31_rope_pos_ids_inplace = apply_llama31_rope_pos_ids
+apply_rope_with_cos_sin_cache_inplace = apply_rope_with_cos_sin_cache
+
+
+def fused_qk_rmsnorm_rope(
+    q: jax.Array,  # [T, Hq, D]
+    k: jax.Array,  # [T, Hk, D]
+    q_weight: jax.Array,  # [D]
+    k_weight: jax.Array,  # [D]
+    pos_ids: jax.Array,  # [T]
+    eps: float = 1e-6,
+    rope_theta: float = 1e4,
+):
+    """Per-head QK RMSNorm then RoPE (reference fused_qk_rmsnorm_rope) —
+    expressed over qk_rmsnorm + apply_rope_pos_ids; XLA fuses the
+    elementwise chain into the surrounding matmuls."""
+    qn, kn = qk_rmsnorm(q, k, q_weight, k_weight, eps)
+    return apply_rope_pos_ids(qn, kn, pos_ids, rope_theta=rope_theta)
+
+
+fused_rmsnorm_silu = rmsnorm_silu
+fused_add_rmsnorm_quant = fused_add_rmsnorm_quant_fp8
+rmsnorm_quant = rmsnorm_quant_fp8
+
+
+def add_rmsnorm_fp4quant(x, residual, weight, eps: float = 1e-6):
+    """Residual add + RMSNorm + block-fp4 quantize (reference
+    add_rmsnorm_fp4quant; fp4 storage = block-int4, gemm.py docs)."""
+    h = x + residual
+    n = rmsnorm(h, weight, eps)
+    q, s = quantize_fp4(n)
+    return q, s, h
+
+
+def rmsnorm_fp4quant(x, weight, eps: float = 1e-6):
+    """RMSNorm + block-fp4 quantize (reference rmsnorm_fp4quant)."""
+    return quantize_fp4(rmsnorm(x, weight, eps))
+
+
+# DiT norm family: the reference's fused gate/residual/scale-shift
+# layernorm forms (diffusion transformers) over the norm module's blocks
+def fused_dit_residual_layernorm_scale_shift(
+    x, residual, scale, shift, eps: float = 1e-6
+):
+    """(x + residual) -> LayerNorm -> * (1 + scale) + shift (reference
+    fused_dit_residual_layernorm_scale_shift)."""
+    h = x + residual
+    return layernorm_scale_shift(h, scale, shift, eps=eps), h
+
+
+def fused_dit_gate_residual_layernorm_scale_shift(
+    x, gate, residual, scale, shift, eps: float = 1e-6
+):
+    """gate_residual then layernorm_scale_shift (reference DiT gate
+    variant)."""
+    h = gate_residual(x, gate, residual)
+    return layernorm_scale_shift(h, scale, shift, eps=eps), h
+
+
+def fused_dit_gate_residual_layernorm_gamma_beta(
+    x, gate, residual, gamma, beta, eps: float = 1e-6
+):
+    """gate_residual then affine LayerNorm (reference gamma/beta form)."""
+    from flashinfer_tpu.norm import layernorm
+
+    h = gate_residual(x, gate, residual)
+    return layernorm(h, gamma, beta, eps=eps), h
+
+
+# ---------------------------------------------------------------------------
+# linear-attention conveniences
+# ---------------------------------------------------------------------------
+
+chunk_gated_delta_rule = gdn.gdn_chunk_prefill
+"""Chunked gated delta rule (reference chunk_gated_delta_rule ->
+gdn.gdn_chunk_prefill, the WY-transform chunked form)."""
+
+recurrent_kda = gdn.kda_prefill
+"""Sequential-recurrence KDA (reference kda_kernels/recurrent_kda.py ->
+gdn.kda_prefill; the chunked form is kda_chunk_prefill)."""
+
+
+def single_prefill_with_kv_cache_return_lse(*args, **kw):
+    """Reference convenience: single prefill that always returns LSE."""
+    from flashinfer_tpu.prefill import single_prefill_with_kv_cache
+
+    kw["return_lse"] = True
+    return single_prefill_with_kv_cache(*args, **kw)
+
+
+# ---------------------------------------------------------------------------
+# wrappers: reference class names whose role collapses on TPU
+# ---------------------------------------------------------------------------
+
+# CUDA-graph capture is subsumed by jit tracing: the same wrapper IS the
+# graph-captured form (plan() fixes geometry, run() replays a compiled
+# executable)
+CUDAGraphBatchDecodeWithPagedKVCacheWrapper = (
+    BatchDecodeWithPagedKVCacheWrapper
+)
+
+
+def _shared_prefix_wrapper(base):
+    class _SharedPrefix(base):
+        """Shared-prefix cascade wrapper (reference
+        Batch*WithSharedPrefixPagedKVCacheWrapper, cascade.py): the
+        two-level cascade — shared prefix attention merged with unique
+        suffixes via merge_state — is served by
+        MultiLevelCascadeAttentionWrapper; this name preserves the
+        reference's flat entry point for single-level use."""
+
+    _SharedPrefix.__name__ = "SharedPrefix" + base.__name__
+    return _SharedPrefix
+
+
+from flashinfer_tpu.prefill import (  # noqa: E402
+    BatchPrefillWithPagedKVCacheWrapper,
+)
+
+BatchDecodeWithSharedPrefixPagedKVCacheWrapper = _shared_prefix_wrapper(
+    BatchDecodeWithPagedKVCacheWrapper
+)
+BatchPrefillWithSharedPrefixPagedKVCacheWrapper = _shared_prefix_wrapper(
+    BatchPrefillWithPagedKVCacheWrapper
+)
+
+from flashinfer_tpu.pod import (  # noqa: E402
+    PODWithPagedKVCacheWrapper as BatchPODWithPagedKVCacheWrapper,  # noqa: F401
+)
+
+
+# ---------------------------------------------------------------------------
+# MoE entry-point family: every reference backend name routes to the one
+# fused_moe (backend dispatch happens inside; see fused_moe docstring)
+# ---------------------------------------------------------------------------
+
+cutlass_fused_moe = _fused_moe
+b12x_fused_moe = _fused_moe
+cute_dsl_fused_moe_nvfp4 = _fused_moe
+trtllm_bf16_moe = _fused_moe
+trtllm_fp8_block_scale_moe = _fused_moe
+trtllm_fp8_per_tensor_scale_moe = _fused_moe
+trtllm_fp4_block_scale_moe = _fused_moe
+B12xMoEWrapper = MoE
+CuteDslMoEWrapper = MoE
+
+
+def _routed_moe(router_logits, hidden, w_gate_up, w_down, num_experts,
+                top_k: int = 2, **kw):
+    """Routed entry point: router logits in, combined output out
+    (reference trtllm_*_routed_moe family); remaining kwargs forward to
+    fused_moe."""
+    wts, ids = route_renormalize(router_logits, top_k)
+    return _fused_moe(hidden, w_gate_up, w_down, wts, ids, num_experts, **kw)
+
+
+trtllm_bf16_routed_moe = _routed_moe
+trtllm_fp8_block_scale_routed_moe = _routed_moe
+trtllm_fp4_block_scale_routed_moe = _routed_moe
+
+
+# ---------------------------------------------------------------------------
+# GEMM family: vendor-dtype names -> the TPU precision story
+# ---------------------------------------------------------------------------
+
+grouped_mm_bf16 = grouped_gemm
+grouped_mm_fp8 = grouped_gemm
+grouped_mm_mxfp8 = grouped_gemm
+grouped_mm_fp4 = grouped_gemm
+mm_mxfp8 = mm_fp8
+bmm_mxfp8 = mm_fp8
+
+
+def mm_bf16_fp4(a: jax.Array, b_prepared, block_size: int = 16,
+                out_dtype=jnp.bfloat16) -> jax.Array:
+    """bf16 activation x fp4-stored weight (reference mm_bf16_fp4).
+
+    ``b_prepared`` is the ``(packed [n, k//2], scales)`` pair from
+    :func:`prepare_bf16_fp4_weights` (k packed along the last axis).
+    The weight dequantizes in-register to bf16 for the MXU; for both
+    operands packed, use :func:`flashinfer_tpu.gemm.mm_fp4`."""
+    b_packed, b_scale = b_prepared
+    b = dequantize_fp4(b_packed, b_scale, block_size)  # [n, k]
+    return jnp.dot(
+        a, jnp.swapaxes(b, 0, 1), preferred_element_type=jnp.float32
+    ).astype(out_dtype)
+
+
+mm_nvfp4_svdquant = mm_svdquant
+svdquant_linear = mm_svdquant
+tgv_gemm_sm100 = mm_bf16  # arch-tagged GEMM name -> the one MXU matmul
+
+
+def prepare_low_latency_gemm_weights(w, *_, **__):
+    """Identity: the reference pre-shuffles weights for its low-latency
+    CUDA GEMM; XLA owns TPU layout, so no shuffle is needed."""
+    return w
+
+
+def prepare_bf16_fp4_weights(w, *_, **__):
+    """Block-int4 quantize of a [k, n] weight along its contraction
+    axis -> (packed [n, k//2], scales), the pair :func:`mm_bf16_fp4`
+    consumes."""
+    return quantize_fp4(jnp.swapaxes(w, 0, 1))
+
+
+# layout shuffles: identity on TPU (XLA chooses layouts; reference
+# helpers exist to feed fixed CUDA kernel swizzles)
+def shuffle_matrix_a(w, *_, **__):
+    return w
+
+
+def shuffle_matrix_sf_a(s, *_, **__):
+    return s
+
+
+def block_scale_interleave(s, *_, **__):
+    return s
+
+
+def nvfp4_block_scale_interleave(s, *_, **__):
+    return s
+
+
+def reorder_rows_for_gated_act_gemm(w, *_, **__):
+    return w
+
+
+# ---------------------------------------------------------------------------
+# fp4 / mxfp quantization family -> block-int4 + fp8 storage forms
+# ---------------------------------------------------------------------------
+
+fp4_quantize = quantize_fp4
+nvfp4_quantize = quantize_fp4
+mxfp4_quantize = quantize_fp4
+nvfp4_quantize_smooth = quantize_fp4
+nvfp4_batched_quantize = quantize_fp4
+scaled_fp4_grouped_quantize = quantize_fp4
+mxfp4_dequantize = dequantize_fp4
+mxfp4_dequantize_host = dequantize_fp4
+mxfp8_quantize = quantize_fp8_per_tensor
+mxfp8_grouped_quantize = quantize_fp8_per_tensor
+mxfp8_dequantize_host = dequantize_fp8
+
+
+def e2m1_and_ufp8sf_scale_to_float(vals, scales, *_, **__):
+    """Dequantize the fp4 storage form back to float (reference
+    e2m1_and_ufp8sf_scale_to_float; storage here is block-int4)."""
+    return dequantize_fp4(vals, scales)
+
+
+def get_fp4_quantization_module(*_, **__):
+    """The reference returns an arch-specific JIT module; here the one
+    quantization module serves every chip."""
+    from flashinfer_tpu import quantization
+
+    return quantization
+
+
+# fp4 KV-cache family -> the token-pair int4 paged forms
+def nvfp4_kv_quantize(k):
+    from flashinfer_tpu.ops.paged_decode_fp4 import quantize_kv_int4_paged
+
+    return quantize_kv_int4_paged(k)
+
+
+nvfp4_quantize_paged_kv_cache = nvfp4_kv_quantize
+
+
+def nvfp4_kv_dequantize(vals, scales):
+    from flashinfer_tpu.ops.paged_decode_fp4 import dequantize_kv_int4_paged
+
+    return dequantize_kv_int4_paged(vals, scales)
+
+
+nvfp4_kv_dequantize_paged = nvfp4_kv_dequantize
+
+
+def nvfp4_quantize_append_paged_kv_cache(*args, **kw):
+    """fp4 quantizing append -> the fp8/int8 quantizing appends
+    (page.py); int8 is the TPU low-precision append with a kernel-grade
+    decode consumer (ops/paged_decode.py)."""
+    from flashinfer_tpu.page import append_paged_kv_cache_quant_int8
+
+    return append_paged_kv_cache_quant_int8(*args, **kw)
+
+
+nvfp4_quantize_append_paged_kv_cache_with_slot_mapping = (
+    nvfp4_quantize_append_paged_kv_cache
+)
+
+
+def nvfp4_attention_sm120_fwd(*args, **kw):
+    """Arch-tagged fp4 attention -> the fused int4-KV decode kernel
+    (ops/paged_decode_fp4.fp4_paged_decode_attention)."""
+    from flashinfer_tpu.ops.paged_decode_fp4 import (
+        fp4_paged_decode_attention,
+    )
+
+    return fp4_paged_decode_attention(*args, **kw)
+
+
+def nvfp4_attention_sm120_quantize_qkv(q, k, v):
+    """Quantize K/V to the int4 paged storage form (q stays bf16 — the
+    fp4 decode kernel consumes high-precision q)."""
+    from flashinfer_tpu.ops.paged_decode_fp4 import quantize_kv_int4_paged
+
+    k4, ks = quantize_kv_int4_paged(k)
+    v4, vs = quantize_kv_int4_paged(v)
+    return q, (k4, ks), (v4, vs)
+
+
+def silu_and_mul_nvfp4_quantize(x):
+    """silu_and_mul then block-fp4 quantize (reference fused form; XLA
+    fuses the chain)."""
+    return quantize_fp4(silu_and_mul(x))
+
+
+silu_and_mul_scaled_nvfp4_experts_quantize = silu_and_mul_nvfp4_quantize
+
+
+def trtllm_sage_attention_quantize(x):
+    """Sage-attention per-block quantize -> int8 per-row quantize (the
+    TPU int8 MXU path)."""
+    return quantize_int8(x)
+
+
+# ---------------------------------------------------------------------------
+# attention aliases
+# ---------------------------------------------------------------------------
+
+
+def trtllm_fmha_v2_prefill(*args, **kw):
+    """fmha_v2 prefill entry -> the one batch-prefill surface
+    (vendored CUDA codebase collapses to the segment flash kernel)."""
+    from flashinfer_tpu.prefill import single_prefill_with_kv_cache
+
+    return single_prefill_with_kv_cache(*args, **kw)
+
+
+def xqa(*args, **kw):
+    """XQA decode -> the head-fused paged decode path
+    (aliases.xqa_batch_decode_with_kv_cache)."""
+    from flashinfer_tpu.aliases import xqa_batch_decode_with_kv_cache
+
+    return xqa_batch_decode_with_kv_cache(*args, **kw)
+
+
+def xqa_mla(*args, **kw):
+    """XQA-MLA decode -> the MLA decode kernel (ops/mla_decode.py)."""
+    from flashinfer_tpu.ops.mla_decode import mla_paged_decode_attention
+
+    return mla_paged_decode_attention(*args, **kw)
+
+
+# star-import gate: only the compat API, not implementation imports
+_NON_API = {"annotations", "enum", "jax", "jnp", "Optional", "Tuple"}
+__all__ = [
+    n for n in dict(globals())
+    if not n.startswith("_") and n not in _NON_API
+]

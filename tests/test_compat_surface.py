@@ -1,0 +1,120 @@
+"""Machine check: every public name in the reference's package
+``__init__`` resolves on flashinfer_tpu (compat.py), so a migrating user
+finds the complete ``flashinfer.*`` surface."""
+
+import os
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flashinfer_tpu as fi
+
+_REF_INIT = Path(
+    os.environ.get(
+        "FLASHINFER_REF_INIT", "/root/reference/flashinfer/__init__.py"
+    )
+)
+
+# names whose reference role was explicitly dropped with rationale
+# (VERDICT/PARITY: vendored GPU fabric / ctx-partitioning machinery)
+_DROPPED = set()
+
+
+def _reference_names():
+    src = _REF_INIT.read_text()
+    names = set()
+    for m in re.finditer(r"from \.[\w.]+ import \(([^)]*)\)", src, re.S):
+        body = "\n".join(
+            line.split("#", 1)[0] for line in m.group(1).splitlines()
+        )
+        for tok in body.split(","):
+            tok = tok.strip().split(" as ")[-1].strip()
+            if tok:
+                names.add(tok)
+    for m in re.finditer(r"from \.[\w.]+ import ([\w, ]+)$", src, re.M):
+        for tok in m.group(1).split(","):
+            tok = tok.strip().split(" as ")[-1].strip()
+            if tok:
+                names.add(tok)
+    for m in re.finditer(r"^from \. import ([\w, ]+(?: as [\w]+)?[\w, ]*)$",
+                         src, re.M):
+        for tok in m.group(1).split(","):
+            tok = tok.strip().split(" as ")[-1].strip()
+            if tok:
+                names.add(tok)
+    return names
+
+
+@pytest.mark.skipif(
+    not _REF_INIT.exists(),
+    reason="reference checkout unavailable (set FLASHINFER_REF_INIT); "
+    "name-parity is NOT being checked on this machine",
+)
+def test_every_reference_top_level_name_resolves():
+    missing = sorted(
+        n for n in _reference_names()
+        if n not in _DROPPED and not hasattr(fi, n)
+    )
+    assert not missing, f"reference flashinfer.* names unresolved: {missing}"
+
+
+def test_compat_composites_behave():
+    """Spot-check the thin composites (not just name presence)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 128)), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+
+    # rmsnorm_fp4quant round-trips through the block-int4 storage form
+    q, s = fi.rmsnorm_fp4quant(x, w)
+    back = np.asarray(fi.e2m1_and_ufp8sf_scale_to_float(q, s))
+    ref = np.asarray(fi.rmsnorm(x, w))
+    # int4 block storage: |err| <= block_amax / 14 (+ slack); near-zero
+    # entries land in the zero bucket so relative error is meaningless
+    assert np.abs(back - ref).max() <= np.abs(ref).max() / 14 + 0.1
+
+    # layout shuffles are identity on TPU
+    assert fi.shuffle_matrix_a(x) is x
+    assert fi.reorder_rows_for_gated_act_gemm(x) is x
+
+    # routed MoE entry == route + fused_moe
+    T, E, K, h, inter = 8, 4, 2, 128, 128
+    hid = jnp.asarray(rng.standard_normal((T, h)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, h, 2 * inter)) * 0.05)
+    w2 = jnp.asarray(rng.standard_normal((E, inter, h)) * 0.05)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    from flashinfer_tpu.fused_moe import fused_moe, route_renormalize
+
+    out = fi.trtllm_bf16_routed_moe(logits, hid, w1, w2, E, top_k=K)
+    wts, ids = route_renormalize(logits, K)
+    ref2 = fused_moe(hid, w1, w2, wts, ids, E)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref2), rtol=2e-3, atol=2e-3
+    )
+
+    # top_k alias + ragged transform
+    vals, idx = fi.top_k(x, 8)
+    assert idx.shape == (16, 8)
+    rows, valid = fi.top_k_ragged_transform(
+        x, jnp.arange(0, 17 * 128, 128, dtype=jnp.int32)[:17],
+        jnp.full((16,), 128, jnp.int32), 8,
+    )
+    assert rows.shape == (16, 8) and bool(valid.all())
+
+    # fused qk norm+rope runs and matches the two-step form
+    q3 = jnp.asarray(rng.standard_normal((8, 4, 64)), jnp.float32)
+    k3 = jnp.asarray(rng.standard_normal((8, 2, 64)), jnp.float32)
+    qw = jnp.ones((64,)); kw = jnp.ones((64,))
+    pos = jnp.arange(8, dtype=jnp.int32)
+    qa, ka = fi.fused_qk_rmsnorm_rope(q3, k3, qw, kw, pos)
+    qn, kn = fi.qk_rmsnorm(q3, k3, qw, kw, 1e-6)
+    qb, kb = fi.apply_rope_pos_ids(qn, kn, pos, rope_theta=1e4)
+    np.testing.assert_allclose(np.asarray(qa), np.asarray(qb), rtol=1e-5)
+
+    # activation enum helper
+    assert fi.is_gated_activation("silu")
+    assert fi.is_gated_activation(fi.ActivationType.Gelu)
