@@ -529,6 +529,53 @@ def xqa_mla(*args, **kw):
     return mla_paged_decode_attention(*args, **kw)
 
 
+# ---------------------------------------------------------------------------
+# reference submodule JIT-module accessors: the reference's get_*_module
+# functions build/load per-arch CUDA modules; here each returns the one
+# module serving every chip (XLA/Mosaic own arch specialization)
+# ---------------------------------------------------------------------------
+
+
+def get_sampling_module(*_, **__):
+    from flashinfer_tpu import sampling
+
+    return sampling
+
+
+def get_page_module(*_, **__):
+    from flashinfer_tpu import page
+
+    return page
+
+
+def get_cascade_module(*_, **__):
+    from flashinfer_tpu import cascade
+
+    return cascade
+
+
+def get_rope_module(*_, **__):
+    from flashinfer_tpu import rope
+
+    return rope
+
+
+def get_act_and_mul_module(*_, **__):
+    from flashinfer_tpu import activation
+
+    return activation
+
+
+def get_seed_and_offset(key=None):
+    """Reference helper extracting (seed, offset) from a CUDA generator
+    for its Philox sampling kernels.  JAX keys are explicit: pass a
+    ``jax.random.PRNGKey`` and get its raw (seed_word, offset_word)."""
+    if key is None:
+        return 0, 0
+    data = jax.random.key_data(key).reshape(-1)
+    return int(data[0]), int(data[-1])
+
+
 # star-import gate: only the compat API, not implementation imports
 _NON_API = {"annotations", "enum", "jax", "jnp", "Optional", "Tuple"}
 __all__ = [

@@ -222,3 +222,119 @@ def generate_cos_sin_cache(
     freqs = _rope_freqs(rotary_dim, rope_theta, rope_scale)
     angles = jnp.arange(max_position, dtype=jnp.float32)[:, None] * freqs[None, :]
     return jnp.concatenate([jnp.cos(angles), jnp.sin(angles)], axis=-1).astype(dtype)
+
+
+def _rope_2d_guard(x):
+    """MLA tensors may arrive 2-D [T, dim] (no head axis, reference
+    rope.py:1286 layout); lift to 3-D and remember to squeeze back."""
+    if x is None:
+        return None, False
+    if x.ndim == 2:
+        return x[:, None, :], True
+    return x, False
+
+
+def _fp8_static(x, scale, dtype=jnp.float8_e4m3fn):
+    """Static-scale fp8 cast (reference quant_scale semantics:
+    fp8_value = high_precision * scale, saturating)."""
+    finfo = jnp.finfo(dtype)
+    return jnp.clip(
+        x.astype(jnp.float32) * scale, float(finfo.min), float(finfo.max)
+    ).astype(dtype)
+
+
+@flashinfer_api
+def rope_quantize_fp8(
+    q_rope: jax.Array,  # [T, Hq, rotary_dim] (or [T, rotary_dim] MLA form)
+    k_rope: jax.Array,  # [T, Hk, rotary_dim] / [T, rotary_dim]
+    q_nope: Optional[jax.Array],  # [T, Hq, d_nope] / [T, d_nope]
+    k_nope: Optional[jax.Array],
+    cos_sin_cache: jax.Array,
+    pos_ids: jax.Array,
+    is_neox: bool = True,
+    quant_scale_q: float = 1.0,
+    quant_scale_kv: float = 1.0,
+):
+    """RoPE the rotary halves, fp8-quantize rotary and nope parts with
+    static scales (reference ``rope_quantize_fp8``, flashinfer/rope.py:1364
+    — the pre-attention quantized-QK path).
+
+    Matches the reference contract: ``is_neox=True`` is the split-half
+    (non-interleaved) rotation; returns the 4-tuple
+    ``(q_rope_fp8, k_rope_fp8, q_nope_fp8, k_nope_fp8)`` (``None``
+    entries pass through as ``None``) so MLA callers can route kpe/ckv to
+    their separate caches; dequantize with ``1/scale``.  2-D MLA-layout
+    tensors (no head axis) are accepted."""
+    (qr3, q2d), (kr3, k2d) = _rope_2d_guard(q_rope), _rope_2d_guard(k_rope)
+    qo, ko = apply_rope_with_cos_sin_cache(
+        qr3, kr3, cos_sin_cache, pos_ids, interleave=not is_neox
+    )
+    if q2d:
+        qo = qo[:, 0]
+    if k2d:
+        ko = ko[:, 0]
+    return (
+        _fp8_static(qo, quant_scale_q),
+        _fp8_static(ko, quant_scale_kv),
+        None if q_nope is None else _fp8_static(q_nope, quant_scale_q),
+        None if k_nope is None else _fp8_static(k_nope, quant_scale_kv),
+    )
+
+
+@flashinfer_api
+def mla_rope_quantize_fp8(q_rope, k_rope, q_nope, k_nope, cos_sin_cache,
+                          pos_ids, is_neox: bool = True,
+                          quant_scale_q: float = 1.0,
+                          quant_scale_kv: float = 1.0):
+    """MLA variant of :func:`rope_quantize_fp8` (reference rope.py:1286):
+    the same op over the MLA split — 2-D ``k_rope`` (kpe, shared across
+    heads) and ``k_nope`` (ckv) are the expected layout."""
+    return rope_quantize_fp8(
+        q_rope, k_rope, q_nope, k_nope, cos_sin_cache, pos_ids,
+        is_neox=is_neox, quant_scale_q=quant_scale_q,
+        quant_scale_kv=quant_scale_kv,
+    )
+
+
+@flashinfer_api
+def rope_quantize_fp8_append_paged_kv_cache(
+    q_rope, k_rope, q_nope, k_nope, v,
+    cos_sin_cache, pos_ids,
+    paged_kv_cache, kv_indices, kv_indptr,
+    batch_indices, positions,
+    kv_layout: str = "NHD",
+    is_neox: bool = True,
+    quant_scale_q: float = 1.0,
+    quant_scale_kv: float = 1.0,
+):
+    """RoPE + fp8 quantize + quantizing paged append in one call
+    (reference rope.py:1504, GQA/MHA form).  Returns
+    ``(q_fp8 [T, Hq, rd(+dn)], (k_cache, v_cache))`` with the caches
+    updated (functional JAX: new arrays; in-place under jit donation).
+
+    MLA (``v is None``) is not fused here: MLA appends target the split
+    ckv/kpe caches — use :func:`mla_rope_quantize_fp8` +
+    ``page.append_paged_mla_kv_cache``."""
+    if v is None:
+        raise NotImplementedError(
+            "MLA form (v=None): use mla_rope_quantize_fp8 + "
+            "page.append_paged_mla_kv_cache (split ckv/kpe caches)"
+        )
+    from flashinfer_tpu.page import append_paged_kv_cache_quant_fp8
+
+    qr, kr = apply_rope_with_cos_sin_cache(
+        q_rope, k_rope, cos_sin_cache, pos_ids, interleave=not is_neox
+    )
+    q_hp = qr if q_nope is None else jnp.concatenate([qr, q_nope], -1)
+    k_hp = kr if k_nope is None else jnp.concatenate([kr, k_nope], -1)
+    qq = _fp8_static(q_hp, quant_scale_q)
+    # the quantizing append owns the k/v fp8 conversion (scale semantics:
+    # high_precision = fp8 * scale, so the append scale is 1/quant_scale)
+    caches = append_paged_kv_cache_quant_fp8(
+        k_hp, v, batch_indices, positions, paged_kv_cache,
+        kv_indices, kv_indptr,
+        jnp.float32(1.0 / max(quant_scale_kv, 1e-12)),
+        jnp.float32(1.0 / max(quant_scale_kv, 1e-12)),
+        kv_layout,
+    )
+    return qq, caches

@@ -118,3 +118,77 @@ def test_compat_composites_behave():
     # activation enum helper
     assert fi.is_gated_activation("silu")
     assert fi.is_gated_activation(fi.ActivationType.Gelu)
+
+
+def test_submodule_level_parity_and_rope_fusions():
+    """Submodule getters resolve + the rope+fp8 fusion family behaves."""
+    import flashinfer_tpu.rope as rope_mod
+    import flashinfer_tpu.sampling as sampling_mod
+
+    assert fi.get_sampling_module() is sampling_mod
+    assert fi.get_rope_module() is rope_mod
+    seed, off = fi.get_seed_and_offset(jax.random.PRNGKey(7))
+    assert isinstance(seed, int) and isinstance(off, int)
+
+    rng = np.random.default_rng(0)
+    T, Hq, Hk, rd, dn = 8, 4, 2, 32, 16
+    qr = jnp.asarray(rng.standard_normal((T, Hq, rd)), jnp.float32)
+    kr = jnp.asarray(rng.standard_normal((T, Hk, rd)), jnp.float32)
+    qn = jnp.asarray(rng.standard_normal((T, Hq, dn)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((T, Hk, dn)), jnp.float32)
+    cache = fi.generate_cos_sin_cache(64, rd)
+    pos = jnp.arange(T, dtype=jnp.int32)
+    # reference 4-tuple contract; is_neox=True == split-half rotation
+    qf, kf, qnf, knf = rope_mod.rope_quantize_fp8(
+        qr, kr, qn, kn, cache, pos, is_neox=True,
+        quant_scale_q=4.0, quant_scale_kv=2.0,
+    )
+    assert qf.dtype == jnp.float8_e4m3fn and qf.shape == (T, Hq, rd)
+    qrr, krr = fi.apply_rope_with_cos_sin_cache(
+        qr, kr, cache, pos, interleave=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(qf, np.float32) / 4.0, np.asarray(qrr),
+        rtol=0.1, atol=0.1,
+    )
+    np.testing.assert_allclose(  # k path with its own scale
+        np.asarray(kf, np.float32) / 2.0, np.asarray(krr),
+        rtol=0.1, atol=0.1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(knf, np.float32) / 2.0, np.asarray(kn),
+        rtol=0.1, atol=0.1,
+    )
+
+    # MLA 2-D layout (kpe shared across heads, no head axis)
+    k2 = jnp.asarray(rng.standard_normal((T, rd)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((T, 64)), jnp.float32)
+    qf2, kf2, _, ckf = rope_mod.mla_rope_quantize_fp8(
+        qr, k2, None, ck, cache, pos, quant_scale_kv=2.0
+    )
+    assert kf2.shape == (T, rd) and ckf.shape == (T, 64)
+
+    # append fusion: GQA path round-trips through the fp8 cache; MLA
+    # (v=None) raises the documented pointer
+    PS, pages = 8, 2
+    kc = jnp.zeros((pages, PS, Hk, rd + dn), jnp.float8_e4m3fn)
+    vc = jnp.zeros((pages, PS, Hk, rd + dn), jnp.float8_e4m3fn)
+    vv = jnp.asarray(rng.standard_normal((T, Hk, rd + dn)), jnp.float32)
+    bi = jnp.zeros((T,), jnp.int32)
+    tp = jnp.arange(T, dtype=jnp.int32)
+    qq, (kc2, vc2) = rope_mod.rope_quantize_fp8_append_paged_kv_cache(
+        qr, kr, qn, kn, vv, cache, pos, (kc, vc),
+        jnp.arange(pages, dtype=jnp.int32), jnp.array([0, pages]),
+        bi, tp, quant_scale_kv=2.0,
+    )
+    k_hp = np.concatenate([np.asarray(krr), np.asarray(kn)], -1)
+    np.testing.assert_allclose(
+        np.asarray(kc2[0, :T], np.float32)[..., :rd] / 2.0,
+        k_hp[..., :rd], rtol=0.15, atol=0.15,
+    )
+    with pytest.raises(NotImplementedError):
+        rope_mod.rope_quantize_fp8_append_paged_kv_cache(
+            qr, k2, None, ck, None, cache, pos, (kc, vc),
+            jnp.arange(pages, dtype=jnp.int32), jnp.array([0, pages]),
+            bi, tp,
+        )
