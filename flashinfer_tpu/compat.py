@@ -576,6 +576,175 @@ def get_seed_and_offset(key=None):
     return int(data[0]), int(data[-1])
 
 
+
+
+
+# ---------------------------------------------------------------------------
+# decode/prefill submodule surface: JIT-module getters + varlen/deepseek
+# entry points (reference decode.py / prefill.py)
+# ---------------------------------------------------------------------------
+
+
+def _self_module(name):
+    def getter(*_, **__):
+        import importlib
+
+        return importlib.import_module(f"flashinfer_tpu.{name}")
+
+    getter.__doc__ = (
+        f"Reference per-arch JIT-module getter; the one flashinfer_tpu."
+        f"{name} module serves every chip (Mosaic owns arch "
+        f"specialization)."
+    )
+    return getter
+
+
+get_batch_decode_module = _self_module("decode")
+get_batch_decode_jit_module = _self_module("decode")
+get_batch_decode_mla_module = _self_module("mla")
+get_single_decode_module = _self_module("decode")
+get_trtllm_gen_decode_module = _self_module("decode")
+get_trtllm_gen_fmha_module = _self_module("attention")
+get_batch_prefill_module = _self_module("prefill")
+get_batch_prefill_jit_module = _self_module("prefill")
+get_customize_batch_prefill_module = _self_module("prefill")
+get_single_prefill_module = _self_module("prefill")
+get_fmha_module = _self_module("prefill")
+get_trtllm_fmha_v2_module = _self_module("prefill")
+get_trtllm_fmha_v2_sm120_module = _self_module("prefill")
+get_trtllm_gen_prefill_module = _self_module("prefill")
+
+
+class TrtllmGenDecodeModule:
+    """Reference per-arch decode-module handle; here a thin view over the
+    one decode surface."""
+
+    def __init__(self, *_, **__):
+        from flashinfer_tpu import decode
+
+        self._mod = decode
+
+    def __getattr__(self, name):
+        return getattr(self._mod, name)
+
+
+def make_hashable_cache(func):
+    """functools.cache that tuple-izes list arguments first (reference
+    prefill.py:142)."""
+    import functools as _ft
+
+    @_ft.cache
+    def cached(*args, **kw):
+        return func(*args, **kw)
+
+    @_ft.wraps(func)
+    def wrapper(*args, **kw):
+        args = tuple(tuple(a) if isinstance(a, list) else a for a in args)
+        kw = {k2: tuple(v) if isinstance(v, list) else v
+              for k2, v in kw.items()}
+        return cached(*args, **kw)
+
+    return wrapper
+
+
+def single_decode_with_kv_cache_with_jit_module(jit_module, *args, **kw):
+    """Reference passes a prebuilt JIT module; compilation is implicit
+    under jax.jit here, so this forwards to the one entry point."""
+    from flashinfer_tpu.decode import single_decode_with_kv_cache
+
+    return single_decode_with_kv_cache(*args, **kw)
+
+
+def single_prefill_with_kv_cache_with_jit_module(jit_module, *args, **kw):
+    from flashinfer_tpu.prefill import single_prefill_with_kv_cache
+
+    return single_prefill_with_kv_cache(*args, **kw)
+
+
+def fmha_varlen_plan(qo_segment_offsets, kv_segment_offsets, *_, **__):
+    """Reference returns device plan buffers for fmha_varlen; the TPU
+    form needs only the offsets themselves (token-axis plan happens
+    inside the wrapper)."""
+    return [qo_segment_offsets, kv_segment_offsets]
+
+
+_VARLEN_PLAN_CACHE = {}
+
+
+def fmha_varlen(
+    q, k, v,
+    qo_segment_offsets, kv_segment_offsets,
+    plan_info=None, max_qo_len=None, out=None, lse=None,
+    causal: bool = False, sm_scale=None,
+    q_scale=None, k_scale=None, v_scale=None,
+    return_lse: bool = False,
+    window_left: int = -1,
+):
+    """Varlen (cu_seqlens) attention -> the ragged batch-prefill wrapper
+    (reference prefill.py:4150).  Static scales fold into sm_scale /
+    the output.  Planned wrappers are cached on the segment geometry, so
+    the reference's plan-once/run-per-step split keeps its cost profile
+    (``plan_info`` itself is unused — the offsets ARE the plan here)."""
+    import numpy as np
+
+    from flashinfer_tpu.prefill import BatchPrefillWithRaggedKVCacheWrapper
+    from flashinfer_tpu.utils import get_sm_scale
+
+    sm = get_sm_scale(q.shape[-1], sm_scale)
+    if q_scale:
+        sm *= float(q_scale)
+    if k_scale:
+        sm *= float(k_scale)
+    qo_np = np.asarray(qo_segment_offsets)
+    kv_np = np.asarray(kv_segment_offsets)
+    key = (qo_np.tobytes(), kv_np.tobytes(), q.shape[1], k.shape[1],
+           q.shape[2], bool(causal), float(sm), int(window_left))
+    w = _VARLEN_PLAN_CACHE.get(key)
+    if w is None:
+        w = BatchPrefillWithRaggedKVCacheWrapper()
+        w.plan(
+            qo_np, kv_np, q.shape[1], k.shape[1], q.shape[2],
+            causal=causal, sm_scale=sm, window_left=window_left,
+        )
+        if len(_VARLEN_PLAN_CACHE) > 64:  # bound host memory
+            _VARLEN_PLAN_CACHE.clear()
+        _VARLEN_PLAN_CACHE[key] = w
+    o = w.run(q, k, v, return_lse=return_lse)
+    if v_scale:
+        if return_lse:
+            o = (o[0] * float(v_scale), o[1])
+        else:
+            o = o * float(v_scale)
+    return o
+
+
+def trtllm_ragged_attention_deepseek(
+    query, key, value, workspace_buffer=None, seq_lens=None,
+    max_q_len=None, max_kv_len=None, bmm1_scale=1.0, bmm2_scale=1.0,
+    o_sf_scale=None, batch_size=None, window_left=-1,
+    cum_seq_lens_q=None, cum_seq_lens_kv=None, **_unused,
+):
+    """DeepSeek ragged prefill entry (reference prefill.py:4408) -> the
+    ragged wrapper; bmm1/bmm2 scales fold into sm_scale / the output."""
+    o = fmha_varlen(
+        query, key, value, cum_seq_lens_q, cum_seq_lens_kv,
+        causal=True, sm_scale=float(bmm1_scale), window_left=window_left,
+    )
+    return o * float(bmm2_scale) if bmm2_scale != 1.0 else o
+
+
+def fmha_v2_prefill_deepseek(query, key, value, out=None, num_heads=None,
+                             head_dim=None, seq_len=None,
+                             scale_softmax=None, **_unused):
+    """fmha_v2 DeepSeek prefill (reference prefill.py:5027) -> single
+    prefill on the flash kernel."""
+    from flashinfer_tpu.prefill import single_prefill_with_kv_cache
+
+    return single_prefill_with_kv_cache(
+        query, key, value, causal=True, sm_scale=scale_softmax,
+    )
+
+
 # star-import gate: only the compat API, not implementation imports
 _NON_API = {"annotations", "enum", "jax", "jnp", "Optional", "Tuple"}
 __all__ = [

@@ -26,3 +26,28 @@ def split_device_green_ctx_by_sm_count(*args, **kwargs):
         "Green contexts are CUDA SM partitioning; no TPU equivalent. "
         "See flashinfer_tpu.green_ctx module docstring for the mapping."
     )
+
+
+def _cuda_only(name):
+    def stub(*args, **kwargs):
+        raise NotImplementedError(
+            f"{name} is CUDA green-context machinery (SM partitioning / "
+            "CUdevice resources); no TPU equivalent — see this module's "
+            "docstring for the mapping."
+        )
+
+    stub.__name__ = name
+    return stub
+
+
+create_green_ctx_streams = _cuda_only("create_green_ctx_streams")
+get_cudevice = _cuda_only("get_cudevice")
+get_device_resource = _cuda_only("get_device_resource")
+split_resource = _cuda_only("split_resource")
+split_resource_by_sm_count = _cuda_only("split_resource_by_sm_count")
+
+
+def get_sm_count_constraint(*args, **kwargs):
+    """Reference returns the (min, multiple) SM-count granularity; the
+    TPU analogue is one indivisible core."""
+    return (1, 1)

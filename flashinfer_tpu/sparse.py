@@ -254,3 +254,20 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
             sm_scale=p["sm_scale"],
         )
         return out[:M]
+
+
+def convert_bsr_mask_layout(mask, indptr):
+    """BSR per-block mask [nnz, R, C] -> the flattened per-row-of-blocks
+    layout the wrappers consume (reference sparse.py:170: within each
+    block-row, block masks transpose to row-major over (R, nnz_row, C))."""
+    import numpy as np
+
+    mask = np.asarray(mask)
+    indptr = np.asarray(indptr)
+    nnz, R, C = mask.shape
+    out = np.empty((nnz * R * C,), dtype=mask.dtype)
+    for i in range(len(indptr) - 1):
+        out[indptr[i] * R * C : indptr[i + 1] * R * C] = (
+            mask[indptr[i] : indptr[i + 1]].transpose(1, 0, 2).reshape(-1)
+        )
+    return jnp.asarray(out)

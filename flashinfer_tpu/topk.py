@@ -159,3 +159,85 @@ def _page_transform_impl(scores, page_table, kv_lens, k, page_size, backend):
     page = jnp.take_along_axis(page_table, tok // page_size, axis=1)
     rows = page * page_size + tok % page_size
     return jnp.where(valid, rows, -1).astype(jnp.int32), valid
+
+
+# ---------------------------------------------------------------------------
+# Reference clusters-top-k family (flashinfer/topk.py:352-505): CUDA
+# cluster-cooperative exact top-k.  On TPU the sorting-free bit-space
+# bisection IS the fast exact algorithm (one core, VMEM-resident row), so
+# the clusters entry points route there and the capability predicates
+# answer for this hardware.
+# ---------------------------------------------------------------------------
+
+
+def can_implement_filtered_topk() -> bool:
+    """Reference: does the GPU have 128KB dynamic shared memory?  TPU's
+    VMEM (~128MB) holds whole 128k-vocab rows, so the filtered algorithm's
+    premise always holds."""
+    return True
+
+
+def can_use_clusters_topk(device=None, deterministic: bool = False,
+                          dsa_graph_safe: bool = False) -> bool:
+    """Reference gates on SM100 clusters; the TPU threshold backend is
+    deterministic (exact k-th value + lowest-index ties), so it remains
+    usable even when determinism is requested."""
+    return not dsa_graph_safe
+
+
+def get_fast_topk_clusters(batch_size: int) -> int:
+    return 1  # one sequential core; no cluster split
+
+
+def get_num_cached_for_topk(device=None, k: int = 0) -> int:
+    return k  # whole rows are VMEM-resident; everything is "cached"
+
+
+def roundup_kbyte(x: int) -> int:
+    return (x + 1023) // 1024 * 1024
+
+
+def get_topk_module(*_, **__):
+    import flashinfer_tpu.topk as _self
+
+    return _self
+
+
+def topk_clusters_exact(logits, top_k_: int, output_values: bool = False,
+                        out_dtype=jnp.int32, pdl: bool = False):
+    """Exact top-k via the sorting-free threshold backend (reference
+    topk_clusters_exact semantics: indices, optionally values)."""
+    vals, idx = top_k_values_indices(logits, top_k_, backend="threshold")
+    idx = idx.astype(out_dtype)
+    return (idx, vals) if output_values else idx
+
+
+def topk_clusters_page_table_transform(logits, seq_lens, src_page_table,
+                                       top_k_: int, pdl: bool = False):
+    """Clusters-exact page-table transform -> the fused transform on the
+    threshold backend (page_size inferred as table-uniform is the
+    caller's contract; reference topk.py:439)."""
+    page_size = logits.shape[1] // src_page_table.shape[1]
+    rows, _ = top_k_page_table_transform(
+        logits, src_page_table, seq_lens, top_k_, page_size,
+        backend="threshold",
+    )
+    return rows
+
+
+def topk_clusters_ragged_transform(logits, seq_lens, offsets, top_k_: int,
+                                   pdl: bool = False):
+    """Clusters-exact ragged transform (reference topk.py:470) -> the
+    compat ragged transform on the threshold backend."""
+    from flashinfer_tpu.compat import top_k_ragged_transform
+
+    off = jnp.asarray(offsets, jnp.int32).reshape(-1)
+    # real [B+1] indptr (last entry = end of the last segment), honoring
+    # top_k_ragged_transform's documented contract
+    indptr = jnp.concatenate(
+        [off, off[-1:] + jnp.asarray(seq_lens, jnp.int32).reshape(-1)[-1:]]
+    )
+    rows, _ = top_k_ragged_transform(
+        logits, indptr, seq_lens, top_k_, backend="threshold"
+    )
+    return rows
