@@ -142,6 +142,23 @@ def _key_to_f32(key):
     return jax.lax.bitcast_convert_type(i, jnp.float32)
 
 
+def key_ge(scores, t):
+    """Order-key comparisons ``scores >= t`` / ``scores > t`` computed in
+    int32 key space -> (ge, gt) bool arrays.
+
+    Float comparisons flush subnormals to zero under XLA (CPU and TPU),
+    which breaks top-k selection for subnormal-scale scores; the key
+    compare is exact and matches the bisection kernel's own ordering.
+    NaN scores are excluded from both results (a NaN key would otherwise
+    sort above +inf)."""
+    ks = _f32_sort_key(scores.astype(jnp.float32))
+    kt = _f32_sort_key(t.astype(jnp.float32))
+    if kt.ndim == ks.ndim - 1:
+        kt = kt[..., None]
+    ok = ~jnp.isnan(scores)
+    return (ks >= kt) & ok, (ks > kt) & ok
+
+
 def _threshold_only_kernel(
     p_ref,  # [rb, Vpad] f32
     a_ref,  # [rb, 1] f32 (k as float)

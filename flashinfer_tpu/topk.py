@@ -11,10 +11,10 @@ backends:
   EXACT k-th-largest value in one HBM pass
   (``ops/sampling_kernels.top_k_thresholds``), then XLA cumsum+scatter
   extracts exactly k indices (not value-sorted: strictly-above-threshold
-  entries in index order, then threshold ties in index order).  The kept
-  SET matches the sort oracle except among entries exactly equal to the
-  k-th value — that tie class is cut by lowest index where a sort cuts
-  arbitrarily.
+  entries in index order, then threshold ties in index order).  Ties are
+  EXACT (order-key comparisons, subnormal-safe): the kept SET matches
+  the sort oracle except among entries exactly equal to the k-th value —
+  that tie class is cut by lowest index where a sort cuts arbitrarily.
 - ``"auto"``: env ``FLASHINFER_TPU_TOPK_BACKEND`` if set, else ``"xla"``
   until the banked bench says otherwise.
 
@@ -44,20 +44,19 @@ def _resolve_backend(backend: str) -> str:
 def _threshold_topk(scores: jax.Array, k: int):
     """Sorting-free exact-count top-k -> (values, indices).
 
-    Two-tier trim: entries STRICTLY above the bisection threshold are all
-    kept (they are genuinely top-k up to float resolution of the
-    threshold); the remaining slots fill with threshold-tie entries in
-    index order.  Trimming the whole kept set by index instead would let
+    Two-tier trim: entries STRICTLY above the bisection threshold (an
+    exact data value) are all kept; the remaining slots fill with
+    exact-tie entries in index order.  Trimming the whole kept set by index instead would let
     a large tie class below the cut (e.g. many zeros in masked/ReLU
     scores) evict strictly-larger values.  Output order: strict entries
     in index order, then ties in index order.  Indices beyond a row's
     valid count (all--inf rows) are -1."""
-    from flashinfer_tpu.ops.sampling_kernels import top_k_thresholds
+    from flashinfer_tpu.ops.sampling_kernels import key_ge, top_k_thresholds
 
     batch, vocab = scores.shape
     t = top_k_thresholds(scores, jnp.full((batch,), k, jnp.float32))
-    keep = scores >= t[:, None]  # >= k entries (epsilon ties kept)
-    strict = scores > t[:, None]  # < k entries (up to float resolution)
+    # order-key comparisons (exact for subnormals, NaN-excluding)
+    keep, strict = key_ge(scores, t)
     tie = keep & ~strict
     n_strict = jnp.sum(strict.astype(jnp.int32), axis=1, keepdims=True)
     pos_strict = jnp.cumsum(strict.astype(jnp.int32), axis=1) - 1
@@ -102,8 +101,9 @@ def top_k_indices(
 
 
 def top_k_mask(scores: jax.Array, k: int, backend: str = "auto") -> jax.Array:
-    """Boolean mask of the top-k entries per row (epsilon-tie note: the
-    threshold backend may mark a few extra tie-band entries)."""
+    """Boolean mask of the top-k entries per row (threshold backend: the
+    exact-equality tie class at the k-th value is marked whole, so the
+    mask can exceed k only by true ties)."""
     if _resolve_backend(backend) == "threshold":
         return _threshold_mask(scores, k)
     return _xla_mask(scores, k)
@@ -111,10 +111,10 @@ def top_k_mask(scores: jax.Array, k: int, backend: str = "auto") -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _threshold_mask(scores: jax.Array, k: int) -> jax.Array:
-    from flashinfer_tpu.ops.sampling_kernels import top_k_thresholds
+    from flashinfer_tpu.ops.sampling_kernels import key_ge, top_k_thresholds
 
     t = top_k_thresholds(scores, jnp.full((scores.shape[0],), k, jnp.float32))
-    return scores >= t[:, None]
+    return key_ge(scores, t)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -99,3 +99,132 @@ def test_fuzz_decode_geometries(seed):
             np.asarray(out[b]), np.asarray(ref[0]), rtol=3e-3, atol=3e-3,
             err_msg=f"seed {seed} b{b} kv{kv_lens[b]} ps{PS}",
         )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_moe_gmm_geometries(seed):
+    """Random token counts / expert counts / routing skew: the Pallas
+    gather-GMM backend must match ragged_dot on every geometry (empty
+    experts, single-expert hotspots, non-pow2 T)."""
+    rng = np.random.default_rng(100 + seed)
+    T = int(rng.integers(1, 300))
+    E = int(rng.choice([1, 2, 4, 8, 16]))
+    K = int(rng.integers(1, min(E, 4) + 1))
+    h = int(rng.choice([128, 256]))
+    inter = int(rng.choice([128, 384]))
+    x = jnp.asarray(rng.standard_normal((T, h)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, h, 2 * inter)) / np.sqrt(h))
+    w2 = jnp.asarray(rng.standard_normal((E, inter, h)) / np.sqrt(inter))
+    # skewed routing: half the seeds dump most tokens on expert 0
+    if seed % 2:
+        ids = jnp.zeros((T, K), jnp.int32).at[:, 1:].set(
+            jnp.asarray(rng.integers(0, E, (T, max(K - 1, 0))), jnp.int32)
+        )[:, :K]
+    else:
+        ids = jnp.asarray(rng.integers(0, E, (T, K)), jnp.int32)
+    wts = jnp.asarray(rng.random((T, K)) + 0.1, jnp.float32)
+    wts = wts / wts.sum(-1, keepdims=True)
+    ref = moe.fused_moe(x, w1, w2, wts, ids, E, backend="ragged")
+    out = moe.fused_moe(x, w1, w2, wts, ids, E, backend="gmm")
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+        err_msg=f"T={T} E={E} K={K} h={h} inter={inter}",
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_masked_fused_prefill_geometries(seed):
+    """Random ragged batches with random custom masks: the in-kernel
+    packed-mask fused prefill must match the dense-mask oracle."""
+    rng = np.random.default_rng(200 + seed)
+    HQ, HKV, D = 4, 2, 32
+    PS = int(rng.choice([8, 16]))
+    B = int(rng.integers(1, 4))
+    qo_lens = rng.integers(1, 200, B)
+    kv_lens = np.maximum(rng.integers(1, 300, B), qo_lens)
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)])
+    pages_per = [-(-int(l) // PS) for l in kv_lens]
+    kv_page_indptr = np.concatenate([[0], np.cumsum(pages_per)])
+    npages = int(kv_page_indptr[-1])
+    kv_indices = rng.permutation(npages).astype(np.int32)
+
+    masks = []
+    for q_, k_ in zip(qo_lens, kv_lens):
+        m = rng.random((q_, k_)) < 0.5
+        qpos = np.arange(q_) + k_ - q_
+        m[np.arange(q_), qpos] = True  # keep rows non-empty
+        masks.append(m)
+    packed = np.packbits(
+        np.concatenate([m.reshape(-1) for m in masks]).astype(np.uint8),
+        bitorder="little",
+    )
+    kc = jax.random.normal(
+        jax.random.PRNGKey(seed), (npages, HKV, PS, D), jnp.float32
+    )
+    vc = jax.random.normal(
+        jax.random.PRNGKey(seed + 50), (npages, HKV, PS, D), jnp.float32
+    )
+    q = jax.random.normal(
+        jax.random.PRNGKey(seed + 99), (int(qo_indptr[-1]), HQ, D),
+        jnp.float32,
+    )
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(
+        kv_layout="HND", backend="pallas_fused"
+    )
+    w.plan(
+        qo_indptr, kv_page_indptr, kv_indices,
+        [int(l) - (p - 1) * PS for l, p in zip(kv_lens, pages_per)],
+        HQ, HKV, D, PS, causal=True, packed_custom_mask=packed,
+    )
+    assert "mask_bytes" in w._fused_plan[0]
+    out = w.run(q, (kc, vc))
+    kflat = np.asarray(jnp.swapaxes(kc, 1, 2)).reshape(-1, HKV, D)
+    vflat = np.asarray(jnp.swapaxes(vc, 1, 2)).reshape(-1, HKV, D)
+    for r in range(B):
+        qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+        rows = np.concatenate([
+            np.arange(PS) + p * PS
+            for p in kv_indices[kv_page_indptr[r]:kv_page_indptr[r + 1]]
+        ])[: kv_lens[r]]
+        ref = attention_ref(
+            q[qs:qe], jnp.asarray(kflat[rows]), jnp.asarray(vflat[rows]),
+            custom_mask=jnp.asarray(masks[r]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[qs:qe]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"seed {seed} request {r}",
+        )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_threshold_topk_distributions(seed):
+    """Adversarial value distributions for the bit-space top-k: heavy
+    ties, denormal-scale values, mixed magnitudes, +/-inf floors."""
+    from flashinfer_tpu import topk
+
+    rng = np.random.default_rng(300 + seed)
+    V = int(rng.choice([257, 1024, 4096]))
+    k = int(rng.integers(1, min(V, 128)))
+    kind = seed % 5
+    if kind == 0:  # quantized values: massive tie classes
+        scores = rng.integers(-3, 4, (4, V)).astype(np.float32)
+    elif kind == 1:  # tiny magnitudes near denormal scale
+        scores = (rng.standard_normal((4, V)) * 1e-38).astype(np.float32)
+    elif kind == 2:  # huge dynamic range with -inf entries
+        scores = rng.standard_normal((4, V)).astype(np.float32)
+        scores[:, rng.integers(0, V, V // 4)] = -np.inf
+        scores[:, 0] = -1e30
+    elif kind == 3:  # all-equal rows
+        scores = np.full((4, V), 2.5, np.float32)
+    else:  # mixed sign + exact zeros
+        scores = np.where(rng.random((4, V)) < 0.5, 0.0,
+                          rng.standard_normal((4, V))).astype(np.float32)
+    sx = jnp.asarray(scores)
+    vx, ix = topk.top_k_values_indices(sx, k, backend="xla")
+    vt, it = topk.top_k_values_indices(sx, k, backend="threshold")
+    # exact contract: same MULTISET of values (ties may pick different
+    # members, but the value profile must match the sort oracle exactly)
+    for a, b in zip(np.asarray(vx), np.asarray(vt)):
+        af = np.sort(a[np.isfinite(a)])
+        bf = np.sort(b[np.isfinite(b)])
+        np.testing.assert_array_equal(af, bf, err_msg=f"kind={kind} k={k}")
