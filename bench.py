@@ -332,12 +332,22 @@ def phase_scans(sweep: bool):
         jax.random.fold_in(key, 13), (B, L, Hg)))
     alpha_k = jnp.exp(-0.05 * jax.random.uniform(
         jax.random.fold_in(key, 14), (B, L, Hg, dk)))
-    for name, fn, aa in (
+    variants = [
         ("gdn_prefill",
          lambda *a: gdn_mod.gdn_chunk_prefill(*a)[0], alpha_g),
         ("kda_prefill",
          lambda *a: gdn_mod.kda_chunk_prefill(*a)[0], alpha_k),
-    ):
+    ]
+    if dk % 128 == 0 and dv % 128 == 0 and L % 128 == 0:
+        # fused VMEM-resident kernel (ops/gdn_kernel.py): the backend
+        # A/B the banked sweep decides on (BENCH_SMALL dims are too
+        # small for its 128-aligned tiles)
+        variants.insert(1, (
+            "gdn_prefill_pallas",
+            lambda *a: gdn_mod.gdn_chunk_prefill(*a, backend="pallas")[0],
+            alpha_g,
+        ))
+    for name, fn, aa in variants:
         t = _guard(
             f"bench.scans.{name}", (B, L, Hg, dk, dv),
             lambda: bench_fn_device(fn, q, k, v, aa, beta, repeats=3),

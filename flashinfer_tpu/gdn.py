@@ -78,7 +78,6 @@ def gdn_prefill(
     return jnp.moveaxis(ys, 0, 1), final
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
 def gdn_chunk_prefill(
     q: jax.Array,  # [B, L, H, dk]
     k: jax.Array,
@@ -87,6 +86,7 @@ def gdn_chunk_prefill(
     beta: jax.Array,  # [B, L, H] update gate
     chunk_size: int = 64,
     initial_state: Optional[jax.Array] = None,  # [B, H, dk, dv]
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Chunked gated-delta-rule prefill (the WY/UT-transform form the
     reference's Blackwell GDN kernels implement, flashinfer/gdn_kernels/).
@@ -99,7 +99,50 @@ def gdn_chunk_prefill(
     boundary states are then plain matmuls — O(L*chunk) FLOPs on the MXU
     with O(L/chunk) sequential depth.  Matches ``gdn_prefill`` exactly
     (same recurrence), requires ``L % chunk_size == 0``.
+
+    ``backend="pallas"`` (or env ``FLASHINFER_TPU_GDN_BACKEND=pallas``)
+    routes to the fully-fused VMEM-resident kernel
+    (``ops/gdn_kernel.py``; chunk 128, 128-aligned dims, normalized-key
+    stability domain — see its docstring); ``"auto"`` stays on this XLA
+    form until the banked bench flips it.
     """
+    from_env = False
+    if backend == "auto":
+        import os
+
+        backend = os.environ.get("FLASHINFER_TPU_GDN_BACKEND", "xla")
+        from_env = True
+    if backend == "pallas":
+        from flashinfer_tpu.ops import gdn_kernel
+
+        eligible = (
+            q.shape[1] % gdn_kernel._CHUNK == 0
+            and q.shape[-1] % 128 == 0 and v.shape[-1] % 128 == 0
+        )
+        if eligible:
+            # the kernel runs its own fixed chunk (128) — a different
+            # explicit chunk_size changes only the internal blocking, not
+            # the result, so it is legal to override here
+            return gdn_kernel.gdn_chunk_prefill_pallas(
+                q, k, v, alpha, beta, initial_state=initial_state
+            )
+        if not from_env:
+            raise ValueError(
+                "backend='pallas' needs L % 128 == 0 and 128-aligned "
+                f"dk/dv, got L={q.shape[1]} dk={q.shape[-1]} "
+                f"dv={v.shape[-1]}"
+            )
+        backend = "xla"  # env-selected: ineligible shapes fall back
+    if backend != "xla":
+        raise ValueError(f"unknown gdn backend {backend!r}")
+    return _gdn_chunk_prefill_xla(
+        q, k, v, alpha, beta, chunk_size, initial_state
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def _gdn_chunk_prefill_xla(q, k, v, alpha, beta, chunk_size=64,
+                           initial_state=None):
     B, L, H, dk = q.shape
     dv = v.shape[-1]
     Q = chunk_size

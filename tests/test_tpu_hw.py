@@ -401,3 +401,31 @@ def test_masked_fused_prefill_on_chip():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), **BF16_TOL
     )
+
+
+def test_gdn_pallas_kernel_on_chip():
+    """Fused chunked GDN kernel vs the exact recurrence at model shapes
+    (normalized keys — the delta-rule operating regime)."""
+    from flashinfer_tpu.gdn import gdn_prefill
+    from flashinfer_tpu.ops.gdn_kernel import gdn_chunk_prefill_pallas
+
+    rng = np.random.default_rng(0)
+    B, L, H, dk, dv = 2, 1024, 4, 128, 128
+    qn = rng.standard_normal((B, L, H, dk))
+    kn = rng.standard_normal((B, L, H, dk))
+    q = jnp.asarray(qn / np.linalg.norm(qn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    k = jnp.asarray(kn / np.linalg.norm(kn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.bfloat16)
+    alpha = jnp.asarray(np.exp(-0.1 * rng.random((B, L, H))), jnp.float32)
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    o_ref, s_ref = gdn_prefill(q, k, v, alpha, beta)
+    o, s = gdn_chunk_prefill_pallas(q, k, v, alpha, beta)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+        rtol=4e-2, atol=4e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=4e-2, atol=4e-2
+    )
