@@ -286,19 +286,29 @@ def phase_scans(sweep: bool):
     A = -jnp.abs(jax.random.normal(jax.random.fold_in(key, 2), (H,)))
     Bm = jax.random.normal(jax.random.fold_in(key, 3), (B, L, G, ds))
     Cm = jax.random.normal(jax.random.fold_in(key, 4), (B, L, G, ds))
-    t = _guard(
-        "bench.scans.mamba_prefill", (B, L, H, dim, ds),
-        lambda: bench_fn_device(
-            lambda *a: mamba_mod.mamba_chunk_scan_combined(*a)[0],
-            x, dt, A, Bm, Cm, repeats=3,
-        ),
-    )
-    # SSD flops: per chunk Q=64, scores [Q,Q] via C.B (ds) + out [Q,dim]
-    Q = 64
-    flops = 2 * B * L * Q * H * (ds + dim) + 2 * B * L * H * dim * ds
-    _emit_row(phase="scans", op="mamba_prefill", B=B, L=L,
-              us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
-    print(f"# scans mamba_prefill: {t*1e6:9.1f} us", file=sys.stderr)
+    from flashinfer_tpu.ops import mamba_kernel as _mk
+
+    mamba_variants = [("mamba_prefill", "xla", 64)]
+    if _mk.eligible(x, Bm):
+        mamba_variants.append(
+            ("mamba_prefill_pallas", "pallas", _mk._CHUNK)
+        )
+    for mname, mbackend, mchunk in mamba_variants:
+        t = _guard(
+            f"bench.scans.{mname}", (B, L, H, dim, ds),
+            lambda: bench_fn_device(
+                lambda *a: mamba_mod.mamba_chunk_scan_combined(
+                    *a, backend=mbackend)[0],
+                x, dt, A, Bm, Cm, repeats=3,
+            ),
+        )
+        # SSD flops: scores [Q,Q] via C.B (ds) + out [Q,dim] per chunk
+        # (per-variant chunk: the pallas kernel runs 128-token chunks)
+        flops = (2 * B * L * mchunk * H * (ds + dim)
+                 + 2 * B * L * H * dim * ds)
+        _emit_row(phase="scans", op=mname, B=B, L=L,
+                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        print(f"# scans {mname}: {t*1e6:9.1f} us", file=sys.stderr)
 
     # --- mamba decode step (bandwidth-bound: state RMW) ---
     st = jax.random.normal(key, (B, H, dim, ds), jnp.float32)

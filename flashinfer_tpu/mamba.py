@@ -114,9 +114,6 @@ def selective_scan(
     return jnp.moveaxis(ys, 0, 1), final.astype(jnp.float32)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("chunk_size", "dt_softplus")
-)
 def mamba_chunk_scan_combined(
     x: jax.Array,  # [B, L, H, dim]
     dt: jax.Array,  # [B, L, H]  (scalar per head/step — Mamba-2/SSD form)
@@ -129,9 +126,14 @@ def mamba_chunk_scan_combined(
     dt_bias: Optional[jax.Array] = None,  # [H]
     dt_softplus: bool = False,  # matches selective_scan + reference default
     initial_state: Optional[jax.Array] = None,  # [B, H, dim, dstate]
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Chunked SSD scan (Mamba-2; reference ``mamba_chunk_scan_combined``
     family, flashinfer/mamba/ SSD combined/chunked scan).
+
+    ``backend="pallas"`` (or env ``FLASHINFER_TPU_MAMBA_BACKEND=pallas``)
+    routes to the fused VMEM-resident kernel (``ops/mamba_kernel.py``,
+    chunk 128); env-selected auto falls back here on ineligible shapes.
 
     The sequence splits into chunks of ``chunk_size``; within a chunk the
     recurrence unrolls into an attention-like matmul (MXU work:
@@ -142,6 +144,41 @@ def mamba_chunk_scan_combined(
     Requires ``L % chunk_size == 0`` (pad upstream).  Returns
     ``(y [B, L, H, dim], final_state [B, H, dim, dstate])``.
     """
+    from_env = False
+    if backend == "auto":
+        import os
+
+        backend = os.environ.get("FLASHINFER_TPU_MAMBA_BACKEND", "xla")
+        from_env = True
+    if backend == "pallas":
+        from flashinfer_tpu.ops import mamba_kernel
+
+        if mamba_kernel.eligible(x, B):
+            return mamba_kernel.mamba_chunk_scan_pallas(
+                x, dt, A, B, C, D=D, z=z, dt_bias=dt_bias,
+                dt_softplus=dt_softplus, initial_state=initial_state,
+            )
+        if not from_env:
+            raise ValueError(
+                "backend='pallas' needs L % 128 == 0, 128-aligned dstate, "
+                f"8-aligned dim; got L={x.shape[1]} ds={B.shape[-1]} "
+                f"dim={x.shape[-1]}"
+            )
+        backend = "xla"
+    if backend != "xla":
+        raise ValueError(f"unknown mamba backend {backend!r}")
+    return _mamba_chunk_scan_xla(
+        x, dt, A, B, C, chunk_size, D, z, dt_bias, dt_softplus,
+        initial_state,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "dt_softplus")
+)
+def _mamba_chunk_scan_xla(x, dt, A, B, C, chunk_size=64, D=None, z=None,
+                          dt_bias=None, dt_softplus=False,
+                          initial_state=None):
     Bsz, L, H, dim = x.shape
     G, ds = B.shape[2], B.shape[3]
     assert L % chunk_size == 0, "pad L to a chunk multiple"
