@@ -348,14 +348,21 @@ def phase_scans(sweep: bool):
         ("kda_prefill",
          lambda *a: gdn_mod.kda_chunk_prefill(*a)[0], alpha_k),
     ]
-    if dk % 128 == 0 and dv % 128 == 0 and L % 128 == 0:
-        # fused VMEM-resident kernel (ops/gdn_kernel.py): the backend
-        # A/B the banked sweep decides on (BENCH_SMALL dims are too
-        # small for its 128-aligned tiles)
+    from flashinfer_tpu.ops import gdn_kernel as _gk
+
+    if _gk.eligible(q, v):
+        # fused VMEM-resident kernels (ops/gdn_kernel.py): the backend
+        # A/Bs the banked sweep decides on (BENCH_SMALL dims are too
+        # small for their 128-aligned tiles)
         variants.insert(1, (
             "gdn_prefill_pallas",
             lambda *a: gdn_mod.gdn_chunk_prefill(*a, backend="pallas")[0],
             alpha_g,
+        ))
+        variants.append((
+            "kda_prefill_pallas",
+            lambda *a: gdn_mod.kda_chunk_prefill(*a, backend="pallas")[0],
+            alpha_k,
         ))
     for name, fn, aa in variants:
         t = _guard(

@@ -115,11 +115,7 @@ def gdn_chunk_prefill(
     if backend == "pallas":
         from flashinfer_tpu.ops import gdn_kernel
 
-        eligible = (
-            q.shape[1] % gdn_kernel._CHUNK == 0
-            and q.shape[-1] % 128 == 0 and v.shape[-1] % 128 == 0
-        )
-        if eligible:
+        if gdn_kernel.eligible(q, v):
             # the kernel runs its own fixed chunk (128) — a different
             # explicit chunk_size changes only the internal blocking, not
             # the result, so it is legal to override here
@@ -256,7 +252,6 @@ def kda_decode_step(
     return o.astype(q.dtype), s.astype(state.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
 def kda_chunk_prefill(
     q: jax.Array,  # [B, L, H, dk]
     k: jax.Array,
@@ -265,6 +260,7 @@ def kda_chunk_prefill(
     beta: jax.Array,  # [B, L, H]
     chunk_size: int = 32,
     initial_state: Optional[jax.Array] = None,  # [B, H, dk, dv]
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Chunked KDA prefill: the gdn_chunk_prefill WY form generalized to
     per-channel decay.  The score couplings become per-channel-weighted
@@ -272,7 +268,39 @@ def kda_chunk_prefill(
     valid while each channel's half-chunk decay stays within fp32 range —
     per-channel log-decay * chunk_size/2 > -60; chunk_size=32 covers
     alpha >= ~0.02, far below trained-gate ranges).  Boundary-state terms
-    use one-sided non-positive exponents (always safe)."""
+    use one-sided non-positive exponents (always safe).
+
+    ``backend="pallas"`` routes to the fused VMEM-resident kernel
+    (``ops/gdn_kernel.kda_chunk_prefill_pallas``, chunk 128).  KDA has NO
+    env opt-in (unlike GDN/mamba): the kernel's chunk-128 midpoint
+    factorization narrows the decay domain to per-token alpha >= ~0.3
+    (vs ~0.02 for this chunk-32 XLA form), so routing must be an explicit,
+    informed per-call choice — a process-wide env flip could silently
+    produce non-finite couplings for strong-decay channels."""
+    if backend == "auto":
+        backend = "xla"
+    if backend == "pallas":
+        from flashinfer_tpu.ops import gdn_kernel
+
+        if not gdn_kernel.eligible(q, v):
+            raise ValueError(
+                "backend='pallas' needs L % 128 == 0 and 128-aligned "
+                f"dk/dv, got L={q.shape[1]} dk={q.shape[-1]} "
+                f"dv={v.shape[-1]}"
+            )
+        return gdn_kernel.kda_chunk_prefill_pallas(
+            q, k, v, alpha, beta, initial_state=initial_state
+        )
+    if backend != "xla":
+        raise ValueError(f"unknown kda backend {backend!r}")
+    return _kda_chunk_prefill_xla(
+        q, k, v, alpha, beta, chunk_size, initial_state
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def _kda_chunk_prefill_xla(q, k, v, alpha, beta, chunk_size=32,
+                           initial_state=None):
     B, L, H, dk = q.shape
     dv = v.shape[-1]
     Q = chunk_size

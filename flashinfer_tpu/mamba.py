@@ -161,8 +161,9 @@ def mamba_chunk_scan_combined(
         if not from_env:
             raise ValueError(
                 "backend='pallas' needs L % 128 == 0, 128-aligned dstate, "
-                f"8-aligned dim; got L={x.shape[1]} ds={B.shape[-1]} "
-                f"dim={x.shape[-1]}"
+                "8-aligned dim, H % G == 0; got "
+                f"L={x.shape[1]} ds={B.shape[-1]} dim={x.shape[-1]} "
+                f"H={x.shape[2]} G={B.shape[2]}"
             )
         backend = "xla"
     if backend != "xla":

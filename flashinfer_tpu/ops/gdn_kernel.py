@@ -53,6 +53,45 @@ from flashinfer_tpu.utils import use_interpret
 _CHUNK = 128  # lane-aligned [Q, Q] matrices; log2(Q) = 7 doubling rounds
 
 
+def eligible(q, v) -> bool:
+    """True when (q, v) shapes fit these kernels (the ONE shape
+    predicate — dispatchers and bench call it)."""
+    return (
+        q.shape[1] % _CHUNK == 0
+        and q.shape[-1] % 128 == 0
+        and v.shape[-1] % 128 == 0
+    )
+
+
+def _masks(Q):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Q, Q), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Q, Q), 1)
+    return (
+        (rows > cols).astype(jnp.float32),
+        (rows >= cols).astype(jnp.float32),
+        (rows == cols).astype(jnp.float32),
+    )
+
+
+def _neumann_inv(C, eye):
+    """(I + C)^{-1} for strictly-lower-triangular C via nilpotent
+    doubling: S_0 = I, T_0 = -C; (S, T) <- (S + T S, T^2) gives
+    S_r = sum_{i < 2^r} (-C)^i — 7 rounds cover Q = 128."""
+
+    def body(_, carry):
+        inv, t = carry
+        return inv + jax.lax.dot_general(
+            t, inv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ), jax.lax.dot_general(
+            t, t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    inv, _ = jax.lax.fori_loop(0, 7, body, (eye, -C))
+    return inv
+
+
 def _gdn_chunk_kernel(
     q_ref,  # [Q, dk] input dtype
     k_ref,
@@ -91,31 +130,14 @@ def _gdn_chunk_kernel(
     # has non-positive exponents; the clamp kills upper-triangle overflow
     R = jnp.exp(jnp.minimum(acum - jnp.broadcast_to(acum_row, (Q, Q)), 0.0))
 
-    rows = jax.lax.broadcasted_iota(jnp.int32, (Q, Q), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (Q, Q), 1)
-    strict = (rows > cols).astype(jnp.float32)
-    causal = (rows >= cols).astype(jnp.float32)
+    strict, causal, _ = _masks(Q)
 
     kk = jax.lax.dot_general(
         kf, kf, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # KK[i, j] = k_i . k_j
     C = strict * beta * R * kk  # [Q(i), Q(j)]
-
-    # (I + C)^{-1} by nilpotent doubling: N = -C
-    def body(_, carry):
-        inv, t = carry
-        return inv + jax.lax.dot_general(
-            t, inv, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ), jax.lax.dot_general(
-            t, t, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    # S_0 = I, T_0 = N; (S, T) <- (S + T S, T^2) gives
-    # S_r = sum_{i < 2^r} N^i, so 7 rounds cover Q = 128 (N^128 = 0)
-    ainv, _ = jax.lax.fori_loop(0, 7, body, (eye, -C))
+    ainv = _neumann_inv(C, eye)
 
     D = jnp.exp(acum)  # [Q, 1]
     s0 = s_ref[...]
@@ -245,5 +267,186 @@ def gdn_chunk_prefill_pallas(
         ),
         interpret=use_interpret(),
     )(qb, kb, vb, scal, initial_state.astype(jnp.float32))
+    o = jnp.transpose(o.reshape(B, H, L, dv), (0, 2, 1, 3))
+    return o, sfinal
+
+
+def _kda_chunk_kernel(
+    q_ref,  # [Q, dk]
+    k_ref,
+    v_ref,  # [Q, dv]
+    acum_ref,  # [Q, dk] f32 per-channel log-decay cumsum
+    scal_ref,  # [Q, 8] f32: lane 0 = beta
+    init_ref,  # [dk, dv] f32
+    o_ref,  # [Q, dv]
+    sfinal_ref,  # [dk, dv] f32 (last chunk)
+    s_ref,  # scratch [dk, dv] f32
+    *,
+    num_chunks: int,
+):
+    """KDA: the GDN kernel with PER-CHANNEL decay.  Quadratic couplings
+    factorize around the chunk-midpoint decay (reference
+    kda_kernels/recurrent_kda.py semantics; same factorization as
+    gdn.kda_chunk_prefill): ``exp(acum_i - acum_j) = f_i * g_j`` with
+    ``f = exp(acum - mid)``, ``g = exp(mid - acum)`` — valid while each
+    channel's half-chunk decay stays inside fp32 range (Q=128: per-token
+    decay >= ~0.26; trained sigmoid gates sit far above)."""
+    c = pl.program_id(2)
+    Q = q_ref.shape[0]
+    dk = q_ref.shape[1]
+
+    @pl.when(c == 0)
+    def _seed():
+        s_ref[...] = init_ref[...]
+
+    qf0 = q_ref[...].astype(jnp.float32)
+    kf0 = k_ref[...].astype(jnp.float32)
+    vf = v_ref[...].astype(jnp.float32)
+    acum = acum_ref[...]
+    beta = scal_ref[...][:, 0:1]
+
+    mid = acum[Q // 2 : Q // 2 + 1, :]  # [1, dk]
+    f = jnp.exp(acum - jnp.broadcast_to(mid, (Q, dk)))
+    g = jnp.exp(jnp.broadcast_to(mid, (Q, dk)) - acum)
+    k_f = kf0 * f
+    k_g = kf0 * g
+    q_f = qf0 * f
+
+    strict, causal, eye = _masks(Q)
+
+    C = strict * beta * jax.lax.dot_general(
+        k_f, k_g, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ainv = _neumann_inv(C, eye)
+
+    D = jnp.exp(acum)  # [Q, dk] elementwise <= 1
+    s0 = s_ref[...]
+    uv = jax.lax.dot_general(
+        ainv, beta * vf, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    us = jax.lax.dot_general(
+        ainv, beta * D * kf0, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    u = uv - jax.lax.dot_general(
+        us, s0, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    P = causal * jax.lax.dot_general(
+        q_f, k_g, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o = jax.lax.dot_general(
+        D * qf0, s0, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + jax.lax.dot_general(
+        P, u, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = o.astype(o_ref.dtype)
+
+    last = acum[Q - 1 : Q, :]  # [1, dk]
+    wk = jnp.exp(jnp.broadcast_to(last, (Q, dk)) - acum) * kf0
+    # per-channel total decay scales S0 ROWS: diag(Dtot) @ S0 (diagonal
+    # built by masking — no lane/sublane transpose exists in Mosaic)
+    eye_dk = (
+        jax.lax.broadcasted_iota(jnp.int32, (dk, dk), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (dk, dk), 1)
+    ).astype(jnp.float32)
+    diag_dtot = eye_dk * jnp.exp(jnp.broadcast_to(last, (dk, dk)))
+    s_new = jax.lax.dot_general(
+        diag_dtot, s0, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + jax.lax.dot_general(
+        wk, u, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s_ref[...] = s_new
+
+    @pl.when(c == num_chunks - 1)
+    def _emit():
+        sfinal_ref[...] = s_new
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def kda_chunk_prefill_pallas(
+    q: jax.Array,  # [B, L, H, dk]
+    k: jax.Array,
+    v: jax.Array,  # [B, L, H, dv]
+    alpha: jax.Array,  # [B, L, H, dk] per-channel decay in (0, 1]
+    beta: jax.Array,  # [B, L, H]
+    initial_state: Optional[jax.Array] = None,
+    chunk_size: int = _CHUNK,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused KDA chunked prefill -> (o, final); per-channel-decay twin of
+    :func:`gdn_chunk_prefill_pallas` (same shape gates + stability
+    domain, plus the midpoint-factorization decay-range note in the
+    kernel docstring)."""
+    B, L, H, dk = q.shape
+    dv = v.shape[-1]
+    Q = chunk_size
+    if Q != _CHUNK:
+        raise ValueError(f"kda pallas kernel supports chunk_size={_CHUNK} "
+                         f"only, got {Q}")
+    if L % Q or dk % 128 or dv % 128:
+        raise ValueError(
+            f"kda pallas kernel needs L % {Q} == 0 and 128-aligned dk/dv, "
+            f"got L={L} dk={dk} dv={dv}"
+        )
+    nC = L // Q
+    if initial_state is None:
+        initial_state = jnp.zeros((B, H, dk, dv), jnp.float32)
+
+    def bh(x, d):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(B, H, nC, Q, d)
+
+    loga = jnp.log(jnp.maximum(alpha.astype(jnp.float32), 1e-30))
+    acum = jnp.cumsum(bh(loga, dk), axis=3)  # per-chunk, per-channel
+    scal = jnp.pad(
+        jnp.transpose(beta.astype(jnp.float32), (0, 2, 1))
+        .reshape(B, H, nC, Q, 1),
+        ((0, 0),) * 4 + ((0, 7),),
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(B, H, nC),
+        in_specs=[
+            pl.BlockSpec((None, None, None, Q, dk),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, None, Q, dk),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, None, Q, dv),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, None, Q, dk),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, None, Q, 8),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, dk, dv), lambda b, h, c: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, None, Q, dv),
+                         lambda b, h, c: (b, h, c, 0, 0)),
+            pl.BlockSpec((None, None, dk, dv), lambda b, h, c: (b, h, 0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((dk, dv), jnp.float32)],
+    )
+    o, sfinal = pl.pallas_call(
+        functools.partial(_kda_chunk_kernel, num_chunks=nC),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nC, Q, dv), q.dtype),
+            jax.ShapeDtypeStruct((B, H, dk, dv), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=use_interpret(),
+    )(bh(q, dk), bh(k, dk), bh(v, dv), acum, scal,
+      initial_state.astype(jnp.float32))
     o = jnp.transpose(o.reshape(B, H, L, dv), (0, 2, 1, 3))
     return o, sfinal

@@ -329,3 +329,33 @@ def test_mamba_ssd_pallas_env_fallback(monkeypatch):
 
     with _pytest.raises(ValueError):
         mamba_chunk_scan_combined(x, dt, A, Bm, Cm, backend="pallas")
+
+
+def test_kda_pallas_kernel_matches_exact_recurrence():
+    """Fused KDA kernel (per-channel decay, midpoint factorization) ==
+    the exact sequential recurrence, nonzero initial state, bf16."""
+    from flashinfer_tpu.gdn import kda_chunk_prefill
+
+    rng = np.random.default_rng(4)
+    B, L, H, dk, dv = 2, 256, 2, 128, 128
+    qn = rng.standard_normal((B, L, H, dk))
+    kn = rng.standard_normal((B, L, H, dk))
+    q = jnp.asarray(qn / np.linalg.norm(qn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    k = jnp.asarray(kn / np.linalg.norm(kn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.bfloat16)
+    alpha = jnp.asarray(np.exp(-0.05 * rng.random((B, L, H, dk))),
+                        jnp.float32)
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    s0 = jnp.asarray(rng.standard_normal((B, H, dk, dv)) * 0.3, jnp.float32)
+    o_ref, s_ref = fi.kda_prefill(q, k, v, alpha, beta, initial_state=s0)
+    o, s = kda_chunk_prefill(q, k, v, alpha, beta, backend="pallas",
+                             initial_state=s0)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+        rtol=4e-2, atol=4e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=4e-2, atol=4e-2
+    )
