@@ -429,3 +429,53 @@ def test_gdn_pallas_kernel_on_chip():
     np.testing.assert_allclose(
         np.asarray(s), np.asarray(s_ref), rtol=4e-2, atol=4e-2
     )
+
+
+def test_mamba_ssd_pallas_kernel_on_chip():
+    """Fused SSD kernel vs the XLA chunked form at Mamba-2-ish shapes."""
+    from flashinfer_tpu.mamba import mamba_chunk_scan_combined
+
+    rng = np.random.default_rng(1)
+    B, L, H, G, dim, ds = 2, 1024, 8, 2, 64, 128
+    x = jnp.asarray(rng.standard_normal((B, L, H, dim)), jnp.bfloat16)
+    dt = jnp.asarray(rng.random((B, L, H)) + 0.1, jnp.float32)
+    A = jnp.asarray(-np.abs(rng.standard_normal(H)) - 0.1, jnp.float32)
+    Bm = jnp.asarray(rng.standard_normal((B, L, G, ds)) * 0.3, jnp.bfloat16)
+    Cm = jnp.asarray(rng.standard_normal((B, L, G, ds)) * 0.3, jnp.bfloat16)
+    y_ref, s_ref = mamba_chunk_scan_combined(x, dt, A, Bm, Cm, chunk_size=64)
+    y, s = mamba_chunk_scan_combined(x, dt, A, Bm, Cm, backend="pallas")
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(y_ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_kda_pallas_kernel_on_chip():
+    """Fused KDA kernel vs the exact recurrence (normalized keys,
+    trained-gate-range decay)."""
+    from flashinfer_tpu.gdn import kda_chunk_prefill, kda_prefill
+
+    rng = np.random.default_rng(2)
+    B, L, H, dk, dv = 1, 512, 4, 128, 128
+    qn = rng.standard_normal((B, L, H, dk))
+    kn = rng.standard_normal((B, L, H, dk))
+    q = jnp.asarray(qn / np.linalg.norm(qn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    k = jnp.asarray(kn / np.linalg.norm(kn, axis=-1, keepdims=True),
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.bfloat16)
+    alpha = jnp.asarray(np.exp(-0.05 * rng.random((B, L, H, dk))),
+                        jnp.float32)
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    o_ref, s_ref = kda_prefill(q, k, v, alpha, beta)
+    o, s = kda_chunk_prefill(q, k, v, alpha, beta, backend="pallas")
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=5e-2, atol=5e-2
+    )
