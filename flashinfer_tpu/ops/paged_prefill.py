@@ -211,19 +211,33 @@ def _fused_prefill_kernel(
     causal: bool,
     num_units: int,
     has_mask: bool,
+    trace_events: bool,
 ):
-    if has_mask:
-        (q_hbm, k_hbm, v_hbm, mask_ref, o_hbm,
-         qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
-         qsem, ksem, vsem, osem) = refs
-    else:
-        (q_hbm, k_hbm, v_hbm, o_hbm,
-         qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
-         qsem, ksem, vsem, osem) = refs
-        mask_ref = None
+    i = 3
+    q_hbm, k_hbm, v_hbm = refs[0], refs[1], refs[2]
+    mask_ref = refs[i] if has_mask else None
+    i += 1 if has_mask else 0
+    o_hbm = refs[i]
+    i += 1
+    ev_ref = refs[i] if trace_events else None
+    i += 1 if trace_events else 0
+    (qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
+     qsem, ksem, vsem, osem) = refs[i:]
     hkv = pl.program_id(0)
     u = pl.program_id(1)
     chunk_tokens = ppc * page_size
+
+    if trace_events:
+        # device-side event tag, reference profiler bit layout
+        # (profiler.decode_tag): sm_id <- kv head, block <- work unit,
+        # event 0, kInstant; slot order == the sequential grid order, so
+        # stream position doubles as the timestamp.  The block shape
+        # covers 8 consecutive units (row u % 8) so the buffer costs
+        # 512 B per (head, unit) octet instead of 4 KB per step.
+        tag = (hkv << 24) | ((u & 0xFFF) << 12) | 2
+        ev_ref[pl.ds(jax.lax.rem(u, 8), 1), :] = jnp.full(
+            (1, 128), tag, jnp.int32
+        )
 
     def kv_dmas(unit, slot):
         dmas = []
@@ -355,7 +369,7 @@ def _fused_prefill_kernel(
     jax.jit,
     static_argnames=(
         "num_units", "block_q", "pages_per_chunk", "sm_scale",
-        "logits_soft_cap", "window_left", "causal",
+        "logits_soft_cap", "window_left", "causal", "trace_events",
     ),
 )
 def fused_paged_prefill(
@@ -371,6 +385,7 @@ def fused_paged_prefill(
     logits_soft_cap: float = 0.0,
     window_left: int = -1,
     causal: bool = True,
+    trace_events: bool = False,
 ):
     total_q, H, D = q.shape
     _, Hkv, page_size, _ = k_cache.shape
@@ -404,11 +419,31 @@ def fused_paged_prefill(
                 lambda h, u, *prefetch: (u, 0, 0),
             )
         )
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+    out_shape = jax.ShapeDtypeStruct(
+        (Hkv, total_q + block_q, group, D), q.dtype
+    )
+    if trace_events:
+        # one tag row per grid step (reference profiler.cuh device tag
+        # buffer, TPU form: see flashinfer_tpu.profiler module docs);
+        # the 12-bit block field of the reference layout caps traceable
+        # plans — refuse loudly rather than alias units
+        if num_units > 4096:
+            raise ValueError(
+                "trace_events supports plans up to 4096 work units "
+                f"(12-bit tag block field), got {num_units}"
+            )
+        out_specs = [out_specs, pl.BlockSpec(
+            (None, None, 8, 128), lambda h, u, *prefetch: (h, u // 8, 0, 0)
+        )]
+        out_shape = [out_shape, jax.ShapeDtypeStruct(
+            (Hkv, cdiv(num_units, 8), 8, 128), jnp.int32
+        )]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=8,
         grid=(Hkv, num_units),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, group, D), q.dtype),
             pltpu.VMEM((2, chunk_tokens, D), k_cache.dtype),
@@ -432,12 +467,10 @@ def fused_paged_prefill(
             bq=block_q, ppc=pages_per_chunk, page_size=page_size,
             group=group, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
             window_left=window_left, causal=causal, num_units=num_units,
-            has_mask=has_mask,
+            has_mask=has_mask, trace_events=trace_events,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (Hkv, total_q + block_q, group, D), q.dtype
-        ),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
             has_side_effects=True,
@@ -448,7 +481,12 @@ def fused_paged_prefill(
         plan["kvlen"], plan["first"], plan["last"], plan["pages"],
         *operands,
     )
+    if trace_events:
+        out, ev = out
+        # [Hkv, ceil(U/8), 8, 128] -> [Hkv, num_units] tags, grid order
+        events = ev[..., 0].reshape(Hkv, -1)[:, :num_units]
     # [Hkv, tq_pad, group, D] -> [tq, H, D]
-    return jnp.transpose(out[:, :total_q], (1, 0, 2, 3)).reshape(
+    result = jnp.transpose(out[:, :total_q], (1, 0, 2, 3)).reshape(
         total_q, H, D
     )
+    return (result, events) if trace_events else result

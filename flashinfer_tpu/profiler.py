@@ -196,3 +196,25 @@ def export_to_perfetto_trace(profiler_buffer, event_names, file_name):
         })
     with open(file_name, "w") as fh:
         _json.dump({"traceEvents": events}, fh)
+
+
+def grid_trace_to_buffer(tags) -> "object":
+    """Pack a kernel's per-grid-step tag array (e.g.
+    ``fused_paged_prefill(..., trace_events=True)``'s ``[Hkv, units]``)
+    into the reference profiler-buffer layout consumable by
+    :func:`export_to_perfetto_trace`: element 0 = header
+    (num_blocks | num_groups << 16), then the tags in grid order."""
+    import numpy as _np
+
+    tags = _np.asarray(tags)
+    num_blocks = tags.shape[-1]
+    if num_blocks > 0xFFFF:
+        raise ValueError(f"{num_blocks} blocks exceed the 16-bit header")
+    # the kernel encodes the unit straight into the block_group field
+    # (group = 0; the head rides sm_id), so the header declares
+    # num_groups = 1 — consumers decoding with header fields then get
+    # blk == unit exactly
+    header = num_blocks | (1 << 16)
+    return _np.concatenate(
+        [_np.array([header], _np.int64), tags.reshape(-1).astype(_np.int64)]
+    )

@@ -76,3 +76,55 @@ def test_namespaces():
     out = dsv3_ops.router_gemm(jnp.ones((4, 8)), jnp.ones((8, 16)))
     assert out.shape == (4, 16)
     assert hasattr(diffusion_ops, "layernorm_scale_shift")
+
+
+def test_in_kernel_event_trace_fused_prefill(tmp_path):
+    """Device-side event tags from the fused prefill kernel decode to the
+    grid schedule and export to a perfetto-compatible trace (reference
+    profiler.cuh device tag buffer, TPU sequential-grid form)."""
+    import numpy as np
+
+    from flashinfer_tpu import profiler
+    from flashinfer_tpu.ops.paged_prefill import (
+        build_prefill_work_units, fused_paged_prefill,
+    )
+
+    PS, HQ, HKV, D = 8, 4, 2, 32
+    qo_indptr = np.array([0, 40])
+    kv_lens = np.array([64], np.int64)
+    kv_page_indptr = np.array([0, 8])
+    kv_indices = np.arange(8, dtype=np.int32)
+    plan_np = build_prefill_work_units(
+        qo_indptr, kv_page_indptr, kv_indices, kv_lens,
+        block_q=64, pages_per_chunk=4, page_size=PS,
+    )
+    num_units = plan_np.pop("num_units")
+    plan_np.pop("block_q"), plan_np.pop("pages_per_chunk")
+    plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
+    q = jax.random.normal(jax.random.PRNGKey(0), (40, HQ, D), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(1), (8, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(2), (8, HKV, PS, D))
+    out, events = fused_paged_prefill(
+        q, kc, vc, plan, num_units=num_units, block_q=64,
+        pages_per_chunk=4, trace_events=True,
+    )
+    assert out.shape == (40, HQ, D)
+    ev = np.asarray(events)
+    assert ev.shape == (HKV, num_units)
+    # tags decode to the exact grid schedule
+    for h in range(HKV):
+        for u in range(num_units):
+            blk, grp, ei, et, sm = profiler.decode_tag(
+                int(ev[h, u]), num_units, 1
+            )
+            assert (sm, blk, et) == (h, u, 2), (h, u, ev[h, u])
+    # and the buffer round-trips through the perfetto exporter
+    buf = profiler.grid_trace_to_buffer(ev)
+    f = tmp_path / "trace.json"
+    profiler.export_to_perfetto_trace(buf, ["unit"], str(f))
+    import json
+
+    tr = json.load(open(f))["traceEvents"]
+    assert len(tr) == HKV * num_units - sum(
+        1 for h in range(HKV) for u in range(num_units) if ev[h, u] == 0
+    )
