@@ -37,9 +37,15 @@ from flashinfer_tpu.logits_processor import (
 from flashinfer_tpu.models import LlamaConfig, init_llama_params, llama_decode_step
 
 
-def generate(prompt_lens, max_new_tokens=8, seed=0):
+def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False):
+    """Serving loop; ``int8_weights=True`` runs every projection on the
+    int8 MXU path (quantize_llama_weights) — the quantized serving mode."""
     cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
     params = init_llama_params(jax.random.PRNGKey(seed), cfg)
+    if int8_weights:
+        from flashinfer_tpu.models import quantize_llama_weights
+
+        params = quantize_llama_weights(params)
     B = len(prompt_lens)
     PS = 8
     max_len = max(prompt_lens) + max_new_tokens
@@ -96,10 +102,16 @@ def generate(prompt_lens, max_new_tokens=8, seed=0):
     x = params["embed"][flat_tokens].astype(cfg.dtype)
     new_caches = []
     for li, layer in enumerate(params["layers"]):
+        from flashinfer_tpu.models.llama import _mm, _pre_quant
+
         h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
-        qp = (h @ layer["q_proj"]).reshape(total_q, cfg.num_qo_heads, cfg.head_dim)
-        kp = (h @ layer["k_proj"]).reshape(total_q, cfg.num_kv_heads, cfg.head_dim)
-        vp = (h @ layer["v_proj"]).reshape(total_q, cfg.num_kv_heads, cfg.head_dim)
+        pre = _pre_quant(h, layer)
+        qp = _mm(h, layer, "q_proj", pre).reshape(
+            total_q, cfg.num_qo_heads, cfg.head_dim)
+        kp = _mm(h, layer, "k_proj", pre).reshape(
+            total_q, cfg.num_kv_heads, cfg.head_dim)
+        vp = _mm(h, layer, "v_proj", pre).reshape(
+            total_q, cfg.num_kv_heads, cfg.head_dim)
         qp, kp = apply_rope_pos_ids(qp, kp, pos, rope_theta=cfg.rope_theta)
         kc, vc = caches[li]
         # append into the HND paged cache (append op expects NHD views)
@@ -112,13 +124,17 @@ def generate(prompt_lens, max_new_tokens=8, seed=0):
         kc, vc = jnp.swapaxes(kc_n, 1, 2), jnp.swapaxes(vc_n, 1, 2)
         new_caches.append((kc, vc))
         attn = prefill.run(qp, (kc, vc))
-        x = x + (attn.reshape(total_q, -1) @ layer["o_proj"]).astype(cfg.dtype)
+        x = x + _mm(attn.reshape(total_q, -1), layer, "o_proj").astype(
+            cfg.dtype)
         h2 = rmsnorm(x, layer["post_norm"], cfg.rms_eps)
-        mlp = jnp.concatenate([h2 @ layer["gate_proj"], h2 @ layer["up_proj"]], -1)
-        x = x + (silu_and_mul(mlp) @ layer["down_proj"]).astype(cfg.dtype)
+        pre2 = _pre_quant(h2, layer, "gate_proj")
+        mlp = jnp.concatenate(
+            [_mm(h2, layer, "gate_proj", pre2),
+             _mm(h2, layer, "up_proj", pre2)], -1)
+        x = x + _mm(silu_and_mul(mlp), layer, "down_proj").astype(cfg.dtype)
     caches = new_caches
     x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
-    all_logits = (x @ params["lm_head"]).astype(jnp.float32)
+    all_logits = _mm(x, params, "lm_head").astype(jnp.float32)
     # decode starts from each request's LAST prompt-token logits
     last_idx = jnp.asarray(qo_indptr[1:] - 1, jnp.int32)
     logits = all_logits[last_idx]
@@ -142,7 +158,8 @@ def generate(prompt_lens, max_new_tokens=8, seed=0):
 
 
 if __name__ == "__main__":
-    outs = generate([5, 9], max_new_tokens=6)
+    int8 = "int8" in sys.argv
+    outs = generate([5, 9], max_new_tokens=6, int8_weights=int8)
     for b, toks in enumerate(outs):
         print(f"request {b}: generated {toks}")
-    print("generate.py ok")
+    print(f"generate.py ok{' (int8 weights)' if int8 else ''}")
