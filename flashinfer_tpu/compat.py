@@ -22,6 +22,7 @@ this file against the reference's ``__init__`` export list.
 
 from __future__ import annotations
 
+import collections
 import enum
 from typing import Optional, Tuple
 
@@ -141,8 +142,15 @@ class SfLayout(enum.Enum):
 # top-k conveniences
 # ---------------------------------------------------------------------------
 
-top_k = topk.top_k_values_indices
-"""Exact top-k -> (values, indices) (reference ``flashinfer.top_k``)."""
+def top_k(scores: jax.Array, k: int, backend: str = "xla"):
+    """Exact top-k -> (values, indices) (reference ``flashinfer.top_k``).
+
+    The reference returns value-sorted entries, so this order-sensitive
+    entry pins ``backend="xla"`` rather than "auto" — the process-wide
+    ``FLASHINFER_TPU_TOPK_BACKEND=threshold`` opt-in must not silently
+    switch migrating callers to index-ordered output.  Set-semantics
+    callers can pass ``backend="threshold"`` (or "auto") explicitly."""
+    return topk.top_k_values_indices(scores, k, backend)
 
 
 def top_k_ragged_transform(
@@ -668,7 +676,7 @@ def fmha_varlen_plan(qo_segment_offsets, kv_segment_offsets, *_, **__):
     return [qo_segment_offsets, kv_segment_offsets]
 
 
-_VARLEN_PLAN_CACHE = {}
+_VARLEN_PLAN_CACHE = collections.OrderedDict()
 
 
 def fmha_varlen(
@@ -706,9 +714,11 @@ def fmha_varlen(
             qo_np, kv_np, q.shape[1], k.shape[1], q.shape[2],
             causal=causal, sm_scale=sm, window_left=window_left,
         )
-        if len(_VARLEN_PLAN_CACHE) > 64:  # bound host memory
-            _VARLEN_PLAN_CACHE.clear()
+        while len(_VARLEN_PLAN_CACHE) >= 64:  # bound host memory: LRU, not
+            _VARLEN_PLAN_CACHE.popitem(last=False)  # a clear-all replan storm
         _VARLEN_PLAN_CACHE[key] = w
+    else:
+        _VARLEN_PLAN_CACHE.move_to_end(key)
     o = w.run(q, k, v, return_lse=return_lse)
     if v_scale:
         if return_lse:

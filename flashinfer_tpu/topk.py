@@ -213,11 +213,23 @@ def topk_clusters_exact(logits, top_k_: int, output_values: bool = False,
 
 
 def topk_clusters_page_table_transform(logits, seq_lens, src_page_table,
-                                       top_k_: int, pdl: bool = False):
+                                       top_k_: int, pdl: bool = False,
+                                       page_size: Optional[int] = None):
     """Clusters-exact page-table transform -> the fused transform on the
-    threshold backend (page_size inferred as table-uniform is the
-    caller's contract; reference topk.py:439)."""
-    page_size = logits.shape[1] // src_page_table.shape[1]
+    threshold backend (reference topk.py:439).
+
+    ``page_size`` defaults to ``max_kv / max_pages``, which is only valid
+    when the table is exactly sized (``max_kv == max_pages * page_size``);
+    over-allocated tables must pass ``page_size`` explicitly or the
+    inferred value silently misindexes cache rows."""
+    if page_size is None:
+        if logits.shape[1] % src_page_table.shape[1] != 0:
+            raise ValueError(
+                f"cannot infer page_size: max_kv={logits.shape[1]} is not a "
+                f"multiple of max_pages={src_page_table.shape[1]}; pass "
+                "page_size explicitly"
+            )
+        page_size = logits.shape[1] // src_page_table.shape[1]
     rows, _ = top_k_page_table_transform(
         logits, src_page_table, seq_lens, top_k_, page_size,
         backend="threshold",
