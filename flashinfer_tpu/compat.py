@@ -756,7 +756,8 @@ def fmha_v2_prefill_deepseek(query, key, value, out=None, num_heads=None,
 
 
 # star-import gate: only the compat API, not implementation imports
-_NON_API = {"annotations", "enum", "jax", "jnp", "Optional", "Tuple"}
+_NON_API = {"annotations", "collections", "enum", "jax", "jnp", "Optional",
+            "Tuple"}
 __all__ = [
     n for n in dict(globals())
     if not n.startswith("_") and n not in _NON_API

@@ -3,6 +3,7 @@ from flashinfer_tpu.testing.utils import (  # noqa: F401
     attention_ref,
     bench_fn,
     bench_fn_device,
+    bench_steps_device,
     attention_flops,
     attention_bytes,
 )

@@ -220,3 +220,48 @@ def bench_fn_device(
         # upper bound rather than reporting nonsense throughput
         return t_hi_min / iters_high
     return slope
+
+
+def bench_steps_device(
+    make_loop: Callable,
+    *args,
+    iters_low: int = 4,
+    iters_high: int = 12,
+    repeats: int = 3,
+) -> float:
+    """Slope-timed per-step cost of a loop that CARRIES its state.
+
+    ``make_loop(n)`` must return a jitted callable running ``n`` dependent
+    steps with mutable state threaded through a ``lax.scan`` /
+    ``while_loop`` carry and a scalar-reducible output.  Use this instead
+    of :func:`bench_fn_device` for stateful step benchmarks (serving
+    loops with KV caches): ``bench_fn_device`` re-feeds identical inputs
+    every iteration, so any buffer the step updates is loop-invariant and
+    the update degenerates into a full-buffer copy per iteration — an
+    artifact a donation-based serving loop never pays.  A carry lets
+    XLA's while-body input/output aliasing update the state in place, so
+    the measured step includes only the writes the real loop performs.
+
+    Per-step time is the ``(t(hi) - t(lo)) / (hi - lo)`` slope, which
+    cancels fixed dispatch/compile-cache/transfer overhead (see
+    :func:`bench_fn_device`; ``float()`` on the result is the execution
+    fence — ``block_until_ready`` is unreliable over tunneled devices).
+    """
+    lo, hi = make_loop(iters_low), make_loop(iters_high)
+    float(lo(*args))  # compile both before timing
+    float(hi(*args))
+    slopes = []
+    t_hi_min = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(lo(*args))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(hi(*args))
+        t_hi = time.perf_counter() - t0
+        t_hi_min = min(t_hi_min, t_hi)
+        slopes.append((t_hi - t_lo) / (iters_high - iters_low))
+    slope = float(np.median(slopes))
+    if slope <= 0:
+        return t_hi_min / iters_high
+    return slope
