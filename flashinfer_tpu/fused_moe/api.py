@@ -83,7 +83,11 @@ class MoEConfig:
     # expert parallelism
     ep_axis: Optional[str] = None  # mesh axis when called inside shard_map
     ep_dispatch: str = "allgather"
-    ep_capacity_factor: float = 2.0  # alltoall mode token-drop threshold
+    # per-destination bucket size for the all_to_all modes, as a multiple
+    # of the balanced load: "alltoall" drops routes beyond it;
+    # "alltoall_exact" never drops but runs ceil(max_load/cap) exchange
+    # rounds, so a tiny value multiplies dispatch latency instead
+    ep_capacity_factor: float = 2.0
 
 
 class MoE:

@@ -211,16 +211,29 @@ def fused_moe_ep(
     Experts are contiguously sharded over ``axis`` (rank r owns
     ``[r*E_local, (r+1)*E_local)``, the Mapping.ep_experts partition).
 
-    Two dispatch modes mirroring the reference moe_ep design space:
+    Three dispatch modes mirroring the reference moe_ep design space:
     - ``"allgather"``: all_gather tokens + psum_scatter combine — minimal
       latency at small world sizes, bandwidth O(T_global * hidden);
     - ``"alltoall"``: capacity-bucketed token exchange (the reference's
       split-mode NCCL/NIXL dispatch+combine as ``lax.all_to_all``) —
-      bandwidth O(T_local * K * hidden), the scalable mode.  Tokens beyond
-      ``capacity_factor * T_local * K / ep`` per destination are dropped
-      (standard capacity semantics): a dropped (token, choice) route
-      contributes ZERO to that token's output, so under-capacity routing
-      silently degrades quality rather than erroring.
+      bandwidth O(T_local * K * hidden), the scalable bounded-latency
+      mode.  Tokens beyond ``capacity_factor * T_local * K / ep`` per
+      destination are dropped (standard capacity semantics): a dropped
+      (token, choice) route contributes ZERO to that token's output, so
+      under-capacity routing silently degrades quality rather than
+      erroring.
+    - ``"alltoall_exact"``: NO-DROP token exchange — parity with the
+      reference EP, which delivers every routed token by sizing NCCL
+      transfers from an exchanged size tensor
+      (moe_ep/modes/split_layer.py:52).  XLA buffers are static-shaped,
+      so the TPU-native equivalent runs the same capacity-bucketed
+      exchange in ROUNDS under a ``lax.while_loop`` whose trip count all
+      ranks agree on via a pmax of the max destination load: balanced
+      routing costs exactly one round (identical traffic to
+      ``"alltoall"`` plus one scalar pmax), pathological routing costs
+      extra rounds instead of dropped tokens.  Latency is data-dependent;
+      use ``"alltoall"`` when bounded step time matters more than exact
+      delivery.
 
     With ``return_dropped=True`` returns ``(out, dropped)`` where
     ``dropped`` is a shape-``[1]`` int32 count of this rank's (token,
@@ -228,7 +241,8 @@ def fused_moe_ep(
     hook for the capacity-drop semantics (reference analogue: per-split
     token accounting, moe_ep/modes/split_layer.py:52).  Shaped ``[1]`` so
     a shard_map ``out_specs=P(axis)`` concatenates it into per-rank
-    counts.  Always 0 for ``"allgather"`` (that mode never drops).
+    counts.  Always 0 for ``"allgather"`` and ``"alltoall_exact"``
+    (those modes never drop).
     """
     if dispatch == "allgather":
         ep = jax.lax.axis_size(axis)
@@ -257,7 +271,41 @@ def fused_moe_ep(
             axis, activation, capacity_factor,
         )
         return (out, dropped) if return_dropped else out
+    if dispatch == "alltoall_exact":
+        out, dropped = _fused_moe_ep_alltoall_exact(
+            hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
+            axis, activation, capacity_factor,
+        )
+        return (out, dropped) if return_dropped else out
     raise ValueError(f"unknown dispatch {dispatch!r}")
+
+
+def _route_buckets(topk_ids, e_local, ep, capacity_factor):
+    """Shared all_to_all routing prologue.
+
+    Stable-sorts this rank's (token, choice) routes by destination rank
+    and returns ``(cap, order, sd, stok, eid, within)``: the bucket
+    capacity, the sort permutation, sorted destination ranks, source
+    token of each sorted route, destination-LOCAL expert ids, and each
+    route's rank within its destination bucket (the capacity/round
+    coordinate).  Both the capacity-drop and the exact dispatch build on
+    exactly this decomposition — keep them in lockstep.
+    """
+    T, K = topk_ids.shape
+    TK = T * K
+    import math
+
+    cap = max(1, int(math.ceil(TK / ep * capacity_factor)))
+    flat_ids = topk_ids.reshape(-1)
+    dst = (flat_ids // e_local).astype(jnp.int32)
+    order = jnp.argsort(dst, stable=True)
+    sd = dst[order]  # sorted destinations
+    stok = order // K  # source token of each sorted entry
+    eid = (flat_ids[order] % e_local).astype(jnp.int32)
+    # index within each destination bucket
+    first = jnp.searchsorted(sd, sd, side="left")
+    within = jnp.arange(TK) - first
+    return cap, order, sd, stok, eid, within
 
 
 def _fused_moe_ep_alltoall(
@@ -269,25 +317,16 @@ def _fused_moe_ep_alltoall(
     T, K = topk_ids.shape
     H = hidden.shape[1]
     TK = T * K
-    import math
-
-    cap = max(1, int(math.ceil(TK / ep * capacity_factor)))
-
-    flat_ids = topk_ids.reshape(-1)
-    dst = (flat_ids // e_local).astype(jnp.int32)
-    order = jnp.argsort(dst, stable=True)
-    sd = dst[order]  # sorted destinations
-    stok = order // K  # source token of each sorted entry
-    # index within each destination bucket
-    first = jnp.searchsorted(sd, sd, side="left")
-    within = jnp.arange(TK) - first
+    cap, order, sd, stok, eid, within = _route_buckets(
+        topk_ids, e_local, ep, capacity_factor
+    )
 
     # capacity-bucketed send buffers; overflow (within >= cap) drops
     send_x = jnp.zeros((ep, cap, H), hidden.dtype).at[sd, within].set(
         hidden[stok], mode="drop"
     )
     send_eid = jnp.zeros((ep, cap), jnp.int32).at[sd, within].set(
-        (flat_ids[order] % e_local).astype(jnp.int32), mode="drop"
+        eid, mode="drop"
     )
     send_valid = jnp.zeros((ep, cap), jnp.float32).at[sd, within].set(
         1.0, mode="drop"
@@ -317,3 +356,89 @@ def _fused_moe_ep_alltoall(
     ).sum(1)
     dropped = jnp.sum((within >= cap).astype(jnp.int32)).reshape(1)
     return combined.astype(hidden.dtype), dropped
+
+
+def _fused_moe_ep_alltoall_exact(
+    hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
+    axis, activation, capacity_factor,
+):
+    """Exact (no-drop) all_to_all EP dispatch: rounds under a while_loop.
+
+    The reference sizes its dispatch transfer from an exchanged size
+    tensor, so every routed token is delivered
+    (moe_ep/modes/split_layer.py:52).  XLA cannot size a buffer
+    dynamically, so delivery-exactness is bought with TIME instead of
+    SHAPE: round ``r`` exchanges the routes whose per-destination rank
+    ``within`` falls in ``[r*cap, (r+1)*cap)``, and the loop runs
+    ``ceil(max destination load / cap)`` rounds — a traced scalar every
+    rank derives from the same ``pmax``, keeping the SPMD program
+    uniform.  Every (token, choice) route is exchanged in exactly one
+    round, so the combined output is the same weighted sum the
+    single-device oracle computes — bit-for-bit in f32 at K=2 (per-route
+    expert rows are row-independent dots, and two-addend float sums are
+    order-free); at K>2 the K-way addition order can differ from the
+    oracle's expert-sorted scatter-add by an ulp.
+    """
+    ep = jax.lax.axis_size(axis)
+    e_local = w_gate_up.shape[0]
+    T, K = topk_ids.shape
+    H = hidden.shape[1]
+    TK = T * K
+    cap, order, sd, stok, eid_src, within = _route_buckets(
+        topk_ids, e_local, ep, capacity_factor
+    )
+
+    # all ranks agree on the round count: ceil(max bucket load / cap)
+    counts = jnp.bincount(sd, length=ep)
+    rounds = jax.lax.pmax(
+        ((counts.max() + cap - 1) // cap).astype(jnp.int32), axis
+    )
+
+    x_src = hidden[stok]  # [TK, H] route payloads, sorted order
+
+    def round_body(state):
+        r, contrib = state
+        lo = r * cap
+        in_round = (within >= lo) & (within < lo + cap)
+        # routes outside this round park in a spill slot that the final
+        # slice discards — keeps the scatter mask-free and in-bounds
+        slot = jnp.where(in_round, within - lo, cap)
+        send_x = (
+            jnp.zeros((ep, cap + 1, H), hidden.dtype)
+            .at[sd, slot].set(x_src)[:, :cap]
+        )
+        send_eid = (
+            jnp.zeros((ep, cap + 1), jnp.int32)
+            .at[sd, slot].set(eid_src)[:, :cap]
+        )
+        send_valid = (
+            jnp.zeros((ep, cap + 1), jnp.float32)
+            .at[sd, slot].set(in_round.astype(jnp.float32))[:, :cap]
+        )
+
+        recv_x = jax.lax.all_to_all(send_x, axis, 0, 0)
+        recv_eid = jax.lax.all_to_all(send_eid, axis, 0, 0)
+        recv_valid = jax.lax.all_to_all(send_valid, axis, 0, 0)
+
+        out = fused_moe(
+            recv_x.reshape(ep * cap, H), w_gate_up, w_down,
+            recv_valid.reshape(ep * cap, 1),  # weight 1 valid, 0 empty
+            recv_eid.reshape(ep * cap, 1), e_local, activation,
+        )
+
+        back = jax.lax.all_to_all(out.reshape(ep, cap, H), axis, 0, 0)
+        got = back[sd, jnp.clip(within - lo, 0, cap - 1)]
+        got = got * in_round[:, None].astype(got.dtype)
+        return r + 1, contrib + got.astype(jnp.float32)
+
+    _, contrib_sorted = jax.lax.while_loop(
+        lambda s: s[0] < rounds,
+        round_body,
+        (jnp.int32(0), jnp.zeros((TK, H), jnp.float32)),
+    )
+    contrib = jnp.zeros((TK, H), jnp.float32).at[order].set(contrib_sorted)
+    combined = (
+        contrib.reshape(T, K, H)
+        * topk_weights.astype(jnp.float32)[..., None]
+    ).sum(1)
+    return combined.astype(hidden.dtype), jnp.zeros((1,), jnp.int32)

@@ -318,3 +318,79 @@ def test_fused_moe_ep_alltoall_capacity_drops():
         np.asarray(weights) * kept_mask, ids_np,
     )
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.devices_8
+def test_fused_moe_ep_alltoall_exact_no_drop_under_overflow():
+    """The exact dispatch under the SAME adversarial routing that makes
+    the capacity mode drop: zero drops, and the output matches the
+    single-device oracle BIT-FOR-BIT in f32 (K=2: two-addend combine is
+    order-free; per-route expert rows are row-independent dots)."""
+    ep = 4
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("tp",))
+    T, E, K, h, inter = 16, 8, 2, 32, 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h), jnp.float32)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (E, h, 2 * inter)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h)) * 0.1
+    # every token's top choice is expert 0: rank 0's bucket overflows on
+    # every source rank at capacity_factor=0.5 (multiple rounds needed)
+    ids = jnp.stack(
+        [jnp.zeros((T,), jnp.int32),
+         jnp.arange(T, dtype=jnp.int32) % E],
+        axis=1,
+    )
+    weights = jnp.full((T, K), 0.5, jnp.float32)
+    single = moe.fused_moe(x, w1, w2, weights, ids, E)
+
+    def fn(x, w1, w2, wts, ids):
+        return moe.fused_moe_ep(
+            x, w1, w2, wts, ids, E, axis="tp", dispatch="alltoall_exact",
+            capacity_factor=0.5, return_dropped=True,
+        )
+
+    out, dropped = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp")),
+            out_specs=(P("tp"), P("tp")),
+            check_vma=False,
+        )
+    )(x, w1, w2, weights, ids)
+
+    assert int(np.asarray(dropped).sum()) == 0
+    # bit-for-bit is the contract (VERDICT r3 #4); if a future XLA changes
+    # gemm blocking across batch shapes this may need an ulp bound
+    diff = np.abs(np.asarray(out) - np.asarray(single))
+    assert diff.max() == 0.0, f"exact dispatch deviated, max abs {diff.max()}"
+
+
+@pytest.mark.devices_8
+def test_fused_moe_ep_alltoall_exact_balanced_routing():
+    """Balanced routing (the one-round fast case) through the exact
+    dispatch matches the single-device oracle."""
+    ep = 4
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("tp",))
+    T, E, K, h, inter = 16, 8, 3, 32, 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h), jnp.float32)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (E, h, 2 * inter)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (E, inter, h)) * 0.1
+    logits = jax.random.normal(jax.random.PRNGKey(3), (T, E))
+    weights, ids = moe.route_renormalize(logits, K)
+    single = moe.fused_moe(x, w1, w2, weights, ids, E)
+
+    def fn(x, w1, w2, wts, ids):
+        return moe.fused_moe_ep(
+            x, w1, w2, wts, ids, E, axis="tp", dispatch="alltoall_exact",
+        )
+
+    out = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp")),
+            out_specs=P("tp"),
+            check_vma=False,
+        )
+    )(x, w1, w2, weights, ids)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(single), rtol=2e-3, atol=2e-3
+    )
