@@ -271,26 +271,34 @@ def kda_chunk_prefill(
     use one-sided non-positive exponents (always safe).
 
     ``backend="pallas"`` routes to the fused VMEM-resident kernel
-    (``ops/gdn_kernel.kda_chunk_prefill_pallas``, chunk 128).  KDA has NO
-    env opt-in (unlike GDN/mamba): the kernel's chunk-128 midpoint
-    factorization narrows the decay domain to per-token alpha >= ~0.3
-    (vs ~0.02 for this chunk-32 XLA form), so routing must be an explicit,
-    informed per-call choice — a process-wide env flip could silently
-    produce non-finite couplings for strong-decay channels."""
+    (``ops/gdn_kernel.kda_chunk_prefill_pallas``, chunk 128).  Its
+    pair scores assemble from 16-row blocks with boundary-referenced
+    history factors (safe at any decay) and midpoint diagonal blocks, so
+    the usable per-token decay domain is alpha >= ~0.007 — wider than
+    this chunk-32 XLA form's ~0.02 and far below trained-gate ranges —
+    which is why the env opt-in ``FLASHINFER_TPU_KDA_BACKEND=pallas``
+    is offered like GDN's (earlier rounds' whole-chunk factorization
+    only covered alpha >= ~0.3 and had no env hook)."""
+    from_env = False
     if backend == "auto":
-        backend = "xla"
+        import os
+
+        backend = os.environ.get("FLASHINFER_TPU_KDA_BACKEND", "xla")
+        from_env = True
     if backend == "pallas":
         from flashinfer_tpu.ops import gdn_kernel
 
-        if not gdn_kernel.eligible(q, v):
+        if gdn_kernel.eligible(q, v):
+            return gdn_kernel.kda_chunk_prefill_pallas(
+                q, k, v, alpha, beta, initial_state=initial_state
+            )
+        if not from_env:
             raise ValueError(
                 "backend='pallas' needs L % 128 == 0 and 128-aligned "
                 f"dk/dv, got L={q.shape[1]} dk={q.shape[-1]} "
                 f"dv={v.shape[-1]}"
             )
-        return gdn_kernel.kda_chunk_prefill_pallas(
-            q, k, v, alpha, beta, initial_state=initial_state
-        )
+        backend = "xla"  # env-selected: ineligible shapes fall back
     if backend != "xla":
         raise ValueError(f"unknown kda backend {backend!r}")
     return _kda_chunk_prefill_xla(

@@ -271,6 +271,83 @@ def gdn_chunk_prefill_pallas(
     return o, sfinal
 
 
+_KDA_SB = 16  # block-row height for the pair-score assembly
+_KDA_CLAMP = 40.0  # per-factor exponent clamp: products stay < e^80
+
+
+def _kda_pair_scores(qf0, kf0, acum, Q, dk):
+    """[Q, Q] decay-weighted pair scores for per-channel decay:
+    ``A[i, j] = sum_c x_i[c] k_j[c] exp(acum_i[c] - acum_j[c])`` for
+    ``x in {k, q}`` (the coupling and attention matrices), assembled from
+    ``_KDA_SB``-row blocks so NO factor or masked-garbage entry can
+    overflow f32:
+
+    - **history block-pairs** (cols strictly before the row block) factor
+      around the block's LEFT BOUNDARY decay: monotone per-channel acum
+      puts the boundary between i and j, so BOTH factors are <= 1 — safe
+      at ANY decay rate, underflow only where the true value underflows;
+    - **diagonal blocks** factor around the block midpoint: true factor
+      exponents span <= SB/2 tokens, and a +-``_KDA_CLAMP`` clamp keeps
+      the (masked-away) garbage entries finite instead of inf*0 = NaN.
+
+    Exactness domain: per-token per-channel log-decay * SB/2 within the
+    clamp, i.e. alpha >= exp(-2*_KDA_CLAMP/_KDA_SB) ~= 0.0067 — an order
+    of magnitude below the ~0.02 aggressive-decay regime real KDA models
+    use (reference kda_kernels/recurrent_kda.py covers the same range by
+    never forming cross-token ratios).  Below that, clamped diagonal
+    entries degrade gracefully (absolute error <= the true coupling,
+    which is itself < e^-40)."""
+    SB = _KDA_SB
+    rows_kk, rows_qk = [], []
+    for b in range(Q // SB):
+        sl = slice(b * SB, (b + 1) * SB)
+        a_r = acum[sl, :]  # [SB, dk]
+        k_r = kf0[sl, :]
+        q_r = qf0[sl, :]
+        col = jax.lax.broadcasted_iota(jnp.int32, (SB, Q), 1)
+
+        # diagonal block: midpoint reference, clamped factors
+        m_d = acum[b * SB + SB // 2 : b * SB + SB // 2 + 1, :]  # [1, dk]
+        f_d = jnp.exp(jnp.clip(a_r - m_d, -_KDA_CLAMP, _KDA_CLAMP))
+        g_d = jnp.exp(jnp.clip(
+            jnp.broadcast_to(m_d, (Q, dk)) - acum, -_KDA_CLAMP, _KDA_CLAMP
+        ))
+        in_blk = ((col >= b * SB) & (col < (b + 1) * SB)).astype(jnp.float32)
+        kg_d = kf0 * g_d
+        kk = in_blk * jax.lax.dot_general(
+            k_r * f_d, kg_d, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        qk = in_blk * jax.lax.dot_general(
+            q_r * f_d, kg_d, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        if b:
+            # history: boundary reference -> both factors in [0, 1]
+            m_h = acum[b * SB - 1 : b * SB, :]  # [1, dk]
+            f_h = jnp.exp(jnp.minimum(a_r - m_h, 0.0))
+            g_h = jnp.exp(jnp.minimum(
+                jnp.broadcast_to(m_h, (Q, dk)) - acum, 0.0
+            ))
+            hist = (col < b * SB).astype(jnp.float32)
+            kg_h = kf0 * g_h
+            kk = kk + hist * jax.lax.dot_general(
+                k_r * f_h, kg_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            qk = qk + hist * jax.lax.dot_general(
+                q_r * f_h, kg_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        rows_kk.append(kk)
+        rows_qk.append(qk)
+    return (
+        jnp.concatenate(rows_kk, axis=0),
+        jnp.concatenate(rows_qk, axis=0),
+    )
+
+
 def _kda_chunk_kernel(
     q_ref,  # [Q, dk]
     k_ref,
@@ -285,12 +362,12 @@ def _kda_chunk_kernel(
     num_chunks: int,
 ):
     """KDA: the GDN kernel with PER-CHANNEL decay.  Quadratic couplings
-    factorize around the chunk-midpoint decay (reference
-    kda_kernels/recurrent_kda.py semantics; same factorization as
-    gdn.kda_chunk_prefill): ``exp(acum_i - acum_j) = f_i * g_j`` with
-    ``f = exp(acum - mid)``, ``g = exp(mid - acum)`` — valid while each
-    channel's half-chunk decay stays inside fp32 range (Q=128: per-token
-    decay >= ~0.26; trained sigmoid gates sit far above)."""
+    come from :func:`_kda_pair_scores` — block-row assembly whose
+    history factors are one-sided (<= 1, safe at any decay) and whose
+    diagonal blocks factor over a 16-token span, so the usable per-token
+    decay domain reaches alpha ~0.007 (vs ~0.3 for a whole-chunk
+    midpoint factorization).  Reference semantics:
+    kda_kernels/recurrent_kda.py."""
     c = pl.program_id(2)
     Q = q_ref.shape[0]
     dk = q_ref.shape[1]
@@ -305,19 +382,10 @@ def _kda_chunk_kernel(
     acum = acum_ref[...]
     beta = scal_ref[...][:, 0:1]
 
-    mid = acum[Q // 2 : Q // 2 + 1, :]  # [1, dk]
-    f = jnp.exp(acum - jnp.broadcast_to(mid, (Q, dk)))
-    g = jnp.exp(jnp.broadcast_to(mid, (Q, dk)) - acum)
-    k_f = kf0 * f
-    k_g = kf0 * g
-    q_f = qf0 * f
-
     strict, causal, eye = _masks(Q)
 
-    C = strict * beta * jax.lax.dot_general(
-        k_f, k_g, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    a_kk, a_qk = _kda_pair_scores(qf0, kf0, acum, Q, dk)
+    C = strict * beta * a_kk
     ainv = _neumann_inv(C, eye)
 
     D = jnp.exp(acum)  # [Q, dk] elementwise <= 1
@@ -335,10 +403,7 @@ def _kda_chunk_kernel(
         preferred_element_type=jnp.float32,
     )
 
-    P = causal * jax.lax.dot_general(
-        q_f, k_g, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    P = causal * a_qk
     o = jax.lax.dot_general(
         D * qf0, s0, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,

@@ -359,3 +359,91 @@ def test_kda_pallas_kernel_matches_exact_recurrence():
     np.testing.assert_allclose(
         np.asarray(s), np.asarray(s_ref), rtol=4e-2, atol=4e-2
     )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_kda_pallas_kernel_aggressive_decay_fuzz(seed):
+    """VERDICT r3 #3: the kernel must serve the decay regime KDA models
+    actually use.  Per-channel alpha log-uniform over [0.02, 1) — far
+    below the old whole-chunk factorization's ~0.3 floor — fuzzed vs the
+    exact sequential recurrence in f32, nonzero initial state."""
+    from flashinfer_tpu.gdn import kda_chunk_prefill
+
+    rng = np.random.default_rng(100 + seed)
+    B, L, H, dk, dv = 2, 256, 2, 128, 128
+    q = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.float32)
+    # log-uniform alpha in [0.02, 1)
+    alpha = jnp.asarray(
+        np.exp(rng.uniform(np.log(0.02), 0.0, (B, L, H, dk))), jnp.float32
+    )
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    s0 = jnp.asarray(rng.standard_normal((B, H, dk, dv)) * 0.3, jnp.float32)
+    o_ref, s_ref = fi.kda_prefill(q, k, v, alpha, beta, initial_state=s0)
+    o, s = kda_chunk_prefill(q, k, v, alpha, beta, backend="pallas",
+                             initial_state=s0)
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(o_ref), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_kda_pallas_kernel_extreme_decay_floor():
+    """At the documented ~0.007 floor (uniform worst case) the kernel
+    stays finite and matches the exact recurrence."""
+    from flashinfer_tpu.gdn import kda_chunk_prefill
+
+    rng = np.random.default_rng(7)
+    B, L, H, dk, dv = 1, 128, 1, 128, 128
+    q = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.float32)
+    alpha = jnp.full((B, L, H, dk), 0.007, jnp.float32)
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    o_ref, s_ref = fi.kda_prefill(q, k, v, alpha, beta)
+    o, s = kda_chunk_prefill(q, k, v, alpha, beta, backend="pallas")
+    assert np.isfinite(np.asarray(o)).all()
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(o_ref), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(s_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_kda_pallas_env_opt_in(monkeypatch):
+    """FLASHINFER_TPU_KDA_BACKEND=pallas routes auto callers to the
+    kernel on eligible shapes and falls back on ineligible ones."""
+    from flashinfer_tpu.gdn import kda_chunk_prefill
+
+    rng = np.random.default_rng(11)
+    monkeypatch.setenv("FLASHINFER_TPU_KDA_BACKEND", "pallas")
+    B, L, H, dk, dv = 1, 128, 1, 128, 128
+    q = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, H, dk)) / np.sqrt(dk),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, H, dv)), jnp.float32)
+    alpha = jnp.asarray(0.4 + 0.5 * rng.random((B, L, H, dk)), jnp.float32)
+    beta = jnp.asarray(rng.random((B, L, H)), jnp.float32)
+    o_ref, _ = fi.kda_prefill(q, k, v, alpha, beta)
+    o, _ = kda_chunk_prefill(q, k, v, alpha, beta)  # auto -> env -> pallas
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(o_ref), rtol=2e-3, atol=2e-3
+    )
+    # ineligible length falls back to xla instead of raising — and the
+    # fallback must produce the right VALUES, not just the right shape
+    o2, _ = kda_chunk_prefill(q[:, :96], k[:, :96], v[:, :96],
+                              alpha[:, :96], beta[:, :96])
+    o2_ref, _ = fi.kda_prefill(q[:, :96], k[:, :96], v[:, :96],
+                               alpha[:, :96], beta[:, :96])
+    np.testing.assert_allclose(
+        np.asarray(o2), np.asarray(o2_ref), rtol=2e-3, atol=2e-3
+    )
