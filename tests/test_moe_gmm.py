@@ -111,10 +111,13 @@ class TestGmm:
 
 
 class TestGatherGmm:
+    @pytest.mark.parametrize("variant", ["stream", "rowcache"])
     @pytest.mark.parametrize("seed", range(3))
-    def test_matches_explicit_gather(self, seed):
+    def test_matches_explicit_gather(self, seed, variant):
         rng = np.random.default_rng(seed + 20)
-        t_rows, k, n, e, topk = 96, 256, 128, 4, 2
+        # n=256, tk=128 -> (tiles_n * tiles_k) = 4: past the rowcache
+        # small-sweep guard, so the variant under test actually runs
+        t_rows, k, n, e, topk = 96, 256, 256, 4, 2
         m = t_rows * topk
         sizes = _sizes(rng, e, m, with_empty=True)
         x = jnp.asarray(rng.standard_normal((t_rows, k)), jnp.bfloat16)
@@ -122,15 +125,16 @@ class TestGatherGmm:
         rhs = jnp.asarray(rng.standard_normal((e, k, n)) / np.sqrt(k),
                           jnp.bfloat16)
         fused = gather_gmm(x, row_ids, rhs, jnp.asarray(sizes),
-                           tm=64, tn=128, tk=128)
+                           tm=64, tn=128, tk=128, variant=variant)
         ref = _oracle(np.asarray(x)[np.asarray(row_ids)], rhs, sizes)
         np.testing.assert_allclose(
             np.asarray(fused, np.float32), ref, rtol=5e-2, atol=5e-2
         )
 
-    def test_int8_gather(self):
+    @pytest.mark.parametrize("variant", ["stream", "rowcache"])
+    def test_int8_gather(self, variant):
         rng = np.random.default_rng(42)
-        t_rows, k, n, e = 64, 128, 128, 3
+        t_rows, k, n, e = 64, 256, 256, 3
         m = t_rows * 2
         sizes = _sizes(rng, e, m, with_empty=False)
         x = jnp.asarray(rng.integers(-127, 127, (t_rows, k)), jnp.int8)
@@ -139,10 +143,57 @@ class TestGatherGmm:
         xs = jnp.asarray(rng.random(t_rows) * 0.01 + 0.001, jnp.float32)
         ws = jnp.asarray(rng.random((e, n)) * 0.01 + 0.001, jnp.float32)
         out = gather_gmm(x, row_ids, rhs, jnp.asarray(sizes), xs, ws,
-                         tm=64, tn=128, tk=128)
+                         tm=64, tn=128, tk=128, variant=variant)
         ref = _oracle(np.asarray(x)[np.asarray(row_ids)], rhs, sizes)
         ref *= np.asarray(xs)[np.asarray(row_ids)][:, None]
         offsets = np.concatenate([[0], np.cumsum(sizes)])
         for g in range(e):
             ref[offsets[g]:offsets[g + 1]] *= np.asarray(ws)[g][None, :]
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-3)
+
+    def test_rowcache_boundary_straddling_groups(self):
+        """Groups deliberately starting mid-tile: the rowcache variant's
+        non-consecutive output-block revisits must merge through the
+        aliased HBM block (every row stored exactly once, none lost)."""
+        rng = np.random.default_rng(9)
+        t_rows, k, n = 128, 256, 256
+        m = 256
+        sizes = np.asarray([37, 90, 56, 73], np.int32)
+        assert sizes.sum() == m
+        # starts 37, 127, 183: every group boundary is mid-tile at tm=64
+        assert all(s % 64 for s in np.cumsum(sizes)[:-1])
+        x = jnp.asarray(rng.standard_normal((t_rows, k)), jnp.bfloat16)
+        row_ids = jnp.asarray(rng.integers(0, t_rows, m), jnp.int32)
+        rhs = jnp.asarray(rng.standard_normal((4, k, n)) / np.sqrt(k),
+                          jnp.bfloat16)
+        out = gather_gmm(x, row_ids, rhs, jnp.asarray(sizes),
+                         tm=64, tn=128, tk=128, variant="rowcache")
+        ref = _oracle(np.asarray(x)[np.asarray(row_ids)], rhs, sizes)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), ref, rtol=5e-2, atol=5e-2
+        )
+
+    def test_rowcache_guards(self):
+        """Tiny (n, k) sweeps silently fall back to stream; oversized row
+        buffers raise on explicit rowcache."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((32, 128)), jnp.bfloat16)
+        row_ids = jnp.arange(64, dtype=jnp.int32) % 32
+        rhs = jnp.asarray(rng.standard_normal((2, 128, 128)), jnp.bfloat16)
+        sizes = jnp.asarray([32, 32], jnp.int32)
+        # tiles_n * tiles_k == 1 -> guard downgrades; result still correct
+        out = gather_gmm(x, row_ids, rhs, sizes, tm=64, variant="rowcache")
+        ref = _oracle(np.asarray(x)[np.asarray(row_ids)], rhs,
+                      np.asarray(sizes))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), ref, rtol=5e-2, atol=5e-2
+        )
+        import flashinfer_tpu.ops.moe_gmm as mg
+
+        big_k = mg._ROWCACHE_VMEM_CAP // 128 * 2 + 256
+        with pytest.raises(ValueError, match="exceeds"):
+            gather_gmm(
+                jnp.zeros((8, big_k), jnp.bfloat16), row_ids,
+                jnp.zeros((2, big_k, 128), jnp.bfloat16), sizes,
+                tm=128, variant="rowcache",
+            )
