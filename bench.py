@@ -322,6 +322,11 @@ def phase_scans(sweep: bool):
                                     (H, dim, ds)))
     Bd = jax.random.normal(jax.random.fold_in(key, 8), (B, G, ds))
     Cd = jax.random.normal(jax.random.fold_in(key, 9), (B, G, ds))
+    # decode steps are state-bandwidth-bound (the [.., dk, dv] f32 state
+    # is read+written once per token); pct_roofline against the HBM spec
+    # is the go/no-go signal for a Pallas decode kernel (VERDICT r3 #8):
+    # XLA already streaming near roofline = no kernel justified
+    hbm_gbps = chip_peak_tbps() * 1000.0  # per-generation HBM spec
     t = _guard(
         "bench.scans.mamba_decode", (B, H, dim, ds),
         lambda: bench_fn_device(
@@ -331,8 +336,38 @@ def phase_scans(sweep: bool):
     )
     state_bytes = 2 * B * H * dim * ds * 4  # read + write f32 state
     _emit_row(phase="scans", op="mamba_decode", B=B,
-              us=round(t * 1e6, 1), gbps=round(state_bytes / t / 1e9, 1))
+              us=round(t * 1e6, 1), gbps=round(state_bytes / t / 1e9, 1),
+              pct_roofline=round(state_bytes / t / 1e9 / hbm_gbps * 100, 1))
     print(f"# scans mamba_decode:  {t*1e6:9.1f} us", file=sys.stderr)
+
+    # --- GDN / KDA decode steps (same roofline protocol) ---
+    sg = jax.random.normal(key, (B, Hg, dk, dv), jnp.float32)
+    qd = jax.random.normal(jax.random.fold_in(key, 20), (B, Hg, dk)) * 0.3
+    kd = jax.random.normal(jax.random.fold_in(key, 21), (B, Hg, dk)) * 0.3
+    vd = jax.random.normal(jax.random.fold_in(key, 22), (B, Hg, dv))
+    bd = jax.nn.sigmoid(
+        jax.random.normal(jax.random.fold_in(key, 23), (B, Hg)))
+    ag_d = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 24), (B, Hg)))
+    ak_d = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 25), (B, Hg, dk)))
+    gstate_bytes = 2 * B * Hg * dk * dv * 4
+    for dname, dfn, da in (
+        ("gdn_decode",
+         lambda *a: gdn_mod.gdn_decode_step(*a)[1], ag_d),
+        ("kda_decode",
+         lambda *a: gdn_mod.kda_decode_step(*a)[1], ak_d),
+    ):
+        t = _guard(
+            f"bench.scans.{dname}", (B, Hg, dk, dv),
+            lambda: bench_fn_device(dfn, sg, qd, kd, vd, da, bd, repeats=5),
+        )
+        _emit_row(
+            phase="scans", op=dname, B=B, us=round(t * 1e6, 1),
+            gbps=round(gstate_bytes / t / 1e9, 1),
+            pct_roofline=round(gstate_bytes / t / 1e9 / hbm_gbps * 100, 1),
+        )
+        print(f"# scans {dname}:  {t*1e6:9.1f} us", file=sys.stderr)
 
     # --- GDN / KDA chunked prefill ---
     q = jax.random.normal(key, (B, L, Hg, dk), jnp.float32) * 0.3
