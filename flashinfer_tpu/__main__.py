@@ -183,6 +183,21 @@ def cmd_tuner_status(_args) -> int:
     return 0
 
 
+def cmd_tune(args) -> int:
+    """Profile the serving-critical op families on the live chip and write
+    the tactics straight into tuning_configs/<chip>.json after every
+    stage — the production path the recovery watchdog invokes after the
+    hardware tier (no manual merge step)."""
+    from flashinfer_tpu.tune import run_tuning_workload
+
+    path = run_tuning_workload(
+        stages=args.stage or None, merge_stem=args.stem,
+        log=lambda m: print(m, flush=True),
+    )
+    print(f"tuning config written: {path}")
+    return 0
+
+
 def cmd_probe(args) -> int:
     """Chip-health probe: compile a trivial kernel in a subprocess under a
     timeout (the post-wedge recovery detector)."""
@@ -228,6 +243,17 @@ def main(argv=None) -> int:
     sp = sub.add_parser("probe")
     sp.add_argument("--timeout", type=float, default=240.0)
     sp.set_defaults(fn=cmd_probe)
+    sp = sub.add_parser("tune")
+    sp.add_argument(
+        "--stage", action="append",
+        choices=["norm", "decode", "prefill", "flash"],
+        help="run only these stages (default: all, wedge-safe order)",
+    )
+    sp.add_argument(
+        "--stem", default=None,
+        help="tuning_configs file stem (default: from device_kind)",
+    )
+    sp.set_defaults(fn=cmd_tune)
     sp = sub.add_parser("quarantine")
     sp.add_argument(
         "--clear", nargs="?", const="", default=None,

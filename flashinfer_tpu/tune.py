@@ -1,0 +1,144 @@
+"""Production autotuning runbook: ``flashinfer_tpu tune``.
+
+The reference ships per-arch tuned configs produced by an offline tuning
+run (``flashinfer/tuning_configs/`` + the autotuner harness); this module
+is the TPU analogue as a CLI command rather than a scratch script
+(VERDICT r3 #9: the config-production path must be invokable by the
+recovery watchdog with no manual merge step).
+
+Ordering follows the chip-health discipline from the wedge history:
+cheap/known-good kernel families first, flash-kernel block variants LAST,
+so a late Mosaic hang still leaves a mergeable config on disk after every
+completed stage (``merge_into_shipped`` runs incrementally).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+
+def _shipped_path(stem: str) -> Path:
+    return Path(__file__).parent / "tuning_configs" / f"{stem}.json"
+
+
+def merge_into_shipped(stem: Optional[str] = None) -> Path:
+    """Merge the live AutoTuner cache into ``tuning_configs/<stem>.json``.
+
+    Freshly profiled tactics override shipped entries of the same key;
+    everything else is preserved.  Returns the written path."""
+    from flashinfer_tpu.autotuner import AutoTuner, _device_config_key
+    from flashinfer_tpu.utils import atomic_write_text
+
+    stem = stem or _device_config_key()
+    if stem is None:
+        raise RuntimeError(
+            "cannot map this device_kind to a tuning-config stem; pass one "
+            "explicitly (e.g. 'v5e')"
+        )
+    tuner = AutoTuner.get()
+    tuner._load()
+    path = _shipped_path(stem)
+    try:
+        shipped = json.loads(path.read_text())
+    except Exception:
+        shipped = {
+            "comment": f"Pre-tuned tactics for TPU {stem} "
+                       "(reference analogue: flashinfer/tuning_configs/).",
+            "tactics": {},
+        }
+    shipped.setdefault("tactics", {}).update(tuner._cache)
+    atomic_write_text(path, json.dumps(shipped, indent=1))
+    return path
+
+
+def run_tuning_workload(stages: Optional[list] = None,
+                        merge_stem: Optional[str] = None,
+                        log=print) -> Path:
+    """Profile the serving-critical op families on the live chip and write
+    the shipped config after EVERY stage (a late wedge keeps earlier
+    stages' tactics).  Returns the config path."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.autotuner import autotune
+
+    H, HQ, HKV, D, PS = 4096, 32, 8, 128, 16  # Llama-3-8B shapes
+
+    def stage_norm():
+        w = jnp.ones((H,), jnp.bfloat16)
+        for t in (1024, 4096, 8192):
+            x = jnp.asarray(np.random.randn(t, H), jnp.bfloat16)
+            fi.rmsnorm(x, w)
+            fi.fused_add_rmsnorm(x, x, w)
+            log(f"norm tuned t={t}")
+
+    def stage_decode():
+        for bs, ctx in ((64, 4096), (16, 4096), (64, 8192), (256, 2048)):
+            pages_per_req = ctx // PS
+            npages = bs * pages_per_req + 1
+            k_cache = jnp.asarray(
+                np.random.randn(npages, HKV, PS, D) / 8, jnp.bfloat16)
+            v_cache = jnp.asarray(
+                np.random.randn(npages, HKV, PS, D) / 8, jnp.bfloat16)
+            wrap = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+            wrap.plan(
+                np.arange(bs + 1) * pages_per_req,
+                np.arange(bs * pages_per_req),
+                np.full((bs,), PS),
+                HQ, HKV, D, PS, q_data_type=jnp.bfloat16,
+            )
+            q = jnp.asarray(np.random.randn(bs, HQ, D), jnp.bfloat16)
+            wrap.run(q, (k_cache, v_cache))
+            log(f"decode tuned bs={bs} ctx={ctx}")
+
+    def stage_prefill():
+        for bs, qlen, ctx in ((4, 1024, 4096), (8, 512, 4096),
+                              (1, 8192, 8192)):
+            pages_per_req = ctx // PS
+            npages = bs * pages_per_req + 1
+            k_cache = jnp.asarray(
+                np.random.randn(npages, HKV, PS, D) / 8, jnp.bfloat16)
+            v_cache = jnp.asarray(
+                np.random.randn(npages, HKV, PS, D) / 8, jnp.bfloat16)
+            wrap = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+            wrap.plan(
+                np.arange(bs + 1) * qlen,
+                np.arange(bs + 1) * pages_per_req,
+                np.arange(bs * pages_per_req),
+                np.full((bs,), PS),
+                HQ, HKV, D, PS, causal=True,
+            )
+            q = jnp.asarray(np.random.randn(bs * qlen, HQ, D), jnp.bfloat16)
+            wrap.run(q, (k_cache, v_cache))
+            log(f"fused prefill tuned bs={bs} qlen={qlen}")
+
+    def stage_flash():
+        # LAST: the most first-compiles — a hang here keeps prior stages
+        for t in (2048, 4096, 8192):
+            q = jnp.asarray(np.random.randn(t, HQ, D), jnp.bfloat16)
+            k = jnp.asarray(np.random.randn(t, HKV, D), jnp.bfloat16)
+            v = jnp.asarray(np.random.randn(t, HKV, D), jnp.bfloat16)
+            fi.single_prefill_with_kv_cache(q, k, v, causal=True)
+            log(f"flash tuned t={t}")
+
+    all_stages = [
+        ("norm", stage_norm),
+        ("decode", stage_decode),
+        ("prefill", stage_prefill),
+        ("flash", stage_flash),
+    ]
+    selected = (
+        [s for s in all_stages if s[0] in stages] if stages else all_stages
+    )
+    log(f"device: {jax.devices()[0].device_kind}")
+    path = None
+    with autotune():
+        for name, fn in selected:
+            fn()
+            path = merge_into_shipped(merge_stem)
+            log(f"stage {name} merged -> {path}")
+    return path

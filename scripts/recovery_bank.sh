@@ -63,4 +63,18 @@ PY
 done
 git add HW_TIER_LOG.txt 2>> "$LOG"
 git commit -m "Bank hardware correctness tier log" >> "$LOG" 2>&1
+
+# ---- 4. autotune: tactics straight into the shipped config (the CLI
+# merges after every stage, so a late wedge still leaves a config).
+# Re-probe first: the hw tier above may have ended on a re-wedge, and an
+# hour-long tune against a wedged chip banks nothing. ----
+if timeout 400 python -m flashinfer_tpu probe --timeout 300 2>&1 \
+    | grep -q '"healthy": true'; then
+  timeout 3600 python -m flashinfer_tpu tune >> "$LOG" 2>&1
+  echo "[$(ts)] tune rc=$?" >> "$LOG"
+  git add flashinfer_tpu/tuning_configs 2>> "$LOG"
+  git commit -m "Bank autotuned tactics into the shipped tuning config" >> "$LOG" 2>&1
+else
+  echo "[$(ts)] chip wedged before tune — skipping autotune step" >> "$LOG"
+fi
 echo "[$(ts)] recovery banking complete" >> "$LOG"

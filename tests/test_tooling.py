@@ -217,3 +217,48 @@ def test_cli_replay_bf16(tmp_path):
                   env_extra={"FLASHINFER_TPU_LOGLEVEL": "0"})
     assert r2.returncode == 0, r2.stderr + r2.stdout
     assert "replayed rmsnorm" in r2.stdout
+
+
+def test_tune_merge_into_shipped(monkeypatch, tmp_path):
+    """`flashinfer_tpu tune` path: the live AutoTuner cache merges straight
+    into tuning_configs/<stem>.json — fresh tactics override same-key
+    shipped entries, everything else is preserved (VERDICT r3 #9: no
+    manual merge step)."""
+    from flashinfer_tpu import tune as tune_mod
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    t = AutoTuner.get()
+    t._load()
+    monkeypatch.setattr(t, "_cache", {"fake.op|1_2": 7})
+    monkeypatch.setattr(
+        tune_mod, "_shipped_path", lambda stem: tmp_path / f"{stem}.json"
+    )
+    # seed a pre-existing shipped config with one stale and one unrelated key
+    (tmp_path / "v5etest.json").write_text(json.dumps(
+        {"comment": "seed",
+         "tactics": {"fake.op|1_2": 1, "other.op|3": 4}}
+    ))
+    p = tune_mod.merge_into_shipped("v5etest")
+    data = json.loads(p.read_text())
+    assert data["tactics"]["fake.op|1_2"] == 7  # fresh overrides stale
+    assert data["tactics"]["other.op|3"] == 4  # unrelated preserved
+    assert data["comment"] == "seed"
+    # a missing config file is created whole
+    p2 = tune_mod.merge_into_shipped("brandnew")
+    assert json.loads(p2.read_text())["tactics"] == {"fake.op|1_2": 7}
+
+
+def test_tune_workload_stage_selection(monkeypatch, tmp_path):
+    """run_tuning_workload honors stage selection and merges after every
+    stage (the wedge-safety property)."""
+    from flashinfer_tpu import tune as tune_mod
+
+    calls = []
+    monkeypatch.setattr(
+        tune_mod, "merge_into_shipped",
+        lambda stem=None: calls.append(stem) or (tmp_path / "x.json"),
+    )
+    # stub the heavy stages by shrinking the workload: select none of the
+    # real stages -> no profiling, no merge
+    path = tune_mod.run_tuning_workload(stages=["nope"], log=lambda m: None)
+    assert path is None and calls == []
