@@ -29,18 +29,17 @@ from flashinfer_tpu.prefill import (  # noqa: F401
 )
 from flashinfer_tpu.gemm import (  # noqa: F401
     SegmentGEMMWrapper,
-    bmm_bf16,
-    bmm_fp8,
     group_gemm_fp4,
     group_gemm_fp8_nt_groupwise,
     group_gemm_int8,
     grouped_gemm,
-    mm_bf16,
     mm_fp4,
-    mm_fp8,
     mm_fp8_groupwise,
     mm_int8,
 )
+# mm_bf16 / bmm_bf16 / mm_fp8 / bmm_fp8 arrive via the compat star-import
+# below as REFERENCE-signature adapters (compat_calls.py); the TPU-native
+# forms live on flashinfer_tpu.gemm for internal/positional callers
 from flashinfer_tpu.quantization import (  # noqa: F401
     dequantize_fp4,
     dequantize_fp8,

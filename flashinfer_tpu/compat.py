@@ -51,10 +51,30 @@ from flashinfer_tpu.fused_moe import (
 )
 from flashinfer_tpu.gemm import (
     grouped_gemm,
-    mm_bf16,
     mm_fp4,
-    mm_fp8,
     mm_svdquant,
+)
+
+# call-compatible adapters: reference signatures, TPU ops underneath
+# (VERDICT r3 #5 — name parity promoted to call parity; see the module
+# docstring for the rejected-semantics policy)
+from flashinfer_tpu.compat_calls import (
+    bmm_bf16,
+    bmm_fp8,
+    bmm_mxfp8,
+    cutlass_fused_moe,
+    fp4_quantize,
+    grouped_mm_bf16,
+    grouped_mm_fp4,
+    grouped_mm_fp8,
+    grouped_mm_mxfp8,
+    mm_bf16,
+    mm_fp8,
+    mxfp8_quantize,
+    trtllm_bf16_moe,
+    trtllm_fp4_block_scale_moe,
+    trtllm_fp8_block_scale_moe,
+    trtllm_fp8_per_tensor_scale_moe,
 )
 from flashinfer_tpu.norm import (
     fused_add_rmsnorm_quant_fp8,
@@ -318,13 +338,10 @@ from flashinfer_tpu.pod import (  # noqa: E402
 # fused_moe (backend dispatch happens inside; see fused_moe docstring)
 # ---------------------------------------------------------------------------
 
-cutlass_fused_moe = _fused_moe
-b12x_fused_moe = _fused_moe
-cute_dsl_fused_moe_nvfp4 = _fused_moe
-trtllm_bf16_moe = _fused_moe
-trtllm_fp8_block_scale_moe = _fused_moe
-trtllm_fp8_per_tensor_scale_moe = _fused_moe
-trtllm_fp4_block_scale_moe = _fused_moe
+# trtllm_*_moe / cutlass_fused_moe are call-compatible adapters imported
+# from compat_calls above; the remaining backend-brand names share them
+b12x_fused_moe = cutlass_fused_moe
+cute_dsl_fused_moe_nvfp4 = cutlass_fused_moe
 B12xMoEWrapper = MoE
 CuteDslMoEWrapper = MoE
 
@@ -347,12 +364,8 @@ trtllm_fp4_block_scale_routed_moe = _routed_moe
 # GEMM family: vendor-dtype names -> the TPU precision story
 # ---------------------------------------------------------------------------
 
-grouped_mm_bf16 = grouped_gemm
-grouped_mm_fp8 = grouped_gemm
-grouped_mm_mxfp8 = grouped_gemm
-grouped_mm_fp4 = grouped_gemm
+# grouped_mm_* / bmm_mxfp8 are call-compatible adapters (compat_calls)
 mm_mxfp8 = mm_fp8
-bmm_mxfp8 = mm_fp8
 
 
 def mm_bf16_fp4(a: jax.Array, b_prepared, block_size: int = 16,
@@ -414,16 +427,15 @@ def reorder_rows_for_gated_act_gemm(w, *_, **__):
 # fp4 / mxfp quantization family -> block-int4 + fp8 storage forms
 # ---------------------------------------------------------------------------
 
-fp4_quantize = quantize_fp4
-nvfp4_quantize = quantize_fp4
-mxfp4_quantize = quantize_fp4
-nvfp4_quantize_smooth = quantize_fp4
-nvfp4_batched_quantize = quantize_fp4
-scaled_fp4_grouped_quantize = quantize_fp4
+# fp4_quantize / mxfp8_quantize are call-compatible adapters (compat_calls)
+nvfp4_quantize = fp4_quantize
+mxfp4_quantize = fp4_quantize
+nvfp4_quantize_smooth = fp4_quantize
+nvfp4_batched_quantize = fp4_quantize
+scaled_fp4_grouped_quantize = fp4_quantize
 mxfp4_dequantize = dequantize_fp4
 mxfp4_dequantize_host = dequantize_fp4
-mxfp8_quantize = quantize_fp8_per_tensor
-mxfp8_grouped_quantize = quantize_fp8_per_tensor
+mxfp8_grouped_quantize = mxfp8_quantize
 mxfp8_dequantize_host = dequantize_fp8
 
 

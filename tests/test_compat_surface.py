@@ -292,3 +292,216 @@ def test_second_batch_compat_behaviors():
     assert utils.get_indptr([3, 4]).tolist() == [0, 3, 7]
     assert not utils.is_sm90a_supported()
     assert utils.determine_gemm_backend() == "xla"
+
+
+# ---------------------------------------------------------------------------
+# Call parity (VERDICT r3 #5): reference-style CALL SEQUENCES at tiny
+# shapes must run unmodified — hasattr is not migration parity.  Shapes/
+# argument orders below are lifted from the reference signatures cited in
+# compat_calls.py.
+# ---------------------------------------------------------------------------
+
+
+def _moe_weights(E, H, I, key=0):
+    rng = np.random.default_rng(key)
+    # reference MajorK layout: [E, out_dim, in_dim]
+    g1 = jnp.asarray(rng.standard_normal((E, 2 * I, H)) * 0.1, jnp.bfloat16)
+    g2 = jnp.asarray(rng.standard_normal((E, H, I)) * 0.1, jnp.bfloat16)
+    return g1, g2
+
+
+def _moe_oracle(x, g1, g2, wts, ids, E):
+    from flashinfer_tpu.fused_moe import fused_moe
+
+    return fused_moe(x, jnp.swapaxes(g1, 1, 2), jnp.swapaxes(g2, 1, 2),
+                     wts, ids, E)
+
+
+def test_call_parity_trtllm_bf16_moe():
+    """Positional reference call (fused_moe/core.py:3012) runs and
+    matches the routed oracle."""
+    T, E, K, H, I = 16, 4, 2, 64, 64
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    g1, g2 = _moe_weights(E, H, I)
+    out = fi.trtllm_bf16_moe(
+        logits, None, x, g1, g2, E, K, None, None, I, 0, E,
+        routing_method_type=1,
+    )
+    from flashinfer_tpu.fused_moe import route_renormalize
+
+    wts, ids = route_renormalize(logits, K)
+    ref = _moe_oracle(x, g1, g2, wts, ids, E)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_call_parity_trtllm_fp8_block_scale_moe():
+    """fp8 values + reference-layout block scales (core.py:3571):
+    hidden_states_scale is [H//bs, T], weight scales [E, M//bs, H//bs]."""
+    T, E, K, H, I, BS = 8, 4, 2, 128, 64, 64
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    xq = jnp.asarray(rng.standard_normal((T, H)), jnp.float8_e4m3fn)
+    xs = jnp.full((H // BS, T), 0.5, jnp.float32)
+    w1q = jnp.asarray(rng.standard_normal((E, 2 * I, H)),
+                      jnp.float8_e4m3fn)
+    w1s = jnp.full((E, 2 * I // BS, H // BS), 0.01, jnp.float32)
+    w2q = jnp.asarray(rng.standard_normal((E, H, I)), jnp.float8_e4m3fn)
+    w2s = jnp.full((E, H // BS, I // BS), 0.01, jnp.float32)
+    out = fi.trtllm_fp8_block_scale_moe(
+        logits, None, xq, xs, w1q, w1s, w2q, w2s,
+        E, K, None, None, I, 0, E, None, routing_method_type=1,
+    )
+    assert out.shape == (T, H)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # oracle: dequantize by hand, run the routed pipeline
+    from flashinfer_tpu.fused_moe import route_renormalize
+
+    wts, ids = route_renormalize(logits, K)
+    xf = (np.asarray(xq, np.float32) * 0.5).astype(np.float32)
+    w1f = np.asarray(w1q, np.float32) * 0.01
+    w2f = np.asarray(w2q, np.float32) * 0.01
+    ref = _moe_oracle(
+        jnp.asarray(xf, jnp.bfloat16), jnp.asarray(w1f, jnp.bfloat16),
+        jnp.asarray(w2f, jnp.bfloat16), wts, ids, E,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_call_parity_cutlass_fused_moe():
+    """Pre-routed entry (core.py:873): token_selected_experts +
+    token_final_scales in, combined output out."""
+    T, E, K, H, I = 16, 4, 2, 64, 64
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    ids = jnp.asarray(rng.integers(0, E, (T, K)), jnp.int32)
+    wts = jnp.full((T, K), 0.5, jnp.float32)
+    g1, g2 = _moe_weights(E, H, I)
+    out = fi.cutlass_fused_moe(x, ids, wts, g1, g2, jnp.bfloat16, [])
+    ref = _moe_oracle(x, g1, g2, wts, ids, E)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_call_parity_moe_loud_errors():
+    """Unsupported semantics fail with actionable messages, not silent
+    wrong numerics."""
+    T, E, K, H, I = 4, 2, 1, 64, 64
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    g1, g2 = _moe_weights(E, H, I)
+    with pytest.raises(ValueError, match="do_finalize"):
+        fi.trtllm_bf16_moe(logits, None, x, g1, g2, E, K, None, None, I,
+                           0, E, do_finalize=False)
+    with pytest.raises(ValueError, match="MajorK"):
+        fi.trtllm_bf16_moe(
+            logits, None, x,
+            jnp.zeros((E, 2 * I // 64, H, 64), jnp.bfloat16), g2,
+            E, K, None, None, I, 0, E,
+        )
+    with pytest.raises(ValueError, match="shard_map"):
+        fi.trtllm_bf16_moe(logits, None, x, g1, g2, E, K, None, None, I,
+                           1, 1)
+    with pytest.raises(ValueError, match="routing_method_type"):
+        fi.trtllm_bf16_moe(logits, None, x, g1, g2, E, K, None, None, I,
+                           0, E, routing_method_type=7)
+    with pytest.raises(ValueError, match="out"):
+        fi.cutlass_fused_moe(x, jnp.zeros((T, 1), jnp.int32),
+                             jnp.ones((T, 1)), g1, g2, jnp.bfloat16, [],
+                             output=jnp.zeros((T, H)))
+    # numerics-affecting args must never be silently dropped
+    with pytest.raises(ValueError, match="quant_scales"):
+        fi.cutlass_fused_moe(x, jnp.zeros((T, 1), jnp.int32),
+                             jnp.ones((T, 1)), g1, g2, jnp.bfloat16,
+                             [jnp.ones(())])
+    with pytest.raises(ValueError, match="use_deepseek_fp8_block_scale"):
+        fi.cutlass_fused_moe(x, jnp.zeros((T, 1), jnp.int32),
+                             jnp.ones((T, 1)), g1, g2, jnp.bfloat16, [],
+                             use_deepseek_fp8_block_scale=True)
+    with pytest.raises(ValueError, match="gemm1_alpha"):
+        fi.trtllm_bf16_moe(logits, None, x, g1, g2, E, K, None, None, I,
+                           0, E, gemm1_alpha=jnp.ones((E,)))
+    with pytest.raises(ValueError, match="activation_type"):
+        fi.trtllm_bf16_moe(logits, None, x, g1, g2, E, K, None, None, I,
+                           0, E, activation_type=1)
+
+
+def test_call_parity_grouped_mm():
+    """Reference grouped_mm family (grouped_mm/core.py): b is [E, n, k],
+    segments from m_indptr, out = a[seg] @ b[e]^T."""
+    E, tpe, k, n = 3, 8, 64, 32
+    rng = np.random.default_rng(5)
+    a = jnp.asarray(rng.standard_normal((E * tpe, k)), jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((E, n, k)) * 0.1, jnp.bfloat16)
+    m_indptr = jnp.asarray(np.arange(E + 1) * tpe, jnp.int32)
+    out = fi.grouped_mm_bf16(a, b, m_indptr)
+    ref = np.concatenate([
+        np.asarray(a, np.float32)[e * tpe:(e + 1) * tpe]
+        @ np.asarray(b, np.float32)[e].T
+        for e in range(E)
+    ])
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-2, atol=2e-2
+    )
+    # fp8 twin with alpha
+    a8 = jnp.asarray(rng.standard_normal((E * tpe, k)), jnp.float8_e4m3fn)
+    out8 = fi.grouped_mm_fp8(a8, b, m_indptr, alpha=jnp.asarray([0.5]))
+    ref8 = 0.5 * np.concatenate([
+        np.asarray(a8, np.float32)[e * tpe:(e + 1) * tpe]
+        @ np.asarray(b, np.float32)[e].T
+        for e in range(E)
+    ])
+    np.testing.assert_allclose(
+        np.asarray(out8, np.float32), ref8, rtol=3e-2, atol=3e-2
+    )
+
+
+def test_call_parity_mm_family():
+    """mm_bf16 (a, b, bias, ...) and bmm twins run with reference
+    argument orders; out= raises."""
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.standard_normal((16, 64)), jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.bfloat16)
+    bias = jnp.asarray(rng.standard_normal((32,)), jnp.float32)
+    out = fi.mm_bf16(a, b, bias)
+    ref = (np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+           + np.asarray(bias))
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+    with pytest.raises(ValueError, match="out"):
+        fi.mm_bf16(a, b, None, False, jnp.zeros((16, 32)))
+    ab = jnp.asarray(rng.standard_normal((2, 8, 64)), jnp.float8_e4m3fn)
+    bb = jnp.asarray(rng.standard_normal((2, 64, 32)), jnp.float8_e4m3fn)
+    o = fi.bmm_mxfp8(ab, bb, jnp.float32(0.1), jnp.float32(0.1),
+                     jnp.float32)
+    refb = (np.asarray(ab, np.float32) * 0.1) @ (
+        np.asarray(bb, np.float32) * 0.1)
+    np.testing.assert_allclose(np.asarray(o), refb, rtol=3e-2, atol=3e-2)
+
+
+def test_call_parity_quantize_family():
+    """mxfp8_quantize / fp4_quantize reference signatures round-trip."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((8, 128)), jnp.bfloat16)
+    q, sf = fi.mxfp8_quantize(x, True, 32)
+    assert q.shape == (8, 128) and sf.shape == (8, 4)
+    back = np.asarray(q, np.float32) * np.repeat(np.asarray(sf), 32, -1)
+    np.testing.assert_allclose(back, np.asarray(x, np.float32),
+                               rtol=0.1, atol=0.1)
+    q4, sf4 = fi.fp4_quantize(x, jnp.asarray([1.0]), 16)
+    assert q4.shape == (8, 64) and sf4.shape == (8, 8)
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    back4 = np.asarray(dequantize_fp4(q4, sf4), np.float32)
+    np.testing.assert_allclose(back4, np.asarray(x, np.float32),
+                               rtol=0.35, atol=0.35)
