@@ -14,3 +14,9 @@ from flashinfer_tpu.models.mixtral import (  # noqa: F401
     make_ep_sharded_decode_step,
     mixtral_decode_step,
 )
+from flashinfer_tpu.models.deepseek import (  # noqa: F401
+    DeepseekConfig,
+    deepseek_decode_step,
+    init_deepseek_params,
+    make_ep_sharded_decode_step as make_deepseek_ep_decode_step,
+)

@@ -1,0 +1,177 @@
+"""DeepSeek-V3-style model family: absorbed MLA decode + DSv3-routed MoE
+(reference architecture served by flashinfer/mla + fused_moe +
+noAuxTcKernels; bench_deepseek_mla.py shapes scaled down)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from flashinfer_tpu.models.deepseek import (
+    DeepseekConfig,
+    deepseek_decode_step,
+    init_deepseek_params,
+    make_ep_sharded_decode_step,
+)
+from flashinfer_tpu.comm.mapping import Mapping
+
+
+def _state(cfg, B, pages_per_req, ps, seed=0):
+    params = init_deepseek_params(jax.random.PRNGKey(seed), cfg)
+    num_pages = B * pages_per_req
+    caches = [
+        (
+            jnp.zeros((num_pages, ps, cfg.kv_lora_rank), cfg.dtype),
+            # TPU-native kpe layout: lane-padded to 128
+            jnp.zeros((num_pages, ps, 128), cfg.dtype),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    table = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, pages_per_req)
+    return params, caches, table
+
+
+def test_decode_step_shapes_and_cache_writes():
+    cfg = DeepseekConfig.tiny()
+    B, ppr, ps = 4, 2, 8
+    params, caches, table = _state(cfg, B, ppr, ps)
+    kv_lens = jnp.full((B,), 5, jnp.int32)
+    tokens = jnp.arange(B, dtype=jnp.int32)
+    logits, new_caches = jax.jit(
+        lambda *a: deepseek_decode_step(params, cfg, *a)
+    )(tokens, kv_lens, caches, table, kv_lens)
+    assert logits.shape == (B, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    # the new token's ckv row landed at (page_of(pos=5), slot 5) per request
+    ckv = np.asarray(new_caches[0][0])
+    kpe = np.asarray(new_caches[0][1])
+    for b in range(B):
+        page = np.asarray(table)[b, 5 // ps]
+        assert np.abs(ckv[page, 5 % ps]).sum() > 0
+        assert np.abs(kpe[page, 5 % ps, : cfg.head_dim_kpe]).sum() > 0
+        assert np.abs(kpe[page, 5 % ps, cfg.head_dim_kpe:]).sum() == 0
+
+
+def test_absorbed_attention_matches_explicit():
+    """The absorption identity: attention computed in the latent space
+    (q_nope @ w_kc -> scores vs ckv; outputs un-absorbed via w_vc) must
+    equal the EXPLICIT per-head form (materialized k_nope = w_kc ckv and
+    v = w_vc ckv rows)."""
+    cfg = DeepseekConfig.tiny(num_layers=1, first_k_dense=1)
+    B, ppr, ps = 2, 2, 8
+    params, caches, table = _state(cfg, B, ppr, ps, seed=3)
+    layer = params["layers"][0]
+    kv_lens = jnp.full((B,), 9, jnp.int32)
+    # pre-fill the caches with history so attention sees real context
+    rng = np.random.default_rng(0)
+    ckv_hist = rng.standard_normal(
+        (B, 9, cfg.kv_lora_rank)).astype(np.float32)
+    kpe_hist = rng.standard_normal(
+        (B, 9, cfg.head_dim_kpe)).astype(np.float32)
+    ckv_c = np.array(caches[0][0])  # np.array: writable copies
+    kpe_c = np.array(caches[0][1])
+    for b in range(B):
+        for t in range(9):
+            page = np.asarray(table)[b, t // ps]
+            ckv_c[page, t % ps] = ckv_hist[b, t]
+            kpe_c[page, t % ps, : cfg.head_dim_kpe] = kpe_hist[b, t]
+    caches = [(jnp.asarray(ckv_c), jnp.asarray(kpe_c))]
+
+    tokens = jnp.arange(B, dtype=jnp.int32)
+    positions = kv_lens  # write at t=9
+    logits, new_caches = deepseek_decode_step(
+        params, cfg, tokens, positions, caches, table, kv_lens
+    )
+
+    # explicit oracle for the attention sublayer of layer 0
+    from flashinfer_tpu.norm import rmsnorm
+    from flashinfer_tpu.rope import apply_rope_pos_ids
+
+    x = np.asarray(params["embed"])[np.asarray(tokens)]
+    h = np.asarray(rmsnorm(jnp.asarray(x), layer["input_norm"],
+                           cfg.rms_eps))
+    q_lat = np.asarray(rmsnorm(jnp.asarray(h @ np.asarray(layer["q_a"])),
+                               layer["q_a_norm"], cfg.rms_eps))
+    H, nope, kpe_d = cfg.num_heads, cfg.head_dim_nope, cfg.head_dim_kpe
+    q = (q_lat @ np.asarray(layer["q_b"])).reshape(B, H, nope + kpe_d)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    kv = h @ np.asarray(layer["kv_a"])
+    ckv_new = np.asarray(rmsnorm(jnp.asarray(kv[:, : cfg.kv_lora_rank]),
+                                 layer["kv_a_norm"], cfg.rms_eps))
+    kpe_new = kv[:, None, cfg.kv_lora_rank:]
+    q_pe_r, kpe_new_r = apply_rope_pos_ids(
+        jnp.asarray(q_pe), jnp.asarray(kpe_new), positions,
+        rope_theta=cfg.rope_theta,
+    )
+    q_pe_r, kpe_new_r = np.asarray(q_pe_r), np.asarray(kpe_new_r)
+    w_kc = np.asarray(layer["w_kc"])  # [H, nope, ckv]
+    w_vc = np.asarray(layer["w_vc"])  # [H, ckv, nope]
+    sm = 1.0 / np.sqrt(nope + kpe_d)
+    o_explicit = np.zeros((B, H, nope), np.float32)
+    for b in range(B):
+        ckv_seq = np.concatenate([ckv_hist[b], ckv_new[b][None]], 0)
+        kpe_seq = np.concatenate([kpe_hist[b], kpe_new_r[b, 0][None]], 0)
+        for hh in range(H):
+            k_nope = ckv_seq @ w_kc[hh].T  # [T, nope] explicit keys
+            v = ckv_seq @ w_vc[hh]  # [T, nope] explicit values
+            s = (q_nope[b, hh] @ k_nope.T + q_pe_r[b, hh] @ kpe_seq.T) * sm
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            o_explicit[b, hh] = p @ v
+    attn_abs = np.asarray(
+        __import__("flashinfer_tpu.models.deepseek",
+                   fromlist=["_mla_attn_decode"])._mla_attn_decode(
+            jnp.asarray(h, cfg.dtype), layer, cfg, caches[0], table,
+            kv_lens, positions, use_pallas=False,
+        )[0]
+    ).reshape(B, H, nope)
+    np.testing.assert_allclose(attn_abs, o_explicit, rtol=2e-4, atol=2e-4)
+
+
+def test_dense_and_moe_layers_coexist():
+    cfg = DeepseekConfig.tiny(num_layers=3, first_k_dense=2)
+    params = init_deepseek_params(jax.random.PRNGKey(0), cfg)
+    assert "gate_up" in params["layers"][0]
+    assert "gate_up" in params["layers"][1]
+    assert "router" in params["layers"][2]
+    assert "shared_gate_up" in params["layers"][2]
+
+
+@pytest.mark.devices_8
+def test_ep_sharded_step_matches_single_device():
+    ep = 4
+    cfg = DeepseekConfig.tiny(num_experts=8, first_k_dense=1, num_layers=2)
+    mapping = Mapping(world_size=ep * 2, dp_size=2, tp_size=ep)
+    mesh = Mesh(
+        np.array(jax.devices()[: ep * 2]).reshape(2, 1, ep, 1),
+        (Mapping.AXIS_DP, "cp", Mapping.AXIS_TP, "pp"),
+    )
+    G = ep * 2
+    B, ppr, ps = G, 2, 8
+    params, caches, table = _state(cfg, B, ppr, ps, seed=1)
+    kv_lens = jnp.full((B,), 3, jnp.int32)
+    tokens = jnp.arange(B, dtype=jnp.int32)
+
+    ref, _ = deepseek_decode_step(
+        params, cfg, tokens, kv_lens, caches, table, kv_lens
+    )
+
+    step, mesh, _ = make_ep_sharded_decode_step(mapping, cfg, mesh=mesh)
+    sharded_caches = [
+        (c[0].reshape(G, -1, ps, cfg.kv_lora_rank),
+         c[1].reshape(G, -1, ps, 128))
+        for c in caches
+    ]
+    # per-chip page tables index LOCAL pages
+    local_pages = B * ppr // G
+    local_table = jnp.tile(
+        jnp.arange(local_pages, dtype=jnp.int32).reshape(B // G, ppr),
+        (G, 1),
+    )
+    out, _ = step(params, tokens, kv_lens, sharded_caches, local_table,
+                  kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
