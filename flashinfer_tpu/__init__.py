@@ -107,13 +107,20 @@ from flashinfer_tpu.norm import (  # noqa: F401
 from flashinfer_tpu.concat_ops import concat_mla_k, concat_mla_q  # noqa: F401
 from flashinfer_tpu.gdn import (  # noqa: F401
     gdn_chunk_prefill,
+    gdn_decode_mtp,
     gdn_decode_step,
     gdn_prefill,
     kda_chunk_prefill,
+    kda_decode_mtp,
     kda_decode_step,
     kda_prefill,
 )
-from flashinfer_tpu.mamba import selective_scan, selective_state_update  # noqa: F401
+from flashinfer_tpu.mamba import (  # noqa: F401
+    checkpointing_ssu,
+    selective_scan,
+    selective_state_update,
+    selective_state_update_mtp,
+)
 from flashinfer_tpu.mhc import (  # noqa: F401
     mhc_dynamic_weights,
     mhc_post_mix,

@@ -275,6 +275,12 @@ def fused_dit_gate_residual_layernorm_gamma_beta(
 # linear-attention conveniences
 # ---------------------------------------------------------------------------
 
+# reference gdn_kernels MTP decode surface (gdn_kernels/__init__.py:
+# gated_delta_rule_mtp / run_mtp_decode, T>=1 draft tokens per call)
+gated_delta_rule_mtp = gdn.gdn_decode_mtp
+gated_delta_rule_bf16state_cooprow_mtp = gdn.gdn_decode_mtp
+run_mtp_decode = gdn.gdn_decode_mtp
+
 chunk_gated_delta_rule = gdn.gdn_chunk_prefill
 """Chunked gated delta rule (reference chunk_gated_delta_rule ->
 gdn.gdn_chunk_prefill, the WY-transform chunked form)."""

@@ -252,6 +252,49 @@ def kda_decode_step(
     return o.astype(q.dtype), s.astype(state.dtype)
 
 
+def _mtp_scan(step_fn, state, seqs):
+    """Scan a per-token decode step over a small T (MTP) axis at dim 1."""
+    def body(st, inp):
+        o, st = step_fn(st, *inp)
+        return st, o
+
+    final, os = jax.lax.scan(
+        body, state, tuple(jnp.moveaxis(a, 1, 0) for a in seqs)
+    )
+    return jnp.moveaxis(os, 0, 1), final
+
+
+@jax.jit
+def gdn_decode_mtp(
+    state: jax.Array,  # [B, H, dk, dv]
+    q: jax.Array,  # [B, T, H, dk] — T draft/MTP tokens
+    k: jax.Array,
+    v: jax.Array,  # [B, T, H, dv]
+    alpha: jax.Array,  # [B, T, H]
+    beta: jax.Array,  # [B, T, H]
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-token GDN decode -> (o [B, T, H, dv], new_state): the
+    reference's MTP decode kernel surface (gdn_kernels
+    ``gated_delta_rule_mtp`` / ``run_mtp_decode``, T >= 1).  On TPU the
+    T-step recurrence scans the single-token step — XLA keeps the state
+    on-chip across the scan; T is the small speculative window."""
+    return _mtp_scan(gdn_decode_step, state, (q, k, v, alpha, beta))
+
+
+@jax.jit
+def kda_decode_mtp(
+    state: jax.Array,  # [B, H, dk, dv]
+    q: jax.Array,  # [B, T, H, dk]
+    k: jax.Array,
+    v: jax.Array,  # [B, T, H, dv]
+    alpha: jax.Array,  # [B, T, H, dk] per-channel decay
+    beta: jax.Array,  # [B, T, H]
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-token KDA decode (per-channel-decay twin of
+    :func:`gdn_decode_mtp`)."""
+    return _mtp_scan(kda_decode_step, state, (q, k, v, alpha, beta))
+
+
 def kda_chunk_prefill(
     q: jax.Array,  # [B, L, H, dk]
     k: jax.Array,

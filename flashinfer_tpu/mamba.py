@@ -246,3 +246,147 @@ def _mamba_chunk_scan_xla(x, dt, A, B, C, chunk_size=64, D=None, z=None,
     if z is not None:
         y = y * jax.nn.silu(z.astype(jnp.float32))
     return y.astype(x.dtype), final
+
+
+@functools.partial(jax.jit, static_argnames=("dt_softplus",))
+def selective_state_update_mtp(
+    state: jax.Array,  # [B, H, dim, dstate]
+    x: jax.Array,  # [B, T, H, dim] — T draft/MTP tokens per request
+    dt: jax.Array,  # [B, T, H, dim]
+    A: jax.Array,  # [H, dim, dstate]
+    B: jax.Array,  # [B, T, G, dstate]
+    C: jax.Array,  # [B, T, G, dstate]
+    D: Optional[jax.Array] = None,
+    z: Optional[jax.Array] = None,  # [B, T, H, dim]
+    dt_bias: Optional[jax.Array] = None,
+    dt_softplus: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-token (MTP) decode step -> (y [B, T, H, dim], new_state).
+
+    The reference ships a dedicated MTP kernel for T >= 1 draft tokens
+    per call (``checkpointing_ssu`` / selective_state_update MTP
+    variants); on TPU the T-step recurrence IS ``selective_scan`` at
+    small L — XLA keeps the state on-chip across the scan, so this is a
+    named delegation, not a new kernel."""
+    y, final = selective_scan(
+        x, dt, A, B, C, D, z, dt_bias, dt_softplus, initial_state=state
+    )
+    # round-trip the caller's state dtype (scan carries f32): MTP loops
+    # feed the state back as a carry and must not change dtype per step
+    return y, final.astype(state.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("dt_softplus",))
+def checkpointing_ssu(
+    state: jax.Array,  # [B, H, dim, dstate] — COMMITTED checkpoint
+    x_cache: jax.Array,  # [B, H, R, dim] ring of cached draft inputs
+    B_cache: jax.Array,  # [B, G, R, dstate]
+    dt_cache: jax.Array,  # [B, H, R] f32 PROCESSED dt (tie_hdim)
+    ring_start: jax.Array,  # [B] int32 oldest live ring row
+    prev_num_accepted_tokens: jax.Array,  # [B] int32 rows to replay
+    x: jax.Array,  # [B, T, H, dim] new draft tokens
+    dt: jax.Array,  # [B, T, H] tie_hdim raw dt
+    A: jax.Array,  # [H, dim, dstate]
+    B: jax.Array,  # [B, T, G, dstate]
+    C: jax.Array,  # [B, T, G, dstate]
+    D: Optional[jax.Array] = None,  # [H, dim]
+    z: Optional[jax.Array] = None,  # [B, T, H, dim]
+    dt_bias: Optional[jax.Array] = None,  # [H]
+    dt_softplus: bool = False,
+):
+    """Speculative-decoding SSU with lazy state recomputation (reference
+    ``flashinfer.mamba.checkpointing_ssu``, mamba/checkpointing_ssu.py).
+
+    The SSM state is enormous next to one token's inputs, so instead of
+    checkpointing states per draft token, the ring caches the draft
+    INPUTS and rebuilds the committed state by REPLAY:
+
+    1. advance ``state`` through the first ``prev_num_accepted_tokens``
+       cached ring rows (the draft tokens the verifier accepted) — this
+       is the only way the committed state moves;
+    2. slide ``ring_start`` past the replayed rows (rejected drafts are
+       simply never replayed and get overwritten);
+    3. emit outputs for the T NEW draft tokens from a TRANSIENT copy of
+       the committed state (drafts are not committed), and cache their
+       (x, B, processed dt) into the ring for the next call's replay.
+
+    Functional twin of the reference's in-place kernel: returns
+    ``(y [B, T, H, dim], state, x_cache, B_cache, dt_cache,
+    ring_start)``.  tie_hdim contract as in the reference kernel: dt is
+    per-head (``[B, T, H]``), dt_bias ``[H]``.  Capacity rule: the ring
+    must hold the pending window — R >= prev_accepted_max + T (the
+    reference's ``pnat + 2T > RING_BUFFER_LEN`` flush rule)."""
+    Bsz, T, H, dim = x.shape
+    R = x_cache.shape[2]
+    G = B.shape[2]
+    rep = H // G
+    Af = A.astype(jnp.float32)[None]  # [1, H, dim, dstate]
+    accepted = prev_num_accepted_tokens.astype(jnp.int32)
+
+    # ---- 1. replay the accepted prefix from the ring ----
+    def replay_step(j, st):
+        idx = (ring_start + j) % R  # [B]
+        xj = jnp.take_along_axis(
+            x_cache, idx[:, None, None, None], axis=2
+        )[:, :, 0].astype(jnp.float32)  # [B, H, dim]
+        Bj = jnp.take_along_axis(
+            B_cache, idx[:, None, None, None], axis=2
+        )[:, :, 0].astype(jnp.float32)  # [B, G, dstate]
+        dtj = jnp.take_along_axis(
+            dt_cache, idx[:, None, None], axis=2
+        )[:, :, 0].astype(jnp.float32)  # [B, H]
+        Bjr = jnp.repeat(Bj, rep, axis=1)  # [B, H, dstate]
+        dA = jnp.exp(dtj[..., None, None] * Af)
+        dBx = (dtj[..., None] * xj)[..., None] * Bjr[:, :, None, :]
+        stepped = st * dA + dBx
+        live = (j < accepted)[:, None, None, None]
+        return jnp.where(live, stepped, st)
+
+    committed = jax.lax.fori_loop(
+        0, R, replay_step, state.astype(jnp.float32)
+    )
+    new_start = (ring_start + accepted) % R
+
+    # ---- 2. process the T new drafts transiently, emitting y ----
+    dtf = dt.astype(jnp.float32)
+    if dt_bias is not None:
+        dtf = dtf + dt_bias.astype(jnp.float32)[None, None]
+    if dt_softplus:
+        dtf = _softplus(dtf)  # [B, T, H] processed
+
+    def draft_step(st, inp):
+        xt, dtt, Bt, Ct = inp  # [B,H,dim], [B,H], [B,G,ds], [B,G,ds]
+        Btr = jnp.repeat(Bt.astype(jnp.float32), rep, axis=1)
+        Ctr = jnp.repeat(Ct.astype(jnp.float32), rep, axis=1)
+        dA = jnp.exp(dtt[..., None, None] * Af)
+        dBx = (dtt[..., None] * xt.astype(jnp.float32))[..., None] * (
+            Btr[:, :, None, :]
+        )
+        st = st * dA + dBx
+        y = jnp.einsum("bhds,bhs->bhd", st, Ctr)
+        return st, y
+
+    _, ys = jax.lax.scan(
+        draft_step,
+        committed,
+        (
+            jnp.moveaxis(x, 1, 0), jnp.moveaxis(dtf, 1, 0),
+            jnp.moveaxis(B, 1, 0), jnp.moveaxis(C, 1, 0),
+        ),
+    )
+    y = jnp.moveaxis(ys, 0, 1)  # [B, T, H, dim]
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None] * x.astype(jnp.float32)
+    if z is not None:
+        y = y * jax.nn.silu(z.astype(jnp.float32))
+
+    # ---- 3. cache the new drafts into the ring ----
+    bidx = jnp.broadcast_to(jnp.arange(Bsz)[:, None], (Bsz, T))
+    pos = (new_start[:, None] + jnp.arange(T)[None, :]) % R  # [B, T]
+    x_cache = x_cache.at[bidx, :, pos].set(x.astype(x_cache.dtype))
+    B_cache = B_cache.at[bidx, :, pos].set(B.astype(B_cache.dtype))
+    dt_cache = dt_cache.at[bidx, :, pos].set(dtf.astype(dt_cache.dtype))
+    return (
+        y.astype(x.dtype), committed.astype(state.dtype),
+        x_cache, B_cache, dt_cache, new_start,
+    )

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -232,9 +232,15 @@ def deepseek_decode_step(
     caches: List[Tuple[jax.Array, jax.Array]],  # per layer (ckv, kpe)
     page_table: jax.Array,  # [B, max_pages]
     kv_lens: jax.Array,  # [B]
-    use_pallas: bool = False,
+    use_pallas: Optional[bool] = None,
 ):
-    """Single-device batched decode step -> (logits [B, vocab], caches)."""
+    """Single-device batched decode step -> (logits [B, vocab], caches).
+
+    ``use_pallas`` defaults to the platform (``is_tpu()``) — on a real
+    chip the paged MLA kernel runs, off-chip the XLA dense-gather
+    reference; pass explicitly to pin either."""
+    if use_pallas is None:
+        use_pallas = is_tpu()
     x = params["embed"][tokens].astype(cfg.dtype)
     new_caches = []
     for li, layer in enumerate(params["layers"]):

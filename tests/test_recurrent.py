@@ -447,3 +447,147 @@ def test_kda_pallas_env_opt_in(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(o2), np.asarray(o2_ref), rtol=2e-3, atol=2e-3
     )
+
+
+def test_mtp_decode_steps_match_stepwise():
+    """gdn/kda/mamba MTP decode (T draft tokens per call, reference
+    gated_delta_rule_mtp / selective_state_update MTP variants) must
+    equal T sequential single-token steps."""
+    from flashinfer_tpu.gdn import (
+        gdn_decode_mtp, gdn_decode_step, kda_decode_mtp, kda_decode_step,
+    )
+    from flashinfer_tpu.mamba import (
+        selective_state_update, selective_state_update_mtp,
+    )
+
+    rng = np.random.default_rng(0)
+    B, T, H, dk, dv = 2, 4, 3, 16, 16
+    s0 = jnp.asarray(rng.standard_normal((B, H, dk, dv)) * 0.2, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, T, H, dk)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, dk)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, dv)), jnp.float32)
+    a = jnp.asarray(rng.uniform(0.5, 1.0, (B, T, H)), jnp.float32)
+    b = jnp.asarray(rng.random((B, T, H)), jnp.float32)
+    o_mtp, s_mtp = gdn_decode_mtp(s0, q, k, v, a, b)
+    st = s0
+    for t in range(T):
+        o_t, st = gdn_decode_step(st, q[:, t], k[:, t], v[:, t], a[:, t],
+                                  b[:, t])
+        np.testing.assert_allclose(np.asarray(o_mtp[:, t]), np.asarray(o_t),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_mtp), np.asarray(st),
+                               rtol=1e-5, atol=1e-5)
+
+    ak = jnp.asarray(rng.uniform(0.5, 1.0, (B, T, H, dk)), jnp.float32)
+    o_mtp, s_mtp = kda_decode_mtp(s0, q, k, v, ak, b)
+    st = s0
+    for t in range(T):
+        o_t, st = kda_decode_step(st, q[:, t], k[:, t], v[:, t], ak[:, t],
+                                  b[:, t])
+        np.testing.assert_allclose(np.asarray(o_mtp[:, t]), np.asarray(o_t),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_mtp), np.asarray(st),
+                               rtol=1e-5, atol=1e-5)
+
+    dim, ds, G = 8, 16, 1
+    sm = jnp.asarray(rng.standard_normal((B, H, dim, ds)) * 0.2, jnp.float32)
+    xm = jnp.asarray(rng.standard_normal((B, T, H, dim)), jnp.float32)
+    dtm = jnp.asarray(rng.random((B, T, H, dim)), jnp.float32)
+    Am = -jnp.abs(jnp.asarray(rng.standard_normal((H, dim, ds)), jnp.float32))
+    Bm = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+    Cm = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+    y_mtp, s_mtp = selective_state_update_mtp(sm, xm, dtm, Am, Bm, Cm)
+    st = sm
+    for t in range(T):
+        y_t, st = selective_state_update(st, xm[:, t], dtm[:, t], Am,
+                                         Bm[:, t], Cm[:, t])
+        np.testing.assert_allclose(np.asarray(y_mtp[:, t]), np.asarray(y_t),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_mtp), np.asarray(st),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_checkpointing_ssu_speculative_replay():
+    """The lazy-recompute contract (reference mamba/checkpointing_ssu):
+    drafting T tokens, then accepting only n of them, must leave the
+    committed state EXACTLY where n sequential committed steps would —
+    across several accept/draft rounds with varying accept counts."""
+    from flashinfer_tpu.mamba import checkpointing_ssu, selective_state_update
+
+    rng = np.random.default_rng(1)
+    B, T, H, dim, ds, G, R = 2, 3, 2, 8, 12, 1, 8
+    A = -jnp.abs(jnp.asarray(rng.standard_normal((H, dim, ds)), jnp.float32))
+    dt_bias = jnp.asarray(rng.random((H,)), jnp.float32)
+
+    state = jnp.asarray(rng.standard_normal((B, H, dim, ds)) * 0.2,
+                        jnp.float32)
+    oracle = state
+    x_cache = jnp.zeros((B, H, R, dim), jnp.float32)
+    B_cache = jnp.zeros((B, G, R, ds), jnp.float32)
+    dt_cache = jnp.zeros((B, H, R), jnp.float32)
+    ring_start = jnp.zeros((B,), jnp.int32)
+    accepted = jnp.zeros((B,), jnp.int32)
+
+    prev_draft = None
+    # accept counts per round, per batch slot (asymmetric on purpose)
+    rounds = [np.array([0, 0]), np.array([2, 1]), np.array([3, 0]),
+              np.array([1, 3])]
+    for rnd, acc in enumerate(rounds):
+        # acc[b] = how many of the PREVIOUS round's drafts the verifier
+        # accepted — set before the call that replays them
+        accepted = jnp.asarray(acc, jnp.int32)
+        x = jnp.asarray(rng.standard_normal((B, T, H, dim)), jnp.float32)
+        dt = jnp.asarray(rng.random((B, T, H)), jnp.float32)
+        Bv = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+        Cv = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+        y, state, x_cache, B_cache, dt_cache, ring_start = checkpointing_ssu(
+            state, x_cache, B_cache, dt_cache, ring_start, accepted,
+            x, dt, A, Bv, Cv, dt_bias=dt_bias, dt_softplus=True,
+        )
+        assert np.isfinite(np.asarray(y)).all()
+        # oracle: commit the accepted prefix of the PREVIOUS round's
+        # drafts with plain sequential steps
+        if prev_draft is not None:
+            px, pdt, pB = prev_draft
+            for b in range(B):
+                ob = oracle[b:b + 1]
+                for t in range(int(acc[b])):
+                    _, ob = selective_state_update(
+                        ob, px[b:b + 1, t],
+                        jnp.broadcast_to(pdt[b:b + 1, t, :, None],
+                                         (1, H, dim)),
+                        A, pB[b:b + 1, t],
+                        jnp.zeros((1, G, ds), jnp.float32),
+                        dt_bias=jnp.broadcast_to(dt_bias[:, None],
+                                                 (H, dim)),
+                        dt_softplus=True,
+                    )
+                oracle = oracle.at[b].set(ob[0])
+        np.testing.assert_allclose(
+            np.asarray(state), np.asarray(oracle), rtol=1e-5, atol=1e-5,
+            err_msg=f"round {rnd}",
+        )
+        prev_draft = (x, dt, Bv)
+    accepted = jnp.asarray([2, 2], jnp.int32)
+    # one final call just to replay the last accept counts
+    x = jnp.zeros((B, T, H, dim), jnp.float32)
+    _, state, *_ = checkpointing_ssu(
+        state, x_cache, B_cache, dt_cache, ring_start, accepted,
+        x, jnp.zeros((B, T, H)), A,
+        jnp.zeros((B, T, G, ds)), jnp.zeros((B, T, G, ds)),
+        dt_bias=dt_bias, dt_softplus=True,
+    )
+    px, pdt, pB = prev_draft
+    for b in range(B):
+        ob = oracle[b:b + 1]
+        for t in range(int(np.asarray(accepted)[b])):
+            _, ob = selective_state_update(
+                ob, px[b:b + 1, t],
+                jnp.broadcast_to(pdt[b:b + 1, t, :, None], (1, H, dim)),
+                A, pB[b:b + 1, t], jnp.zeros((1, G, ds), jnp.float32),
+                dt_bias=jnp.broadcast_to(dt_bias[:, None], (H, dim)),
+                dt_softplus=True,
+            )
+        oracle = oracle.at[b].set(ob[0])
+    np.testing.assert_allclose(np.asarray(state), np.asarray(oracle),
+                               rtol=1e-5, atol=1e-5)
