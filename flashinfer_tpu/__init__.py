@@ -79,10 +79,15 @@ from flashinfer_tpu.activation import (  # noqa: F401
 )
 from flashinfer_tpu.aliases import (  # noqa: F401
     cudnn_batch_decode_with_kv_cache,
+    cudnn_batch_prefill_with_kv_cache,
     fast_decode_plan,
     trtllm_batch_context_with_kv_cache,
+    trtllm_batch_decode_sparse_mla_dsv4,
+    trtllm_batch_decode_trace_dispatch,
     trtllm_batch_decode_with_kv_cache,
+    trtllm_batch_decode_with_kv_cache_mla,
     xqa_batch_decode_with_kv_cache,
+    xqa_batch_decode_with_kv_cache_mla,
 )
 from flashinfer_tpu.msa_ops import (  # noqa: F401
     msa_proxy_score,

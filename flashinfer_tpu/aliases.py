@@ -115,3 +115,54 @@ def fast_decode_plan(wrapper: BatchDecodeWithPagedKVCacheWrapper, *args, **kw):
     The TPU plan is already a thin native-planner call, so this simply
     forwards; the name exists for drop-in compatibility."""
     return wrapper.plan(*args, **kw)
+
+
+def trtllm_batch_decode_with_kv_cache_mla(
+    query, kv_cache, workspace_buffer=None, qk_nope_head_dim=128,
+    kv_lora_rank=512, qk_rope_head_dim=64, block_tables=None,
+    seq_lens=None, max_seq_len=None, sparse_mla_top_k=0, out=None,
+    bmm1_scale=1.0, bmm2_scale=1.0, **_unused,
+):
+    """One-shot absorbed-MLA paged decode (reference mla/_core.py:2571):
+    ``query`` [B, H, kv_lora_rank + rope] against the COMBINED
+    [pages, page_size, kv_lora_rank + rope] cache; bmm1_scale is the
+    softmax scale, bmm2_scale scales the output."""
+    import jax.numpy as jnp
+
+    from flashinfer_tpu.ops.mla_decode import (
+        mla_paged_decode_attention, xla_mla_paged_decode,
+    )
+    from flashinfer_tpu.utils import is_tpu
+
+    if out is not None:
+        raise ValueError(
+            "TPU backend: out= pre-allocated outputs are not supported"
+        )
+    if sparse_mla_top_k:
+        raise ValueError(
+            "TPU backend: sparse MLA goes through "
+            "BatchMLAPagedAttentionWrapper.run_sparse (the top-k rows come "
+            "from topk.top_k_page_table_transform)"
+        )
+    q_nope = query[..., :kv_lora_rank]
+    q_pe = query[..., kv_lora_rank:]
+    ckv = kv_cache[..., :kv_lora_rank]
+    kpe = kv_cache[..., kv_lora_rank:]
+    fn = mla_paged_decode_attention if is_tpu() else xla_mla_paged_decode
+    o = fn(q_nope, q_pe, ckv, kpe, block_tables, seq_lens,
+           sm_scale=float(bmm1_scale))
+    return o * float(bmm2_scale) if bmm2_scale != 1.0 else o
+
+
+xqa_batch_decode_with_kv_cache_mla = trtllm_batch_decode_with_kv_cache_mla
+trtllm_batch_decode_sparse_mla_dsv4 = trtllm_batch_decode_with_kv_cache_mla
+
+
+def trtllm_batch_decode_trace_dispatch(*args, **kw):
+    """Reference trace-dispatch shim for the trtllm decode entry — the
+    traced path here is the same call (fi_trace wraps at the API layer)."""
+    return trtllm_batch_decode_with_kv_cache(*args, **kw)
+
+
+# cudnn prefill brand name collapses onto the one-shot context entry
+cudnn_batch_prefill_with_kv_cache = trtllm_batch_context_with_kv_cache

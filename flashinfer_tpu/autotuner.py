@@ -212,3 +212,189 @@ def autotune(enable: bool = True):
         yield t
     finally:
         t._tuning_enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# Reference autotuner profile-API surface (flashinfer/autotuner.py).  The
+# reference tunes against FAKE tensors described by specs/profiles; this
+# tuner times REAL tensors at call sites, so these classes are lightweight
+# records that carry the same information into AutoTuner.choose_one keys.
+# ---------------------------------------------------------------------------
+
+import dataclasses as _dc
+from typing import Callable as _Callable, Tuple as _Tuple
+
+
+@_dc.dataclass
+class Dim:
+    """A tensor dimension (reference autotuner.Dim)."""
+
+    value: int = 0
+
+
+class StaticDim(Dim):
+    """Fixed-size dimension."""
+
+
+@_dc.dataclass
+class DynamicDim(Dim):
+    """Bucketed dynamic dimension (reference DynamicDim): tuning runs per
+    bucket; this package buckets via next-power-of-two shape keys."""
+
+    min: int = 1
+    opt: int = 1
+    max: int = 1
+
+
+@_dc.dataclass
+class DynamicTensorSpec:
+    """Which input dims vary + their bucketing (reference
+    DynamicTensorSpec)."""
+
+    input_idx: _Tuple = ()
+    dim_idx: _Tuple = ()
+    gen_tuning_buckets: object = ()
+    map_to_tuning_buckets: object = None
+
+
+@_dc.dataclass
+class ConstraintSpec:
+    """Derived-dimension constraint (reference ConstraintSpec)."""
+
+    input_idx: int = 0
+    dim_idx: int = 0
+    infer_shape: object = None
+
+
+@_dc.dataclass
+class OptimizationProfile:
+    """One tuning bucket's concrete shapes (reference
+    OptimizationProfile)."""
+
+    shapes: _Tuple = ()
+
+
+@_dc.dataclass(frozen=True)
+class ProfilingCacheKey:
+    """Cache key record (reference ProfilingCacheKey); this tuner's keys
+    are the `op|shape` strings in tactics.json."""
+
+    op_name: str = ""
+    shape_key: str = ""
+
+
+class FakeTensor:
+    """Shape/dtype-only tensor stand-in (reference FakeTensor, used to
+    describe profiles without allocating)."""
+
+    def __init__(self, shape=(), dtype=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+class TuningConfig:
+    """Bundle of dynamic specs + constraints (reference TuningConfig)."""
+
+    def __init__(self, dynamic_tensor_specs=(), constraint_specs=(),
+                 **_unused):
+        self.dynamic_tensor_specs = tuple(dynamic_tensor_specs)
+        self.constraint_specs = tuple(constraint_specs)
+
+
+class TunableRunner:
+    """Base class for tunable op runners (reference TunableRunner): a
+    runner exposes candidate tactics and a forward; AutoTuner.choose_one
+    times them on the live shapes."""
+
+    def get_valid_tactics(self, inputs, profile) -> list:
+        return [-1]
+
+    def forward(self, inputs, tactic: int = -1):
+        raise NotImplementedError
+
+
+class AutoTunerStatistics:
+    """Tuning-run counters (reference AutoTunerStatistics)."""
+
+    def __init__(self):
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.tuned_ops = {}
+
+
+def autotuner_initializer_empty(shape, dtype):
+    import jax.numpy as jnp
+
+    return jnp.empty(shape, dtype)
+
+
+def autotuner_initializer_ones(shape, dtype):
+    import jax.numpy as jnp
+
+    return jnp.ones(shape, dtype)
+
+
+def autotuner_initializer_rand(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.uniform(jax.random.PRNGKey(0), shape).astype(dtype)
+
+
+def autotuner_initializer_zeros(shape, dtype):
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, dtype)
+
+
+def autotuner_initializer_randn(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.normal(jax.random.PRNGKey(0), shape).astype(dtype)
+
+
+def autotuner_initializer_rand_scaled(shape, dtype, scale: float = 1.0):
+    return autotuner_initializer_rand(shape, dtype) * scale
+
+
+def round_to_nearest_bucket(value: int, buckets) -> int:
+    """Snap a dynamic dim to its tuning bucket (reference
+    round_to_nearest_bucket): smallest bucket >= value, else the max."""
+    bs = sorted(int(b) for b in buckets)
+    for b in bs:
+        if value <= b:
+            return b
+    return bs[-1] if bs else value
+
+
+def make_bucket_mapper(buckets):
+    """Bucket-mapping closure (reference make_bucket_mapper)."""
+    frozen = tuple(sorted(int(b) for b in buckets))
+
+    def mapper(value: int) -> int:
+        return round_to_nearest_bucket(value, frozen)
+
+    return mapper
+
+
+_AUTOTUNE_PROCESS_GROUP = None
+
+
+def set_autotune_process_group(group) -> None:
+    """Reference: a torch.distributed group for sharing tuning results;
+    the mesh-wide analogue is the shared tactics.json file, so the group
+    handle is recorded but unused."""
+    global _AUTOTUNE_PROCESS_GROUP
+    _AUTOTUNE_PROCESS_GROUP = group
+
+
+def get_autotune_process_group():
+    return _AUTOTUNE_PROCESS_GROUP
+
+
+def is_in_profile_measurement() -> bool:
+    """True while the tuner is timing candidates (reference
+    is_in_profile_measurement) — this tuner times inline, so this is
+    simply whether tuning is enabled."""
+    return AutoTuner.get().tuning_enabled

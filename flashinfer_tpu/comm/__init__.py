@@ -24,3 +24,6 @@ from flashinfer_tpu.comm.allreduce import (  # noqa: F401
     allgather,
     reducescatter,
 )
+from flashinfer_tpu.comm.compat import *  # noqa: F401,F403  (reference
+# comm name surface: AR strategies/workspaces, vLLM AR, MoE a2a, DCP a2a)
+from flashinfer_tpu.comm import compat as _compat  # noqa: F401

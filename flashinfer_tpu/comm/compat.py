@@ -1,0 +1,410 @@
+"""Reference ``flashinfer.comm`` name surface on the mesh model.
+
+The reference comm package exports ~40 CUDA-fabric entry points: IPC
+workspace lifecycles (trtllm/vLLM custom all-reduce), Lamport buffer
+initialization, MNNVL fabric handles, and the MoE all-to-all runtime.
+Under XLA every one of these concerns is owned by the compiler — a
+collective is an op inside ``shard_map``, its buffers are XLA's, and
+there is no out-of-band workspace to create, register, or destroy.
+
+Three binding classes here (same policy as the package-level compat):
+
+- **mapped**: all-reduce/all-to-all entry points route to the real
+  collectives (``allreduce_fusion``, ``lax.all_to_all``);
+- **inert lifecycle**: workspace create/destroy/register return
+  lightweight handle records and accept them back — engine plumbing
+  runs unchanged, and the handles document that XLA owns the buffers;
+- **honest absence**: fabric probes report what this hardware has.
+
+Cited: /root/reference/flashinfer/comm/__init__.py (name surface),
+trtllm_allreduce.py, vllm_allreduce.py, moe_alltoall.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.comm.allreduce import allreduce, allreduce_fusion
+
+__all__ = [
+    "AllReduceFusionOp", "AllReduceFusionPattern",
+    "AllReduceFusionWorkspace", "AllReduceStrategyConfig",
+    "AllReduceStrategyType", "MNNVLAllReduceFusionWorkspace",
+    "MoeAlltoAll", "QuantizationSFLayout",
+    "TRTLLMAllReduceFusionWorkspace",
+    "compute_fp4_swizzled_layout_sf_size",
+    "create_allreduce_fusion_workspace", "create_shared_buffer",
+    "decode_cp_a2a_allocate_mnnvl_workspace", "decode_cp_a2a_alltoall",
+    "decode_cp_a2a_init_workspace", "decode_cp_a2a_workspace_size",
+    "free_shared_buffer", "moe_a2a_active_rank_mask", "moe_a2a_combine",
+    "moe_a2a_dispatch", "moe_a2a_get_workspace_size_per_rank",
+    "moe_a2a_initialize", "moe_a2a_sanitize_expert_ids",
+    "moe_a2a_wrap_payload_tensor_in_workspace", "pack_strided_memory",
+    "trtllm_allreduce_fusion",
+    "trtllm_create_ipc_workspace_for_all_reduce",
+    "trtllm_create_ipc_workspace_for_all_reduce_fusion",
+    "trtllm_custom_all_reduce",
+    "trtllm_destroy_ipc_workspace_for_all_reduce",
+    "trtllm_destroy_ipc_workspace_for_all_reduce_fusion",
+    "trtllm_lamport_initialize", "trtllm_lamport_initialize_all",
+    "trtllm_moe_allreduce_fusion", "trtllm_moe_finalize_allreduce_fusion",
+    "vllm_all_reduce", "vllm_dispose", "vllm_get_graph_buffer_ipc_meta",
+    "vllm_init_custom_ar", "vllm_meta_size", "vllm_register_buffer",
+    "vllm_register_graph_buffers",
+]
+
+
+# ---------------------------------------------------------------------------
+# enums + strategy records (reference trtllm_allreduce.py)
+# ---------------------------------------------------------------------------
+
+
+class AllReduceStrategyType(enum.IntEnum):
+    """Reference kernel-strategy selector (one-shot/two-shot/NCCL...).
+    XLA picks the collective algorithm; AUTO is the only meaningful
+    member and the others are accepted as hints."""
+
+    NCCL = 0
+    ONESHOT = 1
+    TWOSHOT = 2
+    AUTO = 3
+    LOWPRECISION = 4
+    MNNVL = 5
+
+
+class AllReduceStrategyConfig(enum.IntEnum):
+    USE_MEMCPY = 0
+    PUSH_MODE = 1
+
+
+class AllReduceFusionOp(enum.IntEnum):
+    """Fusion epilogue selector — maps onto allreduce_fusion's pattern
+    table (residual + RMSNorm [+ quant])."""
+
+    NONE = 0
+    RESIDUAL_RMS_NORM = 1
+    LAST_PROCESS_FOR_UB = 2
+    RESIDUAL_RMS_PREPOST_NORM = 3
+    RESIDUAL_RMS_NORM_QUANT_FP8 = 4
+    RESIDUAL_RMS_NORM_QUANT_NVFP4 = 5
+
+
+class AllReduceFusionPattern(enum.IntEnum):
+    kAllReduce = 0
+    kARResidualRMSNorm = 1
+    kARResidualRMSNormFP8Quant = 2
+    kARResidualRMSNormFP4Quant = 3
+
+
+class QuantizationSFLayout(enum.IntEnum):
+    """Scale-factor layout for quantizing fusions: XLA owns layout, so
+    row-major is the one (and identity-correct) member."""
+
+    ROW_MAJOR = 0
+    SWIZZLED_128x4 = 0
+    SWIZZLED_8x4 = 0
+
+
+def compute_fp4_swizzled_layout_sf_size(rows: int, cols: int,
+                                        sf_vec_size: int = 16) -> int:
+    """Reference sizes the swizzled fp4 scale buffer; row-major here."""
+    return rows * (cols // sf_vec_size)
+
+
+# ---------------------------------------------------------------------------
+# workspace lifecycle -> inert handle records (XLA owns the buffers)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AllReduceFusionWorkspace:
+    """Inert workspace handle: the reference allocates IPC-mapped Lamport
+    buffers; XLA collectives need none.  Carried so engine code that
+    creates/passes/destroys workspaces runs unchanged."""
+
+    tp_size: int = 1
+    max_token_num: int = 0
+    hidden_dim: int = 0
+
+
+TRTLLMAllReduceFusionWorkspace = AllReduceFusionWorkspace
+MNNVLAllReduceFusionWorkspace = AllReduceFusionWorkspace
+
+
+def create_allreduce_fusion_workspace(tp_size: int = 1,
+                                      max_token_num: int = 0,
+                                      hidden_dim: int = 0, **_):
+    return AllReduceFusionWorkspace(tp_size, max_token_num, hidden_dim)
+
+
+def trtllm_create_ipc_workspace_for_all_reduce(*_, **__):
+    return AllReduceFusionWorkspace()
+
+
+def trtllm_create_ipc_workspace_for_all_reduce_fusion(*_, **__):
+    return AllReduceFusionWorkspace()
+
+
+def trtllm_destroy_ipc_workspace_for_all_reduce(*_, **__):
+    return None
+
+
+def trtllm_destroy_ipc_workspace_for_all_reduce_fusion(*_, **__):
+    return None
+
+
+def trtllm_lamport_initialize(*_, **__):
+    """Lamport flag buffers synchronize the reference's one-shot kernels;
+    XLA collectives carry their own synchronization."""
+    return None
+
+
+def trtllm_lamport_initialize_all(*_, **__):
+    return None
+
+
+def create_shared_buffer(*_, **__):
+    """CUDA IPC shared buffers have no TPU analogue; arrays passed to
+    collectives are already device-resident and mesh-addressable."""
+    return None
+
+
+def free_shared_buffer(*_, **__):
+    return None
+
+
+def pack_strided_memory(tensor, *_, **__):
+    """Reference packs strided CUDA memory for IPC transport; identity
+    (XLA owns layout and transport)."""
+    return tensor
+
+
+# ---------------------------------------------------------------------------
+# all-reduce entry points -> the real collectives
+# ---------------------------------------------------------------------------
+
+
+def trtllm_custom_all_reduce(inp, axis: str = "tp", *,
+                             strategy=AllReduceStrategyType.AUTO,
+                             workspace=None, **_unused):
+    """Reference one-shot/two-shot custom AR -> ``psum`` over the mesh
+    axis (call inside shard_map)."""
+    return allreduce(inp, axis=axis)
+
+
+def trtllm_allreduce_fusion(
+    allreduce_in, residual_in=None, rms_gamma=None, axis: str = "tp",
+    *, pattern=AllReduceFusionPattern.kARResidualRMSNorm, eps: float = 1e-6,
+    workspace=None, scale_factor=None, layout_code=None, **_unused,
+):
+    """Reference fused AR(+residual+RMSNorm[+quant]) -> the
+    allreduce_fusion pattern table."""
+    quant = None
+    if pattern == AllReduceFusionPattern.kARResidualRMSNormFP8Quant:
+        quant = jnp.float8_e4m3fn
+    elif pattern == AllReduceFusionPattern.kARResidualRMSNormFP4Quant:
+        raise ValueError(
+            "TPU backend: the FP4-quantizing AR fusion is not implemented "
+            "(the quantizing epilogue here is fp8/int8); use "
+            "kARResidualRMSNormFP8Quant or quantize after the fusion"
+        )
+    if pattern == AllReduceFusionPattern.kAllReduce or residual_in is None:
+        return allreduce(allreduce_in, axis=axis)
+    return allreduce_fusion(
+        allreduce_in, residual_in, rms_gamma, axis=axis, eps=eps,
+        quant_dtype=quant,
+    )
+
+
+def trtllm_moe_allreduce_fusion(token_input, residual=None, gamma=None,
+                                axis: str = "tp", **kw):
+    """MoE-combined AR fusion -> the same fused pattern."""
+    return trtllm_allreduce_fusion(token_input, residual, gamma, axis, **kw)
+
+
+def trtllm_moe_finalize_allreduce_fusion(expert_output, expert_weights=None,
+                                         residual=None, gamma=None,
+                                         axis: str = "tp", **kw):
+    """Finalize (weighted expert combine) + AR fusion: the weighted sum
+    happens in fused_moe's finalize; the AR rides here."""
+    out = expert_output
+    if expert_weights is not None:
+        out = (out.astype(jnp.float32)
+               * expert_weights.astype(jnp.float32)[..., None]).sum(-2)
+        out = out.astype(expert_output.dtype)
+    return trtllm_allreduce_fusion(out, residual, gamma, axis, **kw)
+
+
+# vLLM custom-AR surface: registration is a no-op (no graph buffers to
+# exchange), the reduce is the collective
+def vllm_init_custom_ar(*_, **__):
+    return AllReduceFusionWorkspace()
+
+
+def vllm_all_reduce(inp, axis: str = "tp", **_unused):
+    return allreduce(inp, axis=axis)
+
+
+def vllm_dispose(*_, **__):
+    return None
+
+
+def vllm_meta_size() -> int:
+    return 0
+
+
+def vllm_register_buffer(*_, **__):
+    return None
+
+
+def vllm_register_graph_buffers(*_, **__):
+    return None
+
+
+def vllm_get_graph_buffer_ipc_meta(*_, **__):
+    return (b"", [])
+
+
+# ---------------------------------------------------------------------------
+# MoE all-to-all runtime (reference moe_alltoall.py) -> lax.all_to_all
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _MoeA2AWorkspace:
+    ep_size: int = 1
+    max_tokens: int = 0
+
+
+def moe_a2a_get_workspace_size_per_rank(*_, **__) -> int:
+    return 0
+
+
+def moe_a2a_initialize(ep_size: int = 1, max_tokens: int = 0, **_):
+    return _MoeA2AWorkspace(ep_size, max_tokens)
+
+
+def moe_a2a_wrap_payload_tensor_in_workspace(payload, *_, **__):
+    return payload
+
+
+def moe_a2a_sanitize_expert_ids(expert_ids, num_experts: int,
+                                pad_id: int = -1):
+    """Clamp out-of-range expert ids to the pad id (reference sanitize)."""
+    ids = jnp.asarray(expert_ids)
+    ok = (ids >= 0) & (ids < num_experts)
+    return jnp.where(ok, ids, pad_id)
+
+
+def moe_a2a_active_rank_mask(expert_ids, num_experts: int, ep_size: int):
+    """[ep_size] bool: which ranks receive any of this rank's routes."""
+    ids = jnp.asarray(expert_ids).reshape(-1)
+    e_local = num_experts // ep_size
+    dst = jnp.where(ids >= 0, ids // e_local, ep_size)
+    return (
+        jnp.zeros((ep_size + 1,), jnp.int32).at[dst].add(1)[:ep_size] > 0
+    )
+
+
+def moe_a2a_dispatch(hidden, topk_ids, topk_weights, num_experts: int,
+                     axis: str = "tp", workspace=None,
+                     capacity_factor: float = 2.0, **_unused):
+    """Standalone dispatch half (reference moe_a2a_dispatch): the fused
+    path keeps dispatch inside ``fused_moe_ep``; this explicit form
+    performs the capacity-bucketed exchange and returns the received
+    (tokens, expert_ids, validity) — call inside shard_map."""
+    from flashinfer_tpu.fused_moe.core import _route_buckets
+
+    ep = jax.lax.axis_size(axis)
+    e_local = num_experts // ep
+    T, K = topk_ids.shape
+    H = hidden.shape[1]
+    cap, order, sd, stok, eid, within = _route_buckets(
+        topk_ids, e_local, ep, capacity_factor
+    )
+    send_x = jnp.zeros((ep, cap, H), hidden.dtype).at[sd, within].set(
+        hidden[stok], mode="drop")
+    send_eid = jnp.full((ep, cap), -1, jnp.int32).at[sd, within].set(
+        eid, mode="drop")
+    recv_x = jax.lax.all_to_all(send_x, axis, 0, 0)
+    recv_eid = jax.lax.all_to_all(send_eid, axis, 0, 0)
+    return recv_x, recv_eid, recv_eid >= 0
+
+
+def moe_a2a_combine(expert_output, topk_ids, topk_weights,
+                    num_experts: int, axis: str = "tp", workspace=None,
+                    capacity_factor: float = 2.0, **_unused):
+    """Standalone combine half: route expert outputs back and weight-sum
+    per source token (inverse of :func:`moe_a2a_dispatch`)."""
+    from flashinfer_tpu.fused_moe.core import _route_buckets
+
+    ep = jax.lax.axis_size(axis)
+    e_local = num_experts // ep
+    T, K = topk_ids.shape
+    H = expert_output.shape[-1]
+    cap, order, sd, stok, eid, within = _route_buckets(
+        topk_ids, e_local, ep, capacity_factor
+    )
+    back = jax.lax.all_to_all(
+        expert_output.reshape(ep, cap, H), axis, 0, 0
+    )
+    kept = (within < cap)[:, None].astype(jnp.float32)
+    gathered = back[sd, jnp.minimum(within, cap - 1)] * kept
+    contrib = jnp.zeros((T * K, H), jnp.float32).at[order].set(
+        gathered.astype(jnp.float32))
+    return (
+        contrib.reshape(T, K, H)
+        * topk_weights.astype(jnp.float32)[..., None]
+    ).sum(1).astype(expert_output.dtype)
+
+
+class MoeAlltoAll:
+    """Object form of the a2a runtime (reference MoeAlltoAll): holds the
+    geometry; dispatch/combine call the functions above."""
+
+    def __init__(self, ep_size: int = 1, num_experts: int = 1,
+                 axis: str = "tp", capacity_factor: float = 2.0, **_):
+        self.ep_size = ep_size
+        self.num_experts = num_experts
+        self.axis = axis
+        self.capacity_factor = capacity_factor
+
+    def dispatch(self, hidden, topk_ids, topk_weights, **kw):
+        return moe_a2a_dispatch(
+            hidden, topk_ids, topk_weights, self.num_experts, self.axis,
+            capacity_factor=self.capacity_factor, **kw)
+
+    def combine(self, expert_output, topk_ids, topk_weights, **kw):
+        return moe_a2a_combine(
+            expert_output, topk_ids, topk_weights, self.num_experts,
+            self.axis, capacity_factor=self.capacity_factor, **kw)
+
+
+# ---------------------------------------------------------------------------
+# decode-CP all-to-all (reference decode_cp_a2a) -> parallel/dcp
+# ---------------------------------------------------------------------------
+
+
+def decode_cp_a2a_workspace_size(*_, **__) -> int:
+    return 0
+
+
+def decode_cp_a2a_init_workspace(*_, **__):
+    return None
+
+
+def decode_cp_a2a_allocate_mnnvl_workspace(*_, **__):
+    return None
+
+
+def decode_cp_a2a_alltoall(x, axis: str = "cp", split_axis: int = 0,
+                           concat_axis: int = 0, **_unused):
+    """Decode context-parallel all-to-all (reference decode_cp_a2a):
+    the DCP head/kv exchange — ``lax.all_to_all`` over the cp axis
+    (``parallel.dcp_decode`` is the full fused form)."""
+    return jax.lax.all_to_all(x, axis, split_axis, concat_axis, tiled=True)

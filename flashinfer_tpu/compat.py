@@ -75,6 +75,8 @@ from flashinfer_tpu.compat_calls import (
     trtllm_fp4_block_scale_moe,
     trtllm_fp8_block_scale_moe,
     trtllm_fp8_per_tensor_scale_moe,
+    trtllm_mxint4_block_scale_moe,
+    trtllm_mxint4_block_scale_routed_moe,
 )
 from flashinfer_tpu.norm import (
     fused_add_rmsnorm_quant_fp8,
@@ -457,6 +459,29 @@ def get_fp4_quantization_module(*_, **__):
     from flashinfer_tpu import quantization
 
     return quantization
+
+
+def _module_getter(modname: str):
+    """Factory for the reference's per-op JIT-module getters/generators
+    (gen_*_module / get_*_module): the reference compiles a CUDA module
+    per arch; here every getter returns the one TPU module."""
+
+    def get(*_, **__):
+        import importlib
+
+        return importlib.import_module(f"flashinfer_tpu.{modname}")
+
+    return get
+
+
+gen_quantization_module = _module_getter("quantization")
+gen_norm_module = _module_getter("norm")
+get_norm_module = _module_getter("norm")
+gen_rmsnorm_silu_module = _module_getter("norm")
+gen_cascade_module = _module_getter("cascade")
+gen_mhc_module = _module_getter("mhc")
+get_mhc_module = _module_getter("mhc")
+get_concat_mla_module = _module_getter("concat_ops")
 
 
 # fp4 KV-cache family -> the token-pair int4 paged forms

@@ -650,3 +650,63 @@ def fp4_quantize(input, global_scale=None, sf_vec_size: int = 16,
     ``x ~= dequantize_fp4(x_q, sf)`` exactly, so it is accepted and
     inert.  Swizzle flags are inert (identity layout)."""
     return _quantize_fp4(jnp.asarray(input), block_size=sf_vec_size)
+
+
+def trtllm_mxint4_block_scale_moe(
+    routing_logits, routing_bias, hidden_states,
+    gemm1_weights, gemm1_weights_scale, gemm1_alpha, gemm1_beta,
+    gemm1_clamp_limit, gemm2_weights, gemm2_weights_scale,
+    num_experts: int, top_k: int,
+    n_group: Optional[int] = None, topk_group: Optional[int] = None,
+    intermediate_size: int = 0,
+    local_expert_offset: int = 0,
+    local_num_experts: Optional[int] = None,
+    routed_scaling_factor: Optional[float] = None,
+    routing_method_type: int = 0,
+    do_finalize: bool = True, **_inert,
+):
+    """Reference ``trtllm_mxint4_block_scale_moe`` (fused_moe/
+    core.py:4398): int4-packed weights + block scales.  The TPU int4
+    storage form is the same block-int4 packing as fp4 (two codes per
+    int8 + f32 block scales from the quantize family), so this shares
+    the fp4 adapter's dequantize-to-bf16 route."""
+    return trtllm_fp4_block_scale_moe(
+        routing_logits, routing_bias, hidden_states, None,
+        gemm1_weights, gemm1_weights_scale, None, gemm1_alpha, gemm1_beta,
+        gemm1_clamp_limit, gemm2_weights, gemm2_weights_scale, None,
+        None, None, None,
+        num_experts, top_k, n_group, topk_group, intermediate_size,
+        local_expert_offset, local_num_experts, routed_scaling_factor,
+        routing_method_type, do_finalize, **_inert,
+    )
+
+
+def trtllm_mxint4_block_scale_routed_moe(
+    topk_ids, expert_weights, hidden_states,
+    gemm1_weights, gemm1_weights_scale, gemm1_alpha, gemm1_beta,
+    gemm1_clamp_limit, gemm2_weights, gemm2_weights_scale,
+    num_experts: int, top_k: int, **kw,
+):
+    """Routed twin: caller supplies (topk_ids, expert_weights) instead of
+    routing logits."""
+    return cutlass_fused_moe(
+        hidden_states, topk_ids, expert_weights,
+        _int4_to_bf16(gemm1_weights, gemm1_weights_scale,
+                      "trtllm_mxint4_block_scale_routed_moe"),
+        _int4_to_bf16(gemm2_weights, gemm2_weights_scale,
+                      "trtllm_mxint4_block_scale_routed_moe"),
+        jnp.bfloat16, [],
+    )
+
+
+def _int4_to_bf16(w, s, name):
+    from flashinfer_tpu.quantization import dequantize_fp4
+
+    w, s = jnp.asarray(w), jnp.asarray(s)
+    if w.ndim != 3 or (w.shape[-1] * 2) % s.shape[-1]:
+        raise ValueError(
+            f"TPU backend: {name} expects this package's block-int4 "
+            f"storage (packed [E, M, K//2] + [E, M, K//block] scales); "
+            f"got {w.shape} / {s.shape}"
+        )
+    return dequantize_fp4(w, s).astype(jnp.bfloat16)

@@ -16,3 +16,29 @@ def router_gemm(hidden, router_weight):
     import jax.numpy as jnp
 
     return jnp.dot(hidden, router_weight, preferred_element_type=jnp.float32)
+
+
+def fused_topk_deepseek(scores, bias, n_group, topk_group, topk,
+                        routed_scaling_factor: float = 1.0, **_unused):
+    """DSv3 fused expert routing (reference dsv3_ops.fused_topk_deepseek
+    / trace/templates/sampling.py:898): sigmoid+bias grouped top-k with
+    unbiased renormalized weights -> (values, indices).  Same algorithm
+    as :func:`route_deepseek_v3`, reference argument order."""
+    return route_deepseek_v3(
+        scores, bias, int(topk), int(n_group), int(topk_group),
+        float(routed_scaling_factor),
+    )
+
+
+def mm_M1_16_K7168_N128(a, b, *_, **__):
+    """DSv3 tiny-M latency-specialized GEMM names (reference
+    dsv3_ops router/gate tails): arch-specialized CUDA tile configs —
+    XLA's matmul emitter owns tiling on TPU, so all three names are the
+    one matmul."""
+    import jax.numpy as jnp
+
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+mm_M1_16_K7168_N256 = mm_M1_16_K7168_N128
+mm_M1_16_K6144_N256 = mm_M1_16_K7168_N128

@@ -29,3 +29,11 @@ from flashinfer_tpu.fused_moe.api import (  # noqa: F401
     QuantVariant,
     RoutingConfig,
 )
+from flashinfer_tpu.fused_moe.compat import *  # noqa: F401,F403
+from flashinfer_tpu.fused_moe.compat import (  # noqa: F401
+    MoEWeightPack,
+    WeightLayout,
+    bgmv_moe,
+    mono_moe,
+)
+from flashinfer_tpu.dsv3_ops import fused_topk_deepseek  # noqa: F401

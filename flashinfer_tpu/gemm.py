@@ -298,3 +298,102 @@ class SegmentGEMMWrapper:
         return grouped_gemm(x, weights, seg_lens)
 
     forward = run
+
+
+# ---------------------------------------------------------------------------
+# Reference gemm-submodule name surface (gemm/__init__.py): the deepgemm /
+# blockscale / cutile / tinygemm backend families collapse onto the
+# precision-equivalent MXU paths above.  "nt" = weights row-major [n, k]
+# (transposed here; XLA owns layout).
+# ---------------------------------------------------------------------------
+
+
+def gemm_fp8_nt_groupwise(a, b, a_scale, b_scale, out_dtype=jnp.bfloat16,
+                          **_unused):
+    """Dense fp8 NT groupwise GEMM (reference gemm_fp8_nt_groupwise /
+    deep_gemm): b arrives [n, k] row-major; scales per the groupwise
+    contract of :func:`mm_fp8_groupwise` with b_scale [n//bn, k//bk]
+    transposed to match."""
+    return mm_fp8_groupwise(
+        a, jnp.swapaxes(b, 0, 1), a_scale, jnp.swapaxes(b_scale, 0, 1),
+        out_dtype=out_dtype,
+    )
+
+
+gemm_fp8_nt_blockscaled = gemm_fp8_nt_groupwise
+fp8_blockscale_gemm_sm90 = gemm_fp8_nt_groupwise
+
+
+def group_deepgemm_fp8_nt_groupwise(a, b, a_scale, b_scale, m_indices=None,
+                                    group_sizes=None, out_dtype=jnp.bfloat16,
+                                    **_unused):
+    """Grouped deepgemm fp8 NT (reference group_deepgemm_fp8_nt_groupwise):
+    accepts either ``m_indices`` (per-row group ids, the deepgemm
+    contract) or ``group_sizes`` and routes to the grouped fp8 path."""
+    if group_sizes is None:
+        if m_indices is None:
+            raise ValueError("pass m_indices or group_sizes")
+        ids = jnp.asarray(m_indices, jnp.int32)
+        # deepgemm marks padding rows with -1; groups are contiguous and
+        # non-decreasing, so forward-fill assigns each pad row to the
+        # PRECEDING group (keeping later groups' row offsets aligned —
+        # pad rows' outputs are garbage the caller ignores, but they
+        # must still be COUNTED or every following group shifts)
+        filled = jnp.maximum(jax.lax.cummax(ids), 0)
+        group_sizes = jnp.bincount(
+            filled, length=b.shape[0]
+        ).astype(jnp.int32)
+    return group_gemm_fp8_nt_groupwise(
+        a, b, a_scale, b_scale, group_sizes, out_dtype=out_dtype
+    )
+
+
+def batch_deepgemm_fp8_nt_groupwise(a, b, a_scale, b_scale,
+                                    out_dtype=jnp.bfloat16, **_unused):
+    """Batched deepgemm fp8 NT (reference batch_deepgemm_fp8_nt_groupwise):
+    uniform per-batch segments == a grouped GEMM with equal group sizes."""
+    bsz, m, k = a.shape
+    sizes = jnp.full((bsz,), m, jnp.int32)
+    out = group_gemm_fp8_nt_groupwise(
+        a.reshape(bsz * m, k),
+        b,
+        a_scale.reshape(bsz * m, -1),
+        b_scale,
+        sizes,
+        out_dtype=out_dtype,
+    )
+    return out.reshape(bsz, m, -1)
+
+
+def group_gemm_mxfp4_nt_groupwise(x, w_packed, w_scale, group_sizes,
+                                  block_size: int = 32,
+                                  out_dtype=jnp.bfloat16, **_unused):
+    """mxfp4 grouped NT GEMM -> the block-int4 grouped path.  NT weights
+    arrive row-major [g, n, k//2] (packed on k, the trailing dim) with
+    scales [g, n, k//block]; group_gemm_fp4 wants them k-major, so both
+    transpose here."""
+    return group_gemm_fp4(
+        x, jnp.swapaxes(w_packed, 1, 2), jnp.swapaxes(w_scale, 1, 2),
+        group_sizes, block_size=block_size, out_dtype=out_dtype,
+    )
+
+
+group_gemm_nvfp4_nt_groupwise = group_gemm_mxfp4_nt_groupwise
+group_gemm_mxfp8_mxfp4_nt_groupwise = group_gemm_mxfp4_nt_groupwise
+moe_gemm_fp8_nt_groupwise = group_deepgemm_fp8_nt_groupwise
+moe_gemm_mxfp8_nt_groupwise = group_deepgemm_fp8_nt_groupwise
+
+
+def tinygemm_bf16(a, b, bias=None, out_dtype=jnp.bfloat16, **_unused):
+    """Small-M latency GEMM (reference tinygemm backend): XLA's matmul
+    emitter already specializes small M on TPU — one matmul serves."""
+    out = mm_bf16(a, b, out_dtype=jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(out_dtype)
+
+
+def is_cuda_tile_available() -> bool:
+    """Reference cuTile (cuda.tile DSL) availability probe — a CUDA
+    backend that does not exist on TPU."""
+    return False

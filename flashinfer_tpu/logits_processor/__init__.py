@@ -9,11 +9,22 @@ the XLA analogue of the reference's fused-kernel selection.
 """
 
 from flashinfer_tpu.logits_processor.pipeline import (  # noqa: F401
+    CompileError,
+    Compiler,
+    FusionRule,
+    LegalizationError,
     LogitsPipe,
+    LogitsProcessor,
     MinP,
+    Op,
+    ParameterizedOp,
     Sample,
     Softmax,
+    TaggedTensor,
     Temperature,
+    TensorType,
     TopK,
     TopP,
+    compile_pipeline,
+    legalize_processors,
 )

@@ -167,3 +167,95 @@ class LogitsPipe:
                 functools.partial(self._run)
             )
         return self._compiled(logits, key, **params)
+
+
+# ---------------------------------------------------------------------------
+# Reference compiler-surface names (flashinfer/logits_processor: compiler.py,
+# types.py, op.py).  The TPU pipe IS the compiler — validate -> legalize ->
+# fuse happens in LogitsPipe — so these expose its pieces under the
+# reference names.
+# ---------------------------------------------------------------------------
+
+Op = _Op
+LogitsProcessor = _Op  # reference processor base class
+ParameterizedOp = _Op  # parameterized ops read call-time params
+
+
+class TensorType:
+    """Reference stream-type enum (types.py): the pipe's LOGITS -> PROBS
+    -> TOKENS flow, as string states here."""
+
+    LOGITS = LOGITS
+    PROBS = PROBS
+    TOKENS = TOKENS
+
+
+class TaggedTensor:
+    """A (tensor, stream-type) pair (reference types.TaggedTensor)."""
+
+    def __init__(self, tensor, type: str = LOGITS):  # noqa: A002
+        self.tensor = tensor
+        self.type = type
+
+    @staticmethod
+    def logits(t):
+        return TaggedTensor(t, LOGITS)
+
+    @staticmethod
+    def probs(t):
+        return TaggedTensor(t, PROBS)
+
+
+class CompileError(ValueError):
+    """Pipeline failed validation/compilation (reference compiler.py)."""
+
+
+class LegalizationError(CompileError):
+    """An op has no kernel for its input stream type."""
+
+
+class FusionRule:
+    """A fusion-rule record (reference fusion_rules.py).  XLA performs
+    the actual fusion when the pipe jits; the record exists for
+    introspection parity."""
+
+    def __init__(self, pattern=(), name: str = "xla_fused"):
+        self.pattern = tuple(pattern)
+        self.name = name
+
+
+def legalize_processors(ops, initial_state: str = LOGITS):
+    """Validate + legalize a processor chain (reference
+    legalization.py): returns the ops unchanged on success — each op's
+    ``apply`` already dispatches on the stream state (the TPU form of
+    kernel selection) — and raises :class:`LegalizationError` where the
+    reference would."""
+    state = initial_state
+    for i, op in enumerate(ops):
+        if state == TOKENS:
+            raise LegalizationError(
+                f"op {op.name!r} at position {i} after Sample"
+            )
+        if state not in op.needs:
+            raise LegalizationError(
+                f"op {op.name!r} at position {i} requires "
+                f"{'/'.join(op.needs)}, stream is {state}"
+            )
+        state = op.out_state(state)
+    return list(ops)
+
+
+def compile_pipeline(processors, **_unused):
+    """Compile a processor chain (reference compiler.compile_pipeline)
+    -> a :class:`LogitsPipe` (validated, legalized, jitted whole)."""
+    try:
+        return LogitsPipe(processors)
+    except ValueError as e:
+        raise CompileError(str(e)) from e
+
+
+class Compiler:
+    """Reference compiler object: ``compile()`` == compile_pipeline."""
+
+    def compile(self, processors, **kw):
+        return compile_pipeline(processors, **kw)

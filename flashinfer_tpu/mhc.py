@@ -74,3 +74,134 @@ def mhc_dynamic_weights(
     w_depth = h[:, n : 2 * n]
     w_width = jnp.tanh(h[:, 2 * n :].reshape(t, n, n))
     return w_pre, w_depth, w_width
+
+
+# ---------------------------------------------------------------------------
+# Reference HC=4 fused entry points (flashinfer/mhc.py:76,176,334 backed by
+# csrc/mhc/mhc_post.cu, mhc_pre_big_fuse.cu) — exact math transcribed from
+# the kernels, vectorized over tokens.
+# ---------------------------------------------------------------------------
+
+_HC = 4
+_MIX = 2 * _HC + _HC * _HC  # 24 = pre(4) + post(4) + comb(16)
+
+
+@jax.jit
+def mhc_post(
+    x: jax.Array,  # [..., H]
+    residual: jax.Array,  # [..., HC=4, H]
+    post_layer_mix: jax.Array,  # [..., HC] (trailing 1 squeezed if present)
+    comb_res_mix: jax.Array,  # [..., HC, HC]
+) -> jax.Array:
+    """mHC post mapping (reference mhc.py:76 / mhc_post.cu):
+    ``out[.., n, h] = x[.., h] * post[.., n]
+    + sum_o residual[.., o, h] * comb[.., o, n]``."""
+    if post_layer_mix.shape[-1] == 1 and post_layer_mix.ndim == x.ndim + 1:
+        post_layer_mix = post_layer_mix[..., 0]
+    xf = x.astype(jnp.float32)
+    out = (
+        xf[..., None, :] * post_layer_mix.astype(jnp.float32)[..., :, None]
+        + jnp.einsum(
+            "...oh,...on->...nh", residual.astype(jnp.float32),
+            comb_res_mix.astype(jnp.float32),
+        )
+    )
+    return out.astype(residual.dtype)
+
+
+def _sinkhorn_hc4(cm: jax.Array, eps: float, repeat: int) -> jax.Array:
+    """The kernel's comb normalization: row softmax (+eps), then column
+    normalize; then (repeat-1) x (row divide by rowsum+eps inside the
+    loop, column divide by colsum+eps).  cm: [..., HC(row), HC(col)]."""
+    cm = jax.nn.softmax(cm, axis=-1) + eps
+    cm = cm / (jnp.sum(cm, axis=-2, keepdims=True) + eps)
+    def body(_, m):
+        m = m / (jnp.sum(m, axis=-1, keepdims=True) + eps)
+        return m / (jnp.sum(m, axis=-2, keepdims=True) + eps)
+    return jax.lax.fori_loop(1, repeat, body, cm)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "rms_eps", "mhc_pre_eps", "mhc_sinkhorn_eps",
+                     "mhc_post_mult_value", "sinkhorn_repeat", "num_splits"),
+)
+def mhc_pre_big_fuse(
+    dot_mix: jax.Array,  # [..., 24] or [num_splits, ..., 24]
+    sqrsum: jax.Array,  # [...] or [num_splits, ...]
+    residual: jax.Array,  # [..., HC=4, H]
+    mhc_scale: jax.Array,  # [3] (pre, post, comb) scales
+    mhc_base: jax.Array,  # [24] biases
+    k: int,
+    rms_eps: float = 1e-6,
+    mhc_pre_eps: float = 1e-6,
+    mhc_sinkhorn_eps: float = 1e-6,
+    mhc_post_mult_value: float = 1.0,
+    sinkhorn_repeat: int = 20,
+    num_splits: int = 1,
+    block_size: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """mHC pre-map big fuse (reference mhc.py:176 / mhc_pre_big_fuse.cu):
+    RMS-normalize the raw projection logits, derive sigmoid pre/post
+    gates and the Sinkhorn-normalized 4x4 comb matrix, and build the
+    layer input ``sum_j pre[j] * residual[j]``.  Returns
+    ``(post_mix [..., 4, 1], comb_mix [..., 4, 4], layer_input [..., H])``.
+    ``num_splits > 1`` leading split axes of dot_mix/sqrsum are reduced
+    here (the kernel reduces them internally)."""
+    if num_splits not in (1, 2, 4, 8, 16):
+        raise ValueError("num_splits must be one of {1, 2, 4, 8, 16}")
+    if num_splits > 1:
+        dot_mix = jnp.sum(dot_mix.astype(jnp.float32), axis=0)
+        sqrsum = jnp.sum(sqrsum.astype(jnp.float32), axis=0)
+    y = dot_mix.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(
+        sqrsum.astype(jnp.float32) / float(k) + rms_eps
+    )[..., None]
+    scale = mhc_scale.astype(jnp.float32)
+    base = mhc_base.astype(jnp.float32)
+    pre = jax.nn.sigmoid(
+        y[..., :_HC] * rstd * scale[0] + base[:_HC]
+    ) + mhc_pre_eps
+    post = jax.nn.sigmoid(
+        y[..., _HC:2 * _HC] * rstd * scale[1] + base[_HC:2 * _HC]
+    ) * mhc_post_mult_value
+    cm = (
+        y[..., 2 * _HC:] * rstd * scale[2] + base[2 * _HC:]
+    ).reshape(*y.shape[:-1], _HC, _HC)
+    comb = _sinkhorn_hc4(cm, mhc_sinkhorn_eps, sinkhorn_repeat)
+    layer_input = jnp.einsum(
+        "...n,...nh->...h", pre, residual.astype(jnp.float32)
+    ).astype(residual.dtype)
+    return post[..., None], comb, layer_input
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rms_eps", "mhc_pre_eps", "mhc_sinkhorn_eps",
+                     "mhc_post_mult_value", "sinkhorn_repeat"),
+)
+def mhc_pre_big_fuse_with_prenorm(
+    dot_mix: jax.Array,  # [..., 24] (or [1, ..., 24])
+    residual: jax.Array,  # [..., HC=4, H]
+    mhc_scale: jax.Array,
+    mhc_base: jax.Array,
+    rms_eps: float = 1e-6,
+    mhc_pre_eps: float = 1e-6,
+    mhc_sinkhorn_eps: float = 1e-6,
+    mhc_post_mult_value: float = 1.0,
+    sinkhorn_repeat: int = 20,
+    block_size: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The prenorm variant (reference mhc.py:334): ``sqrsum`` is computed
+    from ``residual`` here (sum of squares over the [HC, H] block,
+    normalized by K = HC * H)."""
+    if dot_mix.ndim == residual.ndim:  # leading [1, ...] split axis
+        dot_mix = dot_mix[0]
+    rf = residual.astype(jnp.float32)
+    sqrsum = jnp.sum(rf * rf, axis=(-2, -1))
+    k = residual.shape[-2] * residual.shape[-1]
+    return mhc_pre_big_fuse(
+        dot_mix, sqrsum, residual, mhc_scale, mhc_base, k,
+        rms_eps, mhc_pre_eps, mhc_sinkhorn_eps, mhc_post_mult_value,
+        sinkhorn_repeat,
+    )

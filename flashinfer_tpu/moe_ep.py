@@ -1,0 +1,481 @@
+"""Expert-parallel MoE runtime namespace (reference ``flashinfer/moe_ep``).
+
+The reference's moe_ep subsystem is an NCCL/NIXL *fleet* runtime:
+bootstrap a communicator world, allocate RDMA buffers, then run
+dispatch -> expert GEMMs -> combine through split- or mega-fused layers.
+On TPU every one of those concerns maps onto the mesh model:
+
+- fleet/bootstrap -> ``jax.distributed`` + a ``Mapping``/``Mesh`` axis
+  (the ICI/DCN fabric needs no per-op communicator objects);
+- dispatch/combine -> ``fused_moe_ep``'s allgather or all_to_all modes
+  (``alltoall_exact`` is the no-drop split-layer equivalent);
+- RDMA buffer sizing / QP knobs -> absent by construction (XLA owns
+  collective buffering); the knob classes survive as inert records so
+  configuration code imports and constructs them unchanged;
+- arch/backends probes answer honestly for this hardware: there is ONE
+  backend ("xla-collective"), and NCCL/NIXL are not it.
+
+Cited: /root/reference/flashinfer/moe_ep/__init__.py (name surface),
+modes/split_layer.py (split semantics; the no-drop delivery contract
+fused_moe_ep's exact mode reproduces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu.fused_moe import fused_moe_ep
+
+
+class MoEEpNotBuiltError(RuntimeError):
+    """Reference: raised when the NCCL/NIXL extension is not built.  On
+    TPU the collective backend is always present, so this is raised only
+    by explicit requests for the CUDA fabrics."""
+
+
+class MoEEpArchError(RuntimeError):
+    """Unsupported arch/backend combination."""
+
+
+class MoEEpConfigError(ValueError):
+    """Invalid EP configuration."""
+
+
+# ---------------------------------------------------------------------------
+# enums + config records
+# ---------------------------------------------------------------------------
+
+
+class EpAlgorithm(enum.Enum):
+    """Dispatch/combine algorithm (reference EpAlgorithm) -> the
+    fused_moe_ep dispatch modes."""
+
+    ALLGATHER = "allgather"
+    ALLTOALL = "alltoall"
+    ALLTOALL_EXACT = "alltoall_exact"  # the no-drop split-layer contract
+
+
+class EpLayout(enum.Enum):
+    """Expert placement (reference EpLayout): experts shard contiguously
+    over the ep axis here (Mapping.ep_experts)."""
+
+    CONTIGUOUS = "contiguous"
+
+
+class QuantType(enum.Enum):
+    """EP-path activation quantization (reference QuantType): the TPU
+    low-precision story is int8 (native MXU); fp8 is storage-only."""
+
+    NONE = "none"
+    INT8 = "int8"
+    FP8 = "fp8"
+
+
+@dataclasses.dataclass
+class AlgoKnob:
+    """Base knob record (reference AlgoKnob family).  The CUDA knobs
+    tune RDMA channel/QP/buffer geometry, which has no TPU analogue —
+    they are carried as inert records so config code runs unchanged."""
+
+    name: str = ""
+    value: Any = None
+
+
+class FleetAlgoKnobAllocator(AlgoKnob):
+    pass
+
+
+class FleetAlgoKnobNumChannelsPerRank(AlgoKnob):
+    pass
+
+
+class FleetAlgoKnobNumQpsPerRank(AlgoKnob):
+    pass
+
+
+class FleetAlgoKnobQuantization(AlgoKnob):
+    pass
+
+
+class FleetAlgoKnobRdmaBufferSize(AlgoKnob):
+    pass
+
+
+class FleetAlgoKnobTopologyCapacity(AlgoKnob):
+    pass
+
+
+class HandleAlgoKnobNumReceivedTokens(AlgoKnob):
+    pass
+
+
+class HandleAlgoKnobSplitOperation(AlgoKnob):
+    pass
+
+
+class HandleAlgoKnobTopKWeights(AlgoKnob):
+    pass
+
+
+class HandleAlgoKnobUserStream(AlgoKnob):
+    pass
+
+
+@dataclasses.dataclass
+class BootstrapConfig:
+    """World-bootstrap parameters (reference BootstrapConfig) — the
+    jax.distributed coordinates."""
+
+    world_size: int = 1
+    rank: int = 0
+    coordinator_address: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FleetParams:
+    """Fleet geometry (reference FleetParams): on TPU this is the mesh
+    axis the experts shard over."""
+
+    ep_size: int = 1
+    num_experts: int = 1
+    axis: str = "tp"
+    algorithm: EpAlgorithm = EpAlgorithm.ALLGATHER
+    capacity_factor: float = 2.0
+    knobs: Tuple[AlgoKnob, ...] = ()
+
+
+@dataclasses.dataclass
+class HandleParams:
+    """Per-forward handle parameters (reference HandleParams)."""
+
+    top_k: int = 2
+    quant: QuantType = QuantType.NONE
+    knobs: Tuple[AlgoKnob, ...] = ()
+
+
+@dataclasses.dataclass
+class DispatchInputParams:
+    hidden_states: Any = None
+    topk_ids: Any = None
+    topk_weights: Any = None
+
+
+@dataclasses.dataclass
+class DispatchOutput:
+    """Dispatch result (reference DispatchOutput).  The fused TPU path
+    never materializes the routed intermediate outside the op, so this
+    record is produced only by the explicit two-phase API below."""
+
+    hidden_states: Any = None
+    handle: Any = None
+
+
+@dataclasses.dataclass
+class CombineInputParams:
+    expert_output: Any = None
+    handle: Any = None
+
+
+@dataclasses.dataclass
+class CombineOutput:
+    hidden_states: Any = None
+
+
+# mega-mode weight preprocessing: the reference fuses all experts' GEMMs
+# into one mega kernel over preprocessed (shuffled/quantized) weights;
+# XLA owns layout, so preprocessing is identity and the configs are
+# records only
+@dataclasses.dataclass
+class DeepGemmMegaMoeConfig:
+    num_experts: int = 1
+    hidden_size: int = 0
+    intermediate_size: int = 0
+
+
+Mxfp8CutedslMegaMoeConfig = DeepGemmMegaMoeConfig
+Nvfp4CutedslMegaMoeConfig = DeepGemmMegaMoeConfig
+
+
+def preprocess_mega_weights(weights, *_, **__):
+    """Identity: mega-kernel weight shuffles are CUDA layout prep."""
+    return weights
+
+
+preprocess_mxfp8_cutedsl_mega_weights = preprocess_mega_weights
+preprocess_nvfp4_cutedsl_mega_weights = preprocess_mega_weights
+
+
+@dataclasses.dataclass
+class FusedMoeKernelConfig:
+    activation: str = "silu"
+
+
+class IdentityConfig:
+    """No-quant kernel config (reference IdentityConfig)."""
+
+
+@dataclasses.dataclass
+class SplitConfig:
+    """Split-layer kernel config (reference SplitConfig)."""
+
+    algorithm: EpAlgorithm = EpAlgorithm.ALLTOALL_EXACT
+    capacity_factor: float = 2.0
+
+
+MegaConfig = SplitConfig
+NCCLEPConfig = SplitConfig
+NcclEpConfig = SplitConfig
+NvepConfig = SplitConfig
+
+
+@dataclasses.dataclass
+class SplitKernelContext:
+    params: FleetParams = dataclasses.field(default_factory=FleetParams)
+
+
+@dataclasses.dataclass
+class MoEEpTensors:
+    """The EP layer's tensor bundle (reference MoEEpTensors)."""
+
+    w_gate_up: Any = None
+    w_down: Any = None
+    w1_scale: Any = None
+    w2_scale: Any = None
+
+
+@dataclasses.dataclass
+class MoEWeightPack:
+    """Expert weight pack (reference MoEWeightPack)."""
+
+    gemm1: Any = None
+    gemm2: Any = None
+
+
+def dummy_moe_weights(num_experts: int, hidden: int, inter: int,
+                      dtype=jnp.bfloat16, seed: int = 0) -> MoEWeightPack:
+    """Random weight pack for tests/benches (reference dummy_moe_weights)."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return MoEWeightPack(
+        gemm1=(jax.random.normal(k1, (num_experts, hidden, 2 * inter),
+                                 jnp.float32) * 0.02).astype(dtype),
+        gemm2=(jax.random.normal(k2, (num_experts, inter, hidden),
+                                 jnp.float32) * 0.02).astype(dtype),
+    )
+
+
+def kernel_requires_weights(config) -> bool:
+    """Reference predicate: every TPU kernel config takes weights."""
+    return True
+
+
+# ---------------------------------------------------------------------------
+# bootstrap / fleet lifecycle -> jax.distributed + Mesh
+# ---------------------------------------------------------------------------
+
+
+def bootstrap_comm_group(config: Optional[BootstrapConfig] = None, **kw):
+    """Initialize the multi-host world (reference bootstrap_comm_group ->
+    ``jax.distributed.initialize``).  Single-process worlds are a no-op."""
+    cfg = config or BootstrapConfig(**kw)
+    if cfg.world_size > 1 and cfg.coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.world_size,
+            process_id=cfg.rank,
+        )
+    return cfg
+
+
+def bootstrap_ep_rank_world() -> Tuple[int, int]:
+    """(rank, world) of this process (reference bootstrap_ep_rank_world)."""
+    return jax.process_index(), jax.process_count()
+
+
+def bootstrap_ep_world_size() -> int:
+    return jax.process_count()
+
+
+def bootstrap_moe_ep_runtime(*args, **kw):
+    """Reference: loads the NCCL/NIXL extension.  The XLA collective
+    runtime is always resident; returns the bootstrap config."""
+    return bootstrap_comm_group(*args, **kw) if (args or kw) else None
+
+
+def ensure_moe_ep_cuda_device(*_, **__):
+    """Reference pins the CUDA device; device placement is XLA's on TPU."""
+    return None
+
+
+def finalize_moe_ep_runtime(*_, **__):
+    return None
+
+
+class Handle:
+    """Per-forward routing handle (reference Handle): carries what the
+    combine needs.  The fused path keeps this inside the op; the
+    two-phase API below threads it explicitly."""
+
+    def __init__(self, params: HandleParams, wts, ids):
+        self.params = params
+        self.topk_weights = wts
+        self.topk_ids = ids
+
+
+class Fleet:
+    """EP communicator + expert placement (reference Fleet).  On TPU a
+    fleet IS a mesh axis: construct inside ``shard_map`` (or pass the
+    axis name) and call :meth:`run_split` per layer."""
+
+    def __init__(self, params: FleetParams):
+        validate_fleet_params(params)
+        self.params = params
+
+    def make_handle(self, wts, ids,
+                    params: Optional[HandleParams] = None) -> Handle:
+        return Handle(params or HandleParams(), wts, ids)
+
+    def run_split(self, hidden, tensors: MoEEpTensors, handle: Handle,
+                  activation: str = "silu", return_dropped: bool = False):
+        """The split-layer forward (reference MoEEpSplitLayer.forward /
+        modes/split_layer.py): dispatch -> expert GEMMs -> combine over
+        the fleet's axis, delivering every routed token when the
+        algorithm is ALLTOALL_EXACT."""
+        return fused_moe_ep(
+            hidden, tensors.w_gate_up, tensors.w_down,
+            handle.topk_weights, handle.topk_ids,
+            self.params.num_experts, axis=self.params.axis,
+            activation=activation,
+            dispatch=self.params.algorithm.value,
+            capacity_factor=self.params.capacity_factor,
+            return_dropped=return_dropped,
+        )
+
+
+def create_fleet(params: FleetParams) -> Fleet:
+    return Fleet(params)
+
+
+class MoEEpLayer:
+    """Layer-object form (reference MoEEpLayer): binds a fleet + weights."""
+
+    def __init__(self, fleet: Fleet, tensors: MoEEpTensors,
+                 config: Optional[SplitConfig] = None):
+        self.fleet = fleet
+        self.tensors = tensors
+        self.config = config or SplitConfig()
+
+    def forward(self, hidden, topk_weights, topk_ids, **kw):
+        return self.fleet.run_split(
+            hidden, self.tensors,
+            self.fleet.make_handle(topk_weights, topk_ids), **kw
+        )
+
+    __call__ = forward
+
+
+MoEEpSplitLayer = MoEEpLayer
+
+
+class MoEEpMegaLayer(MoEEpLayer):
+    """Mega mode fuses dispatch+GEMMs+combine into one kernel chain; the
+    TPU split path is already one jitted program, so mega == split."""
+
+
+def run_split_kernel(ctx: SplitKernelContext, hidden, tensors, handle,
+                     **kw):
+    """Free-function split forward (reference run_split_kernel)."""
+    return Fleet(ctx.params).run_split(hidden, tensors, handle, **kw)
+
+
+# ---------------------------------------------------------------------------
+# validation (reference validation.py family) — TPU-meaningful checks
+# ---------------------------------------------------------------------------
+
+
+def validate_fleet_params(params: FleetParams) -> None:
+    if params.ep_size < 1:
+        raise MoEEpConfigError(f"ep_size must be >= 1, got {params.ep_size}")
+    if params.num_experts % max(params.ep_size, 1):
+        raise MoEEpConfigError(
+            f"num_experts ({params.num_experts}) must divide over ep_size "
+            f"({params.ep_size}) — experts shard contiguously"
+        )
+    if not isinstance(params.algorithm, EpAlgorithm):
+        raise MoEEpConfigError(f"unknown algorithm {params.algorithm!r}")
+
+
+def validate_fleet_weights(tensors: MoEEpTensors) -> None:
+    w1, w2 = tensors.w_gate_up, tensors.w_down
+    if w1 is None or w2 is None:
+        raise MoEEpConfigError("fleet weights missing")
+    if w1.ndim != 3 or w2.ndim != 3 or w1.shape[0] != w2.shape[0]:
+        raise MoEEpConfigError(
+            f"expert weights must be [E_local, ...] stacks, got "
+            f"{getattr(w1, 'shape', None)} / {getattr(w2, 'shape', None)}"
+        )
+
+
+def validate_mega_fleet_params(params: FleetParams) -> None:
+    validate_fleet_params(params)
+
+
+def validate_mega_arch(*_, **__) -> None:
+    return None  # one arch: the mesh
+
+
+def validate_arch_for_backend(backend: str = "xla-collective") -> None:
+    if backend not in ("xla-collective", "auto"):
+        raise MoEEpArchError(
+            f"backend {backend!r} is a CUDA fabric; this hardware runs "
+            "XLA collectives over ICI/DCN"
+        )
+
+
+def validate_bootstrap_world_size(world_size: int) -> None:
+    if world_size < 1:
+        raise MoEEpConfigError("world_size must be >= 1")
+
+
+def validate_bootstrap_process_group_ready() -> bool:
+    return True  # XLA collectives need no separate process group
+
+
+def ensure_bootstrap_dist_validated(*_, **__) -> None:
+    return None
+
+
+def validate_split_forward_inputs(hidden, topk_weights, topk_ids) -> None:
+    if hidden.ndim != 2 or topk_ids.ndim != 2:
+        raise MoEEpConfigError(
+            f"split forward takes hidden [T, H] and topk_ids [T, K]; got "
+            f"{hidden.shape} / {topk_ids.shape}"
+        )
+    if topk_weights.shape != topk_ids.shape:
+        raise MoEEpConfigError("topk_weights/topk_ids shape mismatch")
+
+
+def validate_mega_forward_inputs(hidden, topk_weights, topk_ids) -> None:
+    validate_split_forward_inputs(hidden, topk_weights, topk_ids)
+
+
+# ---------------------------------------------------------------------------
+# backend probes — honest answers for this hardware
+# ---------------------------------------------------------------------------
+
+
+def have_nccl_ep() -> bool:
+    """NCCL-EP is a CUDA fabric; not this hardware's backend."""
+    return False
+
+
+def have_nixl_ep() -> bool:
+    return False
+
+
+def available_backends() -> List[str]:
+    return ["xla-collective"]

@@ -202,3 +202,18 @@ def msa_sparse_attention(
         sm_scale=sm,
     )
     return w.run(q, k, v)
+
+
+# reference msa_ops name surface (msa_ops/__init__.py)
+msa_sparse_decode_attention = msa_sparse_attention
+"""Reference ``msa_sparse_decode_attention`` -> the token-granular
+sparse attention entry (same selection semantics at qo_len == 1)."""
+
+
+def msa_proxy_score_fp4(q, k, block_q: int = 64, block_kv: int = 64):
+    """Reference fp4-quantized proxy scoring (msa_ops/proxy_score.py,
+    cute_dsl fp4 variant): the fp4 path exists to cheapen the PROXY
+    ranking pass on Blackwell tensor cores; on TPU the proxy runs on the
+    bf16 MXU directly (ranking is already the cheap pass), so this is
+    the same block-pooled score."""
+    return msa_proxy_score(q, k, block_q=block_q, block_kv=block_kv)

@@ -218,3 +218,18 @@ def grid_trace_to_buffer(tags) -> "object":
     return _np.concatenate(
         [_np.array([header], _np.int64), tags.reshape(-1).astype(_np.int64)]
     )
+
+
+class TraceGenerator:
+    """Reference profiler.TraceGenerator: accumulates profiler events and
+    emits a trace file.  Wraps this module's timeline recorder."""
+
+    def __init__(self, path: str = "/tmp/flashinfer_tpu_timeline.json"):
+        self.path = path
+        start_timeline()
+
+    def record(self, name: str, t0: float, t1: float) -> None:
+        record_event(name, t0, t1)
+
+    def flush(self):
+        return stop_timeline(self.path)

@@ -353,3 +353,11 @@ def chain_speculative_sampling(
         jnp.where(out_pos == pos[:, None], extra[:, None], -1),
     ).astype(jnp.int32)
     return out, accepted.astype(jnp.int32), emitted.astype(jnp.int32)
+
+
+def get_default_generators(*_, **__):
+    """Reference returns per-device torch.Generators for the sampling
+    kernels.  JAX sampling is functional — every entry takes an explicit
+    ``key=jax.random.PRNGKey(...)`` — so there is no generator registry;
+    returns an empty mapping for import parity."""
+    return {}

@@ -253,3 +253,9 @@ def topk_clusters_ragged_transform(logits, seq_lens, offsets, top_k_: int,
         logits, indptr, seq_lens, top_k_, backend="threshold"
     )
     return rows
+
+
+def get_shared_bytes_per_block_optin(device=None) -> int:
+    """Reference: max opt-in CUDA shared memory per block.  The analogous
+    on-chip working memory on TPU is VMEM (~128 MB v5e)."""
+    return 128 * 1024 * 1024

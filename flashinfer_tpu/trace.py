@@ -105,3 +105,12 @@ def traced_api(fn: Callable = None, *, name: str = None) -> Callable:
     if fn is not None:
         return deco(fn)
     return deco
+
+
+def build_fi_trace_fn(op_name: str, reference_fn: Callable = None, **_):
+    """Reference fi_trace.build_fi_trace_fn: builds the traced wrapper
+    for an op from its TraceTemplate.  Here tracing is the
+    :func:`traced_api` decorator, so this returns it applied."""
+    if reference_fn is None:
+        return lambda fn: traced_api(fn, name=op_name)
+    return traced_api(reference_fn, name=op_name)

@@ -555,3 +555,44 @@ def get_globaltimer_kernel(*_, **__):
 
 def prepare_jit_additional_args(*_, **__):
     return {}
+
+
+# reference numeric/workspace constants (decode.py imports): the CUDA
+# kernels compute softmax in base-2 (log2e folds into the scale) and
+# allocate a fixed single-kernel scratch; TPU kernels use natural log and
+# XLA owns scratch, so these exist for import parity and host-side math
+log2e = 1.44269504088896340736
+SINGLE_KERNEL_TMP_SIZE = 0
+
+
+def get_alibi_slopes(n_heads: int, device=None):
+    """ALiBi head slopes (reference utils.get_alibi_slopes): geometric
+    sequence 2^(-8i/n) with the odd-head interleave extension."""
+    import math
+
+    import jax.numpy as jnp
+
+    n = 2 ** math.floor(math.log2(n_heads))
+    m = jnp.power(2.0 ** (-8.0 / n), jnp.arange(1, 1 + n, dtype=jnp.float32))
+    if n < n_heads:
+        m_hat = jnp.power(
+            2.0 ** (-4.0 / n),
+            jnp.arange(1, 1 + 2 * (n_heads - n), 2, dtype=jnp.float32),
+        )
+        m = jnp.concatenate([m, m_hat])
+    return m
+
+
+def determine_attention_backend(*_, **__) -> str:
+    """Reference picks fa2/fa3/trtllm per arch; one answer here."""
+    return "pallas"
+
+
+class FP4Tensor:
+    """Packed-fp4 tensor record (reference utils.FP4Tensor): data is the
+    block-int4 packed array, scale the per-block f32 scales."""
+
+    def __init__(self, data, scale, original_shape=None):
+        self.data = data
+        self.scale = scale
+        self.original_shape = original_shape or getattr(data, "shape", None)
