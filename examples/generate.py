@@ -14,6 +14,7 @@ canonical serving lifecycle —
 Run: ``python examples/generate.py`` (CPU or TPU; tiny random model).
 """
 
+import functools
 import os
 import sys
 
@@ -157,9 +158,95 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False):
     return out_tokens
 
 
+def generate_stepwise(model: str, prompt_lens, max_new_tokens=8, seed=0):
+    """Serving loop for the MoE/MLA model families (mixtral, deepseek):
+    the prompt is consumed token-by-token through the SAME paged decode
+    step that serves generation — the semantically-real serving flow for
+    an example (production prefill for these families batches tokens;
+    the llama path above shows that shape with the prefill wrapper)."""
+    B = len(prompt_lens)
+    PS = 8
+    max_len = max(prompt_lens) + max_new_tokens
+    pages_per_req = -(-max_len // PS)
+    num_pages = B * pages_per_req
+    page_table = jnp.arange(num_pages, dtype=jnp.int32).reshape(
+        B, pages_per_req)
+    use_pallas = jax.default_backend() == "tpu"
+
+    if model == "mixtral":
+        from flashinfer_tpu.models import (
+            MixtralConfig, init_mixtral_params, mixtral_decode_step,
+        )
+
+        cfg = MixtralConfig.tiny(num_layers=2)
+        params = init_mixtral_params(jax.random.PRNGKey(seed), cfg)
+        caches = [
+            (jnp.zeros((num_pages, cfg.num_kv_heads, PS, cfg.head_dim),
+                       cfg.dtype),) * 2
+            for _ in range(cfg.num_layers)
+        ]
+        step = jax.jit(functools.partial(
+            mixtral_decode_step, params, cfg, use_pallas=use_pallas))
+    elif model == "deepseek":
+        from flashinfer_tpu.models import (
+            DeepseekConfig, deepseek_decode_step, init_deepseek_params,
+        )
+
+        cfg = DeepseekConfig.tiny(num_layers=2)
+        params = init_deepseek_params(jax.random.PRNGKey(seed), cfg)
+        caches = [
+            (jnp.zeros((num_pages, PS, cfg.kv_lora_rank), cfg.dtype),
+             jnp.zeros((num_pages, PS, 128), cfg.dtype))  # lane-padded kpe
+            for _ in range(cfg.num_layers)
+        ]
+        step = jax.jit(functools.partial(
+            deepseek_decode_step, params, cfg, use_pallas=use_pallas))
+    else:
+        raise ValueError(f"unknown model {model!r}")
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, l) for l in prompt_lens]
+    maxp = max(prompt_lens)
+    kv_lens = jnp.zeros((B,), jnp.int32)
+    # consume prompts; each request's HANDOFF logits are captured at its
+    # own last prompt token (shorter requests then idle by re-feeding
+    # that token — the re-fed write lands in the slot the first
+    # generated token overwrites, so the cache enters generation exact)
+    handoff = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+    for t in range(maxp):
+        toks = jnp.asarray(
+            [p[min(t, len(p) - 1)] for p in prompts], jnp.int32)
+        active = jnp.asarray([t < l for l in prompt_lens])
+        positions = jnp.minimum(kv_lens, t)
+        logits, caches = step(toks, positions, caches, page_table, kv_lens)
+        finished_now = jnp.asarray([t == l - 1 for l in prompt_lens])
+        handoff = jnp.where(finished_now[:, None], logits, handoff)
+        kv_lens = kv_lens + active.astype(jnp.int32)
+    logits = handoff
+
+    pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
+    key = jax.random.PRNGKey(seed + 1)
+    out_tokens = [[] for _ in range(B)]
+    for _ in range(max_new_tokens):
+        key, sk = jax.random.split(key)
+        tokens = pipe(logits, key=sk, temperature=0.8, top_k=40, top_p=0.95)
+        for b in range(B):
+            out_tokens[b].append(int(tokens[b]))
+        logits, caches = step(tokens, kv_lens, caches, page_table, kv_lens)
+        kv_lens = kv_lens + 1
+    return out_tokens
+
+
 if __name__ == "__main__":
     int8 = "int8" in sys.argv
-    outs = generate([5, 9], max_new_tokens=6, int8_weights=int8)
+    model = next((a for a in sys.argv[1:] if a in ("mixtral", "deepseek")),
+                 None)
+    if model:
+        outs = generate_stepwise(model, [5, 9], max_new_tokens=6)
+        label = model
+    else:
+        outs = generate([5, 9], max_new_tokens=6, int8_weights=int8)
+        label = "llama" + (" int8 weights" if int8 else "")
     for b, toks in enumerate(outs):
         print(f"request {b}: generated {toks}")
-    print(f"generate.py ok{' (int8 weights)' if int8 else ''}")
+    print(f"generate.py ok ({label})")

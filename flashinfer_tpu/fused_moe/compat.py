@@ -84,7 +84,9 @@ class RoutingInputMode(enum.IntEnum):
 
 @dataclasses.dataclass
 class ExpertConfig:
-    num_experts: int = 1
+    # 0 = infer from the weight pack at run time (a non-zero value here
+    # must match the weights; the runner's `or` fallback fires on 0)
+    num_experts: int = 0
     top_k: int = 2
     intermediate_size: int = 0
     hidden_size: int = 0
@@ -335,8 +337,15 @@ def bgmv_moe_expand(intermediates, lora_b_weights, sorted_token_ids,
         B = _slot_select(b, lora, e).astype(jnp.float32)  # [M, o, r]
         parts.append(jnp.einsum("mr,mor->mo", h, B))
     delta = jnp.concatenate(parts, axis=-1) * w.reshape(-1)[:, None]
-    T = int(num_tokens) if num_tokens is not None else int(tok.max()) + 1
-    return jnp.zeros((T, delta.shape[-1]), jnp.float32).at[tok].add(delta)
+    if num_tokens is None:
+        # inferring from tok.max() breaks under jit and undersizes when
+        # the highest-index tokens receive no slots — require it
+        raise ValueError(
+            "TPU backend: bgmv_moe_expand needs num_tokens= (the output "
+            "row count cannot be inferred from the slot schedule)"
+        )
+    return jnp.zeros((int(num_tokens), delta.shape[-1]),
+                     jnp.float32).at[tok].add(delta)
 
 
 def bgmv_moe(x, lora_a_weights, lora_b_weights, sorted_token_ids,

@@ -211,3 +211,25 @@ def test_sharded_decode_step_int8_weights():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref_logits), rtol=1e-1, atol=2e-2
     )
+
+
+@pytest.mark.parametrize("mode", ["", "int8", "mixtral", "deepseek"])
+def test_generate_example_all_families(mode):
+    """examples/generate.py end-to-end for every model family (llama
+    prefill-wrapper path, int8 serving mode, mixtral and deepseek
+    stepwise serving loops)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    args = [sys.executable, "examples/generate.py", "cpu"]
+    if mode:
+        args.append(mode)
+    r = subprocess.run(
+        args, capture_output=True, text=True, timeout=600,
+        cwd=os.path.join(os.path.dirname(__file__), ".."), env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "generate.py ok" in r.stdout
