@@ -327,10 +327,13 @@ def phase_scans(sweep: bool):
     # is the go/no-go signal for a Pallas decode kernel (VERDICT r3 #8):
     # XLA already streaming near roofline = no kernel justified
     hbm_gbps = chip_peak_tbps() * 1000.0  # per-generation HBM spec
+    # bench the WHOLE (y, new_state) tuple — selecting [1] would let XLA
+    # dead-code-eliminate the output projection (y depends on the state,
+    # never vice versa) and under-report every decode step
     t = _guard(
         "bench.scans.mamba_decode", (B, H, dim, ds),
         lambda: bench_fn_device(
-            lambda *a: mamba_mod.selective_state_update(*a)[1],
+            mamba_mod.selective_state_update,
             st, xd, dtd, Ad, Bd, Cd, repeats=5,
         ),
     )
@@ -353,10 +356,8 @@ def phase_scans(sweep: bool):
         jax.random.fold_in(key, 25), (B, Hg, dk)))
     gstate_bytes = 2 * B * Hg * dk * dv * 4
     for dname, dfn, da in (
-        ("gdn_decode",
-         lambda *a: gdn_mod.gdn_decode_step(*a)[1], ag_d),
-        ("kda_decode",
-         lambda *a: gdn_mod.kda_decode_step(*a)[1], ak_d),
+        ("gdn_decode", gdn_mod.gdn_decode_step, ag_d),
+        ("kda_decode", gdn_mod.kda_decode_step, ak_d),
     ):
         t = _guard(
             f"bench.scans.{dname}", (B, Hg, dk, dv),

@@ -10,8 +10,12 @@ emitting CSV rows of latency / TFLOPS / TB/s.
 
 Routines: decode (paged batch decode), prefill (causal ragged), gemm
 (bf16 square), moe (fused MoE forward), sampling (top-k/top-p over 128k
-vocab).  Runs on whatever backend jax selects (TPU on hardware; CPU with
-the xla backend elsewhere — pass --quick for CI-sized shapes).
+vocab), mamba (SSD prefill + selective-state-update decode), gdn
+(GDN/KDA prefill + decode steps), norm (rmsnorm family), rope,
+quantization (fp8/int8/fp4), sparse_attention (BSR), mla (paged MLA
+decode at DeepSeek shapes).  Runs on whatever backend jax selects (TPU
+on hardware; CPU with the xla backend elsewhere — pass --quick for
+CI-sized shapes).
 """
 
 import argparse
@@ -196,6 +200,200 @@ def _rows_mamba(args):
                latency_us=td * 1e6, tbps=state_bytes / td / 1e12, tflops="")
 
 
+def _rows_gdn(args):
+    """GDN + KDA chunked prefill and decode steps (reference
+    routines/gdn.py)."""
+    import jax
+    import jax.numpy as jnp
+    from flashinfer_tpu.gdn import (
+        gdn_chunk_prefill, gdn_decode_step, kda_chunk_prefill,
+        kda_decode_step,
+    )
+
+    B, L, H = args.mamba_batch, args.mamba_seqlen, args.mamba_heads
+    dk = dv = 32 if args.quick else 128
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, L, H, dk), jnp.float32) * 0.3
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, L, H, dk)) * 0.3
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, L, H, dv))
+    beta = jax.nn.sigmoid(
+        jax.random.normal(jax.random.fold_in(key, 3), (B, L, H)))
+    a_g = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 4), (B, L, H)))
+    a_k = jnp.exp(-0.05 * jax.random.uniform(
+        jax.random.fold_in(key, 5), (B, L, H, dk)))
+    flops = 2 * B * L * H * dk * dv * 2
+    for name, fn, aa in (
+        ("gdn_prefill",
+         lambda *a: gdn_chunk_prefill(*a)[0], a_g),
+        ("kda_prefill",
+         lambda *a: kda_chunk_prefill(*a)[0], a_k),
+    ):
+        t = _bench(args, fn, q, k, v, aa, beta)
+        yield dict(routine=name, config=f"B{B}_L{L}_H{H}",
+                   latency_us=t * 1e6, tbps="", tflops=flops / t / 1e12)
+    s = jax.random.normal(key, (B, H, dk, dv), jnp.float32)
+    state_bytes = 2 * B * H * dk * dv * 4
+    # bench the WHOLE (o, new_state) tuple: selecting [1] would let XLA
+    # dead-code-eliminate the output einsum (o depends on the state, not
+    # vice versa) and under-report the step
+    for name, fn, aa in (
+        ("gdn_decode", gdn_decode_step, a_g[:, 0]),
+        ("kda_decode", kda_decode_step, a_k[:, 0]),
+    ):
+        t = _bench(args, fn, s, q[:, 0], k[:, 0], v[:, 0], aa, beta[:, 0])
+        yield dict(routine=name, config=f"B{B}_H{H}",
+                   latency_us=t * 1e6, tbps=state_bytes / t / 1e12,
+                   tflops="")
+
+
+def _rows_norm(args):
+    """rmsnorm family (reference routines/norm.py)."""
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+
+    h = 256 if args.quick else 8192
+    for tname, tokens in (("small", 128 if args.quick else 1024),
+                          ("large", 512 if args.quick else 16384)):
+        x = jax.random.normal(jax.random.PRNGKey(0), (tokens, h),
+                              jnp.bfloat16)
+        r = jax.random.normal(jax.random.PRNGKey(1), (tokens, h),
+                              jnp.bfloat16)
+        w = jnp.ones((h,), jnp.bfloat16)
+        gbytes = 2 * tokens * h * 2
+        t = _bench(args, lambda xx, ww: fi.rmsnorm(xx, ww), x, w)
+        yield dict(routine="rmsnorm", config=f"{tname}_t{tokens}_h{h}",
+                   latency_us=t * 1e6, tbps=gbytes / t / 1e12, tflops="")
+        t = _bench(args, lambda xx, rr, ww: fi.fused_add_rmsnorm(xx, rr, ww),
+                   x, r, w)
+        yield dict(routine="fused_add_rmsnorm",
+                   config=f"{tname}_t{tokens}_h{h}",
+                   latency_us=t * 1e6, tbps=2 * gbytes / t / 1e12, tflops="")
+
+
+def _rows_rope(args):
+    """RoPE family (reference routines/rope.py)."""
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+
+    hq, hkv, hd = args.num_qo_heads, args.num_kv_heads, args.head_dim
+    tokens = 256 if args.quick else 8192
+    q = jax.random.normal(jax.random.PRNGKey(0), (tokens, hq, hd),
+                          jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (tokens, hkv, hd),
+                          jnp.bfloat16)
+    pos = jnp.arange(tokens, dtype=jnp.int32)
+    gbytes = 2 * tokens * (hq + hkv) * hd * 2
+    t = _bench(args, lambda qq, kk, pp: fi.apply_rope_pos_ids(qq, kk, pp),
+               q, k, pos)
+    yield dict(routine="rope", config=f"t{tokens}_h{hq}/{hkv}",
+               latency_us=t * 1e6, tbps=gbytes / t / 1e12, tflops="")
+
+
+def _rows_quantization(args):
+    """Quantize family (reference routines/quantization.py)."""
+    import jax
+    import jax.numpy as jnp
+    from flashinfer_tpu.quantization import (
+        quantize_fp4, quantize_fp8_per_tensor, quantize_int8,
+    )
+
+    m = 256 if args.quick else 8192
+    k = 256 if args.quick else 4096
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.bfloat16)
+    gbytes = m * k * 3  # read bf16 + write ~1B
+    for name, fn in (
+        ("quant_fp8", lambda xx: quantize_fp8_per_tensor(xx)[0]),
+        ("quant_int8", lambda xx: quantize_int8(xx)[0]),
+        ("quant_fp4", lambda xx: quantize_fp4(xx)[0]),
+    ):
+        t = _bench(args, fn, x)
+        yield dict(routine=name, config=f"{m}x{k}",
+                   latency_us=t * 1e6, tbps=gbytes / t / 1e12, tflops="")
+
+
+def _rows_sparse_attention(args):
+    """Block-sparse attention (reference routines/sparse_attention.py)."""
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import attention_flops
+
+    hd = args.head_dim
+    n = 512 if args.quick else 4096
+    R = C = 64
+    MB, NB = n // R, n // C
+    rng = _np.random.default_rng(0)
+    # ~25%-dense random BSR mask
+    mask = rng.random((MB, NB)) < 0.25
+    _np.fill_diagonal(mask, True)
+    indptr = _np.zeros(MB + 1, _np.int32)
+    indices = []
+    for i in range(MB):
+        cols = _np.nonzero(mask[i])[0]
+        indices.extend(cols)
+        indptr[i + 1] = len(indices)
+    q = jax.random.normal(jax.random.PRNGKey(0), (n, 1, hd), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (n, 1, hd), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (n, 1, hd), jnp.bfloat16)
+    w = fi.BlockSparseAttentionWrapper()
+    w.plan(_np.asarray(indptr), _np.asarray(indices, _np.int32), n, n,
+           R, C, 1, 1, hd)
+    t = _bench(args, lambda qq, kk, vv: w.run(qq, kk, vv), q, k, v)
+    density = mask.mean()
+    fl = attention_flops(n, n, 1, hd, hd, causal=False) * density
+    yield dict(routine="sparse_attention",
+               config=f"n{n}_{R}x{C}_d{density:.2f}",
+               latency_us=t * 1e6, tbps="", tflops=fl / t / 1e12)
+
+
+def _rows_mla(args):
+    """MLA paged decode (reference bench_deepseek_mla.py shapes)."""
+    import jax
+    import jax.numpy as jnp
+    from flashinfer_tpu.ops.mla_decode import (
+        mla_paged_decode_attention, xla_mla_paged_decode,
+    )
+    from flashinfer_tpu.utils import is_tpu
+
+    rank, rope, ps = (64, 64, 8) if args.quick else (512, 64, 16)
+    H = 4 if args.quick else 128
+    for bs in args.batch:
+        for ctx in args.ctx:
+            ppr = ctx // ps
+            npages = bs * ppr
+            qn = jax.random.normal(jax.random.PRNGKey(0), (bs, H, rank),
+                                   jnp.bfloat16)
+            qp = jax.random.normal(jax.random.PRNGKey(1), (bs, H, rope),
+                                   jnp.bfloat16)
+            ckv = jax.random.normal(jax.random.PRNGKey(2),
+                                    (npages, ps, rank), jnp.bfloat16)
+            kpe = jax.random.normal(jax.random.PRNGKey(3),
+                                    (npages, ps, 128), jnp.bfloat16)
+            # permuted pages, like _rows_decode: contiguous tables would
+            # benchmark an unrealistically sequential gather pattern
+            table = jnp.asarray(
+                np.random.default_rng(0).permutation(npages)
+                .reshape(bs, ppr).astype(np.int32)
+            )
+            lens = jnp.full((bs,), ctx, jnp.int32)
+            fn = (mla_paged_decode_attention if is_tpu()
+                  else xla_mla_paged_decode)
+            sm = 1.0 / float(128 + rope) ** 0.5
+            t = _bench(
+                args,
+                lambda a, b, c, d: fn(a, b, c, d, table, lens, sm_scale=sm),
+                qn, qp, ckv, kpe,
+            )
+            gbytes = bs * ctx * (rank + rope) * 2  # cache read per step
+            yield dict(routine="mla_decode", config=f"bs{bs}_ctx{ctx}",
+                       latency_us=t * 1e6, tbps=gbytes / t / 1e12,
+                       tflops="")
+
+
 ROUTINES = {
     "decode": _rows_decode,
     "prefill": _rows_prefill,
@@ -203,6 +401,12 @@ ROUTINES = {
     "moe": _rows_moe,
     "sampling": _rows_sampling,
     "mamba": _rows_mamba,
+    "gdn": _rows_gdn,
+    "norm": _rows_norm,
+    "rope": _rows_rope,
+    "quantization": _rows_quantization,
+    "sparse_attention": _rows_sparse_attention,
+    "mla": _rows_mla,
 }
 
 
