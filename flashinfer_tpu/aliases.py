@@ -144,6 +144,18 @@ def trtllm_batch_decode_with_kv_cache_mla(
             "BatchMLAPagedAttentionWrapper.run_sparse (the top-k rows come "
             "from topk.top_k_page_table_transform)"
         )
+    # reference query layout is [batch, q_len_per_request, heads, dim]
+    # (mla/_core.py:2571); the decode op takes [batch, heads, dim], so
+    # the standard q_len=1 axis is squeezed and q_len>1 (MTP) rejected
+    q4 = query.ndim == 4
+    if q4:
+        if query.shape[1] != 1:
+            raise ValueError(
+                "TPU backend: trtllm_batch_decode_with_kv_cache_mla "
+                f"supports q_len_per_request == 1, got {query.shape[1]} "
+                "(run MTP windows through the MLA wrapper's ragged mode)"
+            )
+        query = query[:, 0]
     q_nope = query[..., :kv_lora_rank]
     q_pe = query[..., kv_lora_rank:]
     ckv = kv_cache[..., :kv_lora_rank]
@@ -151,7 +163,8 @@ def trtllm_batch_decode_with_kv_cache_mla(
     fn = mla_paged_decode_attention if is_tpu() else xla_mla_paged_decode
     o = fn(q_nope, q_pe, ckv, kpe, block_tables, seq_lens,
            sm_scale=float(bmm1_scale))
-    return o * float(bmm2_scale) if bmm2_scale != 1.0 else o
+    o = o * float(bmm2_scale) if bmm2_scale != 1.0 else o
+    return o[:, None] if q4 else o
 
 
 xqa_batch_decode_with_kv_cache_mla = trtllm_batch_decode_with_kv_cache_mla

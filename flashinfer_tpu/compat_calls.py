@@ -681,21 +681,55 @@ def trtllm_mxint4_block_scale_moe(
     )
 
 
+def _unpack_routed_topk_ids(packed):
+    """The trtllm routed-MoE entries take PACKED routing:
+    ``(expert_id << 16) | bf16_bits(weight)`` per (token, choice)
+    (reference fused_moe/core.py packed-topk-ids contract)."""
+    p = jnp.asarray(packed, jnp.int32)
+    ids = (p >> 16).astype(jnp.int32)
+    w = jax.lax.bitcast_convert_type(
+        (p & 0xFFFF).astype(jnp.uint16), jnp.bfloat16
+    ).astype(jnp.float32)
+    return ids, w
+
+
 def trtllm_mxint4_block_scale_routed_moe(
-    topk_ids, expert_weights, hidden_states,
+    topk_ids, hidden_states,
     gemm1_weights, gemm1_weights_scale, gemm1_alpha, gemm1_beta,
     gemm1_clamp_limit, gemm2_weights, gemm2_weights_scale,
-    num_experts: int, top_k: int, **kw,
+    num_experts: int, top_k: int,
+    n_group: Optional[int] = None, topk_group: Optional[int] = None,
+    intermediate_size: int = 0,
+    local_expert_offset: int = 0,
+    local_num_experts: Optional[int] = None,
+    routed_scaling_factor: Optional[float] = None,
+    routing_method_type: int = 0,
+    do_finalize: bool = True,
+    enable_pdl=None, gemm1_lora_delta=None, output=None, **_inert,
 ):
-    """Routed twin: caller supplies (topk_ids, expert_weights) instead of
-    routing logits."""
-    return cutlass_fused_moe(
-        hidden_states, topk_ids, expert_weights,
-        _int4_to_bf16(gemm1_weights, gemm1_weights_scale,
-                      "trtllm_mxint4_block_scale_routed_moe"),
-        _int4_to_bf16(gemm2_weights, gemm2_weights_scale,
-                      "trtllm_mxint4_block_scale_routed_moe"),
-        jnp.bfloat16, [],
+    """Reference ``trtllm_mxint4_block_scale_routed_moe``
+    (fused_moe/core.py:4546): PRE-ROUTED entry — ``topk_ids`` arrives
+    PACKED as ``(expert_id << 16) | bf16_bits(weight)`` and is unpacked
+    here; weights in this package's block-int4 storage dequantize to
+    bf16 (see trtllm_mxint4_block_scale_moe)."""
+    name = "trtllm_mxint4_block_scale_routed_moe"
+    _reject_no_finalize(do_finalize, name)
+    _reject_out(output, name)
+    _reject_numerics_args(
+        name, gemm1_alpha=gemm1_alpha, gemm1_beta=gemm1_beta,
+        gemm1_clamp_limit=gemm1_clamp_limit,
+        gemm1_lora_delta=gemm1_lora_delta,
+    )
+    _check_local_experts(num_experts, local_expert_offset,
+                         local_num_experts, name)
+    ids, wts = _unpack_routed_topk_ids(topk_ids)
+    w1 = jnp.swapaxes(_int4_to_bf16(gemm1_weights, gemm1_weights_scale,
+                                    name), 1, 2)
+    w2 = jnp.swapaxes(_int4_to_bf16(gemm2_weights, gemm2_weights_scale,
+                                    name), 1, 2)
+    return _fused_moe(
+        jnp.asarray(hidden_states).astype(jnp.bfloat16), w1, w2,
+        wts, ids, num_experts,
     )
 
 

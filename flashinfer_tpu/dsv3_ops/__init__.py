@@ -19,11 +19,23 @@ def router_gemm(hidden, router_weight):
 
 
 def fused_topk_deepseek(scores, bias, n_group, topk_group, topk,
-                        routed_scaling_factor: float = 1.0, **_unused):
+                        routed_scaling_factor: float = 1.0,
+                        topk_values=None, topk_indices=None, **_unused):
     """DSv3 fused expert routing (reference dsv3_ops.fused_topk_deepseek
     / trace/templates/sampling.py:898): sigmoid+bias grouped top-k with
     unbiased renormalized weights -> (values, indices).  Same algorithm
-    as :func:`route_deepseek_v3`, reference argument order."""
+    as :func:`route_deepseek_v3`, reference argument order.
+
+    The reference MUTATES caller-allocated ``topk_values``/
+    ``topk_indices`` out-tensors; JAX arrays are immutable, so passing
+    them raises with the functional alternative rather than silently
+    leaving the caller's buffers unwritten."""
+    if topk_values is not None or topk_indices is not None:
+        raise ValueError(
+            "TPU backend: fused_topk_deepseek out-tensors (topk_values/"
+            "topk_indices) are not supported — JAX arrays are immutable; "
+            "use the returned (values, indices)"
+        )
     return route_deepseek_v3(
         scores, bias, int(topk), int(n_group), int(topk_group),
         float(routed_scaling_factor),

@@ -317,7 +317,7 @@ def kda_chunk_prefill(
     (``ops/gdn_kernel.kda_chunk_prefill_pallas``, chunk 128).  Its
     pair scores assemble from 16-row blocks with boundary-referenced
     history factors (safe at any decay) and midpoint diagonal blocks, so
-    the usable per-token decay domain is alpha >= ~0.007 — wider than
+    the usable per-token decay domain is alpha >= ~0.011 — wider than
     this chunk-32 XLA form's ~0.02 and far below trained-gate ranges —
     which is why the env opt-in ``FLASHINFER_TPU_KDA_BACKEND=pallas``
     is offered like GDN's (earlier rounds' whole-chunk factorization

@@ -272,7 +272,13 @@ def gdn_chunk_prefill_pallas(
 
 
 _KDA_SB = 16  # block-row height for the pair-score assembly
-_KDA_CLAMP = 40.0  # per-factor exponent clamp: products stay < e^80
+# Per-factor exponent clamp.  Sized so the dk-SUMMED masked-garbage dot
+# stays finite, not just the per-factor product: worst masked entry is
+# sum_c k_i[c] k_j[c] e^{2*CLAMP}, so 2*CLAMP + ln(dk * max|k_i k_j|)
+# must stay under f32's ~88.7 — CLAMP=36 leaves ln headroom ~11.8 for
+# dk=128 times per-channel key products up to ~250.  Exactness floor:
+# alpha >= exp(-2*CLAMP/SB) ~= 0.011.
+_KDA_CLAMP = 36.0
 
 
 def _kda_pair_scores(qf0, kf0, acum, Q, dk):
@@ -291,7 +297,7 @@ def _kda_pair_scores(qf0, kf0, acum, Q, dk):
       the (masked-away) garbage entries finite instead of inf*0 = NaN.
 
     Exactness domain: per-token per-channel log-decay * SB/2 within the
-    clamp, i.e. alpha >= exp(-2*_KDA_CLAMP/_KDA_SB) ~= 0.0067 — an order
+    clamp, i.e. alpha >= exp(-2*_KDA_CLAMP/_KDA_SB) ~= 0.011 — nearly an order
     of magnitude below the ~0.02 aggressive-decay regime real KDA models
     use (reference kda_kernels/recurrent_kda.py covers the same range by
     never forming cross-token ratios).  Below that, clamped diagonal
@@ -365,7 +371,7 @@ def _kda_chunk_kernel(
     come from :func:`_kda_pair_scores` — block-row assembly whose
     history factors are one-sided (<= 1, safe at any decay) and whose
     diagonal blocks factor over a 16-token span, so the usable per-token
-    decay domain reaches alpha ~0.007 (vs ~0.3 for a whole-chunk
+    decay domain reaches alpha ~0.011 (vs ~0.3 for a whole-chunk
     midpoint factorization).  Reference semantics:
     kda_kernels/recurrent_kda.py."""
     c = pl.program_id(2)

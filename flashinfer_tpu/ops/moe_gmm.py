@@ -388,11 +388,13 @@ def gather_gmm(
     """
     k = x.shape[1]
     if variant == "auto":
-        variant = (
-            "rowcache"
-            if tm * k * x.dtype.itemsize <= _ROWCACHE_VMEM_CAP
-            else "stream"
-        )
+        # repo defaults policy (VERDICT r3): defaults flip only on banked
+        # hardware A/B.  The rowcache aliased-output merge is a
+        # HARDWARE-ONLY code path (interpret mode cannot exercise it) and
+        # has never Mosaic-compiled, so auto stays on the streaming
+        # variant until the hw tier + moe bench rows land; rowcache is
+        # explicit opt-in and A/B'd in the bench meanwhile.
+        variant = "stream"
     if variant not in ("rowcache", "stream"):
         raise ValueError(f"unknown gather_gmm variant {variant!r}")
     if variant == "rowcache":
