@@ -17,6 +17,7 @@ from flashinfer_tpu.models.mixtral import (  # noqa: F401
 from flashinfer_tpu.models.deepseek import (  # noqa: F401
     DeepseekConfig,
     deepseek_decode_step,
+    deepseek_prefill,
     init_deepseek_params,
     make_ep_sharded_decode_step as make_deepseek_ep_decode_step,
 )

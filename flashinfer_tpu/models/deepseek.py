@@ -141,6 +141,38 @@ def init_deepseek_params(key: jax.Array, cfg: DeepseekConfig) -> Dict:
     )
 
 
+def _project_latents(x, layer, cfg: DeepseekConfig, positions):
+    """Shared per-token MLA projections on a FLAT token axis: returns
+    (q_nope [N, H, nope], roped q_pe [N, H, kpe], ckv [N, ckv],
+    roped kpe [N, kpe]) — one definition for the decode step and the
+    prefill path (their cache-sharing contract depends on identical
+    latent math)."""
+    H, nope, kpe = cfg.num_heads, cfg.head_dim_nope, cfg.head_dim_kpe
+    ckv_dim = cfg.kv_lora_rank
+    N = x.shape[0]
+    q_lat = rmsnorm(x @ layer["q_a"], layer["q_a_norm"], cfg.rms_eps)
+    q = (q_lat @ layer["q_b"]).reshape(N, H, nope + kpe)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    kv = x @ layer["kv_a"]  # [N, ckv + kpe]
+    ckv = rmsnorm(kv[:, :ckv_dim], layer["kv_a_norm"], cfg.rms_eps)
+    kpe_k = kv[:, None, ckv_dim:]  # [N, 1, kpe] — shared across heads
+    q_pe, kpe_k = apply_rope_pos_ids(
+        q_pe, kpe_k, positions, rope_theta=cfg.rope_theta
+    )
+    return q_nope, q_pe, ckv, kpe_k[:, 0]
+
+
+def _append_latents(cache, rows, ckv, kpe_vec, kpe_dim: int):
+    """Write per-token latents into the paged (ckv, lane-padded kpe)
+    caches at flat ``rows`` — the ONE cache-append definition."""
+    ckv_cache, kpe_cache = cache
+    cflat = ckv_cache.reshape(-1, ckv_cache.shape[-1])
+    pflat = kpe_cache.reshape(-1, kpe_cache.shape[-1])
+    cflat = cflat.at[rows].set(ckv.astype(cflat.dtype))
+    pflat = pflat.at[rows, :kpe_dim].set(kpe_vec.astype(pflat.dtype))
+    return cflat.reshape(ckv_cache.shape), pflat.reshape(kpe_cache.shape)
+
+
 def _mla_attn_decode(
     x, layer, cfg: DeepseekConfig, cache, page_table, kv_lens, positions,
     use_pallas: bool,
@@ -152,18 +184,9 @@ def _mla_attn_decode(
     the reference's qk_head_dim scale."""
     B = x.shape[0]
     H, nope, kpe = cfg.num_heads, cfg.head_dim_nope, cfg.head_dim_kpe
-    ckv_dim = cfg.kv_lora_rank
 
-    q_lat = rmsnorm(x @ layer["q_a"], layer["q_a_norm"], cfg.rms_eps)
-    q = (q_lat @ layer["q_b"]).reshape(B, H, nope + kpe)
-    q_nope, q_pe = q[..., :nope], q[..., nope:]
-
-    kv = x @ layer["kv_a"]  # [B, ckv + kpe]
-    ckv_new = rmsnorm(kv[:, :ckv_dim], layer["kv_a_norm"], cfg.rms_eps)
-    kpe_new = kv[:, None, ckv_dim:]  # [B, 1, kpe] — shared across heads
-
-    q_pe, kpe_new = apply_rope_pos_ids(
-        q_pe, kpe_new, positions, rope_theta=cfg.rope_theta
+    q_nope, q_pe, ckv_new, kpe_new = _project_latents(
+        x, layer, cfg, positions
     )
 
     # absorb the nope query into the latent space: [B, H, ckv]
@@ -173,16 +196,12 @@ def _mla_attn_decode(
     ).astype(x.dtype)
 
     # append this token's (ckv, kpe) into the paged caches
-    ckv_cache, kpe_cache = cache
-    ps = ckv_cache.shape[1]
+    ps = cache[0].shape[1]
     page_id = page_table[jnp.arange(B), positions // ps]
     rows = page_id * ps + positions % ps
-    cflat = ckv_cache.reshape(-1, ckv_cache.shape[-1])
-    pflat = kpe_cache.reshape(-1, kpe_cache.shape[-1])
-    cflat = cflat.at[rows].set(ckv_new.astype(cflat.dtype))
-    pflat = pflat.at[rows, :kpe].set(kpe_new[:, 0].astype(pflat.dtype))
-    ckv_cache = cflat.reshape(ckv_cache.shape)
-    kpe_cache = pflat.reshape(kpe_cache.shape)
+    ckv_cache, kpe_cache = _append_latents(
+        cache, rows, ckv_new, kpe_new, kpe
+    )
 
     kv_lens_inc = jnp.maximum(kv_lens, positions + 1)
     sm_scale = 1.0 / float(nope + kpe) ** 0.5
@@ -222,6 +241,85 @@ def _layer_mlp(h, layer, cfg: DeepseekConfig, moe_fn=fused_moe):
     return (silu_and_mul(h @ layer["gate_up"]) @ layer["down"]).astype(
         h.dtype
     )
+
+
+def deepseek_prefill(
+    params: Dict,
+    cfg: DeepseekConfig,
+    tokens: jax.Array,  # [B, L] int32 prompt tokens
+    caches: List[Tuple[jax.Array, jax.Array]],  # per layer (ckv, kpe)
+    page_table: jax.Array,  # [B, max_pages]
+):
+    """Batched prefill -> (logits [B, L, vocab], caches).
+
+    MLA prefill runs UNABSORBED (the reference's prefill regime: at long
+    q the per-head materialization amortizes, and the fmha path wants
+    standard per-head K/V): explicit per-head keys ``k_nope = w_kc ckv``
+    and values ``v = w_vc^T ckv`` run through the library's STREAMING
+    segment-flash attention (asymmetric qk/vo head dims; scores never
+    materialize as [L, L] per head), while the paged cache still stores
+    only the LATENT (ckv, lane-padded kpe) — so decode continues
+    ABSORBED from the same cache.  The absorption identity makes the two
+    regimes numerically interchangeable (tested against a pure
+    stepwise-decode consumption)."""
+    from flashinfer_tpu.ops.flash_attention import flash_attention
+    from flashinfer_tpu.ops.xla_ref import xla_ragged_attention
+    from flashinfer_tpu.utils import is_tpu
+
+    B, L = tokens.shape
+    H, nope, kpe = cfg.num_heads, cfg.head_dim_nope, cfg.head_dim_kpe
+    N = B * L
+    sm = 1.0 / float(nope + kpe) ** 0.5
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    pos_flat = positions.reshape(-1)
+    seg = jnp.repeat(jnp.arange(B, dtype=jnp.int32), L)
+    attn_fn = flash_attention if is_tpu() else xla_ragged_attention
+
+    x = params["embed"][tokens].astype(cfg.dtype).reshape(N, -1)
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+        q_nope, q_pe, ckv, kpe_vec = _project_latents(
+            h, layer, cfg, pos_flat
+        )
+        # append the latents into the paged cache (decode reads these)
+        ps = caches[li][0].shape[1]
+        page_id = jnp.take_along_axis(page_table, positions // ps, axis=1)
+        rows = (page_id * ps + positions % ps).reshape(-1)
+        new_caches.append(
+            _append_latents(caches[li], rows, ckv, kpe_vec, kpe)
+        )
+
+        # unabsorbed per-head K/V from the latent; attention streams
+        # through the segment-flash kernel (qk dim nope+kpe, vo dim nope)
+        k_nope = jnp.einsum(
+            "nc,hdc->nhd", ckv.astype(jnp.float32),
+            layer["w_kc"].astype(jnp.float32),
+        )
+        v = jnp.einsum(
+            "nc,hcd->nhd", ckv.astype(jnp.float32),
+            layer["w_vc"].astype(jnp.float32),
+        )
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(kpe_vec[:, None, :].astype(
+                jnp.float32), (N, H, kpe))],
+            axis=-1,
+        ).astype(cfg.dtype)
+        q = jnp.concatenate(
+            [q_nope.astype(jnp.float32), q_pe.astype(jnp.float32)], -1
+        ).astype(cfg.dtype)
+        attn = attn_fn(
+            q, k, v.astype(cfg.dtype), seg, seg, pos_flat, pos_flat,
+            causal=True, sm_scale=sm,
+        )  # [N, H, nope]
+        x = x + (
+            attn.reshape(N, H * nope).astype(cfg.dtype) @ layer["o_proj"]
+        ).astype(cfg.dtype)
+        h = rmsnorm(x, layer["post_norm"], cfg.rms_eps)
+        x = x + _layer_mlp(h, layer, cfg)
+    x = rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits.reshape(B, L, -1), new_caches
 
 
 def deepseek_decode_step(

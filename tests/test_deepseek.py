@@ -175,3 +175,58 @@ def test_ep_sharded_step_matches_single_device():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
     )
+
+
+def test_prefill_then_decode_matches_stepwise():
+    """Unabsorbed prefill + absorbed decode continuation == pure stepwise
+    decode consumption of the same prompt (the absorption identity across
+    the two regimes, sharing one paged latent cache)."""
+    cfg = DeepseekConfig.tiny(num_layers=2, first_k_dense=1)
+    B, L, ps, ppr = 2, 6, 8, 2
+    params = init_deepseek_params(jax.random.PRNGKey(7), cfg)
+    num_pages = B * ppr
+    table = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, ppr)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, L)), jnp.int32)
+
+    def fresh_caches():
+        return [
+            (jnp.zeros((num_pages, ps, cfg.kv_lora_rank), cfg.dtype),
+             jnp.zeros((num_pages, ps, 128), cfg.dtype))
+            for _ in range(cfg.num_layers)
+        ]
+
+    from flashinfer_tpu.models.deepseek import deepseek_prefill
+
+    # path A: one prefill call, then two decode steps
+    logits_a, caches_a = deepseek_prefill(params, cfg, prompt,
+                                          fresh_caches(), table)
+    # path B: stepwise decode consumption
+    caches_b = fresh_caches()
+    kv = jnp.zeros((B,), jnp.int32)
+    for t in range(L):
+        logits_b, caches_b = deepseek_decode_step(
+            params, cfg, prompt[:, t], kv, caches_b, table, kv)
+        kv = kv + 1
+    np.testing.assert_allclose(
+        np.asarray(logits_a[:, -1]), np.asarray(logits_b),
+        rtol=2e-4, atol=2e-4,
+    )
+    # caches agree latent-for-latent
+    for (ca, pa), (cb, pb) in zip(caches_a, caches_b):
+        np.testing.assert_allclose(np.asarray(ca), np.asarray(cb),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-5)
+    # generation continues identically from either path
+    kv_a = jnp.full((B,), L, jnp.int32)
+    toks = jnp.argmax(logits_a[:, -1], -1).astype(jnp.int32)
+    for _ in range(3):
+        la, caches_a = deepseek_decode_step(
+            params, cfg, toks, kv_a, caches_a, table, kv_a)
+        lb, caches_b = deepseek_decode_step(
+            params, cfg, toks, kv_a, caches_b, table, kv_a)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-4, atol=2e-4)
+        toks = jnp.argmax(la, -1).astype(jnp.int32)
+        kv_a = kv_a + 1
