@@ -317,7 +317,15 @@ def moe_a2a_dispatch(hidden, topk_ids, topk_weights, num_experts: int,
     """Standalone dispatch half (reference moe_a2a_dispatch): the fused
     path keeps dispatch inside ``fused_moe_ep``; this explicit form
     performs the capacity-bucketed exchange and returns the received
-    (tokens, expert_ids, validity) — call inside shard_map."""
+    (tokens, expert_ids, validity) — call inside shard_map.
+
+    DEVIATION (ADVICE r4): this is CAPACITY-DROP dispatch — routes
+    beyond ``capacity_factor`` x fair-share per expert contribute zero,
+    while the reference runtime (comm/moe_alltoall.py) delivers every
+    routed token.  For exact no-drop semantics use
+    ``fused_moe_ep(..., dispatch="alltoall_exact")`` (rounds-based
+    exchange)
+    or raise capacity_factor.  See docs/migration.md deviation table."""
     from flashinfer_tpu.fused_moe.core import _route_buckets
 
     ep = jax.lax.axis_size(axis)
@@ -340,7 +348,9 @@ def moe_a2a_combine(expert_output, topk_ids, topk_weights,
                     num_experts: int, axis: str = "tp", workspace=None,
                     capacity_factor: float = 2.0, **_unused):
     """Standalone combine half: route expert outputs back and weight-sum
-    per source token (inverse of :func:`moe_a2a_dispatch`)."""
+    per source token (inverse of :func:`moe_a2a_dispatch`).  Same
+    capacity-drop deviation as dispatch — dropped routes contribute
+    zero to the weighted sum (docs/migration.md)."""
     from flashinfer_tpu.fused_moe.core import _route_buckets
 
     ep = jax.lax.axis_size(axis)

@@ -397,9 +397,26 @@ tgv_gemm_sm100 = mm_bf16  # arch-tagged GEMM name -> the one MXU matmul
 
 
 def prepare_low_latency_gemm_weights(w, *_, **__):
-    """Identity: the reference pre-shuffles weights for its low-latency
-    CUDA GEMM; XLA owns TPU layout, so no shuffle is needed."""
-    return w
+    """Reference ``prepare_low_latency_gemm_weights`` (gemm_base.py:4240
+    example flow): raw weight [n, k] -> the prepared 3-D layout
+    ``(k // 128, n, 128)`` that reference ``mm_fp8`` consumes.
+
+    XLA owns TPU layout so no swizzle is *needed*, but emitting the
+    reference's 3-D shape keeps prepared-ness DETECTABLE: ``mm_fp8``
+    accepts this 3-D form (reconstructing [k, n]) and a 2-D [k, n]
+    native form, and cannot distinguish a raw square [n, k] — so porting
+    callers must keep this prepare step (ADVICE r4; see
+    docs/migration.md deviation table)."""
+    w = jnp.asarray(w)
+    if w.ndim == 3:  # already prepared
+        return w
+    n, k = w.shape
+    if k % 128:
+        raise ValueError(
+            "prepare_low_latency_gemm_weights expects [n, k] with "
+            f"k % 128 == 0 (reference block_size=128); got {w.shape}"
+        )
+    return jnp.swapaxes(w.reshape(n, k // 128, 128), 0, 1)
 
 
 def prepare_bf16_fp4_weights(w, *_, **__):

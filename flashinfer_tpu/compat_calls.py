@@ -299,7 +299,8 @@ def trtllm_fp8_per_tensor_scale_moe(
     routed_scaling_factor: Optional[float] = None,
     use_routing_scales_on_input: bool = False,
     routing_method_type: int = 0,
-    do_finalize: bool = True, **_inert,
+    do_finalize: bool = True, activation_type: int = 3,
+    routing_replay_out=None, **_inert,
 ):
     """Reference ``trtllm_fp8_per_tensor_scale_moe`` (fused_moe/
     core.py:3417): fp8 weights with per-expert-scalar output scales.
@@ -315,7 +316,9 @@ def trtllm_fp8_per_tensor_scale_moe(
         gemm1_beta=_inert.pop("gemm1_beta", None),
         gemm1_clamp_limit=_inert.pop("gemm1_clamp_limit", None),
         output=_inert.pop("output", None),
+        routing_replay_out=routing_replay_out,
     )
+    act = _map_activation(activation_type, name)
     _check_local_experts(num_experts, local_expert_offset,
                          local_num_experts, name)
     if use_routing_scales_on_input:
@@ -346,7 +349,7 @@ def trtllm_fp8_per_tensor_scale_moe(
     return _fused_moe(
         jnp.asarray(hidden_states).astype(jnp.bfloat16),
         w1f.astype(jnp.bfloat16), w2f.astype(jnp.bfloat16),
-        wts, ids, num_experts,
+        wts, ids, num_experts, activation=act,
     )
 
 
@@ -363,7 +366,8 @@ def trtllm_fp4_block_scale_moe(
     local_num_experts: Optional[int] = None,
     routed_scaling_factor: Optional[float] = None,
     routing_method_type: int = 0,
-    do_finalize: bool = True, **_inert,
+    do_finalize: bool = True, activation_type: int = 3,
+    routing_replay_out=None, **_inert,
 ):
     """Reference ``trtllm_fp4_block_scale_moe`` (fused_moe/core.py:4011).
 
@@ -382,7 +386,9 @@ def trtllm_fp4_block_scale_moe(
         output2_scale_scalar=output2_scale_scalar,
         per_token_scale=_inert.pop("per_token_scale", None),
         output=_inert.pop("output", None),
+        routing_replay_out=routing_replay_out,
     )
+    act = _map_activation(activation_type, name)
     _check_local_experts(num_experts, local_expert_offset,
                          local_num_experts, name)
     if gemm1_bias is not None or gemm2_bias is not None:
@@ -413,7 +419,8 @@ def trtllm_fp4_block_scale_moe(
     if hidden_states_scale is not None:
         x = dequantize_fp4(x, jnp.asarray(hidden_states_scale))
     return _fused_moe(
-        x.astype(jnp.bfloat16), w1, w2, wts, ids, num_experts
+        x.astype(jnp.bfloat16), w1, w2, wts, ids, num_experts,
+        activation=act,
     )
 
 
@@ -570,14 +577,38 @@ def bmm_bf16(a, b, bias=None, pdl: bool = False, out=None,
 def mm_fp8(a, b, alpha=None, out_dtype=jnp.bfloat16, out=None,
            backend: str = "trtllm_low_latency",
            a_scale=None, b_scale=None):
-    """Reference ``mm_fp8`` (gemm_base.py:4190): fp8 a [m, k] x b [k, n]
-    with a combined output scale ``alpha``.  The TPU-native keyword pair
+    """Reference ``mm_fp8`` (gemm_base.py:4190): fp8 a [m, k] with a
+    combined output scale ``alpha``.  ``b`` is EITHER the reference's
+    prepared 3-D layout ``(k // 128, n, 128)`` from
+    ``prepare_low_latency_gemm_weights`` (reconstructed to [k, n] here)
+    OR a native 2-D [k, n] weight.  A raw un-prepared [n, k] 2-D weight
+    is indistinguishable when square — keep the prepare step when
+    porting (ADVICE r4; docs/migration.md).  The TPU-native keyword pair
     (a_scale=, b_scale=) is kept as a KEYWORD superset — positional
     callers get the reference argument order (gemm.mm_fp8 keeps the
     native positional form)."""
     _reject_out(out, "mm_fp8")
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if b.ndim == 3:
+        kb, n, blk = b.shape
+        if blk != 128:
+            raise ValueError(
+                "TPU backend: mm_fp8 prepared-b last dim must be the "
+                f"reference block_size 128; got {b.shape}. Produce b with "
+                "prepare_low_latency_gemm_weights"
+            )
+        b = jnp.swapaxes(b, 0, 1).reshape(n, kb * blk).T
+    elif b.ndim == 2:
+        if b.shape[0] != a.shape[-1]:
+            raise ValueError(
+                f"TPU backend: mm_fp8 2-D b must be [k, n] with k="
+                f"{a.shape[-1]}; got {b.shape}. If this is a raw [n, k] "
+                "weight, pass it through prepare_low_latency_gemm_weights "
+                "first (reference flow, gemm_base.py:4240)"
+            )
     return _gemm.mm_fp8(
-        jnp.asarray(a), jnp.asarray(b),
+        a, b,
         a_scale=alpha if alpha is not None else a_scale,
         b_scale=b_scale, out_dtype=out_dtype,
     )

@@ -497,6 +497,80 @@ def test_call_parity_moe_loud_errors():
                            0, E, activation_type=1)
 
 
+def test_call_parity_fp8_per_tensor_activation_type():
+    """ADVICE r4 (medium): activation_type must be dispatched, not
+    silently dropped — Geglu (4) reaches the gelu pipeline and differs
+    from the silu default; routing_replay_out is loudly rejected."""
+    from flashinfer_tpu.fused_moe import fused_moe, route_renormalize
+
+    T, E, K, H, I = 8, 4, 2, 64, 64
+    rng = np.random.default_rng(8)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    xq = jnp.asarray(rng.standard_normal((T, H)), jnp.float8_e4m3fn)
+    w1q = jnp.asarray(rng.standard_normal((E, 2 * I, H)),
+                      jnp.float8_e4m3fn)
+    w2q = jnp.asarray(rng.standard_normal((E, H, I)), jnp.float8_e4m3fn)
+    ones = jnp.ones((E,), jnp.float32)
+    args = (logits, None, xq, w1q, ones, ones, w2q, ones,
+            E, K, None, None, I, 0, E)
+    out_gelu = fi.trtllm_fp8_per_tensor_scale_moe(
+        args[0], *args[1:], routing_method_type=1, activation_type=4)
+    wts, ids = route_renormalize(logits, K)
+    w1 = jnp.swapaxes(jnp.asarray(w1q, jnp.float32), 1, 2)
+    w2 = jnp.swapaxes(jnp.asarray(w2q, jnp.float32), 1, 2)
+    ref = fused_moe(
+        jnp.asarray(xq, jnp.float32).astype(jnp.bfloat16),
+        w1.astype(jnp.bfloat16), w2.astype(jnp.bfloat16),
+        wts, ids, E, activation="gelu",
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_gelu, np.float32), np.asarray(ref, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+    out_silu = fi.trtllm_fp8_per_tensor_scale_moe(
+        args[0], *args[1:], routing_method_type=1)
+    assert not np.allclose(np.asarray(out_gelu, np.float32),
+                           np.asarray(out_silu, np.float32), atol=1e-3)
+    with pytest.raises(ValueError, match="routing_replay_out"):
+        fi.trtllm_fp8_per_tensor_scale_moe(
+            args[0], *args[1:], routing_method_type=1,
+            routing_replay_out=jnp.zeros((T, K), jnp.int32))
+    with pytest.raises(ValueError, match="activation_type"):
+        fi.trtllm_fp8_per_tensor_scale_moe(
+            args[0], *args[1:], routing_method_type=1, activation_type=1)
+
+
+def test_call_parity_fp4_block_scale_activation_type():
+    """Same ADVICE fix on the fp4 adapter: Geglu dispatches; replay-out
+    rejected."""
+    T, E, K, H, I = 8, 4, 2, 64, 64
+    rng = np.random.default_rng(9)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    g1, g2 = _moe_weights(E, H, I)
+    q1, s1 = fi.fp4_quantize(g1.reshape(E * 2 * I, H),
+                             jnp.asarray([1.0]), 16)
+    q2, s2 = fi.fp4_quantize(g2.reshape(E * H, I), jnp.asarray([1.0]), 16)
+    q1 = q1.reshape(E, 2 * I, H // 2)
+    s1 = s1.reshape(E, 2 * I, H // 16)
+    q2 = q2.reshape(E, H, I // 2)
+    s2 = s2.reshape(E, H, I // 16)
+    args = (logits, None, x, None, q1, s1, None, None, None, None,
+            q2, s2, None, None, None, None, E, K)
+    out_gelu = fi.trtllm_fp4_block_scale_moe(
+        *args, routing_method_type=1, activation_type=4)
+    out_silu = fi.trtllm_fp4_block_scale_moe(
+        *args, routing_method_type=1)
+    assert out_gelu.shape == (T, H)
+    assert np.isfinite(np.asarray(out_gelu, np.float32)).all()
+    assert not np.allclose(np.asarray(out_gelu, np.float32),
+                           np.asarray(out_silu, np.float32), atol=1e-3)
+    with pytest.raises(ValueError, match="routing_replay_out"):
+        fi.trtllm_fp4_block_scale_moe(
+            *args, routing_method_type=1,
+            routing_replay_out=jnp.zeros((T, K), jnp.int32))
+
+
 def test_call_parity_grouped_mm():
     """Reference grouped_mm family (grouped_mm/core.py): b is [E, n, k],
     segments from m_indptr, out = a[seg] @ b[e]^T."""
@@ -548,6 +622,34 @@ def test_call_parity_mm_family():
     refb = (np.asarray(ab, np.float32) * 0.1) @ (
         np.asarray(bb, np.float32) * 0.1)
     np.testing.assert_allclose(np.asarray(o), refb, rtol=3e-2, atol=3e-2)
+
+
+def test_call_parity_mm_fp8_prepared_b():
+    """ADVICE r4 (low): mm_fp8 b-layout contract — the reference flow
+    (gemm_base.py:4240) passes b through prepare_low_latency_gemm_weights
+    ([n, k] -> prepared (k//128, n, 128)); the adapter reconstructs
+    [k, n], and raw [n, k] 2-D weights error with instructions."""
+    rng = np.random.default_rng(10)
+    m, n, k = 8, 32, 256
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float8_e4m3fn)
+    b_raw = jnp.asarray(rng.standard_normal((n, k)) * 0.1,
+                        jnp.float8_e4m3fn)  # reference raw layout [n, k]
+    prepared = fi.prepare_low_latency_gemm_weights(b_raw)
+    assert prepared.shape == (k // 128, n, 128)
+    out = fi.mm_fp8(a, prepared, jnp.asarray(0.5))
+    ref = 0.5 * np.asarray(a, np.float32) @ np.asarray(b_raw, np.float32).T
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=3e-2, atol=3e-2)
+    # native 2-D [k, n] still accepted and agrees
+    out2 = fi.mm_fp8(a, jnp.swapaxes(b_raw, 0, 1), jnp.asarray(0.5))
+    np.testing.assert_allclose(np.asarray(out2, np.float32), ref,
+                               rtol=3e-2, atol=3e-2)
+    # raw non-square [n, k] without the prepare step: loud, actionable
+    with pytest.raises(ValueError, match="prepare_low_latency"):
+        fi.mm_fp8(a, b_raw, jnp.asarray(0.5))
+    # idempotent prepare (already-3-D passes through)
+    assert fi.prepare_low_latency_gemm_weights(prepared).shape == \
+        prepared.shape
 
 
 def test_call_parity_quantize_family():
