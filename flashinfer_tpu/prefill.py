@@ -34,6 +34,7 @@ from flashinfer_tpu.ops.flash_attention import flash_attention
 from flashinfer_tpu.ops.xla_ref import xla_ragged_attention
 from flashinfer_tpu.utils import (
     check_kv_layout,
+    fold_scalar_scale,
     get_sm_scale,
     next_power_of_two,
     resolve_backend,
@@ -112,25 +113,47 @@ def single_prefill_with_kv_cache(
     q: jax.Array,  # [qo_len, num_qo_heads, head_dim]
     k: jax.Array,  # [kv_len, num_kv_heads, head_dim] (NHD) or HND
     v: jax.Array,
+    scale_q: Optional[jax.Array] = None,
+    scale_k: Optional[jax.Array] = None,
+    scale_v: Optional[jax.Array] = None,
+    o_dtype=None,
     custom_mask: Optional[jax.Array] = None,
     packed_custom_mask: Optional[jax.Array] = None,
     causal: bool = False,
     kv_layout: str = "NHD",
     pos_encoding_mode: str = "NONE",
+    use_fp16_qk_reduction: bool = False,
     sm_scale: Optional[float] = None,
     window_left: int = -1,
     logits_soft_cap: Optional[float] = None,
-    return_lse: bool = False,
+    rope_scale: Optional[float] = None,
+    rope_theta: Optional[float] = None,
     backend: str = "auto",
+    return_lse: bool = False,
+    kv_cache_sf=None,
+    k_scale: Optional[float] = None,
+    v_scale: Optional[float] = None,
 ):
     """Single-request prefill/append attention (reference
-    ``single_prefill_with_kv_cache``, flashinfer/prefill.py:1117).
+    ``single_prefill_with_kv_cache``, flashinfer/prefill.py:1117) with
+    the reference's FULL kwargs surface and positional order (scale_q/
+    scale_k/scale_v sit between v and o_dtype).
 
     Causal alignment is bottom-right: query ``i`` attends to kv positions
     ``<= kv_len - qo_len + i`` (matching the reference's append semantics).
     ``custom_mask`` ([qo_len, kv_len] bool) / ``packed_custom_mask``
     (packbits form) route through the xla backend (dense mask — the
-    reference's MaskMode::kCustom)."""
+    reference's MaskMode::kCustom).
+
+    Scale handling mirrors the reference fp8 regime by FOLDING:
+    per-tensor scale_q/scale_k (and float k_scale, scalar
+    kv_cache_sf[k]) multiply the softmax scale; scale_v / v_scale /
+    kv_cache_sf[v] multiply the output.  Non-scalar (per-head/block)
+    scale tensors are a different numerics regime and are rejected.
+    ``use_fp16_qk_reduction`` is a CUDA-accumulator knob (inert: the MXU
+    accumulates f32); rope_scale/rope_theta only apply with
+    pos_encoding_mode != NONE, which raises (apply flashinfer_tpu.rope
+    explicitly)."""
     if pos_encoding_mode != "NONE":
         raise NotImplementedError(
             "apply flashinfer_tpu.rope explicitly before attention"
@@ -141,6 +164,28 @@ def single_prefill_with_kv_cache(
     qo_len, _, head_dim = q.shape
     kv_len = k.shape[0]
     sm_scale = get_sm_scale(head_dim, sm_scale)
+
+    def _fold(x, name):
+        return fold_scalar_scale(
+            x, f"single_prefill_with_kv_cache {name}")
+
+    out_mul = 1.0
+    for s, nm in ((scale_q, "scale_q"), (scale_k, "scale_k"),
+                  (k_scale, "k_scale")):
+        f = _fold(s, nm)
+        if f is not None:
+            sm_scale *= f
+    for s, nm in ((scale_v, "scale_v"), (v_scale, "v_scale")):
+        f = _fold(s, nm)
+        if f is not None:
+            out_mul *= f
+    if kv_cache_sf is not None:
+        ksf, vsf = (kv_cache_sf if isinstance(kv_cache_sf, tuple)
+                    else (kv_cache_sf, kv_cache_sf))
+        ksf = _fold(ksf, "kv_cache_sf[k]")
+        vsf = _fold(vsf, "kv_cache_sf[v]")
+        sm_scale *= 1.0 if ksf is None else ksf
+        out_mul *= 1.0 if vsf is None else vsf
     if packed_custom_mask is not None and custom_mask is None:
         # reference mask-bit convention is LSB-first within each byte
         # (flashinfer packbits bitorder='little')
@@ -159,17 +204,26 @@ def single_prefill_with_kv_cache(
     if custom_mask is not None:
         # MaskMode::CUSTOM semantics (reference variants.cuh LogitsMask):
         # the custom mask replaces causal, but sliding window still ANDs in
-        return xla_ragged_attention(
+        res = xla_ragged_attention(
             *args, custom_mask=custom_mask, causal=False,
             window_left=window_left, sm_scale=sm_scale,
             logits_soft_cap=logits_soft_cap or 0.0, return_lse=return_lse,
         )
-    fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
-    return fn(
-        *args, causal=causal, sm_scale=sm_scale,
-        logits_soft_cap=logits_soft_cap or 0.0,
-        window_left=window_left, return_lse=return_lse,
-    )
+    else:
+        fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
+        res = fn(
+            *args, causal=causal, sm_scale=sm_scale,
+            logits_soft_cap=logits_soft_cap or 0.0,
+            window_left=window_left, return_lse=return_lse,
+        )
+    if out_mul == 1.0 and o_dtype is None:
+        return res
+    o, lse = res if return_lse else (res, None)
+    if out_mul != 1.0:
+        o = (o.astype(jnp.float32) * out_mul).astype(o.dtype)
+    if o_dtype is not None:
+        o = o.astype(jnp.dtype(o_dtype))
+    return (o, lse) if return_lse else o
 
 
 def build_multi_item_mask(

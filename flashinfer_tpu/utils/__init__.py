@@ -228,6 +228,30 @@ def canonicalize_dtype(dtype: Any) -> jnp.dtype:
     return jnp.dtype(dtype)
 
 
+def fold_scalar_scale(x, name: str) -> Optional[float]:
+    """Fold a float-or-single-element-tensor scale to a Python float;
+    non-scalar tensors (per-head / per-block fp8 scale factors) are a
+    different numerics regime and are rejected loudly.  Shared by the
+    pre-compiled attention entries (aliases.py) and
+    single_prefill_with_kv_cache's reference scale kwargs."""
+    if x is None:
+        return None
+    if isinstance(x, (int, float)):
+        return float(x)
+    import numpy as np
+
+    arr = np.asarray(x)
+    if arr.size != 1:
+        raise ValueError(
+            f"TPU backend: {name} must be a float or single-element "
+            f"tensor; got shape {arr.shape}. Per-head/per-block scale "
+            "factors are not folded here — dequantize the cache "
+            "explicitly or use the fp8/int8 decode path "
+            "(BatchDecodeWithPagedKVCacheWrapper kv dtypes)"
+        )
+    return float(arr.reshape(()))
+
+
 def get_sm_scale(head_dim: int, sm_scale: Optional[float]) -> float:
     return sm_scale if sm_scale is not None else 1.0 / float(head_dim) ** 0.5
 

@@ -51,8 +51,11 @@ def test_trtllm_alias_decode():
     q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
     tables = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
     lens = jnp.array([10, 25, 32], jnp.int32)
+    # bmm1_scale is the COMPLETE softmax scale per the reference contract
+    # (decode.py:3005 default 1.0) — callers fold 1/sqrt(d) in themselves
     out = fi.trtllm_batch_decode_with_kv_cache(
-        q, (kc, vc), block_tables=tables, seq_lens=lens, kv_layout="HND"
+        q, (kc, vc), block_tables=tables, seq_lens=lens,
+        bmm1_scale=1 / np.sqrt(D), kv_layout="HND"
     )
     from flashinfer_tpu.ops.xla_ref import xla_paged_decode
 
@@ -61,8 +64,9 @@ def test_trtllm_alias_decode():
         sm_scale=1 / np.sqrt(D),
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
-    # xqa / cudnn aliases are the same callable
-    assert fi.xqa_batch_decode_with_kv_cache is fi.trtllm_batch_decode_with_kv_cache
+    # cudnn brand name stays the same callable; xqa now carries its own
+    # reference signature (NHD default) but shares the core
+    assert fi.cudnn_batch_decode_with_kv_cache is fi.trtllm_batch_decode_with_kv_cache
 
 
 def test_msa_sparse_attention_dense_limit():
