@@ -468,9 +468,131 @@ def trtllm_batch_context_with_kv_cache(
     return (o, lse_out) if return_lse else o
 
 
-# cudnn-named entry points collapse onto the same cores.
-cudnn_batch_decode_with_kv_cache = trtllm_batch_decode_with_kv_cache
-cudnn_batch_prefill_with_kv_cache = trtllm_batch_context_with_kv_cache
+def cudnn_batch_decode_with_kv_cache(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    scale: float,
+    workspace_buffer=None,
+    *,
+    max_sequence_kv: int = None,
+    actual_seq_lens_kv=None,
+    block_tables=None,
+    is_cuda_graph_compatible: bool = False,
+    batch_offsets_q=None,
+    batch_offsets_o=None,
+    batch_offsets_k=None,
+    batch_offsets_v=None,
+    out=None,
+):
+    """Reference ``cudnn_batch_decode_with_kv_cache``
+    (cudnn/decode.py:267): separate k/v caches in HND page layout,
+    POSITIONAL ``scale`` (the full softmax scale), keyword-only geometry.
+    The previous plain alias onto the trtllm entry MISBOUND these
+    positionals (scale landed on block_tables) — this adapter carries
+    the real signature.  ``is_cuda_graph_compatible`` is inert (jit +
+    static shapes); non-None batch_offsets_* (strided non-packed
+    layouts) are rejected — pack tokens contiguously."""
+    name = "cudnn_batch_decode_with_kv_cache"
+    _reject(name, out=out, batch_offsets_q=batch_offsets_q,
+            batch_offsets_o=batch_offsets_o,
+            batch_offsets_k=batch_offsets_k,
+            batch_offsets_v=batch_offsets_v)
+    return _one_shot_paged_decode(
+        q, k_cache, v_cache, jnp.asarray(block_tables),
+        jnp.asarray(actual_seq_lens_kv).reshape(-1),
+        sm_scale=float(scale), out_mul=1.0, window_left=-1,
+        kv_layout="HND", q_len_per_req=1, cum_seq_lens_q=None,
+        sinks=None, return_lse=False, out_dtype=q.dtype, name=name,
+    )
+
+
+def cudnn_batch_prefill_with_kv_cache(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    scale: float,
+    workspace_buffer=None,
+    *,
+    max_token_per_sequence: int = None,
+    max_sequence_kv: int = None,
+    actual_seq_lens_q=None,
+    actual_seq_lens_kv=None,
+    block_tables=None,
+    causal: bool = True,
+    return_lse: bool = False,
+    q_scale=None,
+    k_scale=None,
+    v_scale=None,
+    batch_offsets_q=None,
+    batch_offsets_o=None,
+    batch_offsets_k=None,
+    batch_offsets_v=None,
+    batch_offsets_stats=None,
+    batch_offsets_units: str = "elements",
+    out=None,
+    lse=None,
+    is_cuda_graph_compatible: bool = False,
+    backend=None,
+    o_data_type=None,
+):
+    """Reference ``cudnn_batch_prefill_with_kv_cache``
+    (cudnn/prefill.py:689): packed ragged q, paged (4-D) or ragged
+    (3-D) k/v caches, positional ``scale``; RETURNS A TUPLE
+    ``(out, lse-or-None)`` like the reference.  Scalar q/k scales fold
+    into the softmax scale, v_scale folds into the output; strided
+    batch_offsets_* layouts are rejected (pack tokens contiguously)."""
+    name = "cudnn_batch_prefill_with_kv_cache"
+    _reject(name, out=out, lse=lse, batch_offsets_q=batch_offsets_q,
+            batch_offsets_o=batch_offsets_o,
+            batch_offsets_k=batch_offsets_k,
+            batch_offsets_v=batch_offsets_v,
+            batch_offsets_stats=batch_offsets_stats)
+    sm = float(scale)
+    for s, nm in ((q_scale, "q_scale"), (k_scale, "k_scale")):
+        f = _scalar(s, f"{name} {nm}")
+        if f is not None:
+            sm *= f
+    vmul = _scalar(v_scale, f"{name} v_scale")
+    vmul = 1.0 if vmul is None else vmul
+    q_lens = np.asarray(actual_seq_lens_q).reshape(-1)
+    kv_lens = np.asarray(actual_seq_lens_kv).reshape(-1)
+    batch = len(q_lens)
+    qo_indptr = np.concatenate([[0], np.cumsum(q_lens)]).astype(np.int32)
+    if k_cache.ndim == 4:  # paged HND cache
+        page_size = k_cache.shape[2]
+        tables = np.asarray(block_tables)
+        pages_per_req = np.maximum(-(-kv_lens // page_size), 1)
+        kv_indptr = np.concatenate(
+            [[0], np.cumsum(pages_per_req)]).astype(np.int32)
+        indices = np.concatenate(
+            [tables[b, : pages_per_req[b]] for b in range(batch)]
+        ).astype(np.int32)
+        last = (kv_lens - (pages_per_req - 1) * page_size).astype(np.int32)
+        w = BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(
+            qo_indptr, kv_indptr, indices, last,
+            q.shape[1], k_cache.shape[1], q.shape[2], page_size,
+            causal=causal, sm_scale=sm,
+        )
+        res = w.run(q, (k_cache, v_cache), return_lse=return_lse)
+    else:  # ragged (total_kv_tokens, Hkv, D)
+        from flashinfer_tpu.prefill import (
+            BatchPrefillWithRaggedKVCacheWrapper,
+        )
+
+        kv_indptr = np.concatenate(
+            [[0], np.cumsum(kv_lens)]).astype(np.int32)
+        w = BatchPrefillWithRaggedKVCacheWrapper(kv_layout="NHD")
+        w.plan(qo_indptr, kv_indptr, q.shape[1], k_cache.shape[1],
+               q.shape[2], causal=causal, sm_scale=sm)
+        res = w.run(q, k_cache, v_cache, return_lse=return_lse)
+    o, lse_out = res if return_lse else (res, None)
+    if vmul != 1.0:
+        o = (o.astype(jnp.float32) * vmul).astype(o.dtype)
+    if o_data_type is not None:
+        o = o.astype(jnp.dtype(o_data_type))
+    return o, lse_out
 
 
 def fast_decode_plan(wrapper: BatchDecodeWithPagedKVCacheWrapper, *args, **kw):

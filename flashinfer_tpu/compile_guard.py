@@ -205,6 +205,14 @@ def guarded(
     fp = fingerprint(op_name, statics, module)
     if fp in _seen_ok or not _enabled():
         return thunk()
+    if module is not None:
+        # static wedge-pattern lint runs once per module per process,
+        # BEFORE the first hardware compile: a kernel matching a
+        # known-wedging Mosaic pattern refuses to compile in strict mode
+        # (default on real TPU) rather than risking the chip
+        from flashinfer_tpu import wedge_lint
+
+        wedge_lint.check_module(module)
     try:
         if not trace_state_clean():
             # Under an outer jit trace the thunk returns a tracer and

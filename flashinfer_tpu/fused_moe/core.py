@@ -241,6 +241,16 @@ def fused_moe_ep(
       use ``"alltoall"`` when bounded step time matters more than exact
       delivery.
 
+    Mode selection is backed by the banked skew study (BENCH_BANKED.md
+    round 5, `benchmarks/bench_ep_skew.py`): at balanced routing exact
+    delivery is FREE (1 round, same bytes/time as capacity mode), so
+    ``alltoall_exact`` is the right default for load-balanced routers;
+    at zipf-1.5 skew capacity mode silently zeroes ~31% of routes while
+    exact pays ~3 rounds (~2.5x step time, 3x bytes) — pick per your
+    router's balance and step-time budget.  ``allgather`` stays the
+    small-world/latency option (bandwidth O(T_global * hidden),
+    skew-insensitive).
+
     With ``return_dropped=True`` returns ``(out, dropped)`` where
     ``dropped`` is a shape-``[1]`` int32 count of this rank's (token,
     choice) routes that exceeded a destination bucket — the observability

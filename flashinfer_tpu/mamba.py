@@ -342,8 +342,11 @@ def checkpointing_ssu(
         live = (j < accepted)[:, None, None, None]
         return jnp.where(live, stepped, st)
 
+    # dynamic upper bound: O(max accepted) replay work instead of O(R)
+    # (a traced bound lowers fori_loop to while_loop); the j < accepted
+    # mask still handles per-request variation inside the bound
     committed = jax.lax.fori_loop(
-        0, R, replay_step, state.astype(jnp.float32)
+        0, jnp.max(accepted), replay_step, state.astype(jnp.float32)
     )
     new_start = (ring_start + accepted) % R
 

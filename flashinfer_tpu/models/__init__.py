@@ -3,6 +3,7 @@ from flashinfer_tpu.models.llama import (  # noqa: F401
     init_llama_params,
     llama_decode_step,
     make_cp_prefill_step,
+    make_pp_microbatch_decode_step,
     make_pp_sharded_decode_step,
     make_sharded_decode_step,
     quantize_llama_weights,

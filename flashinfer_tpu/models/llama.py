@@ -555,3 +555,140 @@ def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
         )
     )
     return sharded, mesh, dict(params=param_specs, cache=cache_spec)
+
+
+def make_pp_microbatch_decode_step(
+    mapping: Mapping, cfg: LlamaConfig, num_microbatches: int, mesh=None,
+):
+    """GPipe-style microbatched dp x tp x pp decode step.
+
+    ``make_pp_sharded_decode_step`` runs the stages sequentially — at any
+    tick pp_size - 1 stages idle.  Here each dp shard's token batch
+    splits into M microbatches that flow through the stage ring as a
+    (M + pp_size - 1)-tick software pipeline: stage s runs microbatch m
+    at tick s + m, so in steady state every stage computes while the
+    ring ppermutes activations one hop per tick (all inside one jitted
+    fori_loop — no host threads, uniform control flow, masked commits).
+
+    Reference analogue: Mapping.pp_layers layer partitioning
+    (/root/reference/flashinfer/comm/mapping.py:442); the schedule is
+    TPU-native.  Same params/cache/spec layout as the sequential step.
+    """
+    mesh = mesh or mapping.make_mesh()
+    tp, dp, pp = Mapping.AXIS_TP, Mapping.AXIS_DP, Mapping.AXIS_PP
+    assert cfg.num_layers % mapping.pp_size == 0
+    _check_head_divisibility(cfg, mapping.tp_size)
+    qh_l = cfg.num_qo_heads // mapping.tp_size
+    kvh_l = cfg.num_kv_heads // mapping.tp_size
+    pp_size = mapping.pp_size
+    M = int(num_microbatches)
+
+    layer_specs = _tp_param_specs(cfg, tp, layer_leading=pp)
+    param_specs = dict(
+        embed=P(None, None), final_norm=P(None), lm_head=P(None, tp),
+        layers=layer_specs,
+    )
+    cache_spec = (
+        P(pp, dp, None, tp, None, None),
+        P(pp, dp, None, tp, None, None),
+    )
+    in_specs = (param_specs, P(dp), P(dp), cache_spec, P(dp, None), P(dp))
+    out_specs = (P(dp, tp), cache_spec)
+
+    def run_local_layers(layers, x, caches, page_table, kv_lens, positions):
+        use_pallas = is_tpu()
+
+        def body(x, inp):
+            layer, kc, vc = inp
+            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+            attn, (kc2, vc2) = _attn_decode(
+                h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
+                qh_l, kvh_l, use_pallas,
+            )
+            o_partial = _mm(attn, layer, "o_proj")
+            h2, x2 = allreduce_fusion(
+                o_partial, residual=x, rms_weight=layer["post_norm"],
+                eps=cfg.rms_eps, axis=tp,
+            )
+            h2 = h2.astype(cfg.dtype)
+            _pq2 = _pre_quant(h2, layer, "gate_proj")
+            mlp_in = jnp.concatenate(
+                [_mm(h2, layer, "gate_proj", _pq2),
+                 _mm(h2, layer, "up_proj", _pq2)], -1
+            )
+            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
+            (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
+            return x3, (kc2, vc2)
+
+        kcs, vcs = caches
+        x, (kcs2, vcs2) = jax.lax.scan(body, x, (layers, kcs, vcs))
+        return x, (kcs2, vcs2)
+
+    def step(params, tokens, positions, kv_caches, page_table, kv_lens):
+        my_stage = jax.lax.axis_index(pp)
+        b_local = tokens.shape[0]
+        assert b_local % M == 0, (
+            f"per-dp-shard batch {b_local} must divide into "
+            f"{M} microbatches"
+        )
+        mbs = b_local // M
+        x_all = params["embed"][tokens].astype(cfg.dtype)
+        kcs = kv_caches[0][:, 0]
+        vcs = kv_caches[1][:, 0]
+        perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
+        # the final stage BUFFERS its finished activations per tick and
+        # the vocab projection (decode's largest matmul at 128k vocab)
+        # runs ONCE over the whole batch after the loop — not per tick
+        # per stage
+        xfin_buf = jnp.zeros((b_local, x_all.shape[1]), cfg.dtype)
+        act = jnp.zeros((mbs, x_all.shape[1]), cfg.dtype)
+
+        def tick(t, carry):
+            act, kcs, vcs, xfin_buf = carry
+            mb_idx = t - my_stage
+            active = (mb_idx >= 0) & (mb_idx < M)
+            safe = jnp.clip(mb_idx, 0, M - 1)
+            row0 = safe * mbs
+            # stage 0 ingests a fresh microbatch; later stages use the
+            # activation the ring delivered last tick
+            fresh = jax.lax.dynamic_slice_in_dim(x_all, row0, mbs, 0)
+            inp = jnp.where(my_stage == 0, fresh, act)
+            pt_mb = jax.lax.dynamic_slice_in_dim(page_table, row0, mbs, 0)
+            lens_mb = jax.lax.dynamic_slice_in_dim(kv_lens, row0, mbs, 0)
+            pos_mb = jax.lax.dynamic_slice_in_dim(positions, row0, mbs, 0)
+            x2, (kcs2, vcs2) = run_local_layers(
+                params["layers"], inp, (kcs, vcs), pt_mb, lens_mb, pos_mb
+            )
+            # only active ticks commit state (bubbles pass through)
+            out_act = jnp.where(active, x2, inp)
+            kcs = jnp.where(active, kcs2, kcs)
+            vcs = jnp.where(active, vcs2, vcs)
+            # final stage banks this microbatch's finished activation
+            cur = jax.lax.dynamic_slice_in_dim(xfin_buf, row0, mbs, 0)
+            emit = active & (my_stage == pp_size - 1)
+            xfin_buf = jax.lax.dynamic_update_slice_in_dim(
+                xfin_buf, jnp.where(emit, x2, cur), row0, 0
+            )
+            act = jax.lax.ppermute(out_act, pp, perm)
+            return (act, kcs, vcs, xfin_buf)
+
+        act, kcs, vcs, xfin_buf = jax.lax.fori_loop(
+            0, M + pp_size - 1, tick, (act, kcs, vcs, xfin_buf)
+        )
+        # finished activations live on the last stage; broadcast, then
+        # one final-norm + lm_head over the whole batch
+        xfin = jax.lax.psum(
+            jnp.where(my_stage == pp_size - 1,
+                      xfin_buf.astype(jnp.float32), 0.0), pp
+        ).astype(cfg.dtype)
+        xf = rmsnorm(xfin, params["final_norm"], cfg.rms_eps)
+        logits = _mm(xf, params, "lm_head").astype(jnp.float32)
+        return logits, (kcs[:, None], vcs[:, None])
+
+    sharded = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    return sharded, mesh, dict(params=param_specs, cache=cache_spec)

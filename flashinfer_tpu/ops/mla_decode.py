@@ -63,7 +63,7 @@ def _mla_decode_kernel(
 
     def chunk_dmas(chunk_idx, slot):
         dmas = []
-        for j in range(ppc):
+        for j in range(ppc):  # wedge-lint: ok ppc clamped min(256//PS,16) at call site (<=4 at MLA PS=64); 1 DMA/page
             page = pages_ref[b, chunk_idx * ppc + j]
             dst = pl.ds(j * page_size, page_size)
             dmas.append(

@@ -203,6 +203,7 @@ def _fp4_decode_kernel(
             valid = valid & (tok >= kv_len - 1 - window_left)
 
         ss, pvs, vhs = [], [], []
+        # wedge-lint: ok bounded by num_kv_heads (2 dots/head); ppc-scaling removed by the round-3 restructure (rolled DMA fori) — first recompile stays quarantine-gated (hw-queue item 5)
         for h in range(num_kv_heads):
             kh = (
                 unpack(k_buf, slot, h) * row_scales(ksc_buf, slot, h)
@@ -220,7 +221,7 @@ def _fp4_decode_kernel(
         p_all = jnp.where(valid[None], jnp.exp(s_all - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p_all, axis=-1, keepdims=True)
-        for h in range(num_kv_heads):
+        for h in range(num_kv_heads):  # wedge-lint: ok bounded by num_kv_heads; see note above
             vh = (
                 unpack(v_buf, slot, h) * row_scales(vsc_buf, slot, h)
             ).astype(q.dtype)

@@ -241,6 +241,7 @@ def _fused_prefill_kernel(
 
     def kv_dmas(unit, slot):
         dmas = []
+        # wedge-lint: ok default ppc=8 (2 DMAs/page <= 2x queue depth, round-2-validated shape); autotuner candidates guarded; never-compiled kernel stays hw-queue item 3
         for j in range(ppc):
             page = pages_ref[unit * ppc + j]
             dst = pl.ds(j * page_size, page_size)

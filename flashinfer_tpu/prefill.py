@@ -379,6 +379,12 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_data_type=None,
         **_unused,
     ) -> None:
+        if pos_encoding_mode != "NONE":
+            raise NotImplementedError(
+                "TPU backend: fused-RoPE attention variants are explicit "
+                "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
+                "append path) before plan/run"
+            )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr = np.asarray(kv_indptr)
         batch = len(qo_indptr) - 1
@@ -505,6 +511,12 @@ class BatchPrefillWithPagedKVCacheWrapper:
         kv_data_type=None,
         **_unused,
     ) -> None:
+        if pos_encoding_mode != "NONE":
+            raise NotImplementedError(
+                "TPU backend: fused-RoPE attention variants are explicit "
+                "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
+                "append path) before plan/run"
+            )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr_pages = np.asarray(paged_kv_indptr)
         kv_indices = np.asarray(paged_kv_indices)

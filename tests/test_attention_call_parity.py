@@ -223,6 +223,76 @@ def test_trtllm_context_reference_positional_call():
             cum_kv)
 
 
+def test_cudnn_decode_reference_call():
+    """cudnn entry (cudnn/decode.py:267): separate k/v caches,
+    POSITIONAL scale, keyword-only geometry — the old plain alias
+    misbound these (scale landed on block_tables)."""
+    q, kc, vc, tables, lens = _setup_decode(seed=11)
+    D = q.shape[-1]
+    sm = 1.0 / np.sqrt(D)
+    out = fi.cudnn_batch_decode_with_kv_cache(
+        q, kc, vc, sm, jnp.zeros((8,), jnp.uint8),
+        max_sequence_kv=32, actual_seq_lens_kv=lens,
+        block_tables=tables)
+    ref = xla_paged_decode(
+        q, jnp.swapaxes(kc, 1, 2), jnp.swapaxes(vc, 1, 2), tables, lens,
+        sm_scale=sm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    with pytest.raises(ValueError, match="batch_offsets_q"):
+        fi.cudnn_batch_decode_with_kv_cache(
+            q, kc, vc, sm, None, max_sequence_kv=32,
+            actual_seq_lens_kv=lens, block_tables=tables,
+            batch_offsets_q=jnp.zeros((3,), jnp.int32))
+
+
+def test_cudnn_prefill_reference_call():
+    """cudnn prefill (cudnn/prefill.py:689): tuple return, paged and
+    ragged cache forms, scalar scale folding."""
+    B, HQ, HKV, D, PS, P = 2, 4, 2, 64, 8, 4
+    keys = jax.random.split(jax.random.PRNGKey(12), 3)
+    kc = jax.random.normal(keys[0], (B * P, HKV, PS, D), jnp.float32)
+    vc = jax.random.normal(keys[1], (B * P, HKV, PS, D), jnp.float32)
+    qlens = np.array([5, 9])
+    kv_lens = np.array([17, 32])
+    q = jax.random.normal(keys[2], (int(qlens.sum()), HQ, D), jnp.float32)
+    tables = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+    sm = 1.0 / np.sqrt(D)
+    out, lse = fi.cudnn_batch_prefill_with_kv_cache(
+        q, kc, vc, sm, None,
+        max_token_per_sequence=9, max_sequence_kv=32,
+        actual_seq_lens_q=qlens, actual_seq_lens_kv=kv_lens,
+        block_tables=tables, causal=True, return_lse=True)
+    assert out.shape == q.shape and lse.shape == (q.shape[0], HQ)
+    # the trtllm context entry with the same geometry is the oracle
+    cum_q = np.concatenate([[0], np.cumsum(qlens)]).astype(np.int32)
+    cum_kv = np.concatenate([[0], np.cumsum(kv_lens)]).astype(np.int32)
+    ref = fi.trtllm_batch_context_with_kv_cache(
+        q, (kc, vc), None, tables, jnp.asarray(kv_lens, jnp.int32),
+        9, 32, sm, 1.0, B, cum_q, cum_kv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    # ragged (3-D) cache form, v_scale folds into the output
+    k_r = jax.random.normal(keys[0], (int(kv_lens.sum()), HKV, D),
+                            jnp.float32)
+    v_r = jax.random.normal(keys[1], (int(kv_lens.sum()), HKV, D),
+                            jnp.float32)
+    out_r, none_lse = fi.cudnn_batch_prefill_with_kv_cache(
+        q, k_r, v_r, sm, None,
+        max_token_per_sequence=9, max_sequence_kv=32,
+        actual_seq_lens_q=qlens, actual_seq_lens_kv=kv_lens,
+        causal=True, return_lse=False, v_scale=jnp.asarray(2.0))
+    assert none_lse is None
+    base_r, _ = fi.cudnn_batch_prefill_with_kv_cache(
+        q, k_r, v_r, sm, None,
+        max_token_per_sequence=9, max_sequence_kv=32,
+        actual_seq_lens_q=qlens, actual_seq_lens_kv=kv_lens,
+        causal=True, return_lse=False)
+    np.testing.assert_allclose(
+        np.asarray(out_r), 2.0 * np.asarray(base_r),
+        rtol=2e-3, atol=2e-3)
+
+
 def test_single_prefill_full_kwargs_surface():
     """Reference positional order (scale_q/scale_k/scale_v between v and
     o_dtype, prefill.py:1117): scalar scales fold; o_dtype casts;

@@ -63,3 +63,56 @@ def test_pp_tp_dp_decode_matches_single_device():
     ref_k3 = np.asarray(ref_caches[3][0])
     got_k3 = np.asarray(kc2[3, 0])
     np.testing.assert_allclose(got_k3, ref_k3[: Bl * PPR], rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.devices_8
+@pytest.mark.parametrize("num_microbatches", [1, 2])
+def test_pp_microbatch_matches_sequential(num_microbatches):
+    """GPipe-style microbatched pp step reproduces the sequential pp
+    step (and hence the single-device oracle) bit-for-tolerance, for
+    M=1 (degenerate: same schedule length as sequential) and M=2."""
+    from flashinfer_tpu.models import make_pp_microbatch_decode_step
+
+    cfg = LlamaConfig.tiny(num_layers=4, dtype=jnp.float32)
+    mapping = Mapping(world_size=8, dp_size=2, tp_size=2, pp_size=2)
+    step_seq, mesh, _ = make_pp_sharded_decode_step(mapping, cfg)
+    step_mb, _, _ = make_pp_microbatch_decode_step(
+        mapping, cfg, num_microbatches, mesh=mesh)
+
+    B, PPR, PS = 4, 2, 8
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    num_pages = B * PPR
+    caches = [
+        (
+            jnp.zeros((num_pages, cfg.num_kv_heads, PS, cfg.head_dim),
+                      cfg.dtype),
+            jnp.zeros((num_pages, cfg.num_kv_heads, PS, cfg.head_dim),
+                      cfg.dtype),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    table = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, PPR)
+    tokens = jnp.array([1, 2, 3, 4], jnp.int32)
+    kv_lens = jnp.array([3, 0, 7, 5], jnp.int32)
+
+    sp = stack_layer_params(params)
+    dp = 2
+    Bl = B // dp
+    kc = jnp.stack([
+        jnp.stack([c[0][: Bl * PPR], c[0][Bl * PPR:]]) for c in caches
+    ])
+    vc = jnp.stack([
+        jnp.stack([c[1][: Bl * PPR], c[1][Bl * PPR:]]) for c in caches
+    ])
+    table_dp = jnp.concatenate([table[:Bl], table[Bl:] - Bl * PPR], axis=0)
+
+    ref_logits, (rkc, rvc) = step_seq(
+        sp, tokens, kv_lens, (kc, vc), table_dp, kv_lens)
+    logits, (kc2, vc2) = step_mb(
+        sp, tokens, kv_lens, (kc, vc), table_dp, kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(kc2), np.asarray(rkc), rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(vc2), np.asarray(rvc), rtol=3e-4, atol=3e-4)

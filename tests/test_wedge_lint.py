@@ -1,0 +1,159 @@
+"""Wedge-pattern lint (round-5 verdict item 8): the static checker must
+flag each known chip-wedging Mosaic pattern on a deliberately-bad
+fixture, honor reasoned suppressions (and reject reasonless ones), pass
+the current ops/ tree, and be wired into compile_guard."""
+
+import os
+import textwrap
+
+import pytest
+
+from flashinfer_tpu import wedge_lint
+
+BAD_FIXTURE = textwrap.dedent(
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def bad_kernel(q_ref, k_ref, o_ref):
+        # W001: 8 heads x 16 pages x 2 parities of literal-unrolled dots
+        # = 256 MXU dots — the round-2 wedge shape
+        acc = 0.0
+        for h in range(8):
+            for p in range(16):
+                for parity in range(2):
+                    acc += jax.lax.dot(q_ref[h], k_ref[p])
+        o_ref[0] = acc
+
+    def bad_dma_kernel(x_hbm, buf, sem_ref):
+        # W002: 32 unrolled async-copy starts >> DMA queue depth
+        for j in range(32):
+            pltpu.make_async_copy(x_hbm.at[j], buf.at[j], sem_ref.at[j])
+
+    def bad_repeat_kernel(x_ref, o_ref):
+        # W003: lane-dim repeat is an unsupported Mosaic shape cast
+        o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)
+
+    def bad_dynamic_kernel(x_ref, o_ref, ppc):
+        # W004: trace-time unroll with a bound the lint cannot see
+        for j in range(ppc):
+            o_ref[j] = jax.lax.dot(x_ref[j], x_ref[j])
+
+    def plain_host_helper(x, y):
+        # no _ref params, no _kernel suffix: plain host code is exempt
+        for j in range(1000):
+            x = jnp.repeat(x, 4, axis=-1)
+        return x
+    """
+)
+
+
+def test_bad_fixture_flags_every_pattern():
+    findings = wedge_lint.lint_source(BAD_FIXTURE, "fixture.py")
+    codes = {f.code for f in findings}
+    assert codes == {"W001", "W002", "W003", "W004"}, findings
+    funcs = {f.func for f in findings}
+    assert "plain_host_helper" not in funcs
+
+
+def test_nested_literal_dma_unroll_flagged():
+    """W002 must multiply NESTED literal extents: 4 x 4 copies = 16 > 8
+    even though each loop alone stays under the queue depth."""
+    src = textwrap.dedent(
+        """
+        def nested_dma_kernel(x_hbm, buf, sem_ref):
+            for i in range(4):
+                for j in range(4):
+                    pltpu.make_async_copy(
+                        x_hbm.at[i, j], buf.at[i, j], sem_ref.at[i, j])
+        """
+    )
+    codes = {f.code for f in wedge_lint.lint_source(src, "f.py")}
+    assert "W002" in codes
+
+
+def test_positional_safe_axis_repeat_not_flagged():
+    """jnp.repeat(x, 4, 1) — positional sublane axis, the documented
+    safe form — must not trip W003."""
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        def sublane_repeat_kernel(x_ref, o_ref):
+            o_ref[...] = jnp.repeat(x_ref[...], 4, 1)
+
+        def lane_repeat_kernel(x_ref, o_ref):
+            o_ref[...] = jnp.repeat(x_ref[...], 4, -1)
+        """
+    )
+    findings = wedge_lint.lint_source(src, "f.py")
+    assert [f.func for f in findings] == ["lane_repeat_kernel"]
+
+
+def test_suppression_with_reason_honored():
+    src = BAD_FIXTURE.replace(
+        "for j in range(32):",
+        "for j in range(32):  # wedge-lint: ok on-chip validated "
+        "2026-07-29 at this exact config",
+    )
+    codes = {f.code for f in wedge_lint.lint_source(src, "f.py")}
+    assert "W002" not in codes and {"W001", "W003", "W004"} <= codes
+
+
+def test_reasonless_suppression_is_a_finding():
+    src = BAD_FIXTURE.replace(
+        "for j in range(32):",
+        "for j in range(32):  # wedge-lint: ok",
+    )
+    findings = wedge_lint.lint_source(src, "f.py")
+    codes = {f.code for f in findings}
+    assert "W000" in codes and "W002" not in codes
+
+
+def test_preceding_line_suppression():
+    target = "    o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)"
+    assert target in BAD_FIXTURE  # guard against silent no-op replaces
+    src = BAD_FIXTURE.replace(
+        target,
+        "    # wedge-lint: ok expander-dot verified, kept for "
+        "interpret parity\n" + target,
+    )
+    codes = {f.code for f in wedge_lint.lint_source(src, "f.py")}
+    assert "W003" not in codes
+
+
+def test_ops_tree_is_clean():
+    """Every kernel in ops/ either avoids the wedge patterns or carries
+    a reasoned suppression — this is the CI gate the verdict asked for."""
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "flashinfer_tpu", "ops")
+    findings = wedge_lint.lint_tree(os.path.abspath(root))
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_compile_guard_wiring(monkeypatch):
+    """compile_guard.guarded refuses (strict mode) to first-compile a
+    module whose source matches a wedge pattern."""
+    import types
+
+    mod = types.ModuleType("fake_bad_kernels")
+    mod.__name__ = "fake_bad_kernels_" + str(id(mod))
+    import flashinfer_tpu.wedge_lint as wl
+
+    monkeypatch.setattr(
+        wl.inspect, "getsource", lambda m: BAD_FIXTURE, raising=True)
+    monkeypatch.setattr(
+        wl.inspect, "getsourcefile", lambda m: "fake.py", raising=True)
+    monkeypatch.setenv("FLASHINFER_TPU_WEDGE_LINT", "strict")
+    with pytest.raises(wl.WedgeLintError, match="W001"):
+        wl.check_module(mod)
+    # the strict gate re-enforces on EVERY call — a retry must never
+    # slip a known-wedging kernel through to a hardware compile
+    with pytest.raises(wl.WedgeLintError, match="W001"):
+        wl.check_module(mod)
+    # warn mode logs but does not raise
+    mod2 = types.ModuleType("fake_bad_kernels2")
+    mod2.__name__ = "fake_bad_kernels2_" + str(id(mod2))
+    monkeypatch.setenv("FLASHINFER_TPU_WEDGE_LINT", "warn")
+    findings = wl.check_module(mod2)
+    assert {f.code for f in findings} >= {"W001"}
