@@ -1,0 +1,227 @@
+"""Migration proof #2: mechanical port of the reference test file
+``/root/reference/tests/attention/test_batch_decode_kernels.py`` run
+against ``flashinfer_tpu`` (round-5 verdict item 7, second file).
+
+Same porting contract as tests/test_ported_batch_prefill.py (which also
+provides the collection-time sampling helpers): reference parameter
+matrices verbatim, reference call sequences (positional workspace
+buffer, plan kwargs incl. data_type/q_data_type, per-request
+single_decode oracle loop), torch -> jnp.  Skip reasons:
+
+- ``pos_encoding_mode="ROPE_LLAMA"``: the BATCH wrapper rejects fused
+  RoPE loudly (apply flashinfer_tpu.rope first); note the single-request
+  oracle op DOES implement it, so only the batch rows skip.
+- fp8 (float8_e4m3fn) KV: exercised — the TPU wrapper's dequant decode
+  path consumes fp8 caches directly.
+- sampling/work-cap: as in the prefill port (1/48 stride; decode work
+  B*kv*Hq*Hd and cache-size caps for CPU CI;
+  FLASHINFER_TPU_FULL_MATRIX=1 runs everything).
+- the reference's user-allocated-out sub-check is dropped (not
+  skipped): out= is loudly rejected by design (docs/migration.md).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import FULL, _sample
+
+_DECODE_WORK_CAP = 2 ** 29
+_CACHE_ELEM_CAP = 2 ** 26
+
+
+def _decode_gates(batch_size, kv_len, num_qo_heads, head_dim,
+                  num_kv_heads, page_size):
+    work = batch_size * kv_len * num_qo_heads * head_dim
+    pages = -(-kv_len // page_size) * batch_size
+    cache_elems = pages * 2 * page_size * num_kv_heads * head_dim
+    if not FULL and work > _DECODE_WORK_CAP:
+        pytest.skip(
+            f"decode work {work:.1e} exceeds the CPU CI cap "
+            f"{_DECODE_WORK_CAP:.1e}; FLASHINFER_TPU_FULL_MATRIX run")
+    if not FULL and cache_elems > _CACHE_ELEM_CAP:
+        pytest.skip(
+            f"kv cache of {cache_elems:.1e} elements exceeds the CPU CI "
+            f"cap {_CACHE_ELEM_CAP:.1e}; FLASHINFER_TPU_FULL_MATRIX run")
+
+
+def _skip_rope_batch(pos_encoding_mode):
+    if pos_encoding_mode != "NONE":
+        pytest.skip(
+            "the batch decode wrapper rejects fused RoPE loudly (apply "
+            "flashinfer_tpu.rope first; the single_decode oracle op does "
+            "implement ROPE_LLAMA) — docs/migration.md")
+
+
+def _decode_inputs(batch_size, kv_len, page_size, num_kv_heads, head_dim,
+                   kv_layout, kv_dtype, seed):
+    """Reference input builder (test_batch_decode_kernels.py:119-151)."""
+    key = jax.random.PRNGKey(seed)
+    num_pages_per_seq = (kv_len + page_size - 1) // page_size
+    total_num_pages = num_pages_per_seq * batch_size
+    if kv_layout == "HND":
+        kv_shape = (total_num_pages, 2, num_kv_heads, page_size, head_dim)
+    else:
+        kv_shape = (total_num_pages, 2, page_size, num_kv_heads, head_dim)
+    kv_data_fp32 = jax.random.normal(key, kv_shape, jnp.float32)
+    kv_data = kv_data_fp32.astype(kv_dtype)
+    kv_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * \
+        num_pages_per_seq
+    kv_indices = np.arange(0, total_num_pages, dtype=np.int32)
+    kv_last_page_len = np.full(
+        (batch_size,), (kv_len - 1) % page_size + 1, dtype=np.int32)
+    return (kv_data_fp32, kv_data, kv_indptr, kv_indices,
+            kv_last_page_len)
+
+
+def _oracle_kv(kv_data_fp32, kv_indptr, kv_last_page_len, i,
+               num_kv_heads, head_dim, kv_layout, kv_dtype):
+    """Reference per-request K/V reconstruction
+    (test_batch_decode_kernels.py:175-208)."""
+    kv = np.asarray(kv_data_fp32)
+    perm_dims = (0, 2, 1, 3) if kv_layout == "HND" else (0, 1, 2, 3)
+    halves = []
+    for half in (0, 1):
+        full_pages = kv[kv_indptr[i]: kv_indptr[i + 1] - 1, half]
+        full_pages = full_pages.transpose(*perm_dims).reshape(
+            -1, num_kv_heads, head_dim)
+        lastp = kv[kv_indptr[i + 1] - 1, half]
+        last = (lastp[:, : kv_last_page_len[i]] if kv_layout == "HND"
+                else lastp[: kv_last_page_len[i], :])
+        if kv_layout == "HND":
+            last = last.transpose(1, 0, 2)
+        last = last.reshape(-1, num_kv_heads, head_dim)
+        halves.append(jnp.asarray(
+            np.concatenate([full_pages, last], 0)).astype(kv_dtype))
+    return halves[0], halves[1]
+
+
+_DECODE_MATRIX = dict(
+    batch_size=[12, 17, 128], kv_len=[54, 97, 512, 2048, 16384],
+    page_size=[1, 8, 16], num_kv_heads=[4], num_qo_heads=[4, 32],
+    head_dim=[128, 256, 512], kv_layout=["NHD"],
+    pos_encoding_mode=["NONE", "ROPE_LLAMA"], logits_soft_cap=[0.0],
+    return_lse=[True], q_dtype=[jnp.float16],
+    kv_dtype=[jnp.float16, jnp.float8_e4m3fn], contiguous_kv=[True],
+)
+_NAMES = ",".join(_DECODE_MATRIX)
+
+
+def _run_decode_case(
+    batch_size, kv_len, page_size, num_kv_heads, num_qo_heads, head_dim,
+    kv_layout, pos_encoding_mode, logits_soft_cap, return_lse, q_dtype,
+    kv_dtype, tuple_cache=False, use_fast_plan=False, seed=0,
+):
+    _skip_rope_batch(pos_encoding_mode)
+    _decode_gates(batch_size, kv_len, num_qo_heads, head_dim,
+                  num_kv_heads, page_size)
+    q = jax.random.normal(
+        jax.random.PRNGKey(seed),
+        (batch_size, num_qo_heads, head_dim), q_dtype)
+    (kv_data_fp32, kv_data, kv_indptr, kv_indices,
+     kv_last_page_len) = _decode_inputs(
+        batch_size, kv_len, page_size, num_kv_heads, head_dim,
+        kv_layout, kv_dtype, seed + 1)
+
+    workspace_buffer = jnp.empty((32 * 1024 * 1024,), jnp.int8)
+    wrapper = fi.decode.BatchDecodeWithPagedKVCacheWrapper(
+        workspace_buffer, kv_layout)
+    plan_fn = (lambda *a, **k: fi.fast_decode_plan(wrapper, *a, **k)) \
+        if use_fast_plan else wrapper.plan
+    plan_fn(
+        kv_indptr, kv_indices, kv_last_page_len,
+        num_qo_heads, num_kv_heads, head_dim, page_size,
+        logits_soft_cap=logits_soft_cap,
+        pos_encoding_mode=pos_encoding_mode,
+        data_type=kv_dtype, q_data_type=q_dtype,
+    )
+    cache = ((kv_data[:, 0], kv_data[:, 1]) if tuple_cache else kv_data)
+    if return_lse:
+        o, _ = wrapper.run(q, cache, return_lse=True)
+    else:
+        o = wrapper.run(q, cache)
+
+    for i in range(batch_size):
+        ki, vi = _oracle_kv(kv_data_fp32, kv_indptr, kv_last_page_len, i,
+                            num_kv_heads, head_dim, kv_layout, kv_dtype)
+        o_ref_i = fi.decode.single_decode_with_kv_cache(
+            q[i], ki, vi, pos_encoding_mode=pos_encoding_mode,
+            logits_soft_cap=logits_soft_cap)
+        tol = 1e-3 if kv_dtype == jnp.float16 else 2e-2  # fp8 regime
+        np.testing.assert_allclose(
+            np.asarray(o[i], np.float32),
+            np.asarray(o_ref_i, np.float32), rtol=tol, atol=tol)
+    # (the reference's out= re-run sub-check is dropped: out= is loudly
+    # rejected by design — docs/migration.md)
+
+
+@pytest.mark.parametrize(
+    _NAMES,
+    _sample("decode", *_DECODE_MATRIX.values(),
+            specials=[(7, "ROPE_LLAMA"), (11, jnp.float8_e4m3fn)]),
+)
+def test_batch_decode_with_paged_kv_cache(
+    batch_size, kv_len, page_size, num_kv_heads, num_qo_heads, head_dim,
+    kv_layout, pos_encoding_mode, logits_soft_cap, return_lse, q_dtype,
+    kv_dtype, contiguous_kv,
+):
+    """Reference test_batch_decode_with_paged_kv_cache
+    (test_batch_decode_kernels.py:90-221)."""
+    _run_decode_case(
+        batch_size, kv_len, page_size, num_kv_heads, num_qo_heads,
+        head_dim, kv_layout, pos_encoding_mode, logits_soft_cap,
+        return_lse, q_dtype, kv_dtype, seed=0)
+
+
+_DECODE_MATRIX_HD256 = dict(_DECODE_MATRIX, head_dim=[128, 256])
+
+
+@pytest.mark.parametrize(
+    _NAMES,
+    _sample("decode_fast", *_DECODE_MATRIX_HD256.values(),
+            specials=[(11, jnp.float8_e4m3fn)]),
+)
+def test_batch_decode_with_paged_kv_cache_with_fast_plan(
+    batch_size, kv_len, page_size, num_kv_heads, num_qo_heads, head_dim,
+    kv_layout, pos_encoding_mode, logits_soft_cap, return_lse, q_dtype,
+    kv_dtype, contiguous_kv,
+):
+    """Reference fast-plan variant (test_batch_decode_kernels.py:228-385):
+    engines that replan every step route through fast_decode_plan (the
+    reference matrix stops at head_dim 256 — sampled from a
+    variant-specific matrix so no sample slot is burned)."""
+    _run_decode_case(
+        batch_size, kv_len, page_size, num_kv_heads, num_qo_heads,
+        head_dim, kv_layout, pos_encoding_mode, logits_soft_cap,
+        return_lse, q_dtype, kv_dtype, use_fast_plan=True, seed=2)
+
+
+@pytest.mark.parametrize(
+    _NAMES,
+    _sample("decode_tuple", *_DECODE_MATRIX_HD256.values(),
+            specials=[(11, jnp.float8_e4m3fn)]),
+)
+def test_batch_decode_with_tuple_paged_kv_cache(
+    batch_size, kv_len, page_size, num_kv_heads, num_qo_heads, head_dim,
+    kv_layout, pos_encoding_mode, logits_soft_cap, return_lse, q_dtype,
+    kv_dtype, contiguous_kv,
+):
+    """Reference tuple-cache variant (test_batch_decode_kernels.py:387+):
+    the kv cache crosses as a (k, v) tuple (variant-specific matrix,
+    head_dim <= 256 as in the reference)."""
+    _run_decode_case(
+        batch_size, kv_len, page_size, num_kv_heads, num_qo_heads,
+        head_dim, kv_layout, pos_encoding_mode, logits_soft_cap,
+        return_lse, q_dtype, kv_dtype, tuple_cache=True, seed=4)
+
+
+def test_batch_decode_rope_raises():
+    """Pins the ROPE skip reason: the batch wrapper rejects fused RoPE
+    loudly rather than silently decoding un-roped."""
+    w = fi.decode.BatchDecodeWithPagedKVCacheWrapper(None, "NHD")
+    with pytest.raises(NotImplementedError, match="rope"):
+        w.plan(np.array([0, 1], np.int32), np.array([0], np.int32),
+               np.array([4], np.int32), 4, 4, 128, 16,
+               pos_encoding_mode="ROPE_LLAMA")
