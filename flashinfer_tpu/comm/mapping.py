@@ -32,6 +32,7 @@ class Mapping:
     dp_size: int = 1
     moe_tp_size: int = 1
     moe_ep_size: int = 1
+    num_slices: int = 1  # multi-slice (DCN) deployments; dp crosses slices
 
     def __post_init__(self):
         if self.dp_size * self.cp_size * self.tp_size * self.pp_size != self.world_size:
@@ -45,6 +46,19 @@ class Mapping:
                 "moe_tp_size * moe_ep_size must equal tp_size (or both be 1): "
                 f"{self.moe_tp_size}*{self.moe_ep_size} vs tp {self.tp_size}"
             )
+        if self.num_slices > 1:
+            if self.dp_size % self.num_slices:
+                raise ValueError(
+                    "multi-slice topologies put DCN parallelism on the dp "
+                    f"axis: dp_size {self.dp_size} must be a multiple of "
+                    f"num_slices {self.num_slices} (tp/cp/pp collectives "
+                    "must stay inside a slice's ICI)"
+                )
+            if self.world_size % self.num_slices:
+                raise ValueError(
+                    f"world_size {self.world_size} not divisible by "
+                    f"num_slices {self.num_slices}"
+                )
 
     # ---- axis names -------------------------------------------------------
     AXIS_DP = "dp"
@@ -61,7 +75,23 @@ class Mapping:
         return (self.dp_size, self.cp_size, self.tp_size, self.pp_size)
 
     def make_mesh(self, devices: Optional[Sequence] = None):
-        """Build the ``jax.sharding.Mesh`` for this topology."""
+        """Build the ``jax.sharding.Mesh`` for this topology.
+
+        Multi-slice (``num_slices > 1``): the mesh is laid out so the
+        OUTER part of the dp axis crosses slices (DCN) and every inner
+        axis (cp/tp/pp and the within-slice part of dp) stays inside one
+        slice's ICI — the scaling-book recipe: only gradient/batch-style
+        traffic rides DCN, bandwidth-hungry tp/cp collectives never
+        leave a slice.  On real multi-slice TPU the devices are grouped
+        by ``slice_index`` (the jax device attribute
+        ``mesh_utils.create_hybrid_device_mesh`` keys on); hosts without
+        slice metadata (CPU dryruns, single slice) use flat order, which
+        has the same contiguous-blocks-per-slice layout.
+
+        Reference analogue: multi-node rank groups over NCCL/MNNVL
+        (comm/mapping.py:21-461 + mnnvl.py); here the DCN/ICI split is a
+        device-ordering concern and XLA compiles the right collectives.
+        """
         import jax
         from jax.sharding import Mesh
 
@@ -70,8 +100,42 @@ class Mapping:
             raise ValueError(
                 f"need {self.world_size} devices, have {len(devices)}"
             )
-        arr = np.array(devices[: self.world_size]).reshape(self.axis_sizes)
+        devices = devices[: self.world_size]
+        if self.num_slices > 1:
+            per_slice = self.world_size // self.num_slices
+            slice_ids = [getattr(d, "slice_index", None) for d in devices]
+            if all(s is not None for s in slice_ids):
+                # real multi-slice: the population must be exactly
+                # num_slices slices of per_slice devices each — anything
+                # else would put a tp/cp collective block across two
+                # slices' DCN boundary silently
+                from collections import Counter
+
+                counts = Counter(slice_ids)
+                if len(counts) != self.num_slices \
+                        or set(counts.values()) != {per_slice}:
+                    raise ValueError(
+                        f"multi-slice mesh needs {self.num_slices} slices "
+                        f"x {per_slice} devices; got slice populations "
+                        f"{dict(counts)} — a contiguous block would span "
+                        "slices and its ICI collectives would ride DCN"
+                    )
+                # group by slice so each outer-dp block is one slice
+                # (one contiguous ICI domain); stable within a slice
+                devices = [d for _, d in sorted(
+                    zip(slice_ids, devices), key=lambda t: (t[0],)
+                )]
+            # devices without slice metadata (CPU dryruns): flat order
+            # already yields contiguous per-slice blocks
+        arr = np.array(devices).reshape(self.axis_sizes)
         return Mesh(arr, self.axis_names)
+
+    @property
+    def dcn_axis_name(self) -> Optional[str]:
+        """The mesh axis whose collectives cross DCN (None when single
+        slice).  Always ``dp`` by construction — batch-parallel traffic
+        is the only traffic cheap enough for DCN."""
+        return self.AXIS_DP if self.num_slices > 1 else None
 
     # ---- rank coordinate math (parity with reference rank accessors) ------
     def coords(self, rank: int) -> Tuple[int, int, int, int]:

@@ -125,3 +125,67 @@ def test_mapping_math():
     assert len(seen) == 16
     with pytest.raises(ValueError):
         Mapping(world_size=8, tp_size=3)
+
+
+@pytest.mark.devices_8
+def test_multislice_mapping_mesh():
+    """Multi-slice (DCN) topology: dp crosses slices, inner axes stay on
+    one slice's ICI; the full sharded decode step compiles and matches
+    the single-slice mesh result (same devices, same program — only the
+    device ORDER encodes the DCN/ICI split)."""
+    import numpy as np
+
+    from flashinfer_tpu.comm import Mapping
+    from flashinfer_tpu.models import (
+        LlamaConfig, init_llama_params, make_sharded_decode_step,
+    )
+
+    m = Mapping(world_size=8, dp_size=2, tp_size=4, num_slices=2)
+    assert m.dcn_axis_name == "dp"
+    mesh = m.make_mesh()
+    # each dp row is one slice: 4 contiguous devices
+    assert mesh.devices.shape == (2, 1, 4, 1)
+    flat = [d.id for d in mesh.devices.reshape(-1)]
+    assert flat == sorted(flat)
+    # invalid splits raise with the ICI rationale
+    with pytest.raises(ValueError, match="ICI"):
+        Mapping(world_size=8, dp_size=1, tp_size=8, num_slices=2)
+
+    cfg = LlamaConfig.tiny(num_layers=1, num_kv_heads=4, num_qo_heads=8,
+                           vocab_size=128, hidden_size=128,
+                           intermediate_size=256)
+    step, mesh2, _ = make_sharded_decode_step(m, cfg, mesh=mesh)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    B, PPR, PS = 4, 2, 8
+    num_pages = (B // 2) * PPR + 1
+    caches = [
+        (
+            jnp.zeros((2, num_pages, cfg.num_kv_heads, PS, cfg.head_dim),
+                      cfg.dtype),
+            jnp.zeros((2, num_pages, cfg.num_kv_heads, PS, cfg.head_dim),
+                      cfg.dtype),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    table = jnp.tile(
+        jnp.arange((B // 2) * PPR, dtype=jnp.int32).reshape(B // 2, PPR),
+        (2, 1))
+    lens = jnp.full((B,), PS, jnp.int32)
+    toks = jnp.zeros((B,), jnp.int32)
+    logits, _ = step(params, toks, lens, caches, table, lens)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_multislice_uneven_population_rejected():
+    """Mixed/uneven slice populations must be rejected — a contiguous
+    block spanning two slices would silently put tp collectives on DCN
+    (the review repro: slice ids [0,0,0,1,1,1,1,1])."""
+    import types
+
+    from flashinfer_tpu.comm import Mapping
+
+    fake = [types.SimpleNamespace(slice_index=s, id=i)
+            for i, s in enumerate([0, 0, 0, 1, 1, 1, 1, 1])]
+    m = Mapping(world_size=8, dp_size=2, tp_size=4, num_slices=2)
+    with pytest.raises(ValueError, match="slice populations"):
+        m.make_mesh(devices=fake)

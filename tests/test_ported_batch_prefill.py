@@ -52,6 +52,15 @@ _WORK_CAP = 2 ** 30
 def _sample(kind, *param_lists, specials=()):
     """Collection-time deterministic subsample of a reference
     cross-product (full matrix under FLASHINFER_TPU_FULL_MATRIX=1).
+
+    Selection is RANK-based: cases sort by a stable md5 hash and the
+    top ceil(n / _STRIDE) are kept — so small matrices (e.g. the ported
+    sampling file's 9-45-case sets) always keep at least one case
+    instead of modulo-thresholding down to zero.  Hash keys use
+    ``__name__`` for callables (closure reprs embed memory addresses,
+    which would make collection nondeterministic across runs/xdist
+    workers).
+
     ``specials`` is a list of (param_index, value) pairs; at least one
     case with each special value AT THAT INDEX is always kept so its
     written skip reason stays visible in every run (index-based —
@@ -61,16 +70,18 @@ def _sample(kind, *param_lists, specials=()):
     if FULL:
         return cases
 
-    def keep(c):
-        h = int.from_bytes(
-            hashlib.md5(repr((kind,) + c).encode()).digest()[:4],
-            "little")
-        return h % _STRIDE == 0
+    def case_hash(c):
+        stable = tuple(
+            getattr(x, "__name__", x) for x in (kind,) + c)
+        return int.from_bytes(
+            hashlib.md5(repr(stable).encode()).digest()[:8], "little")
 
-    kept = [c for c in cases if keep(c)]
+    n_keep = max(1, -(-len(cases) // _STRIDE))
+    kept = sorted(cases, key=case_hash)[:n_keep]
     for idx, val in specials:
         if not any(c[idx] == val for c in kept):
-            extra = next((c for c in cases if c[idx] == val), None)
+            extra = min((c for c in cases if c[idx] == val),
+                        key=case_hash, default=None)
             if extra is not None:
                 kept.append(extra)
     return kept

@@ -1,0 +1,406 @@
+"""Migration proof #3: mechanical port of the reference test file
+``/root/reference/tests/utils/test_sampling.py`` against
+``flashinfer_tpu.sampling`` (round-5 verdict item 7, third file — the
+sampling surface is a reference headline feature).
+
+Porting deviations, each a written reason:
+
+- **explicit PRNG keys**: the reference samples through torch's stateful
+  generator (``generator=`` kwarg); JAX keys are explicit, so every
+  sampling call here inserts ``key`` (the documented TPU signature —
+  ``jax.random.PRNGKey`` in the second positional slot).  The
+  reproducibility tests become key-equality tests, the strongest form
+  of the reference's seed/offset checks.
+- **trial counts**: the reference loops 1000-5000 stateful draws per
+  membership test and 5M draws per frequency test.  Membership
+  assertions are PER-DRAW invariants, so 20 split-key draws exercise
+  them identically; frequency tests run reduced, chunked trials at
+  vocab <= 32000 on CPU CI (the 128k rows and full trial counts run
+  under FLASHINFER_TPU_FULL_MATRIX=1 / the hardware tier).
+- matrix sampling: collection-time 1/48 stride shared with the other
+  ported files; memory gate skips batch*vocab > 2^27 on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import FULL, _sample
+
+# 2**25: admits (989, 32000) and (99, 128256) but routes (989, 128256)
+# to the FULL/hardware tier — its 20-draw full-vocab sort loops exceed
+# 9 min per case on CPU
+_ELEM_CAP = 2 ** 25
+
+
+def _mem_gate(batch_size, vocab_size):
+    if not FULL and batch_size * vocab_size > _ELEM_CAP:
+        pytest.skip(
+            f"batch*vocab {batch_size * vocab_size:.1e} exceeds the CPU "
+            f"CI cap {_ELEM_CAP:.1e}; FLASHINFER_TPU_FULL_MATRIX run")
+
+
+def normal_distribution(std):
+    def normal_noise(shape, key):
+        return jax.random.normal(key, shape) * std
+
+    normal_noise.__name__ = f"normal_distribution(std={std})"
+    return normal_noise
+
+
+def gumbel_distribution(beta):
+    def gumbel_noise(shape, key):
+        U = jax.random.uniform(key, shape)
+        eps = 1e-20
+        return jnp.log(-jnp.log(U + eps) + eps) / beta
+
+    gumbel_noise.__name__ = f"gumbel_distribution(beta={beta})"
+    return gumbel_noise
+
+
+_DISTS = [normal_distribution(1), normal_distribution(5),
+          gumbel_distribution(0.1)]
+
+
+def _norm_probs(batch_size, vocab_size, seed):
+    pre = jax.random.uniform(jax.random.PRNGKey(seed),
+                             (batch_size, vocab_size))
+    return pre / pre.sum(-1, keepdims=True)
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,distribution,temperature,temperature_arr,"
+    "neg_inf_input",
+    _sample("softmax", [1, 99, 989], [111, 32000, 128256], _DISTS,
+            [1.0, 0.5, 0.1], [True, False], [True, False],
+            specials=[(5, True)]),
+)
+def test_softmax(batch_size, vocab_size, distribution, temperature,
+                 temperature_arr, neg_inf_input):
+    """Reference test_softmax (test_sampling.py:41-76)."""
+    _mem_gate(batch_size, vocab_size)
+    keys = jax.random.split(jax.random.PRNGKey(42), 3)
+    logits = distribution((batch_size, vocab_size), keys[0])
+    if neg_inf_input:
+        n = batch_size * vocab_size
+        num_inf = int(jax.random.randint(keys[1], (), 0, n - 1))
+        inf_idx = jax.random.permutation(keys[2], n)[:num_inf]
+        logits = logits.reshape(-1).at[inf_idx].set(-jnp.inf).reshape(
+            batch_size, vocab_size)
+    if temperature_arr:
+        t = jnp.full((batch_size,), temperature)
+        probs = fi.sampling.softmax(logits, temperature=t)
+        logits_scaled = logits / t[:, None]
+    else:
+        probs = fi.sampling.softmax(logits, temperature=temperature)
+        logits_scaled = logits / temperature
+    probs_ref = jax.nn.softmax(logits_scaled.astype(jnp.float32), axis=-1)
+    np.testing.assert_allclose(np.asarray(probs), np.asarray(probs_ref),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "vocab_size,distribution,zero_ratio",
+    _sample("freq", [111, 32000, 128256], _DISTS, [0.0, 0.5, 0.9],
+            specials=[(2, 0.9)]),
+)
+def test_sampling_freq(vocab_size, distribution, zero_ratio):
+    """Reference test_sampling_freq (test_sampling.py:79-106): empirical
+    frequency tracks the distribution; -inf rows never sampled.
+
+    CPU CI runs vocab=111 only: cosine similarity of an empirical
+    histogram needs trials >> vocab / E[p^2] to clear 0.98 for FLAT
+    distributions — at vocab 32000+ that is millions of draws (the
+    reference uses 5M), which the FULL/hardware run performs."""
+    if not FULL and vocab_size > 111:
+        pytest.skip(
+            "frequency similarity at vocab > 111 needs millions of "
+            "trials to converge for flat distributions; the "
+            "FLASHINFER_TPU_FULL_MATRIX/hardware run uses the "
+            "reference's 5M trials")
+    keys = jax.random.split(jax.random.PRNGKey(42), 3)
+    logits = distribution((1, vocab_size), keys[0])
+    zero_idx = np.asarray(
+        jax.random.permutation(keys[1], vocab_size)
+    )[: int(vocab_size * zero_ratio)]
+    logits = logits.at[:, zero_idx].set(-jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # FULL: the reference's 5M trials; CPU CI: 49k trials at vocab 111
+    chunk = 2048
+    n_chunks = -(-5_000_000 // chunk) if FULL else 24
+    counter = np.zeros(vocab_size, np.int64)
+    idx = jnp.zeros((chunk,), jnp.int32)
+    for i, k in enumerate(jax.random.split(keys[2], n_chunks)):
+        samples = fi.sampling.sampling_from_probs(probs, k, indices=idx)
+        counter += np.bincount(np.asarray(samples), minlength=vocab_size)
+    num_trials = chunk * n_chunks
+    freq = counter.astype(np.float64) / num_trials
+    assert counter[zero_idx].sum() == 0
+    p = np.asarray(probs[0], np.float64)
+    similarity = (freq @ p) / (np.linalg.norm(freq) * np.linalg.norm(p))
+    assert similarity > 0.98, f"similarity: {similarity}"
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size",
+    _sample("bounds", [1, 99, 989], [111, 32000, 128256]),
+)
+def test_sampling(batch_size, vocab_size):
+    """Reference test_sampling (test_sampling.py:179-190): 20 split-key
+    draws replace 5000 stateful draws (per-draw invariant)."""
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    for k in jax.random.split(jax.random.PRNGKey(0), 20):
+        samples = fi.sampling.sampling_from_probs(probs, k)
+        s = np.asarray(samples)
+        assert (s < vocab_size).all() and (s >= 0).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size",
+    _sample("bounds_logits", [1, 99, 989], [111, 32000, 128256]),
+)
+def test_sampling_from_logits(batch_size, vocab_size):
+    """Reference test_sampling_from_logits (test_sampling.py:192-201)."""
+    _mem_gate(batch_size, vocab_size)
+    logits = jax.random.normal(jax.random.PRNGKey(42),
+                               (batch_size, vocab_size))
+    for k in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(fi.sampling.sampling_from_logits(logits, k))
+        assert (s < vocab_size).all() and (s >= 0).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,p",
+    _sample("top_p", [1, 99, 989], [111, 32000, 128256],
+            [0.1, 0.5, 0.9]),
+)
+def test_top_p_sampling(batch_size, vocab_size, p):
+    """Reference test_top_p_sampling (test_sampling.py:227-244): every
+    sample lies in the top-p nucleus."""
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    order = np.argsort(pn, axis=-1)
+    sp = np.take_along_axis(pn, order, -1)
+    cdf = np.cumsum(sp, -1)
+    mask = np.zeros_like(pn, np.int32)
+    # 1e-4 band: the implementation's f32 cumsum at 128k vocab carries
+    # ~1e-5..1e-4 of mass error vs this f64 oracle (same tolerance the
+    # reference's joint test uses)
+    np.put_along_axis(mask, order, (cdf > (1 - p) - 1e-4).astype(np.int32),
+                      -1)
+    for k in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(fi.sampling.top_p_sampling_from_probs(probs, k, p))
+        assert (mask[np.arange(batch_size), s] == 1).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,k",
+    _sample("top_k", [1, 99, 989], [111, 32000, 128256],
+            [10, 100, 500]),
+)
+def test_top_k_sampling(batch_size, vocab_size, k):
+    """Reference test_top_k_sampling (test_sampling.py:247-266)."""
+    if k > vocab_size:
+        pytest.skip("k should be less than vocab_size")
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    pivot = np.sort(pn, -1)[:, ::-1][:, k - 1]
+    mask = (pn >= pivot[:, None]).astype(np.int32)
+    for kk in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(fi.sampling.top_k_sampling_from_probs(probs, kk, k))
+        assert (mask[np.arange(batch_size), s] == 1).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,k",
+    _sample("top_k_var", [1, 99, 989], [111, 32000, 128256],
+            [10, 100, 500]),
+)
+def test_top_k_sampling_with_variable_k(batch_size, vocab_size, k):
+    """Reference variable-k variant (test_sampling.py:269-289): per-row
+    k array."""
+    if k > vocab_size:
+        pytest.skip("k should be less than vocab_size")
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    karr = jax.random.randint(jax.random.PRNGKey(1), (batch_size,), 1,
+                              k + 1)
+    pn = np.asarray(probs, np.float64)
+    sp = np.sort(pn, -1)[:, ::-1]
+    pivot = sp[np.arange(batch_size), np.asarray(karr) - 1]
+    mask = (pn >= pivot[:, None]).astype(np.int32)
+    for kk in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(
+            fi.sampling.top_k_sampling_from_probs(probs, kk, karr))
+        assert (s < vocab_size).all() and (s >= 0).all()
+        assert (mask[np.arange(batch_size), s] == 1).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,p",
+    _sample("min_p", [1, 99, 989], [111, 32000, 128256],
+            [0.05, 0.1, 0.2, 0.7, 1]),
+)
+def test_min_p_sampling(batch_size, vocab_size, p):
+    """Reference test_min_p_sampling (test_sampling.py:292-318)."""
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    top = pn.max(-1, keepdims=True)
+    mask = (pn >= p * top).astype(np.int32)
+    min_p = jnp.full((batch_size,), float(p))
+    for kk in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(
+            fi.sampling.min_p_sampling_from_probs(probs, kk, min_p))
+        assert (mask[np.arange(batch_size), s] == 1).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,p",
+    _sample("joint", [1, 99, 989], [111, 32000, 128256], [0.1, 0.5]),
+)
+def test_top_k_top_p_joint_sampling_from_probs(batch_size, vocab_size, p):
+    """Reference joint filter test (test_sampling.py:323-360)."""
+    _mem_gate(batch_size, vocab_size)
+    k = int(vocab_size * 0.5) if p == 0.1 else int(vocab_size * 0.1)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    order = np.argsort(pn, -1)
+    sp = np.take_along_axis(pn, order, -1)
+    cdf = np.cumsum(sp, -1)
+    mask_p = np.zeros_like(pn, np.int32)
+    np.put_along_axis(mask_p, order,
+                      (cdf > (1 - p) - 1e-4).astype(np.int32), -1)
+    pivot = np.sort(pn, -1)[:, ::-1][:, k - 1]
+    mask_k = (pn >= pivot[:, None]).astype(np.int32)
+    mask = np.minimum(mask_p, mask_k)
+    tp = jnp.full((batch_size,), float(p))
+    tk = jnp.full((batch_size,), k, jnp.int32)
+    for kk in jax.random.split(jax.random.PRNGKey(0), 20):
+        s = np.asarray(fi.sampling.top_k_top_p_sampling_from_probs(
+            probs, kk, tk, tp, filter_apply_order="joint"))
+        assert (s < vocab_size).all() and (s >= 0).all()
+        assert (mask[np.arange(batch_size), s] == 1).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,p",
+    _sample("joint_logits", [1, 99, 989], [111, 32000, 128256],
+            [0.1, 0.5]),
+)
+def test_top_k_top_p_joint_sampling_from_logits(batch_size, vocab_size, p):
+    """Reference alignment test (test_sampling.py:399-425): from_logits
+    with a given key must equal softmax + from_probs with the SAME key
+    (the reference's cloned-generator check, exact here)."""
+    _mem_gate(batch_size, vocab_size)
+    k = int(vocab_size * 0.5) if p == 0.1 else int(vocab_size * 0.1)
+    logits = jax.random.uniform(jax.random.PRNGKey(42),
+                                (batch_size, vocab_size)) * 5
+    key = jax.random.PRNGKey(7)
+    s1 = fi.sampling.top_k_top_p_sampling_from_logits(
+        logits, key, k, p, filter_apply_order="joint")
+    s2 = fi.sampling.top_k_top_p_sampling_from_probs(
+        jax.nn.softmax(logits, axis=-1), key, k, p,
+        filter_apply_order="joint")
+    assert (np.asarray(s1) == np.asarray(s2)).all()
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,p",
+    _sample("renorm_p", [1, 99, 989], [111, 32000, 128256],
+            [0.1, 0.5, 0.9, 1.0]),
+)
+def test_top_p_renorm_probs(batch_size, vocab_size, p):
+    """Reference test_top_p_renorm_probs (test_sampling.py:428-450)."""
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    order = np.argsort(pn, -1)
+    sp = np.take_along_axis(pn, order, -1)
+    cdf = np.cumsum(sp, -1)
+    mask = np.zeros_like(pn, np.int32)
+    np.put_along_axis(mask, order, (cdf >= (1 - p) - 1e-9).astype(np.int32),
+                      -1)
+    ref = np.where(mask == 1, pn, 0.0)
+    ref = ref / ref.sum(-1, keepdims=True)
+    out = np.asarray(fi.sampling.top_p_renorm_probs(probs, p), np.float64)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size,k",
+    _sample("renorm_k", [1, 99, 989], [111, 32000, 128256],
+            [10, 100, 500]),
+)
+def test_top_k_renorm_probs(batch_size, vocab_size, k):
+    """Reference test_top_k_renorm_probs (test_sampling.py:493+)."""
+    if k > vocab_size:
+        pytest.skip("k should be less than vocab_size")
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    pn = np.asarray(probs, np.float64)
+    pivot = np.sort(pn, -1)[:, ::-1][:, k - 1]
+    ref = np.where(pn >= pivot[:, None], pn, 0.0)
+    ref = ref / ref.sum(-1, keepdims=True)
+    out = np.asarray(fi.sampling.top_k_renorm_probs(probs, k), np.float64)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "batch_size,vocab_size",
+    _sample("repro", [1, 99, 989], [111, 32000, 128256]),
+)
+def test_sampling_seed_reproducibility(batch_size, vocab_size):
+    """Reference seed/offset reproducibility tests
+    (test_sampling.py:981-1062), in their exact-key JAX form: same key
+    -> identical samples, different keys -> (overwhelmingly) different."""
+    _mem_gate(batch_size, vocab_size)
+    probs = _norm_probs(batch_size, vocab_size, 42)
+    key = jax.random.PRNGKey(3)
+    a = np.asarray(fi.sampling.sampling_from_probs(probs, key))
+    b = np.asarray(fi.sampling.sampling_from_probs(probs, key))
+    assert (a == b).all()
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    la = np.asarray(fi.sampling.sampling_from_logits(logits, key))
+    lb = np.asarray(fi.sampling.sampling_from_logits(logits, key))
+    assert (la == lb).all()
+    if vocab_size > 1000 and batch_size > 1:
+        c = np.asarray(
+            fi.sampling.sampling_from_probs(probs, jax.random.PRNGKey(4)))
+        assert (a != c).any()
+
+
+def test_chain_speculative_sampling_port():
+    """Reference test_chain_speculative_sampling (test_sampling.py:773):
+    rejection-based verify — accepted prefix tokens must match greedy
+    membership in the draft distribution's support, and output length is
+    num_spec + 1 with -1 padding after the first bonus token."""
+    B, L, V = 4, 3, 64
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    draft_probs = jax.nn.softmax(
+        jax.random.normal(keys[0], (B, L, V)) * 2, -1)
+    draft_ids = jnp.argmax(draft_probs, -1).astype(jnp.int32)
+    target_probs = jax.nn.softmax(
+        jax.random.normal(keys[1], (B, L + 1, V)) * 2, -1)
+    out, accepted, emitted = fi.sampling.chain_speculative_sampling(
+        draft_probs, draft_ids, target_probs, keys[2])
+    o = np.asarray(out)
+    assert o.shape == (B, L + 1)
+    acc = np.asarray(accepted)
+    emt = np.asarray(emitted)
+    for b in range(B):
+        # emitted = leading accepted run (tokens actually kept);
+        # accepted = per-position telemetry count, >= emitted
+        n = int(emt[b])
+        assert 0 <= n <= L and acc[b] >= n
+        # emitted draft tokens + one bonus/resampled token, then -1 pad
+        assert (o[b, : n + 1] >= 0).all()
+        assert (o[b, n + 1:] == -1).all()
+        # the emitted prefix is exactly the draft tokens
+        assert (o[b, :n] == np.asarray(draft_ids)[b, :n]).all()
