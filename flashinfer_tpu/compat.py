@@ -315,14 +315,70 @@ CUDAGraphBatchDecodeWithPagedKVCacheWrapper = (
 def _shared_prefix_wrapper(base):
     class _SharedPrefix(base):
         """Shared-prefix cascade wrapper (reference
-        Batch*WithSharedPrefixPagedKVCacheWrapper, cascade.py): the
-        two-level cascade — shared prefix attention merged with unique
-        suffixes via merge_state — is served by
-        MultiLevelCascadeAttentionWrapper; this name preserves the
-        reference's flat entry point for single-level use."""
+        Batch*WithSharedPrefixPagedKVCacheWrapper, cascade.py:505+):
+        the reference's LEGACY two-level API — ``begin_forward`` plans
+        the UNIQUE-suffix paged geometry, ``forward(q, k_shared,
+        v_shared, unique_kv_cache)`` computes non-causal attention over
+        the dense shared prefix, the planned paged attention over the
+        unique suffixes, and folds the two with ``merge_state`` (the
+        same math MultiLevelCascadeAttentionWrapper runs per level)."""
+
+        def plan(self, *args, **kw):
+            # stash the geometry so forward(..., causal=) can RE-plan
+            # exactly once when the flag changes (the reference passes
+            # causal at forward time for the prefill variant); stashing
+            # here (not in begin_forward) also covers callers using the
+            # modern plan() spelling
+            self._bf_args, self._bf_kw = args, dict(kw)
+            self._planned_causal = bool(kw.get("causal", False))
+            return base.plan(self, *args, **kw)
+
+        begin_forward = plan  # legacy lifecycle name
+
+        def forward(self, q, k_shared, v_shared, unique_kv_cache,
+                    causal: bool = False, sm_scale=None,
+                    logits_soft_cap=None, **kw):
+            if kw:
+                raise TypeError(
+                    f"shared-prefix forward: unsupported kwargs "
+                    f"{sorted(kw)}")
+            from flashinfer_tpu.ops.merge import merge_state
+            from flashinfer_tpu.prefill import (
+                single_prefill_with_kv_cache,
+            )
+
+            if "causal" in _ins.signature(base.plan).parameters \
+                    and causal != self._planned_causal:
+                base.plan(self, *self._bf_args,
+                          **{**self._bf_kw, "causal": causal})
+                self._planned_causal = causal
+            # BOTH halves must use the planned logits math — merging
+            # states computed under different scales is numerically
+            # wrong
+            plan = self._plan
+            sm = sm_scale if sm_scale is not None else plan.sm_scale
+            cap = (logits_soft_cap if logits_soft_cap is not None
+                   else plan.logits_soft_cap)
+            # shared prefix: every query row attends the WHOLE prefix
+            # (non-causal by construction — the prefix precedes all);
+            # single_prefill dispatches to the flash backend rather than
+            # materializing dense scores
+            o_s, lse_s = single_prefill_with_kv_cache(
+                q, k_shared, v_shared, causal=False, sm_scale=sm,
+                logits_soft_cap=cap or None, return_lse=True,
+            )
+            o_u, lse_u = self.run(q, unique_kv_cache, return_lse=True)
+            o, _ = merge_state(o_s, lse_s, o_u, lse_u)
+            return o
+
+        def end_forward(self):  # legacy lifecycle no-op
+            return None
 
     _SharedPrefix.__name__ = "SharedPrefix" + base.__name__
     return _SharedPrefix
+
+
+import inspect as _ins  # noqa: E402
 
 
 from flashinfer_tpu.prefill import (  # noqa: E402

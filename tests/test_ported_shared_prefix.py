@@ -1,0 +1,272 @@
+"""Migration proof #4: mechanical port of the reference test file
+``/root/reference/tests/attention/test_shared_prefix_kernels.py`` —
+the cascade/shared-prefix stack end-to-end: append_paged_kv_cache +
+get_batch_indices_positions + get_seq_lens build a two-region paged
+cache, then MultiLevelCascadeAttentionWrapper (2 levels) must agree
+with the LEGACY Batch*WithSharedPrefixPagedKVCacheWrapper
+begin_forward/forward two-level path, plus the masked
+merge_state_in_place semantics.
+
+Deviations (written reasons):
+- ``merge_state_in_place`` is FUNCTIONAL here (returns the merged
+  (v, s) instead of mutating va/sa — jax arrays are immutable;
+  docs/migration.md); the reference's aliasing assertions become
+  return-value assertions.
+- random-mask tries reduced 50 -> 8 (per-try invariants, split keys).
+- matrix sampling: shared 1/48 rank sampler (FLASHINFER_TPU_FULL_MATRIX
+  =1 for the reference's full cross-product).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample
+
+
+def ceil_div(a, b):
+    return (a + b - 1) // b
+
+
+@pytest.mark.parametrize(
+    "stage,batch_size,unique_kv_len,shared_kv_len,num_heads,causal,"
+    "head_dim,page_size",
+    _sample("shared_prefix", ["decode", "append"], [12, 17], [37, 17],
+            [128, 512, 2048], [8, 16], [False], [128, 256], [1, 16],
+            specials=[(0, "decode"), (0, "append")]),
+)
+def test_batch_attention_with_shared_prefix_paged_kv_cache(
+    stage, batch_size, unique_kv_len, shared_kv_len, num_heads, causal,
+    head_dim, page_size,
+):
+    """Reference test_batch_attention_with_shared_prefix_paged_kv_cache
+    (test_shared_prefix_kernels.py:60-230)."""
+    if stage == "decode" and causal:
+        pytest.skip("Causal attention is not required in decode stage")
+    assert shared_kv_len % page_size == 0
+    kv_layout = "NHD"
+    keys = jax.random.split(jax.random.PRNGKey(0), 5)
+    if stage == "append":
+        q = jax.random.normal(
+            keys[0], (batch_size * unique_kv_len, num_heads, head_dim),
+            jnp.float16)
+        q_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * \
+            unique_kv_len
+    else:
+        q = jax.random.normal(
+            keys[0], (batch_size, num_heads, head_dim), jnp.float16)
+        q_indptr = np.arange(0, batch_size + 1, dtype=np.int32)
+    k_shared = jax.random.normal(
+        keys[1], (shared_kv_len, num_heads, head_dim), jnp.float16)
+    v_shared = jax.random.normal(
+        keys[2], (shared_kv_len, num_heads, head_dim), jnp.float16)
+    k_unique = jax.random.normal(
+        keys[3], (batch_size * unique_kv_len, num_heads, head_dim),
+        jnp.float16)
+    v_unique = jax.random.normal(
+        keys[4], (batch_size * unique_kv_len, num_heads, head_dim),
+        jnp.float16)
+
+    total_pages = (ceil_div(shared_kv_len, page_size)
+                   + batch_size * ceil_div(unique_kv_len, page_size))
+    kv_data = jnp.zeros(
+        (total_pages, 2, page_size, num_heads, head_dim), jnp.float16)
+
+    shared_kv_indices = np.arange(
+        0, ceil_div(shared_kv_len, page_size), dtype=np.int32)
+    shared_append_indptr = np.arange(0, 2, dtype=np.int32) * shared_kv_len
+    shared_kv_indptr = np.arange(0, 2, dtype=np.int32) * ceil_div(
+        shared_kv_len, page_size)
+    shared_last_page_len = np.full(
+        (1,), (shared_kv_len - 1) % page_size + 1, dtype=np.int32)
+    kv_data = fi.append_paged_kv_cache(
+        k_shared, v_shared,
+        *fi.get_batch_indices_positions(
+            shared_append_indptr,
+            fi.get_seq_lens(shared_kv_indptr, shared_last_page_len,
+                            page_size),
+            k_shared.shape[0]),
+        kv_data, shared_kv_indices, shared_kv_indptr,
+        shared_last_page_len, kv_layout,
+    )
+    unique_kv_indices = np.arange(
+        0, batch_size * ceil_div(unique_kv_len, page_size),
+        dtype=np.int32) + ceil_div(shared_kv_len, page_size)
+    unique_append_indptr = np.arange(
+        0, batch_size + 1, dtype=np.int32) * unique_kv_len
+    unique_kv_indptr = np.arange(
+        0, batch_size + 1, dtype=np.int32) * ceil_div(
+        unique_kv_len, page_size)
+    unique_last_page_len = np.full(
+        (batch_size,), (unique_kv_len - 1) % page_size + 1,
+        dtype=np.int32)
+    kv_data = fi.append_paged_kv_cache(
+        k_unique, v_unique,
+        *fi.get_batch_indices_positions(
+            unique_append_indptr,
+            fi.get_seq_lens(unique_kv_indptr, unique_last_page_len,
+                            page_size),
+            k_unique.shape[0]),
+        kv_data, unique_kv_indices, unique_kv_indptr,
+        unique_last_page_len, kv_layout,
+    )
+
+    workspace = jnp.empty((32 * 1024 * 1024,), jnp.int8)
+    multi_level_wrapper = fi.MultiLevelCascadeAttentionWrapper(
+        2, workspace, kv_layout)
+    qo_indptr_top = np.array([0, q.shape[0]], dtype=np.int32)
+    if stage == "decode":
+        qo_indptr_bottom = np.arange(0, batch_size + 1, dtype=np.int32)
+    else:
+        qo_indptr_bottom = np.arange(
+            0, batch_size + 1, dtype=np.int32) * unique_kv_len
+    multi_level_wrapper.plan(
+        [qo_indptr_top, qo_indptr_bottom],
+        [shared_kv_indptr, unique_kv_indptr],
+        [shared_kv_indices, unique_kv_indices],
+        [shared_last_page_len, unique_last_page_len],
+        num_heads, num_heads, head_dim, page_size,
+        **({"causal": causal} if stage == "append" else {}),
+    )
+    o_multi_level = multi_level_wrapper.run(q, kv_data)
+
+    if stage == "decode":
+        two_level = fi.BatchDecodeWithSharedPrefixPagedKVCacheWrapper(
+            workspace, kv_layout)
+        two_level.begin_forward(
+            unique_kv_indptr, unique_kv_indices, unique_last_page_len,
+            num_heads, num_heads, head_dim, page_size)
+        o_two_level = two_level.forward(q, k_shared, v_shared, kv_data)
+    else:
+        two_level = fi.BatchPrefillWithSharedPrefixPagedKVCacheWrapper(
+            workspace, kv_layout)
+        two_level.begin_forward(
+            q_indptr, unique_kv_indptr, unique_kv_indices,
+            unique_last_page_len, num_heads, num_heads, head_dim,
+            page_size)
+        o_two_level = two_level.forward(
+            q, k_shared, v_shared, kv_data, causal=causal)
+
+    np.testing.assert_allclose(
+        np.asarray(o_multi_level, np.float32),
+        np.asarray(o_two_level, np.float32), rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("seed", [0])
+@pytest.mark.parametrize("num_tries", [8])
+def test_merge_state_in_place_with_mask(seed, num_tries):
+    """Reference test_merge_state_in_place_with_mask
+    (test_shared_prefix_kernels.py:233-312), functional form: the
+    returned (v, s) play the role of the mutated buffers."""
+    seq_len, num_heads, head_dim = 512, 32, 128
+    keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+    va = jax.random.normal(keys[0], (seq_len, num_heads, head_dim),
+                           jnp.float16)
+    sa = jax.random.normal(keys[1], (seq_len, num_heads), jnp.float32)
+    vb = jax.random.normal(keys[2], (seq_len, num_heads, head_dim),
+                           jnp.float16)
+    sb = jax.random.normal(keys[3], (seq_len, num_heads), jnp.float32)
+
+    # no mask: result differs from the input state
+    v_ref, s_ref = fi.merge_state_in_place(va, sa, vb, sb)
+    assert not np.allclose(np.asarray(v_ref), np.asarray(va))
+    assert not np.allclose(np.asarray(s_ref), np.asarray(sa))
+
+    # all-ones mask == no mask
+    ones = jnp.ones((seq_len,), bool)
+    v1, s1 = fi.merge_state_in_place(va, sa, vb, sb, mask=ones)
+    np.testing.assert_allclose(np.asarray(v1, np.float32),
+                               np.asarray(v_ref, np.float32),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s_ref),
+                               rtol=1e-3, atol=1e-3)
+
+    # all-zeros mask: unchanged inputs
+    zeros = jnp.zeros((seq_len,), bool)
+    v0, s0 = fi.merge_state_in_place(va, sa, vb, sb, mask=zeros)
+    np.testing.assert_allclose(np.asarray(v0, np.float32),
+                               np.asarray(va, np.float32),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(sa),
+                               rtol=1e-3, atol=1e-3)
+
+    # random masks: merged where True, untouched where False
+    for k in jax.random.split(keys[4], num_tries):
+        mask = jax.random.uniform(k, (seq_len,)) > 0.5
+        vm, sm = fi.merge_state_in_place(va, sa, vb, sb, mask=mask)
+        m = np.asarray(mask)
+        np.testing.assert_allclose(
+            np.asarray(vm, np.float32)[~m],
+            np.asarray(va, np.float32)[~m], rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(sm)[~m], np.asarray(sa)[~m], rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(vm, np.float32)[m],
+            np.asarray(v_ref, np.float32)[m], rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(sm)[m], np.asarray(s_ref)[m], rtol=1e-3, atol=1e-3)
+
+
+def test_shared_prefix_causal_toggle_and_planned_scale():
+    """Review pins: forward(causal=True) then forward(causal=False) must
+    re-plan back (no stale causal mask), and a planned sm_scale must
+    apply to BOTH merged halves."""
+    B, U, S, H, D, PS = 2, 8, 16, 4, 64, 8
+    keys = jax.random.split(jax.random.PRNGKey(1), 5)
+    q = jax.random.normal(keys[0], (B * U, H, D), jnp.float16)
+    q_indptr = np.arange(0, B + 1, dtype=np.int32) * U
+    k_s = jax.random.normal(keys[1], (S, H, D), jnp.float16)
+    v_s = jax.random.normal(keys[2], (S, H, D), jnp.float16)
+    pages_u = B * ceil_div(U, PS)
+    kv = jnp.zeros((ceil_div(S, PS) + pages_u, 2, PS, H, D), jnp.float16)
+    s_idx = np.arange(ceil_div(S, PS), dtype=np.int32)
+    s_indptr = np.arange(0, 2, dtype=np.int32) * ceil_div(S, PS)
+    s_last = np.full((1,), (S - 1) % PS + 1, np.int32)
+    kv = fi.append_paged_kv_cache(
+        k_s, v_s,
+        *fi.get_batch_indices_positions(
+            np.arange(0, 2, dtype=np.int32) * S,
+            fi.get_seq_lens(s_indptr, s_last, PS), S),
+        kv, s_idx, s_indptr, s_last, "NHD")
+    k_u = jax.random.normal(keys[3], (B * U, H, D), jnp.float16)
+    v_u = jax.random.normal(keys[4], (B * U, H, D), jnp.float16)
+    u_idx = np.arange(pages_u, dtype=np.int32) + ceil_div(S, PS)
+    u_indptr = np.arange(0, B + 1, dtype=np.int32) * ceil_div(U, PS)
+    u_last = np.full((B,), (U - 1) % PS + 1, np.int32)
+    kv = fi.append_paged_kv_cache(
+        k_u, v_u,
+        *fi.get_batch_indices_positions(
+            np.arange(0, B + 1, dtype=np.int32) * U,
+            fi.get_seq_lens(u_indptr, u_last, PS), B * U),
+        kv, u_idx, u_indptr, u_last, "NHD")
+
+    w = fi.BatchPrefillWithSharedPrefixPagedKVCacheWrapper(None, "NHD")
+    sm = 0.05  # deliberately non-default: must reach BOTH halves
+    w.begin_forward(q_indptr, u_indptr, u_idx, u_last, H, H, D, PS,
+                    sm_scale=sm)
+    o_nc1 = w.forward(q, k_s, v_s, kv, causal=False)
+    o_c = w.forward(q, k_s, v_s, kv, causal=True)
+    o_nc2 = w.forward(q, k_s, v_s, kv, causal=False)
+    # toggling back must restore the non-causal result exactly
+    np.testing.assert_allclose(np.asarray(o_nc1, np.float32),
+                               np.asarray(o_nc2, np.float32))
+    assert not np.allclose(np.asarray(o_c, np.float32),
+                           np.asarray(o_nc1, np.float32), atol=1e-3)
+    # oracle with the same sm_scale on both halves
+    o_s, lse_s = fi.prefill.single_prefill_with_kv_cache(
+        q, k_s, v_s, causal=False, sm_scale=sm, return_lse=True)
+    pw = fi.prefill.BatchPrefillWithPagedKVCacheWrapper(None, "NHD")
+    pw.plan(q_indptr, u_indptr, u_idx, u_last, H, H, D, PS,
+            causal=False, sm_scale=sm)
+    o_u, lse_u = pw.run(q, kv, return_lse=True)
+    from flashinfer_tpu.ops.merge import merge_state
+
+    ref, _ = merge_state(o_s, lse_s, o_u, lse_u)
+    np.testing.assert_allclose(np.asarray(o_nc1, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-3, atol=1e-3)
+    # kwargs are not silently swallowed
+    with pytest.raises(TypeError, match="unsupported"):
+        w.forward(q, k_s, v_s, kv, bogus_flag=True)
