@@ -122,14 +122,30 @@ class LogitsPipe:
     >>> tokens = pipe(logits, temperature=0.8, top_p=0.9, key=key)
     """
 
-    def __init__(self, ops: Sequence[_Op]):
+    def __init__(self, ops: Sequence[_Op], compile: bool = True,
+                 input_type: Optional[str] = None):
+        """``compile=`` mirrors the reference flag: True jits the fused
+        chain (the default — on TPU compilation IS the fusion), False
+        runs the ops eagerly (debugging).  ``input_type`` sets the
+        starting stream type (reference TensorType.PROBS pipes start
+        mid-stream, e.g. LogitsPipe([TopK()], input_type=PROBS))."""
         self.ops = list(ops)
+        self._compile = bool(compile)
+        self._input_state = input_type if input_type is not None else LOGITS
+        if self._input_state not in (LOGITS, PROBS):
+            raise ValueError(
+                f"input_type must be LOGITS or PROBS, got "
+                f"{self._input_state!r}")
         self._validate()
         self._param_names = [p for op in self.ops for p in op.params]
         self._compiled = None
 
+    # call-time knobs that are pure scheduling on TPU (the reference's
+    # deterministic-kernel switch; XLA reductions are deterministic)
+    _INERT_PARAMS = frozenset({"is_deterministic", "deterministic"})
+
     def _validate(self) -> None:
-        state = LOGITS
+        state = self._input_state
         for i, op in enumerate(self.ops):
             if state == TOKENS:
                 raise ValueError(
@@ -137,16 +153,25 @@ class LogitsPipe:
                     "already ended"
                 )
             if state not in op.needs:
+                # the Softmax hint only helps when the stream can still
+                # MOVE to what the op needs (LOGITS -> PROBS; a PROBS
+                # stream cannot become logits again)
+                hint = (" (insert Softmax() before it?)"
+                        if state == LOGITS and PROBS in op.needs else "")
                 raise ValueError(
                     f"op {op.name!r} at position {i} requires "
-                    f"{'/'.join(op.needs)} input but the stream is {state} "
-                    f"(insert Softmax() before it?)"
+                    f"{'/'.join(op.needs)} input but the stream is "
+                    f"{state}{hint}"
                 )
             state = op.out_state(state)
         self.final_state = state
+        # keep the public legalizer in lockstep (same walk; it raises
+        # LegalizationError, a ValueError subclass, where the reference
+        # would) — one more guard against the two drifting
+        legalize_processors(self.ops, self._input_state)
 
     def _run(self, x, key, **params):
-        state = LOGITS
+        state = self._input_state
         for op in self.ops:
             x = op.apply(state, x, params, key)
             state = op.out_state(state)
@@ -154,6 +179,14 @@ class LogitsPipe:
 
     def __call__(self, logits: jax.Array, key: Optional[jax.Array] = None,
                  **params):
+        if params.get("generator") is not None:
+            raise ValueError(
+                "torch generators have no TPU meaning — pass an explicit "
+                "jax.random.PRNGKey as key="
+            )
+        params.pop("generator", None)  # a forwarded default None is inert
+        params = {k: v for k, v in params.items()
+                  if k not in self._INERT_PARAMS}
         missing = [p for p in self._param_names if p not in params]
         if missing:
             raise ValueError(f"missing runtime params: {missing}")
@@ -162,6 +195,8 @@ class LogitsPipe:
             raise ValueError(
                 f"unknown params {extra}; this pipe takes {self._param_names}"
             )
+        if not self._compile:
+            return self._run(logits, key, **params)
         if self._compiled is None:
             self._compiled = jax.jit(
                 functools.partial(self._run)

@@ -1,0 +1,193 @@
+"""Migration proof #5: mechanical port of the reference test file
+``/root/reference/tests/utils/test_logits_processor.py`` — the
+LogitsPipe mini-compiler: compile=True vs compile=False equivalence
+(TestLogitsPipeCompilation) and pipe-vs-direct-sampling-op equivalence
+(TestLogitsPipeVsSamplingOps), with input_type=PROBS mid-stream pipes.
+
+Deviations (written reasons): explicit PRNG keys replace torch
+generators (``generator=`` is loudly rejected by the pipe);
+``is_deterministic`` is accepted-inert (XLA reductions are
+deterministic); matrix sampling via the shared 1/48 rank sampler with
+the 2^25 element cap from the sampling port."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.logits_processor import (
+    LogitsPipe,
+    MinP,
+    Sample,
+    Softmax,
+    Temperature,
+    TensorType,
+    TopK,
+    TopP,
+)
+from tests.test_ported_batch_prefill import _sample
+from tests.test_ported_sampling import _DISTS, _mem_gate
+
+
+class TestLogitsPipeCompilation:
+    """Reference TestLogitsPipeCompilation: compile=True == compile=False."""
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,distribution,temperature",
+        _sample("lp_temp_softmax", [1, 99, 989], [111, 32000, 128256],
+                _DISTS, [1.0, 0.5, 0.1]),
+    )
+    def test_temperature_softmax(self, batch_size, vocab_size,
+                                 distribution, temperature):
+        _mem_gate(batch_size, vocab_size)
+        logits = distribution((batch_size, vocab_size),
+                              jax.random.PRNGKey(42))
+        pipe_c = LogitsPipe([Temperature(), Softmax()], compile=True)
+        pipe_e = LogitsPipe([Temperature(), Softmax()], compile=False)
+        a = pipe_c(logits, temperature=temperature)
+        b = pipe_e(logits, temperature=temperature)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,p",
+        _sample("lp_topp_c", [1, 99, 989], [111, 32000, 128256],
+                [0.1, 0.5, 0.9]),
+    )
+    def test_topp(self, batch_size, vocab_size, p):
+        _mem_gate(batch_size, vocab_size)
+        pre = jax.random.uniform(jax.random.PRNGKey(42),
+                                 (batch_size, vocab_size))
+        probs = pre / pre.sum(-1, keepdims=True)
+        pipe_c = LogitsPipe([TopP()], compile=True,
+                            input_type=TensorType.PROBS)
+        pipe_e = LogitsPipe([TopP()], compile=False,
+                            input_type=TensorType.PROBS)
+        a = pipe_c(probs, top_p=p, is_deterministic=True)
+        b = pipe_e(probs, top_p=p, is_deterministic=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+
+
+class TestLogitsPipeVsSamplingOps:
+    """Reference TestLogitsPipeVsSamplingOps: a pipe must reproduce the
+    direct sampling op it legalizes to."""
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,temperature,temperature_arr",
+        _sample("lp_vs_softmax", [1, 99, 989], [111, 32000, 128256],
+                [1.0, 0.5, 0.1], [True, False]),
+    )
+    def test_temperature_softmax(self, batch_size, vocab_size,
+                                 temperature, temperature_arr):
+        _mem_gate(batch_size, vocab_size)
+        logits = jax.random.normal(jax.random.PRNGKey(42),
+                                   (batch_size, vocab_size))
+        if temperature_arr:
+            temperature = jax.random.uniform(jax.random.PRNGKey(1),
+                                             (batch_size,))
+        direct = fi.sampling.softmax(logits, temperature=temperature)
+        pipe = LogitsPipe([Temperature(), Softmax()])
+        out = pipe(logits, temperature=temperature)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(direct),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,p",
+        _sample("lp_vs_topp", [1, 99, 989], [111, 32000, 128256],
+                [0.1, 0.5, 0.9]),
+    )
+    def test_topp(self, batch_size, vocab_size, p):
+        _mem_gate(batch_size, vocab_size)
+        pre = jax.random.uniform(jax.random.PRNGKey(42),
+                                 (batch_size, vocab_size))
+        probs = pre / pre.sum(-1, keepdims=True)
+        direct = fi.sampling.top_p_renorm_probs(probs, p)
+        pipe = LogitsPipe([TopP()], input_type=TensorType.PROBS)
+        out = pipe(probs, top_p=p, is_deterministic=True)
+        assert (np.asarray(out) == np.asarray(direct)).all()
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,k",
+        _sample("lp_vs_topk_p", [1, 99, 989], [111, 32000, 128256],
+                [10, 100, 500]),
+    )
+    def test_probs_topk(self, batch_size, vocab_size, k):
+        if k > vocab_size:
+            pytest.skip("k should be less than vocab_size")
+        _mem_gate(batch_size, vocab_size)
+        pre = jax.random.uniform(jax.random.PRNGKey(42),
+                                 (batch_size, vocab_size))
+        probs = pre / pre.sum(-1, keepdims=True)
+        direct = fi.sampling.top_k_renorm_probs(probs, k)
+        pipe = LogitsPipe([TopK()], input_type=TensorType.PROBS)
+        out = pipe(probs, top_k=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(direct))
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,k",
+        _sample("lp_vs_topk_l", [1, 99, 989], [111, 32000, 128256],
+                [10, 100, 500]),
+    )
+    def test_logits_topk(self, batch_size, vocab_size, k):
+        if k > vocab_size:
+            pytest.skip("k should be less than vocab_size")
+        _mem_gate(batch_size, vocab_size)
+        logits = jax.random.normal(jax.random.PRNGKey(42),
+                                   (batch_size, vocab_size))
+        direct = fi.sampling.top_k_mask_logits(logits, k)
+        pipe = LogitsPipe([TopK()])  # LOGITS stream -> mask legalization
+        out = pipe(logits, top_k=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(direct))
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size,p",
+        _sample("lp_vs_minp", [1, 99, 989], [111, 32000, 128256],
+                [0.05, 0.2, 0.7]),
+    )
+    def test_minp(self, batch_size, vocab_size, p):
+        _mem_gate(batch_size, vocab_size)
+        pre = jax.random.uniform(jax.random.PRNGKey(42),
+                                 (batch_size, vocab_size))
+        probs = pre / pre.sum(-1, keepdims=True)
+        mp = jnp.full((batch_size,), float(p))
+        pipe = LogitsPipe([MinP()], input_type=TensorType.PROBS)
+        out = np.asarray(pipe(probs, min_p=mp))
+        pn = np.asarray(probs, np.float64)
+        keep = pn >= p * pn.max(-1, keepdims=True)
+        ref = np.where(keep, pn, 0.0)
+        ref = ref / ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize(
+        "batch_size,vocab_size",
+        _sample("lp_sample", [1, 99, 989], [111, 32000, 128256]),
+    )
+    def test_full_pipe_sample(self, batch_size, vocab_size):
+        """End-to-end pipe with Sample: same key as the direct chain
+        gives identical tokens (the reference's cloned-generator check,
+        exact with explicit keys)."""
+        _mem_gate(batch_size, vocab_size)
+        logits = jax.random.normal(jax.random.PRNGKey(42),
+                                   (batch_size, vocab_size))
+        key = jax.random.PRNGKey(9)
+        pipe = LogitsPipe([Temperature(), Softmax(), TopP(), Sample()])
+        toks = pipe(logits, key=key, temperature=0.7, top_p=0.9)
+        probs = fi.sampling.softmax(logits, temperature=0.7)
+        probs = fi.sampling.top_p_renorm_probs(probs, 0.9)
+        direct = fi.sampling.sampling_from_probs(probs, key)
+        assert (np.asarray(toks) == np.asarray(direct)).all()
+        with pytest.raises(ValueError, match="PRNGKey"):
+            pipe(logits, generator=object(), temperature=0.7, top_p=0.9)
+
+
+def test_pipe_validation_errors():
+    """Reference legalization rules: TopP on a LOGITS stream and ops
+    after Sample are validation errors."""
+    with pytest.raises(ValueError, match="Softmax"):
+        LogitsPipe([TopP()])
+    with pytest.raises(ValueError, match="already ended"):
+        LogitsPipe([Softmax(), Sample(), TopP()])
+    with pytest.raises(ValueError, match="input_type"):
+        LogitsPipe([TopP()], input_type="tokens")
