@@ -156,17 +156,35 @@ def _fused_add_rmsnorm_impl(
     return out.reshape(orig_shape), res.reshape(orig_shape)
 
 
+def _norm_parity_kw(name, out, enable_pdl):
+    """Reference-surface kwargs shared by the norm family: ``enable_pdl``
+    is a CUDA launch knob (inert on TPU); ``out=`` preallocation is
+    loudly rejected (functional arrays + donation, docs/migration.md)."""
+    del enable_pdl  # programmatic-dependent-launch: no TPU meaning
+    if out is not None:
+        raise ValueError(
+            f"TPU backend: {name} out= pre-allocated outputs are not "
+            "supported (functional arrays; jit donation replaces "
+            "preallocation)"
+        )
+
+
 @flashinfer_api
+
+
 def rmsnorm(
     x: jax.Array,
     weight: jax.Array,
     eps: float = 1e-6,
     backend: str = "auto",
+    out=None,
+    enable_pdl=None,
 ) -> jax.Array:
     r"""Root-mean-square normalization: ``out = x / sqrt(mean(x^2)+eps) * w``.
 
     Reference: ``flashinfer.norm.rmsnorm`` (flashinfer/norm/, norm.cuh:37).
     """
+    _norm_parity_kw("rmsnorm", out, enable_pdl)
     be = resolve_backend(backend, "rmsnorm")
     rb = _tuned_row_block(
         x.size // x.shape[-1], x.shape[-1], x.dtype, "rmsnorm",
@@ -177,9 +195,11 @@ def rmsnorm(
 
 @flashinfer_api
 def gemma_rmsnorm(
-    x: jax.Array, weight: jax.Array, eps: float = 1e-6, backend: str = "auto"
+    x: jax.Array, weight: jax.Array, eps: float = 1e-6, backend: str = "auto",
+    out=None, enable_pdl=None,
 ) -> jax.Array:
     """Gemma-style RMSNorm: scales by ``(weight + 1)`` (norm.cuh Gemma family)."""
+    _norm_parity_kw("gemma_rmsnorm", out, enable_pdl)
     return _rmsnorm_impl(x, weight, eps, 1.0, resolve_backend(backend, "gemma_rmsnorm"))
 
 
@@ -190,6 +210,7 @@ def fused_add_rmsnorm(
     weight: jax.Array,
     eps: float = 1e-6,
     backend: str = "auto",
+    enable_pdl=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused residual-add + RMSNorm.
 
@@ -197,6 +218,7 @@ def fused_add_rmsnorm(
     — the functional form of the reference's in-place
     ``fused_add_rmsnorm`` (norm.cuh FusedAddRMSNorm).
     """
+    _norm_parity_kw("fused_add_rmsnorm", None, enable_pdl)
     be = resolve_backend(backend, "fused_add_rmsnorm")
     rb = _tuned_row_block(
         x.size // x.shape[-1], x.shape[-1], x.dtype, "fused_add_rmsnorm",
@@ -214,7 +236,9 @@ def gemma_fused_add_rmsnorm(
     weight: jax.Array,
     eps: float = 1e-6,
     backend: str = "auto",
+    enable_pdl=None,
 ) -> Tuple[jax.Array, jax.Array]:
+    _norm_parity_kw("gemma_fused_add_rmsnorm", None, enable_pdl)
     return _fused_add_rmsnorm_impl(
         x, residual, weight, eps, 1.0,
         resolve_backend(backend, "gemma_fused_add_rmsnorm"),

@@ -1,0 +1,236 @@
+"""Migration proof #6: mechanical port of the reference test file
+``/root/reference/tests/utils/test_norm.py`` — the RMSNorm family with
+the reference's own python oracles (llama_rms_norm, gemma_rms_norm,
+fused_add_rms_norm and the fp8-quant forms transcribed to numpy).
+
+Deviations (written reasons):
+- ``specify_out=True`` rows assert the LOUD out= rejection instead of
+  running (preallocation replaced by functional arrays + donation;
+  docs/migration.md) — the contract the reference sub-check exercised.
+- ``enable_pdl``: accepted-inert (CUDA programmatic-dependent-launch has
+  no TPU meaning) — both True/False rows run.
+- ``contiguous=False`` rows run with the same VALUES (jnp arrays are
+  logically contiguous; torch's strided-view distinction has no TPU
+  meaning) — the int64-stride / contiguous-overflow regression tests
+  are skipped wholesale for the same reason.
+- ``rmsnorm_quant``/``fused_add_rmsnorm_quant`` here compute a dynamic
+  per-tensor scale (returned) rather than taking one; the port checks
+  the round-trip against the reference's normed oracle.
+- matrix sampling: shared 1/48 rank sampler; FULL runs everything.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu import norm
+from tests.test_ported_batch_prefill import _sample
+
+
+def llama_rms_norm(x, w, eps=1e-6):
+    xf = np.asarray(x, np.float32)
+    var = (xf ** 2).mean(-1, keepdims=True)
+    return (xf / np.sqrt(var + eps)) * np.asarray(w, np.float32)
+
+
+def gemma_rms_norm(x, w, eps=1e-6):
+    xf = np.asarray(x, np.float32)
+    var = (xf ** 2).mean(-1, keepdims=True)
+    return (xf / np.sqrt(var + eps)) * (1.0 + np.asarray(w, np.float32))
+
+
+def fused_add_rms_norm(x, residual, w, eps=1e-6):
+    xf = np.asarray(x, np.float32) + np.asarray(residual, np.float32)
+    var = (xf ** 2).mean(-1, keepdims=True)
+    return (xf / np.sqrt(var + eps)) * np.asarray(w, np.float32), xf
+
+
+_BATCHES = [1, 19, 99, 989]
+_HIDDENS = [111, 500, 1024, 3072, 3584, 4096, 8192, 16384]
+
+
+def _x_w(batch_size, hidden_size, dtype, contiguous, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    if contiguous:
+        x = jax.random.normal(keys[0], (batch_size, hidden_size), dtype)
+    else:
+        # reference builds a wider buffer and slices; values identical
+        # (jnp slices copy — the stride distinction has no TPU meaning)
+        x = jax.random.normal(
+            keys[0], (batch_size, hidden_size * 2), dtype)[:, :hidden_size]
+    w = jax.random.normal(keys[1], (hidden_size,), dtype)
+    return x, w, keys[2]
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,specify_out,enable_pdl,contiguous",
+    _sample("norm", _BATCHES, _HIDDENS, [jnp.float16], [True, False],
+            [True, False], [True, False], specials=[(3, True)]),
+)
+def test_norm(batch_size, hidden_size, dtype, specify_out, enable_pdl,
+              contiguous):
+    """Reference test_norm (test_norm.py:102-127)."""
+    x, w, _ = _x_w(batch_size, hidden_size, dtype, contiguous)
+    if specify_out:
+        with pytest.raises(ValueError, match="out="):
+            norm.rmsnorm(x, w, out=jnp.empty_like(x),
+                         enable_pdl=enable_pdl)
+        return
+    y = norm.rmsnorm(x, w, enable_pdl=enable_pdl)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), llama_rms_norm(x, w),
+        rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,enable_pdl,contiguous",
+    _sample("norm_quant", _BATCHES, _HIDDENS,
+            [jnp.float16, jnp.bfloat16], [True, False], [True, False]),
+)
+def test_norm_quant(batch_size, hidden_size, dtype, enable_pdl,
+                    contiguous):
+    """Reference test_norm_quant (test_norm.py:130-156), dynamic-scale
+    round-trip form: q * scale must reproduce the normed oracle."""
+    x, w, _ = _x_w(batch_size, hidden_size, dtype, contiguous, seed=1)
+    q, scale = fi.rmsnorm_quant(x, w)
+    assert q.dtype == jnp.float8_e4m3fn
+    back = np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+    ref = llama_rms_norm(x, w)
+    np.testing.assert_allclose(back, ref, rtol=0.15,
+                               atol=0.1 * np.abs(ref).max())
+
+
+@pytest.mark.parametrize(
+    "batch_size,num_heads,head_dim,dtype",
+    _sample("qknorm", _BATCHES, [4, 7, 16], [64, 128, 256, 512],
+            [jnp.float16]),
+)
+def test_qknorm(batch_size, num_heads, head_dim, dtype):
+    """Reference test_qknorm (test_norm.py:159-187): 3-D [B, H, D]
+    inputs through rmsnorm (per-head rows) and the fused qk entry."""
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(keys[0], (batch_size, num_heads, head_dim),
+                          dtype)
+    k = jax.random.normal(keys[1], (batch_size, num_heads, head_dim),
+                          dtype)
+    w = jax.random.normal(keys[2], (head_dim,), dtype)
+    y = norm.rmsnorm(q, w)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), llama_rms_norm(q, w),
+        rtol=1e-2, atol=1e-2)
+    qn, kn = norm.qk_rmsnorm(q, k, w, w)
+    np.testing.assert_allclose(
+        np.asarray(qn, np.float32), llama_rms_norm(q, w),
+        rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(kn, np.float32), llama_rms_norm(k, w),
+        rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,enable_pdl,contiguous",
+    _sample("fused_add", _BATCHES, _HIDDENS, [jnp.float16],
+            [True, False], [True, False]),
+)
+def test_fused_add_rmsnorm(batch_size, hidden_size, dtype, enable_pdl,
+                           contiguous):
+    """Reference test_fused_add_rmsnorm (test_norm.py:190-221),
+    functional form: (normed, new_residual) returned instead of
+    in-place mutation."""
+    x, w, kr = _x_w(batch_size, hidden_size, dtype, contiguous, seed=3)
+    residual = jax.random.normal(kr, (batch_size, hidden_size), dtype)
+    y, res = norm.fused_add_rmsnorm(x, residual, w,
+                                    enable_pdl=enable_pdl)
+    y_ref, res_ref = fused_add_rms_norm(x, residual, w)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(res, np.float32), res_ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,contiguous",
+    _sample("gemma", _BATCHES, _HIDDENS, [jnp.float16], [True, False]),
+)
+def test_gemma_norm(batch_size, hidden_size, dtype, contiguous):
+    """Reference test_gemma_norm (test_norm.py:268-300)."""
+    x, w, _ = _x_w(batch_size, hidden_size, dtype, contiguous, seed=4)
+    y = norm.gemma_rmsnorm(x, w)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), gemma_rms_norm(x, w),
+        rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,contiguous",
+    _sample("gemma_fused", _BATCHES, _HIDDENS, [jnp.float16],
+            [True, False]),
+)
+def test_gemma_fused_add_rmsnorm(batch_size, hidden_size, dtype,
+                                 contiguous):
+    """Reference test_gemma_fused_add_rmsnorm (test_norm.py:303-334)."""
+    x, w, kr = _x_w(batch_size, hidden_size, dtype, contiguous, seed=5)
+    residual = jax.random.normal(kr, (batch_size, hidden_size), dtype)
+    y, res = norm.gemma_fused_add_rmsnorm(x, residual, w)
+    xf = np.asarray(x, np.float32) + np.asarray(residual, np.float32)
+    var = (xf ** 2).mean(-1, keepdims=True)
+    y_ref = (xf / np.sqrt(var + 1e-6)) * (
+        1.0 + np.asarray(w, np.float32))
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(res, np.float32), xf,
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype",
+    _sample("layernorm", _BATCHES, _HIDDENS, [jnp.float16]),
+)
+def test_layernorm(batch_size, hidden_size, dtype):
+    """Reference test_layernorm (test_norm.py:337-348)."""
+    eps = 1e-6
+    keys = jax.random.split(jax.random.PRNGKey(6), 3)
+    x = jax.random.normal(keys[0], (batch_size, hidden_size), dtype)
+    gamma = jax.random.normal(keys[1], (hidden_size,), jnp.float32)
+    beta = jax.random.normal(keys[2], (hidden_size,), jnp.float32)
+    out = norm.layernorm(x, gamma, beta, eps)
+    xf = np.asarray(x, np.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    ref = (xf - mu) / np.sqrt(var + eps) * np.asarray(gamma) + \
+        np.asarray(beta)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,hidden_size,dtype,quant_scale_seed",
+    _sample("fused_add_quant", _BATCHES, _HIDDENS,
+            [jnp.float16, jnp.bfloat16], [7]),
+)
+def test_fused_add_rmsnorm_quant(batch_size, hidden_size, dtype,
+                                 quant_scale_seed):
+    """Reference test_fused_add_rmsnorm_quant (test_norm.py:224-265),
+    dynamic-scale round-trip form: q * scale reproduces the fused-add
+    normed oracle and new_residual is x + residual."""
+    x, w, kr = _x_w(batch_size, hidden_size, dtype, True,
+                    seed=quant_scale_seed)
+    residual = jax.random.normal(kr, (batch_size, hidden_size), dtype)
+    q, scale, res = fi.fused_add_rmsnorm_quant(x, residual, w)
+    assert q.dtype == jnp.float8_e4m3fn
+    y_ref, res_ref = fused_add_rms_norm(x, residual, w)
+    back = np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+    np.testing.assert_allclose(back, y_ref, rtol=0.15,
+                               atol=0.1 * np.abs(y_ref).max())
+    np.testing.assert_allclose(np.asarray(res, np.float32), res_ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_stride_regressions_not_applicable():
+    """The reference's int64-stride / contiguous-overflow regression
+    suite (test_norm.py:373-710) pins CUDA kernel stride arithmetic on
+    >4GB strided views; jnp arrays are logically contiguous and XLA owns
+    layout, so the failure mode cannot exist — recorded here so the
+    skip is a written decision, not an omission."""
