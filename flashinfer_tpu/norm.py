@@ -170,15 +170,13 @@ def _norm_parity_kw(name, out, enable_pdl):
 
 
 @flashinfer_api
-
-
 def rmsnorm(
     x: jax.Array,
     weight: jax.Array,
     eps: float = 1e-6,
-    backend: str = "auto",
     out=None,
     enable_pdl=None,
+    backend: str = "auto",
 ) -> jax.Array:
     r"""Root-mean-square normalization: ``out = x / sqrt(mean(x^2)+eps) * w``.
 
@@ -195,8 +193,8 @@ def rmsnorm(
 
 @flashinfer_api
 def gemma_rmsnorm(
-    x: jax.Array, weight: jax.Array, eps: float = 1e-6, backend: str = "auto",
-    out=None, enable_pdl=None,
+    x: jax.Array, weight: jax.Array, eps: float = 1e-6, out=None,
+    enable_pdl=None, backend: str = "auto",
 ) -> jax.Array:
     """Gemma-style RMSNorm: scales by ``(weight + 1)`` (norm.cuh Gemma family)."""
     _norm_parity_kw("gemma_rmsnorm", out, enable_pdl)
@@ -209,8 +207,8 @@ def fused_add_rmsnorm(
     residual: jax.Array,
     weight: jax.Array,
     eps: float = 1e-6,
-    backend: str = "auto",
     enable_pdl=None,
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused residual-add + RMSNorm.
 
@@ -235,8 +233,8 @@ def gemma_fused_add_rmsnorm(
     residual: jax.Array,
     weight: jax.Array,
     eps: float = 1e-6,
-    backend: str = "auto",
     enable_pdl=None,
+    backend: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     _norm_parity_kw("gemma_fused_add_rmsnorm", None, enable_pdl)
     return _fused_add_rmsnorm_impl(
