@@ -18,6 +18,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from flashinfer_tpu.api_logging import flashinfer_api
+from flashinfer_tpu.norm import _norm_parity_kw as _act_parity_kw
+
 
 def _split_gate_up(x: jax.Array):
     d = x.shape[-1] // 2
@@ -25,27 +28,45 @@ def _split_gate_up(x: jax.Array):
 
 
 @jax.jit
-def silu_and_mul(x: jax.Array) -> jax.Array:
-    """``silu(x[..., :d]) * x[..., d:]`` (reference flashinfer/activation.py)."""
+def _silu_and_mul(x: jax.Array) -> jax.Array:
     gate, up = _split_gate_up(x)
     gf = gate.astype(jnp.float32)
     return (jax.nn.silu(gf) * up.astype(jnp.float32)).astype(x.dtype)
 
 
+@flashinfer_api
+def silu_and_mul(x: jax.Array, out=None, enable_pdl=None) -> jax.Array:
+    """``silu(x[..., :d]) * x[..., d:]`` (reference flashinfer/activation.py)."""
+    _act_parity_kw("silu_and_mul", out, enable_pdl)
+    return _silu_and_mul(x)
+
+
 @jax.jit
-def gelu_and_mul(x: jax.Array) -> jax.Array:
-    """Exact-erf GeLU gated multiply."""
+def _gelu_and_mul(x: jax.Array) -> jax.Array:
     gate, up = _split_gate_up(x)
     gf = gate.astype(jnp.float32)
     return (jax.nn.gelu(gf, approximate=False) * up.astype(jnp.float32)).astype(x.dtype)
 
 
+@flashinfer_api
+def gelu_and_mul(x: jax.Array, out=None, enable_pdl=None) -> jax.Array:
+    """Exact-erf GeLU gated multiply."""
+    _act_parity_kw("gelu_and_mul", out, enable_pdl)
+    return _gelu_and_mul(x)
+
+
 @jax.jit
-def gelu_tanh_and_mul(x: jax.Array) -> jax.Array:
-    """tanh-approximated GeLU gated multiply."""
+def _gelu_tanh_and_mul(x: jax.Array) -> jax.Array:
     gate, up = _split_gate_up(x)
     gf = gate.astype(jnp.float32)
     return (jax.nn.gelu(gf, approximate=True) * up.astype(jnp.float32)).astype(x.dtype)
+
+
+@flashinfer_api
+def gelu_tanh_and_mul(x: jax.Array, out=None, enable_pdl=None) -> jax.Array:
+    """tanh-approximated GeLU gated multiply."""
+    _act_parity_kw("gelu_tanh_and_mul", out, enable_pdl)
+    return _gelu_tanh_and_mul(x)
 
 
 @functools.partial(jax.jit, static_argnames=("quant_dtype",))

@@ -1,0 +1,68 @@
+"""Migration proof #8: mechanical port of the reference test file
+``/root/reference/tests/utils/test_activation.py`` — the gated
+activation family on the reference's matrices.  Gate-half convention
+matches the reference (act on the FIRST half, multiply the second).
+``enable_pdl`` rows run inert; sampled by the shared 1/48 rank sampler
+(FULL for all)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import norm as _scipy_norm  # exact-erf gelu oracle
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample
+
+_MATRIX = ([128, 256, 512, 2048, 4096, 11008, 16384],
+           [1, 2, 4, 8, 16], [1, 2, 4, 8, 16, 32, 64, 128, 512],
+           [True, False])
+
+
+def _x(dim, batch_size, seq_len, seed):
+    return jax.random.normal(
+        jax.random.PRNGKey(seed), (batch_size, seq_len, 2 * dim),
+        jnp.float16)
+
+
+@pytest.mark.parametrize(
+    "dim,batch_size,seq_len,enable_pdl", _sample("silu", *_MATRIX))
+def test_fused_silu_mul(dim, batch_size, seq_len, enable_pdl):
+    x = _x(dim, batch_size, seq_len, 0)
+    xf = np.asarray(x, np.float32)
+    y_ref = xf[..., dim:] * (xf[..., :dim] /
+                             (1 + np.exp(-xf[..., :dim])))
+    y = fi.silu_and_mul(x, enable_pdl=enable_pdl)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "dim,batch_size,seq_len,enable_pdl", _sample("gelu_tanh", *_MATRIX))
+def test_fused_gelu_tanh_mul(dim, batch_size, seq_len, enable_pdl):
+    x = _x(dim, batch_size, seq_len, 1)
+    xf = np.asarray(x, np.float32)
+    g = xf[..., :dim]
+    inner = np.sqrt(2 / np.pi) * (g + 0.044715 * g ** 3)
+    y_ref = xf[..., dim:] * (0.5 * g * (1 + np.tanh(inner)))
+    y = fi.gelu_tanh_and_mul(x, enable_pdl=enable_pdl)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize(
+    "dim,batch_size,seq_len,enable_pdl", _sample("gelu", *_MATRIX))
+def test_fused_gelu_mul(dim, batch_size, seq_len, enable_pdl):
+    x = _x(dim, batch_size, seq_len, 2)
+    xf = np.asarray(x, np.float32)
+    g = xf[..., :dim].astype(np.float64)
+    y_ref = xf[..., dim:].astype(np.float64) * g * _scipy_norm.cdf(g)
+    y = fi.gelu_and_mul(x, enable_pdl=enable_pdl)
+    np.testing.assert_allclose(np.asarray(y, np.float64), y_ref,
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_out_rejected():
+    x = _x(128, 1, 1, 3)
+    with pytest.raises(ValueError, match="out="):
+        fi.silu_and_mul(x, out=jnp.empty((1, 1, 128), jnp.float16))
