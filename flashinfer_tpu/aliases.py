@@ -34,6 +34,7 @@ from flashinfer_tpu.prefill import BatchPrefillWithPagedKVCacheWrapper
 from flashinfer_tpu.utils import fold_scalar_scale
 
 _LOG2E = math.log2(math.e)
+_warned_default_scale = False  # one-shot contract-change warning
 
 
 def _scalar(x, name: str) -> Optional[float]:
@@ -81,13 +82,7 @@ def _out_dtype(out_dtype, query, name: str):
     return dt
 
 
-def _reject(name: str, **kw):
-    for k, v in kw.items():
-        if v is not None and v is not False:
-            raise ValueError(
-                f"TPU backend: {name} does not implement {k}; see the "
-                "docstring for the supported surface and alternatives"
-            )
+from flashinfer_tpu.utils import reject_unsupported as _reject  # noqa: E402
 
 
 def _split_kv(kv_cache, name: str):
@@ -298,6 +293,22 @@ def trtllm_batch_decode_with_kv_cache(
             skip_softmax_threshold_scale_factor=(
                 skip_softmax_threshold_scale_factor),
             enable_block_sparse_attention=enable_block_sparse_attention)
+    if sm_scale is None and bmm1_scale_log2 is None \
+            and isinstance(bmm1_scale, float) and bmm1_scale == 1.0:
+        global _warned_default_scale
+        if not _warned_default_scale:
+            _warned_default_scale = True
+            import warnings
+
+            warnings.warn(
+                "trtllm_batch_decode_with_kv_cache: bmm1_scale left at "
+                "its reference default 1.0 — it is the COMPLETE softmax "
+                "scale (1/sqrt(head_dim) is NOT applied implicitly). "
+                "Pass bmm1_scale=q_scale*k_scale/sqrt(head_dim) (or the "
+                "TPU keyword sm_scale=) if you relied on the pre-parity "
+                "implicit default. docs/migration.md",
+                stacklevel=2,
+            )
     k_cache, v_cache = _split_kv(kv_cache, name)
     tables = _shared_tables(block_tables, uses_shared_paged_kv_idx, name)
     sm = (float(sm_scale) if sm_scale is not None

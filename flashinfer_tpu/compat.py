@@ -324,13 +324,15 @@ def _shared_prefix_wrapper(base):
         same math MultiLevelCascadeAttentionWrapper runs per level)."""
 
         def plan(self, *args, **kw):
-            # stash the geometry so forward(..., causal=) can RE-plan
-            # exactly once when the flag changes (the reference passes
-            # causal at forward time for the prefill variant); stashing
-            # here (not in begin_forward) also covers callers using the
-            # modern plan() spelling
-            self._bf_args, self._bf_kw = args, dict(kw)
-            self._planned_causal = bool(kw.get("causal", False))
+            # stash the geometry NAME-BOUND (a positional causal binds
+            # correctly) so forward(...) can RE-plan exactly once when
+            # causal or a scale override changes; stashing here (not in
+            # begin_forward) also covers the modern plan() spelling
+            bound = _ins.signature(base.plan).bind(self, *args, **kw)
+            stash = {k: v for k, v in bound.arguments.items()
+                     if k != "self"}
+            stash.update(stash.pop("_unused", {}) or {})
+            self._bf_kw = stash
             return base.plan(self, *args, **kw)
 
         begin_forward = plan  # legacy lifecycle name
@@ -342,23 +344,33 @@ def _shared_prefix_wrapper(base):
                 raise TypeError(
                     f"shared-prefix forward: unsupported kwargs "
                     f"{sorted(kw)}")
+            if not hasattr(self, "_bf_kw"):
+                raise RuntimeError(
+                    "shared-prefix wrapper: call begin_forward()/plan() "
+                    "before forward()")
             from flashinfer_tpu.ops.merge import merge_state
             from flashinfer_tpu.prefill import (
                 single_prefill_with_kv_cache,
             )
 
-            if "causal" in _ins.signature(base.plan).parameters \
-                    and causal != self._planned_causal:
-                base.plan(self, *self._bf_args,
-                          **{**self._bf_kw, "causal": causal})
-                self._planned_causal = causal
-            # BOTH halves must use the planned logits math — merging
+            # BOTH halves must use the same logits math — merging
             # states computed under different scales is numerically
-            # wrong
+            # wrong — so ANY override (causal flag, sm_scale,
+            # logits_soft_cap) RE-plans the unique half to match and
+            # the shared half reads the resulting plan
+            want = dict(self._bf_kw)
+            if "causal" in _ins.signature(base.plan).parameters \
+                    and causal != bool(want.get("causal", False)):
+                want["causal"] = causal
+            if sm_scale is not None:
+                want["sm_scale"] = sm_scale
+            if logits_soft_cap is not None:
+                want["logits_soft_cap"] = logits_soft_cap
+            if want != self._bf_kw:
+                self.plan(**want)
             plan = self._plan
-            sm = sm_scale if sm_scale is not None else plan.sm_scale
-            cap = (logits_soft_cap if logits_soft_cap is not None
-                   else plan.logits_soft_cap)
+            sm = plan.sm_scale
+            cap = plan.logits_soft_cap
             # shared prefix: every query row attends the WHOLE prefix
             # (non-causal by construction — the prefix precedes all);
             # single_prefill dispatches to the flash backend rather than

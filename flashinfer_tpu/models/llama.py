@@ -451,6 +451,42 @@ def make_cp_prefill_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     )
 
 
+def _make_pp_layer_runner(cfg, qh_l, kvh_l, tp):
+    """Per-stage layer scan shared by the sequential and microbatched pp
+    steps (one definition — a numerics fix must reach both schedules)."""
+
+    def run_local_layers(layers, x, caches, page_table, kv_lens, positions):
+        use_pallas = is_tpu()
+
+        def body(x, inp):
+            layer, kc, vc = inp
+            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
+            attn, (kc2, vc2) = _attn_decode(
+                h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
+                qh_l, kvh_l, use_pallas,
+            )
+            o_partial = _mm(attn, layer, "o_proj")
+            h2, x2 = allreduce_fusion(
+                o_partial, residual=x, rms_weight=layer["post_norm"],
+                eps=cfg.rms_eps, axis=tp,
+            )
+            h2 = h2.astype(cfg.dtype)
+            _pq2 = _pre_quant(h2, layer, "gate_proj")
+            mlp_in = jnp.concatenate(
+                [_mm(h2, layer, "gate_proj", _pq2),
+                 _mm(h2, layer, "up_proj", _pq2)], -1
+            )
+            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
+            (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
+            return x3, (kc2, vc2)
+
+        kcs, vcs = caches
+        x, (kcs2, vcs2) = jax.lax.scan(body, x, (layers, kcs, vcs))
+        return x, (kcs2, vcs2)
+
+    return run_local_layers
+
+
 def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     """dp x tp x pp sharded decode step.
 
@@ -485,35 +521,7 @@ def make_pp_sharded_decode_step(mapping: Mapping, cfg: LlamaConfig, mesh=None):
     in_specs = (param_specs, P(dp), P(dp), cache_spec, P(dp, None), P(dp))
     out_specs = (P(dp, tp), cache_spec)
 
-    def run_local_layers(layers, x, caches, page_table, kv_lens, positions):
-        """Scan this stage's layers over the activation."""
-        use_pallas = is_tpu()
-
-        def body(x, inp):
-            layer, kc, vc = inp
-            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
-            attn, (kc2, vc2) = _attn_decode(
-                h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
-                qh_l, kvh_l, use_pallas,
-            )
-            o_partial = _mm(attn, layer, "o_proj")
-            h2, x2 = allreduce_fusion(
-                o_partial, residual=x, rms_weight=layer["post_norm"],
-                eps=cfg.rms_eps, axis=tp,
-            )
-            h2 = h2.astype(cfg.dtype)
-            _pq2 = _pre_quant(h2, layer, "gate_proj")
-            mlp_in = jnp.concatenate(
-                [_mm(h2, layer, "gate_proj", _pq2),
-                 _mm(h2, layer, "up_proj", _pq2)], -1
-            )
-            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
-            (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
-            return x3, (kc2, vc2)
-
-        kcs, vcs = caches
-        x, (kcs2, vcs2) = jax.lax.scan(body, x, (layers, kcs, vcs))
-        return x, (kcs2, vcs2)
+    run_local_layers = _make_pp_layer_runner(cfg, qh_l, kvh_l, tp)
 
     def step(params, tokens, positions, kv_caches, page_table, kv_lens):
         my_stage = jax.lax.axis_index(pp)
@@ -595,34 +603,7 @@ def make_pp_microbatch_decode_step(
     in_specs = (param_specs, P(dp), P(dp), cache_spec, P(dp, None), P(dp))
     out_specs = (P(dp, tp), cache_spec)
 
-    def run_local_layers(layers, x, caches, page_table, kv_lens, positions):
-        use_pallas = is_tpu()
-
-        def body(x, inp):
-            layer, kc, vc = inp
-            h = rmsnorm(x, layer["input_norm"], cfg.rms_eps)
-            attn, (kc2, vc2) = _attn_decode(
-                h, layer, cfg, (kc, vc), page_table, kv_lens, positions,
-                qh_l, kvh_l, use_pallas,
-            )
-            o_partial = _mm(attn, layer, "o_proj")
-            h2, x2 = allreduce_fusion(
-                o_partial, residual=x, rms_weight=layer["post_norm"],
-                eps=cfg.rms_eps, axis=tp,
-            )
-            h2 = h2.astype(cfg.dtype)
-            _pq2 = _pre_quant(h2, layer, "gate_proj")
-            mlp_in = jnp.concatenate(
-                [_mm(h2, layer, "gate_proj", _pq2),
-                 _mm(h2, layer, "up_proj", _pq2)], -1
-            )
-            d_partial = _mm(silu_and_mul(mlp_in), layer, "down_proj")
-            (x3,) = allreduce_fusion(d_partial, residual=x2, axis=tp)
-            return x3, (kc2, vc2)
-
-        kcs, vcs = caches
-        x, (kcs2, vcs2) = jax.lax.scan(body, x, (layers, kcs, vcs))
-        return x, (kcs2, vcs2)
+    run_local_layers = _make_pp_layer_runner(cfg, qh_l, kvh_l, tp)
 
     def step(params, tokens, positions, kv_caches, page_table, kv_lens):
         my_stage = jax.lax.axis_index(pp)

@@ -228,6 +228,20 @@ def canonicalize_dtype(dtype: Any) -> jnp.dtype:
     return jnp.dtype(dtype)
 
 
+def reject_unsupported(name: str, **kw) -> None:
+    """Shared loud-rejection helper for reference-surface adapters: any
+    kwarg that arrived non-None/non-False names a semantic this backend
+    does not implement — never silently dropped.  (compat_calls.py keeps
+    its own numerics-specific variant with a richer message; both exist
+    to enforce the same no-silent-drops policy.)"""
+    for k, v in kw.items():
+        if v is not None and v is not False:
+            raise ValueError(
+                f"TPU backend: {name} does not implement {k}; see the "
+                "docstring for the supported surface and alternatives"
+            )
+
+
 def fold_scalar_scale(x, name: str) -> Optional[float]:
     """Fold a float-or-single-element-tensor scale to a Python float;
     non-scalar tensors (per-head / per-block fp8 scale factors) are a

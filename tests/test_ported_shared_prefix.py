@@ -270,3 +270,59 @@ def test_shared_prefix_causal_toggle_and_planned_scale():
     # kwargs are not silently swallowed
     with pytest.raises(TypeError, match="unsupported"):
         w.forward(q, k_s, v_s, kv, bogus_flag=True)
+
+
+def test_shared_prefix_forward_scale_override_replans():
+    """Round-5 high-sweep pin: a forward-time sm_scale override must
+    reach BOTH merged halves (it re-plans the unique half), positional
+    causal in plan() binds correctly, and forward before plan raises
+    actionably."""
+    B, U, S, H, D, PS = 2, 8, 16, 4, 64, 8
+    keys = jax.random.split(jax.random.PRNGKey(3), 5)
+    q = jax.random.normal(keys[0], (B * U, H, D), jnp.float16)
+    q_indptr = np.arange(0, B + 1, dtype=np.int32) * U
+    k_s = jax.random.normal(keys[1], (S, H, D), jnp.float16)
+    v_s = jax.random.normal(keys[2], (S, H, D), jnp.float16)
+    pages_u = B * ceil_div(U, PS)
+    kv = jnp.zeros((ceil_div(S, PS) + pages_u, 2, PS, H, D), jnp.float16)
+    s_idx = np.arange(ceil_div(S, PS), dtype=np.int32)
+    s_indptr = np.arange(0, 2, dtype=np.int32) * ceil_div(S, PS)
+    s_last = np.full((1,), (S - 1) % PS + 1, np.int32)
+    kv = fi.append_paged_kv_cache(
+        k_s, v_s,
+        *fi.get_batch_indices_positions(
+            np.arange(0, 2, dtype=np.int32) * S,
+            fi.get_seq_lens(s_indptr, s_last, PS), S),
+        kv, s_idx, s_indptr, s_last, "NHD")
+    k_u = jax.random.normal(keys[3], (B * U, H, D), jnp.float16)
+    v_u = jax.random.normal(keys[4], (B * U, H, D), jnp.float16)
+    u_idx = np.arange(pages_u, dtype=np.int32) + ceil_div(S, PS)
+    u_indptr = np.arange(0, B + 1, dtype=np.int32) * ceil_div(U, PS)
+    u_last = np.full((B,), (U - 1) % PS + 1, np.int32)
+    kv = fi.append_paged_kv_cache(
+        k_u, v_u,
+        *fi.get_batch_indices_positions(
+            np.arange(0, B + 1, dtype=np.int32) * U,
+            fi.get_seq_lens(u_indptr, u_last, PS), B * U),
+        kv, u_idx, u_indptr, u_last, "NHD")
+
+    # forward before plan: actionable error, not AttributeError
+    w0 = fi.BatchPrefillWithSharedPrefixPagedKVCacheWrapper(None, "NHD")
+    with pytest.raises(RuntimeError, match="begin_forward"):
+        w0.forward(q, k_s, v_s, kv)
+
+    # positional causal=True in plan binds correctly
+    w = fi.BatchPrefillWithSharedPrefixPagedKVCacheWrapper(None, "NHD")
+    w.plan(q_indptr, u_indptr, u_idx, u_last, H, H, D, PS, True)
+    o_default = w.forward(q, k_s, v_s, kv, causal=True)
+    # forward sm_scale override == planning with that scale up front
+    o_override = w.forward(q, k_s, v_s, kv, causal=True, sm_scale=0.05)
+    w2 = fi.BatchPrefillWithSharedPrefixPagedKVCacheWrapper(None, "NHD")
+    w2.begin_forward(q_indptr, u_indptr, u_idx, u_last, H, H, D, PS,
+                     sm_scale=0.05)
+    o_planned = w2.forward(q, k_s, v_s, kv, causal=True)
+    np.testing.assert_allclose(np.asarray(o_override, np.float32),
+                               np.asarray(o_planned, np.float32),
+                               rtol=1e-3, atol=1e-3)
+    assert not np.allclose(np.asarray(o_override, np.float32),
+                           np.asarray(o_default, np.float32), atol=1e-3)
