@@ -169,12 +169,18 @@ def _gdn_chunk_kernel(
     o_ref[...] = o.astype(o_ref.dtype)
 
     # boundary state: S' = Dtot S + sum_j (Dtot / D_j) k_j u_j^T
-    dtot = jnp.exp(acum[Q - 1 : Q, 0:1])  # [1, 1] scalar
     ratio = jnp.exp(
         jnp.broadcast_to(acum[Q - 1 : Q, 0:1], (Q, 1)) - acum
     )  # [Q, 1] = Dtot / D_j  (non-positive exponents: j <= last)
     wk = ratio * kf  # [Q, dk]
-    s_new = dtot * s0 + jax.lax.dot_general(
+    # two-stage broadcast of the [1, 1] Dtot: (1,1)->(dk,1) sublane-only,
+    # then the multiply lane-broadcasts against (dk,dv) -- Mosaic has no
+    # fused sublane+lane broadcast ("Not implemented: Broadcast in both
+    # sublanes and lanes", banked 2026-07-31)
+    dtot_col = jnp.exp(
+        jnp.broadcast_to(acum[Q - 1 : Q, 0:1], (s0.shape[0], 1))
+    )
+    s_new = dtot_col * s0 + jax.lax.dot_general(
         wk, u, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )

@@ -117,7 +117,13 @@ def _ssd_chunk_kernel(
     # w_j = exp(a_total - acum_j) dt_j   (non-positive exponents)
     a_total = acum[Q - 1 : Q, 0:1]  # [1, 1]
     w = jnp.exp(jnp.broadcast_to(a_total, (Q, 1)) - acum) * dt  # [Q, 1]
-    s_new = jnp.exp(a_total) * s0 + jax.lax.dot_general(
+    # two-stage broadcast of the [1, 1] total decay: (1,1)->(dim,1) is a
+    # sublane-only broadcast and the multiply lane-broadcasts (dim,1)
+    # against (dim,ds) -- Mosaic has no fused sublane+lane broadcast
+    # ("Not implemented: Broadcast in both sublanes and lanes", banked
+    # 2026-07-31)
+    dtot_col = jnp.exp(jnp.broadcast_to(a_total, (s0.shape[0], 1)))
+    s_new = dtot_col * s0 + jax.lax.dot_general(
         w * xf, bf, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )

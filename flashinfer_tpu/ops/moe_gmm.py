@@ -372,29 +372,39 @@ def gather_gmm(
     out_dtype=None,
     variant: str = "auto",
 ):
-    """Fused gather + grouped matmul: ``gmm(x[row_ids], ...)`` without the
-    ``[M, K]`` sorted copy ever touching HBM.
+    """Fused gather + grouped matmul: ``gmm(x[row_ids], ...)``.
 
     ``variant``:
 
+    - ``"sorted"``: XLA gathers the ``[M, K]`` sorted copy, then the
+      tiled GMM kernel (:func:`gmm`) runs over it — the megablox-proven
+      form, and the ONLY variant this chip generation's Mosaic compiles
+      (see below);
     - ``"rowcache"``: tile-outermost grid, whole rows DMA'd once per tile
       into a [tm, K] VMEM buffer (gather traffic ``M * K``, tm DMAs of K
       bytes per tile) — see :func:`_gather_gmm_rowcache_kernel`;
     - ``"stream"``: n-outermost grid, per-(n, k)-step [tk] row slices
-      (gather traffic ``tiles_n * M * K`` in many small DMAs) — kept for
-      A/B benching and as the fallback when a [tm, K] row buffer exceeds
-      the VMEM budget;
-    - ``"auto"``: rowcache when the row buffer fits, else stream.
+      (gather traffic ``tiles_n * M * K`` in many small DMAs);
+    - ``"auto"``: ``"sorted"``.
+
+    Hardware verdict (banked 2026-07-31, BENCH_BANKED.md): Mosaic rejects
+    the in-kernel per-row gather both variants are built on — a single
+    token row is a ``(1, K)`` HBM slice and "Slice shape along dimension
+    0 must be aligned to tiling (8)".  rowcache/stream therefore stay
+    interpret-mode/explicit-opt-in until the compiler relaxes sub-8-row
+    DMA alignment, and ``auto`` resolves to the sorted copy whose extra
+    ``M*K`` HBM round-trip is the price of aligned BlockSpec DMAs.
     """
     k = x.shape[1]
     if variant == "auto":
-        # repo defaults policy (VERDICT r3): defaults flip only on banked
-        # hardware A/B.  The rowcache aliased-output merge is a
-        # HARDWARE-ONLY code path (interpret mode cannot exercise it) and
-        # has never Mosaic-compiled, so auto stays on the streaming
-        # variant until the hw tier + moe bench rows land; rowcache is
-        # explicit opt-in and A/B'd in the bench meanwhile.
-        variant = "stream"
+        variant = "sorted"
+    if variant == "sorted":
+        x_sorted = x[row_ids]
+        lhs_scale = None if x_scale is None else x_scale[row_ids]
+        return gmm(
+            x_sorted, rhs, group_sizes, lhs_scale, rhs_scale,
+            tm=tm, tn=tn, tk=tk, out_dtype=out_dtype,
+        )
     if variant not in ("rowcache", "stream"):
         raise ValueError(f"unknown gather_gmm variant {variant!r}")
     if variant == "rowcache":

@@ -111,7 +111,7 @@ class TestGmm:
 
 
 class TestGatherGmm:
-    @pytest.mark.parametrize("variant", ["stream", "rowcache"])
+    @pytest.mark.parametrize("variant", ["sorted", "stream", "rowcache"])
     @pytest.mark.parametrize("seed", range(3))
     def test_matches_explicit_gather(self, seed, variant):
         rng = np.random.default_rng(seed + 20)
@@ -131,7 +131,7 @@ class TestGatherGmm:
             np.asarray(fused, np.float32), ref, rtol=5e-2, atol=5e-2
         )
 
-    @pytest.mark.parametrize("variant", ["stream", "rowcache"])
+    @pytest.mark.parametrize("variant", ["sorted", "stream", "rowcache"])
     def test_int8_gather(self, variant):
         rng = np.random.default_rng(42)
         t_rows, k, n, e = 64, 256, 256, 3
