@@ -413,11 +413,16 @@ def phase_scans(sweep: bool):
         jax.random.fold_in(key, 13), (B, L, Hg)))
     alpha_k = jnp.exp(-0.05 * jax.random.uniform(
         jax.random.fold_in(key, 14), (B, L, Hg, dk)))
+    # explicit backend="xla": auto now resolves to the pallas kernel on
+    # these eligible shapes (flipped on this A/B's own rows), so the
+    # baseline must pin XLA or the A/B measures the kernel against itself
     variants = [
         ("gdn_prefill",
-         lambda *a: gdn_mod.gdn_chunk_prefill(*a)[0], alpha_g),
+         lambda *a: gdn_mod.gdn_chunk_prefill(*a, backend="xla")[0],
+         alpha_g),
         ("kda_prefill",
-         lambda *a: gdn_mod.kda_chunk_prefill(*a)[0], alpha_k),
+         lambda *a: gdn_mod.kda_chunk_prefill(*a, backend="xla")[0],
+         alpha_k),
     ]
     from flashinfer_tpu.ops import gdn_kernel as _gk
 

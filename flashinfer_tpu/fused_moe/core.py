@@ -86,8 +86,13 @@ def fused_moe(
     - ``"ragged"``: ``jax.lax.ragged_dot`` over materialized sorted rows
       (the XLA fallback, and the oracle for tests).
     - ``"auto"``: env ``FLASHINFER_TPU_MOE_BACKEND`` if set, else
-      ``"ragged"`` until the banked bench says otherwise, with shape
-      gating (gmm needs 128-aligned hidden/inter dims).
+      ``"ragged"`` BY MEASUREMENT: the banked v5e A/B (BENCH_BANKED.md
+      2026-07-31, Mixtral 8x7B shape, T=1024) has ragged_dot at
+      76.0 TFLOP/s int8 / 52.2 bf16 vs the sorted-gather GMM kernel's
+      26.6 / 20.4 — XLA's ragged_dot wins ~2.6-2.9x, so the Pallas pipeline
+      stays opt-in (the in-kernel gather variants additionally do not
+      compile on this Mosaic — see ``ops/moe_gmm.gather_gmm``); shape
+      gating unchanged (gmm needs 128-aligned hidden/inter dims).
 
     Backend resolution happens outside the jitted body so the env var is
     re-read on every *eager* call; a caller that wraps fused_moe in its own

@@ -103,14 +103,25 @@ def gdn_chunk_prefill(
     ``backend="pallas"`` (or env ``FLASHINFER_TPU_GDN_BACKEND=pallas``)
     routes to the fully-fused VMEM-resident kernel
     (``ops/gdn_kernel.py``; chunk 128, 128-aligned dims, normalized-key
-    stability domain — see its docstring); ``"auto"`` stays on this XLA
-    form until the banked bench flips it.
+    stability domain — see its docstring).  ``"auto"`` resolves to the
+    kernel on eligible shapes: the banked v5e A/B (BENCH_BANKED.md
+    2026-07-31, B=4 L=4096 H=16 128x128) measured gdn_prefill_pallas at
+    7121 us vs 10049 us XLA — 1.41x — so the kernel is the default where
+    it applies; ineligible shapes fall back to this XLA form.
+
+    **Numerical domain of the auto default**: the kernel's Neumann-series
+    solve assumes the delta rule's operating regime — L2-NORMALIZED KEYS
+    (the QK-norm every GDN model applies before this op).  Unnormalized
+    keys with coupling magnitudes >> 1 make the underlying recurrence
+    itself chaotic AND can overflow the kernel's intermediate power
+    matrices; such callers (outside any trained-model regime) must pass
+    ``backend="xla"`` explicitly for the back-substituting solve.
     """
     from_env = False
     if backend == "auto":
         import os
 
-        backend = os.environ.get("FLASHINFER_TPU_GDN_BACKEND", "xla")
+        backend = os.environ.get("FLASHINFER_TPU_GDN_BACKEND", "pallas")
         from_env = True
     if backend == "pallas":
         from flashinfer_tpu.ops import gdn_kernel
@@ -318,15 +329,17 @@ def kda_chunk_prefill(
     pair scores assemble from 16-row blocks with boundary-referenced
     history factors (safe at any decay) and midpoint diagonal blocks, so
     the usable per-token decay domain is alpha >= ~0.011 — wider than
-    this chunk-32 XLA form's ~0.02 and far below trained-gate ranges —
-    which is why the env opt-in ``FLASHINFER_TPU_KDA_BACKEND=pallas``
-    is offered like GDN's (earlier rounds' whole-chunk factorization
-    only covered alpha >= ~0.3 and had no env hook)."""
+    this chunk-32 XLA form's ~0.02 and far below trained-gate ranges.
+    ``"auto"`` resolves to the kernel on eligible shapes: the banked v5e
+    A/B (BENCH_BANKED.md 2026-07-31, B=4 L=4096 H=16 128x128) measured
+    kda_prefill_pallas at 8652 us vs 10210 us XLA — 1.18x — and its
+    decay domain is the wider of the two; ineligible shapes fall back to
+    this XLA form."""
     from_env = False
     if backend == "auto":
         import os
 
-        backend = os.environ.get("FLASHINFER_TPU_KDA_BACKEND", "xla")
+        backend = os.environ.get("FLASHINFER_TPU_KDA_BACKEND", "pallas")
         from_env = True
     if backend == "pallas":
         from flashinfer_tpu.ops import gdn_kernel

@@ -134,6 +134,11 @@ def mamba_chunk_scan_combined(
     ``backend="pallas"`` (or env ``FLASHINFER_TPU_MAMBA_BACKEND=pallas``)
     routes to the fused VMEM-resident kernel (``ops/mamba_kernel.py``,
     chunk 128); env-selected auto falls back here on ineligible shapes.
+    ``"auto"`` stays on this XLA form BY MEASUREMENT: the banked v5e A/B
+    (BENCH_BANKED.md 2026-07-31, B=4 L=4096 H=24 dim=64 ds=128) has the
+    kernel at 6565 us vs 2539 us XLA — XLA's SSD lowering wins 2.6x, so
+    the kernel stays opt-in (it exists for shapes/fusions where VMEM
+    residency pays; re-flip only on a banked win).
 
     The sequence splits into chunks of ``chunk_size``; within a chunk the
     recurrence unrolls into an attention-like matmul (MXU work:

@@ -30,12 +30,16 @@ regime — normalized keys (QK-norm, as GDN models apply), so the strict
 couplings ``beta_i R (k_i . k_j)`` are O(1/sqrt(dk)) off-diagonal and
 the series terms decay.  For adversarial unnormalized keys (coupling
 magnitudes >> 1 — a regime where the underlying delta-rule recurrence
-itself diverges) the XLA backend's back-substituting
-``solve_triangular`` remains the robust path and the default.
+itself diverges) the intermediate powers ``C^(2^r)`` can overflow f32,
+so such callers must pass ``backend="xla"`` for the back-substituting
+``solve_triangular`` path.
 
 Validated against the exact recurrence (``gdn.gdn_prefill``) in
-interpret mode (5e-7 max err at L=256, nonzero initial state); opt-in
-(``backend="pallas"``) until hardware-banked.
+interpret mode (5e-7 max err at L=256, nonzero initial state) and on
+hardware (2026-07-31 hw tier).  DEFAULT for eligible shapes since the
+banked 1.41x win over the XLA form (BENCH_BANKED.md 2026-07-31);
+``gdn.gdn_chunk_prefill``'s docstring carries the caller-facing
+domain note.
 """
 
 from __future__ import annotations
@@ -125,6 +129,10 @@ def _gdn_chunk_kernel(
     acum_row = jax.lax.dot_general(
         acum, eye, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        # HIGHEST: carries log-decay exponents — a default bf16 MXU pass
+        # rounds them before the exp (see ops/mamba_kernel.row, banked
+        # 2026-07-31); Q*Q FLOPs, free
+        precision=jax.lax.Precision.HIGHEST,
     )  # [1, Q]
     # R[i, j] = exp(min(acum_i - acum_j, 0)) — the used (lower) triangle
     # has non-positive exponents; the clamp kills upper-triangle overflow

@@ -79,9 +79,14 @@ def _ssd_chunk_kernel(
     ).astype(jnp.float32)
 
     def row(colvec):  # [Q, 1] -> [Q, Q] broadcast of the transposed vector
+        # HIGHEST precision: this dot carries LOG-DECAY EXPONENTS — the
+        # default bf16 MXU pass rounds |acum|~128 by up to ~0.5 absolute,
+        # i.e. e^0.5 ~ 65% after the exp (2026-07-31 hw tier: 6% of SSD
+        # outputs off by up to 2.9).  [Q,1]x[Q,Q] is Q*Q FLOPs — free.
         r = jax.lax.dot_general(
             colvec, eye, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )  # [1, Q]
         return jnp.broadcast_to(r, (Q, Q))
 

@@ -306,7 +306,9 @@ def _fused_prefill_kernel(
         # it rides a constant selector-matrix MXU dot (byte values <= 255
         # are exact in f32); the bit extract is VPU shifts.
         mb = mask_ref.shape[-1]
-        bytes_f = mask_ref[...].astype(jnp.float32)  # [bq, mb]
+        # Mosaic has no direct uint8 -> f32 cast ("Unsupported cast",
+        # banked 2026-07-31 hw tier); widen through int32 first
+        bytes_f = mask_ref[...].astype(jnp.int32).astype(jnp.float32)
         sel = (
             jax.lax.broadcasted_iota(jnp.int32, (mb, chunk_tokens), 1) // 8
             == jax.lax.broadcasted_iota(jnp.int32, (mb, chunk_tokens), 0)

@@ -16,7 +16,11 @@ backends:
   the sort oracle except among entries exactly equal to the k-th value —
   that tie class is cut by lowest index where a sort cuts arbitrarily.
 - ``"auto"``: env ``FLASHINFER_TPU_TOPK_BACKEND`` if set, else ``"xla"``
-  until the banked bench says otherwise.
+  BY MEASUREMENT: the banked v5e A/B (BENCH_BANKED.md 2026-07-31, bs=64
+  vocab=128k) has xla at 1104/7794 us (k=40/2048) vs the threshold
+  kernel's flat ~40.8 ms — ``jax.lax.top_k``'s native lowering wins
+  ~37x, so the bisection kernel stays opt-in for set-semantics
+  consumers; re-flip only on a banked win.
 
 Consumers that treat the result as a SET (sparse-MLA page selection,
 masks) can use either backend; order-sensitive consumers need ``"xla"``.

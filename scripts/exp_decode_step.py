@@ -1,0 +1,69 @@
+"""On-chip experiment: why is gdn_decode_step ~88x slower than
+kda_decode_step (BENCH_SWEEP 2026-07-31: 1837 us vs 20.9 us for identical
+state traffic)?  Hypothesis: the [B,H,1,1] per-head decay broadcasts along
+BOTH minor dims of the [B,H,dk,dv] state tile, which TPU XLA lowers
+pathologically (cf. Mosaic refusing fused sublane+lane broadcasts
+entirely).  Variants:
+
+- base:    alpha[..., None, None] * s            (current form)
+- twostep: broadcast alpha to [B,H,dk] first, then [..., None] * s
+           (sublane-only then lane-only, the mamba/gdn kernel fix)
+- fused:   fold the decay into the k-side einsum operand instead of
+           scaling the state (state never touched by the broadcast)
+
+Run: python scripts/exp_decode_step.py   (real chip; ~1 min)
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from flashinfer_tpu.testing import bench_fn_device  # noqa: E402
+
+B, H, dk, dv = 4, 16, 128, 128
+key = jax.random.PRNGKey(0)
+s0 = jax.random.normal(key, (B, H, dk, dv), jnp.float32)
+q = jax.random.normal(jax.random.fold_in(key, 1), (B, H, dk)) * 0.3
+k = jax.random.normal(jax.random.fold_in(key, 2), (B, H, dk)) * 0.3
+v = jax.random.normal(jax.random.fold_in(key, 3), (B, H, dv))
+alpha = jnp.exp(-0.05 * jax.random.uniform(jax.random.fold_in(key, 4),
+                                           (B, H)))
+beta = jax.nn.sigmoid(jax.random.normal(jax.random.fold_in(key, 5),
+                                        (B, H)))
+
+
+def step(s, a4, kf, vf, qf, b4):
+    s = a4 * s
+    pred = jnp.einsum("bhkv,bhk->bhv", s, kf)
+    s = s + b4 * jnp.einsum("bhk,bhv->bhkv", kf, vf - pred)
+    o = jnp.einsum("bhkv,bhk->bhv", s, qf)
+    return o, s
+
+
+def base(s, qq, kk, vv, aa, bb):
+    return step(s, aa[..., None, None], kk, vv, qq, bb[..., None, None])
+
+
+def twostep(s, qq, kk, vv, aa, bb):
+    a4 = jnp.broadcast_to(aa[..., None], (B, H, dk))[..., None]
+    b4 = jnp.broadcast_to(bb[..., None], (B, H, dk))[..., None]
+    return step(s, a4, kk, vv, qq, b4)
+
+
+def fused(s, qq, kk, vv, aa, bb):
+    # never scale the state: o = a*(q.S) + correction, S' = a*S + ...
+    # requires the same state write anyway -- here decay rides the
+    # [B,H,dk] k/q operands (lane-dim-free broadcasts only)
+    a_k = aa[..., None]  # [B,H,1] -> broadcasts along dk (minor dim only)
+    pred = jnp.einsum("bhkv,bhk->bhv", s, kk) * a_k[..., 0:1]
+    upd = jnp.einsum("bhk,bhv->bhkv", bb[..., None] * kk, vv - pred)
+    s_new = aa[..., None, None] * s + upd
+    o = jnp.einsum("bhkv,bhk->bhv", s_new, qq)
+    return o, s_new
+
+
+for name, fn in (("base", base), ("twostep", twostep), ("fused", fused)):
+    t = bench_fn_device(fn, s0, q, k, v, alpha, beta, repeats=5)
+    gb = 2 * B * H * dk * dv * 4 / 1e9
+    print(f"{name:8s}: {t*1e6:9.1f} us   {gb/t:7.1f} GB/s")
