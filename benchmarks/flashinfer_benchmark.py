@@ -223,11 +223,14 @@ def _rows_gdn(args):
     a_k = jnp.exp(-0.05 * jax.random.uniform(
         jax.random.fold_in(key, 5), (B, L, H, dk)))
     flops = 2 * B * L * H * dk * dv * 2
+    # backend="xla" pins the reference form: auto resolves to the pallas
+    # kernel on these eligible shapes since the 2026-07-31 default flip,
+    # and these rows are banked against XLA-form history
     for name, fn, aa in (
         ("gdn_prefill",
-         lambda *a: gdn_chunk_prefill(*a)[0], a_g),
+         lambda *a: gdn_chunk_prefill(*a, backend="xla")[0], a_g),
         ("kda_prefill",
-         lambda *a: kda_chunk_prefill(*a)[0], a_k),
+         lambda *a: kda_chunk_prefill(*a, backend="xla")[0], a_k),
     ):
         t = _bench(args, fn, q, k, v, aa, beta)
         yield dict(routine=name, config=f"B{B}_L{L}_H{H}",

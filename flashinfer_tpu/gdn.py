@@ -116,6 +116,10 @@ def gdn_chunk_prefill(
     itself chaotic AND can overflow the kernel's intermediate power
     matrices; such callers (outside any trained-model regime) must pass
     ``backend="xla"`` explicitly for the back-substituting solve.
+
+    **Primal-only**: the kernel defines no AD rule (this is an inference
+    library, matching the reference's inference-only kernel scope);
+    differentiating callers must pass ``backend="xla"``.
     """
     from_env = False
     if backend == "auto":
@@ -334,7 +338,8 @@ def kda_chunk_prefill(
     A/B (BENCH_BANKED.md 2026-07-31, B=4 L=4096 H=16 128x128) measured
     kda_prefill_pallas at 8652 us vs 10210 us XLA — 1.18x — and its
     decay domain is the wider of the two; ineligible shapes fall back to
-    this XLA form."""
+    this XLA form.  Primal-only like GDN's kernel: differentiating
+    callers must pass ``backend="xla"``."""
     from_env = False
     if backend == "auto":
         import os
