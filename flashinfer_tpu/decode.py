@@ -258,21 +258,49 @@ class BatchDecodeWithPagedKVCacheWrapper:
                 max(1, min(c // plan.page_size, 64))
                 for c in (128, 256, 512, 1024)
             })
-            ppc = AutoTuner.get().choose_one(
-                "paged_decode.pages_per_chunk",
-                (plan.page_table.shape[0], plan.page_table.shape[1],
-                 plan.num_qo_heads, plan.num_kv_heads, plan.head_dim,
-                 plan.page_size, str(q.dtype)),
-                candidates,
-                lambda c: (lambda: paged_decode_attention(
+            # one shape key + one runner shared by both tactic tuners and
+            # the final guarded call — a plan field added to
+            # decode_tactic_key reaches all three AND the model decode
+            # paths identically
+            from flashinfer_tpu.ops.paged_decode import decode_tactic_key
+
+            shape_key = decode_tactic_key(
+                plan.page_table.shape[0], plan.page_table.shape[1],
+                plan.num_qo_heads, plan.num_kv_heads, plan.head_dim,
+                plan.page_size, q.dtype,
+            )
+
+            def _run(ppc_, csp_):
+                return paged_decode_attention(
                     q, k_cache, v_cache, plan.page_table, plan.kv_lens,
                     sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
                     window_left=plan.window_left, kv_layout=self._kv_layout,
-                    pages_per_chunk=c, return_lse=return_lse,
-                )),
+                    pages_per_chunk=ppc_, return_lse=return_lse,
+                    cross_step_prefetch=csp_,
+                )
+
+            ppc = AutoTuner.get().choose_one(
+                "paged_decode.pages_per_chunk", shape_key, candidates,
+                lambda c: (lambda: _run(c, False)),
                 default=ppc_default,
                 module=_pd_module,
             )
+            # second tactic: next-request chunk-0 prefetch.  "static" hides
+            # the per-request cold-start DMA stall with compile-time slot
+            # indices (see _decode_kernel_fused_heads); "off" keeps the
+            # stall.  Default static BY MEASUREMENT (2026-07-31 A/B,
+            # scripts/exp_decode_prefetch.py: bit-identical outputs and
+            # +1-2.4% everywhere measured, 0.713->0.728 TB/s at the
+            # headline shape).  The dynamic SMEM-parity variant measured
+            # losing on v5e (0.68 vs 0.75 TB/s) and is env-only.
+            pf = AutoTuner.get().choose_one(
+                "paged_decode.prefetch", shape_key, ["static", "off"],
+                lambda c: (lambda: _run(
+                    int(ppc), "static" if c == "static" else False)),
+                default="static",
+                module=_pd_module,
+            ) if self._kv_layout == "HND" else "off"
+            csp = "static" if pf == "static" else False
 
             try:
                 out = compile_guard.guarded(
@@ -285,15 +313,8 @@ class BatchDecodeWithPagedKVCacheWrapper:
                      # must be in the fingerprint, or the recompile runs
                      # outside the guarded window
                      float(sm_scale), float(plan.logits_soft_cap),
-                     int(plan.window_left)),
-                    lambda: paged_decode_attention(
-                        q, k_cache, v_cache, plan.page_table, plan.kv_lens,
-                        sm_scale=sm_scale,
-                        logits_soft_cap=plan.logits_soft_cap,
-                        window_left=plan.window_left,
-                        kv_layout=self._kv_layout,
-                        pages_per_chunk=int(ppc), return_lse=return_lse,
-                    ),
+                     int(plan.window_left), str(csp)),
+                    lambda: _run(int(ppc), csp),
                     module=_pd_module,
                 )
             except compile_guard.KernelQuarantined:

@@ -71,6 +71,7 @@ def fused_moe(
     w2_scale: Optional[jax.Array] = None,
     backend: str = "auto",
     gather_variant: str = "auto",
+    gmm_tiles=None,
 ) -> jax.Array:
     """Single-device fused MoE forward -> [T, hidden].
 
@@ -86,13 +87,13 @@ def fused_moe(
     - ``"ragged"``: ``jax.lax.ragged_dot`` over materialized sorted rows
       (the XLA fallback, and the oracle for tests).
     - ``"auto"``: env ``FLASHINFER_TPU_MOE_BACKEND`` if set, else
-      ``"ragged"`` BY MEASUREMENT: the banked v5e A/B (BENCH_BANKED.md
-      2026-07-31, Mixtral 8x7B shape, T=1024) has ragged_dot at
-      76.0 TFLOP/s int8 / 52.2 bf16 vs the sorted-gather GMM kernel's
-      26.6 / 20.4 — XLA's ragged_dot wins ~2.6-2.9x, so the Pallas pipeline
-      stays opt-in (the in-kernel gather variants additionally do not
-      compile on this Mosaic — see ``ops/moe_gmm.gather_gmm``); shape
-      gating unchanged (gmm needs 128-aligned hidden/inter dims).
+      ``"gmm"`` on hardware BY MEASUREMENT: with tuned tile shapes the
+      sorted-gather GMM kernel beats ragged_dot at every banked v5e point
+      (BENCH_BANKED.md 2026-07-31, Mixtral 8x7B: T=1024 int8
+      132 vs 76 TFLOP/s, bf16 85 vs 53; T=256 int8 68 vs 33, bf16
+      39 vs 20 — the round-4 "ragged wins 2.6-2.9x" verdict was an
+      artifact of the stock (128, 128, 512) tiles, re-banked).  Interpret
+      mode (CPU tests) and non-128-aligned shapes stay ragged.
 
     Backend resolution happens outside the jitted body so the env var is
     re-read on every *eager* call; a caller that wraps fused_moe in its own
@@ -102,7 +103,10 @@ def fused_moe(
     if backend == "auto":
         import os
 
-        backend = os.environ.get("FLASHINFER_TPU_MOE_BACKEND", "ragged")
+        from flashinfer_tpu.utils import use_interpret
+
+        default = "ragged" if use_interpret() else "gmm"
+        backend = os.environ.get("FLASHINFER_TPU_MOE_BACKEND", default)
         if backend == "gmm" and not tileable:
             backend = "ragged"  # auto falls back; explicit "gmm" raises
     if backend not in ("gmm", "ragged"):
@@ -112,16 +116,100 @@ def fused_moe(
             "gmm backend requires 128-aligned hidden/inter dims, got "
             f"hidden={hidden.shape[1]} 2*inter={w_gate_up.shape[2]}"
         )
+    if backend == "gmm":
+        gmm_tiles = _resolve_gmm_tiles(
+            gmm_tiles, hidden, w_gate_up, w_down, topk_ids
+        )
+    else:
+        gmm_tiles = None
     return _fused_moe_impl(
         hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
-        activation, w1_scale, w2_scale, backend, gather_variant,
+        activation, w1_scale, w2_scale, backend, gather_variant, gmm_tiles,
     )
+
+
+# Grouped-GEMM tile-shape selection.  The megablox-form kernel's HBM
+# traffic scales as tiles_n * M * K (lhs re-streaming across the n sweep)
+# + group_visits * K * N (expert-weight streaming), so both shrink with
+# bigger tiles: the banked v5e sweep (scripts/exp_moe_tiles.py,
+# BENCH_BANKED.md 2026-07-31, Mixtral 8x7B) has the stock (128, 128, 512)
+# blocks at 20-27 TFLOP/s vs (256, 2048, 1024) at 85 bf16 / 132 int8 —
+# a 3-4x swing on tile shape alone.  The heuristic below picks
+# largest-that-fits tiles; tuning_configs/ ships measured per-shape
+# winners and a user autotune() overrides both.
+_GMM_VMEM_BUDGET = 13 * 1024 * 1024  # double-buffered blocks + f32 acc
+
+
+def _heuristic_gmm_tiles(m, k, n, itemsize, out_itemsize=2):
+    """Largest (tm, tn, tk) whose double-buffered block footprint fits the
+    VMEM budget, with tn an exact divisor of n and tk of k (both stay
+    128-aligned; callers validated 128-alignment)."""
+
+    def _div_cap(x, cap):
+        # largest 128-multiple divisor of x that is <= cap (x is
+        # 128-aligned, so d == 128 always succeeds)
+        d = (min(cap, x) // 128) * 128
+        while d > 128 and x % d:
+            d -= 128
+        return max(d, 128)
+
+    tm = 256 if m >= 256 else 128
+    tn, tk = _div_cap(n, 2048), _div_cap(k, 1024)
+    while True:
+        footprint = (
+            2 * (tm * tk * itemsize + tk * tn * itemsize
+                 + tm * tn * out_itemsize)
+            + tm * tn * 4
+        )
+        if footprint <= _GMM_VMEM_BUDGET or (tn <= 128 and tk <= 128):
+            return (tm, tn, tk)
+        # shrink the dominant block first
+        if tk * tn >= tm * tn and tn > 128:
+            tn = _div_cap(n, tn - 128)
+        elif tk > 128:
+            tk = _div_cap(k, tk - 128)
+        else:
+            tn = _div_cap(n, tn - 128)
+
+
+def _resolve_gmm_tiles(gmm_tiles, hidden, w_gate_up, w_down, topk_ids):
+    """Normalize to ((tm1, tn1, tk1), (tm2, tn2, tk2)) for the two grouped
+    GEMMs; None consults the autotuner cache keyed by each GEMM's
+    (M, K, N, dtype), falling back to the VMEM-bounded heuristic."""
+    if gmm_tiles is not None:
+        gmm_tiles = tuple(map(tuple, gmm_tiles)) if isinstance(
+            gmm_tiles[0], (tuple, list)
+        ) else (tuple(gmm_tiles),) * 2
+        if len(gmm_tiles) != 2 or any(len(t) != 3 for t in gmm_tiles):
+            raise ValueError(
+                f"gmm_tiles must be (tm, tn, tk) or a pair of them, got "
+                f"{gmm_tiles!r}"
+            )
+        return gmm_tiles
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    tuner = AutoTuner.get()
+    m = topk_ids.shape[0] * topk_ids.shape[1]
+    h, n1 = w_gate_up.shape[1], w_gate_up.shape[2]
+    esz = w_gate_up.dtype.itemsize
+    # int8 writes an f32 output block (scales folded in the epilogue)
+    osz = 4 if esz == 1 else 2
+    dt = w_gate_up.dtype
+    t1 = tuner.lookup(
+        "moe_gmm.tiles", (m, h, n1, dt),
+        default=_heuristic_gmm_tiles(m, h, n1, esz, osz),
+    )
+    t2 = tuner.lookup(
+        "moe_gmm.tiles", (m, w_down.shape[1], h, dt),
+        default=_heuristic_gmm_tiles(m, w_down.shape[1], h, esz, osz),
+    )
+    return (tuple(t1), tuple(t2))
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("num_experts", "activation", "backend",
-                     "gather_variant"),
+                     "gather_variant", "gmm_tiles"),
 )
 def _fused_moe_impl(
     hidden: jax.Array,  # [T, hidden]
@@ -135,6 +223,7 @@ def _fused_moe_impl(
     w2_scale: Optional[jax.Array] = None,  # [E, 1, hidden]
     backend: str = "ragged",
     gather_variant: str = "auto",
+    gmm_tiles=None,
 ) -> jax.Array:
     """Jitted body of :func:`fused_moe` (backend already resolved).
 
@@ -155,25 +244,28 @@ def _fused_moe_impl(
     if backend == "gmm":
         from flashinfer_tpu.ops.moe_gmm import gather_gmm, gmm
 
+        assert gmm_tiles is not None  # resolved by fused_moe for gmm
+        (tm1, tn1, tk1), (tm2, tn2, tk2) = gmm_tiles
         if quantized:
             assert w1_scale is not None and w2_scale is not None
             xq, xs = _quant_rows_int8(hidden)  # per-TOKEN: T rows, not T*K
             h1 = gather_gmm(
                 xq, inv_token, w_gate_up, group_sizes,
                 xs[:, 0], w1_scale.reshape(num_experts, -1),
-                variant=gather_variant,
+                variant=gather_variant, tm=tm1, tn=tn1, tk=tk1,
             ).astype(dtype)
             a = _act(h1, activation)
             aq, as_ = _quant_rows_int8(a)
             h2 = gmm(
                 aq, w_down, group_sizes,
                 as_[:, 0], w2_scale.reshape(num_experts, -1),
+                tm=tm2, tn=tn2, tk=tk2,
             )
         else:
             h1 = gather_gmm(hidden, inv_token, w_gate_up, group_sizes,
-                            variant=gather_variant)
+                            variant=gather_variant, tm=tm1, tn=tn1, tk=tk1)
             a = _act(h1, activation)
-            h2 = gmm(a, w_down, group_sizes)
+            h2 = gmm(a, w_down, group_sizes, tm=tm2, tn=tn2, tk=tk2)
     elif quantized:
         assert w1_scale is not None and w2_scale is not None
         x_sorted = hidden[inv_token]  # [T*K, hidden]

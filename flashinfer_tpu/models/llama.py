@@ -198,9 +198,25 @@ def _attn_decode(
     if int8_kv:
         sm_scale = sm_scale * cfg.kv_k_scale
     fn = paged_decode_attention if use_pallas else xla_paged_decode
+    kw = {}
+    if use_pallas:
+        # same tactic cache the decode wrapper consults (measured default
+        # "static", scripts/exp_decode_prefetch.py: hides the per-request
+        # cold-start chunk DMA with static slot indices); a banked "off"
+        # for this shape reaches the model path too
+        from flashinfer_tpu.autotuner import AutoTuner
+        from flashinfer_tpu.ops.paged_decode import decode_tactic_key
+
+        pf = AutoTuner.get().lookup(
+            "paged_decode.prefetch",
+            decode_tactic_key(B, page_table.shape[1], num_qo_heads,
+                              num_kv_heads, hd, page_size, q.dtype),
+            default="static",
+        )
+        kw["cross_step_prefetch"] = "static" if pf == "static" else False
     o = fn(
         q, k_cache, v_cache, page_table, kv_lens_inc,
-        sm_scale=sm_scale, kv_layout="HND",
+        sm_scale=sm_scale, kv_layout="HND", **kw,
     )
     if int8_kv:
         o = (o.astype(jnp.float32) * cfg.kv_v_scale).astype(q.dtype)

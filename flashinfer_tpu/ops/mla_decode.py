@@ -245,16 +245,23 @@ def xla_mla_paged_decode(
     ckv = ckv_cache[page_table].reshape(batch, max_kv, d_ckv).astype(jnp.float32)
     kpe = kpe_cache[page_table].reshape(batch, max_kv, -1).astype(jnp.float32)
     kpe = kpe[..., : q_pe.shape[-1]]  # drop TPU lane padding if present
+    # HIGHEST: TPU's default matmul precision may run f32 einsums through
+    # reduced-precision MXU passes — not acceptable in a correctness
+    # oracle (see ops/xla_ref.py)
+    prec = jax.lax.Precision.HIGHEST
     s = (
-        jnp.einsum("bhd,bkd->bhk", q_nope.astype(jnp.float32), ckv)
-        + jnp.einsum("bhd,bkd->bhk", q_pe.astype(jnp.float32), kpe)
+        jnp.einsum("bhd,bkd->bhk", q_nope.astype(jnp.float32), ckv,
+                   precision=prec)
+        + jnp.einsum("bhd,bkd->bhk", q_pe.astype(jnp.float32), kpe,
+                     precision=prec)
     ) * sm_scale
     mask = jnp.arange(max_kv)[None, :] < kv_lens[:, None]
     s = jnp.where(mask[:, None], s, _NEG_INF)
     m = jnp.max(s, -1, keepdims=True)
     p = jnp.where(mask[:, None], jnp.exp(s - m), 0.0)
     l = jnp.sum(p, -1, keepdims=True)
-    out = jnp.einsum("bhk,bkd->bhd", p / jnp.where(l > 0, l, 1.0), ckv)
+    out = jnp.einsum("bhk,bkd->bhd", p / jnp.where(l > 0, l, 1.0), ckv,
+                     precision=prec)
     out = out.astype(q_nope.dtype)
     if return_lse:
         lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)

@@ -176,19 +176,29 @@ def _decode_kernel_fused_heads(
     parallelism collapsed into the head loop of a single core.
 
     Cross-step pipelining (``cross_step_prefetch``): each step issues the
-    *next* request's first chunk before finishing, carrying the live slot
-    parity across grid steps in SMEM (chunk counts differ per request, so
-    parity is data-dependent).  Measured OFF-by-default on v5e: the
-    dynamic slot indexing it forces costs more than the per-request
-    cold-start stall it hides (0.68 vs 0.75 TB/s at bs=64/ctx=4k) — kept
-    as an autotunable tactic for shapes with many short requests.
+    *next* request's first chunk before finishing, hiding the per-request
+    cold-start DMA stall (~one chunk's fetch per request; at bs=64/ctx=4k
+    that stall is ~6% of the whole step).  Two variants:
+
+    - ``True`` (dynamic): slot parity carried across grid steps in SMEM
+      (chunk counts differ per request, so parity is data-dependent).
+      Measured LOSING on v5e — the dynamic slot indexing it forces costs
+      more than the stall it hides (0.68 vs 0.75 TB/s at bs=64/ctx=4k).
+    - ``"static"``: prefetch only when the current request's chunk count
+      is EVEN, so the free buffer slot is always slot 0 and every slot
+      index stays a compile-time constant; odd-chunk requests simply keep
+      the cold-start stall.  All conditions derive from the scalar-
+      prefetched ``kvlen_ref``, so there is no carried state at all.  At
+      the tuned bs=64/ctx=4k shape (16 chunks/request) every request has
+      an even count and the whole stall disappears.
     """
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     kv_len = kvlen_ref[b]
     chunk_tokens = ppc * page_size
     num_chunks = pl.cdiv(kv_len, chunk_tokens)
-    if cross_step_prefetch:
+    static_pf = cross_step_prefetch == "static"
+    if cross_step_prefetch is True:
         # kv_len == 0 still walks one (fully masked) chunk: the cross-step
         # pipeline depends on every step consuming the chunk-0 DMA its
         # predecessor issued (dangling semaphore signals otherwise)
@@ -218,10 +228,23 @@ def _decode_kernel_fused_heads(
         for dma in page_dmas(bb, chunk_idx, slot):
             dma.wait()
 
-    if cross_step_prefetch:
+    if cross_step_prefetch is True:
         base = jnp.where(b == 0, 0, base_smem[0])
 
         @pl.when(b == 0)
+        def _warmup():
+            start_chunk(b, 0, 0)
+    elif static_pf:
+        base = 0
+        # predecessor's epilogue prefetched our chunk 0 into slot 0 iff it
+        # ran chunks (nc_prev > 0), had an even count (slot 0 free), and
+        # we have chunks to run (its nc_next > 0 check — same formula)
+        prev_nc = pl.cdiv(kvlen_ref[jnp.maximum(b - 1, 0)], chunk_tokens)
+        prev_prefetched = (
+            (b > 0) & (prev_nc > 0) & (jax.lax.rem(prev_nc, 2) == 0)
+        )
+
+        @pl.when((num_chunks > 0) & ~prev_prefetched)
         def _warmup():
             start_chunk(b, 0, 0)
     else:
@@ -292,7 +315,7 @@ def _decode_kernel_fused_heads(
     acc0 = jnp.zeros((num_kv_heads, gp, head_dim), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
 
-    if cross_step_prefetch:
+    if cross_step_prefetch is True:
         # hand the free slot to the next request's first chunk before the
         # epilogue so its gather overlaps the output write + step transition
         next_base = jax.lax.rem(base + num_chunks, 2)
@@ -302,11 +325,30 @@ def _decode_kernel_fused_heads(
             start_chunk(b + 1, 0, next_base)
 
         base_smem[0] = next_base
+    elif static_pf:
+        next_nc = pl.cdiv(kvlen_ref[jnp.minimum(b + 1, nb - 1)], chunk_tokens)
+
+        @pl.when(
+            (b + 1 < nb) & (num_chunks > 0)
+            & (jax.lax.rem(num_chunks, 2) == 0) & (next_nc > 0)
+        )
+        def _prefetch_next_request_static():
+            start_chunk(b + 1, 0, 0)
 
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
     lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
     lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def decode_tactic_key(batch, max_pages, num_qo_heads, num_kv_heads,
+                      head_dim, page_size, q_dtype):
+    """The ONE shape key for paged-decode tactic caches
+    (``paged_decode.pages_per_chunk`` / ``paged_decode.prefetch``): built
+    here so every lookup site (wrapper run, model decode steps) stays in
+    sync when a field is added."""
+    return (batch, max_pages, num_qo_heads, num_kv_heads, head_dim,
+            page_size, str(q_dtype))
 
 
 @functools.partial(
@@ -337,6 +379,17 @@ def paged_decode_attention(
     ``BatchDecodeWithPagedKVCacheWrapper.plan`` (padded-rectangular page
     table replaces the reference's ragged indptr + CUDAGraph buffer pinning).
     """
+    # identity checks, matching the kernel's dispatch (`is True` /
+    # == "static"): a truthy 1 or np.True_ must not pass validation and
+    # then silently run the no-prefetch path
+    if not (cross_step_prefetch is False or cross_step_prefetch is True
+            or cross_step_prefetch == "static"):
+        raise ValueError(
+            f"cross_step_prefetch must be False, True (dynamic SMEM "
+            f"parity) or 'static', got {cross_step_prefetch!r}"
+        )
+    if cross_step_prefetch == "static":
+        cross_step_prefetch = "static"  # normalize np.str_ etc.
     batch, num_qo_heads, head_dim = q.shape
     if kv_layout == "HND":
         num_pages, num_kv_heads, page_size, _ = k_cache.shape

@@ -18,6 +18,14 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+# These are correctness oracles: f32 operands are NOT enough on TPU, where
+# the default matmul precision may run f32 einsums through faster reduced-
+# precision MXU passes.  HIGHEST pins true f32 multiplications; these paths
+# are dense fallbacks where the extra MXU cost is explicitly acceptable
+# (module docstring).  With HIGHEST the HND paged-decode oracle measures
+# 2.4e-4 vs an f64 reference at bs=8/ctx=4k on v5e (2026-07-31 drive).
+_PREC = jax.lax.Precision.HIGHEST
+
 
 @functools.partial(
     jax.jit,
@@ -49,7 +57,7 @@ def xla_ragged_attention(
     qf = q.astype(jnp.float32)
     kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
     vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
-    s = jnp.einsum("qhd,khd->hqk", qf, kf) * sm_scale
+    s = jnp.einsum("qhd,khd->hqk", qf, kf, precision=_PREC) * sm_scale
     if logits_soft_cap > 0.0:
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
     mask = q_seg[:, None] == kv_seg[None, :]
@@ -64,7 +72,8 @@ def xla_ragged_attention(
     p = jnp.exp(s - m)
     p = jnp.where(mask[None], p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("hqk,khd->qhd", p / jnp.where(l > 0, l, 1.0), vf)
+    out = jnp.einsum("hqk,khd->qhd", p / jnp.where(l > 0, l, 1.0), vf,
+                     precision=_PREC)
     out = out.astype(q.dtype)
     if return_lse:
         lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
@@ -109,7 +118,8 @@ def xla_paged_decode(
     kg = jnp.repeat(kg.astype(jnp.float32), group, axis=2)
     vg = jnp.repeat(vg.astype(jnp.float32), group, axis=2)
 
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kg) * sm_scale
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kg,
+                   precision=_PREC) * sm_scale
     if logits_soft_cap > 0.0:
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
     pos = jnp.arange(max_kv)[None, :]
@@ -121,7 +131,8 @@ def xla_paged_decode(
     p = jnp.exp(s - m)
     p = jnp.where(mask[:, None, :], p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vg)
+    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vg,
+                     precision=_PREC)
     out = out.astype(q.dtype)
     if return_lse:
         lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)
@@ -166,13 +177,15 @@ def xla_fp4_paged_decode(
     group = q.shape[1] // num_kv_heads
     kf = jnp.repeat(kg.astype(jnp.float32), group, axis=2)
     vf = jnp.repeat(vg.astype(jnp.float32), group, axis=2)
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kf) * sm_scale
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kf,
+                   precision=_PREC) * sm_scale
     mask = jnp.arange(kf.shape[1])[None, :] < kv_lens[:, None]
     s = jnp.where(mask[:, None], s, _NEG_INF)
     m = jnp.max(s, -1, keepdims=True)
     p = jnp.where(mask[:, None], jnp.exp(s - m), 0.0)
     l = jnp.sum(p, -1, keepdims=True)
-    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vf)
+    out = jnp.einsum("bhk,bkhd->bhd", p / jnp.where(l > 0, l, 1.0), vf,
+                     precision=_PREC)
     out = out.astype(q.dtype)
     if return_lse:
         lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l[..., 0]), _NEG_INF)

@@ -1,0 +1,146 @@
+"""Hardware sweep: grouped-GEMM tile shapes for the fused-MoE Pallas path.
+
+The megablox-form gmm kernel's HBM traffic at Mixtral serving shapes is
+dominated by (a) lhs re-streaming — the whole [M, K] activation block is
+re-fetched once per n-tile because the grid is n-outermost — and (b)
+expert-weight streaming — each m-tile visit streams its group's full
+[K, N] weights.  Both scale inversely with tile size, so the stock
+(128, 128) blocks move ~3x more HBM bytes than (512, 1024) blocks at
+T=1024.  This sweep measures candidate tilings end-to-end through
+``fused_moe(backend="gmm", gmm_tiles=...)`` against the ragged_dot
+baseline and prints a winners table for tuning_configs/v5e.json.
+
+Usage:  python scripts/exp_moe_tiles.py [--tokens 256,1024] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from flashinfer_tpu import fused_moe as moe_pkg
+from flashinfer_tpu.quantization import quantize_int8
+from flashinfer_tpu.testing import bench_fn_device
+
+E, H, I, K = 8, 4096, 14336, 2  # Mixtral-8x7B
+
+CANDIDATES = [
+    (128, 128, 512),    # old default
+    (256, 512, 512),
+    (256, 1024, 512),
+    (512, 1024, 512),
+    (512, 512, 1024),
+    (256, 1024, 1024),
+    (512, 1024, 1024),
+    (512, 2048, 512),
+]
+
+# round-2 refinement around the T=1024 winner (256, 1024, 1024)
+REFINE = [
+    (128, 1024, 1024),
+    (256, 2048, 1024),
+    (256, 1024, 2048),
+    (128, 512, 1024),
+    (128, 2048, 1024),
+]
+
+# round-3: push the round-2 winner (256, 2048, 1024) toward VMEM limits,
+# plus the small-M decode-serving regime (T=64 -> M=128 rows)
+REFINE3 = [
+    (256, 2048, 1024),
+    (256, 4096, 1024),
+    (256, 2048, 2048),
+    (512, 2048, 1024),
+    (128, 2048, 1024),
+    (128, 4096, 1024),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", default="1024")
+    ap.add_argument("--quick", action="store_true",
+                    help="first 4 candidates, bf16 only")
+    ap.add_argument("--refine", nargs="?", const="2", default=None,
+                    help="refinement round: --refine (round 2) or --refine 3")
+    ap.add_argument("--dtypes", default="bf16,int8")
+    args = ap.parse_args()
+    tokens = [int(t) for t in args.tokens.split(",")]
+    ap_r3 = args.refine == "3"
+    cands = (CANDIDATES[:4] if args.quick
+             else REFINE3 if ap_r3
+             else [(256, 1024, 1024)] + REFINE if args.refine
+             else CANDIDATES)
+    dtypes = args.dtypes.split(",")
+
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (E, H, 2 * I), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(jax.random.fold_in(key, 1), (E, I, H),
+                           jnp.bfloat16) * 0.02
+    w1q, w1s = quantize_int8(w1, axis=1)
+    w2q, w2s = quantize_int8(w2, axis=1)
+
+    results = []
+    for T in tokens:
+        x = jax.random.normal(jax.random.fold_in(key, 2), (T, H),
+                              jnp.bfloat16)
+        logits = jax.random.normal(jax.random.fold_in(key, 3), (T, E),
+                                   jnp.float32)
+        wts, ids = moe_pkg.route_renormalize(logits, K)
+        flops = 2 * T * K * (H * 2 * I + I * H)
+
+        def run(name, fn, *ops):
+            try:
+                t = bench_fn_device(fn, x, wts, ids, *ops, repeats=3)
+            except Exception as e:
+                print(f"# {name}: FAIL {type(e).__name__}: "
+                      f"{str(e).splitlines()[0][:150]}", file=sys.stderr)
+                return None
+            tf = flops / t / 1e12
+            row = {"T": T, "variant": name, "us": round(t * 1e6, 1),
+                   "tflops": round(tf, 2)}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+            return t
+
+        if "bf16" in dtypes:
+            run("ragged_bf16",
+                lambda xx, ww, ii, a, b: moe_pkg.fused_moe(
+                    xx, a, b, ww, ii, E, backend="ragged"), w1, w2)
+            for tiles in cands:
+                name = f"gmm_{tiles[0]}x{tiles[1]}x{tiles[2]}_bf16"
+                run(name,
+                    (lambda tl: lambda xx, ww, ii, a, b: moe_pkg.fused_moe(
+                        xx, a, b, ww, ii, E, backend="gmm",
+                        gather_variant="sorted", gmm_tiles=tl))(tiles),
+                    w1, w2)
+        if "int8" in dtypes:
+            run("ragged_int8",
+                lambda xx, ww, ii, a, b, sa, sb: moe_pkg.fused_moe(
+                    xx, a, b, ww, ii, E, w1_scale=sa, w2_scale=sb,
+                    backend="ragged"), w1q, w2q, w1s, w2s)
+            for tiles in cands:
+                name = f"gmm_{tiles[0]}x{tiles[1]}x{tiles[2]}_int8"
+                run(name,
+                    (lambda tl: lambda xx, ww, ii, a, b, sa, sb:
+                        moe_pkg.fused_moe(
+                            xx, a, b, ww, ii, E, w1_scale=sa, w2_scale=sb,
+                            backend="gmm", gather_variant="sorted",
+                            gmm_tiles=tl))(tiles),
+                    w1q, w2q, w1s, w2s)
+
+    print("\n# === summary ===", file=sys.stderr)
+    for T in tokens:
+        rows = [r for r in results if r["T"] == T]
+        for r in sorted(rows, key=lambda r: r["us"]):
+            print(f"# T={T:5d} {r['variant']:28s} {r['us']:9.1f} us "
+                  f"{r['tflops']:6.2f} TFLOP/s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,28 @@ def test_paged_decode_cross_step_prefetch(lens):
         pages_per_chunk=2,
     )
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "lens", [[30, 25, 60, 1], [0, 17, 64, 33], [32, 32, 32, 32], [32, 0, 48, 64]]
+)
+def test_paged_decode_static_prefetch(lens):
+    """The static-parity next-request prefetch must match the plain path
+    across even (prefetched), odd (cold-start), and zero chunk counts —
+    including an even-count request followed by a zero-length one (the
+    predecessor must NOT issue a dangling chunk-0 DMA)."""
+    B, HQ, HKV, D, PS, P = 4, 4, 2, 64, 8, 8
+    kc = jax.random.normal(jax.random.PRNGKey(0), (32, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (32, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.arange(32, dtype=jnp.int32).reshape(B, P)
+    lens = jnp.array(lens, jnp.int32)
+    o = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2, cross_step_prefetch="static",
+    )
+    ref = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)

@@ -226,6 +226,32 @@ def test_fused_moe_gmm_backend_matches_ragged():
     )
 
 
+@pytest.mark.parametrize(
+    "tiles", [(32, 128, 128), ((16, 256, 128), (32, 128, 128))]
+)
+def test_fused_moe_gmm_tiles_override(tiles):
+    """Explicit / per-GEMM gmm_tiles produce the same result as defaults
+    (the tile shape is a pure schedule choice)."""
+    from flashinfer_tpu import fused_moe as moe
+
+    rng = np.random.default_rng(11)
+    T, E, K, h, inter = 48, 6, 2, 128, 128
+    x = jnp.asarray(rng.standard_normal((T, h)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((E, h, 2 * inter)) / np.sqrt(h),
+                     jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((E, inter, h)) / np.sqrt(inter),
+                     jnp.bfloat16)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    wts, ids = moe.route_renormalize(logits, K)
+    ref = moe.fused_moe(x, w1, w2, wts, ids, E, backend="gmm")
+    out = moe.fused_moe(x, w1, w2, wts, ids, E, backend="gmm",
+                        gmm_tiles=tiles)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
 def test_fused_moe_gmm_backend_int8():
     """int8 gmm path (per-token quant before routing) vs int8 ragged path."""
     from flashinfer_tpu import fused_moe as moe
