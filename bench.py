@@ -265,22 +265,21 @@ def phase_moe(sweep: bool):
                 xx, a, b, ww, ii, E, w1_scale=sa, w2_scale=sb,
                 backend=backend, gather_variant=gv)
 
-        # gmm is A/B'd over the gather variant (VERDICT r3 #6).  2026-07-31
-        # hardware verdict: Mosaic rejects the in-kernel per-row gather
-        # ("Slice shape along dimension 0 must be aligned to tiling (8)"),
-        # so rowcache/stream cannot compile on this chip generation --
-        # "sorted" (XLA gather + tiled GMM kernel) is the compiling form.
+        # A/B: ragged_dot vs the tuned-tile sorted-gather GMM (the auto
+        # default on hardware since the 2026-07-31 tile sweep,
+        # BENCH_BANKED.md).  The stream/rowcache gather variants are NOT
+        # benched: Mosaic rejects their in-kernel per-row gather ("Slice
+        # shape along dimension 0 must be aligned to tiling (8)") on this
+        # chip generation — permanently xfail-documented in the hw tier,
+        # so a guarded compile failure per sweep bought nothing.
         # Per-variant isolation: one failing variant must not cost the
-        # phase's remaining rows (the quick run lost the int8 A/B to the
-        # first rowcache compile error).
+        # phase's remaining rows.
         for name, fn, ops in (
             ("ragged_bf16", bf16_fn("ragged"), (w1, w2)),
             ("gmm_sorted_bf16", bf16_fn("gmm", "sorted"), (w1, w2)),
-            ("gmm_st_bf16", bf16_fn("gmm", "stream"), (w1, w2)),
             ("ragged_int8", int8_fn("ragged"), (w1q, w2q, w1s, w2s)),
             ("gmm_sorted_int8", int8_fn("gmm", "sorted"),
              (w1q, w2q, w1s, w2s)),
-            ("gmm_st_int8", int8_fn("gmm", "stream"), (w1q, w2q, w1s, w2s)),
         ):
             t = _guard_soft(
                 f"bench.moe.{name}", (T, E, H, I, K),

@@ -72,6 +72,42 @@ def test_paged_decode_llama_shape():
     )
 
 
+def test_paged_decode_static_prefetch_on_chip():
+    """The static-parity next-request prefetch (default tactic since
+    2026-07-31) must be BIT-IDENTICAL to the plain kernel on hardware —
+    mixed even/odd/zero chunk counts exercise the scalar-derived
+    warmup/epilogue DMA handshake."""
+    from flashinfer_tpu.ops import paged_decode_attention
+
+    B, PS, ctx = 16, 16, 4096
+    ppr = ctx // PS
+    npages = B * ppr
+    pt = jnp.asarray(
+        np.random.default_rng(0).permutation(npages).astype(np.int32)
+    ).reshape(B, ppr)
+    lens_np = np.random.default_rng(1).integers(0, ctx + 1, B)
+    lens_np[0] = ctx  # even chunk count at the tuned ppc
+    lens_np[1] = 0    # zero-length request mid-batch
+    lens = jnp.asarray(lens_np.astype(np.int32))
+    kc = jax.random.normal(
+        jax.random.PRNGKey(0), (npages, HKV, PS, D), jnp.bfloat16
+    )
+    vc = jax.random.normal(
+        jax.random.PRNGKey(1), (npages, HKV, PS, D), jnp.bfloat16
+    )
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D), jnp.bfloat16)
+    outs = {}
+    for mode in (False, "static"):
+        outs[mode] = paged_decode_attention(
+            q, kc, vc, pt, lens, sm_scale=D ** -0.5, kv_layout="HND",
+            pages_per_chunk=16, cross_step_prefetch=mode,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(outs[False], np.float32),
+        np.asarray(outs["static"], np.float32),
+    )
+
+
 def test_fused_paged_prefill_llama_shape():
     """First-class hardware check of the work-unit fused prefill kernel
     (ops/paged_prefill.py) against the gather+flash path, mixed ragged
