@@ -144,6 +144,7 @@ def _heuristic_gmm_tiles(m, k, n, itemsize, out_itemsize=2):
     """Largest (tm, tn, tk) whose double-buffered block footprint fits the
     VMEM budget, with tn an exact divisor of n and tk of k (both stay
     128-aligned; callers validated 128-alignment)."""
+    from flashinfer_tpu.ops.moe_gmm import tile_footprint
 
     def _div_cap(x, cap):
         # largest 128-multiple divisor of x that is <= cap (x is
@@ -156,11 +157,7 @@ def _heuristic_gmm_tiles(m, k, n, itemsize, out_itemsize=2):
     tm = 256 if m >= 256 else 128
     tn, tk = _div_cap(n, 2048), _div_cap(k, 1024)
     while True:
-        footprint = (
-            2 * (tm * tk * itemsize + tk * tn * itemsize
-                 + tm * tn * out_itemsize)
-            + tm * tn * 4
-        )
+        footprint = tile_footprint(tm, tn, tk, itemsize, out_itemsize)
         if footprint <= _GMM_VMEM_BUDGET or (tn <= 128 and tk <= 128):
             return (tm, tn, tk)
         # shrink the dominant block first
@@ -192,17 +189,28 @@ def _resolve_gmm_tiles(gmm_tiles, hidden, w_gate_up, w_down, topk_ids):
     m = topk_ids.shape[0] * topk_ids.shape[1]
     h, n1 = w_gate_up.shape[1], w_gate_up.shape[2]
     esz = w_gate_up.dtype.itemsize
-    # int8 writes an f32 output block (scales folded in the epilogue)
-    osz = 4 if esz == 1 else 2
     dt = w_gate_up.dtype
-    t1 = tuner.lookup(
-        "moe_gmm.tiles", (m, h, n1, dt),
-        default=_heuristic_gmm_tiles(m, h, n1, esz, osz),
+    # per-GEMM epilogue output dtypes (must match _fused_moe_impl): the
+    # int8 first GEMM stores bf16 directly, the second stores f32 for the
+    # combine; bf16 path stores bf16 everywhere
+    o1 = jnp.bfloat16 if esz == 1 else dt
+    o2 = jnp.float32 if esz == 1 else dt
+    h1_def = _heuristic_gmm_tiles(m, h, n1, esz, jnp.dtype(o1).itemsize)
+    h2_def = _heuristic_gmm_tiles(
+        m, w_down.shape[1], h, esz, jnp.dtype(o2).itemsize
     )
-    t2 = tuner.lookup(
-        "moe_gmm.tiles", (m, w_down.shape[1], h, dt),
-        default=_heuristic_gmm_tiles(m, w_down.shape[1], h, esz, osz),
-    )
+    if tuner.tuning_enabled:
+        # autotune() context: profile candidates per GEMM geometry with
+        # the standalone kernel (writes the same cache keys lookup reads)
+        from flashinfer_tpu.ops.moe_gmm import tune_tiles
+
+        t1 = tune_tiles(m, h, n1, dt, h1_def, out_dtype=o1)
+        t2 = tune_tiles(m, w_down.shape[1], h, dt, h2_def, out_dtype=o2)
+    else:
+        t1 = tuner.lookup("moe_gmm.tiles", (m, h, n1, dt), default=h1_def)
+        t2 = tuner.lookup(
+            "moe_gmm.tiles", (m, w_down.shape[1], h, dt), default=h2_def
+        )
     return (tuple(t1), tuple(t2))
 
 
@@ -249,11 +257,16 @@ def _fused_moe_impl(
         if quantized:
             assert w1_scale is not None and w2_scale is not None
             xq, xs = _quant_rows_int8(hidden)  # per-TOKEN: T rows, not T*K
+            # out_dtype=dtype: the scaled epilogue stores bf16 directly —
+            # writing f32 and casting after costs an extra [M, 2I] f32
+            # round-trip (235 MB at Mixtral T=1024) for precision the
+            # activation immediately discards
             h1 = gather_gmm(
                 xq, inv_token, w_gate_up, group_sizes,
                 xs[:, 0], w1_scale.reshape(num_experts, -1),
                 variant=gather_variant, tm=tm1, tn=tn1, tk=tk1,
-            ).astype(dtype)
+                out_dtype=dtype,
+            )
             a = _act(h1, activation)
             aq, as_ = _quant_rows_int8(a)
             h2 = gmm(

@@ -357,6 +357,93 @@ def gmm(
 
 _ROWCACHE_VMEM_CAP = 8 * 1024 * 1024  # [tm, K] row buffer budget
 
+# candidate tile shapes for profiling (autotune() context): the banked
+# v5e sweep frontier (scripts/exp_moe_tiles.py, BENCH_BANKED.md
+# 2026-07-31) plus the stock shape; filtered per call by divisibility
+# and the empirically-mapped VMEM ceiling (~15.5 MB double-buffered
+# footprint compiles, ~17 MB does not)
+_TILE_CANDIDATES = [
+    (128, 128, 512),
+    (256, 1024, 512),
+    (256, 1024, 1024),
+    (128, 2048, 1024),
+    (256, 2048, 1024),
+    (256, 2048, 2048),
+]
+_TILE_VMEM_CEILING = int(15.5 * 1024 * 1024)
+
+
+def tile_footprint(tm, tn, tk, esz, osz):
+    """Double-buffered VMEM bytes for one grouped-GEMM grid step: lhs +
+    rhs + out blocks x2 plus the f32/int32 accumulator.  The ONE formula
+    both the pre-tuning heuristic (fused_moe/core.py) and the profiling
+    candidate filter below must agree on."""
+    return 2 * (tm * tk * esz + tk * tn * esz + tm * tn * osz) + tm * tn * 4
+
+
+def tune_tiles(m: int, k: int, n: int, dtype, default, out_dtype) -> tuple:
+    """Profile grouped-GEMM tile candidates for one (M, K, N, dtype)
+    geometry with synthetic 8-group data and cache the winner under the
+    same ``moe_gmm.tiles`` key ``fused_moe`` resolves (autotune() context
+    only — callers check ``tuning_enabled`` first).  ``out_dtype`` must
+    match the production epilogue (e.g. the int8 first GEMM stores bf16)
+    so timings carry the real output-write traffic."""
+    import sys
+
+    import numpy as np
+
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    tuner = AutoTuner.get()
+    key = (m, k, n, jnp.dtype(dtype))
+    cached = tuner.lookup("moe_gmm.tiles", key)
+    if cached is not None:
+        # already tuned (this run or shipped): do NOT re-pay the
+        # synthetic-operand allocation + transfer below
+        return tuple(cached)
+    esz = jnp.dtype(dtype).itemsize
+    osz = jnp.dtype(out_dtype).itemsize
+    cands = [
+        c for c in _TILE_CANDIDATES
+        if n % c[1] == 0
+        and tile_footprint(c[0], c[1], _pick_tk(c[2], k), esz, osz)
+        <= _TILE_VMEM_CEILING
+    ]
+    if tuple(default) not in cands:
+        cands.insert(0, tuple(default))
+    groups = 8
+    rng = np.random.default_rng(0)
+    if esz == 1:
+        lhs = jnp.asarray(
+            rng.integers(-127, 128, (m, k), dtype=np.int8))
+        rhs = jnp.asarray(
+            rng.integers(-127, 128, (groups, k, n), dtype=np.int8))
+        ls = jnp.ones((m,), jnp.float32)
+        rs = jnp.ones((groups, n), jnp.float32)
+        scales = (ls, rs)
+    else:
+        lhs = jnp.asarray(
+            rng.standard_normal((m, k), dtype=np.float32), dtype)
+        rhs = jnp.asarray(
+            rng.standard_normal((groups, k, n), dtype=np.float32) * 0.05,
+            dtype)
+        scales = (None, None)
+    # remainder lands in the last group so sum(gs) == m (m < groups would
+    # otherwise profile an empty grid and persist a meaningless winner)
+    gs = np.full((groups,), m // groups, np.int32)
+    gs[-1] += m - int(gs.sum())
+    gs = jnp.asarray(gs)
+
+    def runner(c):
+        tm, tn, tk = c
+        return lambda: gmm(lhs, rhs, gs, *scales, tm=tm, tn=tn, tk=tk,
+                           out_dtype=out_dtype)
+
+    return AutoTuner.get().choose_one(
+        "moe_gmm.tiles", key, cands, runner,
+        default=tuple(default), module=sys.modules[__name__],
+    )
+
 
 def gather_gmm(
     x: jax.Array,  # [T, K] UNSORTED token activations, bf16 or int8

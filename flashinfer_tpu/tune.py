@@ -125,10 +125,37 @@ def run_tuning_workload(stages: Optional[list] = None,
             fi.single_prefill_with_kv_cache(q, k, v, causal=True)
             log(f"flash tuned t={t}")
 
+    def stage_moe():
+        # Mixtral-8x7B geometry at serving token counts; fused_moe's tile
+        # resolution profiles per-GEMM candidates under autotune() (see
+        # ops/moe_gmm.tune_tiles) and the decode-side prefetch tactic is
+        # covered by stage_decode's wrapper runs
+        from flashinfer_tpu import fused_moe as moe_pkg
+        from flashinfer_tpu.quantization import quantize_int8
+
+        E, I, K = 8, 14336, 2
+        w1 = jnp.asarray(
+            np.random.randn(E, H, 2 * I) * 0.02, jnp.bfloat16)
+        w2 = jnp.asarray(
+            np.random.randn(E, I, H) * 0.02, jnp.bfloat16)
+        w1q, w1s = quantize_int8(w1, axis=1)
+        w2q, w2s = quantize_int8(w2, axis=1)
+        for t in (64, 256, 1024):
+            x = jnp.asarray(np.random.randn(t, H), jnp.bfloat16)
+            logits = jnp.asarray(np.random.randn(t, E), jnp.float32)
+            wts, ids = moe_pkg.route_renormalize(logits, K)
+            moe_pkg.fused_moe(x, w1, w2, wts, ids, E, backend="gmm",
+                              gather_variant="sorted")
+            moe_pkg.fused_moe(x, w1q, w2q, wts, ids, E, w1_scale=w1s,
+                              w2_scale=w2s, backend="gmm",
+                              gather_variant="sorted")
+            log(f"moe tiles tuned T={t}")
+
     all_stages = [
         ("norm", stage_norm),
         ("decode", stage_decode),
         ("prefill", stage_prefill),
+        ("moe", stage_moe),
         ("flash", stage_flash),
     ]
     selected = (
