@@ -89,8 +89,18 @@ class AutoTuner:
         try:
             stem = _device_config_key()
             if stem is not None:
-                p = Path(__file__).parent / "tuning_configs" / f"{stem}.json"
-                self._shipped = json.loads(p.read_text()).get("tactics", {})
+                # package copy first, then a bundle-installed copy in the
+                # cache dir (artifacts.unpack_artifacts target) — the
+                # bundle is the newer/fleet-specific table, so it wins
+                for root in (
+                    Path(__file__).parent / "tuning_configs",
+                    env.cache_dir() / "tuning_configs",
+                ):
+                    p = root / f"{stem}.json"
+                    if p.is_file():
+                        self._shipped.update(
+                            json.loads(p.read_text()).get("tactics", {})
+                        )
         except Exception:
             pass
         p = self._cache_path()

@@ -257,6 +257,8 @@ def test_every_reference_submodule_def_resolves():
         "moe_ep": "moe_ep", "concat_ops": "concat_ops",
         "logits_processor": "logits_processor", "autotuner": "autotuner",
         "fi_trace": "trace",
+        # round-5: artifact bundles (XLA-cache + tactics packaging)
+        "artifacts": "artifacts",
     }
     # reference submodules freely re-export each other's utilities, so a
     # name resolves if it exists ANYWHERE on this package's mapped
