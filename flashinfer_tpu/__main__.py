@@ -198,6 +198,27 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def cmd_artifacts(args) -> int:
+    """Artifact bundles (reference artifacts.py role): pack the local
+    compile cache + tactic tables into a checksummed tarball, restore
+    one, or audit presence."""
+    from flashinfer_tpu import artifacts
+
+    if args.action == "pack":
+        out = artifacts.pack_artifacts(args.path or "flashinfer_tpu_artifacts.tgz")
+        print(f"packed -> {out}")
+    elif args.action == "unpack":
+        if not args.path:
+            print("unpack requires a bundle path", file=sys.stderr)
+            return 2
+        n = artifacts.unpack_artifacts(args.path)
+        print(f"restored {n} files into {artifacts.env.cache_dir()}")
+    else:
+        for name, present in artifacts.get_artifacts_status():
+            print(f"{'present' if present else 'MISSING':8s} {name}")
+    return 0
+
+
 def cmd_probe(args) -> int:
     """Chip-health probe: compile a trivial kernel in a subprocess under a
     timeout (the post-wedge recovery detector)."""
@@ -243,6 +264,10 @@ def main(argv=None) -> int:
     sp = sub.add_parser("probe")
     sp.add_argument("--timeout", type=float, default=240.0)
     sp.set_defaults(fn=cmd_probe)
+    sp = sub.add_parser("artifacts")
+    sp.add_argument("action", choices=["status", "pack", "unpack"])
+    sp.add_argument("path", nargs="?")
+    sp.set_defaults(fn=cmd_artifacts)
     sp = sub.add_parser("tune")
     sp.add_argument(
         "--stage", action="append",

@@ -67,13 +67,23 @@ def _bundle_members(cache_root: Path):
     for sub, root in (
         (ArtifactPath.XLA_CACHE, cache_root / ArtifactPath.XLA_CACHE),
         (ArtifactPath.TACTICS, cache_root / ArtifactPath.TACTICS),
-        (ArtifactPath.TUNING_CONFIGS, _tuning_configs_dir()),
     ):
         if not root.is_dir():
             continue
         for p in sorted(root.rglob("*")):
             if p.is_file():
                 yield f"{sub}/{p.relative_to(root)}", p
+    # tuning tables: a bundle-installed copy in the cache dir is the
+    # NEWER table (autotuner._load lets it override the package copy) —
+    # re-packing must relay it, not the stale package file
+    by_stem = {}
+    for root in (_tuning_configs_dir(),
+                 cache_root / ArtifactPath.TUNING_CONFIGS):
+        if root.is_dir():
+            for p in sorted(root.glob("*.json")):
+                by_stem[p.name] = p
+    for name, p in sorted(by_stem.items()):
+        yield f"{ArtifactPath.TUNING_CONFIGS}/{name}", p
 
 
 def build_artifacts(verbose: bool = True) -> None:
@@ -114,17 +124,23 @@ def unpack_artifacts(bundle_path: str,
     """Restore a bundle into the local cache, verifying every checksum
     (reference ``get_checksums`` role).  Returns the file count.
 
-    Raises ``ValueError`` on a checksum mismatch — a truncated or
-    tampered bundle must not seed the executable cache.
+    Raises ``ValueError`` on any integrity failure (checksum mismatch,
+    missing manifest entry, unsafe path) and writes NOTHING in that case
+    — a damaged bundle must not partially seed the executable cache.
+    (This is corruption/truncation DETECTION, not tamper-proofing: the
+    manifest travels inside the bundle, so an adversary who can rewrite
+    the bundle can re-sign it; distribute bundles over channels with
+    their own authenticity guarantees.)
     """
     root = Path(cache_dir) if cache_dir else env.cache_dir()
-    root.mkdir(parents=True, exist_ok=True)
-    n = 0
-    extracted = set()
+    # verify the ENTIRE bundle in memory first; write only after every
+    # member has passed
+    verified = []
     with tarfile.open(bundle_path, "r:gz") as tar:
         if _MANIFEST not in tar.getnames():
             raise ValueError(f"{bundle_path}: missing {_MANIFEST}")
         manifest = json.loads(tar.extractfile(_MANIFEST).read().decode())
+        seen = set()
         for member in tar.getmembers():
             if not member.isfile() or member.name == _MANIFEST:
                 continue
@@ -135,37 +151,36 @@ def unpack_artifacts(bundle_path: str,
                 raise ValueError(f"unsafe member path {member.name!r}")
             if member.name not in manifest:
                 raise ValueError(f"{member.name}: not in manifest")
-            f = tar.extractfile(member)
-            data = f.read()
+            data = tar.extractfile(member).read()
             if hashlib.sha256(data).hexdigest() != manifest[member.name]:
                 raise ValueError(f"{member.name}: checksum mismatch")
-            # everything restores under the cache dir; the autotuner
-            # reads bundle-installed tuning_configs from there too
-            # (autotuner._load second root), overriding the package copy
-            dest = root / rel
-            dest.parent.mkdir(parents=True, exist_ok=True)
-            dest.write_bytes(data)
-            extracted.add(member.name)
-            n += 1
-    dropped = set(manifest) - extracted
+            verified.append((rel, data))
+            seen.add(member.name)
+    dropped = set(manifest) - seen
     if dropped:
         raise ValueError(
             f"{bundle_path}: manifest entries missing from the bundle "
             f"(truncated/repacked?): {sorted(dropped)[:5]}"
         )
-    return n
+    root.mkdir(parents=True, exist_ok=True)
+    for rel, data in verified:
+        # the autotuner reads bundle-installed tuning_configs from the
+        # cache dir too (autotuner._load second root), overriding the
+        # package copy
+        dest = root / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(data)
+    return len(verified)
 
 
 def get_artifacts_status() -> Tuple[Tuple[str, bool], ...]:
-    """Presence audit, reference-shaped (artifacts.py:318)."""
-    root = env.cache_dir()
-    chip = None
-    try:
-        from flashinfer_tpu.autotuner import _device_config_key
+    """Presence audit, reference-shaped (artifacts.py:318).
 
-        chip = _device_config_key()
-    except Exception:  # noqa: BLE001 - no device: report shipped stems
-        pass
+    Deliberately queries NO device: this must answer on a host whose
+    accelerator is absent or wedged (it is part of the recovery
+    tooling), so the tuning-config rows list each available stem rather
+    than resolving the current chip."""
+    root = env.cache_dir()
     status = [
         (ArtifactPath.XLA_CACHE,
          any((root / ArtifactPath.XLA_CACHE).rglob("*"))
@@ -173,16 +188,17 @@ def get_artifacts_status() -> Tuple[Tuple[str, bool], ...]:
         (ArtifactPath.TACTICS,
          (root / ArtifactPath.TACTICS / "tactics.json").is_file()),
     ]
-    cfgs = _tuning_configs_dir()
-    if chip:
-        status.append(
-            (f"{ArtifactPath.TUNING_CONFIGS}/{chip}",
-             (cfgs / f"{chip}.json").is_file())
-        )
+    # glob on a missing directory yields nothing, so no existence check
+    stems = sorted(
+        {p.stem for p in _tuning_configs_dir().glob("*.json")}
+        | {p.stem
+           for p in (root / ArtifactPath.TUNING_CONFIGS).glob("*.json")}
+    )
+    if stems:
+        for s in stems:
+            status.append((f"{ArtifactPath.TUNING_CONFIGS}/{s}", True))
     else:
-        status.append(
-            (ArtifactPath.TUNING_CONFIGS, any(cfgs.glob("*.json")))
-        )
+        status.append((ArtifactPath.TUNING_CONFIGS, False))
     return tuple(status)
 
 

@@ -88,21 +88,27 @@ class AutoTuner:
         # Shape-keyed, version-independent — a library upgrade keeps them.
         try:
             stem = _device_config_key()
-            if stem is not None:
-                # package copy first, then a bundle-installed copy in the
-                # cache dir (artifacts.unpack_artifacts target) — the
-                # bundle is the newer/fleet-specific table, so it wins
-                for root in (
-                    Path(__file__).parent / "tuning_configs",
-                    env.cache_dir() / "tuning_configs",
-                ):
+        except Exception:
+            stem = None
+        if stem is not None:
+            # package copy first, then a bundle-installed copy in the
+            # cache dir (artifacts.unpack_artifacts target) — the
+            # bundle is the newer/fleet-specific table, so it wins.
+            # Per-file try: a corrupt package JSON must not block the
+            # bundle copy the fleet explicitly distributed (or vice
+            # versa)
+            for root in (
+                Path(__file__).parent / "tuning_configs",
+                env.cache_dir() / "tuning_configs",
+            ):
+                try:
                     p = root / f"{stem}.json"
                     if p.is_file():
                         self._shipped.update(
                             json.loads(p.read_text()).get("tactics", {})
                         )
-        except Exception:
-            pass
+                except Exception:
+                    pass
         p = self._cache_path()
         try:
             data = json.loads(p.read_text())
