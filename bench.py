@@ -59,6 +59,10 @@ PHASE_TIMEOUT_S = {
     "topk": 1200.0,
     "scans": 1500.0,
     "serving": 2400.0,
+    "prefill": 1500.0,
+    "prefill_sweep": 2400.0,
+    "mla": 1200.0,
+    "mla_sweep": 2400.0,
 }
 
 
@@ -176,6 +180,143 @@ def phase_decode(sweep: bool):
                   tbps=round(tbps, 4), tok_s=round(tps, 0), peak=peak)
         print(f"# decode bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
               f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s", file=sys.stderr)
+
+
+def phase_prefill(sweep: bool):
+    """Batch chunked prefill TFLOPS (BASELINE.md tracked metric #3:
+    BatchPrefillWithPagedKVCacheWrapper) + the ragged flash self-attention
+    form, Llama-3-8B GQA shapes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.testing import attention_flops, bench_fn_device
+
+    if os.environ.get("BENCH_SMALL"):
+        HQ, HKV, D, PS = 4, 2, 64, 8
+        paged_cfgs, ragged_ts = [(2, 64, 128)], (256,)
+    else:
+        HQ, HKV, D, PS = 32, 8, 128, 16
+        paged_cfgs = ([(8, 512, 4096), (2, 2048, 8192), (16, 256, 2048)]
+                      if sweep else [(8, 512, 4096)])
+        ragged_ts = (4096, 8192) if sweep else (8192,)
+
+    for bs, qlen, ctx in paged_cfgs:
+        ppr = ctx // PS
+        npages = bs * ppr
+        key = jax.random.PRNGKey(0)
+        kc = jax.random.normal(key, (npages, HKV, PS, D), jnp.bfloat16)
+        vc = jax.random.normal(jax.random.fold_in(key, 1),
+                               (npages, HKV, PS, D), jnp.bfloat16)
+        q = jax.random.normal(jax.random.fold_in(key, 2),
+                              (bs * qlen, HQ, D), jnp.bfloat16)
+        w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(
+            np.arange(bs + 1, dtype=np.int32) * qlen,
+            np.arange(bs + 1, dtype=np.int32) * ppr,
+            np.random.default_rng(0).permutation(npages).astype(np.int32),
+            np.full((bs,), PS, np.int32),
+            HQ, HKV, D, PS, causal=True,
+        )
+        t = _guard_soft(
+            "bench.prefill", (bs, qlen, ctx, HQ, HKV, D, PS),
+            lambda: bench_fn_device(
+                lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc,
+                repeats=3,
+            ),
+        )
+        if t is None:
+            continue
+        flops = bs * attention_flops(qlen, ctx, HQ, D, D, causal=True)
+        _emit_row(phase="prefill", kind="paged_chunked", bs=bs, qlen=qlen,
+                  ctx=ctx, us=round(t * 1e6, 1),
+                  tflops=round(flops / t / 1e12, 2))
+        print(f"# prefill paged bs={bs} qlen={qlen} ctx={ctx}: "
+              f"{t*1e6:9.1f} us  {flops/t/1e12:6.2f} TFLOP/s",
+              file=sys.stderr)
+
+    for T in ragged_ts:
+        key = jax.random.PRNGKey(1)
+        q = jax.random.normal(key, (T, HQ, D), jnp.bfloat16)
+        k = jax.random.normal(jax.random.fold_in(key, 1), (T, HKV, D),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.fold_in(key, 2), (T, HKV, D),
+                              jnp.bfloat16)
+        t = _guard_soft(
+            "bench.prefill.ragged", (T, HQ, HKV, D),
+            lambda: bench_fn_device(
+                lambda qq, kk, vv: fi.single_prefill_with_kv_cache(
+                    qq, kk, vv, causal=True),
+                q, k, v, repeats=3,
+            ),
+        )
+        if t is None:
+            continue
+        flops = attention_flops(T, T, HQ, D, D, causal=True)
+        _emit_row(phase="prefill", kind="ragged_flash", qlen=T,
+                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        print(f"# prefill ragged T={T}: {t*1e6:9.1f} us  "
+              f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
+
+
+def phase_mla(sweep: bool):
+    """MLA absorbed decode (BASELINE.md tracked metric #4: DeepSeek-V3
+    ckv 512 + kpe 64): bandwidth vs roofline — the latent cache is read
+    ONCE for all 128 heads, the MLA memory win."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu.ops.mla_decode import mla_paged_decode_attention
+    from flashinfer_tpu.testing import bench_fn_device
+
+    peak = chip_peak_tbps()
+    if os.environ.get("BENCH_SMALL"):
+        H, DC, DP, PS = 8, 128, 64, 8
+        cfgs = [(2, 256)]
+    else:
+        H, DC, DP, PS = 128, 512, 64, 16
+        cfgs = [(64, 4096), (16, 4096), (64, 8192)] if sweep \
+            else [(64, 4096)]
+    for bs, ctx in cfgs:
+        ppr = ctx // PS
+        npages = bs * ppr
+        key = jax.random.PRNGKey(0)
+        ckv = jax.random.normal(key, (npages, PS, DC), jnp.bfloat16)
+        # TPU-native lane-padded kpe layout (first DP columns live)
+        kpe = jnp.pad(
+            jax.random.normal(jax.random.fold_in(key, 1),
+                              (npages, PS, DP), jnp.bfloat16),
+            ((0, 0), (0, 0), (0, 128 - DP)),
+        )
+        qn = jax.random.normal(jax.random.fold_in(key, 2), (bs, H, DC),
+                               jnp.bfloat16)
+        qp = jax.random.normal(jax.random.fold_in(key, 3), (bs, H, DP),
+                               jnp.bfloat16)
+        pt = jnp.asarray(
+            np.random.default_rng(0).permutation(npages)
+            .astype(np.int32).reshape(bs, ppr)
+        )
+        lens = jnp.full((bs,), ctx, jnp.int32)
+        sc = 1.0 / float(np.sqrt(DC + DP))
+        t = _guard_soft(
+            "bench.mla", (bs, ctx, H, DC, DP, PS),
+            lambda: bench_fn_device(
+                lambda a, b, c, d: mla_paged_decode_attention(
+                    a, b, c, d, pt, lens, sm_scale=sc),
+                qn, qp, ckv, kpe, repeats=3,
+            ),
+        )
+        if t is None:
+            continue
+        # decode-bound bytes: latent + rope caches once per request
+        bytes_ = bs * ctx * (DC + 128) * 2.0
+        _emit_row(phase="mla", bs=bs, ctx=ctx, heads=H,
+                  us=round(t * 1e6, 1),
+                  tbps=round(bytes_ / t / 1e12, 4), peak=peak)
+        print(f"# mla bs={bs} ctx={ctx}: {t*1e6:9.1f} us  "
+              f"{bytes_/t/1e12:6.3f} TB/s", file=sys.stderr)
 
 
 def phase_sampling(sweep: bool):
@@ -738,6 +879,8 @@ PHASES = {
     "topk": phase_topk,
     "scans": phase_scans,
     "serving": phase_serving,
+    "prefill": phase_prefill,
+    "mla": phase_mla,
     "selftest": phase_selftest,
 }
 # selftest is CI-only (reachable via --only); production runs must not
@@ -745,7 +888,12 @@ PHASES = {
 #   decode first (the official headline metric), serving second (the
 #   BASELINE.md north star) — a mid-run wedge in a later phase must not
 #   cost either deliverable
-DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans"]
+#   decode/serving first (deliverables), then the hardware-proven phase
+#   set, then the two phases whose BENCH rows have never run on chip
+#   (prefill, mla — kernels hw-proven in the tier, the bench drivers
+#   aren't): a first-run failure there must not cost any proven row
+DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans",
+                  "prefill", "mla"]
 
 
 # --------------------------------------------------------------------------

@@ -133,14 +133,8 @@ def unpack_artifacts(bundle_path: str,
     their own authenticity guarantees.)
     """
     root = Path(cache_dir) if cache_dir else env.cache_dir()
-    # verify the ENTIRE bundle in memory first; write only after every
-    # member has passed
-    verified = []
-    with tarfile.open(bundle_path, "r:gz") as tar:
-        if _MANIFEST not in tar.getnames():
-            raise ValueError(f"{bundle_path}: missing {_MANIFEST}")
-        manifest = json.loads(tar.extractfile(_MANIFEST).read().decode())
-        seen = set()
+
+    def _members(tar, manifest):
         for member in tar.getmembers():
             if not member.isfile() or member.name == _MANIFEST:
                 continue
@@ -151,10 +145,22 @@ def unpack_artifacts(bundle_path: str,
                 raise ValueError(f"unsafe member path {member.name!r}")
             if member.name not in manifest:
                 raise ValueError(f"{member.name}: not in manifest")
-            data = tar.extractfile(member).read()
-            if hashlib.sha256(data).hexdigest() != manifest[member.name]:
+            yield member, rel
+
+    # pass 1: stream every member through sha256 — nothing is written
+    # until the WHOLE bundle has verified (O(chunk) memory, not O(bundle))
+    seen = set()
+    with tarfile.open(bundle_path, "r:gz") as tar:
+        if _MANIFEST not in tar.getnames():
+            raise ValueError(f"{bundle_path}: missing {_MANIFEST}")
+        manifest = json.loads(tar.extractfile(_MANIFEST).read().decode())
+        for member, _rel in _members(tar, manifest):
+            h = hashlib.sha256()
+            f = tar.extractfile(member)
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+            if h.hexdigest() != manifest[member.name]:
                 raise ValueError(f"{member.name}: checksum mismatch")
-            verified.append((rel, data))
             seen.add(member.name)
     dropped = set(manifest) - seen
     if dropped:
@@ -162,15 +168,22 @@ def unpack_artifacts(bundle_path: str,
             f"{bundle_path}: manifest entries missing from the bundle "
             f"(truncated/repacked?): {sorted(dropped)[:5]}"
         )
+    # pass 2: extract (the autotuner reads bundle-installed
+    # tuning_configs from the cache dir too — autotuner._load second
+    # root — overriding the package copy)
+    n = 0
     root.mkdir(parents=True, exist_ok=True)
-    for rel, data in verified:
-        # the autotuner reads bundle-installed tuning_configs from the
-        # cache dir too (autotuner._load second root), overriding the
-        # package copy
-        dest = root / rel
-        dest.parent.mkdir(parents=True, exist_ok=True)
-        dest.write_bytes(data)
-    return len(verified)
+    with tarfile.open(bundle_path, "r:gz") as tar:
+        manifest = json.loads(tar.extractfile(_MANIFEST).read().decode())
+        for member, rel in _members(tar, manifest):
+            dest = root / rel
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            f = tar.extractfile(member)
+            with open(dest, "wb") as out:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    out.write(chunk)
+            n += 1
+    return n
 
 
 def get_artifacts_status() -> Tuple[Tuple[str, bool], ...]:
