@@ -499,6 +499,54 @@ def test_masked_fused_prefill_on_chip():
     )
 
 
+def test_trace_events_prefill_on_chip():
+    """The in-kernel device-tag tracing variant (trace_events=True) must
+    Mosaic-compile and emit decodable tags on hardware — the last prefill
+    variant of the round-3/4 backlog without an on-chip verdict."""
+    from flashinfer_tpu import profiler
+    from flashinfer_tpu.ops.paged_prefill import (
+        build_prefill_work_units, fused_paged_prefill,
+    )
+
+    PS = 16
+    qo_len, kv_len = 256, 512
+    pages = kv_len // PS
+    plan_np = build_prefill_work_units(
+        np.array([0, qo_len]), np.array([0, pages]),
+        np.arange(pages, dtype=np.int32), np.array([kv_len], np.int64),
+        block_q=128, pages_per_chunk=8, page_size=PS,
+    )
+    num_units = plan_np.pop("num_units")
+    plan_np.pop("block_q"), plan_np.pop("pages_per_chunk")
+    plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
+    q = jax.random.normal(jax.random.PRNGKey(0), (qo_len, HQ, D),
+                          jnp.bfloat16)
+    kc = jax.random.normal(jax.random.PRNGKey(1), (pages, HKV, PS, D),
+                           jnp.bfloat16)
+    vc = jax.random.normal(jax.random.PRNGKey(2), (pages, HKV, PS, D),
+                           jnp.bfloat16)
+    out, events = fused_paged_prefill(
+        q, kc, vc, plan, num_units=num_units, block_q=128,
+        pages_per_chunk=8, trace_events=True,
+    )
+    # numerics unchanged by tracing
+    out_plain = fused_paged_prefill(
+        q, kc, vc, plan, num_units=num_units, block_q=128,
+        pages_per_chunk=8,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(out_plain, np.float32)
+    )
+    ev = np.asarray(events)
+    assert ev.shape == (HKV, num_units)
+    for h in range(HKV):
+        for u in range(num_units):
+            blk, grp, ei, et, sm = profiler.decode_tag(
+                int(ev[h, u]), num_units, 1
+            )
+            assert (sm, blk, et) == (h, u, 2), (h, u, ev[h, u])
+
+
 def test_gdn_pallas_kernel_on_chip():
     """Fused chunked GDN kernel vs the exact recurrence at model shapes
     (normalized keys — the delta-rule operating regime)."""
