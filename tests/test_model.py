@@ -233,3 +233,20 @@ def test_generate_example_all_families(mode):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "generate.py ok" in r.stdout
+
+
+def test_quickstart_example():
+    """examples/quickstart.py — the reference README snippet 1:1."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    r = subprocess.run(
+        [sys.executable, "examples/quickstart.py", "cpu"],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.join(os.path.dirname(__file__), ".."), env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "quickstart OK" in r.stdout
