@@ -110,6 +110,33 @@ def test_paged_decode_cross_step_prefetch(lens):
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_paged_decode_static_prefetch_fuzz(seed):
+    """Randomized chunk-count patterns (incl. zeros) through the static
+    prefetch path — it became the DEFAULT tactic, so the warmup/epilogue
+    handshake gets property coverage beyond the four fixed cases."""
+    B, HQ, HKV, D, PS, P = 5, 4, 2, 64, 8, 8
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(0, P * PS + 1, B)
+    lens[rng.integers(0, B)] = 0  # always exercise a zero-length request
+    kc = jax.random.normal(jax.random.PRNGKey(0), (48, HKV, PS, D))
+    vc = jax.random.normal(jax.random.PRNGKey(1), (48, HKV, PS, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, HQ, D))
+    pt = jnp.asarray(
+        rng.permutation(48).astype(np.int32)[: B * P].reshape(B, P)
+    )
+    lens = jnp.asarray(lens.astype(np.int32))
+    o = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2, cross_step_prefetch="static",
+    )
+    ref = paged_decode_attention(
+        q, kc, vc, pt, lens, sm_scale=0.125, kv_layout="HND",
+        pages_per_chunk=2,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.parametrize(
     "lens", [[30, 25, 60, 1], [0, 17, 64, 33], [32, 32, 32, 32], [32, 0, 48, 64]]
 )
