@@ -295,6 +295,12 @@ def gmm(
 
     Rows beyond ``sum(group_sizes)`` (padding) are left unspecified —
     callers slice to the true row count.
+
+    .. note:: the (tm, tn, tk) tile shape swings this kernel 3-4x on v5e
+       (HBM traffic ∝ tiles_n lhs re-streams + per-visit weight panels —
+       design.md §9a); the conservative signature defaults suit small
+       test shapes only.  Production callers go through ``fused_moe``,
+       which resolves measured/heuristic tiles per shape.
     """
     m, k = lhs.shape
     quantized = lhs.dtype == jnp.int8
