@@ -38,6 +38,7 @@ from flashinfer_tpu.ops.paged_decode import paged_decode_attention
 from flashinfer_tpu.ops.xla_ref import xla_paged_decode, xla_ragged_attention
 from flashinfer_tpu.utils import (
     check_kv_layout,
+    get_alibi_slopes,
     get_sm_scale,
     next_power_of_two,
     resolve_backend,
@@ -66,7 +67,13 @@ def single_decode_with_kv_cache(
 
     ``pos_encoding_mode="ROPE_LLAMA"`` applies RoPE to q at position
     ``kv_len-1`` and to k at positions ``0..kv_len-1`` before attention
-    (the reference's fused-RoPE option, decode.cuh:217)."""
+    (the reference's fused-RoPE option, decode.cuh:217).
+    ``pos_encoding_mode="ALIBI"`` adds ``slope_h * (kv_pos - (kv_len-1))``
+    to the scaled logits (reference variants.cuh:68, slopes from
+    ``get_alibi_slopes``) — served on the dense xla path."""
+    from flashinfer_tpu.utils import check_pos_encoding_mode
+
+    check_pos_encoding_mode(pos_encoding_mode)  # typos raise, not fall through
     if check_kv_layout(kv_layout) == TensorLayout.HND:
         k = jnp.swapaxes(k, 0, 1)
         v = jnp.swapaxes(v, 0, 1)
@@ -87,6 +94,12 @@ def single_decode_with_kv_cache(
         )
         q = q2[0]
     backend = resolve_backend(backend, "single_decode")
+    kw = {}
+    if pos_encoding_mode == "ALIBI":
+        from flashinfer_tpu.utils import get_alibi_slopes
+
+        backend = "xla"  # bias term lives on the dense reference path
+        kw["alibi_slopes"] = get_alibi_slopes(q.shape[0])
     fn = flash_attention if backend == "pallas" else xla_ragged_attention
     qb = q[None]  # [1, H, D]
     seg_q = jnp.zeros((1,), jnp.int32)
@@ -96,7 +109,7 @@ def single_decode_with_kv_cache(
         jnp.array([kv_len - 1], jnp.int32), jnp.arange(kv_len, dtype=jnp.int32),
         causal=False, sm_scale=sm_scale,
         logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
-        return_lse=return_lse,
+        return_lse=return_lse, **kw,
     )
     if return_lse:
         return out[0][0], out[1][0]
@@ -119,6 +132,8 @@ class _DecodePlan:
     logits_soft_cap: float
     window_left: int
     q_data_type: object = None
+    pos_encoding_mode: str = "NONE"
+    alibi_slopes: object = None  # [num_qo_heads] f32, ALIBI mode only
 
 
 class BatchDecodeWithPagedKVCacheWrapper:
@@ -164,7 +179,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
         non_blocking: bool = True,
         seq_lens=None,
     ) -> None:
-        if pos_encoding_mode not in ("NONE",):
+        if pos_encoding_mode not in ("NONE", "ALIBI"):
             raise NotImplementedError(
                 "fused RoPE in batch decode: apply flashinfer_tpu.rope first"
             )
@@ -196,6 +211,13 @@ class BatchDecodeWithPagedKVCacheWrapper:
             logits_soft_cap=logits_soft_cap or 0.0,
             window_left=window_left,
             q_data_type=jnp.dtype(q_data_type) if q_data_type else None,
+            pos_encoding_mode=pos_encoding_mode,
+            # slopes are plan-derived: computed once here, not per decode
+            # step in run()
+            alibi_slopes=(
+                get_alibi_slopes(num_qo_heads)
+                if pos_encoding_mode == "ALIBI" else None
+            ),
         )
 
     def run(
@@ -246,6 +268,12 @@ class BatchDecodeWithPagedKVCacheWrapper:
             q = jnp.pad(q, ((0, b_pad - batch), (0, 0), (0, 0)))
 
         backend = resolve_backend(self._backend, "batch_decode")
+        alibi_kw = {}
+        if plan.alibi_slopes is not None:
+            # ALiBi rides the dense xla path (the bias term is not a
+            # Pallas-kernel mode); reference decode qo position = last
+            backend = "xla"
+            alibi_kw["alibi_slopes"] = plan.alibi_slopes
         if backend == "pallas":
             # autotuned pages-per-chunk (reference AutoTuner.choose_one role;
             # zero overhead outside an autotune() context — cached/default)
@@ -324,7 +352,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
                 q, k_cache, v_cache, plan.page_table, plan.kv_lens,
                 sm_scale=sm_scale, logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left, return_lse=return_lse,
-                kv_layout=self._kv_layout,
+                kv_layout=self._kv_layout, **alibi_kw,
             )
         if return_lse:
             o, lse = out

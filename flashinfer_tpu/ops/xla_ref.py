@@ -47,10 +47,14 @@ def xla_ragged_attention(
     window_left: int = -1,
     return_lse: bool = False,
     custom_mask: Optional[jax.Array] = None,  # [total_q, total_kv] bool
+    alibi_slopes: Optional[jax.Array] = None,  # [num_qo_heads] f32
 ):
     """Same contract as ops.flash_attention.flash_attention, plus an
     optional dense custom mask (the xla backend serves the reference's
-    custom-mask modes; the Pallas kernel handles the structured masks)."""
+    custom-mask modes; the Pallas kernel handles the structured masks)
+    and optional ALiBi slopes (``logits*sm_scale + slope_h*(kv_pos -
+    q_pos)``, reference variants.cuh:68-70; per-row constant offsets
+    cancel in softmax, so position-origin conventions agree)."""
     num_qo_heads = q.shape[1]
     num_kv_heads = k.shape[1]
     group = num_qo_heads // num_kv_heads
@@ -58,6 +62,9 @@ def xla_ragged_attention(
     kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
     vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
     s = jnp.einsum("qhd,khd->hqk", qf, kf, precision=_PREC) * sm_scale
+    if alibi_slopes is not None:
+        rel = (kv_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+        s = s + alibi_slopes.astype(jnp.float32)[:, None, None] * rel[None]
     if logits_soft_cap > 0.0:
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
     mask = q_seg[:, None] == kv_seg[None, :]
@@ -98,9 +105,12 @@ def xla_paged_decode(
     window_left: int = -1,
     return_lse: bool = False,
     kv_layout: str = "NHD",
+    alibi_slopes: Optional[jax.Array] = None,  # [num_qo_heads] f32
 ):
     """Dense-gather paged decode reference: gathers the page table into a
-    padded [batch, max_kv, Hkv, D] tensor, then masked attention."""
+    padded [batch, max_kv, Hkv, D] tensor, then masked attention.
+    ``alibi_slopes``: decode-form ALiBi, ``slope_h * (pos - (kv_len-1))``
+    (reference decode qo_idx is the final position)."""
     if kv_layout == "HND":
         k_cache = jnp.swapaxes(k_cache, 1, 2)
         v_cache = jnp.swapaxes(v_cache, 1, 2)
@@ -120,6 +130,12 @@ def xla_paged_decode(
 
     s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kg,
                    precision=_PREC) * sm_scale
+    if alibi_slopes is not None:
+        rel = (
+            jnp.arange(max_kv)[None, :] - (kv_lens[:, None] - 1)
+        ).astype(jnp.float32)
+        s = s + (alibi_slopes.astype(jnp.float32)[None, :, None]
+                 * rel[:, None, :])
     if logits_soft_cap > 0.0:
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
     pos = jnp.arange(max_kv)[None, :]

@@ -35,6 +35,7 @@ from flashinfer_tpu.ops.xla_ref import xla_ragged_attention
 from flashinfer_tpu.utils import (
     check_kv_layout,
     fold_scalar_scale,
+    get_alibi_slopes,
     get_sm_scale,
     next_power_of_two,
     resolve_backend,
@@ -43,6 +44,24 @@ from flashinfer_tpu.utils import (
 
 _Q_PAD_SEG = -1
 _KV_PAD_SEG = -2
+
+# ALiBi rides the dense xla path, which materializes [H, Tq_pad, Tkv_pad]
+# f32 logits; cap that tensor so a long-context ALiBi prefill fails with
+# instructions instead of an opaque device OOM (the Pallas flash kernel
+# has no bias mode yet — chunk the prefill or precompute additive masks)
+_ALIBI_DENSE_LOGITS_CAP = 4 << 30
+
+
+def _check_alibi_dense_size(num_heads: int, tq: int, tkv: int) -> None:
+    need = num_heads * tq * tkv * 4
+    if need > _ALIBI_DENSE_LOGITS_CAP:
+        raise NotImplementedError(
+            f"pos_encoding_mode='ALIBI' runs on the dense path; this "
+            f"geometry needs {need / (1 << 30):.1f} GiB of logits "
+            f"({num_heads} heads x {tq} x {tkv}). Chunk the prefill to "
+            f"shorter qo spans (kv length is the roofline term that "
+            f"matters) or open an issue for a biased flash kernel."
+        )
 
 # flash-kernel launch-geometry candidates: (block_q, block_kv).  The tactic
 # space the reference explores per-arch via jinja template instantiation
@@ -151,10 +170,13 @@ def single_prefill_with_kv_cache(
     kv_cache_sf[v] multiply the output.  Non-scalar (per-head/block)
     scale tensors are a different numerics regime and are rejected.
     ``use_fp16_qk_reduction`` is a CUDA-accumulator knob (inert: the MXU
-    accumulates f32); rope_scale/rope_theta only apply with
-    pos_encoding_mode != NONE, which raises (apply flashinfer_tpu.rope
-    explicitly)."""
-    if pos_encoding_mode != "NONE":
+    accumulates f32); rope_scale/rope_theta only apply with RoPE
+    pos_encoding_modes, which raise (apply flashinfer_tpu.rope
+    explicitly).  ``pos_encoding_mode="ALIBI"`` adds
+    ``slope_h * (kv_pos - q_pos)`` to the scaled logits (reference
+    variants.cuh:68) on the dense xla backend."""
+    alibi = pos_encoding_mode == "ALIBI"
+    if pos_encoding_mode != "NONE" and not alibi:
         raise NotImplementedError(
             "apply flashinfer_tpu.rope explicitly before attention"
         )
@@ -195,6 +217,11 @@ def single_prefill_with_kv_cache(
         )
         custom_mask = bits.reshape(qo_len, kv_len).astype(bool)
     backend = resolve_backend(backend, "single_prefill")
+    kw = {}
+    if alibi:
+        _check_alibi_dense_size(q.shape[1], qo_len, kv_len)
+        backend = "xla"  # bias term lives on the dense reference path
+        kw["alibi_slopes"] = get_alibi_slopes(q.shape[1])
     args = (
         q, k, v,
         jnp.zeros((qo_len,), jnp.int32), jnp.zeros((kv_len,), jnp.int32),
@@ -208,13 +235,14 @@ def single_prefill_with_kv_cache(
             *args, custom_mask=custom_mask, causal=False,
             window_left=window_left, sm_scale=sm_scale,
             logits_soft_cap=logits_soft_cap or 0.0, return_lse=return_lse,
+            **kw,
         )
     else:
         fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
         res = fn(
             *args, causal=causal, sm_scale=sm_scale,
             logits_soft_cap=logits_soft_cap or 0.0,
-            window_left=window_left, return_lse=return_lse,
+            window_left=window_left, return_lse=return_lse, **kw,
         )
     if out_mul == 1.0 and o_dtype is None:
         return res
@@ -331,6 +359,8 @@ class _PrefillPlan:
     logits_soft_cap: float
     window_left: int
     custom_mask: Optional[jax.Array] = None  # [Tq_pad, Tkv_pad] bool (dense)
+    # pos_encoding_mode="ALIBI": plan-derived slope vector (dense xla path)
+    alibi_slopes: Optional[jax.Array] = None
 
 
 def _build_token_axis(
@@ -379,11 +409,13 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_data_type=None,
         **_unused,
     ) -> None:
-        if pos_encoding_mode != "NONE":
+        alibi = pos_encoding_mode == "ALIBI"
+        if pos_encoding_mode != "NONE" and not alibi:
             raise NotImplementedError(
                 "TPU backend: fused-RoPE attention variants are explicit "
                 "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
-                "append path) before plan/run"
+                "append path) before plan/run; pos_encoding_mode='ALIBI' "
+                "is served on the dense xla path"
             )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr = np.asarray(kv_indptr)
@@ -392,6 +424,8 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_lens = kv_indptr[1:] - kv_indptr[:-1]
         tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
         tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
+        if alibi:
+            _check_alibi_dense_size(num_qo_heads, tq_pad, tkv_pad)
         # bottom-right causal alignment: q token i of request r sits at
         # absolute position kv_len_r - qo_len_r + i
         q_seg, q_pos, total_q = _build_token_axis(
@@ -419,6 +453,9 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
             logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
             custom_mask=dense_mask,
+            alibi_slopes=(
+                get_alibi_slopes(num_qo_heads) if alibi else None
+            ),
         )
 
     def run(
@@ -439,6 +476,10 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             k = jnp.pad(k, ((0, tkv - k.shape[0]), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, tkv - v.shape[0]), (0, 0), (0, 0)))
         backend = resolve_backend(self._backend, "batch_prefill_ragged")
+        alibi_kw = {}
+        if plan.alibi_slopes is not None:
+            backend = "xla"  # the bias term lives on the dense path
+            alibi_kw["alibi_slopes"] = plan.alibi_slopes
         if plan.custom_mask is not None:
             # custom-mask mode runs on the dense xla backend; sliding window
             # still ANDs in (reference variants.cuh LogitsMask — only causal
@@ -449,6 +490,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
                 logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left,
                 return_lse=return_lse, custom_mask=plan.custom_mask,
+                **alibi_kw,
             )
         else:
             fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
@@ -457,6 +499,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
                 causal=plan.causal, sm_scale=plan.sm_scale,
                 logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left, return_lse=return_lse,
+                **alibi_kw,
             )
         if return_lse:
             return out[0][: plan.total_q], out[1][: plan.total_q]
@@ -511,11 +554,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
         kv_data_type=None,
         **_unused,
     ) -> None:
-        if pos_encoding_mode != "NONE":
+        alibi = pos_encoding_mode == "ALIBI"
+        if pos_encoding_mode != "NONE" and not alibi:
             raise NotImplementedError(
                 "TPU backend: fused-RoPE attention variants are explicit "
                 "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
-                "append path) before plan/run"
+                "append path) before plan/run; pos_encoding_mode='ALIBI' "
+                "is served on the dense xla path"
             )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr_pages = np.asarray(paged_kv_indptr)
@@ -533,6 +578,8 @@ class BatchPrefillWithPagedKVCacheWrapper:
 
         tq_pad = max(next_power_of_two(int(qo_indptr[-1])), 128)
         tkv_pad = max(next_power_of_two(int(kv_indptr[-1])), 128)
+        if alibi:
+            _check_alibi_dense_size(num_qo_heads, tq_pad, tkv_pad)
 
         # paged-batch MaskMode::CUSTOM (reference prefill.py:1117-2947):
         # the fused work-unit kernel consumes the packed mask directly
@@ -587,10 +634,14 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 logits_soft_cap=logits_soft_cap or 0.0,
                 window_left=window_left,
                 custom_mask=dense_mask,
+                alibi_slopes=(
+                    get_alibi_slopes(num_qo_heads) if alibi else None
+                ),
             )
 
         self._gather_plan_builder = build_gather_plan
-        use_fused = (
+        # ALiBi is a dense-path mode (the fused kernel has no bias term)
+        use_fused = (not alibi) and (
             self._backend == "pallas_fused" or (
             # hardware-validated default for the TPU-preferred HND layout;
             # NHD would need a whole-cache transpose per run() to feed the
@@ -774,6 +825,9 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq = plan.tq_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
+        alibi_kw = {}
+        if plan.alibi_slopes is not None:
+            alibi_kw["alibi_slopes"] = plan.alibi_slopes
         if plan.custom_mask is not None:
             # paged-batch MaskMode::CUSTOM runs on the dense xla backend
             # over the gathered KV (same contract as the ragged wrapper)
@@ -782,19 +836,22 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 causal=False, sm_scale=plan.sm_scale,
                 logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left, return_lse=return_lse,
-                custom_mask=plan.custom_mask,
+                custom_mask=plan.custom_mask, **alibi_kw,
             )
         else:
             backend = resolve_backend(
                 "pallas" if self._backend == "pallas_fused" else self._backend,
                 "batch_prefill_paged",
             )
+            if plan.alibi_slopes is not None:
+                backend = "xla"  # the bias term lives on the dense path
             fn = _tuned_flash if backend == "pallas" else xla_ragged_attention
             out = fn(
                 q, k, v, plan.q_seg, plan.kv_seg, plan.q_pos, plan.kv_pos,
                 causal=plan.causal, sm_scale=plan.sm_scale,
                 logits_soft_cap=plan.logits_soft_cap,
                 window_left=plan.window_left, return_lse=return_lse,
+                **alibi_kw,
             )
         if return_lse:
             return out[0][: plan.total_q], out[1][: plan.total_q]

@@ -603,24 +603,6 @@ log2e = 1.44269504088896340736
 SINGLE_KERNEL_TMP_SIZE = 0
 
 
-def get_alibi_slopes(n_heads: int, device=None):
-    """ALiBi head slopes (reference utils.get_alibi_slopes): geometric
-    sequence 2^(-8i/n) with the odd-head interleave extension."""
-    import math
-
-    import jax.numpy as jnp
-
-    n = 2 ** math.floor(math.log2(n_heads))
-    m = jnp.power(2.0 ** (-8.0 / n), jnp.arange(1, 1 + n, dtype=jnp.float32))
-    if n < n_heads:
-        m_hat = jnp.power(
-            2.0 ** (-4.0 / n),
-            jnp.arange(1, 1 + 2 * (n_heads - n), 2, dtype=jnp.float32),
-        )
-        m = jnp.concatenate([m, m_hat])
-    return m
-
-
 def determine_attention_backend(*_, **__) -> str:
     """Reference picks fa2/fa3/trtllm per arch; one answer here."""
     return "pallas"

@@ -1,0 +1,190 @@
+"""ALiBi (pos_encoding_mode="ALIBI") vs an independent numpy oracle.
+
+Ported reference matrix: ``/root/reference/tests/attention/test_alibi.py``
+(single decode + single prefill), extended to the batch wrappers.  The
+oracle follows the reference helper's formula (bias = slope_h * kv_pos —
+row-constant shifts cancel in softmax, so this equals the kernels'
+``slope_h * (kv_pos - q_pos)``), with slopes from ``get_alibi_slopes``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.utils import get_alibi_slopes
+
+
+def _oracle(q, k, v, mask, slopes):
+    """[q, H, D] x [kv, H, D] dense ALiBi attention in f64."""
+    qn = np.asarray(q, np.float64)
+    kn = np.asarray(k, np.float64)
+    vn = np.asarray(v, np.float64)
+    ql, H, D = qn.shape
+    s = np.einsum("qhd,khd->hqk", qn, kn) / np.sqrt(D)
+    bias = np.asarray(slopes, np.float64)[:, None, None] * np.arange(
+        kn.shape[0]
+    )[None, None, :]
+    s = s + bias
+    s = np.where(mask[None], s, -np.inf)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("hqk,khd->qhd", p, vn)
+
+
+@pytest.mark.parametrize("seq_len", [1, 81, 729])
+@pytest.mark.parametrize("num_heads", [8, 12])
+def test_single_decode_alibi(seq_len, num_heads):
+    D = 128
+    key = jax.random.PRNGKey(seq_len)
+    q = jax.random.normal(key, (num_heads, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (seq_len, num_heads, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (seq_len, num_heads, D),
+                          jnp.float32)
+    o = fi.single_decode_with_kv_cache(q, k, v, pos_encoding_mode="ALIBI")
+    ref = _oracle(np.asarray(q)[None], k, v,
+                  np.ones((1, seq_len), bool),
+                  get_alibi_slopes(num_heads))[0]
+    np.testing.assert_allclose(np.asarray(o, np.float32), ref,
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("q_len,kv_len", [(1, 17), (17, 17), (17, 81),
+                                          (81, 81)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_single_prefill_alibi(q_len, kv_len, causal):
+    H, D = 8, 128
+    key = jax.random.PRNGKey(q_len * 1000 + kv_len)
+    q = jax.random.normal(key, (q_len, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (kv_len, H, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (kv_len, H, D),
+                          jnp.float32)
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=causal, pos_encoding_mode="ALIBI"
+    )
+    mask = np.ones((q_len, kv_len), bool)
+    if causal:
+        mask = np.tril(mask, k=kv_len - q_len)
+    ref = _oracle(q, k, v, mask, get_alibi_slopes(H))
+    np.testing.assert_allclose(np.asarray(o, np.float32), ref,
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_batch_decode_alibi_wrapper():
+    """plan(pos_encoding_mode='ALIBI') reaches the dense path with the
+    decode-form bias; compared per request against the oracle."""
+    B, HQ, HKV, D, PS = 3, 8, 8, 128, 8
+    lens = [24, 8, 17]
+    pages_per = [(x + PS - 1) // PS for x in lens]
+    total_pages = sum(pages_per)
+    key = jax.random.PRNGKey(0)
+    kc = jax.random.normal(key, (total_pages, HKV, PS, D), jnp.float32)
+    vc = jax.random.normal(jax.random.fold_in(key, 1),
+                           (total_pages, HKV, PS, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, HQ, D),
+                          jnp.float32)
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    last = np.asarray([x - (p - 1) * PS for x, p in zip(lens, pages_per)],
+                      np.int32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(indptr, np.arange(total_pages, dtype=np.int32), last,
+           HQ, HKV, D, PS, pos_encoding_mode="ALIBI")
+    o = np.asarray(w.run(q, (kc, vc)), np.float32)
+    slopes = get_alibi_slopes(HQ)
+    kflat = np.asarray(jnp.swapaxes(kc, 1, 2)).reshape(-1, HKV, D)
+    vflat = np.asarray(jnp.swapaxes(vc, 1, 2)).reshape(-1, HKV, D)
+    for b in range(B):
+        rows = slice(int(indptr[b]) * PS, int(indptr[b]) * PS + lens[b])
+        ref = _oracle(np.asarray(q[b])[None], kflat[rows], vflat[rows],
+                      np.ones((1, lens[b]), bool), slopes)[0]
+        np.testing.assert_allclose(o[b], ref, rtol=1e-3, atol=1e-3,
+                                   err_msg=f"request {b}")
+
+
+def test_batch_ragged_prefill_alibi_wrapper():
+    B, H, D = 2, 8, 128
+    qo = np.array([0, 13, 30], np.int32)
+    kv = np.array([0, 29, 62], np.int32)
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (int(qo[-1]), H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (int(kv[-1]), H, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (int(kv[-1]), H, D),
+                          jnp.float32)
+    w = fi.BatchPrefillWithRaggedKVCacheWrapper()
+    w.plan(qo, kv, H, H, D, causal=True, pos_encoding_mode="ALIBI")
+    o = np.asarray(w.run(q, k, v), np.float32)
+    slopes = get_alibi_slopes(H)
+    for b in range(B):
+        qs, ks = slice(qo[b], qo[b + 1]), slice(kv[b], kv[b + 1])
+        ql, kl = int(qo[b + 1] - qo[b]), int(kv[b + 1] - kv[b])
+        mask = np.tril(np.ones((ql, kl), bool), k=kl - ql)
+        ref = _oracle(np.asarray(q)[qs], np.asarray(k)[ks],
+                      np.asarray(v)[ks], mask, slopes)
+        np.testing.assert_allclose(o[qs], ref, rtol=1e-2, atol=1e-2,
+                                   err_msg=f"request {b}")
+
+
+def test_batch_paged_prefill_alibi_wrapper():
+    """ALiBi forces the paged wrapper off the fused kernel onto the
+    gathered dense path (plan-time `use_fused` gate)."""
+    B, H, D, PS = 2, 8, 128, 8
+    qo = np.array([0, 13, 30], np.int32)
+    kv_lens = [29, 33]
+    pages_per = [(x + PS - 1) // PS for x in kv_lens]
+    kv_pages = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    total_pages = int(kv_pages[-1])
+    key = jax.random.PRNGKey(7)
+    kc = jax.random.normal(key, (total_pages, H, PS, D), jnp.float32)
+    vc = jax.random.normal(jax.random.fold_in(key, 1),
+                           (total_pages, H, PS, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (int(qo[-1]), H, D),
+                          jnp.float32)
+    last = np.asarray(
+        [x - (p - 1) * PS for x, p in zip(kv_lens, pages_per)], np.int32
+    )
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(qo, kv_pages, np.arange(total_pages, dtype=np.int32), last,
+           H, H, D, PS, causal=True, pos_encoding_mode="ALIBI")
+    assert w._fused_plan is None  # dense path forced
+    o = np.asarray(w.run(q, (kc, vc)), np.float32)
+    slopes = get_alibi_slopes(H)
+    kflat = np.asarray(jnp.swapaxes(kc, 1, 2)).reshape(-1, H, D)
+    vflat = np.asarray(jnp.swapaxes(vc, 1, 2)).reshape(-1, H, D)
+    for b in range(B):
+        qs = slice(int(qo[b]), int(qo[b + 1]))
+        rows = slice(int(kv_pages[b]) * PS,
+                     int(kv_pages[b]) * PS + kv_lens[b])
+        ql, kl = int(qo[b + 1] - qo[b]), kv_lens[b]
+        mask = np.tril(np.ones((ql, kl), bool), k=kl - ql)
+        ref = _oracle(np.asarray(q)[qs], kflat[rows], vflat[rows], mask,
+                      slopes)
+        np.testing.assert_allclose(o[qs], ref, rtol=1e-2, atol=1e-2,
+                                   err_msg=f"request {b}")
+
+
+def test_alibi_rejects_other_modes_still():
+    q = jnp.zeros((8, 128), jnp.float32)
+    k = jnp.zeros((4, 8, 128), jnp.float32)
+    with pytest.raises(NotImplementedError):
+        fi.single_prefill_with_kv_cache(
+            jnp.zeros((4, 8, 128)), k, k, pos_encoding_mode="ROPE_LLAMA"
+        )
+    # typos raise (reference PosEncodingMode[...] KeyError), never fall
+    # through to unpositioned attention
+    with pytest.raises(KeyError):
+        fi.single_decode_with_kv_cache(q, k, k, pos_encoding_mode="ALIBI ")
+
+
+def test_alibi_dense_memory_guard():
+    """A long-context ALiBi prefill must fail with instructions, not an
+    opaque device OOM (dense logits cap)."""
+    from flashinfer_tpu.prefill import _check_alibi_dense_size
+
+    _check_alibi_dense_size(8, 4096, 4096)  # fine
+    with pytest.raises(NotImplementedError, match="dense path"):
+        _check_alibi_dense_size(32, 65536, 65536)
