@@ -38,6 +38,7 @@ from flashinfer_tpu.ops.paged_decode import paged_decode_attention
 from flashinfer_tpu.ops.xla_ref import xla_paged_decode, xla_ragged_attention
 from flashinfer_tpu.utils import (
     check_kv_layout,
+    check_pos_encoding_mode,
     get_alibi_slopes,
     get_sm_scale,
     next_power_of_two,
@@ -71,8 +72,6 @@ def single_decode_with_kv_cache(
     ``pos_encoding_mode="ALIBI"`` adds ``slope_h * (kv_pos - (kv_len-1))``
     to the scaled logits (reference variants.cuh:68, slopes from
     ``get_alibi_slopes``) — served on the dense xla path."""
-    from flashinfer_tpu.utils import check_pos_encoding_mode
-
     check_pos_encoding_mode(pos_encoding_mode)  # typos raise, not fall through
     if check_kv_layout(kv_layout) == TensorLayout.HND:
         k = jnp.swapaxes(k, 0, 1)
@@ -96,8 +95,6 @@ def single_decode_with_kv_cache(
     backend = resolve_backend(backend, "single_decode")
     kw = {}
     if pos_encoding_mode == "ALIBI":
-        from flashinfer_tpu.utils import get_alibi_slopes
-
         backend = "xla"  # bias term lives on the dense reference path
         kw["alibi_slopes"] = get_alibi_slopes(q.shape[0])
     fn = flash_attention if backend == "pallas" else xla_ragged_attention
@@ -179,6 +176,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
         non_blocking: bool = True,
         seq_lens=None,
     ) -> None:
+        check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
         if pos_encoding_mode not in ("NONE", "ALIBI"):
             raise NotImplementedError(
                 "fused RoPE in batch decode: apply flashinfer_tpu.rope first"

@@ -18,6 +18,8 @@ VMEM scratch carried across the innermost kv dimension.
 from __future__ import annotations
 
 import functools
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -31,32 +33,34 @@ _NEG_INF = -1e30
 
 
 def _flash_kernel(
-    # scalar-prefetch
+    # scalar-prefetch: skip map (+ ALiBi slopes when use_alibi)
     skip_ref,  # [nq * nkv] i32: 1 = block provably all-masked, skip compute
-    # inputs
-    q_ref,  # [bq, head_dim]
-    k_ref,  # [bkv, head_dim]
-    v_ref,  # [bkv, head_dim]
-    q_seg_ref,  # [bq, 1] int32
-    kv_seg_ref,  # [1, bkv] int32 (lane-resident; 2-D because 1-D operands
-    # hit XLA-vs-Mosaic tiling mismatches at large sizes: XLA picks T(1024)
-    # for s32[4096] while Mosaic expects T(bkv))
-    q_pos_ref,  # [bq, 1] int32
-    kv_pos_ref,  # [1, bkv] int32
-    # outputs (lse_ref only present when return_lse)
-    *rest,
+    *rest_all,
     sm_scale: float,
     causal: bool,
     logits_soft_cap: float,
     window_left: int,
     num_kv_blocks: int,
     return_lse: bool,
+    use_alibi: bool = False,
 ):
+    # operand order (after skip_ref): [slopes_ref?], q_ref [bq, head_dim],
+    # k_ref/v_ref [bkv, head_dim], q_seg_ref [bq, 1], kv_seg_ref [1, bkv]
+    # (lane-resident; 2-D because 1-D operands hit XLA-vs-Mosaic tiling
+    # mismatches at large sizes), q_pos_ref [bq, 1], kv_pos_ref [1, bkv],
+    # outputs (lse_ref only when return_lse), scratch
+    if use_alibi:
+        slopes_ref, *rest_all = rest_all
+    else:
+        slopes_ref = None
+    (q_ref, k_ref, v_ref, q_seg_ref, kv_seg_ref, q_pos_ref, kv_pos_ref,
+     *rest) = rest_all
     if return_lse:
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
         lse_ref = None
+    head_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
 
@@ -74,14 +78,19 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         )  # [bq, bkv] f32
         s = s * sm_scale
-        if logits_soft_cap > 0.0:
-            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
-
         q_seg = q_seg_ref[...]  # [bq, 1]
         kv_seg = kv_seg_ref[...]  # [1, bkv] — lane broadcast, free
         mask = q_seg == kv_seg
         q_pos = q_pos_ref[...]
         kv_pos = kv_pos_ref[...]
+        if use_alibi:
+            # reference variants.cuh:68 — bias after scale, before the
+            # soft-cap transform; (1, bkv) - (bq, 1) broadcasts like the
+            # causal mask compare below
+            slope = slopes_ref[head_idx]
+            s = s + slope * (kv_pos - q_pos).astype(jnp.float32)
+        if logits_soft_cap > 0.0:
+            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
         if causal:
             mask = mask & (kv_pos <= q_pos)
         if window_left >= 0:
@@ -138,12 +147,15 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_kv: int = DEFAULT_BLOCK_KV,
     return_lse: bool = False,
+    alibi_slopes: Optional[jax.Array] = None,  # [num_qo_heads] f32
 ):
     """Ragged flash attention over flattened token axes.
 
     GQA is handled by mapping each q head to its kv head (``h // group``) in
     the kv BlockSpec index map.  Padding tokens must carry distinct negative
-    segment ids on the q/kv sides so they never match.
+    segment ids on the q/kv sides so they never match.  ``alibi_slopes``
+    adds ``slope_h * (kv_pos - q_pos)`` to the scaled logits in-kernel
+    (SMEM scalar per grid head — no dense bias tensor).
     """
     total_q, num_qo_heads, head_dim = q.shape
     total_kv, num_kv_heads, head_dim_vo = v.shape[0], v.shape[1], v.shape[2]
@@ -218,6 +230,7 @@ def flash_attention(
         window_left=window_left,
         num_kv_blocks=nkv,
         return_lse=return_lse,
+        use_alibi=alibi_slopes is not None,
     )
 
     out_specs = [
@@ -232,8 +245,13 @@ def flash_attention(
             jax.ShapeDtypeStruct((num_qo_heads, tq_pad, 128), jnp.float32)
         )
 
+    prefetch = [skip_map]
+    if alibi_slopes is not None:
+        prefetch.append(
+            jnp.asarray(alibi_slopes, jnp.float32).reshape(num_qo_heads)
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(prefetch),
         grid=(num_qo_heads, nq, nkv),
         in_specs=[
             pl.BlockSpec((None, bq, head_dim), lambda h, i, j, *_: (h, i, 0)),
@@ -268,7 +286,7 @@ def flash_attention(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=use_interpret(),
-    )(skip_map, qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)
+    )(*prefetch, qT, kT, vT, q_seg2, kv_seg2, q_pos2, kv_pos2)
 
     out = jnp.swapaxes(results[0], 0, 1)[:total_q]  # [Tq, H, D]
     if return_lse:

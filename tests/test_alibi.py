@@ -180,6 +180,32 @@ def test_alibi_rejects_other_modes_still():
         fi.single_decode_with_kv_cache(q, k, k, pos_encoding_mode="ALIBI ")
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hkv", [8, 2])
+def test_alibi_flash_kernel_matches_dense(causal, hkv):
+    """The in-kernel ALiBi bias (explicit backend='pallas', SMEM slope
+    per grid head) must match the dense xla path — interpret mode here,
+    on-chip in the hardware tier.  GQA case included (slopes are per QO
+    head, the kv head map is h // group)."""
+    q_len, kv_len, H, D = 64, 160, 8, 128
+    key = jax.random.PRNGKey(11)
+    q = jax.random.normal(key, (q_len, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (kv_len, hkv, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (kv_len, hkv, D),
+                          jnp.float32)
+    o_kernel = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=causal, pos_encoding_mode="ALIBI", backend="pallas"
+    )
+    o_dense = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=causal, pos_encoding_mode="ALIBI"
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_kernel, np.float32), np.asarray(o_dense, np.float32),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
 def test_alibi_dense_memory_guard():
     """A long-context ALiBi prefill must fail with instructions, not an
     opaque device OOM (dense logits cap)."""

@@ -499,6 +499,27 @@ def test_masked_fused_prefill_on_chip():
     )
 
 
+def test_alibi_flash_kernel_on_chip():
+    """In-kernel ALiBi bias (f32 slopes as a scalar-prefetch operand +
+    per-head SMEM read) must Mosaic-compile and match the dense oracle."""
+    q_len, kv_len = 256, 1024
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(key, (q_len, HQ, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (kv_len, HKV, D),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (kv_len, HKV, D),
+                          jnp.bfloat16)
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=True, pos_encoding_mode="ALIBI", backend="pallas"
+    )
+    ref = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=True, pos_encoding_mode="ALIBI"
+    )
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(ref, np.float32), **BF16_TOL
+    )
+
+
 def test_trace_events_prefill_on_chip():
     """The in-kernel device-tag tracing variant (trace_events=True) must
     Mosaic-compile and emit decodable tags on hardware — the last prefill
