@@ -80,18 +80,16 @@ def single_decode_with_kv_cache(
     head_dim = q.shape[-1]
     sm_scale = get_sm_scale(head_dim, sm_scale)
     if pos_encoding_mode == "ROPE_LLAMA":
-        from flashinfer_tpu.rope import apply_rope_pos_ids
+        from flashinfer_tpu.rope import rotate_at_positions
 
-        q2, _ = apply_rope_pos_ids(
-            q[None], k[:1], jnp.array([kv_len - 1], jnp.int32),
-            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
+        q = rotate_at_positions(
+            q[None], jnp.array([kv_len - 1], jnp.int32),
+            rope_scale or 1.0, rope_theta or 1e4,
+        )[0]
+        k = rotate_at_positions(
+            k, jnp.arange(kv_len, dtype=jnp.int32),
+            rope_scale or 1.0, rope_theta or 1e4,
         )
-        _, k = apply_rope_pos_ids(
-            jnp.zeros((kv_len, 1, head_dim), q.dtype), k,
-            jnp.arange(kv_len, dtype=jnp.int32),
-            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
-        )
-        q = q2[0]
     backend = resolve_backend(backend, "single_decode")
     kw = {}
     if pos_encoding_mode == "ALIBI":
@@ -131,6 +129,7 @@ class _DecodePlan:
     q_data_type: object = None
     pos_encoding_mode: str = "NONE"
     alibi_slopes: object = None  # [num_qo_heads] f32, ALIBI mode only
+    rope: object = None  # (rope_scale, rope_theta), ROPE_LLAMA mode only
 
 
 class BatchDecodeWithPagedKVCacheWrapper:
@@ -177,10 +176,6 @@ class BatchDecodeWithPagedKVCacheWrapper:
         seq_lens=None,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
-        if pos_encoding_mode not in ("NONE", "ALIBI"):
-            raise NotImplementedError(
-                "fused RoPE in batch decode: apply flashinfer_tpu.rope first"
-            )
         from flashinfer_tpu import native
 
         indptr = np.asarray(indptr)
@@ -215,6 +210,10 @@ class BatchDecodeWithPagedKVCacheWrapper:
             alibi_slopes=(
                 get_alibi_slopes(num_qo_heads)
                 if pos_encoding_mode == "ALIBI" else None
+            ),
+            rope=(
+                (rope_scale or 1.0, rope_theta or 1e4)
+                if pos_encoding_mode == "ROPE_LLAMA" else None
             ),
         )
 
@@ -272,6 +271,11 @@ class BatchDecodeWithPagedKVCacheWrapper:
             # Pallas-kernel mode); reference decode qo position = last
             backend = "xla"
             alibi_kw["alibi_slopes"] = plan.alibi_slopes
+        if plan.rope is not None:
+            # in-attention RoPE over an UNROTATED cache: the dense path
+            # rotates gathered keys at their positions (decode.cuh:217)
+            backend = "xla"
+            alibi_kw["rope"] = plan.rope
         if backend == "pallas":
             # autotuned pages-per-chunk (reference AutoTuner.choose_one role;
             # zero overhead outside an autotune() context — cached/default)

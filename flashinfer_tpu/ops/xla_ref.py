@@ -106,11 +106,15 @@ def xla_paged_decode(
     return_lse: bool = False,
     kv_layout: str = "NHD",
     alibi_slopes: Optional[jax.Array] = None,  # [num_qo_heads] f32
+    rope: Optional[Tuple[float, float]] = None,  # (scale, theta)
 ):
     """Dense-gather paged decode reference: gathers the page table into a
     padded [batch, max_kv, Hkv, D] tensor, then masked attention.
     ``alibi_slopes``: decode-form ALiBi, ``slope_h * (pos - (kv_len-1))``
-    (reference decode qo_idx is the final position)."""
+    (reference decode qo_idx is the final position).  ``rope``: the
+    in-attention ROPE_LLAMA mode — the UNROTATED cache's gathered keys
+    rotate at positions 0..len-1 and q rotates at kv_len-1 (reference
+    decode.cuh:217)."""
     if kv_layout == "HND":
         k_cache = jnp.swapaxes(k_cache, 1, 2)
         v_cache = jnp.swapaxes(v_cache, 1, 2)
@@ -125,6 +129,21 @@ def xla_paged_decode(
     vg = v_cache[page_table]
     kg = kg.reshape(batch, max_kv, num_kv_heads, -1)
     vg = vg.reshape(batch, max_kv, num_kv_heads, -1)
+    if rope is not None:
+        from flashinfer_tpu.rope import rotate_at_positions
+
+        rs, rt = rope
+        # rotate AFTER the f32 upcast: rotating in the cache dtype would
+        # re-quantize every key (material error for fp8/int8 caches)
+        q = rotate_at_positions(
+            q.astype(jnp.float32),
+            jnp.maximum(kv_lens.astype(jnp.int32) - 1, 0), rs, rt,
+        )
+        kg = rotate_at_positions(
+            kg.reshape(batch * max_kv, num_kv_heads, head_dim)
+            .astype(jnp.float32),
+            jnp.tile(jnp.arange(max_kv, dtype=jnp.int32), batch), rs, rt,
+        ).reshape(batch, max_kv, num_kv_heads, head_dim)
     kg = jnp.repeat(kg.astype(jnp.float32), group, axis=2)
     vg = jnp.repeat(vg.astype(jnp.float32), group, axis=2)
 

@@ -174,23 +174,37 @@ def single_prefill_with_kv_cache(
     kv_cache_sf[v] multiply the output.  Non-scalar (per-head/block)
     scale tensors are a different numerics regime and are rejected.
     ``use_fp16_qk_reduction`` is a CUDA-accumulator knob (inert: the MXU
-    accumulates f32); rope_scale/rope_theta only apply with RoPE
-    pos_encoding_modes, which raise (apply flashinfer_tpu.rope
-    explicitly).  ``pos_encoding_mode="ALIBI"`` adds
+    accumulates f32).  ``pos_encoding_mode="ROPE_LLAMA"`` rotates q/k at
+    their absolute positions as an elementwise pre-pass (rope_scale/
+    rope_theta honored; position-equivalent to the reference's in-kernel
+    rotation) before any backend.  ``pos_encoding_mode="ALIBI"`` adds
     ``slope_h * (kv_pos - q_pos)`` to the scaled logits (reference
-    variants.cuh:68) on the dense xla backend."""
+    variants.cuh:68) on the dense xla backend by default, in-kernel with
+    explicit backend="pallas"."""
     check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
     alibi = pos_encoding_mode == "ALIBI"
-    if pos_encoding_mode != "NONE" and not alibi:
-        raise NotImplementedError(
-            "apply flashinfer_tpu.rope explicitly before attention"
-        )
     if check_kv_layout(kv_layout) == TensorLayout.HND:
         k = jnp.swapaxes(k, 0, 1)
         v = jnp.swapaxes(v, 0, 1)
     qo_len, _, head_dim = q.shape
     kv_len = k.shape[0]
     sm_scale = get_sm_scale(head_dim, sm_scale)
+    if pos_encoding_mode == "ROPE_LLAMA":
+        # in-attention RoPE (reference applies it in-kernel from an
+        # unrotated cache): rotate q at its bottom-right-aligned absolute
+        # positions and k at 0..kv_len-1 as an elementwise pre-pass —
+        # position-equivalent, and every backend (incl. the flash
+        # kernel) then serves the rotated tensors at full speed
+        from flashinfer_tpu.rope import rotate_at_positions
+
+        q = rotate_at_positions(
+            q, jnp.arange(qo_len, dtype=jnp.int32) + (kv_len - qo_len),
+            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
+        )
+        k = rotate_at_positions(
+            k, jnp.arange(kv_len, dtype=jnp.int32),
+            rope_scale=rope_scale or 1.0, rope_theta=rope_theta or 1e4,
+        )
 
     def _fold(x, name):
         return fold_scalar_scale(
@@ -375,6 +389,9 @@ class _PrefillPlan:
     custom_mask: Optional[jax.Array] = None  # [Tq_pad, Tkv_pad] bool (dense)
     # pos_encoding_mode="ALIBI": plan-derived slope vector (dense xla path)
     alibi_slopes: Optional[jax.Array] = None
+    # pos_encoding_mode="ROPE_LLAMA": (rope_scale, rope_theta) — q/k are
+    # rotated at plan positions in run() (any backend)
+    rope: Optional[Tuple[float, float]] = None
 
 
 def _build_token_axis(
@@ -421,17 +438,16 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         sm_scale: Optional[float] = None,
         q_data_type=jnp.bfloat16,
         kv_data_type=None,
+        rope_scale: Optional[float] = None,
+        rope_theta: Optional[float] = None,
         **_unused,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
         alibi = pos_encoding_mode == "ALIBI"
-        if pos_encoding_mode != "NONE" and not alibi:
-            raise NotImplementedError(
-                "TPU backend: fused-RoPE attention variants are explicit "
-                "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
-                "append path) before plan/run; pos_encoding_mode='ALIBI' "
-                "is served on the dense xla path"
-            )
+        rope = (
+            (rope_scale or 1.0, rope_theta or 1e4)
+            if pos_encoding_mode == "ROPE_LLAMA" else None
+        )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr = np.asarray(kv_indptr)
         batch = len(qo_indptr) - 1
@@ -471,6 +487,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             alibi_slopes=(
                 get_alibi_slopes(num_qo_heads) if alibi else None
             ),
+            rope=rope,
         )
 
     def run(
@@ -490,6 +507,17 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         if k.shape[0] != tkv:
             k = jnp.pad(k, ((0, tkv - k.shape[0]), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, tkv - v.shape[0]), (0, 0), (0, 0)))
+        if plan.rope is not None:
+            from flashinfer_tpu.rope import rotate_at_positions
+
+            rs, rt = plan.rope
+            # sub-16-bit caches upcast before rotating (rotating in fp8
+            # would re-quantize every key); bf16 keeps native dtype — the
+            # same one-rounding a rotated-at-append cache carries
+            if k.dtype.itemsize < 2:
+                k = k.astype(jnp.bfloat16)
+            q = rotate_at_positions(q, plan.q_pos, rs, rt)
+            k = rotate_at_positions(k, plan.kv_pos, rs, rt)
         backend = resolve_backend(self._backend, "batch_prefill_ragged")
         alibi_kw = {}
         if plan.alibi_slopes is not None:
@@ -567,17 +595,16 @@ class BatchPrefillWithPagedKVCacheWrapper:
         sm_scale: Optional[float] = None,
         q_data_type=jnp.bfloat16,
         kv_data_type=None,
+        rope_scale: Optional[float] = None,
+        rope_theta: Optional[float] = None,
         **_unused,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
         alibi = pos_encoding_mode == "ALIBI"
-        if pos_encoding_mode != "NONE" and not alibi:
-            raise NotImplementedError(
-                "TPU backend: fused-RoPE attention variants are explicit "
-                "ops here — apply flashinfer_tpu.rope to q/k (or the cache "
-                "append path) before plan/run; pos_encoding_mode='ALIBI' "
-                "is served on the dense xla path"
-            )
+        rope = (
+            (rope_scale or 1.0, rope_theta or 1e4)
+            if pos_encoding_mode == "ROPE_LLAMA" else None
+        )
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr_pages = np.asarray(paged_kv_indptr)
         kv_indices = np.asarray(paged_kv_indices)
@@ -653,11 +680,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 alibi_slopes=(
                     get_alibi_slopes(num_qo_heads) if alibi else None
                 ),
+                rope=rope,
             )
 
         self._gather_plan_builder = build_gather_plan
-        # ALiBi is a dense-path mode (the fused kernel has no bias term)
-        use_fused = (not alibi) and (
+        # ALiBi is a dense-path mode (the fused kernel has no bias term);
+        # in-attention RoPE needs the gathered token axis to rotate
+        use_fused = (not alibi) and (rope is None) and (
             self._backend == "pallas_fused" or (
             # hardware-validated default for the TPU-preferred HND layout;
             # NHD would need a whole-cache transpose per run() to feed the
@@ -841,6 +870,14 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq = plan.tq_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
+        if plan.rope is not None:
+            from flashinfer_tpu.rope import rotate_at_positions
+
+            rs, rt = plan.rope
+            if k.dtype.itemsize < 2:  # see ragged wrapper note
+                k = k.astype(jnp.bfloat16)
+            q = rotate_at_positions(q, plan.q_pos, rs, rt)
+            k = rotate_at_positions(k, plan.kv_pos, rs, rt)
         alibi_kw = {}
         if plan.alibi_slopes is not None:
             alibi_kw["alibi_slopes"] = plan.alibi_slopes

@@ -81,6 +81,28 @@ def _apply_rotary(
     return jnp.concatenate([out_rot, rest], axis=-1).astype(x.dtype)
 
 
+@jax.jit
+def rotate_at_positions(
+    x: jax.Array,  # [nnz, heads, head_dim]
+    pos_ids: jax.Array,  # [nnz] int
+    rope_scale=1.0,
+    rope_theta=1e4,
+) -> jax.Array:
+    """Rotate one tensor by per-row absolute positions — the in-attention
+    RoPE primitive the pos_encoding_mode="ROPE_LLAMA" paths use (the
+    reference rotates q/k inside the kernel from an UNROTATED cache;
+    here rotation happens as an elementwise pass before attention, which
+    is position-equivalent up to one rounding in x.dtype — callers with
+    sub-16-bit caches upcast first).  scale/theta ride as traced scalars
+    (plan-derived), so one compiled rotation serves every geometry."""
+    head_dim = x.shape[-1]
+    freqs = _rope_freqs(head_dim, rope_theta, rope_scale)
+    angles = pos_ids.astype(jnp.float32)[:, None] * freqs[None, :]
+    return _apply_rotary(
+        x, jnp.cos(angles), jnp.sin(angles), head_dim, False
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("rotary_dim", "interleave", "rope_scale", "rope_theta"),

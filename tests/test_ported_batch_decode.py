@@ -8,9 +8,10 @@ matrices verbatim, reference call sequences (positional workspace
 buffer, plan kwargs incl. data_type/q_data_type, per-request
 single_decode oracle loop), torch -> jnp.  Skip reasons:
 
-- ``pos_encoding_mode="ROPE_LLAMA"``: the BATCH wrapper rejects fused
-  RoPE loudly (apply flashinfer_tpu.rope first); note the single-request
-  oracle op DOES implement it, so only the batch rows skip.
+- ``pos_encoding_mode="ROPE_LLAMA"``: honored (round 5; dense path
+  rotates the unrotated cache's gathered keys) but this file's oracle
+  loop is rope-unaware, so the batch rows still skip; numerics are
+  pinned by tests/test_rope_mode.py.
 - fp8 (float8_e4m3fn) KV: exercised — the TPU wrapper's dequant decode
   path consumes fp8 caches directly.
 - sampling/work-cap: as in the prefill port (1/48 stride; decode work
@@ -50,9 +51,9 @@ def _decode_gates(batch_size, kv_len, num_qo_heads, head_dim,
 def _skip_rope_batch(pos_encoding_mode):
     if pos_encoding_mode != "NONE":
         pytest.skip(
-            "the batch decode wrapper rejects fused RoPE loudly (apply "
-            "flashinfer_tpu.rope first; the single_decode oracle op does "
-            "implement ROPE_LLAMA) — docs/migration.md")
+            "pos_encoding_mode=ROPE_LLAMA is honored on the dense path "
+            "(round 5) but this file's oracle is rope-unaware; numerics "
+            "pinned by tests/test_rope_mode.py")
 
 
 def _decode_inputs(batch_size, kv_len, page_size, num_kv_heads, head_dim,
@@ -217,11 +218,16 @@ def test_batch_decode_with_tuple_paged_kv_cache(
         return_lse, q_dtype, kv_dtype, tuple_cache=True, seed=4)
 
 
-def test_batch_decode_rope_raises():
-    """Pins the ROPE skip reason: the batch wrapper rejects fused RoPE
-    loudly rather than silently decoding un-roped."""
+def test_batch_decode_rope_accepted():
+    """Pins the ROPE skip reason: the batch wrapper now ACCEPTS
+    ROPE_LLAMA (dense path rotates the unrotated cache's gathered keys,
+    tests/test_rope_mode.py pins numerics); typos raise KeyError."""
     w = fi.decode.BatchDecodeWithPagedKVCacheWrapper(None, "NHD")
-    with pytest.raises(NotImplementedError, match="rope"):
+    w.plan(np.array([0, 1], np.int32), np.array([0], np.int32),
+           np.array([4], np.int32), 4, 4, 128, 16,
+           pos_encoding_mode="ROPE_LLAMA")
+    assert w._plan.rope is not None
+    with pytest.raises(KeyError):
         w.plan(np.array([0, 1], np.int32), np.array([0], np.int32),
                np.array([4], np.int32), 4, 4, 128, 16,
-               pos_encoding_mode="ROPE_LLAMA")
+               pos_encoding_mode="ROPE")

@@ -1,0 +1,148 @@
+"""In-attention RoPE (pos_encoding_mode="ROPE_LLAMA") across the surface.
+
+The reference rotates q/k inside the attention kernels from an UNROTATED
+cache (decode.cuh:217, prefill kernels).  Here rotation is an elementwise
+pre-pass at the plan positions — position-equivalent — so each test
+checks the mode against manually rotating the inputs and running mode
+NONE through the same entry point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.rope import rotate_at_positions
+
+RS, RT = 1.0, 1e4
+
+
+def _rot(x, pos):
+    return rotate_at_positions(jnp.asarray(x), jnp.asarray(pos, jnp.int32),
+                               RS, RT)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_single_prefill_rope_mode(causal):
+    ql, kl, H, D = 24, 56, 4, 64
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (ql, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (kl, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (kl, H, D), jnp.float32)
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=causal, pos_encoding_mode="ROPE_LLAMA"
+    )
+    ref = fi.single_prefill_with_kv_cache(
+        _rot(q, np.arange(ql) + (kl - ql)), _rot(k, np.arange(kl)), v,
+        causal=causal,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ragged_wrapper_rope_mode():
+    H, D = 4, 64
+    qo = np.array([0, 13, 30], np.int32)
+    kv = np.array([0, 29, 62], np.int32)
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (int(qo[-1]), H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (int(kv[-1]), H, D),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (int(kv[-1]), H, D),
+                          jnp.float32)
+    w = fi.BatchPrefillWithRaggedKVCacheWrapper()
+    w.plan(qo, kv, H, H, D, causal=True, pos_encoding_mode="ROPE_LLAMA")
+    o = np.asarray(w.run(q, k, v))
+    # manual rotation at the bottom-right-aligned absolute positions
+    qpos = np.concatenate([
+        np.arange(qo[b + 1] - qo[b]) + ((kv[b + 1] - kv[b]) - (qo[b + 1] - qo[b]))
+        for b in range(2)
+    ])
+    kpos = np.concatenate([np.arange(kv[b + 1] - kv[b]) for b in range(2)])
+    w2 = fi.BatchPrefillWithRaggedKVCacheWrapper()
+    w2.plan(qo, kv, H, H, D, causal=True)
+    ref = np.asarray(w2.run(_rot(q, qpos), _rot(k, kpos), v))
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_paged_prefill_wrapper_rope_mode():
+    H, D, PS = 4, 64, 8
+    qo = np.array([0, 13, 30], np.int32)
+    kv_lens = [29, 33]
+    pages_per = [(x + PS - 1) // PS for x in kv_lens]
+    kv_pages = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    total_pages = int(kv_pages[-1])
+    key = jax.random.PRNGKey(7)
+    kc = jax.random.normal(key, (total_pages, H, PS, D), jnp.float32)
+    vc = jax.random.normal(jax.random.fold_in(key, 1),
+                           (total_pages, H, PS, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (int(qo[-1]), H, D),
+                          jnp.float32)
+    last = np.asarray(
+        [x - (p - 1) * PS for x, p in zip(kv_lens, pages_per)], np.int32
+    )
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(qo, kv_pages, np.arange(total_pages, dtype=np.int32), last,
+           H, H, D, PS, causal=True, pos_encoding_mode="ROPE_LLAMA")
+    assert w._fused_plan is None  # rope forces the gather path
+    o = np.asarray(w.run(q, (kc, vc)))
+    # reference: rotate the CACHE rows at their in-request positions and
+    # q at its absolute positions, run mode NONE
+    kflat = np.asarray(jnp.swapaxes(kc, 1, 2)).reshape(-1, H, D)
+    kflat_rot = kflat.copy()
+    for b in range(2):
+        sl = slice(int(kv_pages[b]) * PS, int(kv_pages[b]) * PS + kv_lens[b])
+        kflat_rot[sl] = np.asarray(
+            _rot(kflat[sl], np.arange(kv_lens[b]))
+        )
+    kc_rot = jnp.swapaxes(
+        jnp.asarray(kflat_rot).reshape(total_pages, PS, H, D), 1, 2
+    )
+    qpos = np.concatenate([
+        np.arange(qo[b + 1] - qo[b]) + (kv_lens[b] - (qo[b + 1] - qo[b]))
+        for b in range(2)
+    ])
+    w2 = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+    w2.plan(qo, kv_pages, np.arange(total_pages, dtype=np.int32), last,
+            H, H, D, PS, causal=True)
+    ref = np.asarray(w2.run(_rot(q, qpos), (kc_rot, vc)))
+    np.testing.assert_allclose(o, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_batch_decode_wrapper_rope_mode():
+    B, HQ, HKV, D, PS = 3, 4, 4, 64, 8
+    lens = [24, 8, 17]
+    pages_per = [(x + PS - 1) // PS for x in lens]
+    total_pages = sum(pages_per)
+    key = jax.random.PRNGKey(0)
+    kc = jax.random.normal(key, (total_pages, HKV, PS, D), jnp.float32)
+    vc = jax.random.normal(jax.random.fold_in(key, 1),
+                           (total_pages, HKV, PS, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, HQ, D),
+                          jnp.float32)
+    indptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int32)
+    last = np.asarray([x - (p - 1) * PS for x, p in zip(lens, pages_per)],
+                      np.int32)
+
+    def make(mode):
+        w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(indptr, np.arange(total_pages, dtype=np.int32), last,
+               HQ, HKV, D, PS, pos_encoding_mode=mode)
+        return w
+
+    o = np.asarray(make("ROPE_LLAMA").run(q, (kc, vc)), np.float32)
+    # reference: rotate cache rows by in-request position, q by len-1
+    kflat = np.asarray(jnp.swapaxes(kc, 1, 2)).reshape(-1, HKV, D)
+    kflat_rot = kflat.copy()
+    for b in range(B):
+        sl = slice(int(indptr[b]) * PS, int(indptr[b]) * PS + lens[b])
+        kflat_rot[sl] = np.asarray(_rot(kflat[sl], np.arange(lens[b])))
+    kc_rot = jnp.swapaxes(
+        jnp.asarray(kflat_rot).reshape(total_pages, PS, HKV, D), 1, 2
+    )
+    q_rot = jnp.stack([
+        _rot(q[b][None], [lens[b] - 1])[0] for b in range(B)
+    ])
+    ref = np.asarray(make("NONE").run(q_rot, (kc_rot, vc)), np.float32)
+    np.testing.assert_allclose(o, ref, rtol=1e-3, atol=1e-3)
