@@ -167,17 +167,22 @@ def test_batch_paged_prefill_alibi_wrapper():
                                    err_msg=f"request {b}")
 
 
-def test_alibi_rejects_other_modes_still():
+def test_alibi_mode_validation():
+    """Typos raise (reference PosEncodingMode[...] KeyError), never fall
+    through to unpositioned attention; ROPE_LLAMA is a valid honored mode
+    (tests/test_rope_mode.py pins its numerics)."""
     q = jnp.zeros((8, 128), jnp.float32)
     k = jnp.zeros((4, 8, 128), jnp.float32)
-    with pytest.raises(NotImplementedError):
-        fi.single_prefill_with_kv_cache(
-            jnp.zeros((4, 8, 128)), k, k, pos_encoding_mode="ROPE_LLAMA"
-        )
-    # typos raise (reference PosEncodingMode[...] KeyError), never fall
-    # through to unpositioned attention
+    out = fi.single_prefill_with_kv_cache(
+        jnp.zeros((4, 8, 128)), k, k, pos_encoding_mode="ROPE_LLAMA"
+    )
+    assert out.shape == (4, 8, 128)
     with pytest.raises(KeyError):
         fi.single_decode_with_kv_cache(q, k, k, pos_encoding_mode="ALIBI ")
+    with pytest.raises(KeyError):
+        fi.single_prefill_with_kv_cache(
+            jnp.zeros((4, 8, 128)), k, k, pos_encoding_mode="ROPE"
+        )
 
 
 @pytest.mark.parametrize("causal", [False, True])
