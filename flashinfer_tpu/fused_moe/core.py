@@ -199,18 +199,17 @@ def _resolve_gmm_tiles(gmm_tiles, hidden, w_gate_up, w_down, topk_ids):
     h2_def = _heuristic_gmm_tiles(
         m, w_down.shape[1], h, esz, jnp.dtype(o2).itemsize
     )
+    gemm1, gemm2 = (m, h, n1), (m, w_down.shape[1], h)
     if tuner.tuning_enabled:
         # autotune() context: profile candidates per GEMM geometry with
         # the standalone kernel (writes the same cache keys lookup reads)
         from flashinfer_tpu.ops.moe_gmm import tune_tiles
 
-        t1 = tune_tiles(m, h, n1, dt, h1_def, out_dtype=o1)
-        t2 = tune_tiles(m, w_down.shape[1], h, dt, h2_def, out_dtype=o2)
+        t1 = tune_tiles(*gemm1, dt, h1_def, out_dtype=o1)
+        t2 = tune_tiles(*gemm2, dt, h2_def, out_dtype=o2)
     else:
-        t1 = tuner.lookup("moe_gmm.tiles", (m, h, n1, dt), default=h1_def)
-        t2 = tuner.lookup(
-            "moe_gmm.tiles", (m, w_down.shape[1], h, dt), default=h2_def
-        )
+        t1 = tuner.lookup("moe_gmm.tiles", (*gemm1, dt), default=h1_def)
+        t2 = tuner.lookup("moe_gmm.tiles", (*gemm2, dt), default=h2_def)
     return (tuple(t1), tuple(t2))
 
 

@@ -46,6 +46,22 @@ from flashinfer_tpu.utils import (
 _Q_PAD_SEG = -1
 _KV_PAD_SEG = -2
 
+
+def _apply_plan_rope(plan, q, k):
+    """ROPE_LLAMA pre-pass shared by the batch wrappers' run() paths:
+    rotate q/k at the plan's absolute positions (sub-16-bit caches upcast
+    first — rotating in fp8 would re-quantize every key; bf16 keeps the
+    native dtype, the same one-rounding a rotated-at-append cache has)."""
+    if plan.rope is None:
+        return q, k
+    from flashinfer_tpu.rope import rotate_at_positions
+
+    rs, rt = plan.rope
+    if k.dtype.itemsize < 2:
+        k = k.astype(jnp.bfloat16)
+    return (rotate_at_positions(q, plan.q_pos, rs, rt),
+            rotate_at_positions(k, plan.kv_pos, rs, rt))
+
 # ALiBi rides the dense xla path, which materializes [H, Tq_pad, Tkv_pad]
 # f32 logits; cap that tensor so a long-context ALiBi prefill fails with
 # instructions instead of an opaque device OOM (the Pallas flash kernel
@@ -507,17 +523,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         if k.shape[0] != tkv:
             k = jnp.pad(k, ((0, tkv - k.shape[0]), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, tkv - v.shape[0]), (0, 0), (0, 0)))
-        if plan.rope is not None:
-            from flashinfer_tpu.rope import rotate_at_positions
-
-            rs, rt = plan.rope
-            # sub-16-bit caches upcast before rotating (rotating in fp8
-            # would re-quantize every key); bf16 keeps native dtype — the
-            # same one-rounding a rotated-at-append cache carries
-            if k.dtype.itemsize < 2:
-                k = k.astype(jnp.bfloat16)
-            q = rotate_at_positions(q, plan.q_pos, rs, rt)
-            k = rotate_at_positions(k, plan.kv_pos, rs, rt)
+        q, k = _apply_plan_rope(plan, q, k)
         backend = resolve_backend(self._backend, "batch_prefill_ragged")
         alibi_kw = {}
         if plan.alibi_slopes is not None:
@@ -870,14 +876,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
         tq = plan.tq_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))
-        if plan.rope is not None:
-            from flashinfer_tpu.rope import rotate_at_positions
-
-            rs, rt = plan.rope
-            if k.dtype.itemsize < 2:  # see ragged wrapper note
-                k = k.astype(jnp.bfloat16)
-            q = rotate_at_positions(q, plan.q_pos, rs, rt)
-            k = rotate_at_positions(k, plan.kv_pos, rs, rt)
+        q, k = _apply_plan_rope(plan, q, k)
         alibi_kw = {}
         if plan.alibi_slopes is not None:
             alibi_kw["alibi_slopes"] = plan.alibi_slopes
