@@ -165,6 +165,7 @@ def phase_decode(sweep: bool):
     # headline config first: if the phase dies mid-sweep, the deliverable
     # number is already banked
     grid.sort(key=lambda bc: bc != (64, 4096))
+    best_tbps = 0.0
     for bs, ctx in grid:
         t, tbps, tps = bench_one(bs, ctx)
         if (bs, ctx) == (64, 4096):
@@ -176,6 +177,22 @@ def phase_decode(sweep: bool):
             t2, tbps2, tps2 = bench_one(bs, ctx)
             if t2 < t:
                 t, tbps, tps = t2, tbps2, tps2
+        elif bs >= 16 and best_tbps > 0 and tbps < 0.35 * best_tbps:
+            # implausible row: a tunnel degraded window (~100x slowdowns
+            # lasting tens of seconds, see testing/utils.py) can outlast
+            # even the timer's cross-scale check — the 2026-07-31 sweep
+            # banked 0.0378 TB/s for a shape the same process measured at
+            # 0.73 minutes earlier.  One re-measure after a pause, keep
+            # the faster (bandwidth at bs>=16 varies ~2x across the grid,
+            # never ~20x).
+            print(f"# decode bs={bs} ctx={ctx}: {tbps:.4f} TB/s "
+                  f"implausible vs best {best_tbps:.4f}; re-measuring",
+                  file=sys.stderr)
+            time.sleep(20)
+            t2, tbps2, tps2 = bench_one(bs, ctx)
+            if t2 < t:
+                t, tbps, tps = t2, tbps2, tps2
+        best_tbps = max(best_tbps, tbps)
         _emit_row(phase="decode", bs=bs, ctx=ctx, us=round(t * 1e6, 1),
                   tbps=round(tbps, 4), tok_s=round(tps, 0), peak=peak)
         print(f"# decode bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "

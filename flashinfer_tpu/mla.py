@@ -154,6 +154,18 @@ class BatchMLAPagedAttentionWrapper:
         if plan is None:
             raise RuntimeError("plan() must be called before run()")
         backend = resolve_backend(self._backend, "batch_mla")
+        if ckv_cache.shape[0] == 0:
+            # empty cache (every request has kv_len == 0): attention over
+            # the empty set — zero output, lse = log(0) (the reference
+            # kernel returns zeros and its tests skip the lse check,
+            # test_deepseek_mla.py:630)
+            n = plan.batch_size if plan.decode_mode else plan.total_q
+            out = jnp.zeros((n, plan.num_heads, plan.head_dim_ckv),
+                            q_nope.dtype)
+            if return_lse:
+                return out, jnp.full((n, plan.num_heads), -jnp.inf,
+                                     jnp.float32)
+            return out
         if plan.decode_mode:
             b_pad = plan.page_table.shape[0]
             if q_nope.shape[0] != b_pad:

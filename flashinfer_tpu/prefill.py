@@ -39,6 +39,7 @@ from flashinfer_tpu.utils import (
     get_alibi_slopes,
     get_sm_scale,
     next_power_of_two,
+    normalize_backend,
     resolve_backend,
     TensorLayout,
 )
@@ -435,7 +436,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
     ):
         check_kv_layout(kv_layout)
         self._kv_layout = kv_layout
-        self._backend = backend
+        self._backend = normalize_backend(backend)
         self._plan: Optional[_PrefillPlan] = None
 
     def plan(
@@ -578,7 +579,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
     ):
         check_kv_layout(kv_layout)
         self._kv_layout = kv_layout
-        self._backend = backend
+        self._backend = normalize_backend(backend)
         self._plan: Optional[_PrefillPlan] = None
         self._fused_plan = None  # work-unit plan for backend="pallas_fused"
 

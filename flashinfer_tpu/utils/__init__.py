@@ -156,15 +156,39 @@ def use_interpret() -> bool:
     return env.force_interpret() or not is_tpu()
 
 
+# Reference attention-backend names (``flashinfer/utils.py:522``
+# determine_attention_backend picks fa2/fa3/trtllm-gen/... per CUDA arch;
+# wrapper ctors accept them verbatim, e.g. mla/_core.py:1397 backend=).
+# They select CUDA codegen variants with no TPU meaning and are
+# numerics-neutral, so a verbatim reference call resolves like "auto" —
+# the north-star contract of a tpu backend registered alongside the
+# reference's backend names.
+_REFERENCE_BACKEND_NAMES = frozenset({
+    "fa2", "fa3", "fa2_tc", "trtllm-gen", "trtllm-gen-native", "trtllm",
+    "cutlass", "cudnn", "xqa", "tpu",
+})
+
+
+def normalize_backend(backend: str) -> str:
+    """Map reference CUDA backend names to "auto"; leave TPU-native
+    choices ("auto"/"pallas"/"xla"/"pallas_fused") untouched."""
+    if isinstance(backend, str) and backend.lower() in _REFERENCE_BACKEND_NAMES:
+        return "auto"
+    return backend
+
+
 def resolve_backend(backend: str, op: str = "") -> str:
     """Resolve a per-op backend choice, honoring the global override.
 
     Mirrors the reference's ``determine_attention_backend``
     (``flashinfer/utils.py:522``) collapsed to the TPU world: "pallas"
     (primary, Mosaic kernels) or "xla" (pure-jnp reference/fallback).
+    Reference backend names (fa2/fa3/trtllm-gen/...) are accepted and
+    resolve like "auto".
     """
     from flashinfer_tpu import env
 
+    backend = normalize_backend(backend)
     override = env.backend_override()
     if backend == "auto":
         if override != "auto":

@@ -1,0 +1,310 @@
+"""Migration proof #9: mechanical port of the reference test file
+``/root/reference/tests/attention/test_deepseek_mla.py`` (the
+BatchMLAPagedAttentionWrapper matrix) run against ``flashinfer_tpu``.
+
+Same porting contract as tests/test_ported_batch_prefill.py (which
+provides the collection-time sampling helpers): reference parameter
+matrices verbatim, reference call sequences (positional workspace
+buffer + ctor kwargs incl. use_cuda_graph/preallocated ring buffers,
+plan positional args through kv_data_type, ``run(..., return_lse=True)``),
+torch -> jnp (torch.half -> jnp.float16).  Oracle = the reference's
+``attention_ref``/``generate_kv_from_cache`` (f32, latent broadcast over
+heads, bottom-right causal alignment) transcribed to numpy/jnp.
+
+Deviations / skip reasons:
+
+- ``backend="fa2"/"fa3"``: accepted verbatim — reference CUDA backend
+  names resolve like "auto" (utils.normalize_backend); both values run
+  the same TPU path, so they are coverage duplicates kept for the
+  call-parity proof.
+- LSE comparisons are in NATURAL log: the reference kernels return
+  base-2 LSE (attention_ref scales by log2(e)); this framework returns
+  natural log everywhere (docs/migration.md §LSE).  The oracle here
+  keeps natural log and our lse is compared unscaled.
+- ``use_cuda_graph=True`` + warmup/capture/replay: no CUDA graphs on
+  TPU (jit tracing is the capture); the ctor kwargs are accepted and
+  inert, the warmup/replay block is dropped, the same plan/run calls
+  execute.
+- the reference's pre-allocated ``out=``/``lse=`` sub-check is dropped
+  (not skipped): out= is loudly rejected by design (docs/migration.md).
+- work/cache caps: as in the decode port (CPU CI skips the largest
+  cells with a written reason; FLASHINFER_TPU_FULL_MATRIX=1 runs all).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import FULL, _sample
+
+_HEAD_DIM_CKV = 512
+_HEAD_DIM_KPE = 64
+_MLA_WORK_CAP = 2 ** 31
+_CACHE_ELEM_CAP = 2 ** 26
+
+
+def _mla_gates(batch_size, kv_len, qo_len, num_heads):
+    work = batch_size * qo_len * max(kv_len, 1) * num_heads * \
+        (_HEAD_DIM_CKV + _HEAD_DIM_KPE)
+    pages = max(1, -(-kv_len // 16)) * batch_size
+    cache = pages * 16 * (_HEAD_DIM_CKV + _HEAD_DIM_KPE)
+    if not FULL and work > _MLA_WORK_CAP:
+        pytest.skip(
+            f"MLA work {work:.1e} exceeds the CPU CI cap "
+            f"{_MLA_WORK_CAP:.1e}; FLASHINFER_TPU_FULL_MATRIX run")
+    if not FULL and cache > _CACHE_ELEM_CAP:
+        pytest.skip(
+            f"latent cache of {cache:.1e} elements exceeds the CPU CI "
+            f"cap {_CACHE_ELEM_CAP:.1e}; FLASHINFER_TPU_FULL_MATRIX run")
+
+
+def _attention_ref(batch_size, q, k, v, causal, sm_scale):
+    """Reference oracle (test_deepseek_mla.py:109-153) in f32 numpy;
+    returns (o [B*qo, H, dv] in q.dtype, lse [B*qo, H] NATURAL log —
+    the reference returns base-2, see module docstring)."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    qo_len = q.shape[0] // batch_size
+    kv_len = k.shape[0] // batch_size
+    heads, d_qk = q.shape[1], q.shape[2]
+    d_vo = v.shape[2]
+    qb = q.reshape(batch_size, qo_len, heads, d_qk)
+    kb = k.reshape(batch_size, kv_len, heads, d_qk)
+    vb = v.reshape(batch_size, kv_len, heads, d_vo)
+    logits = np.einsum("bmhd,bnhd->bhmn", qb, kb) * sm_scale
+    if causal:
+        mask = (np.arange(kv_len - qo_len, kv_len)[:, None]
+                >= np.arange(kv_len)[None, :])
+    else:
+        mask = np.ones((qo_len, kv_len), bool)
+    logits = np.where(mask[None, None], logits, -np.inf)
+    if kv_len:
+        m = logits.max(-1, keepdims=True)
+        lse = (np.log(np.exp(logits - m).sum(-1)) + m[..., 0])
+    else:
+        lse = np.full(logits.shape[:-1], -np.inf, np.float32)
+    p = np.exp(logits - lse[..., None]) if kv_len else \
+        np.zeros_like(logits)
+    o = np.einsum("bhmn,bnhd->bmhd", p, vb).reshape(
+        batch_size * qo_len, heads, d_vo)
+    return o, lse.transpose(0, 2, 1).reshape(batch_size * qo_len, heads)
+
+
+def _generate_kv_from_cache(ckv, kpe, kv_len, batch_size, num_heads):
+    """Reference helper (test_deepseek_mla.py:262-278): latent + rope
+    caches -> per-head K/V via broadcast over heads."""
+    ckv = np.asarray(ckv, np.float32)
+    kpe = np.asarray(kpe, np.float32)
+    bs_page_num, page_size, ckv_dim = ckv.shape
+    page_num = bs_page_num // batch_size
+    kpe_dim = kpe.shape[-1]
+    ckv = ckv.reshape(batch_size, page_num * page_size, ckv_dim)[:, :kv_len]
+    kpe = kpe.reshape(batch_size, page_num * page_size, kpe_dim)[:, :kv_len]
+    k = np.concatenate([ckv, kpe], -1).reshape(-1, 1, ckv_dim + kpe_dim)
+    k = np.repeat(k, num_heads, axis=1)
+    v = ckv.reshape(-1, 1, ckv_dim)
+    v = np.repeat(v, num_heads, axis=1)
+    return k, v
+
+
+def _mla_inputs(batch_size, kv_len, qo_len, num_heads, page_size, seed=42):
+    key = jax.random.PRNGKey(seed)
+    q_nope = jax.random.normal(
+        key, (batch_size * qo_len, num_heads, _HEAD_DIM_CKV), jnp.float16)
+    q_pe = jax.random.normal(
+        jax.random.fold_in(key, 1),
+        (batch_size * qo_len, num_heads, _HEAD_DIM_KPE), jnp.float16)
+    pages_num = math.ceil(kv_len / page_size)
+    ckv = jax.random.normal(
+        jax.random.fold_in(key, 2),
+        (batch_size * pages_num, page_size, _HEAD_DIM_CKV), jnp.float16)
+    kpe = jax.random.normal(
+        jax.random.fold_in(key, 3),
+        (batch_size * pages_num, page_size, _HEAD_DIM_KPE), jnp.float16)
+    return q_nope, q_pe, ckv, kpe, pages_num
+
+
+def _check(o, lse, o_ref, lse_ref, kv_len):
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref, rtol=1e-3, atol=1e-3)
+    if kv_len != 0:
+        np.testing.assert_allclose(
+            np.asarray(lse, np.float32), lse_ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,qo_len,num_heads,causal,page_size,backend,"
+    "use_cuda_graph",
+    _sample(
+        "mla_page",
+        [1, 3, 5, 7, 157], [0, 17, 33, 96, 97, 114, 514, 1024],
+        [1, 3, 5, 7, 9, 11, 13, 15, 17], [16], [False, True], [1, 16],
+        ["fa2", "fa3"], [False],
+        specials=((1, 0), (2, 1)),  # keep a kv_len=0 and a decode case
+    ),
+)
+def test_batch_mla_page_attention(batch_size, kv_len, qo_len, num_heads,
+                                  causal, page_size, backend,
+                                  use_cuda_graph):
+    """Reference test_batch_mla_page_attention (test_deepseek_mla.py:498)."""
+    if causal and qo_len > kv_len:
+        pytest.skip("qo_len > kv_len not supported for causal attention")
+    _mla_gates(batch_size, kv_len, qo_len, num_heads)
+    q_nope, q_pe, ckv, kpe, pages_num = _mla_inputs(
+        batch_size, kv_len, qo_len, num_heads, page_size)
+    sm_scale = 1.0 / ((128 + 64) ** 0.5)
+    wrapper = fi.mla.BatchMLAPagedAttentionWrapper(
+        jnp.empty(128 * 1024 * 1024, jnp.int8),
+        backend=backend,
+        use_cuda_graph=True,
+        qo_indptr=jnp.empty(batch_size + 1, jnp.int32),
+        kv_indptr=jnp.empty(batch_size + 1, jnp.int32),
+        kv_indices=jnp.empty(1048576, jnp.int32),
+        kv_len_arr=jnp.empty(batch_size, jnp.int32),
+    )
+    q_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * qo_len
+    kv_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * pages_num
+    kv_indices = np.arange(0, batch_size * pages_num, dtype=np.int32)
+    kv_lens = np.full((batch_size,), kv_len, np.int32)
+    wrapper.plan(q_indptr, kv_indptr, kv_indices, kv_lens, num_heads,
+                 _HEAD_DIM_CKV, _HEAD_DIM_KPE, page_size, causal, sm_scale,
+                 q_nope.dtype, ckv.dtype)
+    o, lse = wrapper.run(q_nope, q_pe, ckv, kpe, return_lse=True)
+
+    k, v = _generate_kv_from_cache(ckv, kpe, kv_len, batch_size, num_heads)
+    q = np.concatenate(
+        [np.asarray(q_nope, np.float32), np.asarray(q_pe, np.float32)], -1)
+    o_ref, lse_ref = _attention_ref(batch_size, q, k, v, causal, sm_scale)
+    _check(o, lse, o_ref, lse_ref, kv_len)
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len_0,kv_len_1,kv_len_2,qo_len,num_heads,causal,"
+    "page_size,backend",
+    _sample(
+        "mla_varlen",
+        [1, 3, 5, 7], [0, 1, 3, 11], [17, 33, 79, 114],
+        [514, 2743, 8736], [1, 3, 5, 7, 9, 11, 13, 15, 17], [16, 64],
+        [False, True], [1], ["fa2", "fa3"],
+    ),
+)
+def test_batch_mla_varlen_page_attention(batch_size, kv_len_0, kv_len_1,
+                                         kv_len_2, qo_len, num_heads,
+                                         causal, page_size, backend):
+    """Reference test_batch_mla_varlen_page_attention
+    (test_deepseek_mla.py:280): three interleaved kv lengths per batch."""
+    if causal and qo_len > min(kv_len_0, kv_len_1, kv_len_2):
+        pytest.skip("qo_len > kv_len not supported for causal attention")
+    _mla_gates(batch_size * 3, max(kv_len_0, kv_len_1, kv_len_2), qo_len,
+               num_heads)
+    n_kinds = 3
+    kv_lens_base = np.array([kv_len_0, kv_len_1, kv_len_2], np.int32)
+    key = jax.random.PRNGKey(42)
+    q_nope = jax.random.normal(
+        key, (n_kinds * batch_size * qo_len, num_heads, _HEAD_DIM_CKV),
+        jnp.float16)
+    q_pe = jax.random.normal(
+        jax.random.fold_in(key, 1),
+        (n_kinds * batch_size * qo_len, num_heads, _HEAD_DIM_KPE),
+        jnp.float16)
+    pages_nums = np.array(
+        [math.ceil(l / page_size) for l in kv_lens_base], np.int32)
+    pages_nums_indptr = np.zeros(n_kinds + 1, np.int32)
+    pages_nums_indptr[1:] = pages_nums.cumsum()
+    pages_sum = int(pages_nums_indptr[-1])
+    ckv = jax.random.normal(
+        jax.random.fold_in(key, 2),
+        (batch_size * pages_sum, page_size, _HEAD_DIM_CKV), jnp.float16)
+    kpe = jax.random.normal(
+        jax.random.fold_in(key, 3),
+        (batch_size * pages_sum, page_size, _HEAD_DIM_KPE), jnp.float16)
+    sm_scale = 1.0 / ((128 + 64) ** 0.5)
+    wrapper = fi.mla.BatchMLAPagedAttentionWrapper(
+        jnp.empty(1024, jnp.int8), backend=backend)
+    q_indptr = np.arange(
+        0, n_kinds * batch_size + 1, dtype=np.int32) * qo_len
+    # reference builds the indptr by interleaving the three kinds per
+    # batch element (test_deepseek_mla.py:358-366): row-major over
+    # (batch, kind), closed by the total page count
+    kv_indptr = np.array(
+        [b * pages_sum + pages_nums_indptr[i]
+         for b in range(batch_size) for i in range(n_kinds)]
+        + [batch_size * pages_sum], np.int32)
+    kv_indices = np.arange(0, batch_size * pages_sum, dtype=np.int32)
+    kv_lens = np.tile(kv_lens_base, batch_size)
+    wrapper.plan(q_indptr, kv_indptr, kv_indices, kv_lens, num_heads,
+                 _HEAD_DIM_CKV, _HEAD_DIM_KPE, page_size, causal, sm_scale,
+                 q_nope.dtype, ckv.dtype)
+    o, lse = wrapper.run(q_nope, q_pe, ckv, kpe, return_lse=True)
+
+    q_rows = (np.arange(0, n_kinds * qo_len)[None, :]
+              + np.arange(0, batch_size)[:, None] * n_kinds * qo_len)
+    kv_rows = (np.arange(0, pages_sum)[None, :]
+               + np.arange(0, batch_size)[:, None] * pages_sum)
+    q_full = np.concatenate(
+        [np.asarray(q_nope, np.float32), np.asarray(q_pe, np.float32)], -1)
+    o_np, lse_np = np.asarray(o, np.float32), np.asarray(lse, np.float32)
+    for i in range(n_kinds):
+        q_rows_i = q_rows[:, i * qo_len:(i + 1) * qo_len].flatten()
+        kv_rows_i = kv_rows[
+            :, pages_nums_indptr[i]:pages_nums_indptr[i + 1]].flatten()
+        k, v = _generate_kv_from_cache(
+            np.asarray(ckv, np.float32)[kv_rows_i],
+            np.asarray(kpe, np.float32)[kv_rows_i],
+            int(kv_lens_base[i]), batch_size, num_heads)
+        o_ref, lse_ref = _attention_ref(
+            batch_size, q_full[q_rows_i], k, v, causal, sm_scale)
+        _check(o_np[q_rows_i], lse_np[q_rows_i], o_ref, lse_ref,
+               int(kv_lens_base[i]))
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,qo_len,num_heads,causal,page_size,backend",
+    _sample(
+        "mla_oob",
+        [1, 2, 3, 4, 5, 6, 7, 157], [17, 33, 75, 197], [3, 7, 17], [16],
+        [False, True], [16, 32], ["fa2", "fa3"],
+    ),
+)
+def test_batch_mla_oob_kv_nan(batch_size, kv_len, qo_len, num_heads,
+                              causal, page_size, backend):
+    """Reference test_batch_mla_oob_kv_nan (test_deepseek_mla.py:416):
+    NaNs planted beyond each request's kv_len must not reach the output."""
+    if causal and qo_len > kv_len:
+        pytest.skip("qo_len > kv_len not supported for causal attention")
+    _mla_gates(batch_size, kv_len, qo_len, num_heads)
+    q_nope, q_pe, ckv, kpe, pages_num = _mla_inputs(
+        batch_size, kv_len, qo_len, num_heads, page_size)
+    ckv_np = np.asarray(ckv, np.float32)
+    kpe_np = np.asarray(kpe, np.float32)
+    last_page_len = kv_len - (pages_num - 1) * page_size
+    for i in range(batch_size):
+        ckv_np[(i + 1) * pages_num - 1, last_page_len:, :] = np.nan
+        kpe_np[(i + 1) * pages_num - 1, last_page_len:, :] = np.nan
+    ckv_nan = jnp.asarray(ckv_np, jnp.float16)
+    kpe_nan = jnp.asarray(kpe_np, jnp.float16)
+    sm_scale = 1.0 / ((128 + 64) ** 0.5)
+    wrapper = fi.mla.BatchMLAPagedAttentionWrapper(
+        jnp.empty(1024, jnp.int8), backend=backend)
+    q_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * qo_len
+    kv_indptr = np.arange(0, batch_size + 1, dtype=np.int32) * pages_num
+    kv_indices = np.arange(0, batch_size * pages_num, dtype=np.int32)
+    kv_lens = np.full((batch_size,), kv_len, np.int32)
+    wrapper.plan(q_indptr, kv_indptr, kv_indices, kv_lens, num_heads,
+                 _HEAD_DIM_CKV, _HEAD_DIM_KPE, page_size, causal, sm_scale,
+                 q_nope.dtype, ckv.dtype)
+    o, lse = wrapper.run(q_nope, q_pe, ckv_nan, kpe_nan, return_lse=True)
+
+    # oracle sees only the in-bounds tokens (NaNs sliced away)
+    k, v = _generate_kv_from_cache(ckv_np, kpe_np, kv_len, batch_size,
+                                   num_heads)
+    assert not np.isnan(k).any()
+    q = np.concatenate(
+        [np.asarray(q_nope, np.float32), np.asarray(q_pe, np.float32)], -1)
+    o_ref, lse_ref = _attention_ref(batch_size, q, k, v, causal, sm_scale)
+    _check(o, lse, o_ref, lse_ref, kv_len)
