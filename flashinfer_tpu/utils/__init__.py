@@ -165,7 +165,7 @@ def use_interpret() -> bool:
 # reference's backend names.
 _REFERENCE_BACKEND_NAMES = frozenset({
     "fa2", "fa3", "fa2_tc", "trtllm-gen", "trtllm-gen-native", "trtllm",
-    "cutlass", "cudnn", "xqa", "tpu",
+    "cutlass", "cudnn", "xqa", "cute-dsl", "cute_dsl", "tpu",
 })
 
 

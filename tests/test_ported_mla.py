@@ -161,7 +161,7 @@ def test_batch_mla_page_attention(batch_size, kv_len, qo_len, num_heads,
     wrapper = fi.mla.BatchMLAPagedAttentionWrapper(
         jnp.empty(128 * 1024 * 1024, jnp.int8),
         backend=backend,
-        use_cuda_graph=True,
+        use_cuda_graph=use_cuda_graph,
         qo_indptr=jnp.empty(batch_size + 1, jnp.int32),
         kv_indptr=jnp.empty(batch_size + 1, jnp.int32),
         kv_indices=jnp.empty(1048576, jnp.int32),
