@@ -317,23 +317,27 @@ def phase_mla(sweep: bool):
         )
         lens = jnp.full((bs,), ctx, jnp.int32)
         sc = 1.0 / float(np.sqrt(DC + DP))
-        t = _guard_soft(
-            "bench.mla", (bs, ctx, H, DC, DP, PS),
-            lambda: bench_fn_device(
-                lambda a, b, c, d: mla_paged_decode_attention(
-                    a, b, c, d, pt, lens, sm_scale=sc),
-                qn, qp, ckv, kpe, repeats=3,
-            ),
-        )
-        if t is None:
-            continue
-        # decode-bound bytes: latent + rope caches once per request
-        bytes_ = bs * ctx * (DC + 128) * 2.0
-        _emit_row(phase="mla", bs=bs, ctx=ctx, heads=H,
-                  us=round(t * 1e6, 1),
-                  tbps=round(bytes_ / t / 1e12, 4), peak=peak)
-        print(f"# mla bs={bs} ctx={ctx}: {t*1e6:9.1f} us  "
-              f"{bytes_/t/1e12:6.3f} TB/s", file=sys.stderr)
+        # A/B the two scratch layouts (split = hw-validated default;
+        # packed = one concatenated score dot) — the banked pair is the
+        # evidence behind the mla_decode.layout tuned tactic
+        for layout in ("split", "packed"):
+            t = _guard_soft(
+                "bench.mla", (bs, ctx, H, DC, DP, PS, layout),
+                lambda: bench_fn_device(
+                    lambda a, b, c, d: mla_paged_decode_attention(
+                        a, b, c, d, pt, lens, sm_scale=sc, layout=layout),
+                    qn, qp, ckv, kpe, repeats=3,
+                ),
+            )
+            if t is None:
+                continue
+            # decode-bound bytes: latent + rope caches once per request
+            bytes_ = bs * ctx * (DC + 128) * 2.0
+            _emit_row(phase="mla", bs=bs, ctx=ctx, heads=H, layout=layout,
+                      us=round(t * 1e6, 1),
+                      tbps=round(bytes_ / t / 1e12, 4), peak=peak)
+            print(f"# mla {layout:6s} bs={bs} ctx={ctx}: {t*1e6:9.1f} us  "
+                  f"{bytes_/t/1e12:6.3f} TB/s", file=sys.stderr)
 
 
 def phase_sampling(sweep: bool):

@@ -271,7 +271,7 @@ def main(argv=None) -> int:
     sp = sub.add_parser("tune")
     sp.add_argument(
         "--stage", action="append",
-        choices=["norm", "decode", "prefill", "flash"],
+        choices=["norm", "decode", "prefill", "moe", "mla", "flash"],
         help="run only these stages (default: all, wedge-safe order)",
     )
     sp.add_argument(

@@ -172,15 +172,42 @@ class BatchMLAPagedAttentionWrapper:
                 pad = b_pad - q_nope.shape[0]
                 q_nope = jnp.pad(q_nope, ((0, pad), (0, 0), (0, 0)))
                 q_pe = jnp.pad(q_pe, ((0, pad), (0, 0), (0, 0)))
-            fn = (
-                mla_paged_decode_attention
-                if backend == "pallas"
-                else xla_mla_paged_decode
-            )
-            out = fn(
-                q_nope, q_pe, ckv_cache, kpe_cache, plan.page_table,
-                plan.kv_lens, sm_scale=plan.sm_scale, return_lse=return_lse,
-            )
+            if backend == "pallas":
+                # autotuned scratch layout: "split" (two buffers, two
+                # score dots — the hardware-validated default) vs
+                # "packed" (one [chunk, 640] buffer, one concatenated
+                # dot; same DMA queue depth).  Shipped-config/default
+                # outside an autotune() context, like decode's ppc.
+                from flashinfer_tpu.autotuner import AutoTuner
+                from flashinfer_tpu.ops import mla_decode as _mla_module
+
+                key = (
+                    plan.page_table.shape[0], plan.page_table.shape[1],
+                    plan.num_heads, plan.head_dim_ckv, plan.head_dim_kpe,
+                    plan.page_size, str(q_nope.dtype),
+                )
+
+                def _run(layout_):
+                    return mla_paged_decode_attention(
+                        q_nope, q_pe, ckv_cache, kpe_cache,
+                        plan.page_table, plan.kv_lens,
+                        sm_scale=plan.sm_scale, return_lse=return_lse,
+                        layout=layout_,
+                    )
+
+                layout = AutoTuner.get().choose_one(
+                    "mla_decode.layout", key, ["split", "packed"],
+                    lambda c: (lambda: _run(c)),
+                    default="split",
+                    module=_mla_module,
+                )
+                out = _run(str(layout))
+            else:
+                out = xla_mla_paged_decode(
+                    q_nope, q_pe, ckv_cache, kpe_cache, plan.page_table,
+                    plan.kv_lens, sm_scale=plan.sm_scale,
+                    return_lse=return_lse,
+                )
             if return_lse:
                 return out[0][: plan.batch_size], out[1][: plan.batch_size]
             return out[: plan.batch_size]

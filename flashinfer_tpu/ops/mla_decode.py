@@ -9,10 +9,14 @@ head_dim_kpe=64) — shared across all query heads (MQA-shaped).  Scores are
 
 Kernel consequences vs the GQA decode kernel (ops/paged_decode.py):
 - num_kv_heads == 1; ALL query heads form one MXU tile.
-- ckv and kpe stream into separate double-buffered VMEM scratch (Mosaic
-  requires 128-aligned lane slices, so a packed [chunk, 576] buffer is
-  not DMA-addressable for the 64-wide kpe columns); scores are the sum
-  of two MXU dots, and the V matrix is the ckv buffer itself — no
+- Two autotunable scratch layouts (``mla_decode.layout`` tactic):
+  "split" streams ckv and kpe into separate double-buffered VMEM
+  buffers and sums two MXU score dots; "packed" exploits the
+  lane-padded kpe cache (d_kpe 64 -> 128) to share one
+  [chunk, d_ckv + 128] buffer — both DMA destination lane slices
+  (0:512, 512:640) are 128-aligned, which a raw [chunk, 576] packing
+  would violate — and collapses the scores to ONE concatenated dot.
+  Either way the V matrix is the ckv lanes of the buffer itself — no
   separate V DMA, matching the reference's read-ckv-once trick.
 
 Cache layout: ckv ``[num_pages, page_size, head_dim_ckv]``,
@@ -37,25 +41,37 @@ _NEG_INF = -1e30
 def _mla_decode_kernel(
     pages_ref,  # [B, P] scalar prefetch
     kvlen_ref,  # [B]
-    qn_ref,  # [Hp, d_ckv] pre-scaled
-    qp_ref,  # [Hp, d_kpe] pre-scaled
-    ckv_hbm,
-    kpe_hbm,
-    o_ref,  # [Hp, 512]
-    lse_ref,  # [Hp, 128]
-    ckv_buf,  # [2, chunk_tokens, d_ckv]
-    kpe_buf,  # [2, chunk_tokens, d_kpe]
-    sem,  # [2, 2, ppc]
-    *,
+    *refs,  # layout-dependent: see unpacking below
     page_size: int,
     ppc: int,
     d_ckv: int,
     sm_scale: float,
+    packed: bool,
 ):
-    # ckv and kpe live in SEPARATE scratch buffers: packing them into one
-    # [chunk, 576] buffer needs a 64-lane destination slice for the kpe DMA,
-    # which Mosaic rejects (lane slices must be 128-aligned).  Scores are
-    # the sum of two dots instead — same MXU work, no slicing.
+    """One kernel body, two scratch layouts (static ``packed``):
+
+    - split (packed=False): refs = (qn_ref [Hp, d_ckv], qp_ref
+      [Hp, d_kpe_pad], ckv_hbm, kpe_hbm, o_ref, lse_ref,
+      ckv_buf [2, chunk, d_ckv], kpe_buf [2, chunk, d_kpe_pad], sem).
+      Two score dots summed.
+    - packed (packed=True): refs = (qc_ref [Hp, d_ckv + d_kpe_pad],
+      ckv_hbm, kpe_hbm, o_ref, lse_ref,
+      kv_buf [2, chunk, d_ckv + d_kpe_pad], sem).  ckv and the
+      LANE-PADDED kpe share one buffer — both DMA destination lane
+      slices (0:d_ckv and d_ckv:) are 128-aligned because d_ckv and
+      d_kpe_pad are multiples of 128 (a raw [chunk, 576] packing is what
+      Mosaic rejects) — and the scores collapse to ONE MXU dot over the
+      concatenated axis; V is the buffer's first d_ckv lanes.  Same DMA
+      count and queue depth as split.
+
+    Everything else (double-buffered page DMAs, online softmax, lse
+    epilogue) is shared — the layouts cannot drift apart.
+    """
+    if packed:
+        qc_ref, ckv_hbm, kpe_hbm, o_ref, lse_ref, kv_buf, sem = refs
+    else:
+        (qn_ref, qp_ref, ckv_hbm, kpe_hbm, o_ref, lse_ref,
+         ckv_buf, kpe_buf, sem) = refs
     b = pl.program_id(0)
     kv_len = kvlen_ref[b]
     chunk_tokens = ppc * page_size
@@ -63,21 +79,20 @@ def _mla_decode_kernel(
 
     def chunk_dmas(chunk_idx, slot):
         dmas = []
-        for j in range(ppc):  # wedge-lint: ok ppc clamped min(256//PS,16) at call site (<=4 at MLA PS=64); 1 DMA/page
+        for j in range(ppc):  # wedge-lint: ok ppc clamped <= 16 at call site; 2 DMAs/page, on-chip-validated queue depth
             page = pages_ref[b, chunk_idx * ppc + j]
-            dst = pl.ds(j * page_size, page_size)
-            dmas.append(
-                pltpu.make_async_copy(
-                    ckv_hbm.at[page], ckv_buf.at[slot, dst],
-                    sem.at[slot, 0, j],
-                )
-            )
-            dmas.append(
-                pltpu.make_async_copy(
-                    kpe_hbm.at[page], kpe_buf.at[slot, dst],
-                    sem.at[slot, 1, j],
-                )
-            )
+            rows = pl.ds(j * page_size, page_size)
+            if packed:
+                d_pad = kv_buf.shape[-1]
+                ckv_dst = kv_buf.at[slot, rows, pl.ds(0, d_ckv)]
+                kpe_dst = kv_buf.at[slot, rows, pl.ds(d_ckv, d_pad - d_ckv)]
+            else:
+                ckv_dst = ckv_buf.at[slot, rows]
+                kpe_dst = kpe_buf.at[slot, rows]
+            dmas.append(pltpu.make_async_copy(
+                ckv_hbm.at[page], ckv_dst, sem.at[slot, 0, j]))
+            dmas.append(pltpu.make_async_copy(
+                kpe_hbm.at[page], kpe_dst, sem.at[slot, 1, j]))
         return dmas
 
     def start_chunk(i, slot):
@@ -92,9 +107,14 @@ def _mla_decode_kernel(
     def _warmup():
         start_chunk(0, 0)
 
-    qn = qn_ref[...]  # pre-scaled by sm_scale on host
-    qp = qp_ref[...]
-    hp = qn.shape[0]
+    # q operands are pre-scaled by sm_scale on the host
+    if packed:
+        qc = qc_ref[...]
+        hp = qc.shape[0]
+    else:
+        qn = qn_ref[...]
+        qp = qp_ref[...]
+        hp = qn.shape[0]
 
     def body(i, carry):
         m, l, acc = carry
@@ -105,13 +125,24 @@ def _mla_decode_kernel(
             start_chunk(i + 1, jax.lax.rem(i + 1, 2))
 
         wait_chunk(i, slot)
-        ckv = ckv_buf[slot]  # [chunk, d_ckv]
-        kpe = kpe_buf[slot]  # [chunk, d_kpe]
-        s = jax.lax.dot_general(
-            qn, ckv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + jax.lax.dot_general(
-            qp, kpe, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Hp, chunk]
+        if packed:
+            kv = kv_buf[slot]  # [chunk, d_ckv + d_kpe_pad]
+            v = kv[:, :d_ckv]
+            s = jax.lax.dot_general(
+                qc, kv, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [Hp, chunk] — q_pe pad columns are zero, contribute nothing
+        else:
+            ckv = ckv_buf[slot]  # [chunk, d_ckv]
+            kpe = kpe_buf[slot]  # [chunk, d_kpe_pad]
+            v = ckv
+            s = jax.lax.dot_general(
+                qn, ckv, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) + jax.lax.dot_general(
+                qp, kpe, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [Hp, chunk]
         tok = i * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, (1, chunk_tokens), 1
         )
@@ -124,7 +155,7 @@ def _mla_decode_kernel(
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         # V is ckv itself — no second value fetch
         pv = jax.lax.dot_general(
-            p.astype(ckv.dtype), ckv, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc * alpha + pv
@@ -142,7 +173,7 @@ def _mla_decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "pages_per_chunk", "return_lse"),
+    static_argnames=("sm_scale", "pages_per_chunk", "return_lse", "layout"),
 )
 def mla_paged_decode_attention(
     q_nope: jax.Array,  # [batch, num_heads, head_dim_ckv]
@@ -155,6 +186,7 @@ def mla_paged_decode_attention(
     sm_scale: float,
     pages_per_chunk: Optional[int] = None,
     return_lse: bool = False,
+    layout: str = "split",
 ):
     batch, num_heads, d_ckv = q_nope.shape
     d_kpe = q_pe.shape[-1]
@@ -188,12 +220,34 @@ def mla_paged_decode_attention(
         qp = jnp.pad(qp, ((0, 0), (0, hp - num_heads), (0, 0)))
 
     chunk_tokens = pages_per_chunk * page_size
+    if layout == "packed":
+        # one [chunk, d_ckv + d_kpe_pad] buffer, one score dot (see
+        # _mla_decode_kernel packed=True); q halves concatenate on host
+        q_operands = (jnp.concatenate([qn, qp], axis=-1),)
+        q_specs = [
+            pl.BlockSpec((None, hp, d_ckv + d_kpe_pad),
+                         lambda b, *_: (b, 0, 0)),
+        ]
+        kv_scratch = [
+            pltpu.VMEM((2, chunk_tokens, d_ckv + d_kpe_pad),
+                       ckv_cache.dtype),
+        ]
+    elif layout == "split":
+        q_operands = (qn, qp)
+        q_specs = [
+            pl.BlockSpec((None, hp, d_ckv), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, hp, d_kpe_pad), lambda b, *_: (b, 0, 0)),
+        ]
+        kv_scratch = [
+            pltpu.VMEM((2, chunk_tokens, d_ckv), ckv_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, d_kpe_pad), ckv_cache.dtype),
+        ]
+    else:
+        raise ValueError(f"layout must be 'split' or 'packed', got {layout!r}")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch,),
-        in_specs=[
-            pl.BlockSpec((None, hp, d_ckv), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((None, hp, d_kpe_pad), lambda b, *_: (b, 0, 0)),
+        in_specs=q_specs + [
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -201,9 +255,7 @@ def mla_paged_decode_attention(
             pl.BlockSpec((None, hp, d_ckv), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec((None, hp, 128), lambda b, *_: (b, 0, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, d_ckv), ckv_cache.dtype),
-            pltpu.VMEM((2, chunk_tokens, d_kpe_pad), ckv_cache.dtype),
+        scratch_shapes=kv_scratch + [
             pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
         ],
     )
@@ -214,6 +266,7 @@ def mla_paged_decode_attention(
             ppc=pages_per_chunk,
             d_ckv=d_ckv,
             sm_scale=sm_scale,
+            packed=(layout == "packed"),
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -224,7 +277,7 @@ def mla_paged_decode_attention(
             vmem_limit_bytes=64 * 1024 * 1024
         ),
         interpret=use_interpret(),
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qn, qp,
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *q_operands,
       ckv_cache, kpe_cache)
 
     out = out[:, :num_heads]

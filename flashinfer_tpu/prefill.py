@@ -804,9 +804,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
 
                 (qo_i, kvp_i, kvi_i, kvl_i, ps, fkey, mflat,
                  mbits) = self._fused_raw
+                # ct stays <= 256: each unit unrolls 2 DMAs/page, and
+                # ppc=16 (32 in-flight) is the on-chip-validated ceiling —
+                # ppc=32 would be the W002 queue-unroll wedge class.
+                # bq is DMA-count-neutral, so it explores up to 512.
                 cands = sorted({
                     (bq_c, max(1, ct // ps))
-                    for bq_c in (64, 128, 256) for ct in (128, 256)
+                    for bq_c in (64, 128, 256, 512) for ct in (128, 256)
                 })
 
                 def _build(c):

@@ -154,11 +154,41 @@ def run_tuning_workload(stages: Optional[list] = None,
                               gather_variant="sorted")
             log(f"moe tiles tuned T={t}")
 
+    def stage_mla():
+        # DeepSeek-V3 absorbed-MLA decode at the bench shape; profiles the
+        # mla_decode.layout tactic (split vs packed scratch)
+        DC, DP, MPS, MH = 512, 64, 16, 128
+        for bs, ctx in ((64, 4096), (16, 4096)):
+            ppr = ctx // MPS
+            npages = bs * ppr + 1
+            ckv = jnp.asarray(
+                np.random.randn(npages, MPS, DC) / 8, jnp.bfloat16)
+            kpe = jnp.asarray(
+                np.pad(np.random.randn(npages, MPS, DP) / 8,
+                       ((0, 0), (0, 0), (0, 128 - DP))), jnp.bfloat16)
+            wrap = fi.mla.BatchMLAPagedAttentionWrapper()
+            wrap.plan(
+                np.arange(bs + 1, dtype=np.int32),
+                np.arange(bs + 1, dtype=np.int32) * ppr,
+                np.arange(bs * ppr, dtype=np.int32),
+                np.full((bs,), ctx, np.int32),
+                MH, DC, DP, MPS, False, 1.0 / (DC + DP) ** 0.5,
+                jnp.bfloat16, jnp.bfloat16,
+            )
+            qn = jnp.asarray(np.random.randn(bs, MH, DC) / 8, jnp.bfloat16)
+            qp = jnp.asarray(np.random.randn(bs, MH, DP) / 8, jnp.bfloat16)
+            wrap.run(qn, qp, ckv, kpe)
+            log(f"mla layout tuned bs={bs} ctx={ctx}")
+
     all_stages = [
         ("norm", stage_norm),
         ("decode", stage_decode),
         ("prefill", stage_prefill),
         ("moe", stage_moe),
+        # mla after moe: the packed-layout candidate is a first Mosaic
+        # compile (wedge-ordering discipline — risky compiles late, so a
+        # hang cannot cost the proven stages' tactics); flash stays last
+        ("mla", stage_mla),
         ("flash", stage_flash),
     ]
     selected = (

@@ -67,6 +67,52 @@ def test_mla_decode(backend):
         )
 
 
+def test_mla_decode_packed_layout():
+    """Packed single-buffer kernel variant (one concatenated score dot)
+    matches the split-layout kernel and the eager oracle bit-for-spec."""
+    from flashinfer_tpu.ops.mla_decode import mla_paged_decode_attention
+
+    B, H, d_ckv, d_kpe, PS = 3, 16, 128, 64, 8
+    kv_lens = np.array([19, 40, 3], np.int32)
+    num_pages = 32
+    sm = 1 / np.sqrt(d_ckv + d_kpe)
+    rng = np.random.default_rng(0)
+    max_pages = int(-(-kv_lens.max() // PS))
+    table = rng.permutation(num_pages)[: B * max_pages].astype(
+        np.int32).reshape(B, max_pages)
+
+    ckv, kpe = _setup_cache(jax.random.PRNGKey(0), num_pages, PS, d_ckv, d_kpe)
+    q_nope = jax.random.normal(jax.random.PRNGKey(1), (B, H, d_ckv), jnp.float32)
+    q_pe = jax.random.normal(jax.random.PRNGKey(2), (B, H, d_kpe), jnp.float32)
+
+    kw = dict(sm_scale=float(sm), return_lse=True)
+    o_s, lse_s = mla_paged_decode_attention(
+        q_nope, q_pe, ckv, kpe, jnp.asarray(table), jnp.asarray(kv_lens),
+        layout="split", **kw)
+    o_p, lse_p = mla_paged_decode_attention(
+        q_nope, q_pe, ckv, kpe, jnp.asarray(table), jnp.asarray(kv_lens),
+        layout="packed", **kw)
+    np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_s),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse_p), np.asarray(lse_s),
+                               rtol=1e-5, atol=1e-5)
+
+    crows = np.asarray(ckv).reshape(-1, d_ckv)
+    prows = np.asarray(kpe).reshape(-1, d_kpe)
+    for b in range(B):
+        tok = np.arange(kv_lens[b])
+        rows = table[b][tok // PS] * PS + tok % PS
+        ref = _mla_ref(q_nope[b:b+1], q_pe[b:b+1], crows[rows], prows[rows], sm)
+        np.testing.assert_allclose(
+            np.asarray(o_p[b]), ref[0], rtol=2e-3, atol=2e-3,
+            err_msg=f"req {b}")
+
+    with pytest.raises(ValueError, match="layout"):
+        mla_paged_decode_attention(
+            q_nope, q_pe, ckv, kpe, jnp.asarray(table),
+            jnp.asarray(kv_lens), layout="bogus", **kw)
+
+
 @pytest.mark.parametrize("backend", ["pallas", "xla"])
 def test_mla_ragged_multitoken(backend):
     """Speculative multi-token qo (qo_len 3) exercises the ragged path."""

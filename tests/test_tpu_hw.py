@@ -198,6 +198,36 @@ def test_mla_decode_deepseek_shape():
     )
 
 
+def test_mla_decode_packed_layout_on_chip():
+    """Packed single-buffer MLA scratch (one concatenated score dot,
+    128-aligned dst lane slices 0:512 / 512:640) vs the validated split
+    kernel on real hardware — first Mosaic compile of the packed form."""
+    from flashinfer_tpu.ops.mla_decode import mla_paged_decode_attention
+
+    B, H, d_ckv, d_kpe, PS, ctx = 4, 128, 512, 64, 16, 2048
+    ppr = ctx // PS
+    npages = B * ppr
+    ckv = jax.random.normal(
+        jax.random.PRNGKey(0), (npages, PS, d_ckv), jnp.bfloat16
+    )
+    kpe = jax.random.normal(
+        jax.random.PRNGKey(1), (npages, PS, d_kpe), jnp.bfloat16
+    )
+    qn = jax.random.normal(jax.random.PRNGKey(2), (B, H, d_ckv), jnp.bfloat16)
+    qp = jax.random.normal(jax.random.PRNGKey(3), (B, H, d_kpe), jnp.bfloat16)
+    pt = jnp.arange(npages, dtype=jnp.int32).reshape(B, ppr)
+    lens = jnp.array([2048, 1031, 64, 1999], jnp.int32)
+    sm = (d_ckv + d_kpe) ** -0.5
+    o_p = mla_paged_decode_attention(
+        qn, qp, ckv, kpe, pt, lens, sm_scale=sm, layout="packed")
+    o_s = mla_paged_decode_attention(
+        qn, qp, ckv, kpe, pt, lens, sm_scale=sm, layout="split")
+    np.testing.assert_allclose(
+        np.asarray(o_p, np.float32), np.asarray(o_s, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
 def test_rmsnorm_llama_shape():
     T, H = 4096, 4096
     x = jax.random.normal(jax.random.PRNGKey(0), (T, H), jnp.bfloat16)
