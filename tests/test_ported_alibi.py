@@ -1,0 +1,110 @@
+"""Migration proof #10: mechanical port of the reference test file
+``/root/reference/tests/attention/test_alibi.py`` run against
+``flashinfer_tpu``.
+
+Same porting contract as tests/test_ported_batch_prefill.py: reference
+parameter matrices verbatim, reference call sequences
+(``single_decode_with_kv_cache(..., pos_encoding_mode="ALIBI")``,
+``single_prefill_with_kv_cache(..., causal=, pos_encoding_mode="ALIBI")``),
+torch.float16 -> jnp.float16.  Oracle = the reference's
+``tests/test_helpers/alibi_reference.py`` (labml-derived slopes +
+distance-bias attention) transcribed to numpy f64.
+
+The reference's warmup_jit fixture (CUDA module prebuild) has no TPU
+meaning and is dropped; XLA compiles on first call.  Work caps as in the
+other ports (FLASHINFER_TPU_FULL_MATRIX=1 runs everything).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample, _work_gate
+
+
+def _get_slopes(n_heads):
+    """Reference slopes (alibi_reference.py:21-58): geometric series from
+    2^(-8/n) at the closest lower power of two, odd-step fill above."""
+    n = 2 ** math.floor(math.log2(n_heads))
+    m0 = 2.0 ** (-8.0 / n)
+    m = m0 ** np.arange(1, 1 + n)
+    if n < n_heads:
+        mh0 = 2.0 ** (-4.0 / n)
+        mh = mh0 ** np.arange(1, 1 + 2 * (n_heads - n), 2)
+        m = np.concatenate([m, mh])
+    return m.astype(np.float64)
+
+
+def _alibi_attention(q, k, v, mask):
+    """Reference oracle (alibi_reference.py:86-124) in f64 numpy: bias =
+    key-distance * per-head slope, added AFTER the 1/sqrt(d) scale."""
+    q = np.asarray(q, np.float64)
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    q_len, num_heads, head_dim = q.shape
+    scores = np.einsum("qhd,khd->qkh", q, k) / math.sqrt(head_dim)
+    distance = np.arange(mask.shape[1], dtype=np.float64)[None, :]
+    biases = distance[:, :, None] * _get_slopes(num_heads)[None, None, :]
+    scores = scores + biases
+    scores = np.where(mask[:, :, None], scores, -np.inf)
+    m_ = scores.max(1, keepdims=True)
+    e = np.exp(scores - m_)
+    attn = e / e.sum(1, keepdims=True)
+    return np.einsum("qkh,khd->qhd", attn, v)
+
+
+@pytest.mark.parametrize(
+    "seq_len,num_heads,head_dim",
+    _sample("alibi_decode", [1, 9, 81, 729], [4, 8, 32], [128, 256]),
+)
+def test_single_decode_alibi(seq_len, num_heads, head_dim):
+    """Reference test_single_decode_alibi (test_alibi.py:57)."""
+    _work_gate(1, 1, seq_len, num_heads, head_dim)
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (num_heads, head_dim), jnp.float16)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1), (seq_len, num_heads, head_dim),
+        jnp.float16)
+    v = jax.random.normal(
+        jax.random.fold_in(key, 2), (seq_len, num_heads, head_dim),
+        jnp.float16)
+    o = fi.single_decode_with_kv_cache(q, k, v, pos_encoding_mode="ALIBI")
+    mask = np.ones((1, seq_len), bool)
+    o_ref = _alibi_attention(np.asarray(q, np.float32)[None], k, v, mask)[0]
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "q_len,kv_len,num_heads,head_dim,causal",
+    _sample(
+        "alibi_prefill",
+        [1, 17, 81, 987], [1, 17, 81, 987], [4, 8, 32], [128, 256],
+        [False, True],
+    ),
+)
+def test_single_prefill_alibi(q_len, kv_len, num_heads, head_dim, causal):
+    """Reference test_single_prefill_alibi (test_alibi.py:76)."""
+    if causal and q_len > kv_len:
+        pytest.skip("Causal attention requires q_len <= kv_len")
+    _work_gate(1, q_len, kv_len, num_heads, head_dim)
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (q_len, num_heads, head_dim), jnp.float16)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1), (kv_len, num_heads, head_dim),
+        jnp.float16)
+    v = jax.random.normal(
+        jax.random.fold_in(key, 2), (kv_len, num_heads, head_dim),
+        jnp.float16)
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, causal=causal, pos_encoding_mode="ALIBI")
+    mask = np.ones((q_len, kv_len), bool)
+    if causal:
+        mask = np.tril(mask, k=kv_len - q_len)
+    o_ref = _alibi_attention(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref, rtol=1e-2, atol=1e-2)

@@ -1,0 +1,95 @@
+"""Migration proof #11: mechanical port of the reference test file
+``/root/reference/tests/attention/test_logits_cap.py`` run against
+``flashinfer_tpu``.
+
+Same porting contract as tests/test_ported_batch_prefill.py: reference
+matrices verbatim — incl. the 33001-kv decode cells (run: decode work
+is small) and the 31111-kv prefill cells (work-cap-gated on CPU CI) —
+reference call sequences
+(``single_{decode,prefill}_with_kv_cache(..., logits_soft_cap=)``),
+torch.float16 -> jnp.float16.  Oracle = the reference's
+``attention_logits_soft_cap_torch`` (tanh capping applied after the
+1/sqrt(d) scale) in f64 numpy.  The warmup_jit CUDA prebuild fixture is
+dropped (XLA compiles on first call).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample, _work_gate
+
+
+def _soft_cap_attention(q, k, v, soft_cap):
+    """Reference oracle (test_logits_cap.py:66-72, non-causal as in the
+    reference) in f64: scores -> cap * tanh(scores / cap) -> softmax."""
+    q = np.asarray(q, np.float64)
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    scores = np.einsum("qhd,khd->qkh", q, k) / math.sqrt(q.shape[-1])
+    scores = soft_cap * np.tanh(scores / soft_cap)
+    m_ = scores.max(1, keepdims=True)
+    e = np.exp(scores - m_)
+    attn = e / e.sum(1, keepdims=True)
+    return np.einsum("qkh,khd->qhd", attn, v)
+
+
+@pytest.mark.parametrize(
+    "seq_len,num_heads,head_dim,soft_cap",
+    _sample(
+        "cap_decode",
+        [1, 9, 81, 729, 33001], [4, 8, 32], [128, 256], [1.0, 30.0, 50.0],
+        # always keep a long-context decode cell (runs: decode work is
+        # within the CPU cap; the 31111-kv PREFILL cells are what gate)
+        specials=((0, 33001),),
+    ),
+)
+def test_single_decode_logits_soft_cap(seq_len, num_heads, head_dim,
+                                       soft_cap):
+    """Reference test_single_decode_logits_soft_cap (test_logits_cap.py:75)."""
+    _work_gate(1, 1, seq_len, num_heads, head_dim)
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (num_heads, head_dim), jnp.float16)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1), (seq_len, num_heads, head_dim),
+        jnp.float16)
+    v = jax.random.normal(
+        jax.random.fold_in(key, 2), (seq_len, num_heads, head_dim),
+        jnp.float16)
+    o = fi.single_decode_with_kv_cache(q, k, v, logits_soft_cap=soft_cap)
+    o_ref = _soft_cap_attention(
+        np.asarray(q, np.float32)[None], k, v, soft_cap)[0]
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "q_len,kv_len,num_heads,head_dim,soft_cap",
+    _sample(
+        "cap_prefill",
+        [1, 17, 81, 987], [1, 17, 81, 987, 31111], [4, 8, 32], [128, 256],
+        [1.0, 30.0, 50.0],
+        specials=((1, 31111),),
+    ),
+)
+def test_single_prefill_logits_soft_cap(q_len, kv_len, num_heads, head_dim,
+                                        soft_cap):
+    """Reference test_single_prefill_logits_soft_cap
+    (test_logits_cap.py:93); non-causal, as in the reference."""
+    _work_gate(1, q_len, kv_len, num_heads, head_dim)
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (q_len, num_heads, head_dim), jnp.float16)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1), (kv_len, num_heads, head_dim),
+        jnp.float16)
+    v = jax.random.normal(
+        jax.random.fold_in(key, 2), (kv_len, num_heads, head_dim),
+        jnp.float16)
+    o = fi.single_prefill_with_kv_cache(q, k, v, logits_soft_cap=soft_cap)
+    o_ref = _soft_cap_attention(q, k, v, soft_cap)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref, rtol=1e-2, atol=1e-2)
