@@ -49,8 +49,6 @@ class BlockSparseAttentionWrapper:
         q_data_type=jnp.bfloat16,
         **_unused,
     ) -> None:
-        if mask is not None:
-            raise NotImplementedError("per-block bitmasks: later round")
         if M % R or N % C:
             raise ValueError("M/N must be multiples of R/C")
         from flashinfer_tpu import native
@@ -61,9 +59,42 @@ class BlockSparseAttentionWrapper:
         nnz_per_row = indptr[1:] - indptr[:-1]
         max_nnz = max(next_power_of_two(int(nnz_per_row.max(initial=1))), 1)
         cols = native.bsr_plan(indptr, indices, max_nnz)
+        dense_mask = None
+        if mask is not None:
+            # per-block interior bitmask (reference sparse.py plan(mask=)):
+            # [nnz, R, C] bool selecting elements WITHIN each nonzero
+            # block, or the flattened per-row-of-blocks layout produced by
+            # convert_bsr_mask_layout.  Honored on the dense-mask path —
+            # run() routes there when a mask is planned (the Pallas BSR
+            # kernel has no interior-mask term; same dispatch pattern as
+            # ALiBi).  Expanded to the dense [M, N] mask HERE, once — not
+            # per run().
+            mask = np.asarray(mask).astype(bool)
+            nnz = len(indices)
+            if mask.shape == (nnz * R * C,):
+                # undo convert_bsr_mask_layout's within-row transpose
+                blocks = np.empty((nnz, R, C), bool)
+                for i in range(MB):
+                    lo, hi = int(indptr[i]), int(indptr[i + 1])
+                    seg = mask[lo * R * C: hi * R * C]
+                    blocks[lo:hi] = seg.reshape(R, hi - lo, C).transpose(
+                        1, 0, 2)
+                mask = blocks
+            if mask.shape != (nnz, R, C):
+                raise ValueError(
+                    f"mask must be [nnz={nnz}, R={R}, C={C}] or the "
+                    f"flattened ({nnz * R * C},) convert_bsr_mask_layout "
+                    f"form, got {mask.shape}")
+            mask_np = np.zeros((M, N), bool)
+            for i in range(MB):
+                for pos in range(int(indptr[i]), int(indptr[i + 1])):
+                    j = int(indices[pos])
+                    mask_np[i * R:(i + 1) * R, j * C:(j + 1) * C] = mask[pos]
+            dense_mask = jnp.asarray(mask_np)
         self._plan = dict(
             indptr=jnp.asarray(indptr, dtype=jnp.int32),
             cols=jnp.asarray(cols),
+            block_mask=dense_mask,
             M=M, N=N, R=R, C=C, max_nnz=max_nnz,
             num_qo_heads=num_qo_heads, num_kv_heads=num_kv_heads,
             head_dim=head_dim,
@@ -75,7 +106,7 @@ class BlockSparseAttentionWrapper:
         if p is None:
             raise RuntimeError("plan() must be called before run()")
         backend = resolve_backend(self._backend, "block_sparse")
-        if backend == "pallas":
+        if backend == "pallas" and p.get("block_mask") is None:
             return bsr_attention(
                 q, k, v, p["indptr"], p["cols"],
                 block_row=p["R"], block_col=p["C"], max_nnz=p["max_nnz"],
@@ -111,13 +142,15 @@ def _dense_masked_attention(q, k, v, mask, sm_scale):
 def _xla_bsr_dense(q, k, v, p):
     M, N, R, C = p["M"], p["N"], p["R"], p["C"]
     MB = M // R
-    indptr = np.asarray(p["indptr"])
-    cols = np.asarray(p["cols"]).reshape(MB, p["max_nnz"])
-    rows_np = np.zeros((MB, N // C), bool)
-    for i in range(MB):
-        n = int(indptr[i + 1] - indptr[i])
-        rows_np[i, cols[i, :n]] = True
-    mask = jnp.asarray(np.repeat(np.repeat(rows_np, R, 0), C, 1))
+    mask = p.get("block_mask")  # dense [M, N], pre-expanded at plan()
+    if mask is None:
+        indptr = np.asarray(p["indptr"])
+        cols = np.asarray(p["cols"]).reshape(MB, p["max_nnz"])
+        rows_np = np.zeros((MB, N // C), bool)
+        for i in range(MB):
+            n = int(indptr[i + 1] - indptr[i])
+            rows_np[i, cols[i, :n]] = True
+        mask = jnp.asarray(np.repeat(np.repeat(rows_np, R, 0), C, 1))
     return _dense_masked_attention(q, k, v, mask, p["sm_scale"])
 
 
@@ -138,9 +171,9 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
 
     def plan(
         self,
-        block_mask_map,  # [MB, NB] bool dense block mask
-        block_row_sz,  # [MB] row sizes
-        block_col_sz,  # [NB] col sizes
+        block_mask_map,  # [MB, NB] bool — or [num_kv_heads, MB, NB]
+        block_row_sz,  # [MB] row sizes — or [num_kv_heads, MB]
+        block_col_sz,  # [NB] col sizes — or [num_kv_heads, NB]
         num_qo_heads: int,
         num_kv_heads: int,
         head_dim: int,
@@ -148,14 +181,46 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
         q_data_type=jnp.bfloat16,
         **_unused,
     ) -> None:
+        """Two input forms, as in the reference (sparse.py:1075): a
+        single shared block structure (2-D map, token-major [len, heads,
+        dim] tensors in run()), or PER-KV-HEAD structures (3-D map —
+        the reference test matrix's form; run() then takes HND
+        [heads, len, dim] tensors and returns [num_qo_heads, len, dim],
+        each q-head group attending under its kv head's structure)."""
+        map_all = np.asarray(block_mask_map, dtype=bool)
+        sm = get_sm_scale(head_dim, sm_scale)
+        if map_all.ndim == 3:
+            rs_all = np.asarray(block_row_sz, dtype=np.int64)
+            cs_all = np.asarray(block_col_sz, dtype=np.int64)
+            if map_all.shape[0] != num_kv_heads or num_qo_heads % num_kv_heads:
+                raise ValueError(
+                    "3-D block_mask_map must be [num_kv_heads, MB, NB] with "
+                    "num_qo_heads divisible by num_kv_heads")
+            MB, NB = map_all.shape[1:]
+            if rs_all.shape != (num_kv_heads, MB) or \
+                    cs_all.shape != (num_kv_heads, NB):
+                raise ValueError(
+                    f"with a 3-D block_mask_map, block_row_sz must be "
+                    f"[{num_kv_heads}, {MB}] and block_col_sz "
+                    f"[{num_kv_heads}, {NB}]; got {rs_all.shape} / "
+                    f"{cs_all.shape}")
+            self._plan = dict(
+                per_head=True, group=num_qo_heads // num_kv_heads,
+                heads=[
+                    self._plan_single(map_all[h], rs_all[h], cs_all[h], sm)
+                    for h in range(num_kv_heads)
+                ],
+            )
+            return
+        self._plan = self._plan_single(
+            map_all, np.asarray(block_row_sz, dtype=np.int64),
+            np.asarray(block_col_sz, dtype=np.int64), sm)
+
+    def _plan_single(self, map_np, rs, cs, sm):
         from flashinfer_tpu.utils import round_up
 
-        map_np = np.asarray(block_mask_map, dtype=bool)
-        rs = np.asarray(block_row_sz, dtype=np.int64)
-        cs = np.asarray(block_col_sz, dtype=np.int64)
         MB, NB = map_np.shape
         M, N = int(rs.sum()), int(cs.sum())
-        sm = get_sm_scale(head_dim, sm_scale)
         TR, TC = self._TR, self._TC
 
         Mpad, Npad = round_up(M, TR), round_up(N, TC)
@@ -207,7 +272,7 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
         mappad[:MB, :NB] = map_np
 
         use_kernel = (MBpad * NBpad * 4 <= 6 << 20) and k_span <= 32
-        self._plan = dict(
+        return dict(
             variable=True, use_kernel=use_kernel,
             M=M, N=N, Mpad=Mpad, Npad=Npad,
             indptr=jnp.asarray(indptr),
@@ -234,6 +299,24 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
         if p is None:
             raise RuntimeError("plan() must be called before run()")
         backend = resolve_backend(self._backend, "block_sparse")
+        if p.get("per_head"):
+            # reference per-kv-head form: HND tensors, each q-head group
+            # under its kv head's structure (one kernel/dense call per kv
+            # head — the structures genuinely differ per head)
+            G = p["group"]
+            outs = []
+            for h, ph in enumerate(p["heads"]):
+                oh = self._run_single(
+                    ph, backend,
+                    jnp.swapaxes(q[h * G:(h + 1) * G], 0, 1),
+                    jnp.swapaxes(k[h:h + 1], 0, 1),
+                    jnp.swapaxes(v[h:h + 1], 0, 1),
+                )
+                outs.append(jnp.swapaxes(oh, 0, 1))
+            return jnp.concatenate(outs, axis=0)
+        return self._run_single(p, backend, q, k, v)
+
+    def _run_single(self, p, backend, q, k, v):
         if backend != "pallas" or not p["use_kernel"]:
             return _dense_masked_attention(
                 q, k, v, self._dense_mask(p), p["sm_scale"]
@@ -254,6 +337,10 @@ class VariableBlockSparseAttentionWrapper(BlockSparseAttentionWrapper):
             sm_scale=p["sm_scale"],
         )
         return out[:M]
+
+    # rebind: the base class set `forward = run` to ITS run; without this,
+    # forward() on a variable/per-head plan would dispatch to the BSR run
+    forward = run
 
 
 def convert_bsr_mask_layout(mask, indptr):

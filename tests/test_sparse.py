@@ -87,3 +87,51 @@ def test_variable_block_sparse_wrapper():
     mask = np.repeat(np.repeat(block_mask, row_sz, 0), col_sz, 1)
     ref = _dense_ref(q, k, v, mask, 1 / np.sqrt(D))
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_vbsr_per_head_forward_alias():
+    """forward() on a per-kv-head (3-D map) plan must dispatch to the
+    subclass run, not the base BSR run (regression: the base class's
+    `forward = run` alias shadowed the override)."""
+    HQ, KVH, D = 4, 2, 32
+    rng = np.random.default_rng(0)
+    row_sz = np.tile(np.array([8, 24]), (KVH, 1))
+    col_sz = np.tile(np.array([16, 16]), (KVH, 1))
+    bmap = rng.random((KVH, 2, 2)) > 0.4
+    bmap[:, 0, 0] = True  # no empty q rows
+    bmap[:, 1, :] = True
+    M, N = int(row_sz[0].sum()), int(col_sz[0].sum())
+    q = jax.random.normal(jax.random.PRNGKey(0), (HQ, M, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (KVH, N, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (KVH, N, D), jnp.float32)
+    w = fi.VariableBlockSparseAttentionWrapper()
+    w.plan(block_mask_map=bmap, block_row_sz=row_sz, block_col_sz=col_sz,
+           num_qo_heads=HQ, num_kv_heads=KVH, head_dim=D)
+    np.testing.assert_allclose(
+        np.asarray(w.forward(q, k, v)), np.asarray(w.run(q, k, v)))
+    # mixed 1-D sizes with a 3-D map must raise, not silently mis-plan
+    with pytest.raises(ValueError, match="block_row_sz"):
+        w.plan(block_mask_map=bmap, block_row_sz=row_sz[0],
+               block_col_sz=col_sz[0], num_qo_heads=HQ, num_kv_heads=KVH,
+               head_dim=D)
+
+
+def test_bsr_mask_flattened_layout_accepted():
+    """plan(mask=) accepts both [nnz, R, C] and the flattened
+    convert_bsr_mask_layout form, with identical results."""
+    R, C, M, N, H = 4, 4, 16, 16, 2
+    indptr = np.array([0, 1, 3, 4, 6], np.int32)
+    indices = np.array([0, 1, 3, 2, 0, 3], np.int32)
+    rng = np.random.default_rng(1)
+    blocks = rng.random((6, R, C)) > 0.5
+    q = jax.random.normal(jax.random.PRNGKey(0), (M, H, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (N, H, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (N, H, 32), jnp.float32)
+    w1 = fi.BlockSparseAttentionWrapper()
+    w1.plan(indptr, indices, M, N, R, C, H, H, 32, mask=blocks)
+    w2 = fi.BlockSparseAttentionWrapper()
+    w2.plan(indptr, indices, M, N, R, C, H, H, 32,
+            mask=np.asarray(fi.sparse.convert_bsr_mask_layout(
+                blocks, indptr)))
+    np.testing.assert_allclose(
+        np.asarray(w1.run(q, k, v)), np.asarray(w2.run(q, k, v)))
