@@ -1,0 +1,261 @@
+"""Migration proof #13: mechanical port of the reference test file
+``/root/reference/tests/attention/test_sliding_window.py`` run against
+``flashinfer_tpu``.
+
+Same porting contract as tests/test_ported_batch_prefill.py: reference
+matrices verbatim, reference call sequences and ORACLES — like the
+reference, most tests check self-consistency (batch wrappers vs the
+library's own single-op entries on per-request slices; windowed decode
+vs un-windowed decode on the hand-sliced window), plus one custom-mask
+cross-check.  torch.float16 -> jnp.float16.
+
+Notes:
+- the reference's head_dim==512 CUDA backend gate
+  (``skip_if_head_dim_unsupported``) is dropped: every head_dim runs
+  here (XLA/Pallas have no 512 restriction).
+- ``backend="fa2"`` cells run verbatim via utils.normalize_backend.
+- the warmup_jit CUDA prebuild fixture is dropped (XLA compiles on
+  first call); work caps as in the other ports.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample, _work_gate
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, jnp.float16)
+
+
+def _close(a, b, rtol=1e-3, atol=1e-3, msg=""):
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        rtol=rtol, atol=atol, err_msg=msg)
+
+
+@pytest.mark.parametrize(
+    "seq_len,window_left,num_kv_heads,num_qo_heads,head_dim",
+    _sample(
+        "sw_single_decode",
+        [1, 3, 19, 99, 199, 1177, 1999], [3, 13, 23, 37, 43], [1, 4],
+        [4, 8], [64, 128, 256, 512],
+    ),
+)
+def test_single_decode_sliding_window(seq_len, window_left, num_kv_heads,
+                                      num_qo_heads, head_dim):
+    """Reference test_single_decode_sliding_window
+    (test_sliding_window.py:72): windowed decode == plain decode over the
+    hand-sliced last window_left+1 tokens."""
+    _work_gate(1, 1, seq_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(0)
+    q = _rand(key, (num_qo_heads, head_dim))
+    k = _rand(jax.random.fold_in(key, 1), (seq_len, num_kv_heads, head_dim))
+    v = _rand(jax.random.fold_in(key, 2), (seq_len, num_kv_heads, head_dim))
+    o_ref = fi.single_decode_with_kv_cache(
+        q, k[-(window_left + 1):], v[-(window_left + 1):])
+    o = fi.single_decode_with_kv_cache(q, k, v, window_left=window_left)
+    _close(o, o_ref)
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,window_left,num_kv_heads,num_qo_heads,head_dim,"
+    "page_size,backend",
+    _sample(
+        "sw_batch_decode",
+        [1, 3, 13, 32], [1, 3, 99, 199, 1999], [33, 533], [1, 4], [4, 8],
+        [64, 128, 256, 512], [1, 16], ["fa2", "auto"],
+    ),
+)
+def test_batch_decode_sliding_window(batch_size, kv_len, window_left,
+                                     num_kv_heads, num_qo_heads, head_dim,
+                                     page_size, backend):
+    """Reference test_batch_decode_sliding_window
+    (test_sliding_window.py:101): NHD paged wrapper vs per-request
+    single-decode slices."""
+    _work_gate(batch_size, 1, kv_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(1)
+    q = _rand(key, (batch_size, num_qo_heads, head_dim))
+    num_pages_per_seq = (kv_len + page_size - 1) // page_size
+    total_num_pages = num_pages_per_seq * batch_size
+    k_data = _rand(jax.random.fold_in(key, 1),
+                   (total_num_pages, page_size, num_kv_heads, head_dim))
+    v_data = _rand(jax.random.fold_in(key, 2),
+                   (total_num_pages, page_size, num_kv_heads, head_dim))
+    kv_indptr = np.arange(batch_size + 1, dtype=np.int32) * num_pages_per_seq
+    kv_indices = np.arange(total_num_pages, dtype=np.int32)
+    kv_last_page_len = np.full(
+        (batch_size,), (kv_len - 1) % page_size + 1, np.int32)
+    wrapper = fi.BatchDecodeWithPagedKVCacheWrapper(
+        jnp.empty(32 * 1024 * 1024, jnp.int8), "NHD", backend=backend)
+    wrapper.plan(kv_indptr, kv_indices, kv_last_page_len, num_qo_heads,
+                 num_kv_heads, head_dim, page_size,
+                 window_left=window_left)
+    o = wrapper.run(q, (k_data, v_data))
+
+    k_np = np.asarray(k_data)
+    v_np = np.asarray(v_data)
+    for i in range(batch_size):
+        ki = np.concatenate([
+            k_np[kv_indptr[i]: kv_indptr[i + 1] - 1].reshape(
+                -1, num_kv_heads, head_dim),
+            k_np[kv_indptr[i + 1] - 1, : kv_last_page_len[i]],
+        ], 0)
+        vi = np.concatenate([
+            v_np[kv_indptr[i]: kv_indptr[i + 1] - 1].reshape(
+                -1, num_kv_heads, head_dim),
+            v_np[kv_indptr[i + 1] - 1, : kv_last_page_len[i]],
+        ], 0)
+        o_ref_i = fi.single_decode_with_kv_cache(
+            q[i], jnp.asarray(ki), jnp.asarray(vi),
+            window_left=window_left)
+        _close(o[i], o_ref_i, msg=f"req {i}")
+
+
+@pytest.mark.parametrize(
+    "seq_len,window_left,num_kv_heads,num_qo_heads,head_dim",
+    _sample(
+        "sw_decode_prefill_match",
+        [1, 3, 19, 99, 199, 1999], [3, 13, 23, 43], [1, 4], [4, 8],
+        [64, 128, 256],
+    ),
+)
+def test_single_decode_prefill_sliding_window_match(
+        seq_len, window_left, num_kv_heads, num_qo_heads, head_dim):
+    """Reference test_single_decode_prefill_sliding_window_match
+    (test_sliding_window.py:192): 1-token causal windowed prefill ==
+    windowed decode."""
+    _work_gate(1, 1, seq_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(2)
+    q = _rand(key, (1, num_qo_heads, head_dim))
+    k = _rand(jax.random.fold_in(key, 1), (seq_len, num_kv_heads, head_dim))
+    v = _rand(jax.random.fold_in(key, 2), (seq_len, num_kv_heads, head_dim))
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, window_left=window_left, causal=True)
+    o_decoded = fi.single_decode_with_kv_cache(
+        q[0], k, v, window_left=window_left)
+    _close(o[0], o_decoded)
+
+
+@pytest.mark.parametrize(
+    "seq_len,window_left,num_kv_heads,num_qo_heads,head_dim",
+    _sample(
+        "sw_single_prefill",
+        [99, 199, 1999], [43, 233], [1, 4], [4, 8], [64, 128, 256, 512],
+    ),
+)
+def test_single_prefill_sliding_window(seq_len, window_left, num_kv_heads,
+                                       num_qo_heads, head_dim):
+    """Reference test_single_prefill_sliding_window
+    (test_sliding_window.py:216): window_left+causal == the equivalent
+    banded custom mask."""
+    _work_gate(1, seq_len, seq_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(3)
+    q = _rand(key, (seq_len, num_qo_heads, head_dim))
+    k = _rand(jax.random.fold_in(key, 1), (seq_len, num_kv_heads, head_dim))
+    v = _rand(jax.random.fold_in(key, 2), (seq_len, num_kv_heads, head_dim))
+    row = np.arange(seq_len, dtype=np.int64)[:, None]
+    col = np.arange(seq_len, dtype=np.int64)[None, :]
+    mask = jnp.asarray((row >= col) & (row - window_left <= col))
+    o_ref = fi.single_prefill_with_kv_cache(q, k, v, custom_mask=mask)
+    o = fi.single_prefill_with_kv_cache(
+        q, k, v, window_left=window_left, causal=True)
+    _close(o, o_ref)
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,qo_len,window_left,num_kv_heads,num_qo_heads,"
+    "head_dim,page_size,backend",
+    _sample(
+        "sw_batch_paged_prefill",
+        [12, 17, 30], [54, 397, 1177], [1, 37, 47], [13, 33, 111],
+        [1, 4, 8], [4, 8], [64, 128, 256, 512], [1, 16], ["fa2", "auto"],
+    ),
+)
+def test_batch_paged_prefill_sliding_window(
+        batch_size, kv_len, qo_len, window_left, num_kv_heads,
+        num_qo_heads, head_dim, page_size, backend):
+    """Reference test_batch_paged_prefill_sliding_window
+    (test_sliding_window.py:250)."""
+    if num_qo_heads < num_kv_heads:
+        pytest.skip("num_qo_heads < num_kv_heads is not supported")
+    _work_gate(batch_size, qo_len, kv_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(4)
+    q = _rand(key, (batch_size * qo_len, num_qo_heads, head_dim))
+    q_indptr = np.arange(batch_size + 1, dtype=np.int32) * qo_len
+    num_pages_per_seq = (kv_len + page_size - 1) // page_size
+    total_num_pages = num_pages_per_seq * batch_size
+    k_data = _rand(jax.random.fold_in(key, 1),
+                   (total_num_pages, page_size, num_kv_heads, head_dim))
+    v_data = _rand(jax.random.fold_in(key, 2),
+                   (total_num_pages, page_size, num_kv_heads, head_dim))
+    kv_indptr = np.arange(batch_size + 1, dtype=np.int32) * num_pages_per_seq
+    kv_indices = np.arange(total_num_pages, dtype=np.int32)
+    kv_last_page_len = np.full(
+        (batch_size,), (kv_len - 1) % page_size + 1, np.int32)
+    wrapper = fi.BatchPrefillWithPagedKVCacheWrapper(
+        jnp.empty(1024, jnp.int8), "NHD", backend=backend)
+    wrapper.plan(q_indptr, kv_indptr, kv_indices, kv_last_page_len,
+                 num_qo_heads, num_kv_heads, head_dim, page_size,
+                 window_left=window_left, causal=True)
+    o = wrapper.run(q, (k_data, v_data))
+
+    k_np = np.asarray(k_data)
+    v_np = np.asarray(v_data)
+    for i in range(batch_size):
+        qi = q[q_indptr[i]: q_indptr[i + 1]]
+        ki = np.concatenate([
+            k_np[kv_indptr[i]: kv_indptr[i + 1] - 1].reshape(
+                -1, num_kv_heads, head_dim),
+            k_np[kv_indptr[i + 1] - 1, : kv_last_page_len[i]],
+        ], 0)
+        vi = np.concatenate([
+            v_np[kv_indptr[i]: kv_indptr[i + 1] - 1].reshape(
+                -1, num_kv_heads, head_dim),
+            v_np[kv_indptr[i + 1] - 1, : kv_last_page_len[i]],
+        ], 0)
+        o_ref_i = fi.single_prefill_with_kv_cache(
+            qi, jnp.asarray(ki), jnp.asarray(vi), window_left=window_left,
+            causal=True, backend="fa2")
+        _close(o[q_indptr[i]: q_indptr[i + 1]], o_ref_i, msg=f"req {i}")
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,qo_len,window_left,num_kv_heads,num_qo_heads,"
+    "head_dim,backend",
+    _sample(
+        "sw_batch_ragged_prefill",
+        [12, 17], [54, 397], [37, 47], [13, 33], [1, 4], [4, 8],
+        [64, 128, 256, 512], ["fa2", "auto"],
+    ),
+)
+def test_batch_ragged_prefill_sliding_window(
+        batch_size, kv_len, qo_len, window_left, num_kv_heads,
+        num_qo_heads, head_dim, backend):
+    """Reference test_batch_ragged_prefill_sliding_window
+    (test_sliding_window.py:358)."""
+    _work_gate(batch_size, qo_len, kv_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(5)
+    q = _rand(key, (batch_size * qo_len, num_qo_heads, head_dim))
+    q_indptr = np.arange(batch_size + 1, dtype=np.int32) * qo_len
+    k = _rand(jax.random.fold_in(key, 1),
+              (batch_size * kv_len, num_kv_heads, head_dim))
+    v = _rand(jax.random.fold_in(key, 2),
+              (batch_size * kv_len, num_kv_heads, head_dim))
+    kv_indptr = np.arange(batch_size + 1, dtype=np.int32) * kv_len
+    wrapper = fi.BatchPrefillWithRaggedKVCacheWrapper(
+        jnp.empty(1024, jnp.int8), "NHD", backend=backend)
+    wrapper.plan(q_indptr, kv_indptr, num_qo_heads, num_kv_heads, head_dim,
+                 window_left=window_left, causal=True)
+    o = wrapper.run(q, k, v)
+
+    for i in range(batch_size):
+        o_ref_i = fi.single_prefill_with_kv_cache(
+            q[q_indptr[i]: q_indptr[i + 1]],
+            k[kv_indptr[i]: kv_indptr[i + 1]],
+            v[kv_indptr[i]: kv_indptr[i + 1]],
+            window_left=window_left, causal=True)
+        _close(o[q_indptr[i]: q_indptr[i + 1]], o_ref_i, msg=f"req {i}")
