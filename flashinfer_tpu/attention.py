@@ -85,6 +85,20 @@ class PODWithPagedKVCacheWrapper(BatchAttention):
     this class exists for API parity and routes to BatchAttention."""
 
 
+def sink_epilogue(out, lse, sink, return_lse: bool):
+    """Shared sink epilogue: renormalized output, and (optionally) the
+    combined lse including the sink term — the ONE copy of this algebra
+    (used by both the paged sink wrapper and the ragged custom-variant
+    path)."""
+    sink = jnp.asarray(sink)
+    out = apply_attention_sink(out, lse, sink)
+    if return_lse:
+        lse_new = jnp.logaddexp(lse, jnp.broadcast_to(
+            sink.astype(jnp.float32)[None, :], lse.shape))
+        return out, lse_new
+    return out
+
+
 @jax.jit
 def apply_attention_sink(
     out: jax.Array,  # [total_q, num_heads, head_dim]
@@ -101,26 +115,80 @@ def apply_attention_sink(
     return (out.astype(jnp.float32) * scale[..., None]).astype(out.dtype)
 
 
-class BatchAttentionWithAttentionSinkWrapper(BatchAttention):
-    """Holistic attention + sink epilogue (reference attention/_core.py:330)."""
+class BatchAttentionWithAttentionSinkWrapper(
+        BatchPrefillWithPagedKVCacheWrapper):
+    """Paged attention + sink epilogue (reference attention/_core.py:330).
 
-    def __init__(self, *args, sink: Optional[jax.Array] = None, **kw):
-        super().__init__(*args, **kw)
+    Matches the reference's contract exactly: the class derives from the
+    PAGED PREFILL wrapper (its plan's 4th positional is
+    ``paged_kv_last_page_len``, NOT token lengths), the ctor accepts the
+    reference kwargs (``q_data_type``/``kv_data_type``/``head_dim_qk``/
+    ``head_dim_vo``/``window_left`` — window_left from the ctor is the
+    plan default), and ``run`` accepts the custom-variant POSITIONAL
+    extras in declared order: ``run(q, paged_kv_cache, sink, sm_scale)``
+    (jit_args additional_tensor_names=["sink"],
+    additional_scalar_names=["sm_scale"]).  A per-run ``sm_scale``
+    rebinds the planned scale exactly (frozen-plan replace), mirroring
+    the reference kernel's per-call scalar."""
+
+    def __init__(self, float_workspace_buffer=None, kv_layout: str = "NHD",
+                 use_cuda_graph: bool = False, backend: str = "auto",
+                 q_data_type=None, kv_data_type=None,
+                 head_dim_qk: int = 128, head_dim_vo: int = 128,
+                 window_left: int = -1,
+                 sink: Optional[jax.Array] = None, **kw):
+        super().__init__(float_workspace_buffer, kv_layout, use_cuda_graph,
+                         backend, **kw)
         self._sink = sink
+        self._ctor_window_left = int(window_left)
 
     def set_sink(self, sink: jax.Array) -> None:
         self._sink = sink
 
-    def run(self, q, paged_kv_cache, *, sink: Optional[jax.Array] = None,
-            return_lse: bool = False, **kw):
+    def plan(self, *args, window_left: Optional[int] = None, **kw):
+        if window_left is None:
+            window_left = self._ctor_window_left
+        return super().plan(*args, window_left=window_left, **kw)
+
+    def run(self, q, paged_kv_cache, *extra,
+            sink: Optional[jax.Array] = None, sm_scale=None,
+            out=None, lse=None, return_lse: bool = False, **kw):
+        if extra:
+            if sink is None:
+                sink = extra[0]
+            if len(extra) > 1 and sm_scale is None:
+                sm_scale = extra[1]
+            if len(extra) > 2:
+                raise TypeError(
+                    f"run() takes at most (sink, sm_scale) positional "
+                    f"extras, got {len(extra)}")
+        if out is not None or lse is not None:
+            raise NotImplementedError(
+                "pre-allocated out=/lse= buffers are not supported (XLA "
+                "owns buffers; docs/migration.md) — drop the kwargs and "
+                "use the returned arrays")
         s = sink if sink is not None else self._sink
         if s is None:
             raise ValueError("attention sink logits not provided")
-        out, lse = super().run(q, paged_kv_cache, return_lse=True, **kw)
-        out = apply_attention_sink(out, lse, s)
-        if return_lse:
-            # combined lse includes the sink term
-            lse_new = jnp.logaddexp(lse, jnp.broadcast_to(
-                s.astype(jnp.float32)[None, :], lse.shape))
-            return out, lse_new
-        return out
+        restore_plan = None
+        if sm_scale is not None and self._plan is not None:
+            import dataclasses
+
+            if getattr(self._plan, "kv_gather_rows", None) is None \
+                    and self._fused_plan is not None:
+                # light plan: materialize the gather plan FIRST — the
+                # lazy rebuild inside super().run would recompute
+                # sm_scale from plan() args and discard the rebind
+                self._plan = self._gather_plan_builder()
+            if float(sm_scale) != self._plan.sm_scale:
+                # reference semantics: the scalar is PER-CALL — apply
+                # for this run only, restore the planned scale after
+                restore_plan = self._plan
+                self._plan = dataclasses.replace(
+                    self._plan, sm_scale=float(sm_scale))
+        try:
+            o, l = super().run(q, paged_kv_cache, return_lse=True, **kw)
+        finally:
+            if restore_plan is not None:
+                self._plan = restore_plan
+        return sink_epilogue(o, l, s, return_lse)

@@ -432,12 +432,22 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         kv_layout: str = "NHD",
         use_cuda_graph: bool = False,
         backend: str = "auto",
+        jit_args=None,
         **_unused,
     ):
         check_kv_layout(kv_layout)
         self._kv_layout = kv_layout
         self._backend = normalize_backend(backend)
         self._plan: Optional[_PrefillPlan] = None
+        # reference custom-variant declaration (prefill.py:2947 jit_args):
+        # positions 7/9 name the extra run() tensors/scalars in call
+        # order.  The TPU build has no jinja codegen, but the DECLARED
+        # extras define how positional run() extras are interpreted —
+        # "sink" (LSE epilogue) and "sm_scale" (plan rebind) are honored,
+        # anything else is rejected loudly.
+        self._extra_names: tuple = ()
+        if jit_args is not None and len(jit_args) >= 10:
+            self._extra_names = tuple(jit_args[7]) + tuple(jit_args[9])
 
     def plan(
         self,
@@ -512,12 +522,45 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         q: jax.Array,  # [total_q, num_qo_heads, head_dim]
         k: jax.Array,  # [total_kv, num_kv_heads, head_dim]
         v: jax.Array,
-        *,
+        *extra,
         return_lse: bool = False,
     ):
         plan = self._plan
         if plan is None:
             raise RuntimeError("plan() must be called before run()")
+        sink = None
+        if extra:
+            # custom-variant positional extras, in the ctor-declared order
+            # (e.g. the attention-sink module: run(q, k, v, sink,
+            # sm_scale)).  sm_scale is PER-CALL (reference kernels take
+            # it as a run scalar): it overrides the plan locally, never
+            # stickily.
+            if len(extra) > len(self._extra_names):
+                raise TypeError(
+                    f"run() got {len(extra)} positional extras but the "
+                    f"wrapper declares {self._extra_names or 'none'} "
+                    "(pass jit_args at construction)")
+            for name, val in zip(self._extra_names, extra):
+                if name == "sink":
+                    sink = jnp.asarray(val)
+                elif name == "sm_scale":
+                    if val is not None and float(val) != plan.sm_scale:
+                        import dataclasses
+
+                        plan = dataclasses.replace(
+                            plan, sm_scale=float(val))
+                else:
+                    raise NotImplementedError(
+                        f"custom-variant extra {name!r} has no TPU "
+                        "implementation (supported: sink, sm_scale)")
+        if sink is not None:
+            from flashinfer_tpu.attention import sink_epilogue
+
+            out, lse = self._run_planned(plan, q, k, v, return_lse=True)
+            return sink_epilogue(out, lse, sink, return_lse)
+        return self._run_planned(plan, q, k, v, return_lse=return_lse)
+
+    def _run_planned(self, plan, q, k, v, *, return_lse: bool):
         tq, tkv = plan.tq_pad, plan.tkv_pad
         if q.shape[0] != tq:
             q = jnp.pad(q, ((0, tq - q.shape[0]), (0, 0), (0, 0)))

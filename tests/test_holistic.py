@@ -79,7 +79,14 @@ def test_sink_wrapper():
      kc, vc, q) = _mixed_setup(3)
     sink = jnp.array([0.0, 1.0, -2.0, 0.5])
     w = fi.BatchAttentionWithAttentionSinkWrapper(kv_layout="NHD", sink=sink)
-    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
+    # reference signature: the sink wrapper derives from the PAGED PREFILL
+    # wrapper, so plan's 4th positional is last_page_len (attention/
+    # _core.py:330 ctor -> BatchPrefillWithPagedKVCacheWrapper.plan)
+    pages_per_req = np.asarray(kv_indptr[1:]) - np.asarray(kv_indptr[:-1])
+    last_page_len = (np.array(kv_lens)
+                     - (np.maximum(pages_per_req, 1) - 1) * PS).astype(
+                         np.int32)
+    w.plan(qo_indptr, kv_indptr, indices, last_page_len, HQ, HKV, D, PS,
            causal=True)
     out = w.run(q, (kc, vc))
     base = fi.BatchAttention(kv_layout="NHD")

@@ -1,0 +1,186 @@
+"""Migration proof #15: mechanical port of the reference test file
+``/root/reference/tests/attention/test_attention_sink.py`` (the main
+``test_attention_sink`` matrix) run against ``flashinfer_tpu``.
+
+Same porting contract as tests/test_ported_batch_prefill.py: reference
+matrix verbatim, reference call sequences — BOTH halves:
+
+1. ``BatchPrefillWithRaggedKVCacheWrapper(ws, kv_layout, backend=,
+   jit_args=, jit_kwargs=)`` with the attention-sink custom-variant
+   declaration, then ``run(q, k, v, sink, sm_scale)`` POSITIONAL (the
+   declared additional tensor/scalar order);
+2. ``BatchAttentionWithAttentionSinkWrapper`` (paged, page_size=1) with
+   the standard paged-prefill plan and ``run(q, (k, v), sink,
+   sm_scale)``, including the reference's fragmented-page-pool
+   scenario.
+
+Oracle = the reference's ``sink_attention_unified`` prefill mode
+(tests/test_helpers/sink_attention_reference.py: sink logit joins the
+softmax denominator per head) transcribed to numpy f64.  The jit_args
+URI/dtype fields are inert on TPU (no jinja codegen) but the DECLARED
+additional names define the positional run() extras — that contract is
+what this file proves.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample, _work_gate
+
+_HEAD_DIM = 128
+
+
+def _sink_attention_ref(batch_size, q, k, v, sink, window_left, causal,
+                        sm_scale):
+    """Reference sink_attention_unified, mode="prefill"
+    (sink_attention_reference.py:39-377) in f64: per-head sink logit
+    joins the softmax denominator; causal mask is bottom-right aligned;
+    window applies with or without causal."""
+    q = np.asarray(q, np.float64)
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    sink = np.asarray(sink, np.float64)
+    qo_len = q.shape[0] // batch_size
+    kv_len = k.shape[0] // batch_size
+    hq, d = q.shape[1], q.shape[2]
+    hkv = k.shape[1]
+    if hq != hkv:
+        k = np.repeat(k, hq // hkv, axis=1)
+        v = np.repeat(v, hq // hkv, axis=1)
+    logits = np.einsum(
+        "bmhd,bnhd->bhmn",
+        q.reshape(batch_size, qo_len, hq, d),
+        k.reshape(batch_size, kv_len, hq, d)) * sm_scale
+    row = np.arange(qo_len)[:, None]
+    col = np.arange(kv_len)[None, :]
+    if causal:
+        mask = (kv_len - qo_len + row) >= col
+        if window_left >= 0:
+            mask &= (row - window_left) <= col
+    else:
+        mask = np.ones((qo_len, kv_len), bool)
+        if window_left >= 0:
+            mask = (row - window_left) <= col
+    logits = np.where(mask[None, None], logits, -np.inf)
+    # sink softmax: per-head sink logit appended to the denominator
+    m = np.maximum(logits.max(-1), sink[None, :, None])  # [b, h, m]
+    num = np.exp(logits - m[..., None])
+    denom = num.sum(-1) + np.exp(sink[None, :, None] - m)
+    p = num / denom[..., None]
+    o = np.einsum(
+        "bhmn,bnhd->bmhd", p, v.reshape(batch_size, kv_len, hq, -1))
+    return o.reshape(batch_size * qo_len, hq, -1)
+
+
+_SINK_JIT_ARGS = (
+    "batch_prefill_attention_sink_tpu",  # uri (inert)
+    None, None, None, None,              # dtypes/idtype (inert)
+    _HEAD_DIM, _HEAD_DIM,                # hidden dims (inert)
+    ["sink"], ["float"],                 # additional tensors
+    ["sm_scale"], ["double"],            # additional scalars
+    "AttentionSink", "",                 # variant name / decl (inert)
+)
+
+
+@pytest.mark.parametrize(
+    "dtype,batch_size,seq_len,num_qo_heads,num_kv_heads,window_left,"
+    "causal,backend",
+    _sample(
+        "attention_sink",
+        [jnp.float16, jnp.bfloat16], [1, 4, 16], [1, 4, 16, 128], [32],
+        [8, 32], [-1, 128], [True, False], ["fa2", "fa3"],
+        specials=((5, 128), (6, False)),  # keep windowed + non-causal cells
+    ),
+)
+def test_attention_sink(dtype, batch_size, seq_len, num_qo_heads,
+                        num_kv_heads, window_left, causal, backend):
+    """Reference test_attention_sink (test_attention_sink.py:158)."""
+    _work_gate(batch_size, seq_len, seq_len, num_qo_heads, _HEAD_DIM)
+    sm_scale = 1.0 / math.sqrt(_HEAD_DIM)
+    key = jax.random.PRNGKey(42)
+    q = jax.random.normal(
+        key, (batch_size * seq_len, num_qo_heads, _HEAD_DIM), dtype)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1),
+        (batch_size * seq_len, num_kv_heads, _HEAD_DIM), dtype)
+    v = jax.random.normal(
+        jax.random.fold_in(key, 2),
+        (batch_size * seq_len, num_kv_heads, _HEAD_DIM), dtype)
+    sink = jax.random.uniform(
+        jax.random.fold_in(key, 3), (num_qo_heads,), jnp.float32) * 5
+
+    o_ref = _sink_attention_ref(
+        batch_size, q, k, v, sink, window_left, causal, sm_scale)
+    tol = dict(rtol=1e-3, atol=1e-3) if dtype == jnp.float16 \
+        else dict(rtol=1e-2, atol=1e-2)
+
+    # ---- ragged wrapper with the custom-variant jit_args declaration ----
+    wrapper = fi.BatchPrefillWithRaggedKVCacheWrapper(
+        jnp.empty(1024, jnp.uint8), kv_layout="NHD", backend=backend,
+        jit_args=_SINK_JIT_ARGS,
+        jit_kwargs={"use_sliding_window": window_left >= 0})
+    indptr = np.arange(
+        0, batch_size * seq_len + 1, seq_len, dtype=np.int32)
+    wrapper.plan(indptr, indptr, num_qo_heads, num_kv_heads, _HEAD_DIM,
+                 causal=causal, window_left=window_left,
+                 q_data_type=dtype, kv_data_type=dtype)
+    o = wrapper.run(q, k, v, sink, sm_scale)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), o_ref.astype(np.float32), **tol)
+
+    # ---- paged sink wrapper, page_size=1 (reference second half) ----
+    wrapper_paged = fi.BatchAttentionWithAttentionSinkWrapper(
+        jnp.empty(1024, jnp.uint8), kv_layout="NHD", backend=backend,
+        q_data_type=dtype, kv_data_type=dtype,
+        head_dim_qk=_HEAD_DIM, head_dim_vo=_HEAD_DIM,
+        window_left=window_left)
+    kv_indices = np.arange(0, batch_size * seq_len, dtype=np.int32)
+    last_page_len = np.full((batch_size,), 1, np.int32)
+    wrapper_paged.plan(
+        indptr, indptr, kv_indices, last_page_len, num_qo_heads,
+        num_kv_heads, _HEAD_DIM, 1, causal=causal,
+        window_left=window_left, q_data_type=dtype, kv_data_type=dtype,
+        non_blocking=True)
+    o_paged = wrapper_paged.run(
+        q, (k[:, None], v[:, None]), sink, sm_scale)
+    np.testing.assert_allclose(
+        np.asarray(o_paged, np.float32), o_ref.astype(np.float32), **tol)
+
+    # ---- fragmented page pool (reference "production scenario") ----
+    total_pages = batch_size * seq_len
+    if total_pages > 1:
+        import random
+
+        random.seed(42 + total_pages)
+        all_pages = list(range(0, total_pages * 2))
+        occupied = set(random.sample(
+            all_pages, min(total_pages, len(all_pages) // 2)))
+        available = [p for p in all_pages if p not in occupied]
+        kv_indices_frag = np.asarray(available[:total_pages], np.int32)
+        k_frag = np.zeros(
+            (total_pages * 2, 1, num_kv_heads, _HEAD_DIM), np.float32)
+        v_frag = np.zeros_like(k_frag)
+        k_np, v_np = np.asarray(k, np.float32), np.asarray(v, np.float32)
+        for i, page_idx in enumerate(kv_indices_frag):
+            k_frag[page_idx, 0] = k_np[i]
+            v_frag[page_idx, 0] = v_np[i]
+        wrapper_frag = fi.BatchAttentionWithAttentionSinkWrapper(
+            jnp.empty(1024, jnp.uint8), kv_layout="NHD", backend=backend,
+            q_data_type=dtype, kv_data_type=dtype,
+            head_dim_qk=_HEAD_DIM, head_dim_vo=_HEAD_DIM,
+            window_left=window_left)
+        wrapper_frag.plan(
+            indptr, indptr, kv_indices_frag, last_page_len, num_qo_heads,
+            num_kv_heads, _HEAD_DIM, 1, causal=causal,
+            window_left=window_left, q_data_type=dtype, kv_data_type=dtype,
+            non_blocking=True)
+        o_frag = wrapper_frag.run(
+            q, (jnp.asarray(k_frag, dtype), jnp.asarray(v_frag, dtype)),
+            sink, sm_scale)
+        np.testing.assert_allclose(
+            np.asarray(o_frag, np.float32), o_ref.astype(np.float32), **tol)
