@@ -48,9 +48,10 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
         kv_len_arr,
         num_qo_heads: int,
         num_kv_heads: int,
-        head_dim: int,
+        head_dim_qk: int,
+        head_dim_vo: int,
         page_size: int,
-        causal: bool = True,
+        causal: bool = False,
         sm_scale: Optional[float] = None,
         logits_soft_cap: Optional[float] = None,
         window_left: int = -1,
@@ -59,30 +60,77 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
         use_profiler: bool = False,
         **_unused,
     ) -> None:
+        """Reference arity (attention/_core.py:95): both head dims are
+        positional (DeepSeek-style qk 192 / vo 128 splits exist there);
+        this build's paged path is square — asymmetric dims raise with
+        the MLA alternative."""
         import numpy as np
 
+        if head_dim_qk != head_dim_vo:
+            raise NotImplementedError(
+                f"asymmetric head dims (qk {head_dim_qk} != vo "
+                f"{head_dim_vo}) — use flashinfer_tpu.mla for the "
+                "compressed-KV DeepSeek form")
         kv_len_arr = np.asarray(kv_len_arr)
         kv_indptr = np.asarray(kv_indptr)
         pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
         # reconstruct last_page_len from token lengths
         last = kv_len_arr - (np.maximum(pages_per_req, 1) - 1) * page_size
+        self._plan_soft_cap = float(logits_soft_cap or 0.0)
         super().plan(
             qo_indptr, kv_indptr, kv_indices, last.astype(np.int32),
-            num_qo_heads, num_kv_heads, head_dim, page_size,
+            num_qo_heads, num_kv_heads, head_dim_qk, page_size,
             causal=causal, sm_scale=sm_scale,
             logits_soft_cap=logits_soft_cap, window_left=window_left,
             q_data_type=q_data_type, kv_data_type=kv_data_type,
         )
 
-    def run(self, q, paged_kv_cache, *, out=None, lse=None, return_lse=False,
-            **kw):
-        return super().run(q, paged_kv_cache, return_lse=return_lse, **kw)
+    def run(self, q, paged_kv_cache, out=None, lse=None, k_scale=None,
+            v_scale=None, logits_soft_cap: float = 0.0,
+            profiler_buffer=None, kv_cache_sf=None, **kw):
+        """Reference contract (attention/_core.py:216): ALWAYS returns
+        ``(out, lse)``; ``k_scale`` folds into sm_scale for this call,
+        ``v_scale`` scales the output.  ``logits_soft_cap``: a non-zero
+        value must match the planned one; the 0.0 default INHERITS the
+        planned cap (it is baked into the kernel at plan time — pass a
+        matching non-zero value to be explicit).  ``profiler_buffer`` is
+        inert (op timelines come from flashinfer_tpu.profiler);
+        ``out``/``lse``/``kv_cache_sf`` prealloc/fp8-sf are rejected
+        loudly; the scale/epilogue mechanics live in the base paged
+        wrapper's run (one copy)."""
+        if kv_cache_sf is not None:
+            raise NotImplementedError(
+                "kv_cache_sf fp8 scale factors: quantize the cache via "
+                "flashinfer_tpu.page append helpers instead")
+        if "return_lse" in kw:
+            if not kw.pop("return_lse"):
+                raise ValueError(
+                    "BatchAttention.run always returns (out, lse) "
+                    "(reference attention/_core.py:216); return_lse="
+                    "False is not available — drop the kwarg")
+        soft_cap = float(logits_soft_cap or 0.0)
+        planned = getattr(self, "_plan_soft_cap", 0.0)
+        if soft_cap != 0.0 and soft_cap != planned:
+            raise ValueError(
+                f"logits_soft_cap={soft_cap} inconsistent with the "
+                f"planned value {planned} (reference requires both, "
+                "attention/_core.py:250)")
+        return super().run(
+            q, paged_kv_cache, out=out, lse=lse, k_scale=k_scale,
+            v_scale=v_scale, return_lse=True, **kw)
 
 
 class PODWithPagedKVCacheWrapper(BatchAttention):
     """Prefill-On-Decode (reference flashinfer/pod.py:61).  On TPU the
     holistic segment kernel already co-schedules prefill and decode work;
-    this class exists for API parity and routes to BatchAttention."""
+    this class exists for API parity and routes to BatchAttention (the
+    reference POD run signature with separate prefill/decode operand
+    sets is a CUDA-stream concept — documented alias, single-output
+    run)."""
+
+    def run(self, q, paged_kv_cache, *, return_lse: bool = False, **kw):
+        out, lse = super().run(q, paged_kv_cache, **kw)
+        return (out, lse) if return_lse else out
 
 
 def sink_epilogue(out, lse, sink, return_lse: bool):
@@ -170,22 +218,9 @@ class BatchAttentionWithAttentionSinkWrapper(
         s = sink if sink is not None else self._sink
         if s is None:
             raise ValueError("attention sink logits not provided")
-        restore_plan = None
-        if sm_scale is not None and self._plan is not None:
-            import dataclasses
-
-            if getattr(self._plan, "kv_gather_rows", None) is None \
-                    and self._fused_plan is not None:
-                # light plan: materialize the gather plan FIRST — the
-                # lazy rebuild inside super().run would recompute
-                # sm_scale from plan() args and discard the rebind
-                self._plan = self._gather_plan_builder()
-            if float(sm_scale) != self._plan.sm_scale:
-                # reference semantics: the scalar is PER-CALL — apply
-                # for this run only, restore the planned scale after
-                restore_plan = self._plan
-                self._plan = dataclasses.replace(
-                    self._plan, sm_scale=float(sm_scale))
+        # per-call sm_scale (reference run scalar): the shared rebind
+        # helper + the lazy-rebuild carry-over keep it alive on any path
+        restore_plan = self._rebind_sm_scale(absolute=sm_scale)
         try:
             o, l = super().run(q, paged_kv_cache, return_lse=True, **kw)
         finally:

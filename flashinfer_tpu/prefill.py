@@ -804,16 +804,66 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._fused_plan = None
             self._plan = build_gather_plan()
 
+    def _rebind_sm_scale(self, *, absolute=None, multiplier=None):
+        """Per-call sm_scale override: swap in a plan with the new scale
+        and return the plan to restore in the caller's ``finally`` (or
+        None if nothing changed).  A later lazy gather-plan rebuild
+        preserves the live rebind (see run()'s materialization site), so
+        no eager plan build is needed here."""
+        if self._plan is None or (absolute is None and multiplier is None):
+            return None
+        new = (float(absolute) if absolute is not None
+               else self._plan.sm_scale * float(multiplier))
+        if new == self._plan.sm_scale:
+            return None
+        import dataclasses
+
+        restore = self._plan
+        self._plan = dataclasses.replace(restore, sm_scale=new)
+        return restore
+
     def run(
         self,
         q: jax.Array,  # [total_q, num_qo_heads, head_dim]
         paged_kv_cache: Union[Tuple[jax.Array, jax.Array], jax.Array],
         *,
+        k_scale=None,
+        v_scale=None,
+        sinks=None,
+        out=None,
+        lse=None,
         return_lse: bool = False,
     ):
         plan = self._plan
         if plan is None:
             raise RuntimeError("plan() must be called before run()")
+        if out is not None or lse is not None:
+            raise NotImplementedError(
+                "pre-allocated out=/lse= buffers are not supported (XLA "
+                "owns buffers; docs/migration.md)")
+        if k_scale is not None or v_scale is not None or sinks is not None:
+            # reference per-run kwargs (prefill.py:2520): k_scale folds
+            # into sm_scale FOR THIS CALL, v_scale scales the output,
+            # sinks renormalize via the LSE epilogue.  The inner call is
+            # NON-VIRTUAL: a subclass run (e.g. the sink wrapper's) must
+            # not re-apply its own epilogue on this internal re-entry.
+            restore_plan = self._rebind_sm_scale(multiplier=k_scale)
+            try:
+                need_lse = return_lse or sinks is not None
+                res = BatchPrefillWithPagedKVCacheWrapper.run(
+                    self, q, paged_kv_cache, return_lse=need_lse)
+            finally:
+                if restore_plan is not None:
+                    self._plan = restore_plan
+            o, l = res if need_lse else (res, None)
+            if sinks is not None:
+                from flashinfer_tpu.attention import sink_epilogue
+
+                res2 = sink_epilogue(o, l, sinks, return_lse)
+                o, l = res2 if return_lse else (res2, None)
+            if v_scale is not None:
+                o = (o.astype(jnp.float32) * float(v_scale)).astype(o.dtype)
+            return (o, l) if return_lse else o
         if isinstance(paged_kv_cache, tuple):
             k_cache, v_cache = paged_kv_cache
         else:
@@ -911,8 +961,16 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 pass  # fall through to the gather + flash path below
         if plan.kv_gather_rows is None:
             # fused plan was active but this call needs the gather path
-            # (return_lse): materialize the deferred plan once
-            plan = self._plan = self._gather_plan_builder()
+            # (return_lse): materialize the deferred plan once.  Preserve
+            # a live sm_scale rebind (per-run k_scale/sm_scale override)
+            # — the builder recomputes the PLANNED scale.
+            new_plan = self._gather_plan_builder()
+            if new_plan.sm_scale != plan.sm_scale:
+                import dataclasses
+
+                new_plan = dataclasses.replace(
+                    new_plan, sm_scale=plan.sm_scale)
+            plan = self._plan = new_plan
         if check_kv_layout(self._kv_layout) == TensorLayout.HND:
             k_cache = jnp.swapaxes(k_cache, 1, 2)
             v_cache = jnp.swapaxes(v_cache, 1, 2)

@@ -50,9 +50,12 @@ def test_holistic_mixed_batch(cls):
     (HQ, HKV, D, PS, qo_lens, kv_lens, qo_indptr, kv_indptr, indices,
      kc, vc, q) = _mixed_setup()
     w = cls(kv_layout="NHD")
-    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
-           causal=True)
-    out = w.run(q, (kc, vc))
+    w.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, D,
+           PS, causal=True)
+    res = w.run(q, (kc, vc))
+    # reference contracts differ: BatchAttention.run ALWAYS returns
+    # (out, lse) (_core.py:216); the POD alias returns the output
+    out = res[0] if isinstance(res, tuple) else res
     ref = _ref_per_request(q, kc, vc, qo_indptr, kv_indptr, indices, kv_lens, PS)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
@@ -90,9 +93,9 @@ def test_sink_wrapper():
            causal=True)
     out = w.run(q, (kc, vc))
     base = fi.BatchAttention(kv_layout="NHD")
-    base.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D, PS,
-              causal=True)
-    o, lse = base.run(q, (kc, vc), return_lse=True)
+    base.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D,
+              D, PS, causal=True)
+    o, lse = base.run(q, (kc, vc))
     ref = fi.apply_attention_sink(o, lse, sink)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
@@ -186,3 +189,36 @@ def test_native_mask_plan_matches_numpy_fallback():
     finally:
         native._LIB = lib_save
     np.testing.assert_array_equal(m_native, m_numpy)
+
+
+def test_sink_wrapper_scale_kwargs_no_double_epilogue():
+    """v_scale=1.0 must be an identity on the sink wrapper (regression:
+    the base run's scale branch recursed VIRTUALLY and applied the sink
+    epilogue twice), and per-run k_scale must not stick."""
+    (HQ, HKV, D, PS, qo_lens, kv_lens, qo_indptr, kv_indptr, indices,
+     kc, vc, q) = _mixed_setup(5)
+    sink = jnp.array([0.3, -0.5, 1.0, 0.0])
+    pages_per_req = np.asarray(kv_indptr[1:]) - np.asarray(kv_indptr[:-1])
+    last_page_len = (np.array(kv_lens)
+                     - (np.maximum(pages_per_req, 1) - 1) * PS).astype(
+                         np.int32)
+    w = fi.BatchAttentionWithAttentionSinkWrapper(kv_layout="NHD", sink=sink)
+    w.plan(qo_indptr, kv_indptr, indices, last_page_len, HQ, HKV, D, PS,
+           causal=True)
+    plain = w.run(q, (kc, vc))
+    with_vs1 = w.run(q, (kc, vc), v_scale=1.0)
+    np.testing.assert_allclose(
+        np.asarray(with_vs1), np.asarray(plain), rtol=0, atol=0)
+    # k_scale applies per call and does not stick
+    scaled = w.run(q, (kc, vc), k_scale=0.5)
+    assert float(np.abs(np.asarray(scaled) - np.asarray(plain)).max()) > 1e-4
+    again = w.run(q, (kc, vc))
+    np.testing.assert_allclose(
+        np.asarray(again), np.asarray(plain), rtol=0, atol=0)
+    # BatchAttention per-run sinks kwarg reaches the base epilogue once
+    base = fi.BatchAttention(kv_layout="NHD")
+    base.plan(qo_indptr, kv_indptr, indices, np.array(kv_lens), HQ, HKV, D,
+              D, PS, causal=True)
+    o_s, _ = base.run(q, (kc, vc), sinks=sink)
+    np.testing.assert_allclose(
+        np.asarray(o_s), np.asarray(plain), rtol=1e-5, atol=1e-6)
