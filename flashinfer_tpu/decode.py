@@ -367,5 +367,14 @@ class BatchDecodeWithPagedKVCacheWrapper:
 
     forward = run  # legacy alias kept by the reference
 
+    def run_return_lse(self, q, paged_kv_cache, **kw):
+        """Reference ``run_return_lse`` (decode.py:2266,
+        functools.partialmethod(run, return_lse=True)): run with the
+        natural-log LSE returned alongside the output."""
+        kw.pop("return_lse", None)
+        return self.run(q, paged_kv_cache, return_lse=True, **kw)
+
+    forward_return_lse = run_return_lse  # reference legacy alias
+
     def end_forward(self) -> None:  # reference legacy no-op
         pass

@@ -600,6 +600,14 @@ class BatchPrefillWithRaggedKVCacheWrapper:
 
     forward = run
 
+    def run_return_lse(self, q, k, v, *extra, **kw):
+        """Reference ``run_return_lse`` (prefill.py:2900, partialmethod
+        with return_lse=True)."""
+        kw.pop("return_lse", None)
+        return self.run(q, k, v, *extra, return_lse=True, **kw)
+
+    forward_return_lse = run_return_lse
+
     def end_forward(self) -> None:
         pass
 
@@ -1016,6 +1024,14 @@ class BatchPrefillWithPagedKVCacheWrapper:
         return out[: plan.total_q]
 
     forward = run
+
+    def run_return_lse(self, q, paged_kv_cache, **kw):
+        """Reference ``run_return_lse`` (prefill.py:4075, partialmethod
+        with return_lse=True)."""
+        kw.pop("return_lse", None)
+        return self.run(q, paged_kv_cache, return_lse=True, **kw)
+
+    forward_return_lse = run_return_lse
 
     def end_forward(self) -> None:
         pass

@@ -1,0 +1,116 @@
+"""Migration proof #17: mechanical port of the reference test file
+``/root/reference/tests/attention/test_decode_prefill_lse.py``.
+
+The MLC regression case: a batch containing a ZERO-LENGTH request
+(kv_indptr [0, 0, 9], last_page_len [0, 1]) must produce identical
+(out, lse) from the CUDA-core and tensor-core decode paths via
+``run_return_lse``.  On TPU both paths are one kernel
+(use_tensor_cores is accepted and inert, decode.py docstring), so the
+pair check degenerates to determinism — the port therefore ADDS an
+independent f64 oracle for the non-empty request, and pins the
+zero-length request's contract: zero output, lse = the library's
+finite -1e30 "log(0)" sentinel (natural log; docs/migration.md §LSE —
+the reference's CUDA kernels return base-2 -inf/0 conventions there,
+equally "empty").
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import flashinfer_tpu as fi
+
+
+def test_mlc_failed_case():
+    kv_layout = "HND"
+    kv_indptr = np.array([0, 0, 9], np.int32)
+    kv_indices = np.array([3, 4, 5, 6, 7, 8, 9, 10, 11], np.int32)
+    kv_last_page_len = np.array([0, 1], np.int32)
+    num_qo_heads = num_kv_heads = 32
+    page_size, head_dim = 16, 128
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (2, num_qo_heads, head_dim), jnp.float16)
+    kv_data = jax.random.normal(
+        jax.random.fold_in(key, 1),
+        (12, 2, num_kv_heads, page_size, head_dim), jnp.float16)
+
+    wrapper = fi.BatchDecodeWithPagedKVCacheWrapper(
+        jnp.empty(1024, jnp.int8), kv_layout)
+    wrapper.plan(
+        kv_indptr, kv_indices, kv_last_page_len, num_qo_heads,
+        num_kv_heads, head_dim, page_size, pos_encoding_mode="NONE",
+        data_type=jnp.float16, q_data_type=jnp.float16)
+    o_1, lse_1 = wrapper.run_return_lse(q, kv_data)
+
+    wrapper_tc = fi.BatchDecodeWithPagedKVCacheWrapper(
+        jnp.empty(1024, jnp.int8), kv_layout, use_tensor_cores=True)
+    wrapper_tc.plan(
+        kv_indptr, kv_indices, kv_last_page_len, num_qo_heads,
+        num_kv_heads, head_dim, page_size, pos_encoding_mode="NONE",
+        data_type=jnp.float16, q_data_type=jnp.float16)
+    o_tc, lse_tc = wrapper_tc.run_return_lse(q, kv_data)
+
+    np.testing.assert_allclose(
+        np.asarray(lse_1, np.float32), np.asarray(lse_tc, np.float32),
+        rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(o_1, np.float32), np.asarray(o_tc, np.float32),
+        rtol=1e-3, atol=1e-3)
+
+    # beyond the reference pair: request 0 is EMPTY (kv_len == 0) —
+    # zero output and the library's finite -1e30 "log(0)" sentinel
+    # (kernels carry it instead of -inf so downstream exp() stays
+    # NaN-free; exp(-1e30) == 0 exactly)
+    assert float(np.abs(np.asarray(o_1[0], np.float32)).max()) == 0.0
+    assert bool(np.all(np.asarray(lse_1[0]) <= -1e30))
+
+    # request 1: 8 full pages + last_page_len 1 = 129 tokens, f64 oracle
+    kv_len = 8 * page_size + 1
+    kvd = np.asarray(kv_data, np.float64)
+    pages = kv_indices
+    k_rows = kvd[pages, 0].transpose(0, 2, 1, 3).reshape(
+        -1, num_kv_heads, head_dim)[:kv_len]
+    v_rows = kvd[pages, 1].transpose(0, 2, 1, 3).reshape(
+        -1, num_kv_heads, head_dim)[:kv_len]
+    qf = np.asarray(q, np.float64)[1]
+    s = np.einsum("hd,khd->hk", qf, k_rows) / np.sqrt(head_dim)
+    m = s.max(-1, keepdims=True)
+    e = np.exp(s - m)
+    o_ref = np.einsum("hk,khd->hd", e / e.sum(-1, keepdims=True), v_rows)
+    lse_ref = (np.log(e.sum(-1)) + m[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(o_1[1], np.float32), o_ref, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(lse_1[1], np.float32), lse_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_prefill_wrappers_run_return_lse_alias():
+    """Reference defines run_return_lse on BOTH prefill wrappers too
+    (prefill.py:2900 ragged, :4075 paged) — alias parity + equality with
+    run(return_lse=True)."""
+    B, S, HQ, HKV, D, PS = 2, 32, 4, 2, 64, 16
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (B * S, HQ, D), jnp.float16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B * S, HKV, D),
+                          jnp.float16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B * S, HKV, D),
+                          jnp.float16)
+    indptr = np.arange(0, B * S + 1, S, dtype=np.int32)
+    wr = fi.BatchPrefillWithRaggedKVCacheWrapper(None, "NHD")
+    wr.plan(indptr, indptr, HQ, HKV, D, causal=True)
+    o1, l1 = wr.run_return_lse(q, k, v)
+    o2, l2 = wr.run(q, k, v, return_lse=True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    npages = B * S // PS
+    kc = k.reshape(npages, PS, HKV, D)
+    vc = v.reshape(npages, PS, HKV, D)
+    ki = np.arange(0, npages + 1, npages // B, dtype=np.int32)
+    wp = fi.BatchPrefillWithPagedKVCacheWrapper(None, "NHD")
+    wp.plan(indptr, ki, np.arange(npages, dtype=np.int32),
+            np.full(B, PS, np.int32), HQ, HKV, D, PS, causal=True)
+    o3, l3 = wp.run_return_lse(q, (kc, vc))
+    o4, l4 = wp.run(q, (kc, vc), return_lse=True)
+    np.testing.assert_array_equal(np.asarray(o3), np.asarray(o4))
+    np.testing.assert_array_equal(np.asarray(l3), np.asarray(l4))
