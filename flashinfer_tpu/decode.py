@@ -62,9 +62,16 @@ def single_decode_with_kv_cache(
     logits_soft_cap: Optional[float] = None,
     return_lse: bool = False,
     backend: str = "auto",
+    k_scale: Optional[float] = None,
+    v_scale: Optional[float] = None,
 ):
     """Single-request decode attention (reference
     ``single_decode_with_kv_cache``, flashinfer/decode.py:514).
+
+    ``k_scale``/``v_scale`` are the fp8 calibration scales (reference
+    decode.py:640): k_scale folds into sm_scale, v_scale multiplies the
+    output; sub-16-bit (fp8) k/v upcast losslessly before attention —
+    the dequantized-value math of the reference's fp8 kernels.
 
     ``pos_encoding_mode="ROPE_LLAMA"`` applies RoPE to q at position
     ``kv_len-1`` and to k at positions ``0..kv_len-1`` before attention
@@ -79,6 +86,11 @@ def single_decode_with_kv_cache(
     kv_len = k.shape[0]
     head_dim = q.shape[-1]
     sm_scale = get_sm_scale(head_dim, sm_scale)
+    if k.dtype.itemsize < 2:  # fp8 cache: lossless upcast, scales fold
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    if k_scale is not None:
+        sm_scale *= float(k_scale)
     if pos_encoding_mode == "ROPE_LLAMA":
         from flashinfer_tpu.rope import rotate_at_positions
 
@@ -106,9 +118,10 @@ def single_decode_with_kv_cache(
         logits_soft_cap=logits_soft_cap or 0.0, window_left=window_left,
         return_lse=return_lse, **kw,
     )
-    if return_lse:
-        return out[0][0], out[1][0]
-    return out[0]
+    o, l = (out[0][0], out[1][0]) if return_lse else (out[0], None)
+    if v_scale is not None:
+        o = (o.astype(jnp.float32) * float(v_scale)).astype(o.dtype)
+    return (o, l) if return_lse else o
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,122 @@
+"""Migration proof #18: mechanical port of the reference test file
+``/root/reference/tests/attention/test_decode_fp8_calibration_scale.py``.
+
+Same porting contract as the other ports: reference matrices verbatim
+(incl. the commented-down dimensions the reference itself trimmed),
+reference call sequences — fp16 baseline run, then the SAME data
+amax-calibrated to fp8 with ``k_scale``/``v_scale`` passed at run time
+— torch.float16 -> jnp.float16, torch.float8_* -> jnp.float8_*.  The
+reference compares fp8 vs fp16 at loose tolerances (quantization
+noise); kept verbatim.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample, _work_gate
+
+
+@pytest.mark.parametrize(
+    "kv_len,num_kv_heads,num_qo_heads,head_dim,kv_layout,"
+    "pos_encoding_mode,fp8_dtype",
+    _sample(
+        "fp8_single_decode",
+        [7, 19, 39, 1170, 39275], [4], [4, 32], [128], ["NHD"], ["NONE"],
+        [jnp.float8_e4m3fn],
+        specials=((0, 39275),),  # keep the long-context cell
+    ),
+)
+def test_single_decode_fp8_calibration_scale(
+        kv_len, num_kv_heads, num_qo_heads, head_dim, kv_layout,
+        pos_encoding_mode, fp8_dtype):
+    """Reference test_single_decode_fp8_calibration_scale
+    (test_decode_fp8_calibration_scale.py:30)."""
+    _work_gate(1, 1, kv_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(42)
+    q = jax.random.normal(key, (num_qo_heads, head_dim), jnp.float16)
+    k = jax.random.normal(
+        jax.random.fold_in(key, 1), (kv_len, num_kv_heads, head_dim),
+        jnp.float16)
+    v = 0.1 * jax.random.normal(
+        jax.random.fold_in(key, 2), (kv_len, num_kv_heads, head_dim),
+        jnp.float16)
+
+    o_fp16 = fi.single_decode_with_kv_cache(
+        q, k, v, kv_layout=kv_layout, pos_encoding_mode=pos_encoding_mode)
+
+    k_scale = float(jnp.max(jnp.abs(k.astype(jnp.float32)))) / 256
+    v_scale = float(jnp.max(jnp.abs(v.astype(jnp.float32)))) / 256
+    k_fp8 = (k.astype(jnp.float32) / k_scale).astype(fp8_dtype)
+    v_fp8 = (v.astype(jnp.float32) / v_scale).astype(fp8_dtype)
+
+    o_fp8 = fi.single_decode_with_kv_cache(
+        q, k_fp8, v_fp8, kv_layout=kv_layout,
+        pos_encoding_mode=pos_encoding_mode,
+        k_scale=k_scale, v_scale=v_scale)
+    np.testing.assert_allclose(
+        np.asarray(o_fp16, np.float32), np.asarray(o_fp8, np.float32),
+        atol=1e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize(
+    "batch_size,kv_len,page_size,num_kv_heads,num_qo_heads,head_dim,"
+    "kv_layout,pos_encoding_mode,dtype",
+    _sample(
+        "fp8_batch_decode",
+        [12, 17], [54, 97], [1, 8, 16], [4], [4, 32], [128, 256],
+        ["HND", "NHD"], ["NONE", "ROPE_LLAMA"],
+        [jnp.float8_e4m3fn, jnp.float8_e5m2],
+        specials=((7, "ROPE_LLAMA"), (8, jnp.float8_e5m2)),
+    ),
+)
+def test_batch_decode_with_paged_kv_cache_fp8_calibration_scale(
+        batch_size, kv_len, page_size, num_kv_heads, num_qo_heads,
+        head_dim, kv_layout, pos_encoding_mode, dtype):
+    """Reference test_batch_decode_with_paged_kv_cache_fp8_calibration_
+    scale (test_decode_fp8_calibration_scale.py:85): re-plan with the
+    fp8 data_type, run with calibration scales."""
+    _work_gate(batch_size, 1, kv_len, num_qo_heads, head_dim)
+    key = jax.random.PRNGKey(42)
+    q = jax.random.normal(key, (batch_size, num_qo_heads, head_dim),
+                          jnp.float16)
+    num_pages_per_seq = (kv_len + page_size - 1) // page_size
+    total_num_pages = num_pages_per_seq * batch_size
+    kv_shape = ((total_num_pages, 2, num_kv_heads, page_size, head_dim)
+                if kv_layout == "HND"
+                else (total_num_pages, 2, page_size, num_kv_heads,
+                      head_dim))
+    kv_data = 0.1 * jax.random.normal(jax.random.fold_in(key, 1),
+                                      kv_shape, jnp.float16)
+    kv_indptr = np.arange(batch_size + 1, dtype=np.int32) * \
+        num_pages_per_seq
+    kv_indices = np.arange(total_num_pages, dtype=np.int32)
+    kv_last_page_len = np.full(
+        (batch_size,), (kv_len - 1) % page_size + 1, np.int32)
+
+    wrapper = fi.BatchDecodeWithPagedKVCacheWrapper(
+        jnp.empty(1024, jnp.int8), kv_layout)
+    wrapper.plan(kv_indptr, kv_indices, kv_last_page_len, num_qo_heads,
+                 num_kv_heads, head_dim, page_size,
+                 pos_encoding_mode=pos_encoding_mode,
+                 data_type=jnp.float16, q_data_type=jnp.float16)
+    o_fp16 = wrapper.run(q, kv_data)
+
+    k_data = kv_data[:, 0]
+    v_data = kv_data[:, 1]
+    k_scale = float(jnp.max(jnp.abs(k_data.astype(jnp.float32)))) / 256
+    v_scale = float(jnp.max(jnp.abs(v_data.astype(jnp.float32)))) / 256
+    k_fp8 = (k_data.astype(jnp.float32) / k_scale).astype(dtype)
+    v_fp8 = (v_data.astype(jnp.float32) / v_scale).astype(dtype)
+    kv_data_fp8 = jnp.stack([k_fp8, v_fp8], axis=1)
+
+    wrapper.plan(kv_indptr, kv_indices, kv_last_page_len, num_qo_heads,
+                 num_kv_heads, head_dim, page_size,
+                 pos_encoding_mode=pos_encoding_mode,
+                 data_type=dtype, q_data_type=jnp.float16)
+    o_fp8 = wrapper.run(q, kv_data_fp8, k_scale=k_scale, v_scale=v_scale)
+    np.testing.assert_allclose(
+        np.asarray(o_fp16, np.float32), np.asarray(o_fp8, np.float32),
+        atol=1e-2, rtol=2e-1)
