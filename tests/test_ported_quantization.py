@@ -1,0 +1,56 @@
+"""Migration proof #19: mechanical port of the reference test file
+``/root/reference/tests/utils/test_quantization.py`` (packbits /
+segment_packbits vs numpy.packbits), matrices verbatim, torch -> jnp.
+The 999999-element cell runs (bit-packing is cheap on CPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import flashinfer_tpu as fi
+from tests.test_ported_batch_prefill import _sample
+
+
+@pytest.mark.parametrize(
+    "num_elements,bitorder",
+    _sample(
+        "packbits",
+        [1, 10, 99, 128, 999, 5000, 131072, 999999], ["big", "little"],
+        specials=((0, 999999), (1, "little")),
+    ),
+)
+def test_packbits(num_elements, bitorder):
+    """Reference test_packbits (test_quantization.py:33)."""
+    x = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(42), (num_elements,))
+    ) < 0.5
+    ref = np.packbits(x, bitorder=bitorder)
+    got = fi.quantization.packbits(jnp.asarray(x), bitorder)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+@pytest.mark.parametrize(
+    "batch_size,bitorder",
+    _sample(
+        "segment_packbits",
+        [1, 10, 99, 128, 777, 999], ["big", "little"],
+        specials=((0, 999),),
+    ),
+)
+def test_segment_packbits(batch_size, bitorder):
+    """Reference test_segment_packbits (test_quantization.py:60):
+    per-segment packing equals packbits of each slice."""
+    old_indptr = np.cumsum(np.arange(batch_size + 1)).astype(np.int64)
+    num_elements = int(old_indptr[-1])
+    x = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(42), (max(num_elements, 1),))
+    )[:num_elements] < 0.5
+    y, new_indptr = fi.quantization.segment_packbits(
+        jnp.asarray(x), jnp.asarray(old_indptr), bitorder)
+    y_np, new_np = np.asarray(y), np.asarray(new_indptr)
+    for i in range(batch_size):
+        seg = x[old_indptr[i]:old_indptr[i + 1]]
+        ref = np.asarray(fi.packbits(jnp.asarray(seg), bitorder))
+        np.testing.assert_array_equal(
+            y_np[new_np[i]:new_np[i + 1]], ref, err_msg=f"segment {i}")
