@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import collections
 import enum
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -139,15 +140,27 @@ def is_gated_activation(act) -> bool:
     return act in _GATED_ACTIVATIONS
 
 
-class TopKTieBreak(enum.Enum):
-    """Tie policy of the sorting-free top-k (reference TopKTieBreak).
+class TopKTieBreak(enum.IntEnum):
+    """Top-k tie-break mode — the reference's int-valued enum verbatim
+    (topk.py:40: NONE=0 legacy order, SMALL=1 prefer smaller indices,
+    LARGE=2 prefer larger indices).  This library's backends naturally
+    prefer LOWEST index on exact ties (xla sort order and the threshold
+    kernel's cut both do), so NONE == SMALL here; LARGE is served by the
+    reversed-input transform in :func:`top_k`.  The pre-round-5 member
+    names remain as aliases."""
 
-    This library's threshold backend cuts exact-equality tie classes at
-    the k-th value by LOWEST INDEX (``topk`` module docstring); the XLA
-    sort backend inherits the sort's tie order."""
+    NONE = 0
+    SMALL = 1
+    LARGE = 2
+    # legacy aliases (same values -> IntEnum aliasing)
+    SortOrder = 0
+    LowestIndex = 1
 
-    LowestIndex = "lowest_index"
-    SortOrder = "sort_order"
+    def __str__(self):  # reference topk.py: str() -> "none"/"small"/"large"
+        return self.name.lower()
+
+    def __format__(self, spec):
+        return format(str(self), spec)
 
 
 class SfLayout(enum.Enum):
@@ -164,15 +177,56 @@ class SfLayout(enum.Enum):
 # top-k conveniences
 # ---------------------------------------------------------------------------
 
-def top_k(scores: jax.Array, k: int, backend: str = "xla"):
-    """Exact top-k -> (values, indices) (reference ``flashinfer.top_k``).
+def top_k(scores: jax.Array, k: int, sorted: bool = False,
+          deterministic: bool = False,
+          tie_break: int = TopKTieBreak.NONE,
+          dsa_graph_safe: bool = False, backend: str = "xla"):
+    """Exact top-k -> (values, indices) — the reference signature
+    verbatim (``flashinfer.top_k``, topk.py:508).
 
-    The reference returns value-sorted entries, so this order-sensitive
-    entry pins ``backend="xla"`` rather than "auto" — the process-wide
-    ``FLASHINFER_TPU_TOPK_BACKEND=threshold`` opt-in must not silently
-    switch migrating callers to index-ordered output.  Set-semantics
-    callers can pass ``backend="threshold"`` (or "auto") explicitly."""
-    return topk.top_k_values_indices(scores, k, backend)
+    The xla backend returns value-sorted entries (a superset of both
+    ``sorted`` settings); ``deterministic``/``dsa_graph_safe`` are inert
+    (this backend is always deterministic and jit-replay-safe).
+    ``tie_break``: NONE and SMALL are the backends' native
+    lowest-index-on-ties order; LARGE runs on the column-reversed input
+    so exact ties resolve to the LARGEST original index, then maps
+    indices back.  Indices are int32 (JAX default; the reference returns
+    int64 — documented in docs/migration.md).
+
+    Order note: this order-sensitive entry pins ``backend="xla"`` rather
+    than "auto" — the process-wide ``FLASHINFER_TPU_TOPK_BACKEND=
+    threshold`` opt-in must not silently switch migrating callers to
+    index-ordered output.  Set-semantics callers can pass
+    ``backend="threshold"`` (or "auto") explicitly; ``sorted=True`` then
+    post-sorts that backend's index-ordered output, and the threshold
+    backend's -1 invalid-slot sentinel is preserved through the LARGE
+    remap."""
+    if int(tie_break) == int(TopKTieBreak.LARGE):
+        vals, idx = _top_k_large_ties(scores, k, backend)
+    else:
+        vals, idx = topk.top_k_values_indices(scores, k, backend)
+    if sorted and backend != "xla":
+        # non-xla backends return index-ordered entries; honor sorted=
+        vals, idx = _sort_desc_pairs(vals, idx)
+    return vals, idx
+
+
+@functools.partial(jax.jit, static_argnames=("k", "backend"))
+def _top_k_large_ties(scores, k, backend):
+    """LARGE tie-break: top-k of the column-reversed input (so exact ties
+    cut at the LARGEST original index), indices mapped back, with the
+    threshold backend's -1 invalid-slot sentinel preserved.  Jitted so
+    XLA fuses the reverse/remap into the selection."""
+    v = scores.shape[-1]
+    vals, idx = topk.top_k_values_indices(scores[..., ::-1], k, backend)
+    return vals, jnp.where(idx >= 0, v - 1 - idx, idx).astype(idx.dtype)
+
+
+@jax.jit
+def _sort_desc_pairs(vals, idx):
+    order = jnp.argsort(-vals.astype(jnp.float32), axis=-1)
+    return (jnp.take_along_axis(vals, order, -1),
+            jnp.take_along_axis(idx, order, -1))
 
 
 def top_k_ragged_transform(
