@@ -23,7 +23,10 @@ import argparse
 import functools
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
@@ -33,7 +36,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from flashinfer_tpu.utils import jax_shard_map as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

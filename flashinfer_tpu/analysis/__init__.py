@@ -1,0 +1,285 @@
+"""flashinfer_tpu.analysis — multi-pass static analyzer for the port's
+deepest contracts.
+
+Grown from ``wedge_lint.py`` (which proved that an AST pass wired into
+CI pays for itself — it encodes two real chip-wedge incidents), this
+package generalizes the approach to the three bug classes every
+round-5 advisor finding fell into:
+
+====  =====================  ==========================================
+pass  module                 bug class (motivating incident)
+====  =====================  ==========================================
+L001  alias_rebind           class-level ``forward = run`` aliases that
+                             skip subclass overrides (the attention-sink
+                             forward() wrong-numerics bug)
+L002  signature_parity       positional-arg drift against the recorded
+                             reference signatures (the ``window_left``
+                             dtype-misbinding bug)
+L003  jit_staticness         env/mutable-global reads pinned at
+                             ``jax.jit`` trace time (the top-k backend
+                             env-override pinning bug)
+L004  wedge                  the original wedge lint (W000–W004), now a
+                             pass behind this driver; ``wedge_lint.py``
+                             remains as a compat shim
+====  =====================  ==========================================
+
+CLI::
+
+    python -m flashinfer_tpu.analysis [paths...]
+        [--baseline FILE | --no-baseline] [--write-baseline]
+        [--bank FILE] [--dump-signatures]
+
+With no paths, analyzes the installed ``flashinfer_tpu`` package tree.
+Exit status is 1 iff findings exist that are not in the committed
+baseline (``flashinfer_tpu/analysis/baseline.json``).  Suppress a
+reviewed-safe line with ``# graft-lint: ok <reason>`` — reasonless
+suppressions are themselves findings (L000).  See
+docs/static_analysis.md for the pass catalog and workflows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
+                                     signature_parity, wedge)
+from flashinfer_tpu.analysis.core import (Finding, Project,  # noqa: F401
+                                          SourceFile, load_file,
+                                          load_source, project_relpath)
+
+__all__ = [
+    "Finding", "Project", "analyze_paths", "analyze_project",
+    "load_baseline", "partition_against_baseline", "main",
+    "DEFAULT_BASELINE_PATH", "PASSES",
+]
+
+PASSES = (alias_rebind, signature_parity, jit_staticness, wedge)
+
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def analyze_project(project: Project,
+                    bank: Optional[dict] = None) -> List[Finding]:
+    """Run every pass over `project`, apply graft-lint suppressions,
+    and emit L000 for reasonless suppression comments."""
+    raw: List[Finding] = []
+    for sf in project.files:
+        if sf.parse_finding is not None:
+            raw.append(sf.parse_finding)
+    for p in PASSES:
+        if p is signature_parity:
+            raw.extend(p.run(project, bank))
+        else:
+            raw.extend(p.run(project))
+
+    by_path: Dict[str, SourceFile] = {sf.path: sf for sf in project.files}
+    findings: List[Finding] = []
+    for f in raw:
+        sf = by_path.get(f.filename)
+        if sf is not None and f.code != "W000" \
+                and sf.suppression_for(f.line) is not None:
+            continue  # suppressed (reasonless ones add L000 below)
+        findings.append(f)
+    # every reasonless graft suppression is a finding, whether or not
+    # it shielded anything — an unreviewable waiver is always a bug.
+    # (One finding per line: the wedge pass already reports W000 when
+    # the bare suppression shields a W-code on that line.)
+    w000_lines = {(f.filename, f.line) for f in findings
+                  if f.code == "W000"}
+    for sf in project.files:
+        for line, reason in sorted(sf.suppressions.items()):
+            if not reason and (sf.path, line) not in w000_lines:
+                findings.append(Finding(
+                    "L000", sf.path, line, "<suppression>",
+                    "graft-lint suppression without a reason — state "
+                    "why the flagged pattern is safe"))
+        # wedge-spelled suppressions never waive L-codes, but a
+        # reasonless one is still an unreviewable waiver: the wedge
+        # pass only reports W000 when it SHIELDS a W-finding, so an
+        # orphan bare '# wedge-lint: ok' would otherwise pass silently
+        # and mute any future W-finding landing on its line
+        for line, reason in sorted(sf.wedge_suppressions.items()):
+            if not reason and (sf.path, line) not in w000_lines \
+                    and line not in sf.suppressions:
+                findings.append(Finding(
+                    "W000", sf.path, line, "<suppression>",
+                    "wedge-lint suppression without a reason — state "
+                    "why the pattern is safe (it currently shields "
+                    "nothing, but would silently waive the next "
+                    "W-finding on this line)"))
+    findings.sort(key=lambda f: (f.filename, f.line, f.code))
+    return findings
+
+
+def analyze_paths(paths: List[str],
+                  bank: Optional[dict] = None) -> List[Finding]:
+    return analyze_project(Project.from_paths(paths), bank)
+
+
+# -- baseline ------------------------------------------------------------
+
+
+def _baseline_key(f: Finding) -> Tuple[str, str, str]:
+    return (f.code, project_relpath(f.filename), f.func)
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[Tuple, int]:
+    """{(code, relpath, func): allowed count}; {} if the file is absent."""
+    path = path or DEFAULT_BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple, int] = {}
+    for e in data.get("findings", []):
+        if e["code"] in _UNBASELINEABLE:
+            continue  # hand-edited in: still never honored
+        key = (e["code"], e["path"], e["func"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def partition_against_baseline(
+        findings: List[Finding], baseline: Dict[Tuple, int]
+) -> Tuple[List[Finding], List[Finding], List[Tuple]]:
+    """-> (new findings, baselined findings, stale baseline keys).
+
+    Keys are (code, path, func) with a count, NOT line numbers — the
+    baseline survives unrelated edits above a finding, and a fixed
+    instance surfaces as a stale entry to prune rather than silently
+    freeing budget for a new bug of the same shape elsewhere."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        key = _baseline_key(f)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [k for k, n in sorted(budget.items()) if n > 0]
+    return new, old, stale
+
+
+# findings that may NEVER be baselined: a reasonless suppression is by
+# definition un-triageable — the whole point of L000/W000 is that it
+# must be fixed (add the reason), not accepted
+_UNBASELINEABLE = frozenset({"L000", "W000"})
+
+
+def write_baseline(findings: List[Finding], path: str) -> None:
+    skipped = [f for f in findings if f.code in _UNBASELINEABLE]
+    if skipped:
+        for f in skipped:
+            print(f"refusing to baseline (fix the suppression reason "
+                  f"instead): {f}")
+        findings = [f for f in findings if f.code not in _UNBASELINEABLE]
+    counts: Dict[Tuple, int] = {}
+    lines: Dict[Tuple, List[int]] = {}
+    for f in findings:
+        key = _baseline_key(f)
+        counts[key] = counts.get(key, 0) + 1
+        lines.setdefault(key, []).append(f.line)
+    entries = [
+        {"code": code, "path": path, "func": func,
+         "count": counts[(code, path, func)],
+         "lines_at_capture": lines[(code, path, func)]}
+        for code, path, func in sorted(counts)]
+    with open(path, "w") as f:
+        json.dump({
+            "comment": (
+                "Accepted pre-existing findings. Keyed by (code, path, "
+                "func) + count; lines_at_capture is informational only. "
+                "Regenerate with `python -m flashinfer_tpu.analysis "
+                "--write-baseline` AFTER triaging that every new entry "
+                "is a documented deviation, not a bug "
+                "(docs/static_analysis.md)."),
+            "findings": entries,
+        }, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+# -- CLI -----------------------------------------------------------------
+
+
+def _default_paths() -> List[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _dump_signatures(paths: List[str], bank: dict) -> None:
+    project = Project.from_paths(paths)
+    out = {}
+    for sf in project.files:
+        defs = signature_parity._qualname_defs(sf)
+        for key, spec in bank.items():
+            path, _, qualname = key.partition(":")
+            if path != project_relpath(sf.path) or qualname not in defs:
+                continue
+            fn = defs[qualname]
+            kwonly = [a.arg for a in fn.args.kwonlyargs]
+            out[key] = {
+                "reference_positional": spec["positional"],
+                "implementation_positional":
+                    signature_parity.positional_params(
+                        fn, method="." in qualname),
+                "implementation_kwonly": kwonly,
+                "has_vararg": fn.args.vararg is not None,
+            }
+    print(json.dumps(out, indent=1))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m flashinfer_tpu.analysis",
+        description="multi-pass static analyzer (lifecycle aliases, "
+                    "signature parity, jit staticness, wedge patterns)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: the "
+                        "flashinfer_tpu package tree)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default {DEFAULT_BASELINE_PATH})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings into the baseline file")
+    p.add_argument("--bank", default=None,
+                   help="signature bank for L002 (default: the "
+                        "committed reference_signatures.json)")
+    p.add_argument("--dump-signatures", action="store_true",
+                   help="print current implementation signatures for "
+                        "every bank symbol, then exit")
+    args = p.parse_args(argv)
+
+    paths = args.paths or _default_paths()
+    bank = signature_parity.load_bank(args.bank)
+    if args.dump_signatures:
+        _dump_signatures(paths, bank)
+        return 0
+
+    findings = analyze_paths(paths, bank)
+    baseline_path = args.baseline or DEFAULT_BASELINE_PATH
+
+    if args.write_baseline:
+        write_baseline(findings, baseline_path)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = findings, [], []
+    else:
+        new, old, stale = partition_against_baseline(
+            findings, load_baseline(baseline_path))
+    for f in new:
+        print(f)
+    for key in stale:
+        print(f"stale baseline entry (no longer fires — prune it): "
+              f"{key[1]} [{key[0]}] {key[2]}")
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{len(old)} baselined, {len(stale)} stale baseline entr(ies)")
+    return 1 if new else 0

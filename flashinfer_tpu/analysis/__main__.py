@@ -1,0 +1,6 @@
+"""``python -m flashinfer_tpu.analysis`` — see package docstring."""
+
+from flashinfer_tpu.analysis import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""L001 — stale class-level method aliases across inheritance.
+
+A class-level alias like ``forward = run`` binds whatever ``run`` IS at
+class-definition time.  A subclass that redefines ``run`` but inherits
+the alias gets a ``forward()`` that silently calls the BASE class's
+``run`` — skipping the subclass's epilogue with no error.  This is the
+BatchAttentionWithAttentionSinkWrapper bug (ADVICE.md round 5, item 1):
+``forward()`` skipped the sink epilogue and produced wrong numerics.
+``sparse.py``'s VariableBlockSparseAttentionWrapper shows the fix
+pattern — rebind ``forward = run`` after the subclass ``def run``.
+
+Flagged shapes (for every alias ``A = T`` where ``T`` is defined as a
+method somewhere in the base chain):
+
+- a class redefines ``T`` but inherits ``A = T`` from an ancestor
+  without rebinding it after its own ``def T``;
+- a class binds ``A = T`` BEFORE its own ``def T`` in the same body
+  (the alias captures the inherited ``T``, not the one defined below);
+- a class inherits both a redefined ``T`` and an alias bound ABOVE the
+  redefinition (the "inheriting a redefined run" case — its
+  ``forward`` skips the override it actually inherits).
+
+Fix: rebind ``A = T`` after the most-derived ``def T``, or replace the
+alias with a ``def A`` that dispatches through ``self.T``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from flashinfer_tpu.analysis.core import ClassInfo, Finding, Project
+
+CODE = "L001"
+
+
+def _chain_pos(chain: List[ClassInfo], info: ClassInfo) -> int:
+    for i, c in enumerate(chain):
+        if c is info:
+            return i
+    return -1
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(project.class_index):
+        for cls in project.class_index[name]:
+            findings.extend(_check_class(project, cls))
+    return findings
+
+
+def _check_class(project: Project, cls: ClassInfo) -> List[Finding]:
+    chain = project.mro_chain(cls)
+    out: List[Finding] = []
+    # every alias name visible on `cls`, resolved to its nearest binder
+    seen_aliases = set()
+    for c in chain:
+        for alias in c.alias_binds:
+            if alias in seen_aliases:
+                continue
+            seen_aliases.add(alias)
+            binder = c
+            target, bind_idx, bind_line = binder.alias_binds[alias]
+            # nearest class in the chain that defines the target method
+            definer = next(
+                (d for d in chain if target in d.method_defs), None)
+            if definer is None:
+                continue  # not a method alias (constant, re-export, ...)
+            def_idx, def_line = definer.method_defs[target]
+            binder_pos = _chain_pos(chain, binder)
+            definer_pos = _chain_pos(chain, definer)
+            if definer_pos < binder_pos:
+                # the method override is MORE derived than the alias
+                # binding: alias dispatches to the stale base method
+                if definer is cls:
+                    line, func = def_line, f"{cls.name}.{target}"
+                else:
+                    line, func = cls.node.lineno, cls.name
+                out.append(Finding(
+                    CODE, cls.file.path, line, func,
+                    f"class-level alias '{alias} = {target}' inherited "
+                    f"from {binder.name} (line {bind_line}) was bound at "
+                    f"class-definition time and skips the '{target}' "
+                    f"override defined in {definer.name} (line {def_line})"
+                    f" — {cls.name}.{alias}() silently calls the base "
+                    f"'{target}'. Rebind '{alias} = {target}' after the "
+                    f"override (sparse.py VariableBlockSparse pattern) or "
+                    f"make '{alias}' a def dispatching via self.{target}"))
+            elif definer is binder and binder is cls \
+                    and bind_idx < def_idx:
+                # same class, alias textually above the def: it captured
+                # the inherited/previous target, not the one below
+                out.append(Finding(
+                    CODE, cls.file.path, bind_line,
+                    f"{binder.name}.{alias}",
+                    f"'{alias} = {target}' appears ABOVE 'def {target}' "
+                    f"(line {def_line}) in the same class body — the "
+                    f"alias captured the inherited '{target}', not the "
+                    f"definition below it. Move the alias after the def"))
+    return out

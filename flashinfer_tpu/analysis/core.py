@@ -1,0 +1,215 @@
+"""Shared data model for the multi-pass static analyzer.
+
+One ``Finding`` shape serves every pass (including the folded-in wedge
+lint, whose ``Finding`` predates this package and fixed the field
+names).  A ``Project`` is the unit of analysis: passes that need
+cross-file resolution (L001 walks base classes defined in other
+modules, L003 propagates env-read taint through cross-module calls)
+consult the project-wide indexes instead of re-parsing.
+
+Suppressions: ``# graft-lint: ok <reason>`` on the flagged line or on a
+standalone comment line directly above it waives a finding.  A
+suppression WITHOUT a reason still waives the finding but is itself
+reported as L000 — the same contract the wedge lint proved with W000:
+an unreviewable waiver is worse than the finding it hides.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional
+
+GRAFT_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*ok\b\s*(.*)")
+# the wedge pass's historical spelling: waives only W-codes (scanned by
+# the wedge lint itself), but the driver still audits it for reasonless
+# comments — an unreviewable waiver is a finding in either spelling
+WEDGE_SUPPRESS_RE = re.compile(r"#\s*wedge-lint:\s*ok\b\s*(.*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str
+    filename: str
+    line: int
+    func: str
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.filename}:{self.line} [{self.code}] {self.func}: "
+                f"{self.message}")
+
+
+def project_relpath(path: str) -> str:
+    """Stable path key for baselines: the path from the last known
+    project-root component on, independent of the CWD the CLI ran in.
+    The RIGHTMOST marker match wins across all markers — so a checkout
+    directory that happens to be named ``flashinfer_tpu`` cannot hijack
+    the key of a ``tests/`` or ``scripts/`` file nested inside it."""
+    p = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+    best = -1
+    for marker in ("/flashinfer_tpu/", "/tests/", "/scripts/",
+                   "/benchmarks/", "/examples/"):
+        best = max(best, p.rfind(marker))
+    if best >= 0:
+        return p[best + 1:]
+    return os.path.basename(p)
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str
+    src: str
+    tree: Optional[ast.Module]
+    suppressions: Dict[int, str]  # graft: line -> reason ("" = reasonless)
+    parse_finding: Optional[Finding] = None
+    # wedge-spelled suppressions, recorded ONLY so the driver can audit
+    # reasonless ones — they never waive L-codes
+    wedge_suppressions: Dict[int, str] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+    def suppression_for(self, line: int) -> Optional[str]:
+        """Reason string if `line` (or the comment line directly above)
+        carries a graft-lint suppression; None otherwise."""
+        for ln in (line, line - 1):
+            if ln in self.suppressions:
+                return self.suppressions[ln]
+        return None
+
+
+def load_source(src: str, path: str) -> SourceFile:
+    suppressions: Dict[int, str] = {}
+    wedge_suppressions: Dict[int, str] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = GRAFT_SUPPRESS_RE.search(line)
+        if m:
+            suppressions[i] = m.group(1).strip()
+        m = WEDGE_SUPPRESS_RE.search(line)
+        if m:
+            wedge_suppressions[i] = m.group(1).strip()
+    try:
+        tree = ast.parse(src, path)
+        parse_finding = None
+    except SyntaxError as e:  # analysis must never crash a build
+        tree = None
+        parse_finding = Finding("L999", path, e.lineno or 0, "<module>",
+                                f"unparseable source: {e.msg}")
+    return SourceFile(path, src, tree, suppressions, parse_finding,
+                      wedge_suppressions)
+
+
+def load_file(path: str) -> SourceFile:
+    with open(path) as f:
+        return load_source(f.read(), path)
+
+
+class Project:
+    """The set of files under analysis plus lazily-built cross-file
+    indexes.  Passes receive the whole project so inheritance and call
+    chains resolve across modules (within the analyzed set)."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self._class_index: Optional[Dict[str, List["ClassInfo"]]] = None
+
+    @classmethod
+    def from_paths(cls, paths: List[str]) -> "Project":
+        files: List[SourceFile] = []
+        for path in paths:
+            if os.path.isdir(path):
+                for dirpath, _dirs, names in os.walk(path):
+                    for fn in sorted(names):
+                        if fn.endswith(".py"):
+                            files.append(
+                                load_file(os.path.join(dirpath, fn)))
+            else:
+                files.append(load_file(path))
+        return cls(files)
+
+    # -- class index (L001) ------------------------------------------------
+
+    @property
+    def class_index(self) -> Dict[str, List["ClassInfo"]]:
+        if self._class_index is None:
+            idx: Dict[str, List[ClassInfo]] = {}
+            for sf in self.files:
+                if sf.tree is None:
+                    continue
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.ClassDef):
+                        info = ClassInfo.from_node(sf, node)
+                        idx.setdefault(info.name, []).append(info)
+            self._class_index = idx
+        return self._class_index
+
+    def resolve_class(self, name: str) -> Optional["ClassInfo"]:
+        hits = self.class_index.get(name)
+        return hits[0] if hits else None
+
+    def mro_chain(self, cls: "ClassInfo") -> List["ClassInfo"]:
+        """Depth-first base-class chain starting at `cls` — an
+        approximation of the MRO sufficient for single-inheritance
+        wrapper stacks (name-resolved within the analyzed file set)."""
+        chain: List[ClassInfo] = []
+        seen = set()
+
+        def _walk(c: ClassInfo) -> None:
+            key = (c.file.path, c.name, c.node.lineno)
+            if key in seen:
+                return
+            seen.add(key)
+            chain.append(c)
+            for base in c.base_names:
+                b = self.resolve_class(base)
+                if b is not None:
+                    _walk(b)
+
+        _walk(cls)
+        return chain
+
+
+def _base_name(expr: ast.expr) -> Optional[str]:
+    """Last dotted component of a base-class expression."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):  # e.g. Generic[...] or a metaclass call
+        return _base_name(expr.func)
+    if isinstance(expr, ast.Subscript):  # Generic[T]
+        return _base_name(expr.value)
+    return None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    file: SourceFile
+    node: ast.ClassDef
+    base_names: List[str]
+    # alias -> (target name, body index, line); LAST class-level
+    # ``alias = target`` assignment wins, like the class body would.
+    alias_binds: Dict[str, tuple]
+    # method name -> (body index, line) of its LAST class-level def
+    method_defs: Dict[str, tuple]
+
+    @classmethod
+    def from_node(cls, sf: SourceFile, node: ast.ClassDef) -> "ClassInfo":
+        bases = [b for b in (_base_name(e) for e in node.bases) if b]
+        aliases: Dict[str, tuple] = {}
+        methods: Dict[str, tuple] = {}
+        for i, stmt in enumerate(node.body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[stmt.name] = (i, stmt.lineno)
+            elif isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Name):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[t.id] = (stmt.value.id, i, stmt.lineno)
+        return cls(node.name, sf, node, bases, aliases, methods)

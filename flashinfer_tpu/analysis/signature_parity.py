@@ -1,0 +1,164 @@
+"""L002 — signature parity against the recorded reference call shapes.
+
+The port's contract is that a VERBATIM reference call site either works
+identically or fails loudly — it must never silently misbind.  The
+classic violation (ADVICE.md round 5, item 2): ``BatchAttention.plan``
+grew ``window_left`` positionally between ``logits_soft_cap`` and
+``q_data_type``, so a reference caller passing the dtypes positionally
+bound a dtype into ``window_left`` with no error.
+
+The pass checks every symbol recorded in ``reference_signatures.json``
+(the signature bank, seeded from the reference snapshot) against the
+implementation's AST:
+
+- each positional parameter (positional-only or positional-or-keyword,
+  after self/cls) must match the reference's positional list name-for-
+  name, in order — any insertion or reorder is a finding;
+- parameters the implementation adds beyond the reference's positional
+  arity must be keyword-only (after ``*``) so a reference positional
+  call overflows loudly instead of misbinding.
+
+An implementation may take FEWER parameters positionally than the
+reference (the rest keyword-only): reference positional calls then
+raise TypeError — loud, therefore acceptable and the recommended fix.
+A bare ``*args`` vararg voids that loud-overflow guarantee and is
+flagged unless the bank entry records ``allow_vararg`` with the
+forwarding contract documented.
+
+Bank format (``reference_signatures.json``)::
+
+    {"symbols": {
+        "flashinfer_tpu/attention.py:BatchAttention.plan": {
+            "reference": "flashinfer/attention/_core.py:95",
+            "positional": ["qo_indptr", ...],
+            "note": "..."}}}
+
+Keys are ``<project-relative path>:<qualname>`` (``project_relpath``
+form, as the baseline uses — duplicate basenames cannot collide).
+Regenerate / audit the bank
+with ``python -m flashinfer_tpu.analysis --dump-signatures`` (prints
+the CURRENT implementation shapes for every recorded symbol) and
+docs/static_analysis.md's workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from flashinfer_tpu.analysis.core import (Finding, Project, SourceFile,
+                                          project_relpath)
+
+CODE = "L002"
+
+DEFAULT_BANK_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "reference_signatures.json")
+
+
+def load_bank(path: Optional[str] = None) -> Dict[str, dict]:
+    with open(path or DEFAULT_BANK_PATH) as f:
+        return json.load(f)["symbols"]
+
+
+def _qualname_defs(sf: SourceFile) -> Dict[str, ast.FunctionDef]:
+    """Top-level functions and one-level class methods by qualname."""
+    out: Dict[str, ast.FunctionDef] = {}
+    if sf.tree is None:
+        return out
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{stmt.name}"] = stmt
+    return out
+
+
+def positional_params(fn: ast.FunctionDef, *, method: bool) -> List[str]:
+    """Names bindable by position, in order, self/cls dropped."""
+    names = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    if method and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def run(project: Project, bank: Optional[Dict[str, dict]] = None
+        ) -> List[Finding]:
+    if bank is None:
+        bank = load_bank()
+    # keys carry project_relpath (the baseline's path keys), so
+    # duplicate basenames (attention.py vs parallel/attention.py,
+    # compat.py vs comm/compat.py) can never match the wrong file
+    by_path: Dict[str, List[tuple]] = {}
+    for key, spec in bank.items():
+        path, _, qualname = key.partition(":")
+        by_path.setdefault(path, []).append((key, qualname, spec))
+
+    findings: List[Finding] = []
+    for sf in project.files:
+        entries = by_path.get(project_relpath(sf.path))
+        if not entries:
+            continue
+        defs = _qualname_defs(sf)
+        for key, qualname, spec in entries:
+            fn = defs.get(qualname)
+            if fn is None:
+                # the FILE is under analysis but the recorded symbol is
+                # gone: a rename/move would otherwise silently drop its
+                # parity protection (bank entries whose file isn't in
+                # the analyzed set stay quiet — the CLI may run on a
+                # subset)
+                findings.append(Finding(
+                    CODE, sf.path, 1, key,
+                    f"recorded reference symbol '{qualname}' not found "
+                    f"in this file — its positional-parity protection "
+                    f"({spec.get('reference', 'reference snapshot')}) "
+                    f"is silently gone; update the bank key or restore "
+                    f"the symbol"))
+                continue
+            findings.extend(_check_symbol(sf, key, qualname, fn, spec))
+    return findings
+
+
+def _check_symbol(sf: SourceFile, key: str, qualname: str,
+                  fn: ast.FunctionDef, spec: dict) -> List[Finding]:
+    ref: List[str] = spec["positional"]
+    impl = positional_params(fn, method="." in qualname)
+    src = spec.get("reference", "reference snapshot")
+    if fn.args.vararg is not None and not spec.get("allow_vararg"):
+        # a bare *args voids the "fewer positionals fail loudly"
+        # guarantee: reference positionals past the declared prefix are
+        # swallowed silently instead of raising TypeError
+        return [Finding(
+            CODE, sf.path, fn.lineno, key,
+            f"'*{fn.args.vararg.arg}' vararg on a reference-parity "
+            f"symbol: a verbatim reference call with more positionals "
+            f"than the declared prefix is silently swallowed instead "
+            f"of raising — enumerate the reference positionals "
+            f"({src}) explicitly, or record allow_vararg in the bank "
+            f"with the forwarding contract documented")]
+    raw = fn.args.posonlyargs + fn.args.args
+    offset = len(raw) - len(impl)  # 1 when self/cls was dropped
+    for i, name in enumerate(impl):
+        arg_node = raw[i + offset]
+        if i >= len(ref):
+            if spec.get("open_tail"):
+                return []  # prefix matched; tail deviation is recorded
+            return [Finding(
+                CODE, sf.path, arg_node.lineno, key,
+                f"positional parameter #{i + 1} '{name}' is beyond the "
+                f"reference positional arity ({len(ref)}, {src}) — a "
+                f"verbatim reference call cannot supply it; make it "
+                f"keyword-only (after '*')")]
+        if name != ref[i]:
+            return [Finding(
+                CODE, sf.path, arg_node.lineno, key,
+                f"positional parameter #{i + 1} is '{name}' where the "
+                f"reference ({src}) has '{ref[i]}' — a verbatim "
+                f"reference positional call misbinds here; restore the "
+                f"reference order or make '{name}' keyword-only")]
+    return []

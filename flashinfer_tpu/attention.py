@@ -54,6 +54,7 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
         causal: bool = False,
         sm_scale: Optional[float] = None,
         logits_soft_cap: Optional[float] = None,
+        *,
         window_left: int = -1,
         q_data_type=jnp.bfloat16,
         kv_data_type=None,
@@ -63,7 +64,16 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
         """Reference arity (attention/_core.py:95): both head dims are
         positional (DeepSeek-style qk 192 / vo 128 splits exist there);
         this build's paged path is square — asymmetric dims raise with
-        the MLA alternative."""
+        the MLA alternative.
+
+        ``window_left`` (a TPU-port extension) and everything after it
+        are KEYWORD-ONLY: the reference plan has no window_left between
+        logits_soft_cap and q_data_type, so a verbatim reference caller
+        passing the dtypes positionally would silently bind a dtype
+        into window_left (ADVICE.md round-5 item 2).  Reference
+        positional calls past logits_soft_cap now raise TypeError —
+        loud, never misbound.  The reference arity is recorded in the
+        L002 signature bank (analysis/reference_signatures.json)."""
         import numpy as np
 
         if head_dim_qk != head_dim_vo:
@@ -76,7 +86,6 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
         pages_per_req = kv_indptr[1:] - kv_indptr[:-1]
         # reconstruct last_page_len from token lengths
         last = kv_len_arr - (np.maximum(pages_per_req, 1) - 1) * page_size
-        self._plan_soft_cap = float(logits_soft_cap or 0.0)
         super().plan(
             qo_indptr, kv_indptr, kv_indices, last.astype(np.int32),
             num_qo_heads, num_kv_heads, head_dim_qk, page_size,
@@ -84,10 +93,14 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
             logits_soft_cap=logits_soft_cap, window_left=window_left,
             q_data_type=q_data_type, kv_data_type=kv_data_type,
         )
+        # recorded only once the plan is actually live: a failed re-plan
+        # must not desync the cap run() validates against from the
+        # still-active previous plan
+        self._plan_soft_cap = float(logits_soft_cap or 0.0)
 
     def run(self, q, paged_kv_cache, out=None, lse=None, k_scale=None,
             v_scale=None, logits_soft_cap: float = 0.0,
-            profiler_buffer=None, kv_cache_sf=None, **kw):
+            profiler_buffer=None, *, kv_cache_sf=None, **kw):
         """Reference contract (attention/_core.py:216): ALWAYS returns
         ``(out, lse)``; ``k_scale`` folds into sm_scale for this call,
         ``v_scale`` scales the output.  ``logits_soft_cap``: a non-zero
@@ -119,6 +132,11 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
             q, paged_kv_cache, out=out, lse=lse, k_scale=k_scale,
             v_scale=v_scale, return_lse=True, **kw)
 
+    # rebind: the paged base class set `forward = run` to ITS run at
+    # class-definition time; without this, forward() would skip the
+    # (out, lse) holistic contract above (L001; ADVICE.md round-5 item 1)
+    forward = run
+
 
 class PODWithPagedKVCacheWrapper(BatchAttention):
     """Prefill-On-Decode (reference flashinfer/pod.py:61).  On TPU the
@@ -131,6 +149,10 @@ class PODWithPagedKVCacheWrapper(BatchAttention):
     def run(self, q, paged_kv_cache, *, return_lse: bool = False, **kw):
         out, lse = super().run(q, paged_kv_cache, **kw)
         return (out, lse) if return_lse else out
+
+    # rebind so forward() honors THIS run's single-output contract
+    # rather than the alias inherited from BatchAttention (L001)
+    forward = run
 
 
 def sink_epilogue(out, lse, sink, return_lse: bool):
@@ -227,3 +249,11 @@ class BatchAttentionWithAttentionSinkWrapper(
             if restore_plan is not None:
                 self._plan = restore_plan
         return sink_epilogue(o, l, s, return_lse)
+
+    # rebind: the base paged wrapper's `forward = run` alias was bound
+    # to the BASE run at class-definition time — inherited as-is it
+    # would silently skip the sink epilogue above (wrong numerics, no
+    # error; the reference's deprecated forward dispatches through
+    # self.run virtually, so ITS sink wrapper does apply the sink).
+    # This was ADVICE.md round-5 item 1 / the motivating L001 shape.
+    forward = run

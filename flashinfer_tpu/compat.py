@@ -201,6 +201,14 @@ def top_k(scores: jax.Array, k: int, sorted: bool = False,
     post-sorts that backend's index-ordered output, and the threshold
     backend's -1 invalid-slot sentinel is preserved through the LARGE
     remap."""
+    # Resolve the backend EAGERLY, on every call, before any jit
+    # boundary: _top_k_large_ties is jitted with `backend` static, so an
+    # "auto" passed through would read FLASHINFER_TPU_TOPK_BACKEND
+    # inside the trace and pin the first resolution in the jit cache —
+    # contradicting topk.py's documented per-call resolution (ADVICE.md
+    # round-5 item 4, the motivating L003 true positive).  This also
+    # makes the sorted= post-sort test below see the concrete backend.
+    backend = topk._resolve_backend(backend)
     if int(tie_break) == int(TopKTieBreak.LARGE):
         vals, idx = _top_k_large_ties(scores, k, backend)
     else:
@@ -216,8 +224,11 @@ def _top_k_large_ties(scores, k, backend):
     """LARGE tie-break: top-k of the column-reversed input (so exact ties
     cut at the LARGEST original index), indices mapped back, with the
     threshold backend's -1 invalid-slot sentinel preserved.  Jitted so
-    XLA fuses the reverse/remap into the selection."""
+    XLA fuses the reverse/remap into the selection.  `backend` is
+    static and arrives PRE-RESOLVED (never "auto") from top_k, so no
+    env read can happen inside this trace."""
     v = scores.shape[-1]
+    # graft-lint: ok backend pre-resolved eagerly in top_k, env branch dead
     vals, idx = topk.top_k_values_indices(scores[..., ::-1], k, backend)
     return vals, jnp.where(idx >= 0, v - 1 - idx, idx).astype(idx.dtype)
 

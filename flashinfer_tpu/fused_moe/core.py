@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from flashinfer_tpu.activation import silu_and_mul
+from flashinfer_tpu.utils import lax_axis_size
 
 
 def _act(h1: jax.Array, activation: str) -> jax.Array:
@@ -370,7 +371,7 @@ def fused_moe_ep(
     (those modes never drop).
     """
     if dispatch == "allgather":
-        ep = jax.lax.axis_size(axis)
+        ep = lax_axis_size(axis)
         rank = jax.lax.axis_index(axis)
         e_local = w_gate_up.shape[0]
 
@@ -437,7 +438,7 @@ def _fused_moe_ep_alltoall(
     hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
     axis, activation, capacity_factor,
 ):
-    ep = jax.lax.axis_size(axis)
+    ep = lax_axis_size(axis)
     e_local = w_gate_up.shape[0]
     T, K = topk_ids.shape
     H = hidden.shape[1]
@@ -504,7 +505,7 @@ def _fused_moe_ep_alltoall_exact(
     order-free); at K>2 the K-way addition order can differ from the
     oracle's expert-sorted scatter-add by an ulp.
     """
-    ep = jax.lax.axis_size(axis)
+    ep = lax_axis_size(axis)
     e_local = w_gate_up.shape[0]
     T, K = topk_ids.shape
     H = hidden.shape[1]

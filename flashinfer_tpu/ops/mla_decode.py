@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from flashinfer_tpu.utils import round_up, use_interpret
+from flashinfer_tpu.utils import round_up, tpu_compiler_params, use_interpret
 
 _NEG_INF = -1e30
 
@@ -273,7 +273,7 @@ def mla_paged_decode_attention(
             jax.ShapeDtypeStruct((batch, hp, d_ckv), q_nope.dtype),
             jax.ShapeDtypeStruct((batch, hp, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024
         ),
         interpret=use_interpret(),

@@ -40,7 +40,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up, use_interpret
+from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up, tpu_compiler_params, use_interpret
 
 _NEG_INF = -1e30
 
@@ -474,7 +474,7 @@ def fused_paged_prefill(
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024,
             has_side_effects=True,
         ),

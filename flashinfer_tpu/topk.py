@@ -157,6 +157,7 @@ def _page_transform_impl(scores, page_table, kv_lens, k, page_size, backend):
     )
     # the consumer (run_sparse) treats rows as a SET, so the threshold
     # backend's index-ordered result is equivalent
+    # graft-lint: ok backend pre-resolved eagerly by the caller, never "auto"
     vals, tok = top_k_values_indices(masked, k, backend)
     valid = jnp.isfinite(vals) & (tok >= 0)
     tok = jnp.maximum(tok, 0)

@@ -1,323 +1,31 @@
-"""Static wedge-pattern lint for Pallas kernel sources.
+"""Compat shim: the wedge-pattern lint now lives in
+``flashinfer_tpu.analysis.wedge`` as the L004 pass of the multi-pass
+static analyzer (``python -m flashinfer_tpu.analysis``), behind the
+shared driver, suppression, and baseline machinery.
 
-This project has twice wedged the shared TPU compile server with kernel
-contents that HANG Mosaic (not fail cleanly): round 1 (flash-kernel
-variants) and round 2 (`fp4_paged_decode_attention` at pages_per_chunk=16
-— an unrolled body of 8 heads x 16 pages x 2 parities of small dots; the
-same kernel at ppc=8 compiled fine).  A wedge takes out EVERY compile
-from every process, for hours to days.  This lint encodes the known-bad
-patterns as AST heuristics so the next kernel that would wedge the chip
-is caught at review/CI time, not at probe time.
-
-No reference analogue — this is TPU-first infra earned by this project's
-wedge history (round-5 verdict item 8).
-
-Checks (kernel-like functions only — a parameter ending in ``_ref`` or a
-name ending in ``_kernel``):
-
-W001 unrolled-dot-explosion: statically-unrolled ``for`` nests whose
-     bodies issue MXU dots; total dots > {DOT_UNROLL_LIMIT} hangs the
-     scheduler (the round-2 wedge: 256 small dots).
-W002 unrolled-dma-queue: literal-range loops issuing async copies with
-     unroll > {DMA_UNROLL_LIMIT} (DMA queue depth) — per-row DMA loops
-     must be chunked or double-buffered instead.
-W003 lane-repeat: ``jnp.repeat``/``pltpu.repeat`` on the minor (lane)
-     dim is an unsupported shape cast in Mosaic ("infer-vector-layout");
-     use a selector-matrix matmul or ride the sublane dim
-     (memory: mosaic-kernel-constraints).
-W004 dynamic-unroll: a Python ``for`` over a NON-literal ``range`` in a
-     kernel body is fully unrolled at trace time with a bound the lint
-     cannot see — the round-2 wedge was exactly this (range(ppc) with
-     ppc=16 from a closure).  Such loops containing dots or async
-     copies must carry a suppression stating the clamp that bounds them
-     (e.g. 'ok ppc clamped <= 8 at call site').
-
-Suppression: append ``# wedge-lint: ok <reason>`` on the flagged line
-(or the ``def`` line to waive a whole function).  A suppression without
-a reason is itself a finding (W000).
-
-Wiring: ``compile_guard.guarded(..., module=m)`` lints ``m``'s source
-once per process before the first hardware compile and refuses to
-compile a flagged kernel unless FLASHINFER_TPU_WEDGE_LINT=off (or warn —
-the default outside TPU).  ``tests/test_wedge_lint.py`` runs the lint
-over the whole ``ops/`` tree in CI.
+This module re-exports the complete historical surface so
+``compile_guard.check_module`` and existing callers/tests keep working
+unchanged.  New code should import from ``flashinfer_tpu.analysis``.
 """
 
 from __future__ import annotations
 
-import ast
-import dataclasses
-import inspect
-import os
-import re
-from typing import List, Optional
+# the tests monkeypatch `wedge_lint.inspect` — it must be the same
+# module object the implementation reads (modules are singletons)
+import inspect  # noqa: F401
+import os  # noqa: F401
 
-DOT_UNROLL_LIMIT = 64
-DMA_UNROLL_LIMIT = 8
-
-_SUPPRESS_RE = re.compile(r"#\s*wedge-lint:\s*ok\b\s*(.*)")
-
-_DOT_NAMES = {"dot", "dot_general", "matmul", "einsum"}
-_DMA_NAMES = {"make_async_copy", "make_async_remote_copy", "async_copy"}
-_REPEAT_NAMES = {"repeat"}
-
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    code: str
-    filename: str
-    line: int
-    func: str
-    message: str
-
-    def __str__(self) -> str:
-        return (f"{self.filename}:{self.line} [{self.code}] {self.func}: "
-                f"{self.message}")
-
-
-def _literal_range_extent(node: ast.For) -> Optional[int]:
-    """Static trip count of ``for _ in range(<int literal>)`` (or
-    range(a, b) with both literal); None when dynamic."""
-    it = node.iter
-    if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-            and it.func.id == "range"):
-        return None
-    vals = []
-    for a in it.args:
-        if isinstance(a, ast.Constant) and isinstance(a.value, int):
-            vals.append(a.value)
-        else:
-            return None
-    if len(vals) == 1:
-        return max(vals[0], 0)
-    if len(vals) >= 2:
-        step = vals[2] if len(vals) > 2 and vals[2] else 1
-        return max((vals[1] - vals[0] + (step - 1)) // step, 0) \
-            if step > 0 else None
-    return None
-
-
-def _call_basename(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return ""
-
-
-def _is_kernel_like(fn: ast.FunctionDef) -> bool:
-    if fn.name.endswith("_kernel"):
-        return True
-    args = fn.args
-    every = (args.posonlyargs + args.args + args.kwonlyargs
-             + ([args.vararg] if args.vararg else []))
-    return any(a.arg.endswith("_ref") for a in every if a)
-
-
-class _KernelVisitor(ast.NodeVisitor):
-    """Walks one kernel-like function, tracking literal unroll products."""
-
-    def __init__(self, filename: str, func: str, suppressed, findings):
-        self.filename = filename
-        self.func = func
-        self.suppressed = suppressed  # {line: reason-or-""}
-        self.findings: List[Finding] = findings
-        self.unroll = 1            # product of enclosing literal ranges
-        self.dot_count = 0         # unroll-weighted dots in this function
-        self.dot_first_line = None
-        self.dma_count = 0         # unroll-weighted async-copy starts
-        self.dma_first_line = None
-
-    def _suppress(self, line: int) -> bool:
-        # a suppression may sit on the flagged line, on a standalone
-        # comment line directly above it, or on the function's def line
-        for ln in (line, line - 1, getattr(self, "_def_line", -1)):
-            if ln in self.suppressed:
-                if not self.suppressed[ln]:
-                    self.findings.append(Finding(
-                        "W000", self.filename, ln, self.func,
-                        "wedge-lint suppression without a reason — state "
-                        "why the pattern is safe (e.g. 'on-chip validated "
-                        "YYYY-MM-DD at config ...')"))
-                return True
-        return False
-
-    def visit_For(self, node: ast.For) -> None:
-        extent = _literal_range_extent(node)
-        if extent is None:
-            is_range = (isinstance(node.iter, ast.Call)
-                        and isinstance(node.iter.func, ast.Name)
-                        and node.iter.func.id == "range")
-            risky = sum(
-                1 for n in ast.walk(node)
-                if (isinstance(n, ast.Call)
-                    and _call_basename(n) in (_DOT_NAMES | _DMA_NAMES))
-                or (isinstance(n, ast.BinOp)
-                    and isinstance(n.op, ast.MatMult)))
-            if is_range and risky and not self._suppress(node.lineno):
-                self.findings.append(Finding(
-                    "W004", self.filename, node.lineno, self.func,
-                    "Python for over a non-literal range unrolls at "
-                    f"trace time with an unbounded factor and contains "
-                    f"{risky} dot/DMA call(s) — the round-2 wedge shape "
-                    "(range(ppc), ppc=16). Clamp the bound and suppress "
-                    "with the clamp stated, or use lax.fori_loop"))
-            self.generic_visit(node)
-            return
-        # the W002 DMA count accrues unroll-weighted at each call site
-        # (visit_Call), so nested literal loops multiply correctly
-        self.unroll *= max(extent, 1)
-        self.generic_visit(node)
-        self.unroll //= max(extent, 1)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        base = _call_basename(node)
-        if base in _DOT_NAMES:
-            self.dot_count += self.unroll
-            if self.dot_first_line is None:
-                self.dot_first_line = node.lineno
-        if base in _DMA_NAMES:
-            self.dma_count += self.unroll
-            if self.dma_first_line is None:
-                self.dma_first_line = node.lineno
-        if base in _REPEAT_NAMES:
-            def _const_axis(v):
-                if isinstance(v, ast.Constant):
-                    return v.value, True
-                if (isinstance(v, ast.UnaryOp)
-                        and isinstance(v.op, ast.USub)
-                        and isinstance(v.operand, ast.Constant)):
-                    return -v.operand.value, True
-                return None, True  # non-constant expression: unknown
-
-            axis = None
-            has_axis = False
-            for kw in node.keywords:
-                if kw.arg == "axis":
-                    axis, has_axis = _const_axis(kw.value)
-            if not has_axis and len(node.args) >= 3:
-                # positional axis form: jnp.repeat(x, reps, axis)
-                axis, has_axis = _const_axis(node.args[2])
-            # axis=-1 is definitely the lane dim; an unknown/omitted axis
-            # flattens (jnp semantics) which also crosses the lane dim
-            if (axis in (-1, None) or not has_axis) \
-                    and not self._suppress(node.lineno):
-                self.findings.append(Finding(
-                    "W003", self.filename, node.lineno, self.func,
-                    "repeat on (or possibly on) the minor/lane dim is an "
-                    "unsupported Mosaic shape cast — use a selector-"
-                    "matrix matmul or move the broadcast to the sublane "
-                    "dim (mosaic-kernel-constraints)"))
-        self.generic_visit(node)
-
-    def visit_BinOp(self, node: ast.BinOp) -> None:
-        if isinstance(node.op, ast.MatMult):
-            self.dot_count += self.unroll
-            if self.dot_first_line is None:
-                self.dot_first_line = node.lineno
-        self.generic_visit(node)
-
-
-def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
-    findings: List[Finding] = []
-    suppressed = {}
-    for i, line in enumerate(src.splitlines(), 1):
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            suppressed[i] = m.group(1).strip()
-    try:
-        tree = ast.parse(src, filename)
-    except SyntaxError as e:  # lint must never crash a build
-        findings.append(Finding(
-            "W999", filename, e.lineno or 0, "<module>",
-            f"unparseable source: {e.msg}"))
-        return findings
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and _is_kernel_like(node):
-            v = _KernelVisitor(filename, node.name, suppressed, findings)
-            v._def_line = node.lineno
-            v.visit(node)
-            if v.dot_count > DOT_UNROLL_LIMIT \
-                    and not v._suppress(v.dot_first_line or node.lineno):
-                findings.append(Finding(
-                    "W001", filename, v.dot_first_line or node.lineno,
-                    node.name,
-                    f"~{v.dot_count} statically-unrolled MXU dots in one "
-                    f"kernel body (> {DOT_UNROLL_LIMIT}) — the round-2 "
-                    "wedge shape; hoist the loop into the grid or shrink "
-                    "the unroll factor (tpu-wedge-history: ppc<=8)"))
-            if v.dma_count > DMA_UNROLL_LIMIT \
-                    and not v._suppress(v.dma_first_line or node.lineno):
-                findings.append(Finding(
-                    "W002", filename, v.dma_first_line or node.lineno,
-                    node.name,
-                    f"~{v.dma_count} statically-unrolled async-copy "
-                    f"starts in one kernel body (> DMA queue depth "
-                    f"{DMA_UNROLL_LIMIT}); chunk the loop nest or "
-                    "double-buffer (wedge history: unrolled per-row DMA "
-                    "loops)"))
-    return findings
-
-
-def lint_file(path: str) -> List[Finding]:
-    with open(path) as f:
-        return lint_source(f.read(), path)
-
-
-def lint_tree(root: str) -> List[Finding]:
-    out: List[Finding] = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                out.extend(lint_file(os.path.join(dirpath, fn)))
-    return out
-
-
-_module_findings: dict = {}  # {module key: cached findings}
-
-
-def check_module(module) -> List[Finding]:
-    """Lint a module's source (compile_guard hook).  The LINT runs once
-    per module per process, but the cached FINDINGS are re-enforced on
-    every call — in strict mode a flagged module raises every time, so
-    a retry can never slip a known-wedging kernel through to a hardware
-    compile.  Strict is the default on real TPU (a hang costs the
-    chip); FLASHINFER_TPU_WEDGE_LINT=warn/off downgrades."""
-    key = getattr(module, "__name__", id(module))
-    if key not in _module_findings:
-        try:
-            src = inspect.getsource(module)
-            path = inspect.getsourcefile(module) or str(key)
-        except (OSError, TypeError):
-            _module_findings[key] = []
-            return []
-        _module_findings[key] = lint_source(src, path)
-    findings = _module_findings[key]
-    if not findings:
-        return findings
-    mode = os.environ.get("FLASHINFER_TPU_WEDGE_LINT", "")
-    if not mode:
-        from flashinfer_tpu.utils import is_tpu
-
-        mode = "strict" if is_tpu() else "warn"
-    if mode == "off":
-        return findings
-    msg = "wedge-lint findings (patterns that have wedged this chip):\n" \
-        + "\n".join(f"  {f}" for f in findings)
-    if mode == "strict":
-        raise WedgeLintError(
-            msg + "\nFix the pattern, or suppress a verified-safe line "
-            "with '# wedge-lint: ok <reason>' "
-            "(FLASHINFER_TPU_WEDGE_LINT=warn/off to downgrade)")
-    import logging
-
-    logging.getLogger("flashinfer_tpu").warning(msg)
-    return findings
-
-
-class WedgeLintError(RuntimeError):
-    """A kernel source matches a known chip-wedging Mosaic pattern."""
+from flashinfer_tpu.analysis.core import Finding  # noqa: F401
+from flashinfer_tpu.analysis.wedge import (  # noqa: F401
+    DMA_UNROLL_LIMIT,
+    DOT_UNROLL_LIMIT,
+    WedgeLintError,
+    _module_findings,
+    check_module,
+    lint_file,
+    lint_source,
+    lint_tree,
+)
 
 
 def main(argv=None) -> int:
@@ -327,7 +35,7 @@ def main(argv=None) -> int:
         description="lint Pallas kernel sources for chip-wedging patterns")
     p.add_argument("paths", nargs="+")
     args = p.parse_args(argv)
-    findings: List[Finding] = []
+    findings = []
     for path in args.paths:
         findings.extend(
             lint_tree(path) if os.path.isdir(path) else lint_file(path))

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Pre-commit entry point for the static analyzer.
+
+Runs `python -m flashinfer_tpu.analysis` over the repository's package
+tree (plus any extra paths given), against the committed baseline.
+Exit 1 means findings a commit would introduce — fix, suppress with a
+reason, or triage into the baseline (docs/static_analysis.md).
+
+Usage:
+    python scripts/graft_lint.py [extra paths...]
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    # keep this CPU-only and jit-free regardless of the host
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from flashinfer_tpu.analysis import main
+
+    # the package tree is ALWAYS linted; extra argv paths add to it
+    # (docstring contract: "plus any extra paths given")
+    paths = [os.path.join(REPO_ROOT, "flashinfer_tpu")] + sys.argv[1:]
+    raise SystemExit(main(paths))

@@ -1,0 +1,721 @@
+"""Multi-pass static analyzer (flashinfer_tpu.analysis).
+
+Each pass must flag the EXACT pre-fix ADVICE.md round-5 bug shape it
+was built from (true positive), honor reasoned ``# graft-lint: ok``
+suppressions (rejecting reasonless ones as L000), and stay quiet on the
+fixed/clean shape.  The whole-tree run over ``flashinfer_tpu/`` against
+the committed baseline is the tier-1 CI gate: new findings fail the
+suite at review time, not at the next advisor round.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from flashinfer_tpu import analysis
+from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
+                                     signature_parity)
+from flashinfer_tpu.analysis.core import Project, load_source
+
+PKG_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "flashinfer_tpu"))
+
+
+def _project(*named_sources):
+    return Project([load_source(textwrap.dedent(src), name)
+                    for name, src in named_sources])
+
+
+# ---------------------------------------------------------------- L001 --
+
+# the ADVICE.md round-5 item-1 shape: the paged base wrapper binds
+# `forward = run` at class-definition time; subclasses redefine run
+PRE_FIX_ALIAS = """
+    class BasePagedWrapper:
+        def run(self, q, kv):
+            return "base"
+        forward = run
+
+    class SinkWrapper(BasePagedWrapper):
+        def run(self, q, kv):
+            return "base+sink-epilogue"
+"""
+
+POST_FIX_ALIAS = PRE_FIX_ALIAS + """\
+        forward = run
+"""
+
+
+def test_l001_flags_pre_fix_sink_wrapper_shape():
+    findings = alias_rebind.run(_project(("attention.py", PRE_FIX_ALIAS)))
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f.code == "L001" and f.func == "SinkWrapper.run"
+    assert "forward = run" in f.message and "SinkWrapper" in f.message
+    # the runtime truth the lint models: the inherited alias really does
+    # call the BASE run
+    ns = {}
+    exec(textwrap.dedent(PRE_FIX_ALIAS), ns)
+    assert ns["SinkWrapper"]().forward(0, 0) == "base"  # the silent bug
+
+
+def test_l001_rebind_fix_is_clean():
+    findings = alias_rebind.run(_project(("attention.py", POST_FIX_ALIAS)))
+    assert findings == [], findings
+    ns = {}
+    exec(textwrap.dedent(POST_FIX_ALIAS), ns)
+    assert ns["SinkWrapper"]().forward(0, 0) == "base+sink-epilogue"
+
+
+def test_l001_resolves_bases_across_files():
+    """The real bug spanned prefill.py (alias) and attention.py
+    (subclass) — the pass must resolve inheritance project-wide."""
+    base = """
+        class BasePagedWrapper:
+            def run(self, q, kv):
+                return "base"
+            forward = run
+    """
+    sub = """
+        class BatchAttention(BasePagedWrapper):
+            def run(self, q, kv):
+                return "holistic"
+    """
+    findings = alias_rebind.run(
+        _project(("prefill.py", base), ("attention.py", sub)))
+    assert [f.code for f in findings] == ["L001"]
+    assert findings[0].filename == "attention.py"
+
+
+def test_l001_grandchild_inheriting_redefined_run_flagged():
+    """'inheriting a redefined run': the grandchild's forward skips the
+    override it actually inherits, even though it defines nothing."""
+    src = PRE_FIX_ALIAS + """
+    class DerivedOfSink(SinkWrapper):
+        pass
+    """
+    findings = alias_rebind.run(_project(("a.py", src)))
+    assert {f.func for f in findings} == {"SinkWrapper.run",
+                                          "DerivedOfSink"}
+
+
+def test_l001_alias_above_def_in_same_class_flagged():
+    src = """
+        class Base:
+            def run(self):
+                return "base"
+
+        class Sub(Base):
+            forward = run_alias_target  # placeholder, replaced below
+            def run(self):
+                return "sub"
+    """.replace("run_alias_target", "run")
+    # `forward = run` above the def binds the INHERITED run... but only
+    # resolves at class creation because Base.run exists in scope? No:
+    # a bare `run` in a class body only sees names already bound in
+    # that body — this exact source raises NameError at runtime, which
+    # is the loud variant.  The lint flags the shape statically.
+    findings = alias_rebind.run(_project(("a.py", src)))
+    assert [f.code for f in findings] == ["L001"]
+    assert "ABOVE" in findings[0].message
+
+
+def test_l001_suppression_honored_and_reasonless_is_l000():
+    suppressed = PRE_FIX_ALIAS.replace(
+        'def run(self, q, kv):\n            return "base+sink-epilogue"',
+        'def run(self, q, kv):  # graft-lint: ok forward overridden in '
+        'every leaf\n            return "base+sink-epilogue"')
+    assert suppressed != PRE_FIX_ALIAS
+    findings = analysis.analyze_project(
+        _project(("attention.py", suppressed)), bank={})
+    assert [f.code for f in findings] == [], findings
+    reasonless = suppressed.replace(
+        "# graft-lint: ok forward overridden in every leaf",
+        "# graft-lint: ok")
+    findings = analysis.analyze_project(
+        _project(("attention.py", reasonless)), bank={})
+    assert [f.code for f in findings] == ["L000"], findings
+
+
+def test_l001_real_attention_py_is_clean_post_fix():
+    """The shipped fix: BatchAttention / POD / the sink wrapper all
+    rebind `forward = run`; the pass agrees across the real files."""
+    project = Project.from_paths([
+        os.path.join(PKG_ROOT, "prefill.py"),
+        os.path.join(PKG_ROOT, "attention.py"),
+        os.path.join(PKG_ROOT, "sparse.py"),
+        os.path.join(PKG_ROOT, "decode.py"),
+        os.path.join(PKG_ROOT, "mla.py"),
+    ])
+    assert alias_rebind.run(project) == []
+
+
+def test_forward_dispatches_to_subclass_run():
+    """Runtime regression for the satellite fix itself: forward() on
+    every attention.py wrapper dispatches to the SUBCLASS run and
+    honors its return contract (ADVICE.md item 1)."""
+    import flashinfer_tpu as fi
+
+    assert fi.BatchAttention.forward \
+        is fi.BatchAttention.run
+    assert fi.PODWithPagedKVCacheWrapper.forward \
+        is fi.PODWithPagedKVCacheWrapper.run
+    assert fi.BatchAttentionWithAttentionSinkWrapper.forward \
+        is fi.BatchAttentionWithAttentionSinkWrapper.run
+    # and none of them inherited the base paged wrapper's bound alias
+    base = fi.BatchPrefillWithPagedKVCacheWrapper
+    for cls in (fi.BatchAttention, fi.PODWithPagedKVCacheWrapper,
+                fi.BatchAttentionWithAttentionSinkWrapper):
+        assert cls.forward is not base.run
+
+
+# ---------------------------------------------------------------- L002 --
+
+# the ADVICE.md round-5 item-2 shape: window_left inserted positionally
+# between logits_soft_cap and q_data_type
+PRE_FIX_PLAN = """
+    class BatchAttention:
+        def plan(self, qo_indptr, kv_indptr, kv_indices, kv_len_arr,
+                 num_qo_heads, num_kv_heads, head_dim_qk, head_dim_vo,
+                 page_size, causal=False, sm_scale=None,
+                 logits_soft_cap=None, window_left=-1,
+                 q_data_type=None, kv_data_type=None,
+                 use_profiler=False):
+            pass
+
+        def run(self, q, paged_kv_cache, out=None, lse=None,
+                k_scale=None, v_scale=None, logits_soft_cap=0.0,
+                profiler_buffer=None, **kw):
+            pass
+"""
+
+POST_FIX_PLAN = PRE_FIX_PLAN.replace(
+    "logits_soft_cap=None, window_left=-1,",
+    "logits_soft_cap=None, *, window_left=-1,")
+
+
+def test_l002_flags_pre_fix_window_left_insertion():
+    findings = signature_parity.run(
+        _project(("flashinfer_tpu/attention.py", PRE_FIX_PLAN)))
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f.code == "L002"
+    assert "window_left" in f.message and "q_data_type" in f.message
+
+
+def test_l002_keyword_only_fix_is_clean():
+    assert POST_FIX_PLAN != PRE_FIX_PLAN
+    findings = signature_parity.run(
+        _project(("flashinfer_tpu/attention.py", POST_FIX_PLAN)))
+    assert findings == [], findings
+
+
+def test_l002_extra_trailing_positional_flagged():
+    src = POST_FIX_PLAN.replace("use_profiler=False):",
+                                "use_profiler=False, extra_knob=None):")
+    # keyword-only extras are fine ...
+    assert signature_parity.run(_project(("flashinfer_tpu/attention.py", src))) == []
+    src = PRE_FIX_PLAN.replace(
+        "logits_soft_cap=None, window_left=-1,\n"
+        "                 q_data_type=None, kv_data_type=None,\n"
+        "                 use_profiler=False):",
+        "logits_soft_cap=None, q_data_type=None, kv_data_type=None,\n"
+        "                 use_profiler=False, extra_knob=None):")
+    findings = signature_parity.run(_project(("flashinfer_tpu/attention.py", src)))
+    # ... positional ones beyond the reference arity are not
+    assert [f.code for f in findings] == ["L002"], findings
+    assert "extra_knob" in findings[0].message
+
+
+def test_l002_vararg_voids_loud_overflow_and_is_flagged():
+    """`*args` after a matching prefix swallows a reference caller's
+    extra positionals with no error — worse than either a misbind
+    (caught above) or a TypeError (the accepted fix); must flag."""
+    src = POST_FIX_PLAN.replace(
+        "def run(self, q, paged_kv_cache, out=None, lse=None,",
+        "def run(self, q, paged_kv_cache, *args, out=None, lse=None,")
+    assert "*args" in src
+    findings = signature_parity.run(
+        _project(("flashinfer_tpu/attention.py", src)))
+    assert [f.code for f in findings] == ["L002"], findings
+    assert "*args" in findings[0].message
+
+
+def test_l002_stale_bank_symbol_is_reported():
+    """Renaming a banked method must surface, not silently drop its
+    parity protection: the file matches but the qualname is gone."""
+    src = POST_FIX_PLAN.replace("def run(", "def execute(")
+    assert "def execute(" in src
+    findings = signature_parity.run(
+        _project(("flashinfer_tpu/attention.py", src)))
+    assert len(findings) == 1, findings
+    assert findings[0].code == "L002"
+    assert "not found" in findings[0].message
+    assert "BatchAttention.run" in findings[0].func
+
+
+def test_l002_real_tree_matches_bank():
+    """Every recorded symbol in the shipped signature bank matches the
+    shipped implementation — the window_left/kv_cache_sf fixes hold."""
+    project = Project.from_paths([PKG_ROOT])
+    assert signature_parity.run(project) == []
+
+
+def test_l002_bank_symbols_exist_in_tree():
+    """A renamed/deleted method must not silently drop out of parity
+    checking: every bank key resolves at its EXACT project-relative
+    path in the real tree (a same-basename file elsewhere — e.g.
+    parallel/attention.py — must not satisfy the check)."""
+    from flashinfer_tpu.analysis.core import project_relpath
+
+    bank = signature_parity.load_bank()
+    project = Project.from_paths([PKG_ROOT])
+    by_path = {}
+    for sf in project.files:
+        by_path[project_relpath(sf.path)] = \
+            signature_parity._qualname_defs(sf)
+    for key in bank:
+        path, _, qualname = key.partition(":")
+        assert qualname in by_path.get(path, {}), \
+            f"bank symbol {key} not found — update the bank or the code"
+
+
+def test_batch_attention_plan_rejects_positional_window_left():
+    """Runtime regression for the satellite fix: the verbatim reference
+    positional call shape (dtypes after logits_soft_cap) now fails
+    LOUDLY instead of binding a dtype into window_left."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flashinfer_tpu as fi
+
+    w = fi.BatchAttention()
+    qo = np.array([0, 1], np.int32)
+    kvp = np.array([0, 1], np.int32)
+    kvi = np.array([0], np.int32)
+    kvl = np.array([1], np.int32)
+    with pytest.raises(TypeError):
+        # 13th positional is the reference's q_data_type slot — the
+        # pre-fix signature bound it into window_left silently
+        w.plan(qo, kvp, kvi, kvl, 1, 1, 128, 128, 1, False, None, None,
+               jnp.bfloat16)
+    # keyword form still works and window_left stays an int
+    w.plan(qo, kvp, kvi, kvl, 1, 1, 128, 128, 1, causal=False,
+           q_data_type=jnp.bfloat16, window_left=-1)
+
+
+def test_batch_attention_failed_replan_keeps_soft_cap_in_sync(monkeypatch):
+    """A re-plan that fails INSIDE the base planner must not desync the
+    logits_soft_cap run() validates against from the still-active
+    previous plan (else a run passing the live plan's cap raises and a
+    run passing the dead plan's cap is accepted silently)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flashinfer_tpu as fi
+
+    w = fi.BatchAttention()
+    qo = np.array([0, 1], np.int32)
+    kvp = np.array([0, 1], np.int32)
+    kvi = np.array([0], np.int32)
+    kvl = np.array([1], np.int32)
+    w.plan(qo, kvp, kvi, kvl, 1, 1, 128, 128, 1, causal=False,
+           logits_soft_cap=30.0, q_data_type=jnp.bfloat16)
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("planner failure mid-replan")
+
+    monkeypatch.setattr(
+        fi.BatchPrefillWithPagedKVCacheWrapper, "plan", boom)
+    with pytest.raises(RuntimeError):
+        w.plan(qo, kvp, kvi, kvl, 1, 1, 128, 128, 1, causal=False,
+               logits_soft_cap=50.0, q_data_type=jnp.bfloat16)
+    assert w._plan_soft_cap == 30.0  # still the live plan's cap
+
+
+# ---------------------------------------------------------------- L003 --
+
+# the ADVICE.md round-5 item-4 shape: a jitted helper with `backend`
+# static reaches an env read through the resolver chain
+PRE_FIX_TOPK = """
+    import functools
+    import os
+
+    import jax
+
+    def _resolve_backend(backend):
+        if backend == "auto":
+            backend = os.environ.get("TOPK_BACKEND", "xla")
+        return backend
+
+    def top_k_values_indices(scores, k, backend="auto"):
+        if _resolve_backend(backend) == "threshold":
+            return "threshold", None
+        return "xla", None
+
+    @functools.partial(jax.jit, static_argnames=("k", "backend"))
+    def _top_k_large_ties(scores, k, backend):
+        return top_k_values_indices(scores, k, backend)
+"""
+
+
+def test_l003_flags_pre_fix_backend_pinning():
+    findings = jit_staticness.run(_project(("compat.py", PRE_FIX_TOPK)))
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f.code == "L003" and f.func == "_top_k_large_ties"
+    assert "top_k_values_indices" in f.message
+
+
+def test_l003_direct_env_read_in_jitted_function():
+    src = """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            if os.environ.get("FLAG", "0") == "1":
+                return x + 1
+            return x
+
+        def eager(x):
+            return os.environ.get("FLAG")  # not jitted: fine
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert [f.func for f in findings] == ["f"]
+    assert "trace time" in findings[0].message
+
+
+def test_l003_jit_wrapped_assignment_form():
+    src = """
+        import os
+        import jax
+
+        def g(x):
+            return os.getenv("FLAG")
+
+        g_fast = jax.jit(g)
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert [f.func for f in findings] == ["g"]
+
+
+def test_l003_mutated_global_read_flagged_constant_exempt():
+    src = """
+        import jax
+
+        _CACHE = {}
+        _TABLE = {"a": 1}  # never mutated: a constant, exempt
+
+        def warm(k, v):
+            _CACHE[k] = v
+
+        @jax.jit
+        def f(x):
+            return _CACHE.get("cfg", 0) + _TABLE["a"] + x
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert len(findings) == 1, findings
+    assert "_CACHE" in findings[0].message
+
+
+def test_l003_mutated_global_taint_propagates_through_calls():
+    """A mutated-global read one call deep must taint the jitted
+    caller, same as an env read (the config-pinned-in-jit-cache class
+    the pass documents)."""
+    src = """
+        import jax
+
+        _CACHE = {}
+
+        def warm(k, v):
+            _CACHE[k] = v
+
+        def get_cfg():
+            return _CACHE.get("cfg", 0)
+
+        @jax.jit
+        def f(x):
+            return get_cfg() + x
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert [f.func for f in findings] == ["f"], findings
+    assert "get_cfg" in findings[0].message
+
+
+def test_l003_composed_jit_wrap_marks_inner_callable():
+    """The repo's dominant launch shape — jax.jit(shard_map(step, ...))
+    — must mark `step` as jitted; the step closures of every sharded
+    model are exactly this population."""
+    src = """
+        import os
+        import jax
+
+        def make(mesh, specs):
+            def step(params, x):
+                if os.environ.get("FLAG"):
+                    return x
+                return x + 1
+            return jax.jit(jax_shard_map(step, mesh=mesh, **specs))
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert [f.func for f in findings] == ["step"], findings
+
+
+def test_l003_data_args_of_composed_jit_wrap_not_marked():
+    """Only the traced callable chain (first positional arg at each
+    level) is jit-marked — a config/callback operand sharing a module
+    function's name must not be reported as jit-traced."""
+    src = """
+        import os
+        import jax
+        import functools
+
+        def post_fn(x):  # env-reading module function...
+            return os.getenv("FLAG")
+
+        def step(params, x):
+            return x
+
+        def make(wrap, cfg):
+            # ...passed as DATA here, never traced
+            return jax.jit(wrap(step, post_fn))
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert findings == [], findings
+
+
+def test_project_relpath_rightmost_marker_wins():
+    """A checkout directory named flashinfer_tpu must not hijack the
+    key of a tests/ file nested inside it."""
+    from flashinfer_tpu.analysis.core import project_relpath
+
+    assert project_relpath(
+        "/home/u/flashinfer_tpu/tests/test_x.py") == "tests/test_x.py"
+    assert project_relpath(
+        "/home/u/flashinfer_tpu/flashinfer_tpu/ops/k.py"
+    ) == "flashinfer_tpu/ops/k.py"
+
+
+def test_l003_external_library_namesakes_not_tainted():
+    """jax.lax.top_k must not inherit taint from a project function
+    that happens to be called top_k (the basename-collision FP)."""
+    src = """
+        import os
+        import jax
+
+        def top_k(scores, k):  # project top_k: reads env
+            os.environ.get("BACKEND")
+
+        @jax.jit
+        def router(logits, k):
+            return jax.lax.top_k(logits, k)  # external: clean
+    """
+    findings = jit_staticness.run(_project(("m.py", src)))
+    assert findings == [], findings
+
+
+def test_l003_eager_resolution_plus_suppression_is_clean():
+    """The shipped fix shape: top_k resolves the backend eagerly and the
+    jitted helper carries a reasoned suppression for the now-dead
+    transitive edge."""
+    fixed = PRE_FIX_TOPK.replace(
+        "        return top_k_values_indices(scores, k, backend)",
+        "        # graft-lint: ok backend pre-resolved eagerly, never auto\n"
+        "        return top_k_values_indices(scores, k, backend)")
+    assert fixed != PRE_FIX_TOPK
+    findings = analysis.analyze_project(
+        _project(("compat.py", fixed)), bank={})
+    assert findings == [], findings
+
+
+def test_compat_top_k_resolves_backend_eagerly(monkeypatch):
+    """Runtime regression for the satellite fix: with tie_break=LARGE,
+    FLASHINFER_TPU_TOPK_BACKEND is honored per-call — the first call's
+    resolution must NOT be pinned by the jit cache (ADVICE.md item 4)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.compat import TopKTieBreak
+
+    # On this input the backends produce a DIFFERENT output order for
+    # the same top-3 set, so a pinned backend is observable: xla is
+    # value-ordered; threshold emits strict entries in index order of
+    # the column-reversed input ([2,4,1,5] -> 4 before 5).
+    scores = jnp.asarray(np.array([[5.0, 1.0, 4.0, 2.0]], np.float32))
+    monkeypatch.delenv("FLASHINFER_TPU_TOPK_BACKEND", raising=False)
+    v1, i1 = fi.top_k(scores, 3, tie_break=TopKTieBreak.LARGE,
+                      backend="auto")
+    # flip the env var AFTER the first (cached) call — with the bug the
+    # first call's in-trace "auto"->xla resolution is replayed from the
+    # jit cache and the override is silently ignored
+    monkeypatch.setenv("FLASHINFER_TPU_TOPK_BACKEND", "threshold")
+    v2, i2 = fi.top_k(scores, 3, tie_break=TopKTieBreak.LARGE,
+                      backend="auto")
+    assert sorted(np.asarray(i1).ravel().tolist()) \
+        == sorted(np.asarray(i2).ravel().tolist()) == [0, 2, 3]
+    assert np.asarray(i1).ravel().tolist() == [0, 2, 3]  # xla: by value
+    assert np.asarray(v1).ravel().tolist() == [5.0, 4.0, 2.0]
+    assert np.asarray(i2).ravel().tolist() == [2, 0, 3]  # threshold
+    assert np.asarray(v2).ravel().tolist() == [4.0, 5.0, 2.0]
+
+
+# ------------------------------------------------------------- driver --
+
+
+def test_wedge_pass_runs_behind_driver():
+    src = """
+        import jax.numpy as jnp
+
+        def lane_repeat_kernel(x_ref, o_ref):
+            o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)
+    """
+    findings = analysis.analyze_project(_project(("k.py", src)), bank={})
+    assert [f.code for f in findings] == ["W003"]
+
+
+def test_graft_suppression_applies_to_wedge_codes_via_driver():
+    src = """
+        import jax.numpy as jnp
+
+        def lane_repeat_kernel(x_ref, o_ref):
+            # graft-lint: ok expander-dot verified on-chip 2026-07-29
+            o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)
+    """
+    findings = analysis.analyze_project(_project(("k.py", src)), bank={})
+    assert findings == [], findings
+
+
+def test_unparseable_source_is_l999_not_a_crash():
+    findings = analysis.analyze_project(
+        _project(("bad.py", "def broken(:\n")), bank={})
+    assert [f.code for f in findings] == ["L999"]
+
+
+def test_whole_tree_findings_subset_of_committed_baseline():
+    """THE tier-1 CI gate: the shipped tree has no findings beyond the
+    committed, triaged baseline — and the baseline carries no stale
+    entries silently freeing budget for new bugs of the same shape."""
+    findings = analysis.analyze_paths([PKG_ROOT])
+    baseline = analysis.load_baseline()
+    new, old, stale = analysis.partition_against_baseline(
+        findings, baseline)
+    assert new == [], "NEW findings not in baseline (fix or triage " \
+        "into baseline.json):\n" + "\n".join(str(f) for f in new)
+    assert stale == [], f"stale baseline entries to prune: {stale}"
+
+
+def test_cli_clean_against_baseline_and_fails_without():
+    assert analysis.main([PKG_ROOT]) == 0
+    # the baseline is non-empty today, so --no-baseline must fail
+    if analysis.load_baseline():
+        assert analysis.main([PKG_ROOT, "--no-baseline"]) == 1
+
+
+def test_cli_dump_signatures_smoke(capsys):
+    assert analysis.main([PKG_ROOT, "--dump-signatures"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "flashinfer_tpu/attention.py:BatchAttention.plan" in out
+    ref = out["flashinfer_tpu/attention.py:BatchAttention.plan"]
+    assert "window_left" in ref["implementation_kwonly"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = analysis.analyze_paths([PKG_ROOT])
+    path = str(tmp_path / "baseline.json")
+    analysis.write_baseline(findings, path)
+    new, old, stale = analysis.partition_against_baseline(
+        findings, analysis.load_baseline(path))
+    assert new == [] and stale == [] and len(old) == len(findings)
+
+
+def test_runtime_guard_honors_graft_suppressions():
+    """A CI-blessed `# graft-lint: ok <reason>` must also satisfy the
+    RUNTIME compile guard (check_module goes through lint_source): a
+    suppression that passes CI but hard-blocks hardware compiles in
+    strict mode would make the two gates diverge."""
+    from flashinfer_tpu.analysis import wedge
+
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def lane_repeat_kernel(x_ref, o_ref):
+            # graft-lint: ok selector-matmul verified on-chip 2026-07-29
+            o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)
+    """)
+    assert wedge.lint_source(src, "k.py") == []
+    # and reasonless graft form is a W000, exactly like the wedge form
+    bare = src.replace(
+        "# graft-lint: ok selector-matmul verified on-chip 2026-07-29",
+        "# graft-lint: ok")
+    assert [f.code for f in wedge.lint_source(bare, "k.py")] == ["W000"]
+
+
+def test_orphan_reasonless_wedge_suppression_is_w000():
+    """A bare '# wedge-lint: ok' that shields NOTHING is still an
+    unreviewable waiver (it would silently mute the next W-finding on
+    its line) — the driver must report it even though the wedge pass
+    only emits W000 for shielding suppressions."""
+    src = """
+        def plain_helper(x):
+            return x + 1  # wedge-lint: ok
+    """
+    findings = analysis.analyze_project(_project(("m.py", src)), bank={})
+    assert [f.code for f in findings] == ["W000"], findings
+    # a REASONED orphan is fine (same contract as the graft spelling)
+    reasoned = src.replace("# wedge-lint: ok",
+                           "# wedge-lint: ok documented-safe pattern")
+    findings = analysis.analyze_project(
+        _project(("m.py", reasoned)), bank={})
+    assert findings == [], findings
+    # and no double-report when the bare suppression DOES shield a
+    # W-code (the wedge pass's own W000 wins)
+    shielding = """
+        import jax.numpy as jnp
+
+        def lane_repeat_kernel(x_ref, o_ref):
+            o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)  # wedge-lint: ok
+    """
+    findings = analysis.analyze_project(
+        _project(("k.py", shielding)), bank={})
+    assert [f.code for f in findings] == ["W000"], findings
+
+
+def test_write_baseline_refuses_reasonless_suppression_findings(
+        tmp_path, capsys):
+    """--write-baseline must never accept L000/W000: a reasonless
+    waiver is definitionally un-triageable and has to be FIXED, not
+    baselined into permanent silence."""
+    findings = [
+        analysis.Finding("L000", "flashinfer_tpu/x.py", 3,
+                         "<suppression>", "no reason"),
+        analysis.Finding("L003", "flashinfer_tpu/x.py", 9, "f", "env"),
+    ]
+    path = str(tmp_path / "b.json")
+    analysis.write_baseline(findings, path)
+    assert "refusing to baseline" in capsys.readouterr().out
+    loaded = analysis.load_baseline(path)
+    assert ("L003", "flashinfer_tpu/x.py", "f") in loaded
+    assert all(code not in ("L000", "W000") for code, _, _ in loaded)
+    # a hand-edited L000 entry is ignored on load as well
+    data = json.load(open(path))
+    data["findings"].append({"code": "L000", "path": "flashinfer_tpu/x.py",
+                             "func": "<suppression>", "count": 1})
+    json.dump(data, open(path, "w"))
+    assert all(code != "L000" for code, _, _ in analysis.load_baseline(path))
+
+
+def test_wedge_lint_shim_surface():
+    """compile_guard and the historical tests import these names from
+    flashinfer_tpu.wedge_lint — the shim must keep them working."""
+    from flashinfer_tpu import wedge_lint as wl
+    from flashinfer_tpu.analysis import wedge
+
+    assert wl.lint_source is wedge.lint_source
+    assert wl.check_module is wedge.check_module
+    assert wl.WedgeLintError is wedge.WedgeLintError
+    assert wl.Finding is analysis.Finding
+    assert wl.DOT_UNROLL_LIMIT == wedge.DOT_UNROLL_LIMIT
