@@ -58,7 +58,10 @@ PHASE_TIMEOUT_S = {
     "moe_sweep": 2400.0,
     "topk": 1200.0,
     "scans": 1500.0,
-    "serving": 2400.0,
+    # serving includes the phase-decomposition micro-loops (6 extra
+    # guarded first compiles through the tunnel) on top of the slope +
+    # e2e measurements
+    "serving": 3000.0,
     "prefill": 1500.0,
     "prefill_sweep": 2400.0,
     "mla": 1200.0,
@@ -76,8 +79,36 @@ def chip_peak_tbps() -> float:
     return DEFAULT_PEAK
 
 
+_AUDITOR = None
+
+
 def _emit_row(**kw):
-    """Phase-side: one measurement, parseable by the orchestrator."""
+    """Phase-side: one measurement, parseable by the orchestrator.
+
+    Every row passes through the obs quality auditor (self-auditing
+    bench telemetry, VERDICT weak #3): the row's throughput metric is
+    compared against the best banked/run measurement of the SAME
+    configuration and stamped ``quality: ok|degraded|poison`` using the
+    committed ``<0.35x best`` implausibility rule — poison rows are
+    machine-flagged at emit time instead of by manual cross-checking.
+    """
+    global _AUDITOR
+    try:
+        if _AUDITOR is None:
+            from flashinfer_tpu.obs import bench_audit
+
+            _AUDITOR = bench_audit.RowAuditor(
+                bench_audit.load_banked_history(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_BANKED.md")))
+        _AUDITOR.stamp(kw)
+        from flashinfer_tpu import obs
+
+        obs.counter_inc("bench.rows", phase=str(kw.get("phase")),
+                        quality=kw.get("quality", "unknown"))
+    except Exception as e:  # noqa: BLE001 - the audit must never cost a row
+        print(f"# row audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     print("ROW " + json.dumps(kw), flush=True)
 
 
@@ -739,38 +770,55 @@ def phase_serving(sweep: bool):
 
     inv_k, inv_v = 1.0 / kscale, 1.0 / vscale
 
+    from flashinfer_tpu.profiler import scope as _scope
+
     def _layer(x, w, kcl, vcl, lens, pt, append):
         """One decoder layer on the int8 shard pipeline; ``append=True``
         additionally quantizes + scatters the new token's K/V into the
-        paged cache before attention (the real serving write path)."""
+        paged cache before attention (the real serving write path).
+        The named scopes label device traces with the SAME phase names
+        the overhead_decomposition row uses (obs catalog
+        serving.phase_us), so a jax.profiler capture cross-checks the
+        micro-loop numbers."""
         wqkv, sqkv, wo, so, wgu, sgu, wd, sd, n1, n2 = w
-        h = rmsnorm(x, n1.astype(x.dtype))
-        hq8, hs = quantize_int8(h)
-        qkv = mm_int8(hq8, wqkv, hs, sqkv)
-        q = qkv[:, :qdim].reshape(bs, hq, hd)
-        k = qkv[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
-        q, k = apply_rope_pos_ids(q, k, lens)
+        with _scope("serving.norm_rope"):
+            h = rmsnorm(x, n1.astype(x.dtype))
+        with _scope("serving.attention"):
+            hq8, hs = quantize_int8(h)
+            qkv = mm_int8(hq8, wqkv, hs, sqkv)
+            q = qkv[:, :qdim].reshape(bs, hq, hd)
+            k = qkv[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
+        with _scope("serving.norm_rope"):
+            q, k = apply_rope_pos_ids(q, k, lens)
         attn_lens = lens
         if append:
-            v = qkv[:, qdim + kvdim:].reshape(bs, hkv, hd)
-            pages = jnp.take_along_axis(pt, lens[:, None] // PS, axis=1)[:, 0]
-            slots = lens % PS
-            k8 = jnp.clip(jnp.round(k * inv_k), -127, 127).astype(jnp.int8)
-            v8 = jnp.clip(jnp.round(v * inv_v), -127, 127).astype(jnp.int8)
-            kcl = kcl.at[pages, :, slots, :].set(k8)
-            vcl = vcl.at[pages, :, slots, :].set(v8)
+            with _scope("serving.kv_append"):
+                v = qkv[:, qdim + kvdim:].reshape(bs, hkv, hd)
+                pages = jnp.take_along_axis(
+                    pt, lens[:, None] // PS, axis=1)[:, 0]
+                slots = lens % PS
+                k8 = jnp.clip(jnp.round(k * inv_k), -127, 127) \
+                    .astype(jnp.int8)
+                v8 = jnp.clip(jnp.round(v * inv_v), -127, 127) \
+                    .astype(jnp.int8)
+                kcl = kcl.at[pages, :, slots, :].set(k8)
+                vcl = vcl.at[pages, :, slots, :].set(v8)
             attn_lens = lens + 1
-        attn = paged_decode_attention(
-            q.astype(jnp.bfloat16), kcl, vcl, pt, attn_lens,
-            sm_scale=sm * kscale, kv_layout="HND",
-        ) * vscale
-        a8, as_ = quantize_int8(attn.reshape(bs, qdim))
-        x = x + mm_int8(a8, wo, as_, so)
-        h2 = rmsnorm(x, n2.astype(x.dtype))
-        g8, gs = quantize_int8(h2)
-        mlp = silu_and_mul(mm_int8(g8, wgu, gs, sgu))
-        m8, ms = quantize_int8(mlp)
-        return (x + mm_int8(m8, wd, ms, sd)).astype(x.dtype), kcl, vcl
+        with _scope("serving.attention"):
+            attn = paged_decode_attention(
+                q.astype(jnp.bfloat16), kcl, vcl, pt, attn_lens,
+                sm_scale=sm * kscale, kv_layout="HND",
+            ) * vscale
+            a8, as_ = quantize_int8(attn.reshape(bs, qdim))
+            x = x + mm_int8(a8, wo, as_, so)
+        with _scope("serving.norm_rope"):
+            h2 = rmsnorm(x, n2.astype(x.dtype))
+        with _scope("serving.moe_or_mlp"):
+            g8, gs = quantize_int8(h2)
+            mlp = silu_and_mul(mm_int8(g8, wgu, gs, sgu))
+            m8, ms = quantize_int8(mlp)
+            out = (x + mm_int8(m8, wd, ms, sd)).astype(x.dtype)
+        return out, kcl, vcl
 
     def step(x, layers, kc, vc, head, head_s, pt, lens):
         # scan over layers: weights + per-layer caches ride the xs axis
@@ -871,12 +919,125 @@ def phase_serving(sweep: bool):
         ),
     )
     pred = fixed + L * per_layer
+
+    # ---- serving-loop phase decomposition (VERDICT weak #2 + #4): the
+    # 13-31% overhead_vs_slope tax, attributed by inclusion until now,
+    # measured phase by phase.  Each named phase of the decode step runs
+    # as its own jitted micro-loop at the EXACT serving shapes (the same
+    # slope-fit protocol as every bench row); kv_append threads the
+    # caches through a scan carry (bench_steps_device) so the measured
+    # write is the aliased in-place one, not a full-cache-copy artifact.
+    # residual_us = t_e2e - sum(phases): the per-step cost the phases
+    # don't explain — dispatch/scheduling/layer-glue, the number the
+    # decode-step NO-GO (weak #4) leaned on without measuring.
+    from flashinfer_tpu import obs
+
+    kc0, vc0 = caches0[0]
+    wqkv, sqkv, wo, so, wgu, sgu, wd, sd, n1, n2 = layer_ws[0]
+    dkey = jax.random.fold_in(key, 777)
+    qkv_like = jax.random.normal(dkey, (bs, qdim + 2 * kvdim), jnp.bfloat16)
+    logits_like = jax.random.normal(jax.random.fold_in(dkey, 1),
+                                    (bs, vocab_shard), jnp.float32) * 4.0
+
+    def f_norm_rope(x, n1_, n2_, qkv_, lens_):
+        h1 = rmsnorm(x, n1_.astype(x.dtype))
+        h2 = rmsnorm(x, n2_.astype(x.dtype))
+        q = qkv_[:, :qdim].reshape(bs, hq, hd)
+        k = qkv_[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
+        q, k = apply_rope_pos_ids(q, k, lens_)
+        return h1 + h2, q, k
+
+    def f_attention(x, wqkv_, sqkv_, wo_, so_, kcl, vcl, pt_, lens_):
+        # the attention block incl. its qkv/o int8 projections (rmsnorm
+        # and rope live in norm_rope; quantize rides the gemm using it)
+        h8, hs = quantize_int8(x)
+        qkv = mm_int8(h8, wqkv_, hs, sqkv_)
+        q = qkv[:, :qdim].reshape(bs, hq, hd)
+        attn = paged_decode_attention(
+            q.astype(jnp.bfloat16), kcl, vcl, pt_, lens_,
+            sm_scale=sm * kscale, kv_layout="HND",
+        ) * vscale
+        a8, as_ = quantize_int8(attn.reshape(bs, qdim))
+        return mm_int8(a8, wo_, as_, so_)
+
+    def f_mlp(x, wgu_, sgu_, wd_, sd_):
+        g8, gs = quantize_int8(x)
+        mlp = silu_and_mul(mm_int8(g8, wgu_, gs, sgu_))
+        m8, ms = quantize_int8(mlp)
+        return mm_int8(m8, wd_, ms, sd_)
+
+    def f_lm_head(x, head_, head_s_):
+        h8, hs = quantize_int8(rmsnorm(x, jnp.ones((hidden,), x.dtype)))
+        return mm_int8(h8, head_, hs, head_s_, out_dtype=jnp.float32)
+
+    def f_sampling(logits, skey):
+        return sampling_from_logits(top_k_mask_logits(logits, 40), skey)
+
+    def make_append_loop(n):
+        @jax.jit
+        def loop(qkv_, kcl, vcl, pt_, lens_):
+            def body(carry, _):
+                kcl_, vcl_ = carry
+                k = qkv_[:, qdim:qdim + kvdim].reshape(bs, hkv, hd)
+                v = qkv_[:, qdim + kvdim:].reshape(bs, hkv, hd)
+                pages = jnp.take_along_axis(
+                    pt_, lens_[:, None] // PS, axis=1)[:, 0]
+                slots = lens_ % PS
+                k8 = jnp.clip(jnp.round(k.astype(jnp.float32) * inv_k),
+                              -127, 127).astype(jnp.int8)
+                v8 = jnp.clip(jnp.round(v.astype(jnp.float32) * inv_v),
+                              -127, 127).astype(jnp.int8)
+                kcl_ = kcl_.at[pages, :, slots, :].set(k8)
+                vcl_ = vcl_.at[pages, :, slots, :].set(v8)
+                return (kcl_, vcl_), jnp.float32(0.0)
+
+            (kcl, vcl), _ = jax.lax.scan(body, (kcl, vcl), None, length=n)
+            return (jnp.sum(kcl.astype(jnp.float32))
+                    + jnp.sum(vcl.astype(jnp.float32))) * 1e-30
+        return loop
+
+    phase_benches = (
+        ("norm_rope", L, lambda: bench_fn_device(
+            f_norm_rope, x0, n1, n2, qkv_like, lens, repeats=2)),
+        ("attention", L, lambda: bench_fn_device(
+            f_attention, x0, wqkv, sqkv, wo, so, kc0, vc0, pt, lens,
+            repeats=2)),
+        ("kv_append", L, lambda: bench_steps_device(
+            make_append_loop, qkv_like, kc0, vc0, pt, lens, repeats=2)),
+        ("moe_or_mlp", L, lambda: bench_fn_device(
+            f_mlp, x0, wgu, sgu, wd, sd, repeats=2)),
+        ("lm_head", 1, lambda: bench_fn_device(
+            f_lm_head, x0, head, head_s, repeats=2)),
+        ("sampling", 1, lambda: bench_fn_device(
+            f_sampling, logits_like, jax.random.PRNGKey(5), repeats=2)),
+    )
+    decomp = {}
+    for pname, mult, thunk in phase_benches:
+        t = _guard_soft(f"bench.serving.decomp_{pname}",
+                        (bs, ctx, L, hidden, pname), thunk)
+        decomp[pname + "_us"] = (None if t is None
+                                 else round(mult * t * 1e6, 2))
+        if t is not None:
+            obs.observe("serving.phase_us", mult * t * 1e6, phase=pname)
+            print(f"# serving decomp {pname}: {mult * t * 1e6:9.1f} us/step",
+                  file=sys.stderr)
+        else:
+            print(f"# serving decomp {pname}: FAILED", file=sys.stderr)
+    parts = [v for v in decomp.values() if v is not None]
+    decomp["residual_us"] = (
+        round(t_e2e * 1e6 - sum(parts), 2)
+        if len(parts) == len(phase_benches) else None)
+    if decomp["residual_us"] is not None:
+        obs.observe("serving.phase_us", max(decomp["residual_us"], 0.0),
+                    phase="residual")
+
     _emit_row(phase="serving", model="llama70b_tp8shard_int8",
               mode="e2e_measured", bs=bs, ctx=ctx,
               layers=L, us_step=round(t_e2e * 1e6, 1),
               tok_s_at_depth=round(bs / t_e2e, 1),
               slope_pred_us=round(pred * 1e6, 1),
               overhead_vs_slope=round(t_e2e / max(pred, 1e-9), 3),
+              overhead_decomposition=decomp,
               extrapolated=False,
               includes=["kv_append", "sampling"])
     print(f"# serving e2e L={L}: {t_e2e*1e6:.1f} us/step measured "

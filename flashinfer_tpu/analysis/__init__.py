@@ -21,6 +21,9 @@ L003  jit_staticness         env/mutable-global reads pinned at
 L004  wedge                  the original wedge lint (W000–W004), now a
                              pass behind this driver; ``wedge_lint.py``
                              remains as a compat shim
+L005  obs_coverage           ``@flashinfer_api`` ops missing from the
+                             obs metric catalog (public ops shipping
+                             unobserved — ISSUE 2 satellite)
 ====  =====================  ==========================================
 
 CLI::
@@ -44,7 +47,7 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
-                                     signature_parity, wedge)
+                                     obs_coverage, signature_parity, wedge)
 from flashinfer_tpu.analysis.core import (Finding, Project,  # noqa: F401
                                           SourceFile, load_file,
                                           load_source, project_relpath)
@@ -55,7 +58,8 @@ __all__ = [
     "DEFAULT_BASELINE_PATH", "PASSES",
 ]
 
-PASSES = (alias_rebind, signature_parity, jit_staticness, wedge)
+PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
+          obs_coverage)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")

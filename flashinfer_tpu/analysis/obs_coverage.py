@@ -1,0 +1,101 @@
+"""L005: every ``@flashinfer_api`` op must be in the obs metric catalog.
+
+The obs layer's per-op metrics (``api.calls{op=}``, ``api.dispatch_us``)
+are only as complete as the catalog that documents them: a new public
+op decorated with ``@flashinfer_api`` but missing from
+``flashinfer_tpu.obs.catalog.API_OPS`` would emit metrics nobody
+documented, dashboarded, or audited — the "ships unobserved" failure
+mode ISSUE 2's satellite list names.  This pass closes the loop
+statically: the decorated surface and the catalog must agree.
+
+Flags:
+
+- a decorated function whose op name (the ``name=`` kwarg literal, or
+  the function's qualname) is absent from ``API_OPS``;
+- a decorated function whose ``name=`` is a non-literal expression —
+  unverifiable statically, so it must be a literal.
+
+Suppression: ``# graft-lint: ok <reason>`` on the ``def`` line (e.g.
+for an intentionally-internal decorated helper).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List, Optional
+
+from flashinfer_tpu.analysis.core import Finding, Project
+
+CODE = "L005"
+
+
+def _decorator_is_api(dec: ast.expr) -> Optional[ast.Call]:
+    """The ``flashinfer_api`` decorator node, bare or called form;
+    returns the Call node (or a sentinel None-args marker) when it IS
+    the decorator, else None-ish False."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = (target.attr if isinstance(target, ast.Attribute)
+            else target.id if isinstance(target, ast.Name) else None)
+    if name != "flashinfer_api":
+        return None
+    return dec if isinstance(dec, ast.Call) else ast.Call(
+        func=target, args=[], keywords=[])
+
+
+def _catalog_ops() -> FrozenSet[str]:
+    from flashinfer_tpu.obs.catalog import API_OPS
+
+    return API_OPS
+
+
+def run(project: Project,
+        ops: Optional[FrozenSet[str]] = None) -> List[Finding]:
+    if ops is None:
+        ops = _catalog_ops()
+    findings: List[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, prefix + child.name + ".")
+                    continue
+                if not isinstance(child,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qual = prefix + child.name
+                for dec in child.decorator_list:
+                    call = _decorator_is_api(dec)
+                    if call is None:
+                        continue
+                    op = qual
+                    dynamic = False
+                    for kw in call.keywords:
+                        if kw.arg == "name":
+                            if isinstance(kw.value, ast.Constant) and \
+                                    isinstance(kw.value.value, str):
+                                op = kw.value.value
+                            else:
+                                dynamic = True
+                    if dynamic:
+                        findings.append(Finding(
+                            CODE, sf.path, child.lineno, qual,
+                            "@flashinfer_api name= is not a string "
+                            "literal — the obs catalog check needs a "
+                            "static op name"))
+                    elif op not in ops:
+                        findings.append(Finding(
+                            CODE, sf.path, child.lineno, qual,
+                            f"public op {op!r} is decorated with "
+                            "@flashinfer_api but absent from "
+                            "flashinfer_tpu.obs.catalog.API_OPS — add "
+                            "it to the catalog (and to docs/"
+                            "observability.md) so it cannot ship "
+                            "unobserved"))
+                # nested defs can also be decorated (factory-built APIs)
+                visit(child, qual + ".")
+
+        visit(sf.tree, "")
+    return findings

@@ -1,18 +1,30 @@
-"""API-call logging/tracing decorator.
+"""API-call logging/tracing/metrics decorator.
 
 TPU re-design of the reference's ``@flashinfer_api``
 (``flashinfer/api_logging.py:34-90``): leveled logging driven by
-``FLASHINFER_TPU_LOGLEVEL`` (0 = off — zero overhead, the decorator is a
-pass-through; 1+ = call names; 3+ = arg/shape/dtype summaries; 10 = full
-tensor dumps to ``FLASHINFER_TPU_DUMP_DIR`` as .npy).  The reference's
-CUDAGraph-awareness is unnecessary (nothing mutates under trace); dumps
-use host transfers and are for debugging only.
+``FLASHINFER_TPU_LOGLEVEL`` (0 = off; 1+ = call names; 3+ = arg/shape/
+dtype summaries; 10 = full tensor dumps to ``FLASHINFER_TPU_DUMP_DIR``
+as .npy), plus the trace-capture/substitution hooks
+(``FLASHINFER_TPU_TRACE_*``, flashinfer_tpu.trace), the op timeline
+(flashinfer_tpu.profiler), and the obs metrics registry
+(``FLASHINFER_TPU_METRICS``: per-op call counters + host-dispatch
+histograms — flashinfer_tpu.obs).  The reference's CUDAGraph-awareness
+is unnecessary (nothing mutates under trace); dumps use host transfers
+and are for debugging only.
+
+Zero-overhead contract: with every surface disabled (the default env),
+a decorated call is ONE :func:`_instrumentation_active` check and then
+the plain function call — the shape
+``tests/test_obs.py::test_zero_overhead_fast_path`` pins so the
+disabled path can never quietly grow per-call work.  The call index in
+log lines comes from the registry's ``api.calls_total`` counter (the
+successor of the ad-hoc module ``_call_counter``), so log indexes and
+metrics share one counting authority.
 """
 
 from __future__ import annotations
 
 import functools
-import itertools
 import logging
 import os
 import time
@@ -21,7 +33,6 @@ from typing import Any, Callable
 from flashinfer_tpu import env
 
 logger = logging.getLogger("flashinfer_tpu")
-_call_counter = itertools.count()
 
 
 def _summarize(x: Any) -> str:
@@ -75,68 +86,114 @@ def _dump(name: str, idx: int, args, kwargs) -> None:
     (d / "meta.json").write_text(json.dumps(meta))
 
 
+def _instrumentation_active() -> bool:
+    """THE fast-path branch: True iff any observability surface is on.
+    Kept as one function so the disabled path is a single call site
+    (pinned by the zero-overhead regression test) and new surfaces must
+    register here rather than adding branches to the wrapper."""
+    if env.log_level() > 0:
+        return True
+    from flashinfer_tpu import profiler as _prof
+
+    if _prof.timeline_active():
+        return True
+    from flashinfer_tpu import trace as _trace
+
+    if _trace._trace_enabled() or _trace._apply_enabled():
+        return True
+    from flashinfer_tpu.obs.registry import metrics_enabled
+
+    return metrics_enabled()
+
+
+def _instrumented_call(f: Callable, api_name: str, args, kwargs):
+    """The slow path: metrics, trace hooks, leveled logging, timeline.
+
+    Ordering contract (unchanged from the pre-obs design):
+    - the timeline span and the dispatch histogram cover the WHOLE
+      dispatch including any trace-apply substitution, so a profiled
+      run measures the SAME configuration production executes;
+    - substituted calls are not log-line'd or dumped (they are counted:
+      ``trace.solution_hits``).
+    """
+    from flashinfer_tpu import profiler as _prof
+    from flashinfer_tpu import trace as _trace
+    from flashinfer_tpu.obs import registry as _registry
+
+    level = env.log_level()
+    metrics_on = _registry.metrics_enabled()
+    reg = _registry.get() if (metrics_on or level > 0) else None
+
+    idx = reg.counter_inc("api.calls_total") if reg is not None else 0
+    if metrics_on:
+        reg.counter_inc("api.calls", op=api_name)
+
+    target, substituted = f, False
+    if _trace._trace_enabled() or _trace._apply_enabled():
+        t_axes = _trace._axes_of(args, kwargs)
+        if _trace._trace_enabled():
+            _trace._dump_trace(api_name, t_axes)
+        if _trace._apply_enabled():
+            sub = _trace._find_solution(api_name, t_axes)
+            if metrics_on:
+                reg.counter_inc(
+                    "trace.solution_hits" if sub is not None
+                    else "trace.solution_misses", op=api_name)
+            if sub is not None:
+                target, substituted = sub, True
+
+    if not substituted and level >= 1:
+        if level >= 3:
+            arg_s = ", ".join(_summarize(a) for a in args)
+            kw_s = ", ".join(f"{k}={_summarize(v)}" for k, v in kwargs.items())
+            logger.info("[%d] %s(%s%s%s)", idx, api_name, arg_s,
+                        ", " if kw_s and arg_s else "", kw_s)
+        else:
+            logger.info("[%d] %s", idx, api_name)
+        if level >= 10:
+            _dump(api_name, idx, args, kwargs)
+
+    timeline_on = _prof.timeline_active()
+    t0 = time.perf_counter()
+    out = target(*args, **kwargs)
+    t_host = time.perf_counter()
+    if metrics_on:
+        # host dispatch cost: wrapper entry to op return, no device sync
+        reg.observe("api.dispatch_us", (t_host - t0) * 1e6, op=api_name)
+    if timeline_on:
+        if os.environ.get("FLASHINFER_TPU_TIMELINE_SYNC") == "1":
+            import jax
+
+            jax.block_until_ready(out)
+        _prof.record_event(api_name, t0, time.perf_counter())
+    if not substituted and level >= 5:
+        logger.info(
+            "[%d] %s done in %.3f ms (host)", idx, api_name,
+            (t_host - t0) * 1e3,
+        )
+    return out
+
+
 def flashinfer_api(fn: Callable = None, *, name: str = None) -> Callable:
-    """Decorator adding leveled call logging + trace-capture/substitution
-    hooks to a public API function (the trace hooks are flashinfer_tpu.trace's
-    FLASHINFER_TPU_TRACE_DUMP / FLASHINFER_TPU_TRACE_APPLY surface)."""
+    """Decorator adding leveled call logging, obs metrics, op-timeline
+    recording, and trace-capture/substitution hooks to a public API
+    function (the trace hooks are flashinfer_tpu.trace's
+    FLASHINFER_TPU_TRACE_DUMP / FLASHINFER_TPU_TRACE_APPLY surface).
+
+    The op name (``name`` or the function's qualname) must be listed in
+    ``flashinfer_tpu.obs.catalog.API_OPS`` — the L005 analysis pass
+    enforces it, so every public op ships observed."""
 
     def deco(f):
         api_name = name or f.__qualname__
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
-            from flashinfer_tpu import profiler as _prof
+            if _instrumentation_active():
+                return _instrumented_call(f, api_name, args, kwargs)
+            return f(*args, **kwargs)
 
-            # timeline recording wraps the whole wrapper (including any
-            # trace-apply substitution) so the profiled run executes the
-            # SAME configuration as production, not a bypassed one
-            if _prof._timeline_events is not None:
-                t0 = time.perf_counter()
-                out = _dispatch(*args, **kwargs)
-                if os.environ.get("FLASHINFER_TPU_TIMELINE_SYNC") == "1":
-                    import jax
-
-                    jax.block_until_ready(out)
-                _prof.record_event(api_name, t0, time.perf_counter())
-                return out
-            return _dispatch(*args, **kwargs)
-
-        def _dispatch(*args, **kwargs):
-            from flashinfer_tpu import trace as _trace
-
-            level = env.log_level()
-            tracing = _trace._trace_enabled() or _trace._apply_enabled()
-            if level <= 0 and not tracing:
-                return f(*args, **kwargs)
-            if tracing:
-                t_axes = _trace._axes_of(args, kwargs)
-                if _trace._trace_enabled():
-                    _trace._dump_trace(api_name, t_axes)
-                if _trace._apply_enabled():
-                    sub = _trace._find_solution(api_name, t_axes)
-                    if sub is not None:
-                        return sub(*args, **kwargs)
-            if level <= 0:
-                return f(*args, **kwargs)
-            idx = next(_call_counter)
-            if level >= 3:
-                arg_s = ", ".join(_summarize(a) for a in args)
-                kw_s = ", ".join(f"{k}={_summarize(v)}" for k, v in kwargs.items())
-                logger.info("[%d] %s(%s%s%s)", idx, api_name, arg_s,
-                            ", " if kw_s and arg_s else "", kw_s)
-            else:
-                logger.info("[%d] %s", idx, api_name)
-            if level >= 10:
-                _dump(api_name, idx, args, kwargs)
-            t0 = time.perf_counter()
-            out = f(*args, **kwargs)
-            if level >= 5:
-                logger.info(
-                    "[%d] %s done in %.3f ms (host)", idx, api_name,
-                    (time.perf_counter() - t0) * 1e3,
-                )
-            return out
-
+        wrapper.__flashinfer_api_name__ = api_name
         return wrapper
 
     if fn is not None:

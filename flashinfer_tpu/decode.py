@@ -189,8 +189,9 @@ class BatchDecodeWithPagedKVCacheWrapper:
         seq_lens=None,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
-        from flashinfer_tpu import native
+        from flashinfer_tpu import native, obs
 
+        replan = self._plan is not None
         indptr = np.asarray(indptr)
         indices = np.asarray(indices)
         last_page_len = np.asarray(last_page_len)
@@ -227,6 +228,17 @@ class BatchDecodeWithPagedKVCacheWrapper:
             rope=(
                 (rope_scale or 1.0, rope_theta or 1e4)
                 if pos_encoding_mode == "ROPE_LLAMA" else None
+            ),
+        )
+        # plan-lifecycle metrics (obs catalog plan.*): bucketed-padding
+        # waste is the recompile-bound trade-off this plan makes — the
+        # batch axis pads to b_bucket, the page table to b_bucket x
+        # p_bucket slots vs len(indices) real pages
+        obs.record_plan(
+            self, replan=replan,
+            padded_vs_actual=(
+                ("batch", b_bucket, batch),
+                ("pages", b_bucket * p_bucket, int(indices.size)),
             ),
         )
 

@@ -396,6 +396,13 @@ def fused_moe_ep(
             hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
             axis, activation, capacity_factor,
         )
+        # obs wiring for the capacity-drop semantics: a no-op while
+        # `dropped` is a tracer (the shard_map/jit steady state — there
+        # the caller reads it via return_dropped=True and may feed the
+        # concrete per-rank counts to obs.record_dropped_tokens itself)
+        from flashinfer_tpu import obs
+
+        obs.record_dropped_tokens(dropped, dispatch)
         return (out, dropped) if return_dropped else out
     if dispatch == "alltoall_exact":
         out, dropped = _fused_moe_ep_alltoall_exact(

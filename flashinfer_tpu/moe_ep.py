@@ -392,6 +392,22 @@ def run_split_kernel(ctx: SplitKernelContext, hidden, tensors, handle,
     return Fleet(ctx.params).run_split(hidden, tensors, handle, **kw)
 
 
+def record_dropped_tokens(dropped, algorithm=EpAlgorithm.ALLTOALL) -> int:
+    """Host-side obs wiring for the capacity-drop counts.
+
+    Inside ``shard_map`` the ``return_dropped=True`` count is a tracer,
+    so ``fused_moe_ep`` cannot feed the registry itself there; the loop
+    that pulls the concrete per-rank counts out of the sharded call
+    hands them to this helper (``obs.catalog`` ``moe.dropped_tokens``).
+    Returns the total recorded (0 when the metrics gate is off).
+    """
+    from flashinfer_tpu import obs
+
+    alg = algorithm.value if isinstance(algorithm, EpAlgorithm) else \
+        str(algorithm)
+    return obs.record_dropped_tokens(dropped, alg)
+
+
 # ---------------------------------------------------------------------------
 # validation (reference validation.py family) — TPU-meaningful checks
 # ---------------------------------------------------------------------------

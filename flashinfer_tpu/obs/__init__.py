@@ -1,0 +1,125 @@
+"""flashinfer_tpu.obs — unified runtime observability.
+
+The metrics half of the observability layer (the tracing half lives in
+``profiler.py`` / ``api_logging.py`` / ``trace.py``; this package ties
+all of them together).  Components:
+
+- :mod:`~flashinfer_tpu.obs.registry` — process-wide thread-safe
+  counters / gauges / fixed-bucket histograms, gated by
+  ``FLASHINFER_TPU_METRICS`` (default off, no-op-cheap);
+- :mod:`~flashinfer_tpu.obs.catalog` — the authoritative metric list
+  (names, types, labels), cross-checked against the decorated public
+  API by the L005 analysis pass;
+- :mod:`~flashinfer_tpu.obs.export` — JSON snapshot, Prometheus text
+  format, and chrome-trace merge of the op timeline;
+- :mod:`~flashinfer_tpu.obs.bench_audit` — the self-auditing bench
+  telemetry (row quality stamps vs BENCH_BANKED.md history);
+- ``python -m flashinfer_tpu.obs`` — ``report`` / ``doctor`` CLI.
+
+Call-site contract: the module-level helpers below apply the metrics
+gate themselves, so instrumentation reads as one line
+(``obs.counter_inc("plan.calls", wrapper=...)``) and costs one function
+call + one env lookup when disabled.  Hot paths that need the gate
+folded into an existing branch (the ``@flashinfer_api`` fast path) use
+:func:`metrics_enabled` directly.
+
+See docs/observability.md for the full catalog and env-var matrix.
+"""
+
+from __future__ import annotations
+
+from flashinfer_tpu.obs import catalog
+from flashinfer_tpu.obs.registry import Registry, get, metrics_enabled
+
+__all__ = [
+    "Registry", "get", "metrics_enabled", "catalog",
+    "counter_inc", "gauge_set", "observe", "record_plan",
+    "record_dropped_tokens", "snapshot", "reset",
+]
+
+_declared = False
+
+
+def _registry() -> Registry:
+    global _declared
+    reg = get()
+    if not _declared:
+        catalog.declare(reg)
+        _declared = True
+    return reg
+
+
+def counter_inc(name: str, value: int = 1, **labels) -> int:
+    """Gated counter increment; returns the new total (0 when gated
+    off, so callers can't misread a disabled counter as progress)."""
+    if not metrics_enabled():
+        return 0
+    return _registry().counter_inc(name, value, **labels)
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    if metrics_enabled():
+        _registry().gauge_set(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    if metrics_enabled():
+        _registry().observe(name, value, **labels)
+
+
+def record_plan(wrapper, *, replan: bool, padded_vs_actual=()) -> None:
+    """Plan-lifecycle wiring shared by the decode/prefill/attention
+    wrappers: one call per plan() with the padding-waste pairs.
+
+    ``padded_vs_actual``: iterable of ``(axis_name, padded, actual)``.
+    """
+    if not metrics_enabled():
+        return
+    reg = _registry()
+    name = type(wrapper).__name__
+    reg.counter_inc("plan.calls", wrapper=name)
+    if replan:
+        reg.counter_inc("plan.replans", wrapper=name)
+    for axis, padded, actual in padded_vs_actual:
+        if padded > 0:
+            reg.observe(
+                "plan.padding_waste_pct",
+                100.0 * (1.0 - float(actual) / float(padded)),
+                wrapper=name, axis=axis,
+            )
+
+
+def record_dropped_tokens(dropped, dispatch: str) -> int:
+    """Count capacity-dropped MoE routes when the count is CONCRETE.
+
+    ``fused_moe_ep`` computes ``dropped`` on device; under jit /
+    shard_map it is a tracer and cannot be read host-side — those calls
+    are skipped (the caller still gets the array via
+    ``return_dropped=True``).  Eager calls (tests, debugging, capacity
+    sizing sweeps) land in the counter.  Returns the count recorded
+    (0 when gated off, skipped, or zero-drop).
+    """
+    if not metrics_enabled():
+        return 0
+    try:
+        import jax
+
+        if isinstance(dropped, jax.core.Tracer):
+            return 0
+        n = int(jax.numpy.sum(dropped))
+    except Exception:
+        return 0
+    if n:
+        _registry().counter_inc("moe.dropped_tokens", n, dispatch=dispatch)
+    return n
+
+
+def snapshot() -> dict:
+    """JSON-ready snapshot of everything recorded (works regardless of
+    the gate — you can read out what an enabled phase recorded after
+    flipping the env var back off)."""
+    return _registry().snapshot()
+
+
+def reset() -> None:
+    _registry().reset()

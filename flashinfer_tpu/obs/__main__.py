@@ -1,0 +1,177 @@
+"""CLI: ``python -m flashinfer_tpu.obs <cmd>``.
+
+Commands:
+
+- ``report``: run a small tier-1-sized workload with metrics enabled
+  (decorated stateless ops + the decode/prefill plan/run lifecycle,
+  CPU-safe under ``JAX_PLATFORMS=cpu``) and print the snapshot —
+  ``--format json`` (default) or ``--format prom``; ``--chrome-trace
+  PATH`` additionally records an op timeline during the workload and
+  writes the merged trace.  ``--no-workload`` skips the built-in
+  workload and reports whatever this process already recorded (for use
+  from a REPL / atexit hook).
+- ``doctor``: device/env/backend health — collect_env, the
+  FLASHINFER_TPU_* flag matrix, backend resolution, compile-guard
+  quarantine state, tuner cache, and registry liveness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _workload() -> None:
+    """A tier-1-sized pass over the instrumented surface: stateless
+    decorated ops plus one full plan/run lifecycle per batch wrapper
+    family (small shapes; runs in seconds on CPU)."""
+    from flashinfer_tpu.env import apply_platform_from_env
+
+    apply_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flashinfer_tpu as fi
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 256), jnp.float32)
+    fi.rmsnorm(x, jnp.ones((256,), jnp.float32))
+    fi.silu_and_mul(jax.random.normal(key, (4, 512), jnp.float32))
+    probs = jax.nn.softmax(jax.random.normal(key, (2, 64), jnp.float32))
+    fi.sampling_from_probs(probs, key)
+
+    T, HQ, HKV, D = 8, 4, 2, 64
+    q = jax.random.normal(key, (T, HQ, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (T, HKV, D),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (T, HKV, D),
+                          jnp.bfloat16)
+    fi.single_prefill_with_kv_cache(q, k, v, causal=True)
+
+    # decode wrapper lifecycle: plan, re-plan (counted), run
+    bs, PS, ppr = 2, 4, 2
+    npages = bs * ppr
+    kc = jax.random.normal(key, (npages, PS, HKV, D), jnp.bfloat16)
+    vc = jax.random.normal(jax.random.fold_in(key, 3),
+                           (npages, PS, HKV, D), jnp.bfloat16)
+    indptr = np.arange(bs + 1, dtype=np.int32) * ppr
+    indices = np.arange(npages, dtype=np.int32)
+    last = np.full((bs,), PS, np.int32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD")
+    w.plan(indptr, indices, last, HQ, HKV, D, PS)
+    w.plan(indptr, indices, last, HQ, HKV, D, PS)  # re-plan
+    qd = jax.random.normal(jax.random.fold_in(key, 4), (bs, HQ, D),
+                           jnp.bfloat16)
+    w.run(qd, (kc, vc))
+
+    # paged-prefill wrapper lifecycle (the gather path off-TPU)
+    wp = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="NHD")
+    wp.plan(np.arange(bs + 1, dtype=np.int32) * 2, indptr, indices, last,
+            HQ, HKV, D, PS, causal=True)
+    qp = jax.random.normal(jax.random.fold_in(key, 5), (bs * 2, HQ, D),
+                           jnp.bfloat16)
+    wp.run(qp, (kc, vc))
+
+
+def cmd_report(args) -> int:
+    from flashinfer_tpu import obs, profiler
+    from flashinfer_tpu.obs import export
+
+    os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    events = None
+    if not args.no_workload:
+        if args.chrome_trace:
+            profiler.start_timeline()
+        _workload()
+        if args.chrome_trace:
+            events = profiler.stop_timeline()
+    snap = obs.snapshot()
+    if args.chrome_trace:
+        export.write_chrome_trace(args.chrome_trace, snap, events)
+        print(f"# chrome trace -> {args.chrome_trace}", file=sys.stderr)
+    if args.format == "prom":
+        sys.stdout.write(export.to_prometheus(snap))
+    else:
+        print(export.to_json(snap))
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """Health report: environment, devices, backend resolution, caches,
+    quarantine — everything a bug report / perf triage needs up front."""
+    from flashinfer_tpu.collect_env import collect_env
+
+    report = {"env": collect_env()}
+
+    flags = {}
+    for name in ("FLASHINFER_TPU_METRICS", "FLASHINFER_TPU_LOGLEVEL",
+                 "FLASHINFER_TPU_BACKEND", "FLASHINFER_TPU_INTERPRET",
+                 "FLASHINFER_TPU_TIMELINE_SYNC", "FLASHINFER_TPU_TRACE_DUMP",
+                 "FLASHINFER_TPU_TRACE_APPLY", "FLASHINFER_TPU_CACHE_DIR",
+                 "FLASHINFER_TPU_DUMP_DIR"):
+        flags[name] = os.environ.get(name, "<unset>")
+    report["flags"] = flags
+
+    try:
+        from flashinfer_tpu.utils import is_tpu, resolve_backend
+
+        report["backend_resolution"] = {
+            "is_tpu": bool(is_tpu()),
+            "single_decode_auto": resolve_backend("auto", "single_decode"),
+        }
+    except Exception as e:  # device init can fail off-accelerator
+        report["backend_resolution"] = f"<unavailable: {type(e).__name__}>"
+
+    from flashinfer_tpu import compile_guard
+
+    q = compile_guard._load_qlist()
+    report["quarantine"] = {
+        "entries": len(q),
+        "ops": sorted({i.get("op", "?") for i in q.values()}),
+    }
+    try:
+        from flashinfer_tpu.autotuner import AutoTuner
+
+        t = AutoTuner.get()
+        t._load()
+        report["tuner"] = {"cache": str(t._cache_path()),
+                          "entries": len(t._cache)}
+    except Exception as e:
+        report["tuner"] = f"<unavailable: {type(e).__name__}>"
+
+    from flashinfer_tpu import obs, profiler
+
+    snap = obs.snapshot()
+    report["registry"] = {
+        "metrics_enabled": obs.metrics_enabled(),
+        "counters": len(snap["counters"]),
+        "gauges": len(snap["gauges"]),
+        "histograms": len(snap["histograms"]),
+        "timeline_active": profiler.timeline_active(),
+    }
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m flashinfer_tpu.obs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("report", help="metrics snapshot (runs a small "
+                                       "built-in workload by default)")
+    sp.add_argument("--format", choices=["json", "prom"], default="json")
+    sp.add_argument("--no-workload", action="store_true",
+                    help="report this process's registry as-is")
+    sp.add_argument("--chrome-trace", metavar="PATH", default=None,
+                    help="also write the merged op-timeline chrome trace")
+    sp.set_defaults(fn=cmd_report)
+    sp = sub.add_parser("doctor", help="device/env/backend health report")
+    sp.set_defaults(fn=cmd_doctor)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

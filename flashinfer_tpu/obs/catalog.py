@@ -1,0 +1,113 @@
+"""The authoritative metric catalog.
+
+Single source of truth for every metric the instrumentation layer may
+emit: name, type, label schema, and what it means.  Three consumers:
+
+- ``docs/observability.md`` documents from this table (kept in sync by
+  hand; the doc test asserts the doc names every catalog entry);
+- the L005 analysis pass (``analysis/obs_coverage.py``) fails CI when a
+  ``@flashinfer_api``-decorated public op is missing from ``API_OPS`` —
+  new public ops cannot ship unobserved;
+- ``obs report`` / the exporters annotate output with ``help`` strings.
+
+``API_OPS`` lists the op names of the decorated public surface (the
+decorator's ``name or f.__qualname__``).  Adding a decorated function
+means adding its name here (and to the doc) — that is the point.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from flashinfer_tpu.obs.registry import PERCENT_BUCKETS
+
+# (type, labels, help)
+METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
+    # -- @flashinfer_api decorator (api_logging.py) -----------------------
+    "api.calls": (
+        "counter", ("op",),
+        "calls through each decorated public op (metrics gate on)"),
+    "api.calls_total": (
+        "counter", (),
+        "instrumented-path call index across all ops — the registry-"
+        "backed successor of api_logging's ad-hoc _call_counter; also "
+        "the [N] index in FLASHINFER_TPU_LOGLEVEL output"),
+    "api.dispatch_us": (
+        "histogram", ("op",),
+        "host dispatch time per call: wrapper entry to op return, no "
+        "device sync (the dispatch-cost number VERDICT weak #4 wanted)"),
+    # -- plan/run wrapper lifecycle (decode.py / prefill.py / attention.py)
+    "plan.calls": (
+        "counter", ("wrapper",),
+        "plan() invocations per wrapper class"),
+    "plan.replans": (
+        "counter", ("wrapper",),
+        "plan() calls that replaced a live plan (re-plan churn — each "
+        "one risks a recompile if the geometry bucket moved)"),
+    "plan.sm_scale_rebinds": (
+        "counter", ("wrapper",),
+        "frozen-plan sm_scale replacements (per-call k_scale/sm_scale "
+        "overrides swapping a dataclasses.replace'd plan in and out)"),
+    "plan.padding_waste_pct": (
+        "histogram", ("wrapper", "axis"),
+        "planned-vs-actual padding waste per plan(): 100*(1 - "
+        "actual/padded) for each padded axis (q/kv token axes, decode "
+        "batch and page-table slots) — the cost of pow2 bucketing"),
+    # -- trace.py solution substitution -----------------------------------
+    "trace.solution_hits": (
+        "counter", ("op",),
+        "TRACE_APPLY calls routed to a registered substitute solution"),
+    "trace.solution_misses": (
+        "counter", ("op",),
+        "TRACE_APPLY calls with no matching solution (fell through to "
+        "the default implementation)"),
+    # -- fused MoE expert parallelism -------------------------------------
+    "moe.dropped_tokens": (
+        "counter", ("dispatch",),
+        "capacity-dropped (token, choice) routes observed at EAGER "
+        "fused_moe_ep calls (inside jit the count is a tracer and is "
+        "skipped — use return_dropped=True there)"),
+    # -- serving-loop phase decomposition (bench.py) ----------------------
+    "serving.phase_us": (
+        "histogram", ("phase",),
+        "per-step cost of each serving-loop phase from the bench.py "
+        "micro-loop decomposition (attention / kv_append / moe_or_mlp / "
+        "norm_rope / sampling / lm_head / residual)"),
+    # -- bench row quality audit (obs.bench_audit) ------------------------
+    "bench.rows": (
+        "counter", ("phase", "quality"),
+        "bench rows emitted per phase, by audited quality stamp "
+        "(ok | degraded | poison)"),
+}
+
+# histograms whose values are percentages, not microseconds
+PERCENT_HISTOGRAMS = ("plan.padding_waste_pct",)
+
+
+def declare(registry) -> None:
+    """Pin non-default bucket boundaries on `registry`."""
+    for name in PERCENT_HISTOGRAMS:
+        registry.declare_histogram(name, PERCENT_BUCKETS)
+
+
+# Decorated public-API op names (decorator name= or f.__qualname__).
+# L005 (analysis/obs_coverage.py) fails CI when a decorated function is
+# absent from this set.
+API_OPS = frozenset({
+    # activation.py
+    "silu_and_mul", "gelu_and_mul", "gelu_tanh_and_mul",
+    # norm.py
+    "rmsnorm", "gemma_rmsnorm", "fused_add_rmsnorm",
+    "gemma_fused_add_rmsnorm",
+    # rope.py
+    "apply_rope", "apply_llama31_rope", "rope_quantize_fp8",
+    "mla_rope_quantize_fp8", "rope_quantize_fp8_append_paged_kv_cache",
+    # page.py
+    "append_paged_kv_cache",
+    # decode.py / prefill.py
+    "single_decode_with_kv_cache", "single_prefill_with_kv_cache",
+    # sampling.py
+    "sampling_from_probs", "sampling_from_logits",
+    "top_p_sampling_from_probs", "top_k_sampling_from_probs",
+    "min_p_sampling_from_probs", "top_k_top_p_sampling_from_probs",
+})

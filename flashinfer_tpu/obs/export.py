@@ -1,0 +1,119 @@
+"""Exporters for the obs registry snapshot.
+
+Three formats, matching the reference's split between machine-readable
+artifacts and Perfetto-loadable traces (``flashinfer/profiler``):
+
+- :func:`to_json` — the canonical snapshot (what ``obs report`` prints);
+- :func:`to_prometheus` — Prometheus text exposition format (counters
+  as ``_total``, histograms as ``_bucket``/``_sum``/``_count`` plus
+  pre-computed quantile gauges), for scraping a long-lived server;
+- :func:`to_chrome_trace` — merges the profiler's op-timeline spans and
+  the snapshot into ONE chrome://tracing / Perfetto-loadable JSON: the
+  spans render on the timeline, the metrics ride as a metadata event so
+  a trace file is self-describing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "flashinfer_tpu_" + _NAME_RE.sub("_", name)
+
+
+def _prom_labels(flat_key: str, extra: str = "") -> str:
+    """Snapshot flat label key ``{k=v,...}`` (or ``""``) -> prometheus
+    ``{k="v",...}``."""
+    parts = []
+    if flat_key:
+        for kv in flat_key.strip("{}").split(","):
+            k, _, v = kv.partition("=")
+            parts.append(f'{k}="{v}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def to_json(snapshot: dict, indent: int = 1) -> str:
+    return json.dumps(snapshot, indent=indent, sort_keys=True)
+
+
+def to_prometheus(snapshot: dict) -> str:
+    from flashinfer_tpu.obs.catalog import METRICS
+
+    lines: List[str] = []
+
+    def help_for(name: str) -> None:
+        spec = METRICS.get(name)
+        if spec:
+            lines.append(f"# HELP {_prom_name(name)} {spec[2]}")
+
+    for name, cells in snapshot.get("counters", {}).items():
+        help_for(name)
+        lines.append(f"# TYPE {_prom_name(name)} counter")
+        for key, val in cells.items():
+            lines.append(f"{_prom_name(name)}_total{_prom_labels(key)} {val}")
+    for name, cells in snapshot.get("gauges", {}).items():
+        help_for(name)
+        lines.append(f"# TYPE {_prom_name(name)} gauge")
+        for key, val in cells.items():
+            lines.append(f"{_prom_name(name)}{_prom_labels(key)} {val}")
+    for name, cells in snapshot.get("histograms", {}).items():
+        help_for(name)
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        for key, h in cells.items():
+            acc = 0
+            for le, c in h.get("buckets", {}).items():
+                acc += c
+                le_lbl = 'le="%s"' % le
+                lines.append(f"{pn}_bucket{_prom_labels(key, le_lbl)} {acc}")
+            # the running acc already equals count; still emit the +Inf
+            # bucket when no overflow landed (prometheus requires it)
+            if "+Inf" not in h.get("buckets", {}):
+                inf_lbl = 'le="+Inf"'
+                lines.append(
+                    f"{pn}_bucket{_prom_labels(key, inf_lbl)} {h['count']}")
+            lines.append(f"{pn}_sum{_prom_labels(key)} {h['sum']}")
+            lines.append(f"{pn}_count{_prom_labels(key)} {h['count']}")
+            for q in ("p50", "p90", "p99"):
+                if q in h:
+                    q_lbl = 'quantile="0.%s"' % q[1:]
+                    lines.append(f"{pn}{_prom_labels(key, q_lbl)} {h[q]}")
+    return "\n".join(lines) + "\n"
+
+
+def to_chrome_trace(snapshot: dict,
+                    timeline_events: Optional[list] = None) -> dict:
+    """Merge op-timeline spans (``profiler.stop_timeline`` events) with
+    the metrics snapshot into one chrome-trace dict (same span encoding
+    as profiler.stop_timeline's file form, so tooling treats both
+    identically)."""
+    pid = os.getpid()
+    events = [
+        {
+            "name": e["name"], "ph": "X", "pid": pid, "tid": 0,
+            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+            "cat": "flashinfer_tpu",
+        }
+        for e in (timeline_events or [])
+    ]
+    events.append({
+        "name": "flashinfer_tpu.obs.snapshot", "ph": "M", "pid": pid,
+        "tid": 0, "args": {"snapshot": snapshot},
+    })
+    return {"traceEvents": events}
+
+
+def write_chrome_trace(path: str, snapshot: dict,
+                       timeline_events: Optional[list] = None) -> None:
+    from flashinfer_tpu.utils import atomic_write_text
+
+    atomic_write_text(path, json.dumps(
+        to_chrome_trace(snapshot, timeline_events)))

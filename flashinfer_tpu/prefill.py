@@ -470,6 +470,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
         **_unused,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
+        replan = self._plan is not None
         alibi = pos_encoding_mode == "ALIBI"
         rope = (
             (rope_scale or 1.0, rope_theta or 1e4)
@@ -516,6 +517,13 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             ),
             rope=rope,
         )
+        from flashinfer_tpu import obs
+
+        obs.record_plan(
+            self, replan=replan,
+            padded_vs_actual=(("q_tokens", tq_pad, total_q),
+                              ("kv_tokens", tkv_pad, total_kv)),
+        )
 
     def run(
         self,
@@ -547,6 +555,10 @@ class BatchPrefillWithRaggedKVCacheWrapper:
                     if val is not None and float(val) != plan.sm_scale:
                         import dataclasses
 
+                        from flashinfer_tpu import obs
+
+                        obs.counter_inc("plan.sm_scale_rebinds",
+                                        wrapper=type(self).__name__)
                         plan = dataclasses.replace(
                             plan, sm_scale=float(val))
                 else:
@@ -658,6 +670,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
         **_unused,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
+        replan = self._plan is not None
         alibi = pos_encoding_mode == "ALIBI"
         rope = (
             (rope_scale or 1.0, rope_theta or 1e4)
@@ -811,6 +824,13 @@ class BatchPrefillWithPagedKVCacheWrapper:
         else:
             self._fused_plan = None
             self._plan = build_gather_plan()
+        from flashinfer_tpu import obs
+
+        obs.record_plan(
+            self, replan=replan,
+            padded_vs_actual=(("q_tokens", tq_pad, int(qo_indptr[-1])),
+                              ("kv_tokens", tkv_pad, int(kv_indptr[-1]))),
+        )
 
     def _rebind_sm_scale(self, *, absolute=None, multiplier=None):
         """Per-call sm_scale override: swap in a plan with the new scale
@@ -826,6 +846,9 @@ class BatchPrefillWithPagedKVCacheWrapper:
             return None
         import dataclasses
 
+        from flashinfer_tpu import obs
+
+        obs.counter_inc("plan.sm_scale_rebinds", wrapper=type(self).__name__)
         restore = self._plan
         self._plan = dataclasses.replace(restore, sm_scale=new)
         return restore

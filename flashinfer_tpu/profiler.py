@@ -39,14 +39,38 @@ def annotate(name: str) -> Iterator[None]:
         yield
 
 
+@contextlib.contextmanager
+def scope(name: str) -> Iterator[None]:
+    """Named span usable INSIDE jit-traced code: the emitted XLA ops
+    carry ``name`` so device traces (and the bench.py serving-loop
+    phase decomposition) attribute work to serving phases.
+    ``annotate`` is the host-side twin (TraceAnnotation does nothing
+    under tracing)."""
+    import jax
+
+    with jax.named_scope(name):
+        yield
+
+
 # ---------------------------------------------------------------------------
 # Op-level event timeline (reference profiler.cuh event-buffer analogue):
 # every @flashinfer_api call between start_timeline()/stop_timeline() is
 # recorded and exportable as chrome://tracing JSON.  Host-side spans by
 # default (dispatch cost); set FLASHINFER_TPU_TIMELINE_SYNC=1 to
 # block_until_ready each op for true wall durations.
+#
+# Thread-safe (ISSUE 2 satellite): serving loops drive decorated ops
+# from executor threads, and the previous bare-global-list design could
+# lose events (append after a concurrent stop) or double-export (two
+# concurrent stops returning the same list).  Same pattern trace.py
+# already uses for its jsonl writes: one module lock around every
+# mutation; timeline_active() stays lock-free (a benign race — the
+# recorder re-checks under the lock).
 # ---------------------------------------------------------------------------
 
+import threading as _threading
+
+_timeline_lock = _threading.Lock()
 _timeline_events: Optional[list] = None
 
 
@@ -56,20 +80,25 @@ def timeline_active() -> bool:
 
 def start_timeline() -> None:
     global _timeline_events
-    _timeline_events = []
+    with _timeline_lock:
+        _timeline_events = []
 
 
 def record_event(name: str, t0: float, t1: float) -> None:
-    if _timeline_events is not None:
-        _timeline_events.append({"name": name, "ts": t0, "dur": t1 - t0})
+    with _timeline_lock:
+        if _timeline_events is not None:
+            _timeline_events.append({"name": name, "ts": t0, "dur": t1 - t0})
 
 
 def stop_timeline(path: Optional[str] = None) -> list:
     """Stop recording; return the events and optionally write a
-    chrome://tracing / Perfetto-loadable JSON file."""
+    chrome://tracing / Perfetto-loadable JSON file.  Concurrent-stop
+    safe: the event list is swapped out under the lock, so exactly one
+    caller gets the events — a second stop returns []."""
     global _timeline_events
-    events = _timeline_events or []
-    _timeline_events = None
+    with _timeline_lock:
+        events = _timeline_events or []
+        _timeline_events = None
     if path is not None:
         import json
         import os

@@ -96,6 +96,13 @@ def traced_api(fn: Callable = None, *, name: str = None) -> Callable:
                 _dump_trace(op, axes)
             if _apply_enabled():
                 sub = _find_solution(op, axes)
+                # substitution hit/miss metrics (same wiring as the
+                # @flashinfer_api path, api_logging._instrumented_call)
+                from flashinfer_tpu import obs
+
+                obs.counter_inc(
+                    "trace.solution_hits" if sub is not None
+                    else "trace.solution_misses", op=op)
                 if sub is not None:
                     return sub(*args, **kwargs)
             return f(*args, **kwargs)

@@ -53,6 +53,11 @@ def pytest_configure(config):
         "markers", "devices_8: test requires the 8-device virtual mesh"
     )
     config.addinivalue_line("markers", "tpu_only: test requires real TPU hardware")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow') — multi-minute "
+        "subprocess benches and similar",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
