@@ -277,10 +277,19 @@ def phase_prefill(sweep: bool):
         if t is None:
             continue
         flops = bs * attention_flops(qlen, ctx, HQ, D, D, causal=True)
+        # block-config metadata: which pipelined-kernel launch shape this
+        # number belongs to (None fields = gather+flash fallback ran) —
+        # the row is meaningless for tuning without it
+        # (benchmarks/bench_prefill_blocks.py sweeps these knobs)
+        cfg = w.fused_prefill_config or {}
         _emit_row(phase="prefill", kind="paged_chunked", bs=bs, qlen=qlen,
-                  ctx=ctx, us=round(t * 1e6, 1),
+                  ctx=ctx, block_q=cfg.get("block_q"),
+                  pages_per_chunk=cfg.get("pages_per_chunk"),
+                  num_units=cfg.get("num_units"),
+                  us=round(t * 1e6, 1),
                   tflops=round(flops / t / 1e12, 2))
-        print(f"# prefill paged bs={bs} qlen={qlen} ctx={ctx}: "
+        print(f"# prefill paged bs={bs} qlen={qlen} ctx={ctx} "
+              f"bq={cfg.get('block_q')} ppc={cfg.get('pages_per_chunk')}: "
               f"{t*1e6:9.1f} us  {flops/t/1e12:6.2f} TFLOP/s",
               file=sys.stderr)
 
@@ -302,7 +311,20 @@ def phase_prefill(sweep: bool):
         if t is None:
             continue
         flops = attention_flops(T, T, HQ, D, D, causal=True)
+        # block-config metadata: the (block_q, block_kv) _tuned_flash
+        # resolves for this shape (THE shared key builder — a hand-copied
+        # tuple here would silently desync and bank wrong metadata)
+        from flashinfer_tpu.autotuner import AutoTuner
+        from flashinfer_tpu.prefill import (
+            _FLASH_BLOCK_CANDIDATES, flash_block_key,
+        )
+
+        fkey = flash_block_key(T, T, HQ, HKV, D, "bfloat16", True)
+        fbq, fbkv = AutoTuner.get().lookup(
+            "flash_attention.blocks", fkey,
+            default=_FLASH_BLOCK_CANDIDATES[0])
         _emit_row(phase="prefill", kind="ragged_flash", qlen=T,
+                  block_q=int(fbq), block_kv=int(fbkv),
                   us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
         print(f"# prefill ragged T={T}: {t*1e6:9.1f} us  "
               f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)

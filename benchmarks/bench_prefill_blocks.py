@@ -1,0 +1,191 @@
+"""Block-shape sweep harness for the pipelined fused prefill kernel.
+
+The on-chip autotune surface for ISSUE 3's tentpole (c): sweeps the
+``fused_prefill.blocks`` knob — (qo-tile ``block_q``, kv-chunk
+``pages_per_chunk``) — across the paged chunked-prefill shape grid,
+emits ``ROW {json}`` lines carrying the full block-config metadata, and
+quality-stamps every row through ``obs.bench_audit.RowAuditor`` against
+the BENCH_BANKED.md history (the same <0.35x implausibility rule as
+bench.py).
+
+Usage::
+
+    python benchmarks/bench_prefill_blocks.py            # on-chip sweep
+    python benchmarks/bench_prefill_blocks.py --smoke    # CPU interpret
+    python benchmarks/bench_prefill_blocks.py --emit-config > prefill.json
+
+``--emit-config`` prints a ready-to-paste ``"prefill"`` section for
+``flashinfer_tpu/tuning_configs/<gen>.json`` with each shape's winner —
+the step that graduates the shipped section from ``"seed": true`` to
+measured (docs/performance.md walks the workflow).
+
+Candidate ceiling note: chunk_tokens stays <= 256 (ppc <= 16 at page 16)
+— each work unit unrolls 2 DMAs/page and 32 in-flight copies is the
+on-chip-validated queue ceiling; ppc=32 would be the W002 queue-unroll
+wedge class (see ops/paged_prefill.py kv_dmas).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd (sys.path[0] is benchmarks/)
+    sys.path.insert(0, _REPO)
+
+_AUDITOR = None
+
+
+def _emit_row(**kw):
+    """One measurement, RowAuditor-stamped, parseable by orchestrators."""
+    global _AUDITOR
+    try:
+        from flashinfer_tpu.obs import bench_audit
+
+        if _AUDITOR is None:
+            _AUDITOR = bench_audit.RowAuditor(
+                bench_audit.load_banked_history(
+                    os.path.join(_REPO, "BENCH_BANKED.md")))
+        _AUDITOR.stamp(kw)
+    except Exception as e:  # noqa: BLE001 - the audit must never cost a row
+        print(f"# row audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    print("ROW " + json.dumps(kw), flush=True)
+    return kw
+
+
+def candidate_grid(page_size: int, smoke: bool):
+    """(block_q, pages_per_chunk) candidates — the SAME grid the
+    wrapper's in-run tuner explores (ops/paged_prefill.block_candidates,
+    W002-safe chunk ceiling), so banked winners are always reproducible
+    by runtime autotune."""
+    if smoke:
+        return [(32, 2), (64, 2), (64, 4)]
+    from flashinfer_tpu.ops.paged_prefill import block_candidates
+
+    return block_candidates(page_size)
+
+
+def shape_grid(smoke: bool):
+    """(bs, qlen, ctx, HQ, HKV, D, page_size) sweep shapes — the bench.py
+    prefill phase configs plus the VERDICT next-round target cell."""
+    if smoke:
+        return [(2, 32, 64, 4, 2, 64, 8)]
+    return [
+        (8, 512, 4096, 32, 8, 128, 16),   # VERDICT target: >= 60 TFLOPS
+        (2, 2048, 8192, 32, 8, 128, 16),
+        (16, 256, 2048, 32, 8, 128, 16),
+    ]
+
+
+def sweep(smoke: bool, repeats: int):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu.ops.paged_prefill import (
+        build_prefill_work_units, fused_paged_prefill,
+    )
+    from flashinfer_tpu.testing import attention_flops, bench_fn_device
+    from flashinfer_tpu import compile_guard
+
+    winners = {}
+    for bs, qlen, ctx, HQ, HKV, D, PS in shape_grid(smoke):
+        ppr = ctx // PS
+        npages = bs * ppr
+        rng = np.random.default_rng(0)
+        key = jax.random.PRNGKey(0)
+        kc = jax.random.normal(key, (npages, HKV, PS, D), jnp.bfloat16)
+        vc = jax.random.normal(jax.random.fold_in(key, 1),
+                               (npages, HKV, PS, D), jnp.bfloat16)
+        q = jax.random.normal(jax.random.fold_in(key, 2),
+                              (bs * qlen, HQ, D), jnp.bfloat16)
+        qo_indptr = np.arange(bs + 1, dtype=np.int32) * qlen
+        kv_page_indptr = np.arange(bs + 1, dtype=np.int32) * ppr
+        kv_page_indices = rng.permutation(npages).astype(np.int32)
+        kv_lens = np.full((bs,), ctx, np.int64)
+        flops = bs * attention_flops(qlen, ctx, HQ, D, D, causal=True)
+        fused_key = "_".join(map(str, (
+            bs, max(1 << (bs * qlen - 1).bit_length(), 128), HQ, HKV, D, PS,
+        )))
+
+        best = None
+        for bq, ppc in candidate_grid(PS, smoke):
+            plan_np = build_prefill_work_units(
+                qo_indptr, kv_page_indptr, kv_page_indices, kv_lens,
+                block_q=bq, pages_per_chunk=ppc, page_size=PS, causal=True,
+            )
+            statics = dict(
+                num_units=plan_np.pop("num_units"),
+                block_q=plan_np.pop("block_q"),
+                pages_per_chunk=plan_np.pop("pages_per_chunk"),
+            )
+            stats = plan_np.pop("stats")
+            plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
+            try:
+                t = compile_guard.guarded(
+                    "bench.prefill_blocks",
+                    (bs, qlen, ctx, HQ, HKV, D, PS, bq, ppc),
+                    lambda: bench_fn_device(
+                        lambda qq, kk, vv: fused_paged_prefill(
+                            qq, kk, vv, plan, sm_scale=D ** -0.5,
+                            causal=True, **statics),
+                        q, kc, vc, repeats=repeats,
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 - one cell, not the sweep
+                first = (str(e).splitlines() or ["?"])[0][:120]
+                print(f"# blocks ({bq},{ppc}) FAILED "
+                      f"{type(e).__name__}: {first}", file=sys.stderr)
+                continue
+            tflops = flops / t / 1e12
+            row = _emit_row(
+                phase="prefill_blocks", bs=bs, qlen=qlen, ctx=ctx,
+                block_q=bq, pages_per_chunk=ppc,
+                num_units=statics["num_units"],
+                units_pruned=stats["units_pruned"],
+                us=round(t * 1e6, 1), tflops=round(tflops, 2),
+            )
+            print(f"# blocks bs={bs} qlen={qlen} ctx={ctx} "
+                  f"bq={bq:3d} ppc={ppc:2d}: {t*1e6:9.1f} us  "
+                  f"{tflops:6.2f} TFLOP/s  [{row.get('quality', '?')}]",
+                  file=sys.stderr)
+            if row.get("quality") != "poison" and (
+                    best is None or tflops > best[0]):
+                best = (tflops, bq, ppc)
+        if best is not None:
+            winners[f"fused_prefill.blocks|{fused_key}"] = [best[1], best[2]]
+    return winners
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes, interpret-safe (CPU CI)")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--emit-config", action="store_true",
+                    help="print a tuning_configs 'prefill' section with "
+                         "each shape's winner on stdout")
+    args = ap.parse_args()
+    if not args.smoke:
+        from flashinfer_tpu.env import apply_platform_from_env
+
+        apply_platform_from_env()
+    winners = sweep(args.smoke, args.repeats)
+    if args.emit_config:
+        print(json.dumps({"prefill": {
+            "comment": "measured by benchmarks/bench_prefill_blocks.py "
+                       "(replace the shipped seed section with this)",
+            "seed": bool(args.smoke),
+            "tactics": winners,
+        }}, indent=1))
+    else:
+        print(json.dumps({"winners": winners}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

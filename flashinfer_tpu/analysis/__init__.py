@@ -24,6 +24,11 @@ L004  wedge                  the original wedge lint (W000–W004), now a
 L005  obs_coverage           ``@flashinfer_api`` ops missing from the
                              obs metric catalog (public ops shipping
                              unobserved — ISSUE 2 satellite)
+L006  tuning_schema          ``tuning_configs/*.json`` entries naming
+                             knobs the autotuner never registered, or
+                             values the registered KnobSpec rejects
+                             (stale shipped tactics silently falling
+                             back to defaults — ISSUE 3 satellite)
 ====  =====================  ==========================================
 
 CLI::
@@ -47,7 +52,8 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
-                                     obs_coverage, signature_parity, wedge)
+                                     obs_coverage, signature_parity,
+                                     tuning_schema, wedge)
 from flashinfer_tpu.analysis.core import (Finding, Project,  # noqa: F401
                                           SourceFile, load_file,
                                           load_source, project_relpath)
@@ -59,7 +65,7 @@ __all__ = [
 ]
 
 PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
-          obs_coverage)
+          obs_coverage, tuning_schema)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")

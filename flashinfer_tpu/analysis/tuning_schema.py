@@ -1,0 +1,120 @@
+"""L006: every ``tuning_configs/*.json`` entry must name a knob the
+autotuner actually registers.
+
+The shipped per-generation tactic tables (``flashinfer_tpu/
+tuning_configs/v5e.json`` etc.) are string-keyed: ``"op.knob|shape"``.
+Nothing ties those strings to the ``choose_one``/``lookup`` call sites —
+a renamed knob, a typo'd key, or a malformed value silently orphans the
+entry and the kernel quietly falls back to defaults (the stale-config
+bug class ISSUE 3's autotune satellite names).  This pass closes the
+loop: every key's op name must exist in
+``flashinfer_tpu.autotuner.KNOWN_KNOBS`` and every value must satisfy
+the registered :class:`~flashinfer_tpu.autotuner.KnobSpec` (arity for
+block tuples, enum membership for string knobs).
+
+Config discovery is project-relative: any ``tuning_configs`` directory
+that sits next to an analyzed ``.py`` file is scanned, so synthetic
+projects in tests see only the configs they stage.  Findings carry the
+JSON file as the filename and the offending key's line; ``func`` is the
+key itself so baselining stays per-entry.
+
+Validated shapes per file: the flat top-level ``"tactics"`` table plus
+every named section carrying its own ``"tactics"`` (the schema
+``autotuner._flatten_config`` consumes).  An unparseable config file is
+itself a finding — the runtime loader swallows it silently by design,
+which is exactly when lint must speak up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from flashinfer_tpu.analysis.core import Finding, Project
+
+CODE = "L006"
+
+
+def _config_paths(project: Project) -> List[str]:
+    dirs = []
+    seen = set()
+    for sf in project.files:
+        d = os.path.join(os.path.dirname(os.path.abspath(sf.path)),
+                         "tuning_configs")
+        if d not in seen:
+            seen.add(d)
+            if os.path.isdir(d):
+                dirs.append(d)
+    paths = []
+    for d in sorted(dirs):
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".json"):
+                paths.append(os.path.join(d, fn))
+    return paths
+
+
+def _key_line(src: str, key: str) -> int:
+    """Line of the key's first occurrence (1-based; 1 if not found)."""
+    needle = json.dumps(key)
+    for i, line in enumerate(src.splitlines(), 1):
+        if needle in line:
+            return i
+    return 1
+
+
+def _tables(data: dict) -> Dict[str, dict]:
+    """{section label: tactics table} in the loader's merge order."""
+    out = {"tactics": data.get("tactics", {})}
+    for key, sec in sorted(data.items()):
+        if key != "tactics" and isinstance(sec, dict) \
+                and isinstance(sec.get("tactics"), dict):
+            out[key] = sec["tactics"]
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    from flashinfer_tpu.autotuner import validate_tactic
+
+    findings: List[Finding] = []
+    for path in _config_paths(project):
+        try:
+            with open(path) as fh:
+                src = fh.read()
+            data = json.loads(src)
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(Finding(
+                CODE, path, getattr(e, "lineno", 1) or 1, "<config>",
+                f"unreadable tuning config: {e} — the runtime loader "
+                "ignores broken files silently, so every shipped tactic "
+                "in it is dead"))
+            continue
+        if not isinstance(data, dict):
+            findings.append(Finding(
+                CODE, path, 1, "<config>",
+                "tuning config root must be a JSON object with a "
+                "'tactics' table"))
+            continue
+        for section, table in _tables(data).items():
+            if not isinstance(table, dict):
+                findings.append(Finding(
+                    CODE, path, _key_line(src, section), section,
+                    "'tactics' must be a string-keyed object"))
+                continue
+            for key, value in table.items():
+                op_name, sep, shape = key.partition("|")
+                if not sep or not shape:
+                    findings.append(Finding(
+                        CODE, path, _key_line(src, key), key,
+                        "tactic keys are 'op.knob|shape_key' — this one "
+                        "has no shape part and can never be looked up"))
+                    continue
+                err = validate_tactic(op_name, value)
+                if err is not None:
+                    findings.append(Finding(
+                        CODE, path, _key_line(src, key), key,
+                        f"stale/invalid tuning entry in section "
+                        f"{section!r}: {err} — the autotuner drops it at "
+                        "load time and the kernel silently runs "
+                        "defaults"))
+    return findings

@@ -21,8 +21,107 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import dataclasses
+
 from flashinfer_tpu import env
 from flashinfer_tpu.version import __version__
+
+
+# ---------------------------------------------------------------------------
+# Knob registry: the autotuner's first-class tactic surface.  Every op that
+# consults the tuner (lookup / choose_one) registers its knob here — name
+# and legal value shape — so (a) the
+# shipped tuning_configs/*.json files are lint-checkable (analysis pass
+# L006 `tuning_schema` rejects stale/misspelled keys at CI time) and (b) a
+# corrupt or hand-edited config entry is ignored instead of crashing a
+# kernel launch with a nonsense block shape.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One tunable launch parameter.
+
+    ``arity=0`` means a scalar value; ``arity=n`` a list/tuple of n ints
+    (JSON lists round-trip to tuples at lookup).  ``choices`` restricts
+    string-valued knobs to an enum."""
+
+    op_name: str
+    arity: int = 0
+    kind: str = "int"  # "int" | "str"
+    choices: Optional[Tuple[str, ...]] = None
+    description: str = ""
+
+    def validate(self, value) -> Optional[str]:
+        """Error message if `value` is not a legal tactic, else None."""
+        if self.arity == 0:
+            if self.kind == "str":
+                if not isinstance(value, str):
+                    return f"expected a string, got {value!r}"
+                if self.choices and value not in self.choices:
+                    return (f"{value!r} not in allowed choices "
+                            f"{list(self.choices)}")
+                return None
+            if not isinstance(value, int) or isinstance(value, bool) \
+                    or value <= 0:
+                return f"expected a positive int, got {value!r}"
+            return None
+        if not isinstance(value, (list, tuple)) or len(value) != self.arity:
+            return (f"expected a list of {self.arity} positive ints, "
+                    f"got {value!r}")
+        for v in value:
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                return (f"expected a list of {self.arity} positive ints, "
+                        f"got {value!r}")
+        return None
+
+
+KNOWN_KNOBS: Dict[str, KnobSpec] = {}
+
+
+def register_knob(op_name: str, *, arity: int = 0, kind: str = "int",
+                  choices: Optional[Sequence[str]] = None,
+                  description: str = "") -> KnobSpec:
+    spec = KnobSpec(op_name, arity, kind,
+                    tuple(choices) if choices else None, description)
+    KNOWN_KNOBS[op_name] = spec
+    return spec
+
+
+# The registered surface (one entry per choose_one/lookup op name in the
+# tree; L006 cross-checks tuning_configs/*.json against this table).
+register_knob("rmsnorm.row_block",
+              description="rmsnorm Pallas kernel row-block size")
+register_knob("fused_add_rmsnorm.row_block",
+              description="fused_add_rmsnorm Pallas kernel row-block size")
+register_knob("paged_decode.pages_per_chunk",
+              description="decode kernel KV pages per DMA chunk")
+register_knob("paged_decode.prefetch", kind="str",
+              choices=("static", "off"),
+              description="decode kernel cross-step prefetch mode")
+register_knob("fused_prefill.blocks", arity=2,
+              description="fused work-unit prefill (block_q, "
+                          "pages_per_chunk) — the qo-tile/kv-chunk "
+                          "shapes of the pipelined mainloop")
+register_knob("flash_attention.blocks", arity=2,
+              description="ragged flash kernel (block_q, block_kv) "
+                          "grid blocks")
+register_knob("moe_gmm.tiles", arity=3,
+              description="MoE grouped-matmul (tm, tk, tn) tile shape")
+register_knob("mla_decode.layout", kind="str",
+              choices=("split", "packed"),
+              description="MLA decode scratch layout")
+
+
+def validate_tactic(op_name: str, value) -> Optional[str]:
+    """Error message if (op_name, value) is not a registered legal
+    tactic; None when valid.  Unknown op names are errors — that is the
+    stale-config bug class L006 exists to catch."""
+    spec = KNOWN_KNOBS.get(op_name)
+    if spec is None:
+        return (f"unknown autotuner knob {op_name!r} (registered: "
+                f"{sorted(KNOWN_KNOBS)})")
+    return spec.validate(value)
 
 
 def _device_config_key() -> Optional[str]:
@@ -43,6 +142,31 @@ def _device_config_key() -> Optional[str]:
     if "v4" in kind:
         return "v4"
     return None
+
+
+def _flatten_config(data: dict) -> Dict[str, Any]:
+    """Merge a shipped config file's tactic tables.
+
+    Schema: a top-level ``"tactics"`` dict plus any number of named
+    SECTIONS — dicts carrying their own ``"tactics"`` (and optionally
+    ``"seed": true`` for entries derived off-chip, plus a ``"comment"``).
+    Sections group an op family's entries (the ``"prefill"`` section
+    feeds the pipelined prefill path; see docs/performance.md) and merge
+    after the flat table, so a section entry wins on key collision.
+    Entries that fail :func:`validate_tactic` are dropped — a stale or
+    hand-mangled config key must not reach a kernel launch (L006 catches
+    it at lint time; this is the runtime belt to that suspender)."""
+    out: Dict[str, Any] = {}
+    tables = [data.get("tactics", {})]
+    tables += [sec["tactics"] for key, sec in sorted(data.items())
+               if isinstance(sec, dict) and key != "tactics"
+               and isinstance(sec.get("tactics"), dict)]
+    for table in tables:
+        for key, val in table.items():
+            op_name = key.split("|", 1)[0]
+            if validate_tactic(op_name, val) is None:
+                out[key] = val
+    return out
 
 
 class AutoTuner:
@@ -105,7 +229,7 @@ class AutoTuner:
                     p = root / f"{stem}.json"
                     if p.is_file():
                         self._shipped.update(
-                            json.loads(p.read_text()).get("tactics", {})
+                            _flatten_config(json.loads(p.read_text()))
                         )
                 except Exception:
                     pass

@@ -52,7 +52,16 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "histogram", ("wrapper", "axis"),
         "planned-vs-actual padding waste per plan(): 100*(1 - "
         "actual/padded) for each padded axis (q/kv token axes, decode "
-        "batch and page-table slots) — the cost of pow2 bucketing"),
+        "batch and page-table slots; the fused-prefill work-unit axes "
+        "prefill_unit_rows / prefill_mxu_cells measure idle tile rows "
+        "and idle MXU cells across the planned units — the number the "
+        "ISSUE 3 tile packing exists to shrink)"),
+    "plan.prefill_units_pruned": (
+        "counter", ("wrapper",),
+        "fused-prefill work units removed at plan time (provably "
+        "all-masked: causal chunks above the diagonal, sliding-window "
+        "chunks below the window, all-zero custom-mask windows) — MXU "
+        "work the pipelined kernel never sees"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),

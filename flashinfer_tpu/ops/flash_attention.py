@@ -12,7 +12,12 @@ kernel — they are closure specializations, the Pallas analogue of the
 reference's jinja-specialized kernel instantiations.
 
 Grid: ``(num_qo_heads, q_blocks, kv_blocks)`` with online-softmax state in
-VMEM scratch carried across the innermost kv dimension.
+VMEM scratch carried across the innermost kv dimension.  A plan-time
+block-code map hoists mask work out of the inner loop: blocks provably
+all-masked are skipped (both matmuls bypassed), blocks provably all-valid
+run an unmasked fast path (no segment/causal/window selects), and only
+genuinely mixed blocks — the diagonal and request boundaries — pay for
+in-register mask recomputation.
 """
 
 from __future__ import annotations
@@ -32,9 +37,14 @@ DEFAULT_BLOCK_KV = 512
 _NEG_INF = -1e30
 
 
+BLOCK_COMPUTE = 0  # mixed block: recompute segment/causal/window masks
+BLOCK_SKIP = 1  # provably all-masked: bypass both matmuls
+BLOCK_FULL = 2  # provably all-valid: unmasked fast path (no selects)
+
+
 def _flash_kernel(
-    # scalar-prefetch: skip map (+ ALiBi slopes when use_alibi)
-    skip_ref,  # [nq * nkv] i32: 1 = block provably all-masked, skip compute
+    # scalar-prefetch: block-code map (+ ALiBi slopes when use_alibi)
+    code_ref,  # [nq * nkv] i32: BLOCK_COMPUTE / BLOCK_SKIP / BLOCK_FULL
     *rest_all,
     sm_scale: float,
     causal: bool,
@@ -70,17 +80,20 @@ def _flash_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(skip_ref[q_idx * num_kv_blocks + kv_idx] == 0)
-    def _compute():
+    code = code_ref[q_idx * num_kv_blocks + kv_idx]
+
+    def compute(masked: bool):
+        """One online-softmax block step.  ``masked=False`` is the
+        BLOCK_FULL fast path: the plan proved every (q, kv) pair of this
+        block valid (one common segment, causal/window satisfied
+        block-wide), so no mask is materialized and no selects run — the
+        plan-time mask hoisting that keeps interior blocks MXU-bound."""
         # native-dtype (bf16) matmul on the MXU, f32 accumulation
         s = jax.lax.dot_general(
             q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, bkv] f32
         s = s * sm_scale
-        q_seg = q_seg_ref[...]  # [bq, 1]
-        kv_seg = kv_seg_ref[...]  # [1, bkv] — lane broadcast, free
-        mask = q_seg == kv_seg
         q_pos = q_pos_ref[...]
         kv_pos = kv_pos_ref[...]
         if use_alibi:
@@ -91,18 +104,23 @@ def _flash_kernel(
             s = s + slope * (kv_pos - q_pos).astype(jnp.float32)
         if logits_soft_cap > 0.0:
             s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
-        if causal:
-            mask = mask & (kv_pos <= q_pos)
-        if window_left >= 0:
-            mask = mask & (kv_pos >= q_pos - window_left)
-        s = jnp.where(mask, s, _NEG_INF)
+        if masked:
+            q_seg = q_seg_ref[...]  # [bq, 1]
+            kv_seg = kv_seg_ref[...]  # [1, bkv] — lane broadcast, free
+            mask = q_seg == kv_seg
+            if causal:
+                mask = mask & (kv_pos <= q_pos)
+            if window_left >= 0:
+                mask = mask & (kv_pos >= q_pos - window_left)
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[...][:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         # guard fully-masked rows: keep exp argument finite
         p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = alpha * l_ref[...][:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -112,6 +130,14 @@ def _flash_kernel(
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(code == BLOCK_COMPUTE)
+    def _compute_masked():
+        compute(masked=True)
+
+    @pl.when(code == BLOCK_FULL)
+    def _compute_full():
+        compute(masked=False)
 
     @pl.when(kv_idx == num_kv_blocks - 1)
     def _finalize():
@@ -193,10 +219,14 @@ def flash_attention(
     q_pos2 = q_pos.astype(jnp.int32).reshape(-1, 1)
     kv_pos2 = kv_pos.astype(jnp.int32).reshape(1, -1)
 
-    # conservative per-(q_blk, kv_blk) skip map: blocks provably all-masked
-    # bypass both matmuls (the causal/segment block-sparsity that the
-    # reference gets from its work-queue plan).  Padding maps to distinct
-    # large sentinels so pad-only blocks fall out via segment disjointness.
+    # conservative per-(q_blk, kv_blk) block-code map, the plan-time mask
+    # hoisting: blocks provably all-masked (BLOCK_SKIP) bypass both
+    # matmuls — the causal/segment block-sparsity the reference gets from
+    # its work-queue plan — and blocks provably all-VALID (BLOCK_FULL)
+    # run the unmasked fast path with no segment/causal/window selects in
+    # the inner loop.  Padding maps to distinct large sentinels so
+    # pad-only blocks fall out via segment disjointness (and can never be
+    # FULL: the q/kv sentinels differ).
     BIGQ, BIGK = 2**30, 2**30 + 5
     qss = jnp.where(q_seg2[:, 0] < 0, BIGQ, q_seg2[:, 0]).reshape(nq, bq)
     kss = jnp.where(kv_seg2[0] < 0, BIGK, kv_seg2[0]).reshape(nkv, bkv)
@@ -211,16 +241,25 @@ def flash_attention(
         & (kmin[None, :] == kmax[None, :])
         & (qmin[:, None] == kmin[None, :])
     )
+    full = single_common
     if causal:
         skip = skip | (
             single_common & (kp.min(1)[None, :] > qp.max(1)[:, None])
         )
+        # causal holds for EVERY pair iff max(kv_pos) <= min(q_pos)
+        full = full & (kp.max(1)[None, :] <= qp.min(1)[:, None])
     if window_left >= 0:
         skip = skip | (
             single_common
             & (kp.max(1)[None, :] < qp.min(1)[:, None] - window_left)
         )
-    skip_map = skip.astype(jnp.int32).reshape(-1)
+        # window holds for EVERY pair iff min(kv_pos) >= max(q_pos) - wl
+        full = full & (
+            kp.min(1)[None, :] >= qp.max(1)[:, None] - window_left
+        )
+    code_map = jnp.where(
+        skip, BLOCK_SKIP, jnp.where(full, BLOCK_FULL, BLOCK_COMPUTE)
+    ).astype(jnp.int32).reshape(-1)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -245,7 +284,7 @@ def flash_attention(
             jax.ShapeDtypeStruct((num_qo_heads, tq_pad, 128), jnp.float32)
         )
 
-    prefetch = [skip_map]
+    prefetch = [code_map]
     if alibi_slopes is not None:
         prefetch.append(
             jnp.asarray(alibi_slopes, jnp.float32).reshape(num_qo_heads)

@@ -1,13 +1,38 @@
-"""Fused paged-KV batch prefill Pallas kernel (work-unit scheduled).
+"""Fused paged-KV batch prefill Pallas kernel (pipelined work units).
 
 The TPU translation of the reference's prefill work queue
 (``PrefillPlan``/``PrefillSplitQOKVIndptr``, scheduler.cuh:545-897 +
 ``BatchPrefillWithPagedKVCacheDispatched``, prefill.cuh:4057): the plan
 splits every request into (qo-tile, kv-chunk) work units; the kernel walks
-the unit list sequentially, double-buffering the next unit's KV pages while
-computing the current one, and carries the online-softmax accumulator
-across the kv-chunks of each qo tile (reset on first-chunk, write-out on
-last-chunk flags — plan-encoded, no in-kernel scheduling).
+the unit list sequentially with an explicitly pipelined mainloop:
+
+- **Double-buffered KV streaming.** The next unit's KV pages are DMA'd
+  HBM->VMEM while the current unit's MXU dots run (two chunk slots, one
+  semaphore per page copy) — the copy never serializes with compute.
+- **Double-buffered q streaming.** q tiles are fetched once per tile (not
+  per unit) into the slot the plan assigned (``qslot``, tile parity); the
+  fetch for the next tile is issued at the current tile's last unit, so it
+  overlaps that unit's compute.  The wait lands on the next tile's first
+  unit (``first`` doubles as the q-wait flag).
+- **Plan-time mask hoisting.** ``build_prefill_work_units`` classifies
+  every unit with a block code — ``FULL`` (every position provably valid:
+  no mask math at all in-kernel), ``PARTIAL`` (bounds/causal/window
+  recomputed in-register), ``PARTIAL_MASK`` (additionally expands the
+  per-unit packed custom-mask bitmap) — and *prunes* units that are
+  provably all-masked (causal chunks above the diagonal, sliding-window
+  chunks below the window, custom-mask windows with no set bit).  The
+  inner loop never discovers dead work; the plan already removed it —
+  the same block-sparsity the reference gets from its work-queue plan.
+- **Work-unit packing.** With ``pack_tiles=True`` (default) qo tiles are
+  aligned segments of the *global* flattened token axis, so short
+  requests coalesce into full tiles: one q fetch and one output
+  write-back serve every request overlapping the tile, and each
+  (tile, request, chunk) unit masks to its row span ``[rowlo, rowhi)``.
+  Rows outside the span contribute ``p = 0, alpha = 1`` identity steps
+  to the online softmax, so packed and unpacked plans produce
+  BIT-IDENTICAL outputs (pinned by tests/test_pipelined_prefill.py).
+  Padding waste (idle unit rows / idle MXU cells) is reported through
+  the plan's ``stats`` into the obs padding-waste histograms.
 
 Grid is ``(num_kv_heads, num_units)``: each unit computes ALL q heads of
 one KV head's GQA group, so every KV page is fetched from HBM exactly once
@@ -17,12 +42,18 @@ vs the gather+flash path (prefill.py): no extra HBM round trip for KV —
 for chunked prefill (small qo vs large kv) the gather pass costs ~50% of
 the attention time, which this kernel eliminates.
 
-Correctness invariant (relied on by the partial-tile write-back): units
-are ordered by ascending qstart within each kv head, and the unit grid
-dimension executes sequentially — a partial tile's full-block output DMA
-may clobber the next request's rows, which later units then rewrite.
+Correctness invariant (relied on by the unpacked partial-tile
+write-back): units are ordered by ascending qstart, and the unit grid
+dimension executes sequentially — an unpacked partial tile's full-block
+output DMA may clobber the next request's rows, which later units then
+rewrite (packed tiles are disjoint and never clobber).
 ``build_prefill_work_units`` asserts the ordering; do not mark the unit
 dim "parallel".
+
+The plan's ``causal``/``window_left`` MUST match the kernel call's: the
+plan prunes and FULL-codes units under those rules, so a mismatched
+kernel call would double-apply or miss masking.  The paged-prefill
+wrapper passes both from one place.
 
 Hardware-validated on v5e (tests/test_tpu_hw.py — mixed ragged batch with
 append semantics vs dense oracle) and the default paged-prefill backend
@@ -44,11 +75,67 @@ from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up, tpu_compiler
 
 _NEG_INF = -1e30
 
+# plan-time block codes (the hoisted mask descriptors): the kernel
+# specializes its softmax update on these instead of recomputing
+# validity for provably-full blocks
+CODE_FULL = 0  # every (row, col) valid — no mask math in-kernel
+CODE_PARTIAL = 1  # bounds/causal/window recomputed in-register
+CODE_PARTIAL_MASK = 2  # PARTIAL + packed custom-mask bitmap expansion
+
+_POPCNT = np.array([bin(i).count("1") for i in range(256)], np.int64)
+
 
 def mask_lane_bytes(chunk_tokens: int) -> int:
     """Lane width of the per-unit packed-mask bitmap (>= 128 for Mosaic
     VMEM blocks)."""
     return max(round_up(cdiv(chunk_tokens, 8), 128), 128)
+
+
+def block_candidates(page_size: int):
+    """THE ``fused_prefill.blocks`` autotune candidate grid — consumed by
+    both the wrapper's in-run tuner (prefill.py) and the offline sweep
+    (benchmarks/bench_prefill_blocks.py) so the two can never explore
+    diverging spaces.  chunk_tokens stays <= 256: each unit unrolls 2
+    DMAs/page and ppc=16 (32 in-flight) is the on-chip-validated queue
+    ceiling — ppc=32 would be the W002 queue-unroll wedge class.
+    block_q is DMA-count-neutral, so it explores up to 512."""
+    return sorted({
+        (bq, max(1, ct // page_size))
+        for bq in (64, 128, 256, 512) for ct in (128, 256)
+    })
+
+
+def _normalize_mask(mask_flat, mask_total_bits, qo_indptr, kv_lens):
+    """Validate the flat mask concat; -> (unpacked bool bits, the
+    caller's original packed/bool form for the zero-repack native path,
+    total_bits, per-request bit offsets).
+
+    The bool view feeds the plan-time classification (mask summaries,
+    pruning); the original form goes straight to the C++ planner, which
+    reads LSB-first packed bytes directly — re-packing the bool view
+    would be an O(total bits) pass on the hottest host-plan loop."""
+    if mask_total_bits is None:
+        if mask_flat.dtype == np.uint8:
+            raise ValueError(
+                "packed mask bytes require mask_total_bits (the byte "
+                "count is 8x short and would truncate the mask)"
+            )
+        mask_total_bits = int(mask_flat.size)
+    if mask_flat.dtype == np.uint8:
+        native_form = mask_flat.reshape(-1)
+        bits = np.unpackbits(
+            native_form, bitorder="little"
+        )[:mask_total_bits].astype(bool)
+    else:
+        bits = np.asarray(mask_flat, bool).reshape(-1)
+        native_form = bits
+    offsets = np.concatenate(
+        [[0], np.cumsum(
+            (qo_indptr[1:] - qo_indptr[:-1]).astype(np.int64)
+            * np.asarray(kv_lens, np.int64)
+        )]
+    )
+    return bits, native_form, int(mask_total_bits), offsets
 
 
 def build_prefill_work_units(
@@ -62,142 +149,278 @@ def build_prefill_work_units(
     mask_flat: Optional[np.ndarray] = None,  # concat per-request [qo*kv]:
     #   bool bits, or uint8 LSB-first packed bytes (+ mask_total_bits)
     mask_total_bits: Optional[int] = None,
+    *,
+    causal: bool = True,
+    window_left: int = -1,
+    pack_tiles: bool = True,
+    prune: bool = True,
 ):
-    """Host-side plan: flatten (request, qo-tile, kv-chunk) units.
+    """Host-side plan: flatten (qo-tile, request, kv-chunk) work units.
 
     Returns a dict of numpy arrays padded to a power-of-two unit count
-    (padding units have qlen 0 and last=0 so they neither write nor
-    corrupt), plus the static (block_q, pages_per_chunk) the arrays were
-    built for.
+    (padding units have ``first=0, wout=0`` and an empty row span so
+    they neither write nor corrupt), plus the static (block_q,
+    pages_per_chunk) the arrays were built for and a ``stats`` dict
+    (unit counts before/after pruning, row/MXU-cell fill — the
+    padding-waste numbers the obs histograms report).
 
-    With ``mask_flat`` (MaskMode::CUSTOM, the reference's flat
-    per-request mask concat, prefill.py:1492), each unit additionally
-    gets its window of the mask re-packed as a little-endian byte bitmap
-    ``mask_bytes [num_units, block_q, mask_lane_bytes(chunk)]``, shaped
-    for a direct per-unit VMEM fetch; the kernel expands bits in-register
-    (selector dot + shifts), so no dense [qo, kv] array ever exists on
-    device (reference analogue: packed_custom_mask consumed inside the
-    kernel, prefill.cuh:2682).  Byte budget per unit is
-    ``block_q * max(128, chunk/8)`` — the 128-lane Mosaic floor means the
-    bit-packing only wins HBM bytes over a dense bool tile at
-    chunk_tokens > 1024; at the default chunk of 128-256 the win is the
-    in-kernel consumption (no [tq_pad, tkv_pad] dense mask built or
-    shipped), not the packing."""
+    Per-unit fields: ``qstart`` (q-tile token start), ``rowlo``/``rowhi``
+    (this unit's request's row span within the tile), ``qpos0``
+    (absolute q position of tile row 0 for that request, may be
+    negative), ``kvstart``/``kvlen``, ``first`` (first unit of its tile:
+    accumulator reset + q-DMA wait), ``wout`` (last unit of its tile:
+    output write-back), ``qslot`` (q double-buffer slot, tile parity),
+    ``code`` (CODE_FULL / CODE_PARTIAL / CODE_PARTIAL_MASK — the
+    plan-time mask descriptor), ``pages``.
+
+    ``causal``/``window_left`` feed the plan-time pruning and FULL
+    classification and must match the kernel call (the wrapper passes
+    both from the same plan).  A custom mask replaces causal (the
+    reference MaskMode::CUSTOM rule); window still ANDs in.
+
+    With ``mask_flat`` (the reference's flat per-request mask concat,
+    prefill.py:1492), each unit additionally gets its window of the mask
+    re-packed as a little-endian byte bitmap ``mask_bytes [num_units,
+    block_q, mask_lane_bytes(chunk)]``, shaped for a direct per-unit
+    VMEM fetch; the kernel expands bits in-register (selector dot +
+    shifts), so no dense [qo, kv] array ever exists on device (reference
+    analogue: packed_custom_mask consumed inside the kernel,
+    prefill.cuh:2682).  All-ones windows are demoted to CODE_PARTIAL
+    (no expansion) and all-zero windows are pruned, so the expansion
+    dot only runs where the mask actually cuts.  The per-unit re-pack is
+    the hottest host-plan loop; when the unit enumeration is canonical
+    (``pack_tiles=False`` or every qo_len a multiple of ``block_q``) it
+    runs in the C++ planner (csrc/planner.cpp prefill_mask_plan) and the
+    per-unit bitmaps are row-selected from its output after pruning."""
     chunk_tokens = pages_per_chunk * page_size
-    units = []  # (qstart, qlen, qpos0, kvstart, kvlen_req, first, last, pages)
-    unit_masks = []  # packed [block_q, ceil(chunk/8)] per unit (numpy path)
-    use_native_mask = False
-    mask_offsets = None
     if mask_flat is not None:
-        from flashinfer_tpu import native
-
-        if mask_total_bits is None:
-            if mask_flat.dtype == np.uint8:
-                raise ValueError(
-                    "packed mask bytes require mask_total_bits (the byte "
-                    "count is 8x short and would truncate the mask)"
-                )
-            mask_total_bits = int(mask_flat.size)
-        # the per-unit re-pack touches every mask bit of every tile — the
-        # hottest host-plan loop; the C++ planner does it with two shifts
-        # per output byte straight from the packed bytes (numpy per-tile
-        # packbits is the fallback, which needs the unpacked bool form)
-        use_native_mask = native.get_lib() is not None
-        if not use_native_mask:
-            if mask_flat.dtype == np.uint8:
-                mask_flat = np.unpackbits(
-                    mask_flat.reshape(-1), bitorder="little"
-                )[:mask_total_bits].astype(bool)
-            mask_offsets = np.concatenate(
-                [[0], np.cumsum(
-                    (qo_indptr[1:] - qo_indptr[:-1]).astype(np.int64)
-                    * np.asarray(kv_lens, np.int64)
-                )]
-            )
+        causal = False  # MaskMode::CUSTOM replaces causal (window ANDs)
+        mask_bits, mask_native, mask_total_bits, mask_offsets = \
+            _normalize_mask(mask_flat, mask_total_bits, qo_indptr, kv_lens)
     B = len(qo_indptr) - 1
+    qo_lens = [int(qo_indptr[r + 1]) - int(qo_indptr[r]) for r in range(B)]
+    # canonical enumeration (per-request tiles) == packed enumeration iff
+    # every request's qo span tiles without crossing a block_q boundary
+    aligned = all(
+        int(qo_indptr[r]) % block_q == 0 for r in range(B) if qo_lens[r] > 0
+    )
+    packed = pack_tiles and not aligned
+
+    # ---- enumerate (tile, request) row spans ----------------------------
+    # span: (tile_start, rowlo, rowhi, request)
+    spans = []
     for r in range(B):
+        qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+        if qe <= qs:
+            continue
+        if packed:
+            t0, t1 = qs // block_q, (qe - 1) // block_q
+            for t in range(t0, t1 + 1):
+                ts = t * block_q
+                spans.append((ts, max(qs - ts, 0),
+                              min(qe - ts, block_q), r))
+        else:
+            for t in range(cdiv(qe - qs, block_q)):
+                ts = qs + t * block_q
+                spans.append((ts, 0, min(block_q, qe - ts), r))
+    spans.sort(key=lambda s: (s[0], s[3]))
+
+    # ---- classify + prune (canonical index kept for the native-mask
+    #      row selection) ---------------------------------------------------
+    # unit: [qstart, rowlo, rowhi, qpos0, kvstart, kvlen, code, pages,
+    #        tile_key, canon_idx]
+    units = []
+    canon_idx = 0
+    n_pruned = 0
+    wl = int(window_left)
+    for ts, rowlo, rowhi, r in spans:
         qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
         kv_len = int(kv_lens[r])
         pages = kv_page_indices[
             int(kv_page_indptr[r]) : int(kv_page_indptr[r + 1])
         ]
-        if (mask_flat is not None and not use_native_mask
-                and qe > qs and kv_len > 0):
-            req_mask = np.asarray(
-                mask_flat[mask_offsets[r] : mask_offsets[r + 1]], bool
-            ).reshape(qe - qs, kv_len)
+        qpos0 = kv_len - (qe - qs) + (ts - qs)
+        n_chunks = max(cdiv(kv_len, chunk_tokens), 1) if kv_len > 0 else 1
+        if mask_flat is not None and kv_len > 0:
+            req_mask = mask_bits[
+                mask_offsets[r] : mask_offsets[r + 1]
+            ].reshape(qe - qs, kv_len)
         else:
             req_mask = None
-        n_tiles = max(cdiv(qe - qs, block_q), 1) if qe > qs else 0
-        n_chunks = max(cdiv(kv_len, chunk_tokens), 1) if kv_len > 0 else 1
-        for t in range(n_tiles):
-            qstart = qs + t * block_q
-            qlen = min(block_q, qe - qstart)
-            qpos0 = kv_len - (qe - qs) + t * block_q
-            for c in range(n_chunks):
-                pg = pages[c * pages_per_chunk : (c + 1) * pages_per_chunk]
-                pg = np.pad(pg, (0, pages_per_chunk - len(pg)))
-                units.append((
-                    qstart, qlen, qpos0, c * chunk_tokens, kv_len,
-                    1 if c == 0 else 0, 1 if c == n_chunks - 1 else 0, pg,
-                ))
-                if mask_flat is not None and not use_native_mask:
-                    tile = np.zeros((block_q, chunk_tokens), bool)
-                    if req_mask is not None:
-                        r0 = qstart - qs
-                        c0 = c * chunk_tokens
-                        w = min(chunk_tokens, kv_len - c0)
-                        tile[:qlen, :w] = req_mask[
-                            r0 : r0 + qlen, c0 : c0 + w
-                        ]
-                    # pack per tile: keeps transient host memory at the
-                    # packed size instead of 8x unpacked bools for the
-                    # whole unit list (matters at 64k+ units)
-                    unit_masks.append(
-                        np.packbits(tile, axis=-1, bitorder="little")
-                    )
-    # the partial-tile write-back rewrite depends on ascending qstart order
+        kept_any = False
+        for c in range(n_chunks):
+            kvstart = c * chunk_tokens
+            ci = canon_idx
+            canon_idx += 1
+            w = min(chunk_tokens, kv_len - kvstart)
+            qp_first = qpos0 + rowlo
+            qp_last = qpos0 + rowhi - 1
+            # ---- provably-all-masked? -> prune (the hoisted skip) ----
+            skip = w <= 0
+            if causal and not skip:
+                skip = kvstart > qp_last
+            if wl >= 0 and not skip:
+                skip = kvstart + w - 1 < qp_first - wl
+            sub = None
+            if req_mask is not None and not skip:
+                sub = req_mask[ts + rowlo - qs : ts + rowhi - qs,
+                               kvstart : kvstart + w]
+                skip = not bool(sub.any())
+            if skip and prune:
+                n_pruned += 1
+                continue
+            # ---- provably-full? -> CODE_FULL (no in-kernel masking) ----
+            full = (rowlo == 0 and rowhi == block_q and w == chunk_tokens)
+            if full and causal:
+                full = kvstart + w - 1 <= qp_first
+            if full and wl >= 0:
+                full = kvstart >= qp_last - wl
+            if full and sub is not None:
+                full = bool(sub.all())
+            if full:
+                code = CODE_FULL
+            elif sub is not None and not bool(sub.all()):
+                code = CODE_PARTIAL_MASK
+            else:
+                code = CODE_PARTIAL
+            pg = pages[c * pages_per_chunk : (c + 1) * pages_per_chunk]
+            pg = np.pad(pg, (0, pages_per_chunk - len(pg)))
+            units.append([ts, rowlo, rowhi, qpos0, kvstart, kv_len, code,
+                          pg, ts if packed else (ts, r), ci])
+            kept_any = True
+        if not kept_any:
+            # every chunk pruned (e.g. kv_len == 0): the tile still needs
+            # an accumulator reset + write-back so those rows emit zeros
+            # (attention over the empty set) instead of uninitialized HBM
+            units.append([ts, rowlo, rowlo, qpos0, 0, 0, CODE_PARTIAL,
+                          np.zeros(pages_per_chunk, np.int64),
+                          ts if packed else (ts, r), -1])
+
+    # ---- first/wout flags + q slots per tile -----------------------------
+    first = [0] * len(units)
+    wout = [0] * len(units)
+    qslot = [0] * len(units)
+    tile_ord = -1
+    prev_key = object()
+    for i, u in enumerate(units):
+        if u[8] != prev_key:
+            tile_ord += 1
+            first[i] = 1
+            if i > 0:
+                wout[i - 1] = 1
+            prev_key = u[8]
+        qslot[i] = tile_ord % 2
+    if units:
+        wout[-1] = 1
+
+    # the (unpacked) partial-tile write-back rewrite depends on ascending
+    # qstart order; packed tiles are disjoint but keep the same ordering
     starts = [u[0] for u in units]
     assert starts == sorted(starts), "work units must be qstart-ordered"
-    U = max(next_power_of_two(max(len(units), 1)), 8)
-    # pad units: first=1 (reset, harmless), last=0 (MUST NOT write output)
-    pad_unit = (0, 0, 0, 0, 0, 1, 0, np.zeros(pages_per_chunk, np.int64))
+
+    n_real = len(units)
+    U = max(next_power_of_two(max(n_real, 1)), 8)
+    stats = {
+        "units": n_real,
+        "units_canonical": canon_idx,
+        "units_pruned": n_pruned,
+        "tiles": tile_ord + 1,
+        "packed": bool(packed),
+        "unit_rows_total": n_real * block_q,
+        "unit_rows_valid": int(sum(u[2] - u[1] for u in units)),
+        "mxu_cells_total": n_real * block_q * chunk_tokens,
+        "mxu_cells_valid": int(sum(
+            (u[2] - u[1]) * max(min(chunk_tokens, u[5] - u[4]), 0)
+            for u in units
+        )),
+    }
+    # pad units: first=0 (no q fetch/wait), wout=0 (never write), empty
+    # row span + kvlen 0 (identity online-softmax steps)
+    pad_unit = [0, 0, 0, 0, 0, 0, CODE_PARTIAL,
+                np.zeros(pages_per_chunk, np.int64), None, -1]
     while len(units) < U:
         units.append(pad_unit)
-        if mask_flat is not None and not use_native_mask:
-            unit_masks.append(
-                np.zeros((block_q, cdiv(chunk_tokens, 8)), np.uint8)
-            )
+        first.append(0)
+        wout.append(0)
+        qslot.append(0)
+
     arr = lambda i, dt: np.asarray([u[i] for u in units], dt)
     plan = dict(
-        qstart=arr(0, np.int32), qlen=arr(1, np.int32), qpos0=arr(2, np.int32),
-        kvstart=arr(3, np.int32), kvlen=arr(4, np.int32),
-        first=arr(5, np.int32), last=arr(6, np.int32),
+        qstart=arr(0, np.int32), rowlo=arr(1, np.int32),
+        rowhi=arr(2, np.int32), qpos0=arr(3, np.int32),
+        kvstart=arr(4, np.int32), kvlen=arr(5, np.int32),
+        first=np.asarray(first, np.int32), wout=np.asarray(wout, np.int32),
+        qslot=np.asarray(qslot, np.int32), code=arr(6, np.int32),
         pages=np.stack([u[7] for u in units]).astype(np.int32).reshape(-1),
         num_units=U,
         block_q=block_q,
         pages_per_chunk=pages_per_chunk,
+        stats=stats,
     )
     if mask_flat is not None:
-        mb = mask_lane_bytes(chunk_tokens)
-        if use_native_mask:
-            plan["mask_bytes"] = native.prefill_mask_plan(
-                mask_flat, mask_total_bits,
-                qo_indptr, np.asarray(kv_lens, np.int64),
-                block_q, chunk_tokens, mb, U,
-            )
-        else:
-            packed = np.stack(unit_masks)  # [U, block_q, ceil(chunk/8)]
-            plan["mask_bytes"] = np.pad(
-                packed, ((0, 0), (0, 0), (0, mb - packed.shape[-1]))
-            )
+        plan["mask_bytes"] = _build_unit_masks(
+            units, U, qo_indptr, kv_lens, mask_bits, mask_native,
+            mask_total_bits, mask_offsets, block_q, chunk_tokens, packed,
+            canon_idx,
+        )
     return plan
 
 
+def _build_unit_masks(units, U, qo_indptr, kv_lens, mask_bits, mask_native,
+                      mask_total_bits, mask_offsets, block_q, chunk_tokens,
+                      packed, n_canonical):
+    """Per-unit packed bitmaps [U, block_q, mask_lane_bytes].
+
+    Canonical enumeration -> the C++ planner builds bitmaps for ALL
+    canonical units in one pass and the kept units row-select from it
+    (pruning removes whole units, never rewrites a bitmap); packed
+    enumeration (tile rows offset into the request) -> numpy per-tile
+    extraction."""
+    from flashinfer_tpu import native
+
+    mb = mask_lane_bytes(chunk_tokens)
+    out = np.zeros((U, block_q, mb), np.uint8)
+    if not packed and native.get_lib() is not None:
+        # mask_native is the caller's ORIGINAL packed-bytes form when one
+        # was supplied — the C++ planner reads LSB-first bytes directly,
+        # so the bool view never round-trips through packbits here
+        canon = native.prefill_mask_plan(
+            mask_native, mask_total_bits,
+            qo_indptr, np.asarray(kv_lens, np.int64),
+            block_q, chunk_tokens, mb, max(n_canonical, 1),
+        )
+        for i, u in enumerate(units):
+            if u[9] >= 0:
+                out[i] = canon[u[9]]
+        return out
+    for i, u in enumerate(units):
+        ts, rowlo, rowhi, _qpos0, kvstart, kv_len, _code, _pg, key, ci = u
+        if ci < 0 or rowhi <= rowlo or kv_len <= kvstart:
+            continue
+        r = key[1] if isinstance(key, tuple) else None
+        if r is None:
+            # packed tile key carries no request id; recover it from the
+            # row span (rows [ts+rowlo, ts+rowhi) lie inside one request)
+            tok = ts + rowlo
+            r = int(np.searchsorted(qo_indptr, tok, side="right") - 1)
+        qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+        req = mask_bits[mask_offsets[r] : mask_offsets[r + 1]].reshape(
+            qe - qs, kv_len
+        )
+        w = min(chunk_tokens, kv_len - kvstart)
+        tile = np.zeros((block_q, chunk_tokens), bool)
+        tile[rowlo:rowhi, :w] = req[
+            ts + rowlo - qs : ts + rowhi - qs, kvstart : kvstart + w
+        ]
+        packed_tile = np.packbits(tile, axis=-1, bitorder="little")
+        out[i, :, : packed_tile.shape[-1]] = packed_tile
+    return out
+
+
 def _fused_prefill_kernel(
-    # scalar prefetch
-    qstart_ref, qlen_ref, qpos0_ref, kvstart_ref, kvlen_ref,
-    first_ref, last_ref, pages_ref,
+    # scalar prefetch (the plan)
+    qstart_ref, rowlo_ref, rowhi_ref, qpos0_ref, kvstart_ref, kvlen_ref,
+    first_ref, wout_ref, qslot_ref, code_ref, pages_ref,
     # inputs: q/k/v in ANY (manual DMA); with has_mask, a pipelined
     # per-unit packed-mask block [bq, mask_lane_bytes] uint8 follows
     *refs,
@@ -251,56 +474,106 @@ def _fused_prefill_kernel(
                 v_hbm.at[page, hkv], vbuf.at[slot, dst, :], vsem.at[slot, j]))
         return dmas
 
-    def q_dma(unit):
+    def q_dma(unit, slot):
         # all q heads of this kv head's group in one DMA: q is laid out
         # [Hkv, tq, group, D] by the wrapper so the head dim is a full
         # index, not a partial sublane slice (Mosaic requires 8-aligned
         # sublane slices; group can be 4)
         return pltpu.make_async_copy(
             q_hbm.at[hkv, pl.ds(qstart_ref[unit], bq)],
-            qbuf, qsem,
+            qbuf.at[slot], qsem.at[slot],
         )
 
-    # this unit's q fetch (single buffer: fetched and consumed per step)
-    q_dma(u).start()
+    # guarded next-unit index (scalar arrays are exactly num_units long)
+    nxt = jnp.minimum(u + 1, num_units - 1)
 
-    # KV double buffering: unit 0 warm-up, then prefetch u+1 into the
-    # other slot while computing u
+    # warm-up: unit 0's q tile (only if unit 0 opens a tile — an
+    # all-padding plan must not leave an unwaited DMA) + its KV chunk
+    @pl.when(jnp.logical_and(u == 0, first_ref[0] == 1))
+    def _():
+        q_dma(0, qslot_ref[0]).start()
+
     @pl.when(u == 0)
     def _():
         for d in kv_dmas(0, 0):
             d.start()
 
+    # pipelined prefetch: next tile's q (issued at this tile's last unit,
+    # overlapping this unit's compute) and next unit's KV chunk
+    @pl.when(jnp.logical_and(u + 1 < num_units, first_ref[nxt] == 1))
+    def _():
+        q_dma(nxt, qslot_ref[nxt]).start()
+
     @pl.when(u + 1 < num_units)
     def _():
-        for d in kv_dmas(u + 1, jax.lax.rem(u + 1, 2)):
+        for d in kv_dmas(nxt, jax.lax.rem(u + 1, 2)):
             d.start()
 
     slot = jax.lax.rem(u, 2)
-    q_dma(u).wait()
-    for d in kv_dmas(u, slot):
-        d.wait()
+    qslot = qslot_ref[u]
 
     @pl.when(first_ref[u] == 1)
     def _():
+        q_dma(u, qslot).wait()
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
+
+    for d in kv_dmas(u, slot):
+        d.wait()
 
     # the whole GQA group rides one MXU dot: merged rows r = q_row*group+g,
     # so the q-row of merged row r is r // group (computed by iota, no
     # relayout), and [bq*group, D] -> [bq, group, D] is a free reshape
     bqg = bq * group
-    rows_q = jax.lax.broadcasted_iota(jnp.int32, (bqg, 1), 0) // group
-    cols = jax.lax.broadcasted_iota(jnp.int32, (1, chunk_tokens), 1)
-    q_pos = qpos0_ref[u] + rows_q
-    kv_pos = kvstart_ref[u] + cols
-    valid = (rows_q < qlen_ref[u]) & (kv_pos < kvlen_ref[u])
-    if causal:
-        valid = valid & (kv_pos <= q_pos)
-    if window_left >= 0:
-        valid = valid & (kv_pos >= q_pos - window_left)
-    if has_mask:
+    k = kbuf[slot]
+    v = vbuf[slot]
+    qm = qbuf[qslot].reshape(bqg, k.shape[-1])  # [bq*group, D]
+    s = jax.lax.dot_general(
+        qm, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale  # [bq*group, chunk]
+    if logits_soft_cap > 0.0:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+
+    def online_update(valid):
+        """One online-softmax step; ``valid=None`` is the CODE_FULL fast
+        path (no mask materialized, no selects — the MFU path the
+        plan-time hoisting exists to reach)."""
+        s_ = s if valid is None else jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+        p = jnp.exp(s_ - m_new)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[...][:, :1] + jnp.sum(p, -1, keepdims=True),
+            (bqg, 128),
+        )
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, (bqg, 128))
+
+    def bounds_valid():
+        rows_q = jax.lax.broadcasted_iota(jnp.int32, (bqg, 1), 0) // group
+        cols = jax.lax.broadcasted_iota(jnp.int32, (1, chunk_tokens), 1)
+        q_pos = qpos0_ref[u] + rows_q
+        kv_pos = kvstart_ref[u] + cols
+        valid = (
+            (rows_q >= rowlo_ref[u]) & (rows_q < rowhi_ref[u])
+            & (kv_pos < kvlen_ref[u])
+        )
+        if causal:
+            valid = valid & (kv_pos <= q_pos)
+        if window_left >= 0:
+            valid = valid & (kv_pos >= q_pos - window_left)
+        return valid
+
+    def mask_bits():
         # expand the packed per-unit bitmap in-register.  Lane-dim
         # byte->column expansion is an unsupported Mosaic shape cast, so
         # it rides a constant selector-matrix MXU dot (byte values <= 255
@@ -323,38 +596,31 @@ def _fused_prefill_kernel(
         bit = (byte_col.astype(jnp.int32) >> shift) & 1  # [bq, chunk]
         # q-row -> merged GQA rows: sublane-side broadcast + free
         # leading-dim reshape (lane dim untouched)
-        bit_g = jnp.broadcast_to(
+        return jnp.broadcast_to(
             (bit > 0).reshape(bq, 1, chunk_tokens),
             (bq, group, chunk_tokens),
         ).reshape(bqg, chunk_tokens)
-        valid = valid & bit_g
 
-    k = kbuf[slot]
-    v = vbuf[slot]
-    qm = qbuf[...].reshape(bqg, k.shape[-1])  # [bq*group, D]
-    s = jax.lax.dot_general(
-        qm, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * sm_scale  # [bq*group, chunk]
-    if logits_soft_cap > 0.0:
-        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
-    s = jnp.where(valid, s, _NEG_INF)
-    m_prev = m_ref[...][:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_ref[...] = jnp.broadcast_to(
-        alpha * l_ref[...][:, :1] + jnp.sum(p, -1, keepdims=True),
-        (bqg, 128),
-    )
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_ref[...] = acc_ref[...] * alpha + pv
-    m_ref[...] = jnp.broadcast_to(m_new, (bqg, 128))
+    code = code_ref[u]
 
-    @pl.when((last_ref[u] == 1) & (qlen_ref[u] > 0))
+    @pl.when(code == CODE_FULL)
+    def _():
+        online_update(None)
+
+    if has_mask:
+        @pl.when(code == CODE_PARTIAL)
+        def _():
+            online_update(bounds_valid())
+
+        @pl.when(code == CODE_PARTIAL_MASK)
+        def _():
+            online_update(bounds_valid() & mask_bits())
+    else:
+        @pl.when(code != CODE_FULL)
+        def _():
+            online_update(bounds_valid())
+
+    @pl.when(wout_ref[u] == 1)
     def _():
         l = l_ref[...][:, :1]
         o = (acc_ref[...] / jnp.where(l > 0, l, 1.0)).astype(obuf.dtype)
@@ -443,19 +709,19 @@ def fused_paged_prefill(
             (Hkv, cdiv(num_units, 8), 8, 128), jnp.int32
         )]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=8,
+        num_scalar_prefetch=11,
         grid=(Hkv, num_units),
         in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((block_q, group, D), q.dtype),
+            pltpu.VMEM((2, block_q, group, D), q.dtype),
             pltpu.VMEM((2, chunk_tokens, D), k_cache.dtype),
             pltpu.VMEM((2, chunk_tokens, D), v_cache.dtype),
             pltpu.VMEM((block_q, group, D), q.dtype),
             pltpu.VMEM((block_q * group, D), jnp.float32),
             pltpu.VMEM((block_q * group, 128), jnp.float32),
             pltpu.VMEM((block_q * group, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
             pltpu.SemaphoreType.DMA(()),
@@ -480,8 +746,9 @@ def fused_paged_prefill(
         ),
         interpret=use_interpret(),
     )(
-        plan["qstart"], plan["qlen"], plan["qpos0"], plan["kvstart"],
-        plan["kvlen"], plan["first"], plan["last"], plan["pages"],
+        plan["qstart"], plan["rowlo"], plan["rowhi"], plan["qpos0"],
+        plan["kvstart"], plan["kvlen"], plan["first"], plan["wout"],
+        plan["qslot"], plan["code"], plan["pages"],
         *operands,
     )
     if trace_events:

@@ -91,6 +91,20 @@ _FLASH_BLOCK_CANDIDATES = (
 )
 
 
+def flash_block_key(total_q, total_kv, num_qo_heads, num_kv_heads,
+                    head_dim, dtype, causal) -> tuple:
+    """The ``flash_attention.blocks`` tactic key for a shape — pow2-
+    bucketed token axes keep the key space finite and make shipped-config
+    keys hit across nearby lengths.  THE key builder: ``_tuned_flash``
+    and bench.py's block-metadata lookup both call it, so the bench can
+    never bank metadata under a desynced hand-copied key."""
+    return (
+        next_power_of_two(max(int(total_q), 16)),
+        next_power_of_two(max(int(total_kv), 128)),
+        num_qo_heads, num_kv_heads, head_dim, str(dtype), int(causal),
+    )
+
+
 def _tuned_flash(
     q, k, v, q_seg, kv_seg, q_pos, kv_pos, *,
     causal, sm_scale, logits_soft_cap, window_left, return_lse,
@@ -111,12 +125,9 @@ def _tuned_flash(
     )
     if alibi_slopes is not None:
         kwargs["alibi_slopes"] = alibi_slopes
-    # pow2-bucketed token axes keep the tactic key space finite and make
-    # shipped-config keys hit across nearby lengths
-    key = (
-        next_power_of_two(max(q.shape[0], 16)),
-        next_power_of_two(max(k.shape[0], 128)),
-        q.shape[1], k.shape[1], q.shape[2], str(q.dtype), int(causal),
+    key = flash_block_key(
+        q.shape[0], k.shape[0], q.shape[1], k.shape[1], q.shape[2],
+        q.dtype, causal,
     )
     bq, bkv = AutoTuner.get().choose_one(
         "flash_attention.blocks", key, _FLASH_BLOCK_CANDIDATES,
@@ -791,6 +802,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 np.asarray(qo_indptr), np.asarray(kv_indptr_pages),
                 np.asarray(kv_indices), np.asarray(kv_lens), page_size,
                 fused_key, mask_flat, mask_total_bits,
+                causal, window_left,
             )
             self._fused_tuned = False
             units = build_prefill_work_units(
@@ -798,12 +810,17 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 block_q=int(bq_u), pages_per_chunk=int(ppc_u),
                 page_size=page_size, mask_flat=mask_flat,
                 mask_total_bits=mask_total_bits,
+                # the plan prunes + FULL-codes units under the SAME
+                # causal/window the kernel will run with (paged_prefill
+                # module contract)
+                causal=causal, window_left=window_left,
             )
             statics = dict(
                 num_units=units.pop("num_units"),
                 block_q=units.pop("block_q"),
                 pages_per_chunk=units.pop("pages_per_chunk"),
             )
+            fused_stats = units.pop("stats")
             self._fused_plan = (
                 {k: jnp.asarray(v) for k, v in units.items()}, statics,
             )
@@ -823,14 +840,43 @@ class BatchPrefillWithPagedKVCacheWrapper:
             )
         else:
             self._fused_plan = None
+            fused_stats = None
             self._plan = build_gather_plan()
         from flashinfer_tpu import obs
 
+        # work-unit fill axes ride the same padding-waste histograms the
+        # token axes use, so the packing win (ISSUE 3 tentpole d) is
+        # measurable: unit_rows = idle qo-tile rows across all units,
+        # mxu_cells = idle (row, kv-col) positions across all MXU dots
+        unit_axes = ()
+        if fused_stats is not None:
+            unit_axes = (
+                ("prefill_unit_rows", fused_stats["unit_rows_total"],
+                 fused_stats["unit_rows_valid"]),
+                ("prefill_mxu_cells", fused_stats["mxu_cells_total"],
+                 fused_stats["mxu_cells_valid"]),
+            )
+            if fused_stats["units_pruned"]:
+                obs.counter_inc(
+                    "plan.prefill_units_pruned",
+                    fused_stats["units_pruned"],
+                    wrapper=type(self).__name__,
+                )
         obs.record_plan(
             self, replan=replan,
             padded_vs_actual=(("q_tokens", tq_pad, int(qo_indptr[-1])),
-                              ("kv_tokens", tkv_pad, int(kv_indptr[-1]))),
+                              ("kv_tokens", tkv_pad, int(kv_indptr[-1])),
+                              *unit_axes),
         )
+
+    @property
+    def fused_prefill_config(self) -> Optional[dict]:
+        """The live fused-path launch config (block_q / pages_per_chunk /
+        num_units) or None on the gather path — bench rows carry this as
+        block-config metadata (docs/performance.md)."""
+        if self._fused_plan is None:
+            return None
+        return dict(self._fused_plan[1])
 
     def _rebind_sm_scale(self, *, absolute=None, multiplier=None):
         """Per-call sm_scale override: swap in a plan with the new scale
@@ -927,27 +973,28 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 )
 
                 (qo_i, kvp_i, kvi_i, kvl_i, ps, fkey, mflat,
-                 mbits) = self._fused_raw
-                # ct stays <= 256: each unit unrolls 2 DMAs/page, and
-                # ppc=16 (32 in-flight) is the on-chip-validated ceiling —
-                # ppc=32 would be the W002 queue-unroll wedge class.
-                # bq is DMA-count-neutral, so it explores up to 512.
-                cands = sorted({
-                    (bq_c, max(1, ct // ps))
-                    for bq_c in (64, 128, 256, 512) for ct in (128, 256)
-                })
+                 mbits, causal_p, wl_p) = self._fused_raw
+                from flashinfer_tpu.ops.paged_prefill import (
+                    block_candidates,
+                )
+
+                # the shared grid (W002-safe chunk ceiling documented at
+                # the definition) — the offline sweep explores the same
+                cands = block_candidates(ps)
 
                 def _build(c):
                     u = build_prefill_work_units(
                         qo_i, kvp_i, kvi_i, kvl_i,
                         block_q=c[0], pages_per_chunk=c[1], page_size=ps,
                         mask_flat=mflat, mask_total_bits=mbits,
+                        causal=causal_p, window_left=wl_p,
                     )
                     st = dict(
                         num_units=u.pop("num_units"),
                         block_q=u.pop("block_q"),
                         pages_per_chunk=u.pop("pages_per_chunk"),
                     )
+                    u.pop("stats")
                     return {k2: jnp.asarray(v2) for k2, v2 in u.items()}, st
 
                 def _runner(c):

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "slow: excluded from the tier-1 run (-m 'not slow') — multi-minute "
         "subprocess benches and similar",
     )
+    config.addinivalue_line(
+        "markers",
+        "quick: ~10-minute representative tier — one test per public "
+        "surface, the reviewer-reproducible surface proof "
+        "(`python -m pytest tests/ -m quick`; runner line in ROADMAP.md)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -10,6 +10,7 @@ def _silu(x):
     return x / (1 + np.exp(-x))
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 @pytest.mark.parametrize("d", [128, 1408])
 def test_silu_and_mul(dtype, d):

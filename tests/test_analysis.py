@@ -16,7 +16,8 @@ import pytest
 
 from flashinfer_tpu import analysis
 from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
-                                     obs_coverage, signature_parity)
+                                     obs_coverage, signature_parity,
+                                     tuning_schema)
 from flashinfer_tpu.analysis.core import Project, load_source
 
 PKG_ROOT = os.path.abspath(
@@ -685,6 +686,7 @@ def test_unparseable_source_is_l999_not_a_crash():
     assert [f.code for f in findings] == ["L999"]
 
 
+@pytest.mark.quick
 def test_whole_tree_findings_subset_of_committed_baseline():
     """THE tier-1 CI gate: the shipped tree has no findings beyond the
     committed, triaged baseline — and the baseline carries no stale
@@ -809,3 +811,97 @@ def test_wedge_lint_shim_surface():
     assert wl.WedgeLintError is wedge.WedgeLintError
     assert wl.Finding is analysis.Finding
     assert wl.DOT_UNROLL_LIMIT == wedge.DOT_UNROLL_LIMIT
+
+
+# ------------------------------------------------------ L006 tuning_schema --
+
+
+def _staged_config(tmp_path, payload):
+    """A synthetic project dir: one analyzed module + a tuning_configs
+    JSON next to it (the pass discovers configs project-relative)."""
+    pkg = tmp_path / "pkg"
+    (pkg / "tuning_configs").mkdir(parents=True)
+    (pkg / "mod.py").write_text("x = 1\n")
+    cfg = pkg / "tuning_configs" / "gen.json"
+    cfg.write_text(payload if isinstance(payload, str)
+                   else json.dumps(payload))
+    return Project.from_paths([str(pkg)]), str(cfg)
+
+
+def test_l006_valid_flat_and_section_entries_pass(tmp_path):
+    project, _ = _staged_config(tmp_path, {
+        "tactics": {"rmsnorm.row_block|1024_4096_bfloat16": 256},
+        "prefill": {
+            "seed": True,
+            "tactics": {
+                "fused_prefill.blocks|8_4096_32_8_128_16": [256, 16],
+                "mla_decode.layout|a_b": "split",
+            },
+        },
+    })
+    assert tuning_schema.run(project) == []
+
+
+def test_l006_stale_and_malformed_entries_flagged(tmp_path):
+    project, cfg = _staged_config(tmp_path, {
+        "tactics": {
+            "renamed_op.blocks|8_4096": [128, 8],       # unknown knob
+            "fused_prefill.blocks|8_4096": [128],       # wrong arity
+            "mla_decode.layout|a": "interleaved",       # not in choices
+            "rmsnorm.row_block": 128,                   # no shape part
+        },
+    })
+    findings = tuning_schema.run(project)
+    assert [f.code for f in findings] == ["L006"] * 4
+    assert all(f.filename == cfg for f in findings)
+    by_func = {f.func: f.message for f in findings}
+    assert "unknown autotuner knob" in by_func["renamed_op.blocks|8_4096"]
+    assert "2 positive ints" in by_func["fused_prefill.blocks|8_4096"]
+    assert "choices" in by_func["mla_decode.layout|a"]
+    assert "no shape part" in by_func["rmsnorm.row_block"]
+    # findings anchor to the key's own line in the JSON
+    src = open(cfg).read()
+    for f in findings:
+        assert json.dumps(f.func) in src.splitlines()[f.line - 1]
+
+
+def test_l006_unparseable_config_is_a_finding_not_a_crash(tmp_path):
+    project, cfg = _staged_config(tmp_path, "{not json")
+    findings = tuning_schema.run(project)
+    assert [f.code for f in findings] == ["L006"]
+    assert "unreadable" in findings[0].message
+
+
+def test_l006_shipped_configs_clean_and_consumed():
+    """The committed tuning_configs files pass the schema gate AND the
+    prefill sections actually reach the autotuner's merged table."""
+    project = Project.from_paths([PKG_ROOT])
+    assert tuning_schema.run(project) == []
+    from flashinfer_tpu.autotuner import _flatten_config
+
+    for stem in ("v5e", "v5p"):
+        path = os.path.join(PKG_ROOT, "tuning_configs", f"{stem}.json")
+        data = json.load(open(path))
+        assert data["prefill"]["tactics"], stem  # populated section
+        flat = _flatten_config(data)
+        for key in data["prefill"]["tactics"]:
+            assert key in flat, (stem, key)
+        # seed labeling stays explicit until on-chip rows are banked
+        assert data["prefill"]["seed"] is True
+
+
+def test_flatten_config_drops_invalid_entries_and_merges_sections():
+    from flashinfer_tpu.autotuner import _flatten_config
+
+    flat = _flatten_config({
+        "tactics": {
+            "rmsnorm.row_block|k": 128,
+            "rmsnorm.row_block|bad": "not-an-int",
+            "gone_op.tiles|k": [1, 2],
+        },
+        "prefill": {"tactics": {"fused_prefill.blocks|k": [128, 8],
+                                "rmsnorm.row_block|k": 256}},
+    })
+    # section entry wins on collision; invalid/unknown entries dropped
+    assert flat == {"rmsnorm.row_block|k": 256,
+                    "fused_prefill.blocks|k": [128, 8]}

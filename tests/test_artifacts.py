@@ -95,10 +95,17 @@ def test_bundle_tuning_configs_reach_autotuner(fake_cache, tmp_path,
     monkeypatch.setattr(autotuner, "_device_config_key", lambda: "fakechip")
     (fake_cache / "tuning_configs").mkdir()
     (fake_cache / "tuning_configs" / "fakechip.json").write_text(
-        _json.dumps({"tactics": {"some_op.knob|1_2": 7}})
+        _json.dumps({"tactics": {
+            # a registered knob reaches lookup; an unregistered one is
+            # dropped by the validating loader (the L006 runtime belt)
+            "rmsnorm.row_block|1_2": 7,
+            "some_renamed_op.knob|1_2": 7,
+        }})
     )
     t = autotuner.AutoTuner()
-    assert t.lookup("some_op.knob", (1, 2)) == 7
+    assert t.lookup("rmsnorm.row_block", (1, 2)) == 7
+    assert t.lookup("some_renamed_op.knob", (1, 2), default="dropped") \
+        == "dropped"
 
 
 def test_status_and_listing(fake_cache):

@@ -13,6 +13,7 @@ from flashinfer_tpu.ops.merge import variable_length_merge_states
 from flashinfer_tpu.testing import attention_ref
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("qo_len,kv_len", [(1, 64), (64, 64), (17, 99), (128, 256)])
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("backend", ["pallas", "xla"])
@@ -43,6 +44,7 @@ def test_single_prefill_features(window_left, soft_cap):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
 def test_single_decode(kv_layout):
     H, KVH, D, S = 8, 2, 64, 133

@@ -36,6 +36,7 @@ def _ragged_kv_for_request(cache_rows, pages, page_size, kv_len):
     return jnp.stack(rows)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
 @pytest.mark.parametrize("backend", ["pallas", "xla"])
 def test_batch_decode_wrapper(kv_layout, backend):
@@ -96,6 +97,7 @@ def test_batch_prefill_ragged_wrapper(causal, backend):
         )
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
 def test_batch_prefill_paged_fused_backend(kv_layout):
     """backend='pallas_fused': work-unit kernel vs per-request reference."""

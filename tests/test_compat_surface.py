@@ -318,7 +318,8 @@ def test_second_batch_compat_behaviors():
             rtol=2e-3, atol=2e-3, err_msg=f"req {r}",
         )
 
-    # clusters top-k routes to the exact threshold backend
+    # clusters top-k routes to the measured default backend (sort-first;
+    # VERDICT weak #8) — result is set-equal to the xla oracle either way
     logits = jnp.asarray(rng.standard_normal((4, 512)) * 3, jnp.float32)
     idx = topk.topk_clusters_exact(logits, 16)
     _, ref_idx = topk.top_k_values_indices(logits, 16, backend="xla")

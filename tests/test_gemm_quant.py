@@ -44,6 +44,7 @@ def test_fp8_roundtrip_and_bmm():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-2, atol=0.2)
 
 
+@pytest.mark.quick
 def test_int8_mm():
     x = jax.random.normal(jax.random.PRNGKey(0), (32, 64))
     w = jax.random.normal(jax.random.PRNGKey(1), (64, 16))

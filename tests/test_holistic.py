@@ -172,10 +172,14 @@ def test_native_mask_plan_matches_numpy_fallback():
     ) < 0.5
 
     def build():
+        # pack_tiles=False keeps the unit enumeration canonical so the
+        # first build actually routes through the C++ planner (packed
+        # tiles on this unaligned geometry would force numpy for both
+        # sides and compare nothing)
         plan = build_prefill_work_units(
             qo_indptr, kv_page_indptr, kv_indices, kv_lens,
             block_q=bq, pages_per_chunk=ppc, page_size=PS,
-            mask_flat=mask_flat,
+            mask_flat=mask_flat, pack_tiles=False,
         )
         return plan["mask_bytes"]
 

@@ -35,6 +35,7 @@ def _setup_cache(key, num_pages, ps, d_ckv, d_kpe, dtype=jnp.float32):
     return ckv, kpe
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("backend", ["pallas", "xla"])
 def test_mla_decode(backend):
     B, H, d_ckv, d_kpe, PS = 3, 16, 128, 64, 8

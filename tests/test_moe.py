@@ -27,6 +27,7 @@ def _moe_ref(x, w1, w2, weights, ids):
     return out
 
 
+@pytest.mark.quick
 def test_route_topk_and_renormalize():
     logits = jax.random.normal(jax.random.PRNGKey(0), (5, 16))
     w, ids = moe.route_topk(logits, 4)

@@ -18,6 +18,7 @@ def ref_rmsnorm(x, w, eps, bias=0.0):
     return x / np.sqrt(var + eps) * w
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("batch", [1, 19, 128])
 @pytest.mark.parametrize("hidden", [128, 4096])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])

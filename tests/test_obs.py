@@ -45,6 +45,7 @@ def all_obs_off(monkeypatch):
 # ---------------------------------------------------------------- registry --
 
 
+@pytest.mark.quick
 def test_registry_counter_gauge_histogram(metrics_on):
     reg = Registry()
     assert reg.counter_inc("c", op="a") == 1

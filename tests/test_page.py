@@ -8,6 +8,7 @@ import pytest
 import flashinfer_tpu as fi
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("kv_layout", ["NHD", "HND"])
 @pytest.mark.parametrize("page_size", [1, 16])
 def test_append_paged_kv_cache(kv_layout, page_size):

@@ -593,3 +593,56 @@ def test_checkpointing_ssu_speculative_replay():
         oracle = oracle.at[b].set(ob[0])
     np.testing.assert_allclose(np.asarray(state), np.asarray(oracle),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.quick
+def test_checkpointing_ssu_replay_is_o_accepted_not_o_ring(monkeypatch):
+    """VERDICT weak #6 regression: the speculative replay loop must do
+    O(max(accepted)) work, not O(R) — with a large ring and a tiny
+    accept count, the fori_loop's traced bound (-> while_loop) must trip
+    exactly max(accepted) times.  Counted under disable_jit, where the
+    loop bound is concrete and fori_loop runs its body eagerly."""
+    from flashinfer_tpu.mamba import checkpointing_ssu
+
+    rng = np.random.default_rng(0)
+    B, T, H, dim, ds, G, R = 2, 2, 2, 4, 6, 1, 64
+    state = jnp.asarray(rng.standard_normal((B, H, dim, ds)), jnp.float32)
+    x_cache = jnp.asarray(rng.standard_normal((B, H, R, dim)), jnp.float32)
+    B_cache = jnp.asarray(rng.standard_normal((B, G, R, ds)), jnp.float32)
+    dt_cache = jnp.asarray(rng.random((B, H, R)), jnp.float32)
+    ring_start = jnp.zeros((B,), jnp.int32)
+    accepted = jnp.asarray([3, 1], jnp.int32)
+    x = jnp.asarray(rng.standard_normal((B, T, H, dim)), jnp.float32)
+    dt = jnp.asarray(rng.random((B, T, H)), jnp.float32)
+    A = -jnp.abs(jnp.asarray(rng.standard_normal((H, dim, ds)), jnp.float32))
+    Bv = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+    Cv = jnp.asarray(rng.standard_normal((B, T, G, ds)), jnp.float32)
+
+    bounds = []
+    body_trips = []
+    orig = jax.lax.fori_loop
+
+    def counting_fori(lo, hi, body, init, **kw):
+        bounds.append((int(lo), int(hi)))
+
+        def counted_body(i, carry):
+            body_trips.append(1)
+            return body(i, carry)
+
+        return orig(lo, hi, counted_body, init, **kw)
+
+    with jax.disable_jit():
+        monkeypatch.setattr(jax.lax, "fori_loop", counting_fori)
+        y, *_ = checkpointing_ssu(
+            state, x_cache, B_cache, dt_cache, ring_start, accepted,
+            x, dt, A, Bv, Cv,
+        )
+        monkeypatch.undo()
+    assert np.isfinite(np.asarray(y)).all()
+    # exactly one replay loop, bounded by max(accepted) — NOT the ring
+    replay = [b for b in bounds if b == (0, 3)]
+    assert replay, f"replay loop bound not max(accepted): {bounds}"
+    assert all(hi < R for _, hi in bounds), (
+        f"a loop still runs O(R={R}) trips for O(accepted) progress: "
+        f"{bounds}")
+    assert sum(body_trips) == sum(hi - lo for lo, hi in bounds)

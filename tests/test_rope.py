@@ -30,6 +30,7 @@ def ref_rope(x, pos, rotary_dim, interleave, rope_scale, rope_theta):
     return out
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("interleave", [False, True])
 @pytest.mark.parametrize("rotary_dim", [64, 128])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])

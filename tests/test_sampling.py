@@ -23,6 +23,7 @@ def test_softmax_temperature():
     np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
 
 
+@pytest.mark.quick
 def test_sampling_from_probs_support():
     batch, vocab = 16, 64
     probs = np.zeros((batch, vocab), np.float32)

@@ -21,6 +21,7 @@ def _dense_ref(q, k, v, mask, sm_scale):
     return np.einsum("hqk,khd->qhd", p / np.where(l > 0, l, 1), vf)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("backend", ["pallas", "xla"])
 @pytest.mark.parametrize("R,C", [(16, 16), (32, 64)])
 def test_block_sparse_wrapper(backend, R, C):

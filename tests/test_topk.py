@@ -128,3 +128,51 @@ def test_threshold_topk_wide_dynamic_range():
         assert sx == st
     mt = topk.top_k_mask(jnp.asarray(scores), k, backend="threshold")
     assert (np.asarray(mt).sum(1) == k).all()
+
+
+@pytest.mark.quick
+def test_page_table_transform_backend_ab_parity(monkeypatch):
+    """VERDICT weak #8 satellite: the sparse-MLA transform defaults to
+    the sort backend (the bisection kernel loses ~40x at its flagship
+    shape), the kernel stays opt-in via FLASHINFER_TPU_TOPK_BACKEND,
+    and BOTH backends pin IDENTICAL page tables — the A/B the default
+    flip rests on.  Distinct scores per row make the top-k set unique,
+    so the sorted row lists must match exactly, not just as sets."""
+    rng = np.random.default_rng(7)
+    B, max_kv, PS, k = 4, 512, 16, 48
+    # strictly distinct scores -> a unique top-k set per row
+    base = rng.permutation(B * max_kv).astype(np.float32).reshape(B, max_kv)
+    scores = jnp.asarray(base / 7.0, jnp.float32)
+    table = jnp.asarray(
+        rng.permutation(B * (max_kv // PS)).reshape(B, -1), jnp.int32
+    )
+    kv_lens = jnp.asarray([512, 300, 64, 17], jnp.int32)
+
+    monkeypatch.delenv("FLASHINFER_TPU_TOPK_BACKEND", raising=False)
+    rows_default = topk.topk_clusters_page_table_transform(
+        scores, kv_lens, table, k, page_size=PS
+    )
+    rows_default2 = np.asarray(topk.top_k_page_table_transform(
+        scores, table, kv_lens, k, PS, backend="auto")[0])
+    rows_xla = np.asarray(topk.top_k_page_table_transform(
+        scores, table, kv_lens, k, PS, backend="xla")[0])
+    rows_thr = np.asarray(topk.top_k_page_table_transform(
+        scores, table, kv_lens, k, PS, backend="threshold")[0])
+    # default == the sort backend (per-entry, not just set)
+    np.testing.assert_array_equal(np.asarray(rows_default), rows_xla)
+    np.testing.assert_array_equal(rows_default2, rows_xla)
+    # A/B parity: identical page tables from both backends
+    # (order differs by contract: xla value-sorted, threshold
+    # index-ordered — padding -1s excluded from the set compare)
+    for sx, st in zip(_sets(jnp.asarray(rows_xla)),
+                      _sets(jnp.asarray(rows_thr))):
+        assert sx == st
+    # same number of valid (non-padding) entries per row
+    np.testing.assert_array_equal((rows_xla >= 0).sum(1),
+                                  (rows_thr >= 0).sum(1))
+
+    # the kernel stays opt-in through the env var
+    monkeypatch.setenv("FLASHINFER_TPU_TOPK_BACKEND", "threshold")
+    rows_env = np.asarray(topk.topk_clusters_page_table_transform(
+        scores, kv_lens, table, k, page_size=PS))
+    np.testing.assert_array_equal(rows_env, rows_thr)

@@ -142,6 +142,7 @@ def test_fused_paged_prefill_llama_shape():
     )
     num_units = plan_np.pop("num_units")
     plan_np.pop("block_q"), plan_np.pop("pages_per_chunk")
+    plan_np.pop("stats")
     plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
     out = fused_paged_prefill(
         q, kc, vc, plan, num_units=num_units, block_q=128, pages_per_chunk=8,
@@ -569,6 +570,7 @@ def test_trace_events_prefill_on_chip():
     )
     num_units = plan_np.pop("num_units")
     plan_np.pop("block_q"), plan_np.pop("pages_per_chunk")
+    plan_np.pop("stats")
     plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
     q = jax.random.normal(jax.random.PRNGKey(0), (qo_len, HQ, D),
                           jnp.bfloat16)

@@ -100,6 +100,7 @@ def test_in_kernel_event_trace_fused_prefill(tmp_path):
     )
     num_units = plan_np.pop("num_units")
     plan_np.pop("block_q"), plan_np.pop("pages_per_chunk")
+    plan_np.pop("stats")
     plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
     q = jax.random.normal(jax.random.PRNGKey(0), (40, HQ, D), jnp.float32)
     kc = jax.random.normal(jax.random.PRNGKey(1), (8, HKV, PS, D))
