@@ -29,34 +29,66 @@ L006  tuning_schema          ``tuning_configs/*.json`` entries naming
                              values the registered KnobSpec rejects
                              (stale shipped tactics silently falling
                              back to defaults — ISSUE 3 satellite)
+L007  pallas_contract        plan/kernel launch-contract skew: kernel
+                             arity vs specs, index_map arity vs grid
+                             rank, and num_scalar_prefetch vs the plan
+                             arrays the registered planner emits
+                             (PR 3's "11 scalar-prefetch operands"
+                             contract, previously enforced by nothing)
+L008  tracer_leak            Python if/while/assert, int()/bool()/
+                             float()/.item(), and np.* applied to
+                             traced values inside jit bodies and
+                             Pallas kernels
+L009  vmem_budget            tuning-config block shapes whose scratch/
+                             block VMEM provably exceeds the launch's
+                             budget (extends L006 with the semantics)
+L010  kernel_init_guard      accumulator refs written only under
+                             first-step-EXCLUDING pl.when guards (no
+                             step-0 init: stale-scratch numerics), and
+                             out-of-range input_output_aliases
 ====  =====================  ==========================================
+
+L007–L010 are interprocedural: they resolve planners/kernels through
+the project symbol index in ``core.py``, so the planner in one module
+and the kernel in another are checked as one contract.
 
 CLI::
 
     python -m flashinfer_tpu.analysis [paths...]
         [--baseline FILE | --no-baseline] [--write-baseline]
         [--bank FILE] [--dump-signatures]
+        [--sarif FILE] [--changed-only] [--changed-base REF]
 
 With no paths, analyzes the installed ``flashinfer_tpu`` package tree.
 Exit status is 1 iff findings exist that are not in the committed
-baseline (``flashinfer_tpu/analysis/baseline.json``).  Suppress a
-reviewed-safe line with ``# graft-lint: ok <reason>`` — reasonless
-suppressions are themselves findings (L000).  See
-docs/static_analysis.md for the pass catalog and workflows.
+baseline (``flashinfer_tpu/analysis/baseline.json``).  ``--sarif``
+additionally writes the non-baselined findings as SARIF 2.1.0 (GitHub
+code-scanning).  ``--changed-only`` restricts analysis to files the
+git working tree changed against ``--changed-base`` (default HEAD) —
+the incremental pre-commit mode.  Suppress a reviewed-safe line with
+``# graft-lint: ok <reason>`` — reasonless suppressions are themselves
+findings (L000).  See docs/static_analysis.md for the pass catalog and
+workflows.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional, Tuple
+import subprocess
+import sys
+from typing import Dict, List, Optional, Set, Tuple
 
 from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
-                                     obs_coverage, signature_parity,
-                                     tuning_schema, wedge)
+                                     kernel_init_guard, obs_coverage,
+                                     pallas_contract, signature_parity,
+                                     tracer_leak, tuning_schema,
+                                     vmem_budget, wedge)
+from flashinfer_tpu.analysis import sarif as sarif_mod
 from flashinfer_tpu.analysis.core import (Finding, Project,  # noqa: F401
-                                          SourceFile, load_file,
-                                          load_source, project_relpath)
+                                          SourceFile, iter_python_files,
+                                          load_file, load_source,
+                                          project_relpath)
 
 __all__ = [
     "Finding", "Project", "analyze_paths", "analyze_project",
@@ -65,7 +97,8 @@ __all__ = [
 ]
 
 PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
-          obs_coverage, tuning_schema)
+          obs_coverage, tuning_schema, pallas_contract, tracer_leak,
+          vmem_budget, kernel_init_guard)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")
@@ -220,6 +253,54 @@ def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
 
+_iter_python_files = iter_python_files
+
+
+def _git_changed_files(paths: List[str],
+                       base: str) -> Optional[Set[str]]:
+    """Absolute paths of files changed vs `base` (plus untracked) in
+    every git repo owning one of `paths`; None when git is unusable —
+    the caller falls back to full analysis with a warning rather than
+    silently passing a broken tree."""
+    roots: Set[str] = set()
+    for p in paths:
+        d = p if os.path.isdir(p) else os.path.dirname(os.path.abspath(p))
+        try:
+            top = subprocess.run(
+                ["git", "-C", d, "rev-parse", "--show-toplevel"],
+                capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if top.returncode != 0:
+            return None
+        roots.add(top.stdout.strip())
+    changed: Set[str] = set()
+    for root in sorted(roots):
+        try:
+            # quotepath off: otherwise non-ASCII names print octal-
+            # escaped and quoted, match nothing, and silently drop out
+            diff = subprocess.run(
+                ["git", "-C", root, "-c", "core.quotepath=false",
+                 "diff", "--name-only", base, "--"],
+                capture_output=True, text=True, timeout=30)
+            untracked = subprocess.run(
+                ["git", "-C", root, "-c", "core.quotepath=false",
+                 "ls-files", "--others", "--exclude-standard"],
+                capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if diff.returncode != 0 or untracked.returncode != 0:
+            return None
+        for line in (diff.stdout + untracked.stdout).splitlines():
+            if line.strip():
+                # realpath: git reports the PHYSICAL toplevel, while the
+                # analyzed paths may reach the repo through a symlink —
+                # matching abspaths would silently intersect to nothing
+                changed.add(os.path.realpath(
+                    os.path.join(root, line.strip())))
+    return changed
+
+
 def _dump_signatures(paths: List[str], bank: dict) -> None:
     project = Project.from_paths(paths)
     out = {}
@@ -264,6 +345,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--dump-signatures", action="store_true",
                    help="print current implementation signatures for "
                         "every bank symbol, then exit")
+    p.add_argument("--sarif", metavar="FILE", default=None,
+                   help="also write the non-baselined findings as "
+                        "SARIF 2.1.0 (GitHub code scanning)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="analyze only files the git working tree "
+                        "changed (incremental pre-commit mode)")
+    p.add_argument("--changed-base", metavar="REF", default="HEAD",
+                   help="git ref --changed-only diffs against "
+                        "(default HEAD)")
     args = p.parse_args(argv)
 
     paths = args.paths or _default_paths()
@@ -272,10 +362,54 @@ def main(argv: Optional[List[str]] = None) -> int:
         _dump_signatures(paths, bank)
         return 0
 
-    findings = analyze_paths(paths, bank)
+    files = _iter_python_files(paths)
+    if args.changed_only:
+        changed = _git_changed_files(paths, args.changed_base)
+        if changed is None:
+            print("--changed-only: git unavailable for the analyzed "
+                  "paths; falling back to full analysis",
+                  file=sys.stderr)
+        elif any(p.endswith(".json")
+                 and os.path.basename(os.path.dirname(p))
+                 == "tuning_configs" for p in changed):
+            # a config-only diff has no changed .py file to anchor the
+            # subset, but L006/L009 exist to lint exactly these JSONs —
+            # run the full analysis so the edit is actually checked
+            print("--changed-only: tuning_configs/*.json changed; "
+                  "running full analysis (L006/L009 need the launch "
+                  "modules)", file=sys.stderr)
+        else:
+            files = [f for f in files
+                     if os.path.realpath(f) in changed]
+            if not files:
+                print("--changed-only: no analyzed files changed vs "
+                      f"{args.changed_base}")
+                if args.sarif:
+                    with open(args.sarif, "w") as fh:
+                        json.dump(sarif_mod.to_sarif([]), fh, indent=1)
+                return 0
+    project = Project.from_paths(files)
+    findings = analyze_project(project, bank)
     baseline_path = args.baseline or DEFAULT_BASELINE_PATH
 
+    # interprocedural passes see less on a partial tree, so whole-tree
+    # claims (baseline rewrites, stale-entry pruning) need the full
+    # default file set analyzed.  Config JSONs discovered next to
+    # analyzed modules count as analyzed (L006/L009).
+    analyzed = {project_relpath(sf.path) for sf in project.files}
+    analyzed |= {project_relpath(p)
+                 for p in tuning_schema._config_paths(project)}
+    saw_whole_tree = {project_relpath(f)
+                      for f in _iter_python_files(_default_paths())
+                      } <= analyzed
+
     if args.write_baseline:
+        if not saw_whole_tree:
+            print("--write-baseline requires a whole-tree run: a "
+                  "subset (explicit paths or --changed-only) misses "
+                  "cross-module findings and would truncate the "
+                  "baseline", file=sys.stderr)
+            return 2
         write_baseline(findings, baseline_path)
         print(f"wrote {len(findings)} finding(s) to {baseline_path}")
         return 0
@@ -285,6 +419,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         new, old, stale = partition_against_baseline(
             findings, load_baseline(baseline_path))
+        # "no longer fires" is likewise only decidable when the run saw
+        # the WHOLE tree (an L003 on norm.py fires through callees in
+        # other modules) — a subset run re-checking a file with less
+        # context must not demand pruning its entries.  A whole-tree
+        # run keeps every stale key: one naming a no-longer-analyzed
+        # path is the deleted/renamed-file case, exactly what needs
+        # pruning.
+        if not saw_whole_tree:
+            stale = []
+    if args.sarif:
+        with open(args.sarif, "w") as fh:
+            json.dump(sarif_mod.to_sarif(new), fh, indent=1)
+            fh.write("\n")
+        print(f"# sarif ({len(new)} result(s)) -> {args.sarif}",
+              file=sys.stderr)
     for f in new:
         print(f)
     for key in stale:

@@ -20,7 +20,7 @@ import ast
 import dataclasses
 import os
 import re
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 GRAFT_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*ok\b\s*(.*)")
 # the wedge pass's historical spelling: waives only W-codes (scanned by
@@ -56,6 +56,22 @@ def project_relpath(path: str) -> str:
     if best >= 0:
         return p[best + 1:]
     return os.path.basename(p)
+
+
+def iter_python_files(paths: List[str]) -> List[str]:
+    """The ONE directory walk: every consumer (Project.from_paths, the
+    CLI's full-tree/stale-baseline comparisons) must enumerate files
+    identically or the comparisons silently diverge."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, _dirs, names in os.walk(path):
+                for fn in sorted(names):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            out.append(path)
+    return out
 
 
 @dataclasses.dataclass
@@ -117,20 +133,13 @@ class Project:
     def __init__(self, files: List[SourceFile]):
         self.files = files
         self._class_index: Optional[Dict[str, List["ClassInfo"]]] = None
+        self._function_index: Optional[
+            Dict[str, List["FunctionInfo"]]] = None
+        self._pallas_sites: Optional[List["PallasCallSite"]] = None
 
     @classmethod
     def from_paths(cls, paths: List[str]) -> "Project":
-        files: List[SourceFile] = []
-        for path in paths:
-            if os.path.isdir(path):
-                for dirpath, _dirs, names in os.walk(path):
-                    for fn in sorted(names):
-                        if fn.endswith(".py"):
-                            files.append(
-                                load_file(os.path.join(dirpath, fn)))
-            else:
-                files.append(load_file(path))
-        return cls(files)
+        return cls([load_file(f) for f in iter_python_files(paths)])
 
     # -- class index (L001) ------------------------------------------------
 
@@ -151,6 +160,50 @@ class Project:
     def resolve_class(self, name: str) -> Optional["ClassInfo"]:
         hits = self.class_index.get(name)
         return hits[0] if hits else None
+
+    # -- function index (the L007–L010 cross-module resolution layer) ------
+
+    @property
+    def function_index(self) -> Dict[str, List["FunctionInfo"]]:
+        """Every def in the analyzed set, keyed by bare name — the
+        project symbol index that lets a pass in one file see the
+        planner/kernel defined in another (same resolution scope as the
+        class index: name-level, within the analyzed files)."""
+        if self._function_index is None:
+            idx: Dict[str, List[FunctionInfo]] = {}
+            for sf in self.files:
+                if sf.tree is None:
+                    continue
+                for node, qualname in _walk_defs(sf.tree):
+                    idx.setdefault(node.name, []).append(
+                        FunctionInfo(node.name, qualname, sf, node))
+            self._function_index = idx
+        return self._function_index
+
+    def resolve_function(
+            self, name: str,
+            prefer_file: Optional[SourceFile] = None
+    ) -> Optional["FunctionInfo"]:
+        """The def `name` resolves to: the one in `prefer_file` when it
+        defines it (Python name resolution would find the local def
+        first), else the unique project-wide def, else None (ambiguous
+        names stay unresolved — no guessing across modules)."""
+        hits = self.function_index.get(name)
+        if not hits:
+            return None
+        if prefer_file is not None:
+            local = [h for h in hits if h.file is prefer_file]
+            if len(local) == 1:
+                return local[0]
+        return hits[0] if len(hits) == 1 else None
+
+    @property
+    def pallas_sites(self) -> List["PallasCallSite"]:
+        """Every ``pl.pallas_call`` launch in the analyzed set, with its
+        statically-resolved contract pieces (shared by L007–L010)."""
+        if self._pallas_sites is None:
+            self._pallas_sites = collect_pallas_sites(self)
+        return self._pallas_sites
 
     def mro_chain(self, cls: "ClassInfo") -> List["ClassInfo"]:
         """Depth-first base-class chain starting at `cls` — an
@@ -213,3 +266,393 @@ class ClassInfo:
                     if isinstance(t, ast.Name):
                         aliases[t.id] = (stmt.value.id, i, stmt.lineno)
         return cls(node.name, sf, node, bases, aliases, methods)
+
+
+# ---------------------------------------------------------------------------
+# Cross-module resolution layer (L007–L010): function index, static
+# expression helpers, and the shared Pallas launch-site scanner.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    name: str
+    qualname: str
+    file: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+
+    @property
+    def positional_params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    @property
+    def has_vararg(self) -> bool:
+        return self.node.args.vararg is not None
+
+
+def _walk_defs(tree: ast.Module):
+    """(def node, dotted qualname) for every function def, in source
+    order, nesting encoded in the qualname (``outer.inner``)."""
+    out = []
+
+    def _walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((child, q))
+                _walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                _walk(child, f"{prefix}{child.name}.")
+            else:
+                _walk(child, prefix)
+
+    _walk(tree, "")
+    return out
+
+
+def expr_basename(expr: ast.expr) -> str:
+    """Last dotted component: ``pltpu.PrefetchScalarGridSpec`` ->
+    ``PrefetchScalarGridSpec``; bare names return themselves."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def expr_root(expr: ast.expr) -> Optional[str]:
+    """Leftmost Name of a dotted chain (``np`` for ``np.sum``), the
+    Name itself for bare names, None otherwise."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def const_int(expr: ast.expr) -> Optional[int]:
+    """Fold an integer-constant expression: literals, +,-,*,//,<<, and
+    unary minus over them (``64 * 1024 * 1024`` resolves)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = const_int(expr.operand)
+        return -v if v is not None else None
+    if isinstance(expr, ast.BinOp):
+        lo, hi = const_int(expr.left), const_int(expr.right)
+        if lo is None or hi is None:
+            return None
+        if isinstance(expr.op, ast.Add):
+            return lo + hi
+        if isinstance(expr.op, ast.Sub):
+            return lo - hi
+        if isinstance(expr.op, ast.Mult):
+            return lo * hi
+        if isinstance(expr.op, ast.FloorDiv):
+            return lo // hi if hi else None
+        if isinstance(expr.op, ast.LShift):
+            return lo << hi
+    return None
+
+
+class FnLocals:
+    """Single-assignment resolution for names local to one function:
+    ``kernel = functools.partial(_k, ...)`` or ``in_specs = [...]``.
+    A name counts as resolvable ONLY when it is assigned exactly once
+    and never mutated in place (.append/.extend/.insert / augmented
+    assignment) — conditional rebinds and list growth make the static
+    count a guess, and a guessed contract check is worse than none."""
+
+    _MUTATORS = {"append", "extend", "insert", "add", "update"}
+
+    def __init__(self, fn_node: ast.AST):
+        assigns: Dict[str, List[ast.expr]] = {}
+        mutated: Set[str] = set()
+        # the scope's own parameters are bindings with UNKNOWN values:
+        # they must resolve to None (and block outer-scope fall-through
+        # in ChainLocals), never to a shadowed outer assignment
+        params: Set[str] = set()
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            a = fn_node.args
+            params = {p.arg for p in (a.posonlyargs + a.args
+                                      + a.kwonlyargs)}
+            for va in (a.vararg, a.kwarg):
+                if va is not None:
+                    params.add(va.arg)
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append(n.value)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name):
+                mutated.add(n.target.id)
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self._MUTATORS \
+                    and isinstance(n.func.value, ast.Name):
+                mutated.add(n.func.value.id)
+        self._assigns = assigns
+        self._mutated = mutated
+        self._params = params
+
+    def value_of(self, name: str) -> Optional[ast.expr]:
+        if name in self._params:
+            return None
+        vals = self._assigns.get(name)
+        if vals is None or len(vals) != 1 or name in self._mutated:
+            return None
+        return vals[0]
+
+    def seq_elements(self, expr: ast.expr,
+                     _depth: int = 0) -> Optional[List[ast.expr]]:
+        """Statically-known elements of a list/tuple expression: a
+        literal, a concat of statics, or a once-assigned local name."""
+        if _depth > 8:
+            return None
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            if any(isinstance(e, ast.Starred) for e in expr.elts):
+                return None
+            return list(expr.elts)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            lo = self.seq_elements(expr.left, _depth + 1)
+            hi = self.seq_elements(expr.right, _depth + 1)
+            if lo is None or hi is None:
+                return None
+            return lo + hi
+        if isinstance(expr, ast.Name):
+            v = self.value_of(expr.id)
+            if v is not None:
+                return self.seq_elements(v, _depth + 1)
+        return None
+
+
+_PALLAS_CALL_NAMES = {"pallas_call"}
+_GRID_SPEC_NAMES = {"PrefetchScalarGridSpec", "GridSpec"}
+_PARTIAL_CALL_NAMES = {"partial"}
+
+
+def _unwrap_partial(
+        expr: ast.expr, locals_: FnLocals,
+        _depth: int = 0) -> Tuple[Optional[ast.expr], Set[str], int]:
+    """(innermost callable expr, keyword names bound along the partial
+    chain, count of POSITIONALLY-bound partial args — they consume the
+    kernel's leading params).  Resolves through once-assigned local
+    names."""
+    bound: Set[str] = set()
+    npos = 0
+    while _depth < 8:
+        _depth += 1
+        if isinstance(expr, ast.Call) \
+                and expr_basename(expr.func) in _PARTIAL_CALL_NAMES \
+                and expr.args:
+            bound |= {k.arg for k in expr.keywords if k.arg}
+            npos += len(expr.args) - 1
+            expr = expr.args[0]
+            continue
+        if isinstance(expr, ast.Name):
+            v = locals_.value_of(expr.id)
+            if v is not None and not isinstance(v, ast.Name):
+                expr = v
+                continue
+        break
+    return expr, bound, npos
+
+
+@dataclasses.dataclass
+class PallasCallSite:
+    """One ``pl.pallas_call`` launch and everything about its contract
+    that is statically decidable.  ``None`` fields mean "not statically
+    countable here" — passes must skip, never guess."""
+
+    file: SourceFile
+    enclosing: Optional[FunctionInfo]  # the launcher def
+    call: ast.Call                     # the pallas_call(...) itself
+    invocation: Optional[ast.Call]     # pallas_call(...)(operands...)
+    kernel: Optional[FunctionInfo]     # resolved kernel def
+    kernel_bound_kwargs: Set[str]      # kwargs bound via functools.partial
+    kernel_bound_posargs: int          # positional partial binds (leading)
+    is_prefetch_spec: bool             # PrefetchScalarGridSpec launch
+    num_scalar_prefetch: Optional[int]
+    grid_rank: Optional[int]
+    in_spec_exprs: Optional[List[ast.expr]]
+    out_spec_exprs: Optional[List[ast.expr]]
+    scratch_exprs: Optional[List[ast.expr]]
+    io_aliases_expr: Optional[ast.expr]
+    vmem_limit_bytes: Optional[int]
+    locals_: FnLocals
+
+    @property
+    def line(self) -> int:
+        return self.call.lineno
+
+
+def _spec_list(expr: Optional[ast.expr],
+               locals_: FnLocals) -> Optional[List[ast.expr]]:
+    """Spec kwarg -> element list.  A bare BlockSpec/ShapeDtypeStruct
+    call (the single-output shorthand) counts as a 1-element list."""
+    if expr is None:
+        return None
+    elems = locals_.seq_elements(expr)
+    if elems is not None:
+        return elems
+    resolved = expr
+    if isinstance(expr, ast.Name):
+        v = locals_.value_of(expr.id)
+        if v is None:
+            return None
+        elems = locals_.seq_elements(v)
+        if elems is not None:
+            return elems
+        resolved = v
+    if isinstance(resolved, ast.Call):
+        return [resolved]
+    return None
+
+
+def walk_own_scope(node: ast.AST):
+    """Child nodes of `node` excluding the interiors of nested defs
+    (the nested def node itself IS yielded)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class ChainLocals(FnLocals):
+    """FnLocals over a lexical scope chain (innermost def first): a
+    launch inside a closure still resolves names bound in the enclosing
+    launcher — matching Python's own lookup order."""
+
+    def __init__(self, scopes: List[ast.AST]):
+        self._chain = [FnLocals(s) for s in scopes]
+
+    def value_of(self, name: str) -> Optional[ast.expr]:
+        for loc in self._chain:
+            v = loc.value_of(name)
+            if v is not None:
+                return v
+            # a name bound-but-unresolvable in an inner scope (param,
+            # multi-assign, mutation) must not fall through to a stale
+            # outer binding
+            if name in loc._assigns or name in loc._mutated \
+                    or name in loc._params:
+                return None
+        return None
+
+
+def collect_pallas_sites(project: "Project") -> List[PallasCallSite]:
+    sites: List[PallasCallSite] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+
+        def _scan(scope: ast.AST, chain: List[ast.AST],
+                  qual_prefix: str) -> None:
+            for node in walk_own_scope(scope):
+                if isinstance(node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _scan(node, [node] + chain,
+                          f"{qual_prefix}{node.name}.")
+                elif isinstance(node, ast.ClassDef):
+                    _scan(node, chain, f"{qual_prefix}{node.name}.")
+                elif isinstance(node, ast.Call) and expr_basename(
+                        node.func) in _PALLAS_CALL_NAMES:
+                    enclosing = None
+                    for s in chain:
+                        if isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                            enclosing = FunctionInfo(
+                                s.name, s.name, sf, s)
+                            break
+                    sites.append(_build_site(
+                        project, sf, enclosing, node,
+                        ChainLocals(chain or [sf.tree]),
+                        chain[0] if chain else sf.tree))
+
+        _scan(sf.tree, [], "")
+    return sites
+
+
+def _build_site(project: "Project", sf: SourceFile,
+                enclosing: Optional[FunctionInfo], call: ast.Call,
+                locals_: FnLocals, scope_node: ast.AST) -> PallasCallSite:
+    kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+
+    # grid spec: inline call, once-assigned local, or direct kwargs
+    spec_call = None
+    gs = kwargs.get("grid_spec")
+    if isinstance(gs, ast.Name):
+        gs = locals_.value_of(gs.id)
+    if isinstance(gs, ast.Call) \
+            and expr_basename(gs.func) in _GRID_SPEC_NAMES:
+        spec_call = gs
+    spec_kwargs = ({k.arg: k.value for k in spec_call.keywords if k.arg}
+                   if spec_call is not None else kwargs)
+    is_prefetch = bool(
+        spec_call is not None
+        and expr_basename(spec_call.func) == "PrefetchScalarGridSpec")
+
+    nsp = None
+    if is_prefetch:
+        nsp_expr = spec_kwargs.get("num_scalar_prefetch")
+        nsp = const_int(nsp_expr) if nsp_expr is not None else 0
+    grid_rank = None
+    grid_expr = spec_kwargs.get("grid")
+    if isinstance(grid_expr, ast.Name):
+        grid_expr = locals_.value_of(grid_expr.id)
+    if isinstance(grid_expr, (ast.Tuple, ast.List)):
+        grid_rank = len(grid_expr.elts)
+    elif grid_expr is not None and const_int(grid_expr) is not None:
+        grid_rank = 1
+
+    in_specs = _spec_list(spec_kwargs.get("in_specs"), locals_)
+    out_specs = _spec_list(spec_kwargs.get("out_specs"), locals_)
+    scratch = _spec_list(spec_kwargs.get("scratch_shapes"), locals_)
+    if scratch is None and "scratch_shapes" not in spec_kwargs \
+            and (spec_call is not None or "grid_spec" not in kwargs):
+        # an omitted scratch_shapes is statically ZERO scratch refs —
+        # leaving it "uncountable" would disable the kernel-arity check
+        # for every plain launch; only an UNRESOLVED grid_spec (where
+        # the real kwargs are invisible) keeps it unknown
+        scratch = []
+
+    # kernel: first positional arg, through partial and local names
+    kernel_info = None
+    bound: Set[str] = set()
+    bound_pos = 0
+    if call.args:
+        target, bound, bound_pos = _unwrap_partial(call.args[0], locals_)
+        if target is not None:
+            base = expr_basename(target)
+            if base:
+                kernel_info = project.resolve_function(
+                    base, prefer_file=sf)
+
+    # the immediately-applied operand call, if any
+    invocation = None
+    for n in ast.walk(scope_node):
+        if isinstance(n, ast.Call) and n.func is call:
+            invocation = n
+            break
+
+    vmem = None
+    cp = kwargs.get("compiler_params")
+    if isinstance(cp, ast.Call):
+        for k in cp.keywords:
+            if k.arg == "vmem_limit_bytes":
+                vmem = const_int(k.value)
+
+    return PallasCallSite(
+        file=sf, enclosing=enclosing, call=call, invocation=invocation,
+        kernel=kernel_info, kernel_bound_kwargs=bound,
+        kernel_bound_posargs=bound_pos,
+        is_prefetch_spec=is_prefetch, num_scalar_prefetch=nsp,
+        grid_rank=grid_rank, in_spec_exprs=in_specs,
+        out_spec_exprs=out_specs, scratch_exprs=scratch,
+        io_aliases_expr=kwargs.get("input_output_aliases"),
+        vmem_limit_bytes=vmem, locals_=locals_)

@@ -1,0 +1,282 @@
+"""L010 — accumulator-init races and input/output alias bounds.
+
+The Pallas accumulation idiom is a scratch ref initialized on the
+FIRST grid step and read-modified on every step::
+
+    @pl.when(k_i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+    acc_ref[...] += partial_product        # every step
+
+The race this pass encodes: an accumulator whose every write sits
+under a guard that provably EXCLUDES the first step (``step != 0``,
+``step > 0``) with no step-0 initialization write anywhere.  Scratch
+VMEM is not zeroed between grid steps — the first read sees whatever
+the previous launch left there: silently wrong numerics on-chip,
+often-correct zeros in interpret mode, which is exactly the split that
+makes this bug survive CPU CI.
+
+Guard classification is deliberately narrow: a condition only counts
+as "excludes step 0" when its subject is a *grid-step name* — a value
+assigned from ``pl.program_id(...)`` in the kernel — compared against
+a nonzero bound.  Conditions on plan values (``num_chunks > 0``) are
+neither init nor exclusion; they gate whole-kernel work, not steps.
+
+Second check: ``input_output_aliases`` literal dicts must stay in
+bounds — each input index below ``num_scalar_prefetch + len(in_specs)``
+(aliasing a scalar-prefetch operand is also flagged: prefetch operands
+live in SMEM and cannot alias an output buffer) and each output index
+below ``len(out_specs)``.  Non-literal alias dicts are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from flashinfer_tpu.analysis.core import (Finding, PallasCallSite,
+                                          Project, SourceFile,
+                                          expr_basename)
+
+CODE = "L010"
+
+_FIRST_NAME_RE = re.compile(r"first", re.IGNORECASE)
+
+
+def _program_id_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and expr_basename(n.value.func) == "program_id":
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _subject_name(expr: ast.expr) -> Optional[str]:
+    """Bare name, or the base name of a constant-indexed subscript
+    (``first_ref[u]`` -> ``first_ref``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Subscript) and isinstance(expr.value, ast.Name):
+        return expr.value.id
+    return None
+
+
+_INIT, _EXCLUDING, _OTHER = "init", "excluding", "other"
+
+
+def _classify_guard(cond: ast.expr, pid_names: Set[str]) -> str:
+    """What a ``pl.when`` condition says about the first grid step."""
+    if not (isinstance(cond, ast.Compare) and len(cond.ops) == 1):
+        return _OTHER
+    op = cond.ops[0]
+    left, right = cond.left, cond.comparators[0]
+    # normalize constant-on-the-left
+    if isinstance(left, ast.Constant):
+        left, right = right, left
+        flip = {ast.Gt: ast.Lt, ast.Lt: ast.Gt,
+                ast.GtE: ast.LtE, ast.LtE: ast.GtE}
+        op = flip.get(type(op), type(op))() if type(op) in flip else op
+    subject = _subject_name(left)
+    rconst = right.value if isinstance(right, ast.Constant) else None
+    if subject is None:
+        return _OTHER
+    if subject in pid_names:
+        if rconst == 0:
+            if isinstance(op, ast.Eq) or isinstance(op, ast.LtE):
+                return _INIT
+            if isinstance(op, (ast.NotEq, ast.Gt)):
+                return _EXCLUDING
+        if isinstance(rconst, int) and rconst >= 1:
+            if isinstance(op, (ast.Eq, ast.GtE)):
+                return _EXCLUDING
+    elif _FIRST_NAME_RE.search(subject):
+        # the plan-encoded first-of-tile flag idiom: first_ref[u] == 1
+        if rconst == 1 and isinstance(op, ast.Eq):
+            return _INIT
+    return _OTHER
+
+
+@dataclasses.dataclass
+class _RefUse:
+    reads: List[int] = dataclasses.field(default_factory=list)
+    # write line -> guard class
+    writes: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+
+
+def _check_kernel(sf: SourceFile, fn: ast.FunctionDef,
+                  findings: List[Finding]) -> None:
+    pid_names = _program_id_names(fn)
+    params = {a.arg for a in fn.args.posonlyargs + fn.args.args}
+    if fn.args.vararg:
+        params.add(fn.args.vararg.arg)
+    # ref-like locals: unpacked from params (refs[i:] destructuring)
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            src_names = {s.id for s in ast.walk(n.value)
+                         if isinstance(s, ast.Name)}
+            if src_names & params:
+                for t in n.targets:
+                    elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+                    for e in elts:
+                        e = e.value if isinstance(e, ast.Starred) else e
+                        if isinstance(e, ast.Name):
+                            params.add(e.id)
+
+    uses: Dict[str, _RefUse] = {}
+    # guard classes of local helper defs, resolved from call sites
+    helper_guards: Dict[str, List[str]] = {}
+
+    def _guard_of(def_node) -> Optional[ast.expr]:
+        for d in def_node.decorator_list:
+            if isinstance(d, ast.Call) \
+                    and expr_basename(d.func) == "when" and d.args:
+                return d.args[0]
+        return None
+
+    def _scan_exprs(node: ast.AST, guard_class: str,
+                    helper: Optional[str]) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Name) \
+                    and n.func.id in helper_guards:
+                helper_guards[n.func.id].append(
+                    helper if helper is not None else guard_class)
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id in params:
+                use = uses.setdefault(n.value.id, _RefUse())
+                if isinstance(n.ctx, ast.Store):
+                    use.writes.append(
+                        (n.lineno,
+                         helper if helper is not None else guard_class))
+                elif isinstance(n.ctx, ast.Load):
+                    use.reads.append(n.lineno)
+
+    def _walk(stmts, guard_class: str, helper: Optional[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                g = _guard_of(stmt)
+                if g is not None:
+                    cls = _classify_guard(g, pid_names)
+                    # nested guards: an excluding inner guard wins; an
+                    # init outer guard keeps init
+                    eff = (_EXCLUDING if _EXCLUDING in (guard_class, cls)
+                           else _INIT if _INIT in (guard_class, cls)
+                           else _OTHER)
+                    _walk(stmt.body, eff, helper)
+                else:
+                    # un-guarded local helper: its writes classify by
+                    # the guards of its call sites (resolved below)
+                    helper_guards.setdefault(stmt.name, [])
+                    _walk(stmt.body, guard_class, stmt.name)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                _scan_exprs(stmt.test, guard_class, helper)
+                _walk(stmt.body, guard_class, helper)
+                _walk(stmt.orelse, guard_class, helper)
+            elif isinstance(stmt, ast.For):
+                _scan_exprs(stmt.iter, guard_class, helper)
+                _walk(stmt.body, guard_class, helper)
+                _walk(stmt.orelse, guard_class, helper)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    _scan_exprs(item.context_expr, guard_class, helper)
+                _walk(stmt.body, guard_class, helper)
+            else:
+                _scan_exprs(stmt, guard_class, helper)
+
+    # two passes: helper defs may be called before/after their bodies
+    # register, and helper call sites must exist before classification
+    _walk(fn.body, _OTHER, None)
+    uses.clear()
+    for k in helper_guards:
+        helper_guards[k] = []
+    _walk(fn.body, _OTHER, None)
+
+    def _resolve(cls_or_helper: str, depth: int = 0) -> List[str]:
+        if cls_or_helper in (_INIT, _EXCLUDING, _OTHER):
+            return [cls_or_helper]
+        if depth > 4:
+            return [_OTHER]
+        sites = helper_guards.get(cls_or_helper, [])
+        out: List[str] = []
+        for s in sites:
+            out.extend(_resolve(s, depth + 1))
+        return out or [_OTHER]
+
+    for ref, use in sorted(uses.items()):
+        if not use.reads or not use.writes:
+            continue
+        classes: List[Tuple[int, str]] = []
+        for line, raw in use.writes:
+            for c in _resolve(raw):
+                classes.append((line, c))
+        has_init = any(c in (_INIT, _OTHER) for _, c in classes)
+        excluding = [line for line, c in classes if c == _EXCLUDING]
+        if excluding and not has_init:
+            findings.append(Finding(
+                CODE, sf.path, excluding[0], fn.name,
+                f"ref '{ref}' is read in this kernel but every write is "
+                "guarded to EXCLUDE the first grid step (pl.when(step != "
+                "0)-shaped) with no step-0 initialization write — scratch "
+                "VMEM is not zeroed between steps, so the first read sees "
+                "stale data from the previous launch (wrong numerics "
+                "on-chip; interpret mode often hides it)"))
+
+
+def _check_io_aliases(site: PallasCallSite,
+                      findings: List[Finding]) -> None:
+    expr = site.io_aliases_expr
+    if not isinstance(expr, ast.Dict):
+        return
+    n_in = (len(site.in_spec_exprs)
+            if site.in_spec_exprs is not None else None)
+    nsp = site.num_scalar_prefetch if site.is_prefetch_spec else 0
+    n_out = (len(site.out_spec_exprs)
+             if site.out_spec_exprs is not None else None)
+    func = site.enclosing.name if site.enclosing else "<module>"
+    for k, v in zip(expr.keys, expr.values):
+        ki = k.value if isinstance(k, ast.Constant) \
+            and isinstance(k.value, int) else None
+        vi = v.value if isinstance(v, ast.Constant) \
+            and isinstance(v.value, int) else None
+        if ki is not None and nsp is not None and ki < nsp:
+            findings.append(Finding(
+                CODE, site.file.path, expr.lineno, func,
+                f"input_output_aliases key {ki} names a scalar-prefetch "
+                f"operand (num_scalar_prefetch={nsp}) — prefetch "
+                "operands live in SMEM and cannot alias an output "
+                "buffer"))
+        elif ki is not None and nsp is not None and n_in is not None \
+                and ki >= nsp + n_in:
+            findings.append(Finding(
+                CODE, site.file.path, expr.lineno, func,
+                f"input_output_aliases key {ki} is out of range: the "
+                f"launch has {nsp} scalar-prefetch + {n_in} array "
+                "input(s)"))
+        if vi is not None and n_out is not None and vi >= n_out:
+            findings.append(Finding(
+                CODE, site.file.path, expr.lineno, func,
+                f"input_output_aliases value {vi} is out of range: the "
+                f"launch has {n_out} output(s)"))
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for site in project.pallas_sites:
+        _check_io_aliases(site, findings)
+        k = site.kernel
+        if k is None:
+            continue
+        key = (k.file.path, k.node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        _check_kernel(k.file, k.node, findings)
+    return findings

@@ -1,0 +1,267 @@
+"""L007 — plan/kernel launch-contract parity for Pallas calls.
+
+The plan/run split is the port's deepest contract: host planners emit
+scalar arrays that a device kernel consumes POSITIONALLY.  Nothing at
+runtime ties the three parties together — the kernel's parameter list,
+the grid spec's operand counts, and the planner's emitted plan arrays —
+so a skew fails late (Mosaic compile error on-chip, or silently wrong
+scalars read from the wrong prefetch slot).  PR 3's own commit note is
+the motivating incident: "fused_prefill plan arrays changed … 11
+scalar-prefetch operands — hw tier tests updated" was enforced by
+nothing.  Every piece is statically decidable from the AST:
+
+1. **Kernel arity.**  When ``num_scalar_prefetch``, ``in_specs``,
+   ``out_specs`` and ``scratch_shapes`` are all statically countable,
+   the kernel's positional parameter count must equal their sum (vararg
+   kernels are checked as: named positional params must not exceed it).
+2. **Scalar-prefetch params.**  A vararg kernel of a prefetch launch
+   names its scalar refs individually (the tree-wide idiom:
+   ``def k(a_ref, b_ref, *refs, ...)``); the named-positional count
+   must equal ``num_scalar_prefetch``.  This is what catches the
+   "11 operands" skew without countable in_specs.
+3. **index_map arity.**  A ``BlockSpec`` index_map lambda receives the
+   grid indices (plus the scalar-prefetch refs under
+   ``PrefetchScalarGridSpec``): a non-vararg lambda must take exactly
+   ``grid_rank (+ num_scalar_prefetch)`` params, a vararg lambda at
+   most that many named ones.
+4. **Planner registry.**  ``PLANNER_KERNELS`` maps a host planner to
+   the kernel consuming its plan (resolved through the project symbol
+   index, so they may live in different modules).  At the launch the
+   plan arrays are spelled ``plan["key"]`` positionally: their count
+   must equal ``num_scalar_prefetch`` and every consumed key must be a
+   key the planner actually emits.  Seeded with the fused-prefill pair
+   (``build_prefill_work_units`` -> ``_fused_prefill_kernel``,
+   ops/paged_prefill.py's 11 scalar-prefetch operands).
+
+Unresolvable pieces (dynamic ``len(prefetch)``, conditionally-built
+spec lists) are SKIPPED, never guessed — a contract pass that guesses
+trains people to ignore it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from flashinfer_tpu.analysis.core import (Finding, FunctionInfo,
+                                          PallasCallSite, Project,
+                                          expr_basename)
+
+CODE = "L007"
+
+# planner -> kernel pairs whose plan-array contract L007 enforces
+# end-to-end (check 4).  Extend when a new build_* planner feeds a
+# kernel's scalar-prefetch operands.
+PLANNER_KERNELS: Dict[str, str] = {
+    "build_prefill_work_units": "_fused_prefill_kernel",
+}
+
+
+def _lambda_of(spec: ast.expr) -> Optional[ast.Lambda]:
+    """The index_map lambda of a BlockSpec(...) expression, if any."""
+    if not (isinstance(spec, ast.Call)
+            and expr_basename(spec.func) == "BlockSpec"):
+        return None
+    cands = list(spec.args) + [k.value for k in spec.keywords
+                               if k.arg == "index_map"]
+    for c in cands:
+        if isinstance(c, ast.Lambda):
+            return c
+    return None
+
+
+def _check_index_maps(site: PallasCallSite,
+                      findings: List[Finding]) -> None:
+    if site.grid_rank is None:
+        return
+    expected = site.grid_rank
+    if site.is_prefetch_spec:
+        if site.num_scalar_prefetch is None:
+            return
+        expected += site.num_scalar_prefetch
+    for group in (site.in_spec_exprs, site.out_spec_exprs):
+        for spec in group or ():
+            lam = _lambda_of(spec)
+            if lam is None:
+                continue
+            named = len(lam.args.posonlyargs) + len(lam.args.args)
+            vararg = lam.args.vararg is not None
+            bad = (named > expected) if vararg else (named != expected)
+            if bad:
+                findings.append(Finding(
+                    CODE, site.file.path, lam.lineno,
+                    site.enclosing.name if site.enclosing else "<module>",
+                    f"BlockSpec index_map takes {named} parameter(s) but "
+                    f"the launch passes {expected} (grid rank "
+                    f"{site.grid_rank}"
+                    + (f" + {site.num_scalar_prefetch} scalar-prefetch "
+                       f"refs" if site.is_prefetch_spec else "")
+                    + ") — the map would be called with a mismatched "
+                    "argument list at trace time"))
+
+
+def _kernel_positional(site: PallasCallSite) -> Optional[int]:
+    """Named positional parameter count of the resolved kernel, with
+    partial-bound names excluded: keyword binds by name, and each
+    POSITIONAL partial arg consumes one leading param."""
+    k = site.kernel
+    if k is None:
+        return None
+    named = len([p for p in k.positional_params
+                 if p not in site.kernel_bound_kwargs])
+    return max(0, named - site.kernel_bound_posargs)
+
+
+def _check_kernel_arity(site: PallasCallSite,
+                        findings: List[Finding]) -> None:
+    named = _kernel_positional(site)
+    if named is None or site.kernel is None:
+        return
+    func = site.enclosing.name if site.enclosing else "<module>"
+    counts = (site.num_scalar_prefetch if site.is_prefetch_spec else 0,
+              site.in_spec_exprs, site.out_spec_exprs,
+              site.scratch_exprs)
+    if all(c is not None for c in counts):
+        expected = counts[0] + sum(len(c) for c in counts[1:])
+        if site.kernel.has_vararg:
+            if named > expected:
+                findings.append(Finding(
+                    CODE, site.file.path, site.line, func,
+                    f"kernel '{site.kernel.name}' names {named} "
+                    f"positional ref(s) before its vararg but the launch "
+                    f"only passes {expected} "
+                    "(num_scalar_prefetch + in_specs + out_specs + "
+                    "scratch_shapes) — the extra refs would bind nothing"))
+        elif named != expected:
+            findings.append(Finding(
+                CODE, site.file.path, site.line, func,
+                f"kernel '{site.kernel.name}' takes {named} positional "
+                f"ref(s) but the launch passes {expected} "
+                f"(num_scalar_prefetch={counts[0]} + "
+                f"{len(counts[1])} in_specs + {len(counts[2])} out_specs "
+                f"+ {len(counts[3])} scratch_shapes) — Mosaic fails this "
+                "at compile time on-chip; fix it at review time"))
+
+
+def _leading_plan_keys(site: PallasCallSite) -> Optional[List[str]]:
+    """The ``plan["key"]`` operands spelled before the first starred
+    operand at the launch invocation; None when the invocation is
+    absent or its leading operands are not plan subscripts."""
+    inv = site.invocation
+    if inv is None:
+        return None
+    keys: List[str] = []
+    base: Optional[str] = None
+    for a in inv.args:
+        if isinstance(a, ast.Starred):
+            break
+        is_key = (isinstance(a, ast.Subscript)
+                  and isinstance(a.value, ast.Name)
+                  and isinstance(a.slice, ast.Constant)
+                  and isinstance(a.slice.value, str))
+        if is_key and (base is None or a.value.id == base):
+            base = a.value.id
+            keys.append(a.slice.value)
+        elif is_key and keys:
+            # a key drawn from a DIFFERENT dict: the scalar prefix may
+            # span several plan dicts — not countable here, skip rather
+            # than report a truncated count
+            return None
+        else:
+            return keys if keys else None
+    return keys if keys else None
+
+
+def _planner_emitted_keys(planner: FunctionInfo) -> Optional[Set[str]]:
+    """String keys the planner's plan dict carries: ``dict(...)``
+    keyword names, ``{"k": ...}`` literal keys, and ``name["k"] = ...``
+    subscript stores anywhere in its body."""
+    keys: Set[str] = set()
+    found = False
+    for n in ast.walk(planner.node):
+        if isinstance(n, ast.Call) and expr_basename(n.func) == "dict":
+            kw = {k.arg for k in n.keywords if k.arg}
+            if kw:
+                keys |= kw
+                found = True
+        elif isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+                    found = True
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.slice, ast.Constant) \
+                        and isinstance(t.slice.value, str):
+                    keys.add(t.slice.value)
+                    found = True
+    return keys if found else None
+
+
+def _check_planner_contract(project: Project, site: PallasCallSite,
+                            findings: List[Finding]) -> None:
+    if site.kernel is None or not site.is_prefetch_spec:
+        return
+    planner_name = next(
+        (p for p, k in PLANNER_KERNELS.items()
+         if k == site.kernel.name), None)
+    if planner_name is None:
+        return
+    func = site.enclosing.name if site.enclosing else "<module>"
+    nsp = site.num_scalar_prefetch
+    # a REGISTERED kernel follows the named-scalar-refs convention:
+    # every scalar-prefetch ref is a named positional param before the
+    # vararg — so the named count must equal num_scalar_prefetch (this
+    # is what catches a skewed num_scalar_prefetch= literal)
+    named = _kernel_positional(site)
+    if named is not None and nsp is not None \
+            and site.kernel.has_vararg and named != nsp:
+        findings.append(Finding(
+            CODE, site.file.path, site.line, func,
+            f"kernel '{site.kernel.name}' names {named} scalar-prefetch "
+            f"ref(s) before its vararg but the launch sets "
+            f"num_scalar_prefetch={nsp} — scalar refs bind positionally, "
+            "so every ref after the skew reads the WRONG plan array "
+            "(silently wrong indices, not an error)"))
+    keys = _leading_plan_keys(site)
+    if keys is not None and nsp is not None and len(keys) != nsp:
+        findings.append(Finding(
+            CODE, site.file.path,
+            site.invocation.lineno if site.invocation else site.line,
+            func,
+            f"launch passes {len(keys)} plan array(s) "
+            f"({', '.join(keys)}) but num_scalar_prefetch={nsp} — the "
+            f"'{planner_name}' plan and the kernel would skew; every "
+            "scalar ref after the mismatch reads the wrong operand"))
+    planner = project.resolve_function(planner_name,
+                                       prefer_file=site.file)
+    if planner is None:
+        # not statically decidable here: a subset/--changed-only run may
+        # simply not include the planner's module (and resolve_function
+        # also returns None on ambiguity) — skip, never guess.  A truly
+        # stale registry entry is caught by the whole-tree fixture
+        # regressions, which require the planner checks to fire.
+        return
+    emitted = _planner_emitted_keys(planner)
+    if emitted is None or keys is None:
+        return
+    missing = [k for k in keys if k not in emitted]
+    if missing:
+        findings.append(Finding(
+            CODE, site.file.path,
+            site.invocation.lineno if site.invocation else site.line,
+            func,
+            f"launch consumes plan key(s) {missing} that planner "
+            f"'{planner_name}' ({planner.file.basename}:"
+            f"{planner.node.lineno}) never emits — the KeyError fires "
+            "at the first run() after the next plan-schema change"))
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for site in project.pallas_sites:
+        _check_kernel_arity(site, findings)
+        _check_index_maps(site, findings)
+        _check_planner_contract(project, site, findings)
+    return findings

@@ -1,0 +1,102 @@
+"""SARIF 2.1.0 output for the analyzer — the GitHub code-scanning
+surface.
+
+One run, one tool (``graft-lint``), one rule per pass code, one result
+per finding.  URIs are the same project-relative keys the baseline
+uses (``project_relpath``), so annotations land on the right file in
+any checkout regardless of where the CLI ran.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flashinfer_tpu.analysis.core import Finding, project_relpath
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+# code -> (short description, help text) — the rule metadata the
+# code-scanning UI shows; keep in sync with docs/static_analysis.md
+RULE_DESCRIPTIONS: Dict[str, str] = {
+    "L000": "graft-lint suppression without a reason",
+    "L001": "class-level method alias skipping a subclass override",
+    "L002": "positional-signature drift vs the reference bank",
+    "L003": "trace-time env/global read pinned by the jit cache",
+    "L004": "chip-wedging Mosaic pattern (wedge lint)",
+    "L005": "@flashinfer_api op missing from the obs catalog",
+    "L006": "stale/invalid tuning_configs tactic entry",
+    "L007": "Pallas plan/kernel launch-contract mismatch",
+    "L008": "traced value leaking into Python control flow",
+    "L009": "tuning-config blocks exceeding the VMEM budget",
+    "L010": "unguarded accumulator init / bad input_output_aliases",
+    "L999": "unparseable source",
+    "W000": "wedge-lint suppression without a reason",
+    "W001": "strided-gather lowering wedge",
+    "W002": "DMA queue-unroll wedge",
+    "W003": "lane-dim repeat/reshape wedge",
+    "W004": "unrolled-dot flags wedge",
+    "W999": "wedge-lint internal error",
+}
+
+
+def to_sarif(findings: List[Finding]) -> dict:
+    codes = sorted({f.code for f in findings})
+    rules = [
+        {
+            "id": code,
+            "name": code,
+            "shortDescription": {
+                "text": RULE_DESCRIPTIONS.get(code, "analyzer finding"),
+            },
+            # relative URI-reference: resolves inside whatever checkout
+            # the SARIF was uploaded from (the upstream repo does not
+            # carry this doc, so no absolute upstream link)
+            "helpUri": "docs/static_analysis.md",
+            "defaultConfiguration": {"level": "error"},
+        }
+        for code in codes
+    ]
+    rule_index = {code: i for i, code in enumerate(codes)}
+    results = [
+        {
+            "ruleId": f.code,
+            "ruleIndex": rule_index[f.code],
+            "level": "error",
+            "message": {"text": f"{f.func}: {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": project_relpath(f.filename),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(int(f.line), 1)},
+                    },
+                }
+            ],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graft-lint",
+                        "informationUri": (
+                            "https://github.com/flashinfer-ai/flashinfer"),
+                        "rules": rules,
+                    },
+                },
+                "originalUriBaseIds": {
+                    "SRCROOT": {"description": {
+                        "text": "repository root"}},
+                },
+                "results": results,
+            },
+        ],
+    }

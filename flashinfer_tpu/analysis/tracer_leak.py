@@ -1,0 +1,338 @@
+"""L008 — traced values leaking into Python-level control flow.
+
+``jax.jit`` and Pallas kernels run their Python bodies ONCE, over
+tracers.  A Python ``if``/``while``/``assert`` on a traced value either
+raises ``TracerBoolConversionError`` at first trace (the loud case) or
+— worse — silently specializes the whole trace on the first concrete
+value when the operand happens to be concrete off-jit (the wrong-
+numerics case).  ``int()``/``bool()``/``float()``/``.item()``
+concretize a tracer the same way, and ``np.*`` calls materialize it
+host-side at trace time, pinning the first value into the compiled
+program.
+
+Scope (deliberately precise, not maximal):
+
+- **jit bodies**: functions decorated/wrapped with ``jax.jit``/``pjit``
+  (bare, via ``functools.partial(jax.jit, ...)``, or assignment-
+  wrapped).  Parameters named in ``static_argnames``/``static_argnums``
+  are concrete and exempt.
+- **Pallas kernels**: functions resolved as pallas_call targets
+  (through the project symbol index, so a kernel launched from another
+  module is still covered).  Positional params are refs/values in the
+  traced world; keyword-only params are the partial-bound statics.
+
+Taint is local and syntactic: a name assigned from a traced expression
+is traced; ``.shape``/``.dtype``/``.ndim`` access, ``len()``, and
+``is``/``is not`` comparisons yield static values (pytree structure is
+static under jit) and break the chain.  Nested defs (the ``pl.when``
+closure idiom) share the enclosing traced environment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from flashinfer_tpu.analysis.core import (Finding, Project, SourceFile,
+                                          expr_basename, expr_root)
+
+CODE = "L008"
+
+_JIT_NAMES = {"jit", "pjit"}
+_PARTIAL_NAMES = {"partial"}
+# attribute reads that are static under tracing (structure, not data)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                 "itemsize", "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "type", "range", "enumerate",
+                 "hasattr", "id", "repr", "str", "format"}
+_CONCRETIZERS = {"int", "bool", "float", "complex"}
+_NP_ROOTS = {"np", "numpy"}
+
+
+def _is_jit_expr(expr: ast.expr) -> bool:
+    if expr_basename(expr) in _JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        if expr_basename(expr.func) in _JIT_NAMES:
+            return True
+        if expr_basename(expr.func) in _PARTIAL_NAMES and expr.args \
+                and _is_jit_expr(expr.args[0]):
+            return True
+    return False
+
+
+def _static_names_of(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names pinned static by a jit call's
+    static_argnames/static_argnums literals."""
+    out: Set[str] = set()
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for k in call.keywords:
+        if k.arg == "static_argnames":
+            vals = (k.value.elts
+                    if isinstance(k.value, (ast.Tuple, ast.List))
+                    else [k.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+        elif k.arg == "static_argnums":
+            vals = (k.value.elts
+                    if isinstance(k.value, (ast.Tuple, ast.List))
+                    else [k.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int) \
+                        and 0 <= v.value < len(pos):
+                    out.add(pos[v.value])
+    return out
+
+
+def _jitted_defs(sf: SourceFile) -> List[Tuple[ast.FunctionDef, Set[str]]]:
+    """(def, static param names) for every jitted function in `sf`."""
+    if sf.tree is None:
+        return []
+    # assignment-wrapped: g = jax.jit(f, static_argnames=...)
+    wrapped: Dict[str, ast.Call] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            wrapped[node.args[0].id] = node
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        statics: Optional[Set[str]] = None
+        for d in node.decorator_list:
+            if _is_jit_expr(d):
+                statics = set()
+                if isinstance(d, ast.Call):
+                    statics = _static_names_of(d, node)
+                    # partial(jax.jit, static_argnames=...) carries the
+                    # kwargs on the partial call itself
+                break
+        if statics is None and node.name in wrapped:
+            statics = _static_names_of(wrapped[node.name], node)
+        if statics is not None:
+            out.append((node, statics))
+    return out
+
+
+class _Scope:
+    """Taint environment for one traced body (shared by nested defs)."""
+
+    def __init__(self, traced: Set[str]):
+        self.traced = set(traced)
+
+    # -- expression taint ------------------------------------------------
+
+    def tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.traced
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self.tainted(expr.value)
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return False  # None-ness / identity is pytree structure
+            return self.tainted(expr.left) or any(
+                self.tainted(c) for c in expr.comparators)
+        if isinstance(expr, ast.Call):
+            base = expr_basename(expr.func)
+            if base in _STATIC_CALLS:
+                return False
+            args = list(expr.args) + [k.value for k in expr.keywords]
+            arg_taint = any(self.tainted(a) for a in args)
+            # a call ON a traced object (plan.get(...)) is traced too
+            if isinstance(expr.func, ast.Attribute) \
+                    and self.tainted(expr.func):
+                return True
+            return arg_taint
+        if isinstance(expr, ast.Subscript):
+            return self.tainted(expr.value)
+        if isinstance(expr, (ast.BinOp,)):
+            return self.tainted(expr.left) or self.tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.tainted(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.tainted(v) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return any(self.tainted(e)
+                       for e in (expr.test, expr.body, expr.orelse))
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in expr.elts
+                       if not isinstance(e, ast.Starred)) or any(
+                self.tainted(e.value) for e in expr.elts
+                if isinstance(e, ast.Starred))
+        if isinstance(expr, ast.Starred):
+            return self.tainted(expr.value)
+        return False
+
+    def bind(self, target: ast.expr, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.traced.add(target.id)
+            else:
+                self.traced.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self.bind(t.value if isinstance(t, ast.Starred) else t,
+                          tainted)
+
+
+def _check_body(fn: ast.FunctionDef, scope: _Scope, sf: SourceFile,
+                kind: str, findings: List[Finding],
+                fname: Optional[str] = None) -> None:
+    fname = fname or fn.name
+
+    def visit(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the pl.when-closure idiom: nested defs trace in the
+                # SAME environment; their own params shadow
+                inner = _Scope(scope.traced)
+                for a in (stmt.args.posonlyargs + stmt.args.args
+                          + stmt.args.kwonlyargs):
+                    inner.traced.discard(a.arg)
+                _check_body(stmt, inner, sf, kind, findings, fname)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)) \
+                    and scope.tainted(stmt.test):
+                findings.append(Finding(
+                    CODE, sf.path, stmt.lineno, fname,
+                    f"Python {'if' if isinstance(stmt, ast.If) else 'while'}"
+                    f" on a traced value inside a {kind} — the branch is "
+                    "resolved ONCE at trace time (or raises "
+                    "TracerBoolConversionError); use jnp.where / "
+                    "lax.cond / pl.when on the traced operand, or hoist "
+                    "the decision to the host"))
+            if isinstance(stmt, ast.Assert) and scope.tainted(stmt.test):
+                findings.append(Finding(
+                    CODE, sf.path, stmt.lineno, fname,
+                    f"assert on a traced value inside a {kind} — it "
+                    "cannot check runtime data (checkify is the traced "
+                    "form); move the assert to the host-side planner"))
+            # scan only THIS statement's own expressions — compound
+            # statements' bodies are visited recursively below, and
+            # scanning them here too would double-report
+            if isinstance(stmt, (ast.If, ast.While)):
+                own = ast.walk(stmt.test)
+            elif isinstance(stmt, ast.For):
+                own = ast.walk(stmt.iter)
+            elif isinstance(stmt, ast.With):
+                own = (n for item in stmt.items
+                       for n in ast.walk(item.context_expr))
+            elif isinstance(stmt, ast.Try):
+                own = iter(())
+            else:
+                own = ast.walk(stmt)
+            for expr in own:
+                if not isinstance(expr, ast.Call):
+                    continue
+                base = expr_basename(expr.func)
+                args = list(expr.args) + [k.value for k in expr.keywords]
+                if base in _CONCRETIZERS and any(
+                        scope.tainted(a) for a in args):
+                    findings.append(Finding(
+                        CODE, sf.path, expr.lineno, fname,
+                        f"{base}() on a traced value inside a {kind} "
+                        "concretizes it at trace time — the first "
+                        "traced value is baked into every later call"))
+                elif base == "item" \
+                        and isinstance(expr.func, ast.Attribute) \
+                        and scope.tainted(expr.func.value):
+                    findings.append(Finding(
+                        CODE, sf.path, expr.lineno, fname,
+                        f".item() on a traced value inside a {kind} "
+                        "forces a host round-trip at trace time — keep "
+                        "the value on-device or compute it in the "
+                        "host-side plan"))
+                elif expr_root(expr.func) in _NP_ROOTS \
+                        and isinstance(expr.func, ast.Attribute) \
+                        and any(scope.tainted(a) for a in args):
+                    findings.append(Finding(
+                        CODE, sf.path, expr.lineno, fname,
+                        f"np.{expr_basename(expr.func)}() applied to a "
+                        f"traced value inside a {kind} materializes it "
+                        "host-side at trace time and pins the result in "
+                        "the jit cache — use the jnp equivalent"))
+            # statement-level rebinds AFTER scanning the statement, so
+            # `x = int(x)` still reports on the traced right-hand side
+            if isinstance(stmt, ast.Assign):
+                t = scope.tainted(stmt.value)
+                for tgt in stmt.targets:
+                    scope.bind(tgt, t)
+            elif isinstance(stmt, ast.AugAssign):
+                if scope.tainted(stmt.value) and isinstance(
+                        stmt.target, ast.Name):
+                    scope.traced.add(stmt.target.id)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                scope.bind(stmt.target, scope.tainted(stmt.value))
+            elif isinstance(stmt, ast.For):
+                # iterating a Python list built FROM traced values is
+                # legal (the list is host-side); the loop var inherits
+                # the iterable's taint for reads inside the body
+                scope.bind(stmt.target, scope.tainted(stmt.iter))
+                visit(stmt.body)
+                visit(stmt.orelse)
+                continue
+            elif isinstance(stmt, ast.With):
+                visit(stmt.body)
+                continue
+            elif isinstance(stmt, ast.Try):
+                visit(stmt.body)
+                for h in stmt.handlers:
+                    visit(h.body)
+                visit(stmt.orelse)
+                visit(stmt.finalbody)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                visit(stmt.body)
+                visit(stmt.orelse)
+
+    visit(fn.body)
+
+
+def _kernel_defs(project: Project) -> List[Tuple[SourceFile,
+                                                 ast.FunctionDef,
+                                                 Set[str]]]:
+    """Unique kernels with the param names partial-bound at ANY launch
+    site (keyword binds by name, positional binds the leading params) —
+    those are compile-time statics, not traced refs."""
+    agg: Dict[tuple, Tuple[SourceFile, ast.FunctionDef, Set[str]]] = {}
+    for site in project.pallas_sites:
+        k = site.kernel
+        if k is None:
+            continue
+        key = (k.file.path, k.node.lineno)
+        entry = agg.setdefault(key, (k.file, k.node, set()))
+        bound = entry[2]
+        bound |= site.kernel_bound_kwargs
+        pos = [a.arg for a in (k.node.args.posonlyargs
+                               + k.node.args.args)]
+        bound |= set(pos[:site.kernel_bound_posargs])
+    return list(agg.values())
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        for fn, statics in _jitted_defs(sf):
+            traced = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)
+                      if a.arg not in statics}
+            if fn.args.vararg:
+                traced.add(fn.args.vararg.arg)
+            _check_body(fn, _Scope(traced), sf, "jit-traced body",
+                        findings)
+    for sf, fn, bound in _kernel_defs(project):
+        # positional params are refs; keyword-only params and
+        # partial-bound names (keyword OR leading positional) are the
+        # launch statics
+        traced = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  if a.arg not in bound}
+        if fn.args.vararg:
+            traced.add(fn.args.vararg.arg)
+        _check_body(fn, _Scope(traced), sf, "Pallas kernel", findings)
+    return findings

@@ -93,9 +93,10 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
             logits_soft_cap=logits_soft_cap, window_left=window_left,
             q_data_type=q_data_type, kv_data_type=kv_data_type,
         )
-        # recorded only once the plan is actually live: a failed re-plan
-        # must not desync the cap run() validates against from the
-        # still-active previous plan
+        # record of the PLANNED cap (run() no longer validates against
+        # it — a differing per-run cap rebinds the frozen plan instead);
+        # set only once the plan is actually live so a failed re-plan
+        # cannot desync it from the still-active previous plan
         self._plan_soft_cap = float(logits_soft_cap or 0.0)
 
     def run(self, q, paged_kv_cache, out=None, lse=None, k_scale=None,
@@ -103,14 +104,17 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
             profiler_buffer=None, *, kv_cache_sf=None, **kw):
         """Reference contract (attention/_core.py:216): ALWAYS returns
         ``(out, lse)``; ``k_scale`` folds into sm_scale for this call,
-        ``v_scale`` scales the output.  ``logits_soft_cap``: a non-zero
-        value must match the planned one; the 0.0 default INHERITS the
-        planned cap (it is baked into the kernel at plan time — pass a
-        matching non-zero value to be explicit).  ``profiler_buffer`` is
-        inert (op timelines come from flashinfer_tpu.profiler);
-        ``out``/``lse``/``kv_cache_sf`` prealloc/fp8-sf are rejected
-        loudly; the scale/epilogue mechanics live in the base paged
-        wrapper's run (one copy)."""
+        ``v_scale`` scales the output.  ``logits_soft_cap``: the 0.0
+        default INHERITS the planned cap; a non-zero value takes effect
+        FOR THIS CALL (the reference forwards the run value to the
+        kernel, attention/_core.py:250) — a value differing from the
+        planned one rebinds the frozen plan for the call, the same
+        mechanism as the per-run sm_scale rebind (a novel cap compiles
+        a fresh kernel variant; counted via plan.soft_cap_rebinds).
+        ``profiler_buffer`` is inert (op timelines come from
+        flashinfer_tpu.profiler); ``out``/``lse``/``kv_cache_sf``
+        prealloc/fp8-sf are rejected loudly; the scale/epilogue
+        mechanics live in the base paged wrapper's run (one copy)."""
         if kv_cache_sf is not None:
             raise NotImplementedError(
                 "kv_cache_sf fp8 scale factors: quantize the cache via "
@@ -122,15 +126,18 @@ class BatchAttention(BatchPrefillWithPagedKVCacheWrapper):
                     "(reference attention/_core.py:216); return_lse="
                     "False is not available — drop the kwarg")
         soft_cap = float(logits_soft_cap or 0.0)
-        planned = getattr(self, "_plan_soft_cap", 0.0)
-        if soft_cap != 0.0 and soft_cap != planned:
-            raise ValueError(
-                f"logits_soft_cap={soft_cap} inconsistent with the "
-                f"planned value {planned} (reference requires both, "
-                "attention/_core.py:250)")
-        return super().run(
-            q, paged_kv_cache, out=out, lse=lse, k_scale=k_scale,
-            v_scale=v_scale, return_lse=True, **kw)
+        restore_plan = None
+        if soft_cap != 0.0:
+            # ADVICE r5 item 3: the verbatim reference caller varies the
+            # cap per run; honor it instead of raising on the mismatch
+            restore_plan = self._rebind_soft_cap(soft_cap)
+        try:
+            return super().run(
+                q, paged_kv_cache, out=out, lse=lse, k_scale=k_scale,
+                v_scale=v_scale, return_lse=True, **kw)
+        finally:
+            if restore_plan is not None:
+                self._plan = restore_plan
 
     # rebind: the paged base class set `forward = run` to ITS run at
     # class-definition time; without this, forward() would skip the

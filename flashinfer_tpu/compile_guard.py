@@ -209,10 +209,12 @@ def guarded(
         # static wedge-pattern lint runs once per module per process,
         # BEFORE the first hardware compile: a kernel matching a
         # known-wedging Mosaic pattern refuses to compile in strict mode
-        # (default on real TPU) rather than risking the chip
-        from flashinfer_tpu import wedge_lint
+        # (default on real TPU) rather than risking the chip.  Imported
+        # from the analyzer package directly — the wedge_lint module is
+        # a deprecated shim and warns on import
+        from flashinfer_tpu.analysis import wedge
 
-        wedge_lint.check_module(module)
+        wedge.check_module(module)
     try:
         if not trace_state_clean():
             # Under an outer jit trace the thunk returns a tracer and

@@ -12,7 +12,8 @@ Commands:
   from a REPL / atexit hook).
 - ``doctor``: device/env/backend health — collect_env, the
   FLASHINFER_TPU_* flag matrix, backend resolution, compile-guard
-  quarantine state, tuner cache, and registry liveness.
+  quarantine state, tuner cache, registry liveness, and lint hygiene
+  (the reasonless-suppression count the analyzer would fail on).
 """
 
 from __future__ import annotations
@@ -152,6 +153,29 @@ def cmd_doctor(args) -> int:
         "histograms": len(snap["histograms"]),
         "timeline_active": profiler.timeline_active(),
     }
+
+    # static-analysis hygiene: a reasonless `# graft-lint: ok` /
+    # `# wedge-lint: ok` is an unreviewable waiver (L000/W000 — the
+    # analyzer fails on them, they can never be baselined); a non-zero
+    # count here means the tree cannot pass lint
+    try:
+        import flashinfer_tpu as _fi
+        from flashinfer_tpu.analysis import core as _acore
+
+        pkg = os.path.dirname(os.path.abspath(_fi.__file__))
+        total = reasonless = 0
+        for path in _acore.iter_python_files([pkg]):
+            sf = _acore.load_file(path)
+            for table in (sf.suppressions, sf.wedge_suppressions):
+                total += len(table)
+                reasonless += sum(
+                    1 for reason in table.values() if not reason)
+        report["lint"] = {
+            "suppressions": total,
+            "reasonless_suppressions": reasonless,
+        }
+    except Exception as e:  # doctor must never crash on a broken tree
+        report["lint"] = f"<unavailable: {type(e).__name__}>"
     print(json.dumps(report, indent=1, sort_keys=True))
     return 0
 

@@ -48,6 +48,13 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "counter", ("wrapper",),
         "frozen-plan sm_scale replacements (per-call k_scale/sm_scale "
         "overrides swapping a dataclasses.replace'd plan in and out)"),
+    "plan.soft_cap_rebinds": (
+        "counter", ("wrapper",),
+        "frozen-plan logits_soft_cap replacements (BatchAttention.run "
+        "honoring a per-run cap that differs from the planned one — "
+        "the reference-parity rebind; each novel cap value compiles a "
+        "fresh kernel variant, so a hot counter here means the caller "
+        "should re-plan instead)"),
     "plan.padding_waste_pct": (
         "histogram", ("wrapper", "axis"),
         "planned-vs-actual padding waste per plan(): 100*(1 - "

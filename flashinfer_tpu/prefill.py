@@ -899,6 +899,27 @@ class BatchPrefillWithPagedKVCacheWrapper:
         self._plan = dataclasses.replace(restore, sm_scale=new)
         return restore
 
+    def _rebind_soft_cap(self, soft_cap):
+        """Per-call logits_soft_cap override (the reference forwards the
+        run() value to the kernel, attention/_core.py:250): swap in a
+        plan with the new cap and return the plan to restore in the
+        caller's ``finally`` (None if unchanged).  The cap is a kernel
+        jit-static, so a novel value compiles a fresh variant — the same
+        frozen-plan-replace contract as ``_rebind_sm_scale``."""
+        if self._plan is None or soft_cap is None:
+            return None
+        new = float(soft_cap)
+        if new == self._plan.logits_soft_cap:
+            return None
+        import dataclasses
+
+        from flashinfer_tpu import obs
+
+        obs.counter_inc("plan.soft_cap_rebinds", wrapper=type(self).__name__)
+        restore = self._plan
+        self._plan = dataclasses.replace(restore, logits_soft_cap=new)
+        return restore
+
     def run(
         self,
         q: jax.Array,  # [total_q, num_qo_heads, head_dim]
@@ -1040,14 +1061,16 @@ class BatchPrefillWithPagedKVCacheWrapper:
         if plan.kv_gather_rows is None:
             # fused plan was active but this call needs the gather path
             # (return_lse): materialize the deferred plan once.  Preserve
-            # a live sm_scale rebind (per-run k_scale/sm_scale override)
-            # — the builder recomputes the PLANNED scale.
+            # live sm_scale / logits_soft_cap rebinds (per-run overrides)
+            # — the builder recomputes the PLANNED values.
             new_plan = self._gather_plan_builder()
-            if new_plan.sm_scale != plan.sm_scale:
+            if new_plan.sm_scale != plan.sm_scale \
+                    or new_plan.logits_soft_cap != plan.logits_soft_cap:
                 import dataclasses
 
                 new_plan = dataclasses.replace(
-                    new_plan, sm_scale=plan.sm_scale)
+                    new_plan, sm_scale=plan.sm_scale,
+                    logits_soft_cap=plan.logits_soft_cap)
             plan = self._plan = new_plan
         if check_kv_layout(self._kv_layout) == TensorLayout.HND:
             k_cache = jnp.swapaxes(k_cache, 1, 2)

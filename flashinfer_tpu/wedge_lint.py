@@ -3,20 +3,31 @@
 static analyzer (``python -m flashinfer_tpu.analysis``), behind the
 shared driver, suppression, and baseline machinery.
 
-This module re-exports the complete historical surface so
-``compile_guard.check_module`` and existing callers/tests keep working
-unchanged.  New code should import from ``flashinfer_tpu.analysis``.
+This module re-exports the complete historical surface so existing
+callers/tests keep working unchanged, but importing it now emits a
+``DeprecationWarning``: the runtime compile guard goes straight to
+``flashinfer_tpu.analysis.wedge``, and new code should too
+(docs/migration.md "wedge_lint deprecation").
 """
 
 from __future__ import annotations
 
+import warnings
+
+warnings.warn(
+    "flashinfer_tpu.wedge_lint is a deprecated compat shim — the wedge "
+    "lint is pass L004 of the multi-pass analyzer: run `python -m "
+    "flashinfer_tpu.analysis` and import from "
+    "flashinfer_tpu.analysis.wedge (docs/migration.md)",
+    DeprecationWarning, stacklevel=2)
+
 # the tests monkeypatch `wedge_lint.inspect` — it must be the same
 # module object the implementation reads (modules are singletons)
-import inspect  # noqa: F401
-import os  # noqa: F401
+import inspect  # noqa: F401,E402
+import os  # noqa: F401,E402
 
-from flashinfer_tpu.analysis.core import Finding  # noqa: F401
-from flashinfer_tpu.analysis.wedge import (  # noqa: F401
+from flashinfer_tpu.analysis.core import Finding  # noqa: F401,E402
+from flashinfer_tpu.analysis.wedge import (  # noqa: F401,E402
     DMA_UNROLL_LIMIT,
     DOT_UNROLL_LIMIT,
     WedgeLintError,

@@ -6,8 +6,13 @@ tree (plus any extra paths given), against the committed baseline.
 Exit 1 means findings a commit would introduce — fix, suppress with a
 reason, or triage into the baseline (docs/static_analysis.md).
 
+Every analyzer flag passes through, so the two CI surfaces are this
+one script:
+    python scripts/graft_lint.py --sarif out.sarif   # code scanning
+    python scripts/graft_lint.py --changed-only      # pre-commit
+
 Usage:
-    python scripts/graft_lint.py [extra paths...]
+    python scripts/graft_lint.py [extra paths...] [analyzer flags...]
 """
 
 import os
@@ -22,6 +27,7 @@ if __name__ == "__main__":
     from flashinfer_tpu.analysis import main
 
     # the package tree is ALWAYS linted; extra argv paths add to it
-    # (docstring contract: "plus any extra paths given")
-    paths = [os.path.join(REPO_ROOT, "flashinfer_tpu")] + sys.argv[1:]
-    raise SystemExit(main(paths))
+    # (docstring contract: "plus any extra paths given"); flags pass
+    # through to the analyzer's own argparse
+    argv = [os.path.join(REPO_ROOT, "flashinfer_tpu")] + sys.argv[1:]
+    raise SystemExit(main(argv))

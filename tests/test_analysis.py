@@ -905,3 +905,916 @@ def test_flatten_config_drops_invalid_entries_and_merges_sections():
     # section entry wins on collision; invalid/unknown entries dropped
     assert flat == {"rmsnorm.row_block|k": 256,
                     "fused_prefill.blocks|k": [128, 8]}
+
+
+# ---------------------------------------- L007 pallas_contract --
+
+
+OPS_PREFILL = os.path.join(PKG_ROOT, "ops", "paged_prefill.py")
+
+
+def _prefill_project(src):
+    """The real paged_prefill.py (optionally surgically edited) as a
+    one-file project — the acceptance regression runs the pass against
+    the REAL planner/kernel/launch, not a toy."""
+    return _project(("ops/paged_prefill.py", src))
+
+
+@pytest.mark.quick
+def test_l007_flags_injected_num_scalar_prefetch_skew():
+    """THE acceptance regression: deliberately skewing the
+    num_scalar_prefetch literal at the fused-prefill launch must fail
+    L007 (both the kernel-param check and the plan-operand check)."""
+    real = open(OPS_PREFILL).read()
+    skew = real.replace("num_scalar_prefetch=11,",
+                        "num_scalar_prefetch=10,")
+    assert skew != real
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_prefill_project(skew))
+    assert len(findings) == 2, findings
+    assert all(f.code == "L007" for f in findings)
+    assert any("names 11 scalar-prefetch ref(s)" in f.message
+               for f in findings)
+    assert any("passes 11 plan array(s)" in f.message for f in findings)
+
+
+def test_l007_flags_dropped_plan_array_operand():
+    """Dropping one plan array from the launch invocation (10 operands
+    vs num_scalar_prefetch=11) must fail."""
+    real = open(OPS_PREFILL).read()
+    drop = real.replace(
+        'plan["qslot"], plan["code"], plan["pages"],',
+        'plan["code"], plan["pages"],')
+    assert drop != real
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_prefill_project(drop))
+    assert [f.code for f in findings] == ["L007"], findings
+    assert "passes 10 plan array(s)" in findings[0].message
+
+
+def test_l007_flags_plan_key_the_planner_never_emits():
+    """Dropping 'qslot' from the planner's returned dict while the
+    launch still consumes plan["qslot"] must fail — the cross-function
+    (planner -> launch) half of the contract."""
+    real = open(OPS_PREFILL).read()
+    dropkey = real.replace(
+        "qslot=np.asarray(qslot, np.int32), code=arr(6, np.int32),",
+        "code=arr(6, np.int32),")
+    assert dropkey != real
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_prefill_project(dropkey))
+    assert [f.code for f in findings] == ["L007"], findings
+    assert "qslot" in findings[0].message
+    assert "build_prefill_work_units" in findings[0].message
+
+
+def test_l007_index_map_arity_and_kernel_arity_fixture():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(x_ref, o_ref, acc_ref, extra_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(
+                _k,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (0, 0)),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+            )(x)
+    """
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_project(("k.py", src)))
+    # one index_map arity finding (lambda i vs rank-2 grid) and one
+    # kernel arity finding (4 params vs 1+1+1=3)
+    assert len(findings) == 2, findings
+    assert all(f.code == "L007" for f in findings)
+    assert any("index_map takes 1 parameter(s)" in f.message
+               for f in findings)
+    assert any("takes 4 positional ref(s)" in f.message
+               for f in findings)
+
+
+def test_l007_positional_partial_binds_counted_out():
+    """partial(_k, True) consumes the kernel's leading param: the
+    3-param kernel launched with 2 specs must NOT be flagged."""
+    src = """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _k(causal, x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(
+                functools.partial(_k, True),
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            )(x)
+    """
+    from flashinfer_tpu.analysis import pallas_contract
+
+    assert pallas_contract.run(_project(("k.py", src))) == []
+
+
+def test_l007_unresolvable_registered_planner_skips():
+    """A subset run missing the registered planner's module must skip
+    the planner checks, not report — --changed-only analyzes partial
+    trees and can only under-report, never false-fail."""
+    real = open(OPS_PREFILL).read()
+    # strip the planner def so only the launch half is in the project
+    launch_only = real.replace("def build_prefill_work_units",
+                               "def _renamed_away_planner")
+    assert launch_only != real
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_prefill_project(launch_only))
+    assert findings == [], findings
+
+
+def test_l007_shadowing_param_does_not_resolve_to_outer_assign():
+    """An inner function's parameter must be UNRESOLVABLE, not fall
+    through to a shadowed outer once-assigned name — the launch takes
+    whatever list the caller passes at runtime."""
+    src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _k(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def outer(x):
+            specs = [pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                     pl.BlockSpec((8, 128), lambda i: (0, 0))]
+
+            def inner(specs):
+                return pl.pallas_call(
+                    _k,
+                    grid=(4,),
+                    in_specs=specs,
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                )(x)
+
+            return inner([pl.BlockSpec((8, 128), lambda i: (0, 0))])
+    """
+    from flashinfer_tpu.analysis import pallas_contract
+
+    assert pallas_contract.run(_project(("k.py", src))) == []
+
+
+def test_l007_cross_module_planner_resolution():
+    """Planner in one module, launch in another: the registry check
+    resolves through the project symbol index."""
+    planner = """
+        import numpy as np
+
+        def build_prefill_work_units(n):
+            return dict(qstart=np.zeros(n), kvlen=np.zeros(n))
+    """
+    launch = """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _fused_prefill_kernel(qstart_ref, kvlen_ref, *refs, bq):
+            pass
+
+        def go(plan, q):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(4,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[],
+            )
+            return pl.pallas_call(
+                functools.partial(_fused_prefill_kernel, bq=8),
+                grid_spec=grid_spec,
+                out_shape=q,
+            )(plan["qstart"], plan["MISSING"], q)
+    """
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(
+        _project(("planner.py", planner), ("launchmod.py", launch)))
+    assert [f.code for f in findings] == ["L007"], findings
+    assert "MISSING" in findings[0].message
+
+
+def test_l007_to_l010_real_tree_clean():
+    """Clean-tree pin for ALL four kernel-contract passes on one shared
+    Project (pallas_sites resolve once): the shipped planner/kernel/
+    launch triples agree, no traced-value leaks, shipped configs fit
+    VMEM, accumulators are initialized — with NO baseline absorption
+    (the passes themselves return nothing)."""
+    from flashinfer_tpu.analysis import (kernel_init_guard,
+                                         pallas_contract, tracer_leak,
+                                         vmem_budget)
+
+    project = Project.from_paths([PKG_ROOT])
+    assert pallas_contract.run(project) == []
+    assert tracer_leak.run(project) == []
+    assert vmem_budget.run(project) == []
+    assert kernel_init_guard.run(project) == []
+
+
+# ------------------------------------------- L008 tracer_leak --
+
+
+@pytest.mark.quick
+def test_l008_flags_traced_control_flow_and_concretization():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x, n):
+            if x > 0:
+                x = x + 1
+            k = int(n)
+            y = np.sum(x)
+            z = x.item()
+            assert x > 0
+            return x
+    """
+    from flashinfer_tpu.analysis import tracer_leak
+
+    findings = tracer_leak.run(_project(("m.py", src)))
+    assert len(findings) == 5, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "Python if" in msgs
+    assert "int()" in msgs
+    assert "np.sum()" in msgs
+    assert ".item()" in msgs
+    assert "assert" in msgs
+
+
+def test_l008_static_args_shape_and_structure_are_exempt():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            if n > 2:
+                return x
+            total, d = x.shape
+            if total > 8:
+                return x
+            has = x is not None
+            if has:
+                return x
+            while d > 1:
+                d //= 2
+            return x
+    """
+    from flashinfer_tpu.analysis import tracer_leak
+
+    assert tracer_leak.run(_project(("m.py", src))) == []
+
+
+def test_l008_pallas_kernel_refs_are_traced_kwonly_static():
+    src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(x_ref, o_ref, *, causal):
+            if causal:          # partial-bound static: fine
+                pass
+            if x_ref[0] > 0:    # traced ref read: leak
+                pass
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            import functools
+            return pl.pallas_call(
+                functools.partial(_k, causal=True),
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            )(x)
+    """
+    from flashinfer_tpu.analysis import tracer_leak
+
+    findings = tracer_leak.run(_project(("k.py", src)))
+    assert [f.code for f in findings] == ["L008"], findings
+    assert findings[0].func == "_k"
+
+
+def test_l008_positionally_bound_kernel_static_exempt():
+    """partial(_k, True): the leading positional param is a launch
+    static, not a traced ref — branching on it must not be flagged."""
+    src = """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _k(causal, x_ref, o_ref):
+            if causal:
+                o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(
+                functools.partial(_k, True),
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            )(x)
+    """
+    from flashinfer_tpu.analysis import tracer_leak
+
+    assert tracer_leak.run(_project(("k.py", src))) == []
+
+
+# ------------------------------------------- L009 vmem_budget --
+
+
+def _staged_vmem_project(tmp_path, blocks):
+    """A synthetic project holding the REAL fused-prefill launcher and
+    one tuning config naming the given blocks for a huge page_size."""
+    pkg = tmp_path / "pkg"
+    (pkg / "tuning_configs").mkdir(parents=True)
+    (pkg / "mod.py").write_text(open(OPS_PREFILL).read())
+    cfg = pkg / "tuning_configs" / "v5e.json"
+    cfg.write_text(json.dumps({
+        "tactics": {
+            "fused_prefill.blocks|8_4096_32_8_128_16384": blocks,
+        },
+    }))
+    return Project.from_paths([str(pkg)]), str(cfg)
+
+
+@pytest.mark.quick
+def test_l009_flags_blocks_that_cannot_fit_vmem(tmp_path):
+    from flashinfer_tpu.analysis import vmem_budget
+
+    project, cfg = _staged_vmem_project(tmp_path, [8192, 512])
+    findings = vmem_budget.run(project)
+    assert [f.code for f in findings] == ["L009"], findings
+    f = findings[0]
+    assert f.filename == cfg
+    assert "vmem_limit_bytes=64 MiB" in f.message
+    assert "can never compile" in f.message
+    # findings anchor to the key's line in the JSON
+    assert json.dumps(f.func) in open(cfg).read().splitlines()[f.line - 1]
+
+
+def test_l009_sane_blocks_pass(tmp_path):
+    from flashinfer_tpu.analysis import vmem_budget
+
+    project, _ = _staged_vmem_project(tmp_path, [128, 1])
+    assert vmem_budget.run(project) == []
+
+
+def test_l009_conditional_assignments_min_merge():
+    """A write under an If may not execute: the evaluator must keep the
+    SMALLEST value on any path, or 'cannot fit' stops being a proof."""
+    import ast as ast_mod
+
+    from flashinfer_tpu.analysis.vmem_budget import _Evaluator
+
+    fn = ast_mod.parse(textwrap.dedent("""
+        def launcher(total_q, block_q):
+            bq = 64
+            if total_q > 512:
+                bq = block_q
+            else:
+                bq = 32
+    """)).body[0]
+    ev = _Evaluator({"total_q": 256, "block_q": 8192}, 2)
+    ev.run_body(fn)
+    assert ev.env["bq"] == 32  # NOT 8192 (last-write-wins would)
+
+
+def test_l007_absent_scratch_shapes_counts_as_zero():
+    """Omitting scratch_shapes= is statically ZERO scratch refs — the
+    kernel-arity check must still run and catch the extra param."""
+    src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _k(x_ref, o_ref, ghost_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(
+                _k,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            )(x)
+    """
+    from flashinfer_tpu.analysis import pallas_contract
+
+    findings = pallas_contract.run(_project(("k.py", src)))
+    assert any(f.code == "L007" and "3 positional ref(s)" in f.message
+               for f in findings), findings
+
+
+def test_l009_estimate_is_physically_plausible():
+    """The symbolic evaluator reproduces the hand-computed scratch
+    footprint of the fused-prefill kernel for a known shape."""
+    from flashinfer_tpu.analysis.vmem_budget import KNOB_LAUNCHES, _estimate
+
+    project = Project.from_paths([PKG_ROOT])
+    est = _estimate(project, KNOB_LAUNCHES["fused_prefill.blocks"],
+                    [256, 16], "8_4096_32_8_128_16".split("_"))
+    assert est is not None
+    total, budget, _launcher = est
+    # bq=256 group=4 D=128 chunk=256: qbuf 512K + k/v 256K + obuf 256K
+    # + acc 512K + m/l 1M  ≈ 2.6 MB
+    assert 2_000_000 < total < 3_500_000, total
+    assert budget == 64 * 1024 * 1024
+
+
+# -------------------------------------- L010 kernel_init_guard --
+
+
+L010_KERNEL = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _acc_kernel(x_ref, o_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i != 0)
+        def _():
+            acc_ref[...] = acc_ref[...] + x_ref[...]
+
+        o_ref[...] = acc_ref[...]
+
+    def launch(x):
+        return pl.pallas_call(
+            _acc_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+        )(x)
+"""
+
+
+@pytest.mark.quick
+def test_l010_flags_uninitialized_guarded_accumulator():
+    from flashinfer_tpu.analysis import kernel_init_guard
+
+    findings = kernel_init_guard.run(_project(("k.py", L010_KERNEL)))
+    assert [f.code for f in findings] == ["L010"], findings
+    assert "acc_ref" in findings[0].message
+    assert "EXCLUDE the first grid step" in findings[0].message
+
+
+def test_l010_step_zero_init_write_is_clean():
+    fixed = L010_KERNEL.replace(
+        "        o_ref[...] = acc_ref[...]",
+        """\
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        o_ref[...] = acc_ref[...]""")
+    assert fixed != L010_KERNEL
+    from flashinfer_tpu.analysis import kernel_init_guard
+
+    assert kernel_init_guard.run(_project(("k.py", fixed))) == []
+
+
+def test_l010_value_guards_are_not_step_guards():
+    """`pl.when(num_chunks > 0)` gates work, not steps — it must not
+    classify as excluding (the mla_decode/paged_decode idiom)."""
+    src = L010_KERNEL.replace("@pl.when(i != 0)",
+                              "@pl.when(x_ref[0] > 0)")
+    assert src != L010_KERNEL
+    from flashinfer_tpu.analysis import kernel_init_guard
+
+    assert kernel_init_guard.run(_project(("k.py", src))) == []
+
+
+def test_l010_input_output_alias_bounds():
+    src = L010_KERNEL.replace(
+        "scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],",
+        "scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],\n"
+        "            input_output_aliases={3: 2},")
+    # silence the accumulator finding: this test is about the aliases
+    src = src.replace("@pl.when(i != 0)", "@pl.when(i == 0)")
+    from flashinfer_tpu.analysis import kernel_init_guard
+
+    findings = kernel_init_guard.run(_project(("k.py", src)))
+    assert len(findings) == 2, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "key 3 is out of range" in msgs
+    assert "value 2 is out of range" in msgs
+
+
+# ------------------------------------------------- SARIF surface --
+
+
+# A faithful subset of the SARIF 2.1.0 schema (oasis-tcs/sarif-spec
+# Schemata/sarif-schema-2.1.0.json): the required/enum constraints for
+# every node the exporter emits.  Validated with jsonschema so a
+# structural regression (missing version, results without messages,
+# bad level enum) fails here rather than at GitHub upload time.
+SARIF_SCHEMA_SUBSET = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"enum": ["2.1.0"]},
+        "$schema": {"type": "string", "format": "uri"},
+        "runs": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["tool"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["message"],
+                            "properties": {
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "level": {"enum": [
+                                    "none", "note", "warning", "error"]},
+                                "ruleId": {"type": "string"},
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "uri": {
+                                                                "type":
+                                                                "string"},
+                                                        },
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum":
+                                                                1},
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+@pytest.mark.quick
+def test_sarif_output_validates_against_schema():
+    import jsonschema
+
+    from flashinfer_tpu.analysis import sarif as sarif_mod
+
+    findings = [
+        analysis.Finding("L007", "flashinfer_tpu/ops/x.py", 3, "launch",
+                         "skewed"),
+        analysis.Finding("L000", "flashinfer_tpu/y.py", 0,
+                         "<suppression>", "no reason"),
+    ]
+    doc = sarif_mod.to_sarif(findings)
+    jsonschema.validate(doc, SARIF_SCHEMA_SUBSET)
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graft-lint"
+    assert [r["ruleId"] for r in run["results"]] == ["L007", "L000"]
+    # line 0 is clamped to the schema's minimum
+    assert run["results"][1]["locations"][0]["physicalLocation"][
+        "region"]["startLine"] == 1
+    # rules cover exactly the emitted codes
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} \
+        == {"L000", "L007"}
+    # empty-findings document is also valid (the CI always-upload path)
+    jsonschema.validate(sarif_mod.to_sarif([]), SARIF_SCHEMA_SUBSET)
+
+
+def test_cli_sarif_flag_writes_new_findings(tmp_path, capsys):
+    """--sarif writes the NON-baselined findings: a clean single-file
+    run produces a valid empty SARIF, and a file with a real finding
+    lands in the document (single-file runs keep the tier-1 cost down;
+    the whole-tree CLI run is covered by
+    test_cli_clean_against_baseline_and_fails_without)."""
+    import jsonschema
+
+    out = tmp_path / "out.sarif"
+    clean = os.path.join(PKG_ROOT, "attention.py")
+    assert analysis.main([clean, "--sarif", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    jsonschema.validate(doc, SARIF_SCHEMA_SUBSET)
+    assert doc["runs"][0]["results"] == []
+    # a self-contained wedge fixture surfaces its finding in the doc
+    # (the tree's own baselined L003s are transitive — a single-file
+    # run cannot see their cross-module callees, so a fixture it is)
+    noisy = tmp_path / "wedgy.py"
+    noisy.write_text(WEDGY)
+    assert analysis.main(
+        [str(noisy), "--no-baseline", "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    jsonschema.validate(doc, SARIF_SCHEMA_SUBSET)
+    results = doc["runs"][0]["results"]
+    assert results and all(r["ruleId"] == "W003" for r in results)
+    assert all(r["locations"][0]["physicalLocation"]["artifactLocation"]
+               ["uri"] == "wedgy.py" for r in results)
+
+
+# ------------------------------------------- --changed-only mode --
+
+
+def _git(repo, *args):
+    import subprocess
+
+    r = subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=t@t",
+         "-c", "user.name=t", *args],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+WEDGY = """
+import jax.numpy as jnp
+
+
+def lane_repeat_kernel(x_ref, o_ref):
+    o_ref[...] = jnp.repeat(x_ref[...], 4, axis=-1)
+"""
+
+
+@pytest.mark.quick
+def test_changed_only_analyzes_only_the_changed_module(tmp_path, capsys):
+    """A one-file diff analyzes only that file's modules: the unchanged
+    file's finding must NOT appear, the changed file's must."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "clean_a.py").write_text("x = 1\n")
+    (repo / "clean_b.py").write_text(WEDGY)  # committed: not "changed"
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    (repo / "clean_a.py").write_text(WEDGY.replace(
+        "lane_repeat_kernel", "other_repeat_kernel"))
+    rc = analysis.main([str(repo), "--changed-only", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "other_repeat_kernel" in out   # the changed file's finding
+    assert "lane_repeat_kernel" not in out  # unchanged file not analyzed
+    assert "1 finding(s)" in out
+
+
+def test_changed_only_config_json_diff_runs_full_analysis(tmp_path,
+                                                          capsys):
+    """A tuning_configs/*.json-only diff must NOT report 'no analyzed
+    files changed' — L006/L009 lint exactly those files, so the CLI
+    falls back to full analysis."""
+    repo = tmp_path / "repo"
+    (repo / "tuning_configs").mkdir(parents=True)
+    _git(repo, "init", "-q")
+    (repo / "mod.py").write_text(WEDGY)
+    (repo / "tuning_configs" / "v5e.json").write_text("{}\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    (repo / "tuning_configs" / "v5e.json").write_text(
+        '{"tactics": {}}\n')
+    rc = analysis.main([str(repo), "--changed-only", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1  # the full run sees mod.py's wedge finding
+    assert "no analyzed files changed" not in out
+    assert "lane_repeat_kernel" in out
+
+
+def test_changed_only_clean_diff_exits_zero(tmp_path, capsys):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "mod.py").write_text(WEDGY)
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    rc = analysis.main([str(repo), "--changed-only", "--no-baseline"])
+    assert rc == 0
+    assert "no analyzed files changed" in capsys.readouterr().out
+
+
+def test_whole_tree_run_reports_deleted_file_stale_entries(tmp_path,
+                                                           capsys):
+    """A baseline entry naming a file that no longer exists must still
+    print as stale on a whole-tree run — that's the deleted/renamed
+    module case pruning exists for."""
+    import flashinfer_tpu.analysis as analysis_mod
+
+    real = json.load(open(analysis_mod.DEFAULT_BASELINE_PATH))
+    real["findings"].append({
+        "code": "L003", "path": "flashinfer_tpu/deleted_module.py",
+        "func": "gone", "count": 1, "lines_at_capture": [1]})
+    fake = tmp_path / "b.json"
+    fake.write_text(json.dumps(real))
+    rc = analysis.main([PKG_ROOT, "--baseline", str(fake)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "deleted_module.py" in out
+    assert "1 stale" in out
+
+
+def test_write_baseline_refuses_subset_runs(tmp_path, capsys):
+    """--write-baseline on a subset (one file / --changed-only) would
+    truncate the committed baseline to what the partial tree shows —
+    the CLI must refuse."""
+    one = os.path.join(PKG_ROOT, "attention.py")
+    out = tmp_path / "b.json"
+    rc = analysis.main([one, "--write-baseline",
+                        "--baseline", str(out)])
+    assert rc == 2
+    assert not out.exists()
+    assert "whole-tree" in capsys.readouterr().err
+
+
+def test_subset_run_does_not_report_foreign_stale_entries(capsys):
+    """Analyzing one file against the full baseline must not claim
+    every other file's baselined findings are stale."""
+    one = os.path.join(PKG_ROOT, "attention.py")
+    rc = analysis.main([one])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "stale baseline entry (no longer fires" not in out
+    assert "0 stale" in out
+
+
+# ------------------------- satellite: per-run soft-cap rebind --
+
+
+def _plan_batch_attention(w, soft_cap):
+    import numpy as np
+
+    qo = np.array([0, 2, 4], np.int32)
+    kvp = np.array([0, 2, 4], np.int32)
+    kvi = np.arange(4, dtype=np.int32)
+    kvl = np.array([8, 8], np.int32)
+    w.plan(qo, kvp, kvi, kvl, 4, 2, 64, 64, 4, causal=True,
+           logits_soft_cap=soft_cap)
+
+
+def _soft_cap_inputs():
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (4, 4, 64), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (4, 4, 2, 64),
+                           jnp.bfloat16)
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (4, 4, 2, 64),
+                           jnp.bfloat16)
+    return q, (kc, vc)
+
+
+def test_batch_attention_run_honors_differing_soft_cap():
+    """ADVICE r5 item 3 (resolved): a per-run logits_soft_cap differing
+    from the planned one takes effect for that call — the verbatim
+    reference call shape — instead of raising; the plan's own cap is
+    restored afterwards."""
+    import numpy as np
+
+    import flashinfer_tpu as fi
+
+    q, kv = _soft_cap_inputs()
+    w = fi.BatchAttention(kv_layout="NHD")
+    _plan_batch_attention(w, 30.0)
+    out_30, _ = w.run(q, kv)
+    out_50_rebound, _ = w.run(q, kv, logits_soft_cap=50.0)
+    # plan restored after the rebound call
+    assert w._plan.logits_soft_cap == 30.0
+    out_30_again, _ = w.run(q, kv)
+    np.testing.assert_array_equal(np.asarray(out_30),
+                                  np.asarray(out_30_again))
+
+    # ground truth: a wrapper PLANNED at 50 produces the rebound output
+    w50 = fi.BatchAttention(kv_layout="NHD")
+    _plan_batch_attention(w50, 50.0)
+    out_50_planned, _ = w50.run(q, kv)
+    np.testing.assert_array_equal(np.asarray(out_50_rebound),
+                                  np.asarray(out_50_planned))
+    # and the capped outputs genuinely differ from the 30-cap ones
+    assert not np.array_equal(np.asarray(out_30),
+                              np.asarray(out_50_rebound))
+
+
+def test_batch_attention_soft_cap_rebind_counted(monkeypatch):
+    import flashinfer_tpu as fi
+    from flashinfer_tpu import obs
+
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    obs.reset()
+    try:
+        q, kv = _soft_cap_inputs()
+        w = fi.BatchAttention(kv_layout="NHD")
+        _plan_batch_attention(w, 30.0)
+        w.run(q, kv, logits_soft_cap=50.0)   # differing: rebinds
+        w.run(q, kv, logits_soft_cap=30.0)   # matching: no rebind
+        w.run(q, kv)                         # default: inherits, none
+        snap = obs.snapshot()
+        assert snap["counters"]["plan.soft_cap_rebinds"][
+            "{wrapper=BatchAttention}"] == 1
+    finally:
+        obs.reset()
+
+
+# --------------------------------- satellite: wedge_lint shim --
+
+
+def test_wedge_lint_import_warns_deprecation():
+    import importlib
+    import sys as _sys
+    import warnings
+
+    _sys.modules.pop("flashinfer_tpu.wedge_lint", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        importlib.import_module("flashinfer_tpu.wedge_lint")
+    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)
+           and "flashinfer_tpu.analysis" in str(w.message)]
+    assert dep, [str(w.message) for w in caught]
+
+
+def test_compile_guard_does_not_import_the_deprecated_shim():
+    """The runtime path goes straight to analysis.wedge — importing
+    compile_guard and running its lint hook must not pull wedge_lint
+    in (no DeprecationWarning for normal kernel launches)."""
+    import ast as _ast
+    import inspect as _inspect
+
+    from flashinfer_tpu import compile_guard
+
+    src = _inspect.getsource(compile_guard)
+    assert "from flashinfer_tpu.analysis import wedge" in src
+    for node in _ast.walk(_ast.parse(src)):
+        if isinstance(node, _ast.ImportFrom):
+            assert not any(a.name == "wedge_lint" for a in node.names), \
+                "compile_guard must not import the wedge_lint shim"
+
+
+# -------------------------------------- driver: all ten passes --
+
+
+def test_driver_runs_all_ten_passes():
+    """Clean-tree pin for the grown driver: L001–L010 all registered,
+    and the four new passes return NOTHING on the shipped tree (no
+    baseline absorption)."""
+    from flashinfer_tpu.analysis import (kernel_init_guard,
+                                         pallas_contract, tracer_leak,
+                                         vmem_budget)
+
+    assert pallas_contract in analysis.PASSES
+    assert tracer_leak in analysis.PASSES
+    assert vmem_budget in analysis.PASSES
+    assert kernel_init_guard in analysis.PASSES
+    assert len(analysis.PASSES) == 10

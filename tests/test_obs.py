@@ -464,9 +464,15 @@ def test_obs_doctor_cli():
     )
     assert p.returncode == 0, p.stderr[-2000:]
     report = json.loads(p.stdout)
-    assert {"env", "flags", "quarantine", "registry"} <= set(report)
+    assert {"env", "flags", "quarantine", "registry", "lint"} \
+        <= set(report)
     assert report["env"].get("flashinfer_tpu")
     assert "FLASHINFER_TPU_METRICS" in report["flags"]
+    # lint hygiene: reasonless suppressions are L000/W000 — the tree
+    # cannot pass the analyzer with a non-zero count, so doctor must
+    # report zero here (and a total, so drift is visible)
+    assert report["lint"]["reasonless_suppressions"] == 0
+    assert report["lint"]["suppressions"] >= 1
 
 
 @pytest.mark.slow
