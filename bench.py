@@ -26,6 +26,14 @@ equivalent).  ``vs_baseline`` = fraction of this chip's HBM peak (v5e ~0.82
 TB/s), i.e. roofline efficiency — the reference publishes no absolute
 numbers (BASELINE.md), so roofline fraction is the comparable.
 
+Roofline attribution: every row is stamped by the shared cost model
+(``obs.costmodel`` formulas x ``obs.hwspec`` chip ceilings via
+``obs.roofline.stamp_row``), so each carries ``{flops, bytes_read,
+bytes_written, intensity, bound, pct_roofline,
+effective_pct_roofline, chip, dtype}`` uniformly — no phase computes
+FLOP/byte/peak arithmetic inline, and ``python -m flashinfer_tpu.obs
+perf`` reproduces every efficiency fraction from the banked rows.
+
 ``--bank`` appends the full run record (configs + timestamps + rows) to
 ``BENCH_BANKED.md`` so numbers survive a later wedge.
 """
@@ -37,15 +45,6 @@ import os
 import subprocess
 import sys
 import time
-
-HBM_PEAK_TBPS = {
-    "v5e": 0.819,
-    "v5": 0.819,  # v5 lite
-    "v5p": 2.765,
-    "v4": 1.228,
-    "v6e": 1.64,
-}
-DEFAULT_PEAK = 0.819
 
 PROBE_TIMEOUT_S = 330.0
 PHASE_TIMEOUT_S = {
@@ -69,14 +68,13 @@ PHASE_TIMEOUT_S = {
 }
 
 
-def chip_peak_tbps() -> float:
-    import jax
+def _stamp(row, cost, seconds):
+    """Stamp the canonical roofline fields onto a row via the shared
+    model (obs.roofline x obs.hwspec detection) — THE only path from a
+    measurement to an efficiency fraction in this file."""
+    from flashinfer_tpu.obs import hwspec, roofline
 
-    kind = jax.devices()[0].device_kind.lower()
-    for key, val in sorted(HBM_PEAK_TBPS.items(), key=lambda kv: -len(kv[0])):
-        if key in kind.replace(" ", ""):
-            return val
-    return DEFAULT_PEAK
+    return roofline.stamp_row(row, cost, seconds, hwspec.current_spec())
 
 
 _AUDITOR = None
@@ -143,9 +141,10 @@ def phase_decode(sweep: bool):
     import numpy as np
 
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import attention_bytes, bench_fn_device
+    from flashinfer_tpu.obs import costmodel, hwspec
+    from flashinfer_tpu.testing import bench_fn_device
 
-    peak = chip_peak_tbps()
+    peak = hwspec.current_spec().hbm_tbps
 
     def bench_one(batch, ctx, page_size=16, num_qo_heads=32, num_kv_heads=8,
                   head_dim=128, dtype=jnp.bfloat16):
@@ -183,10 +182,9 @@ def phase_decode(sweep: bool):
                 lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc, repeats=5
             ),
         )
-        total_bytes = batch * attention_bytes(
-            1, ctx, num_qo_heads, num_kv_heads, head_dim, head_dim, 2
-        )
-        return t, total_bytes / t / 1e12, batch / t
+        cost = costmodel.paged_decode(batch, ctx, num_qo_heads,
+                                      num_kv_heads, head_dim)
+        return t, cost.bytes_total / t / 1e12, batch / t, cost
 
     grid = ([(1, 512), (1, 2048), (1, 4096), (1, 8192),
              (16, 512), (16, 2048), (16, 4096), (16, 8192),
@@ -198,14 +196,14 @@ def phase_decode(sweep: bool):
     grid.sort(key=lambda bc: bc != (64, 4096))
     best_tbps = 0.0
     for bs, ctx in grid:
-        t, tbps, tps = bench_one(bs, ctx)
+        t, tbps, tps, cost = bench_one(bs, ctx)
         if (bs, ctx) == (64, 4096):
             # headline cell: the tunnel's run-to-run spread is ~4%
             # (BENCH_BANKED 0.718-0.745 TB/s across three runs); a second
             # independent measurement minutes apart costs ~1 min and the
             # min-time (max-bandwidth) of the two rejects a degraded
             # window poisoning the deliverable number
-            t2, tbps2, tps2 = bench_one(bs, ctx)
+            t2, tbps2, tps2, _ = bench_one(bs, ctx)
             if t2 < t:
                 t, tbps, tps = t2, tbps2, tps2
         elif bs >= 16 and best_tbps > 0 and tbps < 0.35 * best_tbps:
@@ -220,12 +218,14 @@ def phase_decode(sweep: bool):
                   f"implausible vs best {best_tbps:.4f}; re-measuring",
                   file=sys.stderr)
             time.sleep(20)
-            t2, tbps2, tps2 = bench_one(bs, ctx)
+            t2, tbps2, tps2, _ = bench_one(bs, ctx)
             if t2 < t:
                 t, tbps, tps = t2, tbps2, tps2
         best_tbps = max(best_tbps, tbps)
-        _emit_row(phase="decode", bs=bs, ctx=ctx, us=round(t * 1e6, 1),
-                  tbps=round(tbps, 4), tok_s=round(tps, 0), peak=peak)
+        _emit_row(**_stamp(
+            dict(phase="decode", bs=bs, ctx=ctx, us=round(t * 1e6, 1),
+                 tbps=round(tbps, 4), tok_s=round(tps, 0), peak=peak),
+            cost, t))
         print(f"# decode bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
               f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s", file=sys.stderr)
 
@@ -239,7 +239,8 @@ def phase_prefill(sweep: bool):
     import jax.numpy as jnp
 
     import flashinfer_tpu as fi
-    from flashinfer_tpu.testing import attention_flops, bench_fn_device
+    from flashinfer_tpu.obs import costmodel
+    from flashinfer_tpu.testing import bench_fn_device
 
     if os.environ.get("BENCH_SMALL"):
         HQ, HKV, D, PS = 4, 2, 64, 8
@@ -276,21 +277,30 @@ def phase_prefill(sweep: bool):
         )
         if t is None:
             continue
-        flops = bs * attention_flops(qlen, ctx, HQ, D, D, causal=True)
         # block-config metadata: which pipelined-kernel launch shape this
         # number belongs to (None fields = gather+flash fallback ran) —
         # the row is meaningless for tuning without it
         # (benchmarks/bench_prefill_blocks.py sweeps these knobs)
         cfg = w.fused_prefill_config or {}
-        _emit_row(phase="prefill", kind="paged_chunked", bs=bs, qlen=qlen,
-                  ctx=ctx, block_q=cfg.get("block_q"),
-                  pages_per_chunk=cfg.get("pages_per_chunk"),
-                  num_units=cfg.get("num_units"),
-                  us=round(t * 1e6, 1),
-                  tflops=round(flops / t / 1e12, 2))
+        # launched work from the live plan's post-pruning/post-packing
+        # stats (effective work = attended tokens); banked `tflops`
+        # stays the EFFECTIVE number — comparable across block configs
+        cost = costmodel.paged_prefill(
+            bs, qlen, ctx, HQ, HKV, D, causal=True,
+            stats=w.fused_prefill_stats, block_q=cfg.get("block_q"),
+            pages_per_chunk=cfg.get("pages_per_chunk"), page_size=PS)
+        _emit_row(**_stamp(
+            dict(phase="prefill", kind="paged_chunked", bs=bs, qlen=qlen,
+                 ctx=ctx, block_q=cfg.get("block_q"),
+                 pages_per_chunk=cfg.get("pages_per_chunk"),
+                 num_units=cfg.get("num_units"),
+                 us=round(t * 1e6, 1),
+                 tflops=round(cost.effective_flops / t / 1e12, 2)),
+            cost, t))
         print(f"# prefill paged bs={bs} qlen={qlen} ctx={ctx} "
               f"bq={cfg.get('block_q')} ppc={cfg.get('pages_per_chunk')}: "
-              f"{t*1e6:9.1f} us  {flops/t/1e12:6.2f} TFLOP/s",
+              f"{t*1e6:9.1f} us  "
+              f"{cost.effective_flops/t/1e12:6.2f} TFLOP/s",
               file=sys.stderr)
 
     for T in ragged_ts:
@@ -310,7 +320,7 @@ def phase_prefill(sweep: bool):
         )
         if t is None:
             continue
-        flops = attention_flops(T, T, HQ, D, D, causal=True)
+        cost = costmodel.attention(T, T, HQ, HKV, D, causal=True)
         # block-config metadata: the (block_q, block_kv) _tuned_flash
         # resolves for this shape (THE shared key builder — a hand-copied
         # tuple here would silently desync and bank wrong metadata)
@@ -323,11 +333,14 @@ def phase_prefill(sweep: bool):
         fbq, fbkv = AutoTuner.get().lookup(
             "flash_attention.blocks", fkey,
             default=_FLASH_BLOCK_CANDIDATES[0])
-        _emit_row(phase="prefill", kind="ragged_flash", qlen=T,
-                  block_q=int(fbq), block_kv=int(fbkv),
-                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        _emit_row(**_stamp(
+            dict(phase="prefill", kind="ragged_flash", qlen=T,
+                 block_q=int(fbq), block_kv=int(fbkv),
+                 us=round(t * 1e6, 1),
+                 tflops=round(cost.flops / t / 1e12, 2)),
+            cost, t))
         print(f"# prefill ragged T={T}: {t*1e6:9.1f} us  "
-              f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
+              f"{cost.flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
 
 
 def phase_mla(sweep: bool):
@@ -338,10 +351,11 @@ def phase_mla(sweep: bool):
     import jax
     import jax.numpy as jnp
 
+    from flashinfer_tpu.obs import costmodel, hwspec
     from flashinfer_tpu.ops.mla_decode import mla_paged_decode_attention
     from flashinfer_tpu.testing import bench_fn_device
 
-    peak = chip_peak_tbps()
+    peak = hwspec.current_spec().hbm_tbps
     if os.environ.get("BENCH_SMALL"):
         H, DC, DP, PS = 8, 128, 64, 8
         cfgs = [(2, 256)]
@@ -384,13 +398,18 @@ def phase_mla(sweep: bool):
             )
             if t is None:
                 continue
-            # decode-bound bytes: latent + rope caches once per request
-            bytes_ = bs * ctx * (DC + 128) * 2.0
-            _emit_row(phase="mla", bs=bs, ctx=ctx, heads=H, layout=layout,
-                      us=round(t * 1e6, 1),
-                      tbps=round(bytes_ / t / 1e12, 4), peak=peak)
+            # decode-bound: latent + lane-padded rope caches stream once
+            # per request (the dominant term; q/out ride along)
+            cost = costmodel.mla_decode(bs, ctx, H, latent_dim=DC,
+                                        rope_dim=DP)
+            tbps = cost.bytes_total / t / 1e12
+            _emit_row(**_stamp(
+                dict(phase="mla", bs=bs, ctx=ctx, heads=H, layout=layout,
+                     us=round(t * 1e6, 1), tbps=round(tbps, 4),
+                     peak=peak),
+                cost, t))
             print(f"# mla {layout:6s} bs={bs} ctx={ctx}: {t*1e6:9.1f} us  "
-                  f"{bytes_/t/1e12:6.3f} TB/s", file=sys.stderr)
+                  f"{tbps:6.3f} TB/s", file=sys.stderr)
 
 
 def phase_sampling(sweep: bool):
@@ -427,12 +446,18 @@ def phase_sampling(sweep: bool):
         vocab, sizes = 1024, (8,)       # at 128k vocab takes minutes/row
     else:
         vocab, sizes = 128 * 1024, ((64, 1, 16) if sweep else (64,))
+    from flashinfer_tpu.obs import costmodel
+
     for bs in sizes:
         tk = bench_one(bs, vocab, "pallas") * 1e6
         tx = bench_one(bs, vocab, "xla") * 1e6
-        _emit_row(phase="sampling", bs=bs, vocab=vocab,
-                  kernel_us=round(tk, 1), xla_us=round(tx, 1),
-                  speedup=round(tx / tk, 2))
+        # kernel_us is the row's primary time: the stamp attributes the
+        # kernel path (one f32 pass over [bs, vocab] probs)
+        _emit_row(**_stamp(
+            dict(phase="sampling", bs=bs, vocab=vocab,
+                 kernel_us=round(tk, 1), xla_us=round(tx, 1),
+                 speedup=round(tx / tk, 2)),
+            costmodel.sampling(bs, vocab), tk * 1e-6))
         print(f"# sampling vocab={vocab} bs={bs:3d}: kernel {tk:8.1f} us  "
               f"xla-sort {tx:8.1f} us  ({tx / tk:4.1f}x)", file=sys.stderr)
 
@@ -446,6 +471,7 @@ def phase_moe(sweep: bool):
     import jax.numpy as jnp
 
     from flashinfer_tpu import fused_moe as moe_pkg
+    from flashinfer_tpu.obs import costmodel
     from flashinfer_tpu.quantization import quantize_int8
     from flashinfer_tpu.testing import bench_fn_device
 
@@ -468,7 +494,6 @@ def phase_moe(sweep: bool):
         logits = jax.random.normal(jax.random.fold_in(key, 3), (T, E),
                                    jnp.float32)
         wts, ids = moe_pkg.route_renormalize(logits, K)
-        flops = 2 * T * K * (H * 2 * I + I * H)  # madd=2 flops, both GEMMs
         # weights ride as operands — bench_fn_device forbids closing over
         # large arrays (they'd embed as HLO constants)
         def bf16_fn(backend, gv="auto"):
@@ -502,11 +527,17 @@ def phase_moe(sweep: bool):
             )
             if t is None:
                 continue
-            _emit_row(phase="moe", variant=name, tokens=T,
-                      us=round(t * 1e6, 1),
-                      tflops=round(flops / t / 1e12, 2))
+            int8 = name.endswith("int8")
+            cost = costmodel.moe_gmm(T, E, H, I, K,
+                                     weight_bytes=1 if int8 else 2,
+                                     dtype="int8" if int8 else "bf16")
+            _emit_row(**_stamp(
+                dict(phase="moe", variant=name, tokens=T,
+                     us=round(t * 1e6, 1),
+                     tflops=round(cost.flops / t / 1e12, 2)),
+                cost, t))
             print(f"# moe {name:12s} T={T:5d}: {t*1e6:9.1f} us  "
-                  f"{flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
+                  f"{cost.flops/t/1e12:6.2f} TFLOP/s", file=sys.stderr)
 
 
 def phase_scans(sweep: bool):
@@ -519,6 +550,7 @@ def phase_scans(sweep: bool):
 
     from flashinfer_tpu import gdn as gdn_mod
     from flashinfer_tpu import mamba as mamba_mod
+    from flashinfer_tpu.obs import costmodel
     from flashinfer_tpu.testing import bench_fn_device
 
     if os.environ.get("BENCH_SMALL"):
@@ -554,12 +586,14 @@ def phase_scans(sweep: bool):
         )
         if t is None:
             continue
-        # SSD flops: scores [Q,Q] via C.B (ds) + out [Q,dim] per chunk
+        # SSD cost: scores [Q,Q] via C.B (ds) + out [Q,dim] per chunk
         # (per-variant chunk: the pallas kernel runs 128-token chunks)
-        flops = (2 * B * L * mchunk * H * (ds + dim)
-                 + 2 * B * L * H * dim * ds)
-        _emit_row(phase="scans", op=mname, B=B, L=L,
-                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        cost = costmodel.ssd_prefill(B, L, H, dim, ds, chunk=mchunk)
+        _emit_row(**_stamp(
+            dict(phase="scans", op=mname, B=B, L=L,
+                 us=round(t * 1e6, 1),
+                 tflops=round(cost.flops / t / 1e12, 2)),
+            cost, t))
         print(f"# scans {mname}: {t*1e6:9.1f} us", file=sys.stderr)
 
     # --- mamba decode step (bandwidth-bound: state RMW) ---
@@ -571,10 +605,10 @@ def phase_scans(sweep: bool):
     Bd = jax.random.normal(jax.random.fold_in(key, 8), (B, G, ds))
     Cd = jax.random.normal(jax.random.fold_in(key, 9), (B, G, ds))
     # decode steps are state-bandwidth-bound (the [.., dk, dv] f32 state
-    # is read+written once per token); pct_roofline against the HBM spec
-    # is the go/no-go signal for a Pallas decode kernel (VERDICT r3 #8):
-    # XLA already streaming near roofline = no kernel justified
-    hbm_gbps = chip_peak_tbps() * 1000.0  # per-generation HBM spec
+    # is read+written once per token); pct_roofline (stamped by the
+    # shared model, 0..1 fraction) is the go/no-go signal for a Pallas
+    # decode kernel (VERDICT r3 #8): XLA already streaming near roofline
+    # = no kernel justified
     # bench the WHOLE (y, new_state) tuple — selecting [1] would let XLA
     # dead-code-eliminate the output projection (y depends on the state,
     # never vice versa) and under-report every decode step
@@ -586,12 +620,12 @@ def phase_scans(sweep: bool):
         ),
     )
     if t is not None:
-        state_bytes = 2 * B * H * dim * ds * 4  # read + write f32 state
-        _emit_row(phase="scans", op="mamba_decode", B=B,
-                  us=round(t * 1e6, 1),
-                  gbps=round(state_bytes / t / 1e9, 1),
-                  pct_roofline=round(
-                      state_bytes / t / 1e9 / hbm_gbps * 100, 1))
+        cost = costmodel.state_decode(B, H, dim, ds)
+        _emit_row(**_stamp(
+            dict(phase="scans", op="mamba_decode", B=B,
+                 us=round(t * 1e6, 1),
+                 gbps=round(cost.bytes_total / t / 1e9, 1)),
+            cost, t))
         print(f"# scans mamba_decode:  {t*1e6:9.1f} us", file=sys.stderr)
 
     # --- GDN / KDA decode steps (same roofline protocol) ---
@@ -605,7 +639,6 @@ def phase_scans(sweep: bool):
         jax.random.fold_in(key, 24), (B, Hg)))
     ak_d = jnp.exp(-0.05 * jax.random.uniform(
         jax.random.fold_in(key, 25), (B, Hg, dk)))
-    gstate_bytes = 2 * B * Hg * dk * dv * 4
     for dname, dfn, da in (
         ("gdn_decode", gdn_mod.gdn_decode_step, ag_d),
         ("kda_decode", gdn_mod.kda_decode_step, ak_d),
@@ -617,11 +650,11 @@ def phase_scans(sweep: bool):
         )
         if t is None:
             continue
-        _emit_row(
-            phase="scans", op=dname, B=B, us=round(t * 1e6, 1),
-            gbps=round(gstate_bytes / t / 1e9, 1),
-            pct_roofline=round(gstate_bytes / t / 1e9 / hbm_gbps * 100, 1),
-        )
+        cost = costmodel.state_decode(B, Hg, dk, dv)
+        _emit_row(**_stamp(
+            dict(phase="scans", op=dname, B=B, us=round(t * 1e6, 1),
+                 gbps=round(cost.bytes_total / t / 1e9, 1)),
+            cost, t))
         print(f"# scans {dname}:  {t*1e6:9.1f} us", file=sys.stderr)
 
     # --- GDN / KDA chunked prefill ---
@@ -670,9 +703,12 @@ def phase_scans(sweep: bool):
         )
         if t is None:
             continue
-        flops = 2 * B * L * Hg * (dk * dv * 2)  # state in/out matmuls
-        _emit_row(phase="scans", op=name, B=B, L=L,
-                  us=round(t * 1e6, 1), tflops=round(flops / t / 1e12, 2))
+        cost = costmodel.gated_delta_prefill(B, L, Hg, dk, dv)
+        _emit_row(**_stamp(
+            dict(phase="scans", op=name, B=B, L=L,
+                 us=round(t * 1e6, 1),
+                 tflops=round(cost.flops / t / 1e12, 2)),
+            cost, t))
         print(f"# scans {name}: {t*1e6:9.1f} us", file=sys.stderr)
 
 
@@ -683,6 +719,7 @@ def phase_topk(sweep: bool):
     import jax.numpy as jnp
 
     from flashinfer_tpu import topk as topk_mod
+    from flashinfer_tpu.obs import costmodel
     from flashinfer_tpu.testing import bench_fn_device
 
     if os.environ.get("BENCH_SMALL"):
@@ -699,8 +736,10 @@ def phase_topk(sweep: bool):
                 f"bench.topk.{backend}", (bs, vocab, k),
                 lambda: bench_fn_device(fn, scores, repeats=5),
             )
-            _emit_row(phase="topk", backend=backend, bs=bs, vocab=vocab,
-                      k=k, us=round(t * 1e6, 1))
+            _emit_row(**_stamp(
+                dict(phase="topk", backend=backend, bs=bs, vocab=vocab,
+                     k=k, us=round(t * 1e6, 1)),
+                costmodel.topk(bs, vocab, k), t))
             print(f"# topk {backend:10s} k={k:5d}: {t*1e6:9.1f} us",
                   file=sys.stderr)
 
@@ -870,17 +909,29 @@ def phase_serving(sweep: bool):
     fixed = max(times[l1] - l1 * per_layer, 0.0)
     t_full = fixed + full_layers * per_layer
     toks = bs / t_full
+    # per-phase cost shapes of THIS run's pipeline (BENCH_SMALL shrinks
+    # them, so the model must come from the locals, not SERVING_SHAPES)
+    from flashinfer_tpu.obs import costmodel
+
+    serve_shape = dict(hidden=hidden, hq=hq, hkv=hkv, hd=hd, inter=inter,
+                       vocab_shard=vocab_shard, page_size=PS,
+                       weight_bytes=1, kv_bytes=1)
     # VERDICT r3 weak #6: the 80-layer number is a slope-fit projection from
     # two measured depths on one chip — carry that in the JSON itself so a
     # reader of BENCH_r{N}.json cannot quote it as a measured number.
-    _emit_row(phase="serving", model="llama70b_tp8shard_int8", bs=bs,
-              ctx=ctx, layers_measured=list(depths),
-              us_per_layer=round(per_layer * 1e6, 1),
-              us_step_80l=round(t_full * 1e6, 1),
-              tok_s_per_chip=round(toks, 1),
-              linearity=round(times[l2] / times[l1], 3),
-              extrapolated=True,
-              excluded=["ici_allreduce", "kv_append", "sampling"])
+    _emit_row(**_stamp(
+        dict(phase="serving", model="llama70b_tp8shard_int8", bs=bs,
+             ctx=ctx, layers_measured=list(depths),
+             us_per_layer=round(per_layer * 1e6, 1),
+             us_step_80l=round(t_full * 1e6, 1),
+             tok_s_per_chip=round(toks, 1),
+             linearity=round(times[l2] / times[l1], 3),
+             extrapolated=True,
+             excluded=["ici_allreduce", "kv_append", "sampling"]),
+        costmodel.serving_step(bs, ctx, full_layers,
+                               include_kv_append=False,
+                               include_sampling=False, **serve_shape),
+        t_full))
     print(f"# serving 70B extrapolated: {t_full*1e3:.2f} ms/step, "
           f"{toks:.0f} tok/s/chip", file=sys.stderr)
 
@@ -1053,15 +1104,17 @@ def phase_serving(sweep: bool):
         obs.observe("serving.phase_us", max(decomp["residual_us"], 0.0),
                     phase="residual")
 
-    _emit_row(phase="serving", model="llama70b_tp8shard_int8",
-              mode="e2e_measured", bs=bs, ctx=ctx,
-              layers=L, us_step=round(t_e2e * 1e6, 1),
-              tok_s_at_depth=round(bs / t_e2e, 1),
-              slope_pred_us=round(pred * 1e6, 1),
-              overhead_vs_slope=round(t_e2e / max(pred, 1e-9), 3),
-              overhead_decomposition=decomp,
-              extrapolated=False,
-              includes=["kv_append", "sampling"])
+    _emit_row(**_stamp(
+        dict(phase="serving", model="llama70b_tp8shard_int8",
+             mode="e2e_measured", bs=bs, ctx=ctx,
+             layers=L, us_step=round(t_e2e * 1e6, 1),
+             tok_s_at_depth=round(bs / t_e2e, 1),
+             slope_pred_us=round(pred * 1e6, 1),
+             overhead_vs_slope=round(t_e2e / max(pred, 1e-9), 3),
+             overhead_decomposition=decomp,
+             extrapolated=False,
+             includes=["kv_append", "sampling"]),
+        costmodel.serving_step(bs, ctx, L, **serve_shape), t_e2e))
     print(f"# serving e2e L={L}: {t_e2e*1e6:.1f} us/step measured "
           f"(slope model predicts {pred*1e6:.1f} us without append+sampling)",
           file=sys.stderr)
@@ -1191,7 +1244,10 @@ def orchestrate(sweep: bool, bank: bool, phases=None, no_probe=False) -> int:
          if r.get("phase") == "decode" and (r["bs"], r["ctx"]) == (64, 4096)),
         None,
     )
-    peak = (headline or {}).get("peak", DEFAULT_PEAK)
+    from flashinfer_tpu.obs import hwspec
+
+    peak = (headline or {}).get(
+        "peak", hwspec.CHIP_SPECS[hwspec.DEFAULT_CHIP].hbm_tbps)
     tbps = (headline or {}).get("tbps", 0.0)
     result = {
         "metric": "batch_decode_attention_bandwidth_bs64_ctx4k",

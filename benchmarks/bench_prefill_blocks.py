@@ -6,7 +6,11 @@ The on-chip autotune surface for ISSUE 3's tentpole (c): sweeps the
 emits ``ROW {json}`` lines carrying the full block-config metadata, and
 quality-stamps every row through ``obs.bench_audit.RowAuditor`` against
 the BENCH_BANKED.md history (the same <0.35x implausibility rule as
-bench.py).
+bench.py).  Rows are roofline-stamped by the shared cost model from
+each candidate's own plan stats, so ``tflops`` (and the ranking) count
+*effective* work — a candidate can't win by padding — while
+``pct_roofline`` vs ``effective_pct_roofline`` shows the waste
+(docs/observability.md §"Roofline attribution").
 
 Usage::
 
@@ -89,8 +93,11 @@ def sweep(smoke: bool, repeats: int):
     from flashinfer_tpu.ops.paged_prefill import (
         build_prefill_work_units, fused_paged_prefill,
     )
-    from flashinfer_tpu.testing import attention_flops, bench_fn_device
+    from flashinfer_tpu.obs import costmodel, hwspec, roofline
+    from flashinfer_tpu.testing import bench_fn_device
     from flashinfer_tpu import compile_guard
+
+    chip = hwspec.current_spec()
 
     winners = {}
     for bs, qlen, ctx, HQ, HKV, D, PS in shape_grid(smoke):
@@ -107,7 +114,6 @@ def sweep(smoke: bool, repeats: int):
         kv_page_indptr = np.arange(bs + 1, dtype=np.int32) * ppr
         kv_page_indices = rng.permutation(npages).astype(np.int32)
         kv_lens = np.full((bs,), ctx, np.int64)
-        flops = bs * attention_flops(qlen, ctx, HQ, D, D, causal=True)
         fused_key = "_".join(map(str, (
             bs, max(1 << (bs * qlen - 1).bit_length(), 128), HQ, HKV, D, PS,
         )))
@@ -141,14 +147,22 @@ def sweep(smoke: bool, repeats: int):
                 print(f"# blocks ({bq},{ppc}) FAILED "
                       f"{type(e).__name__}: {first}", file=sys.stderr)
                 continue
-            tflops = flops / t / 1e12
-            row = _emit_row(
-                phase="prefill_blocks", bs=bs, qlen=qlen, ctx=ctx,
-                block_q=bq, pages_per_chunk=ppc,
-                num_units=statics["num_units"],
-                units_pruned=stats["units_pruned"],
-                us=round(t * 1e6, 1), tflops=round(tflops, 2),
-            )
+            # shared cost model: launched work from THIS candidate's
+            # plan stats, effective = attended tokens — `tflops` stays
+            # the effective number so candidates with different padding
+            # waste compare on useful work (and the stamped
+            # effective_pct_roofline ranks them the same way)
+            cost = costmodel.paged_prefill(
+                bs, qlen, ctx, HQ, HKV, D, causal=True, stats=stats,
+                block_q=bq, pages_per_chunk=ppc, page_size=PS)
+            tflops = cost.effective_flops / t / 1e12
+            row = _emit_row(**roofline.stamp_row(
+                dict(phase="prefill_blocks", bs=bs, qlen=qlen, ctx=ctx,
+                     block_q=bq, pages_per_chunk=ppc,
+                     num_units=statics["num_units"],
+                     units_pruned=stats["units_pruned"],
+                     us=round(t * 1e6, 1), tflops=round(tflops, 2)),
+                cost, t, chip))
             print(f"# blocks bs={bs} qlen={qlen} ctx={ctx} "
                   f"bq={bq:3d} ppc={ppc:2d}: {t*1e6:9.1f} us  "
                   f"{tflops:6.2f} TFLOP/s  [{row.get('quality', '?')}]",

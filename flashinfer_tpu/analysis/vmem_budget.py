@@ -38,21 +38,15 @@ from flashinfer_tpu.analysis.core import (Finding, FunctionInfo,
                                           expr_basename)
 from flashinfer_tpu.analysis.tuning_schema import (_config_paths,
                                                    _key_line, _tables)
+from flashinfer_tpu.obs.hwspec import VMEM_CAPS
 
 CODE = "L009"
 
 # Per-generation VMEM ceilings (bytes) used when a launch declares no
-# vmem_limit_bytes.  Provenance: v5e 64 MiB is on-chip-validated by
-# this repo's own kernels (they request vmem_limit_bytes=64 MiB and
-# compile — HW_TIER_LOG); v5p carries 2x v5e per tuning_configs/
-# v5p.json; v4/v6e conservatively inherit the v5e bound.  These are
-# compile-budget ceilings, not datasheet capacities.
-VMEM_CAPS: Dict[str, int] = {
-    "v4": 64 * 1024 * 1024,
-    "v5e": 64 * 1024 * 1024,
-    "v5p": 128 * 1024 * 1024,
-    "v6e": 64 * 1024 * 1024,
-}
+# vmem_limit_bytes: imported from the chip-spec registry above
+# (obs/hwspec.py is plain data with no env/backend reads at import, so
+# this lint path stays accelerator-free).  Provenance lives with the
+# specs — compile budgets, not datasheet capacities.
 _DEFAULT_CAP = 128 * 1024 * 1024
 
 _DTYPE_SIZES = {

@@ -13,8 +13,17 @@ all of them together).  Components:
 - :mod:`~flashinfer_tpu.obs.export` — JSON snapshot, Prometheus text
   format, and chrome-trace merge of the op timeline;
 - :mod:`~flashinfer_tpu.obs.bench_audit` — the self-auditing bench
-  telemetry (row quality stamps vs BENCH_BANKED.md history);
-- ``python -m flashinfer_tpu.obs`` — ``report`` / ``doctor`` CLI.
+  telemetry (row quality stamps vs BENCH_BANKED.md history, raw +
+  roofline-fraction spaces);
+- :mod:`~flashinfer_tpu.obs.hwspec` — the chip-spec registry (peak
+  HBM/MXU/VMEM/ICI per generation; the single source of truth);
+- :mod:`~flashinfer_tpu.obs.costmodel` — analytic FLOPs/bytes per op
+  family (NOT imported here: the zero-overhead test pins that plain
+  library use never loads it);
+- :mod:`~flashinfer_tpu.obs.roofline` — cost x wall time x spec ->
+  ``pct_roofline`` attribution + the ``obs perf`` report builder;
+- ``python -m flashinfer_tpu.obs`` — ``report`` / ``doctor`` /
+  ``perf`` CLI.
 
 Call-site contract: the module-level helpers below apply the metrics
 gate themselves, so instrumentation reads as one line

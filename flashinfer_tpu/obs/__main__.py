@@ -12,8 +12,17 @@ Commands:
   from a REPL / atexit hook).
 - ``doctor``: device/env/backend health — collect_env, the
   FLASHINFER_TPU_* flag matrix, backend resolution, compile-guard
-  quarantine state, tuner cache, registry liveness, and lint hygiene
-  (the reasonless-suppression count the analyzer would fail on).
+  quarantine state, tuner cache, registry liveness, lint hygiene
+  (the reasonless-suppression count the analyzer would fail on), and
+  cost-model coverage (``@flashinfer_api`` ops with no roofline
+  attribution formula).
+- ``perf``: the roofline doctor — attribute banked bench rows
+  (``--banked BENCH_BANKED.md``) through obs.costmodel/obs.roofline
+  and print the per-op efficiency table, bound classification, worst
+  offenders, padding-waste and per-serving-phase MFU report that the
+  round-5 VERDICT computed by hand.  ``--json`` for the
+  schema-stable machine form; exits non-zero on malformed banked
+  blocks (the CI smoke gate).
 """
 
 from __future__ import annotations
@@ -176,7 +185,48 @@ def cmd_doctor(args) -> int:
         }
     except Exception as e:  # doctor must never crash on a broken tree
         report["lint"] = f"<unavailable: {type(e).__name__}>"
+
+    # cost-model coverage (mirrors analysis L005's obs-coverage idea):
+    # a decorated public op with no obs.costmodel family can bench but
+    # never roofline-attribute — new ops must not silently ship
+    # unattributed, so the uncovered list must stay empty
+    try:
+        from flashinfer_tpu.obs import costmodel, hwspec
+
+        report["costmodel"] = {
+            "api_ops_covered": len(costmodel.API_OP_COSTS),
+            "uncovered_api_ops": list(costmodel.uncovered_api_ops()),
+            "chip": hwspec.detect_chip(),
+        }
+    except Exception as e:
+        report["costmodel"] = f"<unavailable: {type(e).__name__}>"
     print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_perf(args) -> int:
+    """Roofline doctor over banked bench rows — the VERDICT analysis,
+    reproduced mechanically (no jax / no device needed)."""
+    from flashinfer_tpu.obs import bench_audit, roofline
+
+    path = args.banked
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "BENCH_BANKED.md")
+    try:
+        rows = bench_audit.load_banked_history(path, strict=True)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not rows:
+        print(f"error: no bench rows found in {path}", file=sys.stderr)
+        return 2
+    report = roofline.build_perf_report(rows, chip=args.chip)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(roofline.render_perf_report(report))
     return 0
 
 
@@ -193,6 +243,18 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_report)
     sp = sub.add_parser("doctor", help="device/env/backend health report")
     sp.set_defaults(fn=cmd_doctor)
+    sp = sub.add_parser("perf", help="roofline attribution report over "
+                                     "banked bench rows")
+    sp.add_argument("--banked", metavar="PATH", default=None,
+                    help="BENCH_BANKED.md-style history "
+                         "(default: the repo's BENCH_BANKED.md)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable report (schema "
+                         "flashinfer_tpu.obs.perf/1)")
+    sp.add_argument("--chip", default=None,
+                    help="default chip for rows that name none "
+                         "(default: v5e, the banked history's chip)")
+    sp.set_defaults(fn=cmd_perf)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -34,13 +34,19 @@ import json
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
-# measurement outputs (never part of a row's identity key)
+# measurement outputs (never part of a row's identity key).  The
+# roofline stamp fields (obs.roofline.ROW_FIELDS) live here too — and
+# so does ``chip``: a bs=64 ctx=4096 decode row measured on v5p MUST
+# compete with the v5e history for the same configuration, which is
+# exactly what the roofline-fraction comparison below makes fair.
 MEASUREMENT_FIELDS = frozenset({
     "us", "tbps", "tok_s", "tflops", "gbps", "pct_roofline",
     "kernel_us", "xla_us", "speedup", "us_per_layer", "us_step_80l",
     "tok_s_per_chip", "linearity", "us_step", "tok_s_at_depth",
     "slope_pred_us", "overhead_vs_slope", "overhead_decomposition",
-    "peak", "quality", "vs_best",
+    "peak", "quality", "vs_best", "vs_best_roofline",
+    "flops", "bytes_read", "bytes_written", "intensity", "bound",
+    "effective_pct_roofline", "chip", "dtype", "flops_effective",
 })
 
 # primary throughput metric, in preference order; all higher-is-better
@@ -49,6 +55,11 @@ THROUGHPUT_FIELDS = ("tbps", "tflops", "gbps", "tok_s_per_chip",
 
 POISON_THRESHOLD = 0.35  # the committed phase_decode implausibility rule
 DEGRADED_THRESHOLD = 0.70
+# a measurement above the binding hardware ceiling is a timer artifact
+# (the <0.35x rule only catches too-SLOW artifacts; the banked history
+# carries decode rows at 1.5-2.0x the v5e roofline from slope-fit noise
+# on ~20 us kernels) — small tolerance for spec rounding
+IMPLAUSIBLY_FAST_ROOFLINE = 1.05
 
 _JSON_BLOCK_RE = re.compile(r"^```json\s*$(.*?)^```\s*$",
                             re.MULTILINE | re.DOTALL)
@@ -60,6 +71,27 @@ def row_key(row: dict) -> Tuple:
         (k, str(v)) for k, v in row.items()
         if k not in MEASUREMENT_FIELDS
     ))
+
+
+# fields obs.roofline.stamp_row always writes alongside pct_roofline —
+# their presence identifies a stamped (fraction-valued) row
+_STAMP_MARKERS = ("bound", "chip", "flops")
+
+
+def roofline_fraction(row: dict) -> Optional[float]:
+    """The row's fraction-of-binding-roofline, normalized.  Rows
+    stamped by obs.roofline (identified by the stamp fields riding
+    along) carry a 0..1 fraction; pre-roofline scans rows banked a
+    PERCENT under the same name, and the banked history spans 0.5-94.0
+    percent — magnitude can't discriminate (a 0.6-percent artifact row
+    would read as a winning 0.6 fraction), the stamp's presence can."""
+    v = row.get("pct_roofline")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        return None
+    v = float(v)
+    if any(row.get(k) is not None for k in _STAMP_MARKERS):
+        return v
+    return v / 100.0
 
 
 def primary_metric(row: dict) -> Optional[Tuple[str, float]]:
@@ -75,38 +107,63 @@ def primary_metric(row: dict) -> Optional[Tuple[str, float]]:
     return None
 
 
-def load_banked_history(path: str) -> List[dict]:
+def load_banked_history(path: str, strict: bool = False) -> List[dict]:
     """Rows from every ```json block of a BENCH_BANKED.md-style file
-    (each block is a full run record with a "rows" list).  Tolerant:
-    a malformed block is skipped, an absent file is empty history."""
+    (each block is a full run record with a "rows" list).  Tolerant by
+    default: a malformed block is skipped, an absent file is empty
+    history.  ``strict=True`` (the ``obs perf`` CI smoke gate) raises
+    ``ValueError`` naming every malformed block / non-dict row instead
+    of silently dropping data."""
     rows: List[dict] = []
+    errors: List[str] = []
     try:
         with open(path) as fh:
             text = fh.read()
-    except OSError:
+    except OSError as e:
+        if strict:
+            raise ValueError(f"{path}: {e}") from e
         return rows
     for m in _JSON_BLOCK_RE.finditer(text):
+        line = text.count("\n", 0, m.start()) + 1
         try:
             record = json.loads(m.group(1))
-        except json.JSONDecodeError:
+        except json.JSONDecodeError as e:
+            errors.append(f"{path}:{line}: malformed json block ({e})")
             continue
         got = record.get("rows", []) if isinstance(record, dict) else []
+        bad = sum(1 for r in got if not isinstance(r, dict))
+        if bad:
+            errors.append(f"{path}:{line}: {bad} non-dict row(s)")
         rows.extend(r for r in got if isinstance(r, dict))
+    if strict and errors:
+        raise ValueError("; ".join(errors))
     return rows
 
 
 class RowAuditor:
-    """Tracks best-by-configuration and stamps rows in place."""
+    """Tracks best-by-configuration and stamps rows in place.
+
+    Two comparison spaces per configuration key:
+
+    - **raw** (the original rule): the primary throughput metric vs the
+      best known raw measurement — meaningful when history and row come
+      from the same chip generation;
+    - **roofline-fraction** (chip-generation-portable): the row's
+      ``pct_roofline`` vs the best known fraction for the key.  ``chip``
+      is a measurement field, so a v5p row and the v5e history share a
+      key — raw TB/s would mis-compare across that boundary in either
+      direction, while fraction-of-own-roofline stays honest.  When
+      both spaces are available the fraction ratio decides the quality
+      stamp; the raw ratio still rides along as ``vs_best``.
+    """
 
     def __init__(self, history: Iterable[dict] = ()):
         self._best: Dict[Tuple, float] = {}
+        self._best_frac: Dict[Tuple, float] = {}
         for row in history:
             self._account(row)
 
     def _account(self, row: dict) -> None:
-        pm = primary_metric(row)
-        if pm is None:
-            return
         # a row some past auditor already stamped poison never defines
         # the baseline.  Low artifacts can't raise the max() anyway;
         # this guards the residual case — history trimmed down to a
@@ -115,29 +172,53 @@ class RowAuditor:
         if row.get("quality") == "poison":
             return
         key = row_key(row)
-        _, value = pm
-        if value > self._best.get(key, 0.0):
-            self._best[key] = value
+        pm = primary_metric(row)
+        if pm is not None:
+            _, value = pm
+            if value > self._best.get(key, 0.0):
+                self._best[key] = value
+        frac = roofline_fraction(row)
+        if frac is not None and frac > self._best_frac.get(key, 0.0):
+            self._best_frac[key] = frac
 
     def stamp(self, row: dict) -> dict:
-        """Add ``quality`` (+ ``vs_best`` when history exists) to `row`
-        in place and fold it into the running best.  Never raises."""
+        """Add ``quality`` (+ ``vs_best`` / ``vs_best_roofline`` when
+        history exists) to `row` in place and fold it into the running
+        best.  Never raises."""
         try:
+            key = row_key(row)
             pm = primary_metric(row)
-            if pm is None:
+            ratio_raw = None
+            if pm is not None:
+                _, value = pm
+                best = max(self._best.get(key, 0.0), value)
+                ratio_raw = value / best
+                if best > value:
+                    row["vs_best"] = round(ratio_raw, 3)
+            ratio_frac = None
+            frac = roofline_fraction(row)
+            if frac is not None and frac > IMPLAUSIBLY_FAST_ROOFLINE:
+                # faster than the hardware ceiling: a timer artifact,
+                # poisoned outright (and never folded into the best)
+                row["quality"] = "poison"
+                return row
+            if frac is not None:
+                best_frac = max(self._best_frac.get(key, 0.0), frac)
+                ratio_frac = frac / best_frac
+                if best_frac > frac:
+                    row["vs_best_roofline"] = round(ratio_frac, 3)
+            # fraction space takes precedence: it is the comparison
+            # that stays valid when the chip generation changed
+            ratio = ratio_frac if ratio_frac is not None else ratio_raw
+            if ratio is None:
                 row["quality"] = "ok"  # nothing measurable to audit
                 return row
-            _, value = pm
-            best = max(self._best.get(row_key(row), 0.0), value)
-            ratio = value / best
             if ratio < POISON_THRESHOLD:
                 row["quality"] = "poison"
             elif ratio < DEGRADED_THRESHOLD:
                 row["quality"] = "degraded"
             else:
                 row["quality"] = "ok"
-            if best > value:
-                row["vs_best"] = round(ratio, 3)
             self._account(row)
         except Exception:  # noqa: BLE001 - the audit must never cost a row
             row.pop("quality", None)

@@ -1,0 +1,579 @@
+"""Analytic FLOP / HBM-byte cost model per op family.
+
+The counting half of roofline attribution (Williams et al., CACM 2009):
+each public op family gets one formula for total FLOPs and HBM bytes
+moved (read/write split), computed from the *plan objects the library
+already builds* — fused-prefill work-unit stats report both *launched*
+work (what the MXU actually executed, padding included) and *effective*
+work (the attended tokens a perfect packing would compute).
+:mod:`~flashinfer_tpu.obs.roofline` joins a :class:`Cost` with a
+measured wall time and a :class:`~flashinfer_tpu.obs.hwspec.ChipSpec`.
+
+Conventions (pinned by ``tests/test_roofline.py`` against brute-force
+tiny-shape counts):
+
+- a multiply-add is 2 FLOPs (matching ``testing.utils.attention_flops``
+  and every banked TFLOP/s number);
+- bytes are *algorithmic* HBM traffic: every operand read once, every
+  output written once, caches at their storage width (quantized-KV
+  cost halves/quarters with the byte width) — re-fetch inefficiency is
+  what the measured-vs-roofline gap exposes, so it must not be modeled
+  away here;
+- elementwise/sampling ops count 2 FLOPs/element so intensity stays
+  honest-tiny (they are bandwidth attributions, not MFU claims).
+
+Import contract: pure Python, no jax / no env reads — ``obs perf``
+runs in CI lint processes, and the zero-overhead test pins that merely
+importing ``flashinfer_tpu`` and running ops never loads this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Cost:
+    """Launched (+ optionally effective) work for one op invocation."""
+
+    flops: float
+    bytes_read: float
+    bytes_written: float
+    # effective (useful) work after padding/pruning waste; None == all
+    # launched work was useful
+    flops_effective: Optional[float] = None
+    dtype: str = "bf16"  # compute dtype -> which MXU peak applies
+    op: str = ""
+
+    @property
+    def bytes_total(self) -> float:
+        return self.bytes_read + self.bytes_written
+
+    @property
+    def effective_flops(self) -> float:
+        return self.flops if self.flops_effective is None \
+            else self.flops_effective
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity, FLOPs per HBM byte (launched work)."""
+        return self.flops / self.bytes_total if self.bytes_total else 0.0
+
+    def __add__(self, other: "Cost") -> "Cost":
+        return Cost(
+            flops=self.flops + other.flops,
+            bytes_read=self.bytes_read + other.bytes_read,
+            bytes_written=self.bytes_written + other.bytes_written,
+            flops_effective=self.effective_flops + other.effective_flops
+            if (self.flops_effective is not None
+                or other.flops_effective is not None) else None,
+            dtype=self.dtype, op=self.op or other.op,
+        )
+
+
+def attended_tokens(qo_len: int, kv_len: int, causal: bool = False,
+                    window_left: int = -1) -> int:
+    """Number of attended (q, kv) pairs for one request — THE counted
+    term of every attention formula (bottom-right causal alignment,
+    matching testing.utils.attention_ref)."""
+    total = 0
+    off = kv_len - qo_len
+    for qi in range(qo_len):
+        hi = min(qi + off, kv_len - 1) if causal else kv_len - 1
+        lo = max(qi + off - window_left, 0) if window_left >= 0 else 0
+        if hi >= lo:
+            total += hi - lo + 1
+    return total
+
+
+def _attended_closed(qo_len: int, kv_len: int, causal: bool) -> float:
+    # closed form of attended_tokens for window_left=-1 (the bench
+    # shapes) — O(1) so stamping a 16-cell sweep costs nothing
+    if causal and qo_len > 1:
+        return qo_len * (kv_len - qo_len) + (qo_len * (qo_len + 1)) // 2
+    return qo_len * kv_len
+
+
+def attention(qo_len: int, kv_len: int, num_qo_heads: int,
+              num_kv_heads: int, head_dim_qk: int,
+              head_dim_vo: Optional[int] = None, *, causal: bool = False,
+              batch: int = 1, q_bytes: int = 2, kv_bytes: int = 2,
+              out_bytes: int = 2, dtype: str = "bf16") -> Cost:
+    """Generic (ragged/flash/single/decode) attention: QK^T + PV FLOPs,
+    q+k+v read / o written once.  ``kv_bytes`` carries the quantized-KV
+    byte width (int8 cache -> 1, fp8 -> 1)."""
+    dvo = head_dim_qk if head_dim_vo is None else head_dim_vo
+    att = _attended_closed(qo_len, kv_len, causal)
+    return Cost(
+        flops=2.0 * batch * att * num_qo_heads * (head_dim_qk + dvo),
+        bytes_read=float(batch) * (
+            qo_len * num_qo_heads * head_dim_qk * q_bytes
+            + kv_len * num_kv_heads * (head_dim_qk + dvo) * kv_bytes),
+        bytes_written=float(batch) * qo_len * num_qo_heads * dvo
+        * out_bytes,
+        dtype=dtype, op="attention",
+    )
+
+
+def paged_decode(batch: int, ctx: int, num_qo_heads: int,
+                 num_kv_heads: int, head_dim: int, *, kv_bytes: int = 2,
+                 q_bytes: int = 2, dtype: str = "bf16") -> Cost:
+    """Batched paged-KV decode: one query token per request streams the
+    whole cache — the bandwidth-bound headline op."""
+    c = attention(1, ctx, num_qo_heads, num_kv_heads, head_dim,
+                  causal=False, batch=batch, q_bytes=q_bytes,
+                  kv_bytes=kv_bytes, dtype=dtype)
+    return dataclasses.replace(c, op="paged_decode")
+
+
+def mla_decode(batch: int, ctx: int, num_heads: int, *,
+               latent_dim: int = 512, rope_dim: int = 64,
+               lane_pad: int = 128, cache_bytes: int = 2,
+               q_bytes: int = 2, out_bytes: int = 2,
+               dtype: str = "bf16") -> Cost:
+    """MLA absorbed decode (DeepSeek ckv 512 + kpe 64): the latent cache
+    is read ONCE for all heads (the MLA memory win); the TPU kpe layout
+    is lane-padded to `lane_pad` columns, so cache bytes charge
+    ``latent_dim + lane_pad`` per token — the padding is real HBM
+    traffic.  FLOPs count the live dims only: q.k over
+    (latent+rope) and p.v over latent."""
+    att = float(batch) * ctx * num_heads
+    return Cost(
+        flops=2.0 * att * ((latent_dim + rope_dim) + latent_dim),
+        bytes_read=(
+            float(batch) * ctx * (latent_dim + lane_pad) * cache_bytes
+            + batch * num_heads * (latent_dim + rope_dim) * q_bytes),
+        bytes_written=float(batch) * num_heads * latent_dim * out_bytes,
+        dtype=dtype, op="mla_decode",
+    )
+
+
+def fused_prefill_from_stats(
+    stats: Mapping[str, int], *, block_q: int, pages_per_chunk: int,
+    page_size: int, num_qo_heads: int, num_kv_heads: int, head_dim: int,
+    total_q: int, q_bytes: int = 2, kv_bytes: int = 2,
+    out_bytes: int = 2, dtype: str = "bf16",
+) -> Cost:
+    """Launched + effective work of the pipelined work-unit prefill,
+    straight from the plan's post-pruning/post-packing ``stats`` (PR 3:
+    ``mxu_cells_total`` = every (q-row, kv-col) MXU position the real
+    units execute; ``mxu_cells_valid`` = the in-bounds ones).  The gap
+    IS the padding waste ``plan.padding_waste_pct`` histograms."""
+    chunk_tokens = pages_per_chunk * page_size
+    per_cell = 2.0 * num_qo_heads * (head_dim + head_dim)
+    return Cost(
+        flops=stats["mxu_cells_total"] * per_cell,
+        flops_effective=stats["mxu_cells_valid"] * per_cell,
+        # q is fetched once per packed tile (the double-buffered qslot
+        # stream); k+v stream once per unit chunk
+        bytes_read=(
+            stats["tiles"] * block_q * num_qo_heads * head_dim * q_bytes
+            + stats["units"] * chunk_tokens * num_kv_heads
+            * (head_dim + head_dim) * kv_bytes),
+        bytes_written=float(total_q) * num_qo_heads * head_dim
+        * out_bytes,
+        dtype=dtype, op="fused_prefill",
+    )
+
+
+def paged_prefill(batch: int, qo_len: int, kv_len: int,
+                  num_qo_heads: int, num_kv_heads: int, head_dim: int,
+                  *, causal: bool = True, stats: Optional[Mapping] = None,
+                  block_q: Optional[int] = None,
+                  pages_per_chunk: Optional[int] = None,
+                  page_size: int = 16, q_bytes: int = 2,
+                  kv_bytes: int = 2, dtype: str = "bf16") -> Cost:
+    """Batch chunked paged prefill.  With live plan ``stats`` (the
+    fused backend) the launched work comes from the work-unit grid and
+    the effective work from the attended tokens; without (gather
+    fallback, or a banked row reconstructed from config alone) the
+    cost is effective-only."""
+    eff = attention(qo_len, kv_len, num_qo_heads, num_kv_heads,
+                    head_dim, causal=causal, batch=batch,
+                    q_bytes=q_bytes, kv_bytes=kv_bytes, dtype=dtype)
+    if stats is None or block_q is None or pages_per_chunk is None:
+        return dataclasses.replace(eff, op="paged_prefill")
+    launched = fused_prefill_from_stats(
+        stats, block_q=block_q, pages_per_chunk=pages_per_chunk,
+        page_size=page_size, num_qo_heads=num_qo_heads,
+        num_kv_heads=num_kv_heads, head_dim=head_dim,
+        total_q=batch * qo_len, q_bytes=q_bytes, kv_bytes=kv_bytes,
+        dtype=dtype)
+    return dataclasses.replace(launched, flops_effective=eff.flops,
+                               op="paged_prefill")
+
+
+def moe_gmm(tokens: int, num_experts: int, hidden: int, inter: int,
+            top_k: int, *, weight_bytes: int = 2, act_bytes: int = 2,
+            experts_loaded: Optional[int] = None,
+            dtype: str = "bf16") -> Cost:
+    """Fused MoE (gate/up + down GEMMs over routed tokens).  Per-expert
+    token loads: each ACTIVE expert's weight block is streamed once per
+    launch (``experts_loaded``, default every expert hot — the bench
+    regime where tokens*top_k >> experts); routed activations are
+    gathered in and scattered out per (token, choice)."""
+    if experts_loaded is None:
+        experts_loaded = min(num_experts, tokens * top_k)
+    per_tok = hidden * 2 * inter + inter * hidden  # both GEMMs, madd=2
+    return Cost(
+        flops=2.0 * tokens * top_k * per_tok,
+        bytes_read=(
+            float(experts_loaded) * (hidden * 2 * inter + inter * hidden)
+            * weight_bytes
+            + tokens * hidden * act_bytes  # x in
+            + tokens * top_k * (hidden + 2 * inter) * act_bytes),
+        bytes_written=(
+            float(tokens) * top_k * hidden * act_bytes  # expert outs
+            + tokens * hidden * act_bytes),  # combined y
+        dtype=dtype, op="moe_gmm",
+    )
+
+
+def gemm(m: int, n: int, k: int, *, a_bytes: int = 2, b_bytes: int = 2,
+         out_bytes: int = 2, dtype: str = "bf16") -> Cost:
+    return Cost(
+        flops=2.0 * m * n * k,
+        bytes_read=float(m) * k * a_bytes + float(k) * n * b_bytes,
+        bytes_written=float(m) * n * out_bytes, dtype=dtype, op="gemm",
+    )
+
+
+def sampling(batch: int, vocab: int, *, probs_bytes: int = 4) -> Cost:
+    """Categorical sampling / filtering over the full distribution:
+    one pass over [batch, vocab] probs, a few tokens out."""
+    return Cost(
+        flops=2.0 * batch * vocab,
+        bytes_read=float(batch) * vocab * probs_bytes,
+        bytes_written=float(batch) * 4, op="sampling",
+    )
+
+
+def topk(batch: int, vocab: int, k: int = 0, *,
+         score_bytes: int = 4) -> Cost:
+    """Exact top-k over [batch, vocab] scores: the lower-bound traffic
+    is one read of the score matrix + k indices/values out."""
+    return Cost(
+        flops=2.0 * batch * vocab,
+        bytes_read=float(batch) * vocab * score_bytes,
+        bytes_written=float(batch) * max(k, 1) * 8, op="topk",
+    )
+
+
+def elementwise(elements: int, *, reads_per: int = 1, writes_per: int = 1,
+                bytes_per: int = 2, flops_per: float = 2.0,
+                op: str = "elementwise") -> Cost:
+    """Gated activations / masks / casts: pure bandwidth."""
+    return Cost(
+        flops=flops_per * elements,
+        bytes_read=float(elements) * reads_per * bytes_per,
+        bytes_written=float(elements) * writes_per * bytes_per, op=op,
+    )
+
+
+def norm(tokens: int, hidden: int, *, bytes_per: int = 2,
+         fused_residual: bool = False) -> Cost:
+    """RMS-norm family: read x (+ residual) + weight, write out
+    (+ residual); ~4 FLOPs/element (square, sum, rsqrt-mul, scale)."""
+    n = tokens * hidden
+    extra = n if fused_residual else 0
+    return Cost(
+        flops=4.0 * n,
+        bytes_read=float(n + extra + hidden) * bytes_per,
+        bytes_written=float(n + extra) * bytes_per, op="norm",
+    )
+
+
+def rope(tokens: int, num_heads: int, head_dim: int, *,
+         bytes_per: int = 2, quantize_out_bytes: Optional[int] = None
+         ) -> Cost:
+    """Rotary embedding over q/k rows: read + write each element, ~6
+    FLOPs/element (two muls + add per rotated pair, cos/sin amortized);
+    the fp8-quantizing variants write at the narrow width."""
+    n = tokens * num_heads * head_dim
+    wb = bytes_per if quantize_out_bytes is None else quantize_out_bytes
+    return Cost(flops=6.0 * n, bytes_read=float(n) * bytes_per,
+                bytes_written=float(n) * wb, op="rope")
+
+
+def page_append(tokens: int, num_kv_heads: int, head_dim: int, *,
+                kv_bytes: int = 2, in_bytes: int = 2) -> Cost:
+    """append_paged_kv_cache: read the new k+v rows, scatter them into
+    the paged cache at the cache's storage width."""
+    n = tokens * num_kv_heads * head_dim * 2  # k and v
+    return Cost(flops=2.0 * n, bytes_read=float(n) * in_bytes,
+                bytes_written=float(n) * kv_bytes, op="page_append")
+
+
+# -- linear-attention / SSM families (bench.py phase_scans) ---------------
+
+
+def state_decode(batch: int, num_heads: int, dk: int, dv: int, *,
+                 state_bytes: int = 4) -> Cost:
+    """One decode step of a state-space / linear-attention model: the
+    [heads, dk, dv] f32 state is read + written once per token (the
+    bandwidth bound the no-kernel verdicts divide by)."""
+    n = batch * num_heads * dk * dv
+    return Cost(flops=4.0 * n, bytes_read=float(n) * state_bytes,
+                bytes_written=float(n) * state_bytes, op="state_decode")
+
+
+def ssd_prefill(batch: int, seqlen: int, num_heads: int, head_dim: int,
+                state_dim: int, *, chunk: int = 64,
+                act_bytes: int = 4) -> Cost:
+    """Mamba-2 chunked SSD prefill: intra-chunk scores [Q,Q] via C.B
+    plus the state outer products (the bench.py formula, now shared)."""
+    flops = (2.0 * batch * seqlen * chunk * num_heads
+             * (state_dim + head_dim)
+             + 2.0 * batch * seqlen * num_heads * head_dim * state_dim)
+    n_io = batch * seqlen * num_heads * head_dim
+    return Cost(flops=flops, bytes_read=float(n_io) * act_bytes * 2,
+                bytes_written=float(n_io) * act_bytes, op="ssd_prefill")
+
+
+def gated_delta_prefill(batch: int, seqlen: int, num_heads: int,
+                        dk: int, dv: int, *, act_bytes: int = 4) -> Cost:
+    """GDN / KDA chunked prefill: state in/out matmuls per token."""
+    n_io = batch * seqlen * num_heads * (dk + dv)
+    return Cost(flops=2.0 * batch * seqlen * num_heads * (dk * dv * 2),
+                bytes_read=float(n_io) * act_bytes,
+                bytes_written=float(batch) * seqlen * num_heads * dv
+                * act_bytes, op="gated_delta_prefill")
+
+
+# -- serving decode step (bench.py phase_serving int8 shard pipeline) -----
+
+# dims of the per-chip tp=8 70B shard bench.py measures; keyed by the
+# row's `model` field so `obs perf` can attribute banked rows that
+# predate cost stamping
+SERVING_SHAPES: Dict[str, Dict[str, int]] = {
+    "llama70b_tp8shard_int8": dict(
+        hidden=8192, hq=8, hkv=1, hd=128, inter=3584, vocab_shard=16032,
+        page_size=16, weight_bytes=1, kv_bytes=1,
+    ),
+}
+
+SERVING_PHASES = ("norm_rope", "attention", "kv_append", "moe_or_mlp",
+                  "lm_head", "sampling")
+
+
+def serving_phase_costs(bs: int, ctx: int, layers: int, *, hidden: int,
+                        hq: int, hkv: int, hd: int, inter: int,
+                        vocab_shard: int, page_size: int = 16,
+                        weight_bytes: int = 1, kv_bytes: int = 1,
+                        act_bytes: int = 2) -> Dict[str, Cost]:
+    """Per-step cost of each serving-loop phase (the SAME names the
+    ``overhead_decomposition`` row and profiler scopes use), aggregated
+    over `layers`.  int8-weight GEMMs -> dtype int8."""
+    qdim, kvdim = hq * hd, hkv * hd
+    L = float(layers)
+
+    def lg(m, n, k):  # one int8 GEMM per layer, activations int8 in
+        return dataclasses.replace(
+            gemm(m, n, k, a_bytes=1, b_bytes=weight_bytes,
+                 out_bytes=act_bytes), dtype="int8")
+
+    attn = (lg(bs, qdim + 2 * kvdim, hidden) + lg(bs, hidden, qdim)
+            + dataclasses.replace(
+                paged_decode(bs, ctx, hq, hkv, hd, kv_bytes=kv_bytes),
+                dtype="int8"))
+    mlp = lg(bs, 2 * inter, hidden) + lg(bs, hidden, inter)
+    nr = (norm(bs, hidden) + norm(bs, hidden)
+          + rope(bs, hq + hkv, hd))
+    costs = {
+        "norm_rope": _scale(nr, L),
+        "attention": _scale(attn, L),
+        "kv_append": _scale(
+            page_append(bs, hkv, hd, kv_bytes=kv_bytes), L),
+        "moe_or_mlp": _scale(mlp, L),
+        "lm_head": dataclasses.replace(
+            norm(bs, hidden) + lg(bs, vocab_shard, hidden),
+            dtype="int8"),
+        "sampling": sampling(bs, vocab_shard),
+    }
+    return costs
+
+
+def _scale(c: Cost, k: float) -> Cost:
+    return dataclasses.replace(
+        c, flops=c.flops * k, bytes_read=c.bytes_read * k,
+        bytes_written=c.bytes_written * k,
+        flops_effective=None if c.flops_effective is None
+        else c.flops_effective * k)
+
+
+def serving_step(bs: int, ctx: int, layers: int, *,
+                 include_kv_append: bool = True,
+                 include_sampling: bool = True, **shape) -> Cost:
+    """Whole decode step of the int8 shard pipeline: sum of phases
+    (the slope-fit row excludes kv_append + sampling, mirroring what
+    it measures)."""
+    phases = serving_phase_costs(bs, ctx, layers, **shape)
+    total = None
+    for name in SERVING_PHASES:
+        if name == "kv_append" and not include_kv_append:
+            continue
+        if name == "sampling" and not include_sampling:
+            continue
+        total = phases[name] if total is None else total + phases[name]
+    return dataclasses.replace(total, dtype="int8", op="serving_step")
+
+
+# -- @flashinfer_api coverage (obs doctor) --------------------------------
+
+# decorated public op -> cost-model family (a function in this module).
+# `obs doctor` lists API_OPS absent here, mirroring L005's obs-coverage
+# idea: a new public op cannot silently ship roofline-unattributable.
+API_OP_COSTS: Dict[str, str] = {
+    "silu_and_mul": "elementwise", "gelu_and_mul": "elementwise",
+    "gelu_tanh_and_mul": "elementwise",
+    "rmsnorm": "norm", "gemma_rmsnorm": "norm",
+    "fused_add_rmsnorm": "norm", "gemma_fused_add_rmsnorm": "norm",
+    "apply_rope": "rope", "apply_llama31_rope": "rope",
+    "rope_quantize_fp8": "rope", "mla_rope_quantize_fp8": "rope",
+    "rope_quantize_fp8_append_paged_kv_cache": "rope",
+    "append_paged_kv_cache": "page_append",
+    "single_decode_with_kv_cache": "attention",
+    "single_prefill_with_kv_cache": "attention",
+    "sampling_from_probs": "sampling", "sampling_from_logits": "sampling",
+    "top_p_sampling_from_probs": "sampling",
+    "top_k_sampling_from_probs": "sampling",
+    "min_p_sampling_from_probs": "sampling",
+    "top_k_top_p_sampling_from_probs": "sampling",
+}
+
+
+def uncovered_api_ops() -> Tuple[str, ...]:
+    """Decorated public ops with no cost-model family (doctor check)."""
+    from flashinfer_tpu.obs.catalog import API_OPS
+
+    return tuple(sorted(API_OPS - set(API_OP_COSTS)))
+
+
+# -- banked-row reconstruction (obs perf on pre-roofline history) ---------
+
+# fixed configs of bench.py's phases that rows don't spell out
+# (Llama-3 GQA 32/8/128, DeepSeek MLA 128 heads 512+64, Mixtral 8x7B,
+# the scans dims) — used ONLY for rows banked before cost stamping;
+# new rows carry their cost fields inline.
+_BENCH_DECODE = dict(num_qo_heads=32, num_kv_heads=8, head_dim=128)
+_BENCH_PREFILL = dict(HQ=32, HKV=8, D=128)
+_BENCH_MOE = dict(num_experts=8, hidden=4096, inter=14336, top_k=2)
+_BENCH_SCANS = dict(H=24, dim=64, ds=128, Hg=16, dk=128, dv=128)
+
+
+def _row_seconds(row: Mapping) -> Optional[float]:
+    """Wall time of the measurement a row's stamp refers to."""
+    for f in ("us", "us_step", "us_step_80l", "kernel_us"):
+        v = row.get(f)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v) * 1e-6
+    return None
+
+
+def cost_from_stamped_row(row: Mapping) -> Optional[Tuple[Cost, float]]:
+    """(Cost, seconds) straight from a row that obs.roofline already
+    stamped (new-generation banked rows are self-describing): launched
+    flops + read/write bytes, the optional ``flops_effective`` waste
+    split, and the compute dtype — no shape reconstruction needed."""
+    try:
+        flops = float(row["flops"])
+        br = float(row["bytes_read"])
+        bw = float(row["bytes_written"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    seconds = _row_seconds(row)
+    if seconds is None:
+        return None
+    eff = row.get("flops_effective")
+    return Cost(
+        flops=flops, bytes_read=br, bytes_written=bw,
+        flops_effective=float(eff) if isinstance(eff, (int, float))
+        else None,
+        dtype=str(row.get("dtype", "bf16")), op=str(row.get("phase", "")),
+    ), seconds
+
+
+def cost_for_bench_row(row: Mapping) -> Optional[Tuple[Cost, float]]:
+    """(Cost, seconds) for a bench row: the row's own roofline stamp
+    when present (:func:`cost_from_stamped_row`), else reconstructed
+    from the row's configuration via the fixed bench shapes below.
+    None when the phase has no model (the CI selftest stub) or the row
+    is malformed."""
+    stamped = cost_from_stamped_row(row)
+    if stamped is not None:
+        return stamped
+    phase = row.get("phase")
+    try:
+        if phase == "decode":
+            return (paged_decode(int(row["bs"]), int(row["ctx"]),
+                                 **_BENCH_DECODE),
+                    float(row["us"]) * 1e-6)
+        if phase == "prefill":
+            p = _BENCH_PREFILL
+            if row.get("kind") == "ragged_flash":
+                T = int(row["qlen"])
+                c = attention(T, T, p["HQ"], p["HKV"], p["D"],
+                              causal=True)
+            else:
+                c = paged_prefill(int(row["bs"]), int(row["qlen"]),
+                                  int(row["ctx"]), p["HQ"], p["HKV"],
+                                  p["D"], causal=True)
+            return c, float(row["us"]) * 1e-6
+        if phase == "mla":
+            return (mla_decode(int(row["bs"]), int(row["ctx"]),
+                               int(row.get("heads", 128))),
+                    float(row["us"]) * 1e-6)
+        if phase == "moe":
+            int8 = "int8" in str(row.get("variant", ""))
+            return (moe_gmm(int(row["tokens"]), **_BENCH_MOE,
+                            weight_bytes=1 if int8 else 2,
+                            dtype="int8" if int8 else "bf16"),
+                    float(row["us"]) * 1e-6)
+        if phase == "sampling":
+            return (sampling(int(row["bs"]), int(row["vocab"])),
+                    float(row["kernel_us"]) * 1e-6)
+        if phase == "topk":
+            return (topk(int(row["bs"]), int(row["vocab"]),
+                         int(row.get("k", 0))),
+                    float(row["us"]) * 1e-6)
+        if phase == "scans":
+            return _scans_row_cost(row)
+        if phase == "serving":
+            return _serving_row_cost(row)
+    except (KeyError, TypeError, ValueError):
+        return None
+    return None
+
+
+def _scans_row_cost(row: Mapping) -> Optional[Tuple[Cost, float]]:
+    op, B = str(row.get("op", "")), int(row["B"])
+    s = _BENCH_SCANS
+    t = float(row["us"]) * 1e-6
+    if op == "mamba_decode":
+        return state_decode(B, s["H"], s["dim"], s["ds"]), t
+    if op in ("gdn_decode", "kda_decode"):
+        return state_decode(B, s["Hg"], s["dk"], s["dv"]), t
+    L = int(row["L"])
+    if op.startswith("mamba_prefill"):
+        chunk = 128 if op.endswith("pallas") else 64
+        return ssd_prefill(B, L, s["H"], s["dim"], s["ds"],
+                           chunk=chunk), t
+    if op.startswith(("gdn_prefill", "kda_prefill")):
+        return gated_delta_prefill(B, L, s["Hg"], s["dk"], s["dv"]), t
+    return None
+
+
+def _serving_row_cost(row: Mapping) -> Optional[Tuple[Cost, float]]:
+    shape = SERVING_SHAPES.get(str(row.get("model", "")))
+    if shape is None:
+        return None
+    bs, ctx = int(row["bs"]), int(row["ctx"])
+    if row.get("mode") == "e2e_measured":
+        return (serving_step(bs, ctx, int(row["layers"]), **shape),
+                float(row["us_step"]) * 1e-6)
+    if "us_step_80l" in row:
+        return (serving_step(bs, ctx, 80, include_kv_append=False,
+                             include_sampling=False, **shape),
+                float(row["us_step_80l"]) * 1e-6)
+    return None

@@ -842,6 +842,10 @@ class BatchPrefillWithPagedKVCacheWrapper:
             self._fused_plan = None
             fused_stats = None
             self._plan = build_gather_plan()
+        # plan-time work accounting (launched vs effective MXU cells,
+        # tiles, pruned units) — the cost model's input for roofline
+        # attribution (obs.costmodel.fused_prefill_from_stats)
+        self._fused_stats = fused_stats
         from flashinfer_tpu import obs
 
         # work-unit fill axes ride the same padding-waste histograms the
@@ -877,6 +881,17 @@ class BatchPrefillWithPagedKVCacheWrapper:
         if self._fused_plan is None:
             return None
         return dict(self._fused_plan[1])
+
+    @property
+    def fused_prefill_stats(self) -> Optional[dict]:
+        """The live plan's post-pruning/post-packing work accounting
+        (``build_prefill_work_units`` ``stats``: units/tiles/pruned +
+        launched-vs-valid unit rows and MXU cells), or None on the
+        gather path — obs.costmodel derives launched-vs-effective
+        roofline work from this."""
+        if self._fused_plan is None or self._fused_stats is None:
+            return None
+        return dict(self._fused_stats)
 
     def _rebind_sm_scale(self, *, absolute=None, multiplier=None):
         """Per-call sm_scale override: swap in a plan with the new scale
@@ -1015,11 +1030,12 @@ class BatchPrefillWithPagedKVCacheWrapper:
                         block_q=u.pop("block_q"),
                         pages_per_chunk=u.pop("pages_per_chunk"),
                     )
-                    u.pop("stats")
-                    return {k2: jnp.asarray(v2) for k2, v2 in u.items()}, st
+                    stats = u.pop("stats")
+                    return ({k2: jnp.asarray(v2) for k2, v2 in u.items()},
+                            st, stats)
 
                 def _runner(c):
-                    up, st = _build(c)
+                    up, st, _ = _build(c)
                     return lambda: fused_paged_prefill(
                         q, k_hnd, v_hnd, up,
                         sm_scale=plan.sm_scale,
@@ -1035,8 +1051,11 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 )
                 best = (int(best[0]), int(best[1]))
                 if best != cur:
-                    self._fused_plan = _build(best)
-                    unit_plan, statics = self._fused_plan
+                    # stats are per-block-config (unit/tile/cell counts):
+                    # the retuned plan must refresh them or the cost
+                    # model would attribute the OLD launch shape
+                    unit_plan, statics, self._fused_stats = _build(best)
+                    self._fused_plan = (unit_plan, statics)
 
             try:
                 out = compile_guard.guarded(

@@ -473,6 +473,12 @@ def test_obs_doctor_cli():
     # report zero here (and a total, so drift is visible)
     assert report["lint"]["reasonless_suppressions"] == 0
     assert report["lint"]["suppressions"] >= 1
+    # cost-model coverage (ISSUE 5): a decorated public op with no
+    # roofline formula would bench but never attribute — the doctor
+    # must report the straggler list, and the tree must keep it empty
+    assert report["costmodel"]["uncovered_api_ops"] == []
+    assert report["costmodel"]["api_ops_covered"] >= 10
+    assert report["costmodel"]["chip"] in ("v4", "v5e", "v5p", "v6e")
 
 
 @pytest.mark.slow

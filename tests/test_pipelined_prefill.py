@@ -300,3 +300,59 @@ def test_wrapper_fused_backend_masked_matches_gather_path():
             w.run(q, (kc_hnd, vc_hnd)), np.float32)
     np.testing.assert_allclose(outs["pallas_fused"], outs["xla"],
                                rtol=3e-2, atol=3e-2)
+
+
+def test_wrapper_live_retune_refreshes_plan_and_stats(monkeypatch):
+    """In-run autotune swap: when `choose_one` picks a different block
+    config than the planned one, the wrapper must rebuild the fused
+    plan AND refresh `fused_prefill_stats` — the plan stays the
+    (unit_plan, statics) 2-tuple every consumer unpacks, and the stats
+    describe the NEW launch shape (the roofline cost model attributes
+    from them; stale stats would attribute the old grid)."""
+    import flashinfer_tpu as fi
+    from flashinfer_tpu import autotuner
+    from flashinfer_tpu.ops.paged_prefill import block_candidates
+
+    qo_lens, kv_lens = [24, 40], [48, 64]
+    (qo_indptr, kv_page_indptr, kv_page_indices, q, kc, vc) = _setup(
+        qo_lens, kv_lens, seed=3)
+    q = q.astype(jnp.bfloat16)
+    kc = kc.astype(jnp.bfloat16)
+    vc = vc.astype(jnp.bfloat16)
+    last_page = (np.asarray(kv_lens)
+                 - (np.asarray([np.ceil(l / PS) for l in kv_lens],
+                               np.int32) - 1) * PS).astype(np.int32)
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(
+        kv_layout="HND", backend="pallas_fused")
+    w.plan(qo_indptr, kv_page_indptr, kv_page_indices, last_page,
+           HQ, HKV, D, PS, causal=True)
+    cfg0 = w.fused_prefill_config
+    stats0 = w.fused_prefill_stats
+    assert cfg0 is not None and stats0 is not None
+
+    other = next(
+        c for c in block_candidates(PS)
+        if (int(c[0]), int(c[1]))
+        != (cfg0["block_q"], cfg0["pages_per_chunk"]))
+    monkeypatch.setattr(
+        autotuner.AutoTuner, "choose_one",
+        lambda self, op, key, cands, runner, default=None, module=None:
+        other)
+    with autotuner.autotune():
+        out = np.asarray(w.run(q, (kc, vc)), np.float32)
+
+    cfg1 = w.fused_prefill_config
+    assert (cfg1["block_q"], cfg1["pages_per_chunk"]) \
+        == (int(other[0]), int(other[1]))
+    stats1 = w.fused_prefill_stats
+    assert stats1 != stats0  # per-config unit/tile/cell counts moved
+    assert stats1["mxu_cells_valid"] == stats0["mxu_cells_valid"]
+    # the swapped plan is still runnable and numerically right (vs the
+    # gather fallback)
+    ref = fi.BatchPrefillWithPagedKVCacheWrapper(
+        kv_layout="HND", backend="xla")
+    ref.plan(qo_indptr, kv_page_indptr, kv_page_indices, last_page,
+             HQ, HKV, D, PS, causal=True)
+    np.testing.assert_allclose(out, np.asarray(ref.run(q, (kc, vc)),
+                                               np.float32),
+                               rtol=3e-2, atol=3e-2)
