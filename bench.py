@@ -53,6 +53,10 @@ PHASE_TIMEOUT_S = {
     "sampling": 1200.0,
     "decode": 1500.0,
     "decode_sweep": 3600.0,
+    # 4 split candidates x (compile + measure) on the cliff cell + the
+    # long-context control
+    "decode_splits": 1800.0,
+    "decode_splits_sweep": 2400.0,
     "moe": 1500.0,
     "moe_sweep": 2400.0,
     "topk": 1200.0,
@@ -68,13 +72,15 @@ PHASE_TIMEOUT_S = {
 }
 
 
-def _stamp(row, cost, seconds):
+def _stamp(row, cost, seconds, **split_meta):
     """Stamp the canonical roofline fields onto a row via the shared
     model (obs.roofline x obs.hwspec detection) — THE only path from a
-    measurement to an efficiency fraction in this file."""
+    measurement to an efficiency fraction in this file.  ``split_meta``
+    forwards the split-KV stamp fields (num_splits / merge_bytes)."""
     from flashinfer_tpu.obs import hwspec, roofline
 
-    return roofline.stamp_row(row, cost, seconds, hwspec.current_spec())
+    return roofline.stamp_row(row, cost, seconds, hwspec.current_spec(),
+                              **split_meta)
 
 
 _AUDITOR = None
@@ -169,8 +175,14 @@ def phase_decode(sweep: bool):
         )
 
         w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+        # num_splits=1 pins the PROVEN unsplit kernel: this phase is the
+        # official headline metric and its rows compete with the banked
+        # unsplit history — the split path (never yet run on chip) is
+        # measured by phase_decode_splits, whose rows carry num_splits
+        # identity.  Without the pin, the shipped decode.splits seeds
+        # would silently reroute the (256,512)/(64,512) cells here.
         w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads,
-               head_dim, page_size)
+               head_dim, page_size, num_splits=1)
 
         # Slope-fit in-jit loop timing (bench_fn_device docstring): the only
         # honest protocol through the axon tunnel.  The whole first call —
@@ -228,6 +240,91 @@ def phase_decode(sweep: bool):
             cost, t))
         print(f"# decode bs={bs:4d} ctx={ctx:5d}: {t*1e6:9.1f} us  "
               f"{tbps:6.3f} TB/s  {tps:10.0f} tok/s", file=sys.stderr)
+
+
+def phase_decode_splits(sweep: bool):
+    """Split-KV decode A/B on the short-context cliff cell (ISSUE 6:
+    the bs=256/ctx=512 rows swing 0.21-0.54 TB/s while long-context
+    decode sits at 0.88-0.91 of roofline).  Runs the wrapper end to end
+    at every forced split factor plus the plan-time AUTO selection, so
+    the banked rows prove (a) what each S measures and (b) that the
+    cost-model chooser picked the winner.  Deeper candidate sweeps live
+    in benchmarks/bench_decode_splits.py (kernel-level, --emit-config)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.obs import costmodel, hwspec
+    from flashinfer_tpu.ops.paged_decode import split_pages_per_chunk
+    from flashinfer_tpu.testing import bench_fn_device
+
+    chip = hwspec.current_spec()
+
+    def bench_one(batch, ctx, num_splits, page_size=16, num_qo_heads=32,
+                  num_kv_heads=8, head_dim=128, dtype=jnp.bfloat16):
+        pages_per_req = ctx // page_size
+        num_pages = batch * pages_per_req
+        rng = np.random.default_rng(0)
+        perm = rng.permutation(num_pages).astype(np.int32)
+        indptr = np.arange(batch + 1, dtype=np.int32) * pages_per_req
+        last_page = np.full((batch,), page_size, np.int32)
+        key = jax.random.PRNGKey(0)
+        kc = jax.random.normal(
+            key, (num_pages, num_kv_heads, page_size, head_dim), dtype)
+        vc = jax.random.normal(
+            jax.random.fold_in(key, 1),
+            (num_pages, num_kv_heads, page_size, head_dim), dtype)
+        q = jax.random.normal(
+            jax.random.fold_in(key, 2),
+            (batch, num_qo_heads, head_dim), dtype)
+        w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(indptr, perm, last_page, num_qo_heads, num_kv_heads,
+               head_dim, page_size, num_splits=num_splits)
+        S = w._plan.num_splits
+        t = _guard_soft(
+            "bench.decode_splits",
+            (batch, ctx, page_size, num_qo_heads, num_kv_heads,
+             head_dim, str(dtype), S),
+            lambda: bench_fn_device(
+                lambda qq, kk, vv: w.run(qq, (kk, vv)), q, kc, vc,
+                repeats=5),
+        )
+        if t is None:
+            return None
+        ppc = split_pages_per_chunk(page_size, num_kv_heads, head_dim, 2)
+        cost = costmodel.decode_split(
+            batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+            num_splits=S, page_size=page_size, pages_per_chunk=ppc)
+        bd = costmodel.decode_split_breakdown(
+            batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+            num_splits=S, page_size=page_size, pages_per_chunk=ppc)
+        tbps = cost.bytes_total / t / 1e12
+        return t, tbps, S, cost, bd
+
+    if os.environ.get("BENCH_SMALL"):
+        grid, shape = [(4, 128)], dict(
+            num_qo_heads=8, num_kv_heads=2, head_dim=64, page_size=16)
+    else:
+        grid = ([(256, 512), (64, 512), (64, 4096)] if sweep
+                else [(256, 512)])
+        shape = {}
+    for bs, ctx in grid:
+        for forced in (1, 2, 4, None):  # None = plan-time auto choice
+            r = bench_one(bs, ctx, forced, **shape)
+            if r is None:
+                continue
+            t, tbps, S, cost, bd = r
+            _emit_row(**_stamp(
+                dict(phase="decode_splits", bs=bs, ctx=ctx,
+                     mode="auto" if forced is None else "forced",
+                     us=round(t * 1e6, 1), tbps=round(tbps, 4),
+                     peak=chip.hbm_tbps),
+                cost, t, num_splits=S, merge_bytes=bd["merge_bytes"]))
+            mode = "auto" if forced is None else "forced"
+            print(f"# decode_splits bs={bs:4d} ctx={ctx:5d} "
+                  f"S={S} ({mode}): {t*1e6:9.1f} us  "
+                  f"{tbps:6.4f} TB/s", file=sys.stderr)
 
 
 def phase_prefill(sweep: bool):
@@ -1131,6 +1228,7 @@ def phase_selftest(sweep: bool):
 
 PHASES = {
     "decode": phase_decode,
+    "decode_splits": phase_decode_splits,
     "sampling": phase_sampling,
     "moe": phase_moe,
     "topk": phase_topk,
@@ -1149,8 +1247,12 @@ PHASES = {
 #   set, then the two phases whose BENCH rows have never run on chip
 #   (prefill, mla — kernels hw-proven in the tier, the bench drivers
 #   aren't): a first-run failure there must not cost any proven row
+#   decode_splits rides after the proven set: its kernel is
+#   interpret-proven but has never run on chip (split path committed,
+#   on-chip proof pending — PARITY.md), so a first-run failure there
+#   must not cost a proven row
 DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans",
-                  "prefill", "mla"]
+                  "prefill", "mla", "decode_splits"]
 
 
 # --------------------------------------------------------------------------

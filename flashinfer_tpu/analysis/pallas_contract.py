@@ -54,6 +54,7 @@ CODE = "L007"
 # kernel's scalar-prefetch operands.
 PLANNER_KERNELS: Dict[str, str] = {
     "build_prefill_work_units": "_fused_prefill_kernel",
+    "build_decode_split_units": "_decode_split_kernel_fused_heads",
 }
 
 

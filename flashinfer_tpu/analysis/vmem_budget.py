@@ -103,6 +103,24 @@ register_knob_launch(KnobLaunch(
     aliases={"head_dim_vo": "head_dim"},
 ))
 
+# key: (batch, max_pages, num_qo_heads, num_kv_heads, head_dim,
+# page_size, pages_per_chunk, dtype) — ops/paged_decode.py
+# decode_split_tactic_key.  The tactic VALUE (num_splits) never enters
+# the scratch arithmetic — the split kernel's VMEM footprint is the
+# double-buffered (pages_per_chunk, Hkv, PS, D) chunk pair, which the
+# key's own fields size — so this binding is the feasibility gate
+# plan-time selection composes with (decode.py _split_vmem_feasible):
+# a split tactic whose chunk scratch can't compile is pruned before it
+# is ever considered.
+register_knob_launch(KnobLaunch(
+    knob="decode.splits",
+    launcher="paged_decode_attention_split",
+    value_names=("num_splits",),
+    shape_names=("batch", "max_pages", "num_qo_heads", "num_kv_heads",
+                 "head_dim", "page_size", "pages_per_chunk",
+                 "__dtype__"),
+))
+
 
 class _Unevaluable(Exception):
     pass

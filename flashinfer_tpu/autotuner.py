@@ -99,6 +99,10 @@ register_knob("paged_decode.pages_per_chunk",
 register_knob("paged_decode.prefetch", kind="str",
               choices=("static", "off"),
               description="decode kernel cross-step prefetch mode")
+register_knob("decode.splits",
+              description="split-KV decode partition factor per request "
+                          "(1 = unsplit; plan-time, overrides the "
+                          "cost-model choice — see docs/performance.md)")
 register_knob("fused_prefill.blocks", arity=2,
               description="fused work-unit prefill (block_q, "
                           "pages_per_chunk) — the qo-tile/kv-chunk "

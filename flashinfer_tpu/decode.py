@@ -143,6 +143,56 @@ class _DecodePlan:
     pos_encoding_mode: str = "NONE"
     alibi_slopes: object = None  # [num_qo_heads] f32, ALIBI mode only
     rope: object = None  # (rope_scale, rope_theta), ROPE_LLAMA mode only
+    # split-KV partition (reference scheduler.cuh:150 DecodePlan split
+    # work estimation, cost-model-chosen here): num_splits == 1 runs
+    # the unsplit kernel; > 1 runs the partial-state kernel + merge
+    # over these build_decode_split_units arrays
+    num_splits: int = 1
+    split_arrays: object = None  # dict of jnp scalar-prefetch arrays
+    split_units: int = 0
+    split_single_chunk: bool = False
+    split_ppc: int = 0
+
+
+_SPLIT_PROJECT_CACHE: list = []  # one-element AST-project cache
+# (shape_key, batch, ctx, kv_itemsize) -> chosen S: the chooser (and
+# its per-candidate L009 symbolic evaluations) is pure in these, and
+# plan() sits on the serving replan path — growth is bounded by the
+# pow2 geometry buckets the keys are built from
+_SPLIT_CHOICE_CACHE: dict = {}
+
+
+def _split_vmem_feasible(num_splits: int, shape_fields) -> bool:
+    """Prune a split candidate through the L009 VMEM-feasibility
+    evaluator: plug the candidate into the ``decode.splits`` knob
+    launch binding (analysis/vmem_budget.KNOB_LAUNCHES) and evaluate
+    the split launcher's own scratch arithmetic symbolically — only
+    compilable tactics reach the cost-model comparison (ROADMAP item
+    5's compose-them direction).  The evaluator is a LOWER bound, so
+    False is a proof of infeasibility; anything unresolvable (or any
+    analysis failure) keeps the candidate — pruning must never be a
+    guess."""
+    try:
+        from flashinfer_tpu.analysis.core import Project
+        from flashinfer_tpu.analysis.vmem_budget import (KNOB_LAUNCHES,
+                                                         _estimate)
+        from flashinfer_tpu.obs import hwspec
+        from flashinfer_tpu.ops import paged_decode as _pd
+
+        if not _SPLIT_PROJECT_CACHE:
+            _SPLIT_PROJECT_CACHE.append(
+                Project.from_paths([_pd.__file__]))
+        est = _estimate(
+            _SPLIT_PROJECT_CACHE[0], KNOB_LAUNCHES["decode.splits"],
+            int(num_splits), [str(f) for f in shape_fields])
+        if est is None:
+            return True
+        total, declared, _launcher = est
+        budget = declared if declared is not None \
+            else hwspec.current_spec().vmem_bytes
+        return total <= budget
+    except Exception:
+        return True
 
 
 class BatchDecodeWithPagedKVCacheWrapper:
@@ -151,7 +201,14 @@ class BatchDecodeWithPagedKVCacheWrapper:
 
     plan() host-side: converts ragged (indptr, indices, last_page_len) into a
     padded rectangular page table bucketed to powers of two — bounded
-    recompile count replaces CUDAGraph shape freezing."""
+    recompile count replaces CUDAGraph shape freezing.  When the batch
+    geometry sits on the short-context/large-batch decode cliff, plan()
+    additionally partitions each request's KV into ``num_splits``
+    chunk-aligned spans (split-KV decode, reference scheduler.cuh:150)
+    — the factor is chosen by inverting the analytic cost model
+    (``obs.costmodel.choose_decode_splits``) over L009-feasible
+    candidates, overridable by the ``decode.splits`` autotune knob or
+    the explicit ``num_splits=`` plan argument."""
 
     def __init__(
         self,
@@ -187,6 +244,10 @@ class BatchDecodeWithPagedKVCacheWrapper:
         rope_theta: Optional[float] = None,
         non_blocking: bool = True,
         seq_lens=None,
+        *,
+        num_splits: Optional[int] = None,  # split-KV factor; None = auto
+        # keyword-only: beyond the reference plan() arity (L002) — a
+        # verbatim reference call never reaches it
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
         from flashinfer_tpu import native, obs
@@ -205,6 +266,79 @@ class BatchDecodeWithPagedKVCacheWrapper:
         table, kv_lens_pad = native.decode_plan(
             indptr, indices, last_page_len, page_size, b_bucket, p_bucket
         )
+
+        # ---- split-KV partitioning (HND fused-heads path only; the
+        # dense ALIBI/ROPE routes and NHD never consult it) ----------------
+        split_kw = dict(num_splits=1, split_arrays=None, split_units=0,
+                        split_single_chunk=False, split_ppc=0)
+        split_eligible = (self._kv_layout == "HND"
+                          and pos_encoding_mode == "NONE")
+        if not split_eligible and num_splits is not None \
+                and int(num_splits) > 1:
+            # an explicit request that cannot be honored must not be
+            # silently downgraded to the unsplit path
+            raise ValueError(
+                f"num_splits={num_splits} requires kv_layout='HND' and "
+                f"pos_encoding_mode='NONE' (got {self._kv_layout!r}, "
+                f"{pos_encoding_mode!r}) — the split kernel is the HND "
+                "fused-heads path only")
+        if split_eligible:
+            from flashinfer_tpu.ops.paged_decode import (
+                build_decode_split_units, decode_split_tactic_key,
+                split_pages_per_chunk)
+
+            kv_itemsize = (jnp.dtype(kv_data_type).itemsize
+                           if kv_data_type is not None else 2)
+            ppc = split_pages_per_chunk(page_size, num_kv_heads,
+                                        head_dim, kv_itemsize)
+            key_dtype = jnp.dtype(q_data_type) if q_data_type \
+                else (jnp.dtype(data_type) if data_type else "bfloat16")
+            shape_key = decode_split_tactic_key(
+                b_bucket, p_bucket, num_qo_heads, num_kv_heads,
+                head_dim, page_size, ppc, key_dtype)
+            S = num_splits
+            if S is None:
+                # knob first (measured winner / user override), then the
+                # analytic cost model over L009-feasible candidates
+                from flashinfer_tpu.autotuner import AutoTuner
+
+                S = AutoTuner.get().lookup("decode.splits", shape_key,
+                                           default=None)
+            if S is None:
+                ctx = int(np.asarray(kv_lens_pad).max(initial=0))
+                cache_key = (shape_key, batch, ctx, kv_itemsize)
+                S = _SPLIT_CHOICE_CACHE.get(cache_key)
+                if S is None:
+                    try:
+                        from flashinfer_tpu.obs import costmodel, hwspec
+
+                        S, _table = costmodel.choose_decode_splits(
+                            batch, ctx, num_qo_heads, num_kv_heads,
+                            head_dim,
+                            hbm_tbps=hwspec.current_spec().hbm_tbps,
+                            page_size=page_size, pages_per_chunk=ppc,
+                            kv_bytes=kv_itemsize,
+                            feasible=lambda s: _split_vmem_feasible(
+                                s, shape_key))
+                    except Exception:
+                        S = 1  # selection must never cost a plan
+                    _SPLIT_CHOICE_CACHE[cache_key] = S
+            S = max(int(S), 1)
+            if S > 1:
+                sp = build_decode_split_units(
+                    table, kv_lens_pad, num_splits=S,
+                    page_size=page_size, pages_per_chunk=ppc)
+                sp.pop("stats")
+                split_kw = dict(
+                    num_splits=sp.pop("num_splits"),
+                    split_units=sp.pop("num_units"),
+                    split_single_chunk=sp.pop("single_chunk"),
+                    split_ppc=sp.pop("pages_per_chunk"),
+                    split_arrays={k: jnp.asarray(v)
+                                  for k, v in sp.items()},
+                )
+            obs.counter_inc("plan.decode_splits",
+                            wrapper=type(self).__name__, splits=str(S))
 
         self._plan = _DecodePlan(
             page_table=jnp.asarray(table),
@@ -229,6 +363,7 @@ class BatchDecodeWithPagedKVCacheWrapper:
                 (rope_scale or 1.0, rope_theta or 1e4)
                 if pos_encoding_mode == "ROPE_LLAMA" else None
             ),
+            **split_kw,
         )
         # plan-lifecycle metrics (obs catalog plan.*): bucketed-padding
         # waste is the recompile-bound trade-off this plan makes — the
@@ -301,7 +436,44 @@ class BatchDecodeWithPagedKVCacheWrapper:
             # rotates gathered keys at their positions (decode.cuh:217)
             backend = "xla"
             alibi_kw["rope"] = plan.rope
-        if backend == "pallas":
+        if backend == "pallas" and plan.num_splits > 1:
+            # split-KV path: partial-state kernel over the plan's work
+            # units + merge_states reduction (plan-time cost-model
+            # choice; the arrays were built by build_decode_split_units
+            # in plan())
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_decode as _pd_module
+            from flashinfer_tpu.ops.paged_decode import (
+                paged_decode_attention_split)
+
+            def _run_split():
+                return paged_decode_attention_split(
+                    q, k_cache, v_cache, plan.split_arrays,
+                    num_units=plan.split_units,
+                    num_splits=plan.num_splits,
+                    single_chunk=plan.split_single_chunk,
+                    pages_per_chunk=plan.split_ppc,
+                    sm_scale=sm_scale,
+                    logits_soft_cap=plan.logits_soft_cap,
+                    window_left=plan.window_left,
+                    return_lse=return_lse,
+                )
+
+            try:
+                out = compile_guard.guarded(
+                    "paged_decode_split",
+                    (plan.split_units, plan.num_splits,
+                     plan.split_single_chunk, plan.split_ppc,
+                     plan.num_qo_heads, plan.num_kv_heads,
+                     plan.head_dim, plan.page_size, str(q.dtype),
+                     str(k_cache.dtype), float(sm_scale),
+                     float(plan.logits_soft_cap),
+                     int(plan.window_left), return_lse),
+                    _run_split, module=_pd_module,
+                )
+            except compile_guard.KernelQuarantined:
+                backend = "xla"
+        elif backend == "pallas":
             # autotuned pages-per-chunk (reference AutoTuner.choose_one role;
             # zero overhead outside an autotune() context — cached/default)
             from flashinfer_tpu.autotuner import AutoTuner

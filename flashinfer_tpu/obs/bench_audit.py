@@ -47,6 +47,12 @@ MEASUREMENT_FIELDS = frozenset({
     "peak", "quality", "vs_best", "vs_best_roofline",
     "flops", "bytes_read", "bytes_written", "intensity", "bound",
     "effective_pct_roofline", "chip", "dtype", "flops_effective",
+    # split-KV decode stamp: merge_bytes is derived from the cost model
+    # and pred_us from its predictor (both recalibrate-able — like
+    # slope_pred_us, never identity); num_splits is deliberately NOT
+    # here — rows at different split factors are different
+    # configurations and must not compete in the quality audit
+    "merge_bytes", "pred_us",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

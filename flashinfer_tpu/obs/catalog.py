@@ -69,6 +69,12 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "all-masked: causal chunks above the diagonal, sliding-window "
         "chunks below the window, all-zero custom-mask windows) — MXU "
         "work the pipelined kernel never sees"),
+    "plan.decode_splits": (
+        "counter", ("wrapper", "splits"),
+        "decode plan() split-KV selections by chosen partition factor "
+        "(cost-model-guided, L009-feasibility-pruned; splits=1 means "
+        "the unsplit kernel was predicted faster — a hot >1 label "
+        "means the short-context split path is live)"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),

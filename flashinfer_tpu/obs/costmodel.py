@@ -127,6 +127,199 @@ def paged_decode(batch: int, ctx: int, num_qo_heads: int,
     return dataclasses.replace(c, op="paged_decode")
 
 
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def split_chunk_pages(page_size: int, num_kv_heads: int, head_dim: int,
+                      itemsize: int = 2) -> int:
+    """Pages-per-chunk of the split-KV decode path — MUST equal
+    ``ops/paged_decode.split_pages_per_chunk`` (duplicated because this
+    module stays jax-free by import contract; equality is pinned by
+    tests/test_split_decode.py)."""
+    ppc = max(1, min(512 // page_size, 16))
+    per_page = 4 * num_kv_heads * page_size * head_dim * itemsize
+    return max(1, min(ppc, (8 << 20) // per_page))
+
+
+def decode_split_breakdown(
+    batch: int, ctx: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim: int, *, num_splits: int, page_size: int = 16,
+    pages_per_chunk: Optional[int] = None, kv_bytes: int = 2,
+    q_bytes: int = 2, out_bytes: int = 2, lse_lanes: int = 128,
+) -> Dict[str, float]:
+    """Traffic/shape breakdown of split-KV decode at one split factor —
+    the terms :func:`decode_split` sums and the bench stamp's
+    ``merge_bytes`` field.  Mirrors ``build_decode_split_units``
+    geometry exactly: per-request span ``per = ceil(pages/S)`` rounded
+    up to whole DMA chunks, so sub-chunk splits degenerate into empty
+    units (their kernel grid steps still write identity partials, which
+    the merge traffic charges).
+
+    Keys: ``kv_bytes`` (cache streamed once — splits are disjoint),
+    ``q_bytes`` (one padded-group q-block fetch per unit),
+    ``merge_bytes`` (f32 partial out+lse written by the kernel and read
+    back by merge_states; 0 at S=1), ``out_bytes`` (merged output),
+    ``units_real``/``units_total``, ``max_chunks_per_unit``,
+    ``kv_tokens_launched`` (whole-chunk walks incl. the masked tail)."""
+    S = int(num_splits)
+    ppc = pages_per_chunk if pages_per_chunk else split_chunk_pages(
+        page_size, num_kv_heads, head_dim, kv_bytes)
+    chunk_tokens = ppc * page_size
+    pages = _cdiv(max(ctx, 1), page_size)
+    per = _cdiv(_cdiv(max(pages, 1), S), ppc) * ppc
+    units_real = 0
+    max_chunks = 0
+    kv_launched = 0
+    for s in range(S):
+        start = s * per * page_size
+        uk = min(start + per * page_size, ctx) - start
+        if uk <= 0:
+            continue
+        units_real += 1
+        c = _cdiv(uk, chunk_tokens)
+        max_chunks = max(max_chunks, c)
+        kv_launched += c * chunk_tokens
+    group = num_qo_heads // max(num_kv_heads, 1)
+    gp = _cdiv(max(group, 1), 8) * 8
+    partial_elems = (float(batch) * S * num_kv_heads * gp
+                     * (head_dim + lse_lanes))
+    return {
+        "kv_bytes": float(batch) * ctx * num_kv_heads * head_dim * 2
+        * kv_bytes,
+        "q_bytes": float(batch) * units_real * num_kv_heads * gp
+        * head_dim * q_bytes,
+        "merge_bytes": 2.0 * 4.0 * partial_elems if S > 1 else 0.0,
+        "out_bytes": float(batch) * num_qo_heads * head_dim * out_bytes,
+        "units_real": units_real,
+        "units_total": S,
+        "max_chunks_per_unit": max_chunks,
+        "kv_tokens_launched": float(batch) * kv_launched,
+    }
+
+
+def decode_split(batch: int, ctx: int, num_qo_heads: int,
+                 num_kv_heads: int, head_dim: int, *, num_splits: int,
+                 page_size: int = 16,
+                 pages_per_chunk: Optional[int] = None,
+                 kv_bytes: int = 2, q_bytes: int = 2,
+                 dtype: str = "bf16") -> Cost:
+    """Split-KV paged decode: S partial passes + the merge reduction.
+    At ``num_splits=1`` this is exactly :func:`paged_decode` (no
+    partials exist).  Launched FLOPs count the whole-chunk KV walk
+    (masked tails included); effective FLOPs count the attended
+    tokens — the launched/effective gap is the split padding waste."""
+    if num_splits <= 1:
+        return dataclasses.replace(
+            paged_decode(batch, ctx, num_qo_heads, num_kv_heads,
+                         head_dim, kv_bytes=kv_bytes, q_bytes=q_bytes,
+                         dtype=dtype),
+            op="decode_split")
+    bd = decode_split_breakdown(
+        batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+        num_splits=num_splits, page_size=page_size,
+        pages_per_chunk=pages_per_chunk, kv_bytes=kv_bytes,
+        q_bytes=q_bytes)
+    per_tok = 2.0 * num_qo_heads * (head_dim + head_dim)
+    merge_elems = bd["merge_bytes"] / (2.0 * 4.0)
+    return Cost(
+        flops=bd["kv_tokens_launched"] * per_tok + 2.0 * merge_elems,
+        flops_effective=float(batch) * ctx * per_tok,
+        bytes_read=bd["kv_bytes"] + bd["q_bytes"]
+        + bd["merge_bytes"] / 2.0,
+        bytes_written=bd["merge_bytes"] / 2.0 + bd["out_bytes"],
+        dtype=dtype, op="decode_split",
+    )
+
+
+# per-grid-step fixed overhead of the split predictor's stall model
+# (DMA issue + epilogue per work unit) — a committed estimate pending
+# on-chip calibration; the DECISIONS it drives (S>1 on short-ctx/
+# large-batch, S=1 on long-ctx) are pinned by tests/test_split_decode.py
+DECODE_UNIT_OVERHEAD_S = 0.3e-6
+
+
+def predict_decode_seconds(batch: int, ctx: int, num_qo_heads: int,
+                           num_kv_heads: int, head_dim: int, *,
+                           num_splits: int, hbm_tbps: float,
+                           page_size: int = 16,
+                           pages_per_chunk: Optional[int] = None,
+                           kv_bytes: int = 2) -> float:
+    """Predicted wall time of one decode step at a candidate split
+    factor: roofline transfer time of the algorithmic traffic, plus a
+    cold-start stall term — each multi-chunk work unit (and each
+    request of the unsplit kernel) exposes one chunk's DMA before its
+    double buffer fills, while an all-single-chunk unit stream is
+    cross-unit prefetched and exposes none — plus a per-unit fixed
+    overhead.  This is the invert-the-cost-model selection rule
+    (ROADMAP item 5): the same physics ``obs perf`` attributes with,
+    used *forward* at plan time."""
+    ppc = pages_per_chunk if pages_per_chunk else split_chunk_pages(
+        page_size, num_kv_heads, head_dim, kv_bytes)
+    cost = decode_split(
+        batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+        num_splits=num_splits, page_size=page_size, pages_per_chunk=ppc,
+        kv_bytes=kv_bytes)
+    bd = decode_split_breakdown(
+        batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+        num_splits=num_splits, page_size=page_size, pages_per_chunk=ppc,
+        kv_bytes=kv_bytes)
+    bw = hbm_tbps * 1e12
+    chunk_bytes = (min(ppc * page_size, max(ctx, 1)) * num_kv_heads
+                   * head_dim * 2 * kv_bytes)
+    if num_splits <= 1:
+        exposed = batch  # one cold start per request
+        units = batch
+    elif bd["max_chunks_per_unit"] <= 1:
+        exposed = 0  # cross-unit double buffer: no cold start anywhere
+        units = batch * bd["units_total"]
+    else:
+        exposed = batch * bd["units_real"]
+        units = batch * bd["units_total"]
+    return (cost.bytes_total / bw + exposed * chunk_bytes / bw
+            + units * DECODE_UNIT_OVERHEAD_S)
+
+
+def choose_decode_splits(batch: int, ctx: int, num_qo_heads: int,
+                         num_kv_heads: int, head_dim: int, *,
+                         hbm_tbps: float, page_size: int = 16,
+                         pages_per_chunk: Optional[int] = None,
+                         kv_bytes: int = 2,
+                         candidates: Tuple[int, ...] = (1, 2, 4, 8),
+                         feasible=None) -> Tuple[int, Dict[int, dict]]:
+    """Plan-time split-factor selection: predict each candidate S with
+    :func:`predict_decode_seconds`, drop candidates ``feasible``
+    rejects (the L009 VMEM-feasibility evaluator at the decode.py call
+    site), and return ``(best_S, table)`` where ``table[S]`` carries
+    the predicted seconds / bytes / intensity evidence.  A larger S
+    must beat the incumbent by >2% predicted time — on ties (e.g. a
+    sub-chunk split degenerating to the same real partition) the
+    smaller S wins, so S=1 stays the default wherever splitting has
+    nothing to remove."""
+    best, best_t = 1, None
+    table: Dict[int, dict] = {}
+    for S in sorted(set(int(s) for s in candidates)):
+        if S < 1:
+            continue
+        if S > 1 and feasible is not None and not feasible(S):
+            continue
+        cost = decode_split(
+            batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+            num_splits=S, page_size=page_size,
+            pages_per_chunk=pages_per_chunk, kv_bytes=kv_bytes)
+        t = predict_decode_seconds(
+            batch, ctx, num_qo_heads, num_kv_heads, head_dim,
+            num_splits=S, hbm_tbps=hbm_tbps, page_size=page_size,
+            pages_per_chunk=pages_per_chunk, kv_bytes=kv_bytes)
+        table[S] = {
+            "seconds": t, "bytes": cost.bytes_total,
+            "intensity": cost.intensity,
+        }
+        if best_t is None or t < best_t * 0.98:
+            best, best_t = S, t
+    return best, table
+
+
 def mla_decode(batch: int, ctx: int, num_heads: int, *,
                latent_dim: int = 512, rope_dim: int = 64,
                lane_pad: int = 128, cache_bytes: int = 2,

@@ -102,12 +102,24 @@ def attribute(cost: Cost, seconds: float, spec: ChipSpec) -> RooflineResult:
 
 
 def stamp_row(row: Dict, cost: Cost, seconds: float,
-              spec: ChipSpec) -> Dict:
+              spec: ChipSpec, *, num_splits: Optional[int] = None,
+              merge_bytes: Optional[float] = None) -> Dict:
     """Write the canonical roofline fields onto a bench row in place.
     Every bench.py routine stamps through here — the uniform schema is
     what makes ``obs perf`` and the auditor's roofline-fraction rule
-    possible."""
+    possible.
+
+    ``num_splits``/``merge_bytes`` are the split-KV decode metadata
+    (docs/observability.md): ``num_splits`` is part of the row's
+    configuration identity (rows at different split factors never
+    compete in the quality audit); ``merge_bytes`` is the cost model's
+    partial-state traffic term (``costmodel.decode_split_breakdown``),
+    a derived measurement field."""
     res = attribute(cost, seconds, spec)
+    if num_splits is not None:
+        row["num_splits"] = int(num_splits)
+    if merge_bytes is not None:
+        row["merge_bytes"] = float(merge_bytes)
     row["flops"] = float(cost.flops)
     row["bytes_read"] = float(cost.bytes_read)
     row["bytes_written"] = float(cost.bytes_written)

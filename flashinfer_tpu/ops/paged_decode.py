@@ -10,10 +10,23 @@ TPU-native re-design of the reference batch-decode path
   loads.
 - GQA "use_tensor_cores" trick maps to MXU-shaped q packing: the q heads of
   one KV head are processed together as an [group_padded, head_dim] tile.
-- No split-KV grid balancing: a TPU core runs the grid sequentially with
-  pipelined DMA, so one kernel instance walks a request's whole KV range;
-  the reference's split-KV-then-merge machinery (needed to fill idle SMs)
-  is unnecessary.  LSE output is still available for cascade/DCP merging.
+- Split-KV work partitioning (reference ``scheduler.cuh:150,426``) exists
+  here as a *pipeline-shape* tool, not an SM-filling one: the default
+  kernel walks a request's whole KV range sequentially with pipelined DMA
+  (grid starvation doesn't exist on a TPU core), but short-context /
+  large-batch shapes pay a per-request cold-start DMA stall that the
+  split path removes.  ``build_decode_split_units`` partitions each
+  request's page list into ``num_splits`` contiguous chunk-aligned KV
+  spans at plan time (PR 3 work-unit style scalar-prefetch arrays);
+  ``_decode_split_kernel_fused_heads`` writes per-unit ``(out, lse)``
+  partials — when every unit is a single DMA chunk the unit stream is
+  cross-unit double-buffered with zero cold start anywhere — and
+  ``ops/merge.py merge_states`` reduces the partials by the
+  online-softmax merge identity.  The split factor is chosen by the
+  analytic cost model at plan time (``obs/costmodel.choose_decode_splits``;
+  ``decode.splits`` autotune knob overrides).  On-chip proof pending;
+  interpret-mode parity is pinned by tests/test_split_decode.py.  LSE
+  output remains available for cascade/DCP merging on both paths.
 
 Cache layouts: "HND" ``[num_pages, num_kv_heads, page_size, head_dim]``
 (TPU-preferred: one page+head slice is a contiguous [page_size, head_dim]
@@ -28,6 +41,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -510,4 +524,400 @@ def paged_decode_attention(
     out = out[:, :, :group, :].reshape(batch, num_qo_heads, head_dim)
     if return_lse:
         return out, lse[:, :, :group, 0].reshape(batch, num_qo_heads)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Split-KV decode: plan-time work partitioning + partial-state kernel + merge
+# (reference scheduler.cuh:150,426 split-KV-then-merge, TPU-reshaped: the
+# split removes per-request DMA cold starts instead of filling idle SMs)
+# ---------------------------------------------------------------------------
+
+
+def split_pages_per_chunk(page_size: int, num_kv_heads: int, head_dim: int,
+                          itemsize: int = 2) -> int:
+    """The ONE pages-per-chunk formula of the split path, shared by the
+    planner (unit boundaries are chunk-aligned), the kernel entry
+    (scratch shapes), and the plan-time cost model — a skew between any
+    two would misalign unit spans against the DMA loop.  Same default +
+    8 MiB double-buffer clamp as the unsplit fused-heads path."""
+    ppc = max(1, min(512 // page_size, 16))
+    per_page = 4 * num_kv_heads * page_size * head_dim * itemsize
+    return max(1, min(ppc, (8 << 20) // per_page))
+
+
+def decode_split_tactic_key(batch, max_pages, num_qo_heads, num_kv_heads,
+                            head_dim, page_size, pages_per_chunk, q_dtype):
+    """Shape key for the ``decode.splits`` knob: ``decode_tactic_key``
+    fields + the pages-per-chunk the plan was built for (the split
+    choice and the L009 VMEM proof both depend on it)."""
+    return (batch, max_pages, num_qo_heads, num_kv_heads, head_dim,
+            page_size, pages_per_chunk, str(q_dtype))
+
+
+def build_decode_split_units(
+    page_table: np.ndarray,  # [B_pad, P] int32 padded page table
+    kv_lens: np.ndarray,  # [B_pad] kv token lengths (0 for pad rows)
+    *,
+    num_splits: int,
+    page_size: int,
+    pages_per_chunk: int,
+):
+    """Host-side split planner: partition every request's page list into
+    ``num_splits`` contiguous, chunk-aligned KV spans (the decode
+    analogue of ``build_prefill_work_units``; reference ``DecodePlan``
+    split-KV work estimation, scheduler.cuh:150).
+
+    Unit ``u = b * num_splits + s`` covers request ``b``'s pages
+    ``[s * per_b, (s + 1) * per_b)`` where ``per_b = ceil(pages_b /
+    num_splits)`` rounded up to a whole number of DMA chunks — chunk
+    alignment keeps every unit's page walk a whole-chunk loop, so
+    splits below chunk granularity degenerate into empty units (kvlen
+    0) which the kernel skips without issuing DMA.  The unit order is
+    split-major within each request, so partials reshape to
+    ``[B_pad, num_splits, ...]`` for one batched ``merge_states`` call.
+
+    Returns a plan dict whose five array keys (``pages``, ``kvlen``,
+    ``wu_req``, ``wu_page0``, ``wu_kvlen``) are the scalar-prefetch
+    operands of ``_decode_split_kernel_fused_heads`` IN ORDER (the
+    L007 planner/kernel contract), plus statics (``num_units``,
+    ``num_splits``, ``single_chunk``, ``pages_per_chunk``) and a
+    ``stats`` dict (empty-unit count, launched-vs-real page traffic —
+    the padding-waste numbers the cost model charges)."""
+    pt = np.asarray(page_table)
+    lens = np.asarray(kv_lens, np.int64).reshape(-1)
+    B, P = pt.shape
+    S = int(num_splits)
+    assert S >= 1, num_splits
+    W = B * S
+    pages_r = -(-lens // page_size)  # cdiv; 0 for empty/pad rows
+    per = -(-np.maximum(pages_r, 1) // S)
+    per = -(-per // pages_per_chunk) * pages_per_chunk  # chunk-align
+    wu_req = np.repeat(np.arange(B, dtype=np.int64), S)
+    s_idx = np.tile(np.arange(S, dtype=np.int64), B)
+    per_u = np.repeat(per, S)
+    page0 = per_u * s_idx
+    start_tok = page0 * page_size
+    end_tok = np.minimum(start_tok + per_u * page_size, np.repeat(lens, S))
+    uklen = np.maximum(end_tok - start_tok, 0)
+    page0 = np.where(uklen > 0, page0, 0)  # empty units never DMA
+    chunks_u = -(-uklen // (pages_per_chunk * page_size))
+    max_chunks = int(chunks_u.max(initial=0))
+    # pad table columns so every unit's whole-chunk walk stays in bounds
+    width = max(P, int((per * (pages_r > 0)).max(initial=0)) * S,
+                pages_per_chunk)
+    if width != P:
+        pt = np.pad(pt, ((0, 0), (0, width - P)))
+    stats = {
+        "units": W,
+        "units_empty": int((uklen == 0).sum()),
+        "max_chunks_per_unit": max_chunks,
+        "pages_real": int(pages_r.sum()),
+        "pages_launched": int((chunks_u * pages_per_chunk).sum()),
+    }
+    return dict(
+        pages=pt.astype(np.int32),
+        kvlen=lens.astype(np.int32),
+        wu_req=wu_req.astype(np.int32),
+        wu_page0=page0.astype(np.int32),
+        wu_kvlen=uklen.astype(np.int32),
+        num_units=W,
+        num_splits=S,
+        single_chunk=bool(max_chunks <= 1),
+        pages_per_chunk=pages_per_chunk,
+        stats=stats,
+    )
+
+
+def _decode_split_kernel_fused_heads(
+    # scalar prefetch (the build_decode_split_units plan arrays, in order)
+    pages_ref,  # [B_pad, P_w] int32 page table (padded with valid ids)
+    kvlen_ref,  # [B_pad] int32 full per-request kv lengths
+    req_ref,  # [W] int32 request id per work unit
+    page0_ref,  # [W] int32 first page-table column of the unit's span
+    uklen_ref,  # [W] int32 kv tokens in the unit's span (0 = empty unit)
+    # inputs
+    q_ref,  # [Hkv, Gp, D] (block of [B_pad, Hkv, Gp, D], gathered by req)
+    k_hbm,  # [num_pages, Hkv, PS, D] in ANY/HBM
+    v_hbm,
+    # outputs (per-unit partial state)
+    o_ref,  # [Hkv, Gp, D] f32 — softmax-normalized partial output
+    lse_ref,  # [Hkv, Gp, 128] f32 — partial log-sum-exp (natural log)
+    # scratch
+    k_buf,  # [2, ppc, Hkv, PS, D]
+    v_buf,
+    sem,  # DMA sems [2, 2, ppc]
+    *,
+    page_size: int,
+    ppc: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    num_kv_heads: int,
+    single_chunk: bool,
+):
+    """Partial-state variant of ``_decode_kernel_fused_heads``: one grid
+    step per work unit, per-unit ``(out, lse)`` written unreduced for a
+    downstream ``merge_states`` pass.
+
+    Pipeline shape by plan certificate: with ``single_chunk`` (every
+    unit at most one DMA chunk — the shape the plan-time split chooser
+    targets) the unit stream is cross-UNIT double-buffered: each unit
+    issues its successor's chunk before waiting on its own, so no unit
+    ever exposes a cold-start DMA stall (the per-request stall the
+    unsplit kernel pays on short-context/large-batch shapes).  The
+    general path (multi-chunk units) keeps the unsplit kernel's
+    intra-unit double buffer and pays one cold start per unit."""
+    u = pl.program_id(0)
+    nu = pl.num_programs(0)
+    b = req_ref[u]
+    kv_len = kvlen_ref[b]
+    page0 = page0_ref[u]
+    uklen = uklen_ref[u]
+    chunk_tokens = ppc * page_size
+    num_chunks = pl.cdiv(uklen, chunk_tokens)
+
+    def page_dmas(uu, chunk_idx, slot):
+        dmas = []
+        for j in range(ppc):  # wedge-lint: ok ppc bounded by the shared 8 MiB VMEM clamp (split_pages_per_chunk) — same on-chip-validated bound as the unsplit fused-heads kernel
+            page = pages_ref[
+                req_ref[uu], page0_ref[uu] + chunk_idx * ppc + j
+            ]
+            dmas.append(
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_buf.at[slot, j], sem.at[slot, 0, j]
+                )
+            )
+            dmas.append(
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_buf.at[slot, j], sem.at[slot, 1, j]
+                )
+            )
+        return dmas
+
+    def start_chunk(uu, chunk_idx, slot):
+        for dma in page_dmas(uu, chunk_idx, slot):
+            dma.start()
+
+    def wait_chunk(uu, chunk_idx, slot):
+        for dma in page_dmas(uu, chunk_idx, slot):
+            dma.wait()
+
+    q = q_ref[...]  # [Hkv, Gp, D] native dtype
+    gp = q.shape[1]
+    head_dim = q.shape[2]
+
+    def chunk_update(i, carry, slot):
+        m, l, acc = carry  # [Hkv, Gp, 1] x2, [Hkv, Gp, D]
+        tok = i * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_tokens), 1
+        )
+        valid = tok < uklen
+        if window_left >= 0:
+            # window positions are GLOBAL kv positions of the full request
+            valid = valid & (
+                page0 * page_size + tok >= kv_len - 1 - window_left
+            )
+        ss, pvs = [], []
+        # wedge-lint: ok bounded by num_kv_heads (<=16 served models, 2 dots/head); same loop as the unsplit fused-heads kernel
+        for h in range(num_kv_heads):
+            kh = k_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            if kh.dtype != q.dtype:
+                # quantized (fp8/int8) KV: in-register dequant cast, the
+                # same scale-folding contract as the unsplit kernel
+                kh = kh.astype(q.dtype)
+            s = jax.lax.dot_general(
+                q[h], kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # [Gp, chunk_tokens] f32
+            if logits_soft_cap > 0.0:
+                s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+            ss.append(jnp.where(valid, s, _NEG_INF))
+        s_all = jnp.stack(ss)  # [Hkv, Gp, chunk]
+        m_cur = jnp.max(s_all, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p_all = jnp.where(valid[None], jnp.exp(s_all - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p_all, axis=-1, keepdims=True)
+        for h in range(num_kv_heads):  # wedge-lint: ok bounded by num_kv_heads; same loop as the unsplit fused-heads kernel
+            vh = v_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
+            if vh.dtype != q.dtype:
+                vh = vh.astype(q.dtype)
+            pvs.append(
+                jax.lax.dot_general(
+                    p_all[h].astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        pv = jnp.stack(pvs)  # [Hkv, Gp, D]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((num_kv_heads, gp, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, gp, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, gp, head_dim), jnp.float32)
+
+    def finalize(m, l, acc):
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+    if single_chunk:
+        # cross-unit double buffer: successor's chunk issued before this
+        # unit's wait; empty units (uklen 0) neither issue nor wait, and
+        # the issue/wait conditions are the SAME uklen test, so the
+        # semaphore chain stays balanced
+        slot = jax.lax.rem(u, 2)
+
+        @pl.when((u == 0) & (uklen > 0))
+        def _warmup():
+            start_chunk(u, 0, slot)
+
+        @pl.when((u + 1 < nu)
+                 & (uklen_ref[jnp.minimum(u + 1, nu - 1)] > 0))
+        def _prefetch_next_unit():
+            start_chunk(u + 1, 0, jax.lax.rem(u + 1, 2))
+
+        @pl.when(uklen > 0)
+        def _compute():
+            wait_chunk(u, 0, slot)
+            finalize(*chunk_update(0, (m0, l0, acc0), slot))
+
+        @pl.when(uklen <= 0)
+        def _empty():
+            finalize(m0, l0, acc0)
+    else:
+        @pl.when(num_chunks > 0)
+        def _warmup_general():
+            start_chunk(u, 0, 0)
+
+        def body(i, carry):
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < num_chunks)
+            def _prefetch():
+                start_chunk(u, i + 1, jax.lax.rem(i + 1, 2))
+
+            wait_chunk(u, i, slot)
+            return chunk_update(i, carry, slot)
+
+        m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+        finalize(m, l, acc)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_units", "num_splits", "single_chunk", "pages_per_chunk",
+        "sm_scale", "logits_soft_cap", "window_left", "return_lse",
+    ),
+)
+def paged_decode_attention_split(
+    q: jax.Array,  # [B_pad, num_qo_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, Hkv, PS, D] (HND only)
+    v_cache: jax.Array,
+    plan: dict,  # jnp arrays from build_decode_split_units
+    *,
+    num_units: int,
+    num_splits: int,
+    single_chunk: bool,
+    pages_per_chunk: int,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    return_lse: bool = False,
+):
+    """Split-KV batched paged decode over plan-time work units: per-unit
+    partial states from ``_decode_split_kernel_fused_heads`` reduced by
+    the batched ``merge_states`` operator (the online-softmax merge
+    identity, ops/merge.py) — the TPU form of the reference's
+    split-KV-then-merge decode (scheduler.cuh:426 + cascade.cuh:214).
+
+    HND caches only (the fused-heads fast path); ``plan`` statics
+    (``num_units``/``num_splits``/``single_chunk``/``pages_per_chunk``)
+    must come from the SAME ``build_decode_split_units`` call that built
+    the arrays — unit spans are chunk-aligned to that pages_per_chunk.
+    Partials are f32 and the merge runs in f32, so the result matches
+    the unsplit kernel to accumulation rounding (pinned by
+    tests/test_split_decode.py)."""
+    from flashinfer_tpu.ops.merge import merge_states
+
+    batch, num_qo_heads, head_dim = q.shape
+    _num_pages, num_kv_heads, page_size, _ = k_cache.shape
+    assert num_qo_heads % num_kv_heads == 0
+    group = num_qo_heads // num_kv_heads
+    gp = round_up(group, 8)
+    assert num_units == num_splits * batch, (num_units, num_splits, batch)
+
+    qg = q.reshape(batch, num_kv_heads, group, head_dim)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+
+    kernel = functools.partial(
+        _decode_split_kernel_fused_heads,
+        page_size=page_size,
+        ppc=pages_per_chunk,
+        sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap,
+        window_left=window_left,
+        num_kv_heads=num_kv_heads,
+        single_chunk=single_chunk,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(num_units,),
+        in_specs=[
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, head_dim),
+                lambda u, pages, kvlen, req, *_: (req[u], 0, 0, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, head_dim),
+                lambda u, *_: (u, 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, 128), lambda u, *_: (u, 0, 0, 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                k_cache.dtype,
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                v_cache.dtype,
+            ),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+        ],
+    )
+    o_part, lse_part = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (num_units, num_kv_heads, gp, head_dim), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (num_units, num_kv_heads, gp, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(
+        plan["pages"], plan["kvlen"], plan["wu_req"], plan["wu_page0"],
+        plan["wu_kvlen"], qg, k_cache, v_cache,
+    )
+
+    # batched merge reduction: [B, S, Hkv*Gp, ...] partials -> one state
+    v_p = o_part.reshape(batch, num_splits, num_kv_heads * gp, head_dim)
+    s_p = lse_part[..., 0].reshape(batch, num_splits, num_kv_heads * gp)
+    merged_v, merged_s = merge_states(v_p, s_p)
+    out = merged_v.reshape(batch, num_kv_heads, gp, head_dim)
+    out = out[:, :, :group, :].reshape(
+        batch, num_qo_heads, head_dim).astype(q.dtype)
+    if return_lse:
+        lse = merged_s.reshape(batch, num_kv_heads, gp)[:, :, :group]
+        return out, lse.reshape(batch, num_qo_heads)
     return out

@@ -1,0 +1,411 @@
+"""Split-KV decode: interpret-mode parity + plan/selection contracts.
+
+ISSUE 6 acceptance suite for the split-KV paged-decode path
+(``ops/paged_decode.py`` ``build_decode_split_units`` /
+``_decode_split_kernel_fused_heads`` / ``paged_decode_attention_split``):
+
+- split-vs-unsplit parity across S in {1, 2, 4, 8} x {GQA,
+  quantized-KV (int8 + fp8), ragged page counts, single-page requests}
+  — both kernel-level and through the wrapper plan/run lifecycle;
+- the online-softmax merge identity pinned against ``merge_states``
+  (partial states computed by the UNSPLIT kernel over disjoint KV
+  spans must merge to the full answer — the algebra the split kernel's
+  reduction stands on);
+- plan-time selection: ``choose_decode_splits`` picks S>1 for the
+  bs=256/ctx=512-class cliff shapes and S=1 for long-context shapes
+  (the cost-model pin the acceptance criteria name), the L009
+  VMEM-feasibility evaluator prices the split launch, and the
+  ``plan.decode_splits`` obs counter records every selection;
+- the cost model's chunk formula never skews from the kernel's (the
+  two are deliberately duplicated across the jax-free import
+  boundary).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.obs import costmodel
+from flashinfer_tpu.ops.merge import merge_states
+from flashinfer_tpu.ops.paged_decode import (
+    build_decode_split_units,
+    decode_split_tactic_key,
+    paged_decode_attention,
+    paged_decode_attention_split,
+    split_pages_per_chunk,
+)
+
+SPLITS = (1, 2, 4, 8)
+
+
+def _paged_inputs(kv_lens, HKV, D, PS, cache_dtype=jnp.bfloat16, seed=0):
+    """Padded rectangular page table + HND caches for ragged kv_lens,
+    pages permuted so split spans never alias contiguous memory."""
+    kv_lens = np.asarray(kv_lens, np.int64)
+    B = len(kv_lens)
+    pages_r = -(-kv_lens // PS)
+    P = max(int(pages_r.max(initial=1)), 1)
+    npages = int(pages_r.sum()) + 1
+    key = jax.random.PRNGKey(seed)
+    kc = jax.random.normal(
+        key, (npages, HKV, PS, D), jnp.float32).astype(cache_dtype)
+    vc = jax.random.normal(
+        jax.random.fold_in(key, 1),
+        (npages, HKV, PS, D), jnp.float32).astype(cache_dtype)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(np.arange(1, npages)).astype(np.int32)
+    pt = np.zeros((B, P), np.int32)
+    nxt = 0
+    for b in range(B):
+        for j in range(int(pages_r[b])):
+            pt[b, j] = perm[nxt]
+            nxt += 1
+    return pt, kv_lens, kc, vc
+
+
+def _run_split(q, kc, vc, pt, kv_lens, S, **kw):
+    ppc = split_pages_per_chunk(
+        kc.shape[2], kc.shape[1], kc.shape[3],
+        np.dtype(kc.dtype).itemsize)
+    plan = build_decode_split_units(
+        pt, kv_lens, num_splits=S, page_size=kc.shape[2],
+        pages_per_chunk=ppc)
+    statics = dict(
+        num_units=plan.pop("num_units"),
+        num_splits=plan.pop("num_splits"),
+        single_chunk=plan.pop("single_chunk"),
+        pages_per_chunk=plan.pop("pages_per_chunk"),
+    )
+    stats = plan.pop("stats")
+    plan = {k: jnp.asarray(v) for k, v in plan.items()}
+    out = paged_decode_attention_split(q, kc, vc, plan, **statics, **kw)
+    return out, stats
+
+
+CASES = {
+    # name: (kv_lens, HQ, HKV, D, cache dtype)
+    "gqa": ([512, 480, 129, 512], 8, 2, 64, jnp.bfloat16),
+    "quant_int8": ([512, 480, 129, 512], 8, 2, 64, jnp.int8),
+    "quant_fp8": ([512, 480, 129, 512], 8, 2, 64, jnp.float8_e4m3fn),
+    "ragged": ([513, 17, 256, 300], 4, 4, 64, jnp.bfloat16),
+    "single_page": ([16, 512, 1, 7], 8, 2, 64, jnp.bfloat16),
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+@pytest.mark.parametrize("S", SPLITS)
+def test_split_vs_unsplit_kernel_parity(case, S):
+    """The tentpole pin: the partial-state kernel + merge_states
+    reduction matches the unsplit fused-heads kernel for every split
+    factor, including quantized caches, ragged page lists, and
+    single-page requests (empty-unit handling)."""
+    kv_lens, HQ, HKV, D, cdt = CASES[case]
+    PS = 16
+    pt, lens, kc, vc = _paged_inputs(kv_lens, HKV, D, PS, cdt)
+    q = jax.random.normal(
+        jax.random.PRNGKey(7), (len(kv_lens), HQ, D), jnp.bfloat16)
+    sm = D ** -0.5
+    ref, ref_lse = paged_decode_attention(
+        q, kc, vc, jnp.asarray(pt), jnp.asarray(lens.astype(np.int32)),
+        sm_scale=sm, kv_layout="HND", return_lse=True)
+    (out, lse), _stats = _run_split(
+        q, kc, vc, pt, lens, S, sm_scale=sm, return_lse=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.02, rtol=0.02)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), atol=1e-2, rtol=1e-3)
+
+
+@pytest.mark.quick
+def test_split_kernel_quick():
+    """Quick-tier representative of the split kernel surface: both
+    pipeline variants (single-chunk cross-unit prefetch via S=4, the
+    general multi-chunk path via S=2 over a long request) against the
+    unsplit kernel."""
+    PS = 16
+    pt, lens, kc, vc = _paged_inputs([1024, 33, 512], 2, 64, PS)
+    q = jax.random.normal(jax.random.PRNGKey(3), (3, 8, 64), jnp.bfloat16)
+    sm = 0.125
+    ref = paged_decode_attention(
+        q, kc, vc, jnp.asarray(pt), jnp.asarray(lens.astype(np.int32)),
+        sm_scale=sm, kv_layout="HND")
+    for S, want_single in ((4, True), (2, False)):
+        (out), stats = _run_split(q, kc, vc, pt, lens, S, sm_scale=sm)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=0.02, rtol=0.02)
+        assert (stats["max_chunks_per_unit"] <= 1) == want_single
+
+
+@pytest.mark.parametrize("S", (2, 4))
+def test_wrapper_split_parity(S):
+    """plan(num_splits=S)/run matches the unsplit wrapper bit-for-
+    tolerance through the full lifecycle (padded batch buckets, scale
+    folding, LSE return)."""
+    PS = 16
+    kv_lens = [512, 480, 129, 512, 77]
+    B, HQ, HKV, D = len(kv_lens), 8, 2, 64
+    pages_r = np.array([-(-l // PS) for l in kv_lens])
+    indptr = np.concatenate([[0], np.cumsum(pages_r)]).astype(np.int32)
+    npages = int(pages_r.sum())
+    indices = np.random.default_rng(0).permutation(npages).astype(np.int32)
+    last = np.array([(l - 1) % PS + 1 for l in kv_lens], np.int32)
+    key = jax.random.PRNGKey(0)
+    kc = jax.random.normal(key, (npages, HKV, PS, D), jnp.bfloat16)
+    vc = jax.random.normal(
+        jax.random.fold_in(key, 1), (npages, HKV, PS, D), jnp.bfloat16)
+    q = jax.random.normal(
+        jax.random.fold_in(key, 2), (B, HQ, D), jnp.bfloat16)
+
+    def run(s):
+        w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+        w.plan(indptr, indices, last, HQ, HKV, D, PS, num_splits=s)
+        return w, w.run_return_lse(q, (kc, vc), v_scale=0.5)
+
+    w1, (ref, ref_lse) = run(1)
+    ws, (out, lse) = run(S)
+    assert w1._plan.num_splits == 1
+    assert ws._plan.num_splits == S
+    assert ws._plan.split_units == ws._plan.page_table.shape[0] * S
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.02, rtol=0.02)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), atol=1e-2, rtol=1e-3)
+
+
+def test_merge_identity_pinned_against_merge_states():
+    """The algebra the split path stands on: UNSPLIT kernel partials
+    over disjoint KV spans, merged by ``merge_states``, equal the
+    full-range answer (reference recursive_attention.rst identity;
+    cascade.cuh:214 MergeStates)."""
+    PS, B, HQ, HKV, D = 16, 2, 8, 2, 64
+    ctx = 512
+    pt, lens, kc, vc = _paged_inputs([ctx] * B, HKV, D, PS)
+    q = jax.random.normal(jax.random.PRNGKey(5), (B, HQ, D), jnp.bfloat16)
+    sm = D ** -0.5
+    full, full_lse = paged_decode_attention(
+        q, kc, vc, jnp.asarray(pt), jnp.asarray(lens.astype(np.int32)),
+        sm_scale=sm, kv_layout="HND", return_lse=True)
+    # two disjoint half-spans computed by the same unsplit kernel
+    half_pages = (ctx // PS) // 2
+    parts = []
+    for lo, hi in ((0, half_pages), (half_pages, ctx // PS)):
+        sub_pt = pt[:, lo:hi]
+        sub_lens = np.full((B,), (hi - lo) * PS, np.int32)
+        v, s = paged_decode_attention(
+            q, kc, vc, jnp.asarray(sub_pt), jnp.asarray(sub_lens),
+            sm_scale=sm, kv_layout="HND", return_lse=True)
+        parts.append((v, s))
+    v_st = jnp.stack([p[0] for p in parts], axis=1)  # [B, 2, HQ, D]
+    s_st = jnp.stack([p[1] for p in parts], axis=1)  # [B, 2, HQ]
+    merged_v, merged_s = merge_states(v_st, s_st)
+    np.testing.assert_allclose(
+        np.asarray(merged_v, np.float32), np.asarray(full, np.float32),
+        atol=0.02, rtol=0.02)
+    np.testing.assert_allclose(
+        np.asarray(merged_s), np.asarray(full_lse), atol=1e-2, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# plan-time selection: the cost-model pins the acceptance criteria name
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_choose_decode_splits_short_vs_long_context():
+    """S>1 for bs=256/ctx=512-class shapes (the VERDICT cliff cells),
+    S=1 for long-context shapes — the plan-time inversion of the cost
+    model, at the v5e roofline the seeds were derived at."""
+    bw = 0.819
+    for bs, ctx in ((256, 512), (64, 512), (256, 256)):
+        best, table = costmodel.choose_decode_splits(
+            bs, ctx, 32, 8, 128, hbm_tbps=bw)
+        assert best > 1, (bs, ctx, table)
+    for bs, ctx in ((64, 4096), (64, 8192), (1, 8192), (16, 2048)):
+        best, table = costmodel.choose_decode_splits(
+            bs, ctx, 32, 8, 128, hbm_tbps=bw)
+        assert best == 1, (bs, ctx, table)
+    # the chooser honors the feasibility pruner: rejecting every S>1
+    # forces the unsplit path even on cliff shapes
+    best, _ = costmodel.choose_decode_splits(
+        256, 512, 32, 8, 128, hbm_tbps=bw, feasible=lambda s: False)
+    assert best == 1
+
+
+def test_decode_split_cost_model_terms():
+    """decode_split cost: S=1 degenerates to paged_decode exactly; S>1
+    adds the f32 partial-state merge traffic on both sides of the
+    HBM bus and counts launched (chunk-padded) vs effective FLOPs."""
+    base = costmodel.paged_decode(64, 512, 32, 8, 128)
+    s1 = costmodel.decode_split(64, 512, 32, 8, 128, num_splits=1)
+    assert s1.flops == base.flops
+    assert s1.bytes_total == base.bytes_total
+    assert s1.op == "decode_split"
+
+    s2 = costmodel.decode_split(64, 512, 32, 8, 128, num_splits=2)
+    bd = costmodel.decode_split_breakdown(
+        64, 512, 32, 8, 128, num_splits=2)
+    assert bd["merge_bytes"] > 0
+    # partial out+lse written once, read back once by the merge
+    assert s2.bytes_written == pytest.approx(
+        bd["merge_bytes"] / 2 + bd["out_bytes"])
+    assert s2.bytes_read == pytest.approx(
+        bd["kv_bytes"] + bd["q_bytes"] + bd["merge_bytes"] / 2)
+    assert s2.effective_flops == pytest.approx(
+        costmodel.attention(1, 512, 32, 8, 128, batch=64).flops)
+    # sub-chunk split degenerates: same real partition as S=2, more
+    # empty-unit merge traffic — the chooser's tie rule prefers S=2
+    bd8 = costmodel.decode_split_breakdown(
+        64, 512, 32, 8, 128, num_splits=8)
+    assert bd8["units_real"] == bd["units_real"] == 2
+    assert bd8["merge_bytes"] > bd["merge_bytes"]
+
+
+def test_split_chunk_pages_matches_kernel_formula():
+    """The jax-free cost-model copy of the chunk formula must never
+    skew from the kernel's (plan geometry and cost geometry are the
+    same physical walk)."""
+    for ps in (4, 8, 16, 32):
+        for hkv in (1, 2, 8, 16):
+            for d in (64, 128, 256):
+                for itemsize in (1, 2, 4):
+                    assert costmodel.split_chunk_pages(
+                        ps, hkv, d, itemsize) == split_pages_per_chunk(
+                        ps, hkv, d, itemsize), (ps, hkv, d, itemsize)
+
+
+def test_planner_geometry_and_contract_keys():
+    """build_decode_split_units: chunk-aligned spans, split-major unit
+    order, empty-unit accounting, the single-chunk certificate, and
+    exactly the five scalar-prefetch plan keys the kernel launch
+    consumes (the L007 planner/kernel contract)."""
+    PS, ppc = 16, 4
+    pt = np.arange(24, dtype=np.int32).reshape(3, 8)
+    lens = np.array([128, 36, 0])
+    plan = build_decode_split_units(
+        pt, lens, num_splits=2, page_size=PS, pages_per_chunk=ppc)
+    assert plan["num_units"] == 6 and plan["num_splits"] == 2
+    # request 0: 8 pages -> per=4 -> two real units of 64 tokens
+    assert list(plan["wu_page0"][:2]) == [0, 4]
+    assert list(plan["wu_kvlen"][:2]) == [64, 64]
+    # request 1: 3 pages -> per=ceil(2/ppc)*ppc=4 -> unit 1 empty
+    assert list(plan["wu_kvlen"][2:4]) == [36, 0]
+    # request 2 (pad row): both units empty, page0 forced to 0
+    assert list(plan["wu_kvlen"][4:]) == [0, 0]
+    assert list(plan["wu_page0"][4:]) == [0, 0]
+    assert plan["single_chunk"] is True
+    assert plan["stats"]["units_empty"] == 3
+    launch_keys = ("pages", "kvlen", "wu_req", "wu_page0", "wu_kvlen")
+    assert all(k in plan for k in launch_keys)
+
+    # a span wider than one chunk flips the certificate off
+    plan2 = build_decode_split_units(
+        pt, lens, num_splits=1, page_size=PS, pages_per_chunk=ppc)
+    assert plan2["single_chunk"] is False
+    assert plan2["stats"]["max_chunks_per_unit"] == 2
+
+
+def test_l009_evaluator_prices_the_split_launch():
+    """The decode.splits knob launch binding resolves against the real
+    kernel source and prices the double-buffered chunk scratch — the
+    feasibility gate plan-time selection composes with."""
+    from flashinfer_tpu.analysis.core import Project
+    from flashinfer_tpu.analysis.vmem_budget import (KNOB_LAUNCHES,
+                                                     _estimate)
+    from flashinfer_tpu.ops import paged_decode as pd
+
+    project = Project.from_paths([os.path.dirname(pd.__file__)])
+    key = decode_split_tactic_key(256, 32, 32, 8, 128, 16, 16,
+                                  "bfloat16")
+    est = _estimate(project, KNOB_LAUNCHES["decode.splits"], 2,
+                    [str(f) for f in key])
+    assert est is not None
+    total, _budget, launcher = est
+    # k+v scratch: 2 bufs x 2 slots x ppc=16 x Hkv=8 x PS=16 x D=128 at
+    # the 1-byte lower-bound itemsize = 1 MiB, plus the double-buffered
+    # q/out/lse blocks at the key's declared bf16 — a real, bounded price
+    assert 1_000_000 < total < 4_000_000, total
+    assert launcher.name == "paged_decode_attention_split"
+
+    from flashinfer_tpu.decode import _split_vmem_feasible
+    assert _split_vmem_feasible(2, key) is True
+
+
+def test_plan_rejects_unhonorable_explicit_splits():
+    """An explicit num_splits>1 on a non-eligible plan (NHD layout /
+    dense pos-encoding routes) raises instead of silently running the
+    unsplit path."""
+    PS, B = 16, 2
+    indptr = np.arange(B + 1, dtype=np.int32) * 4
+    indices = np.arange(B * 4, dtype=np.int32)
+    last = np.full((B,), PS, np.int32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD")
+    with pytest.raises(ValueError, match="num_splits"):
+        w.plan(indptr, indices, last, 8, 2, 64, PS, num_splits=2)
+    w2 = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    with pytest.raises(ValueError, match="num_splits"):
+        w2.plan(indptr, indices, last, 8, 2, 64, PS,
+                pos_encoding_mode="ALIBI", num_splits=2)
+    # NHD + explicit 1 (or None) stays fine
+    w.plan(indptr, indices, last, 8, 2, 64, PS, num_splits=1)
+    assert w._plan.num_splits == 1
+
+
+def test_plan_decode_splits_counter(monkeypatch):
+    """Every HND decode plan records its split selection in the
+    plan.decode_splits counter (wrapper + splits labels)."""
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    from flashinfer_tpu import obs
+
+    obs.reset()
+    PS, B, HQ, HKV, D = 16, 2, 8, 2, 64
+    ppr = 4
+    indptr = np.arange(B + 1, dtype=np.int32) * ppr
+    indices = np.arange(B * ppr, dtype=np.int32)
+    last = np.full((B,), PS, np.int32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(indptr, indices, last, HQ, HKV, D, PS, num_splits=2)
+    w.plan(indptr, indices, last, HQ, HKV, D, PS, num_splits=1)
+    snap = obs.snapshot()
+    c = snap["counters"]["plan.decode_splits"]
+    key = "{splits=%s,wrapper=BatchDecodeWithPagedKVCacheWrapper}"
+    assert c[key % 2] == 1
+    assert c[key % 1] == 1
+
+
+@pytest.mark.quick
+def test_stamp_row_split_metadata_and_audit():
+    """stamp_row carries the split metadata; the quality auditor treats
+    merge_bytes as a derived measurement (never identity) while
+    num_splits keeps rows at different factors from competing."""
+    from flashinfer_tpu.obs import bench_audit, hwspec, roofline
+
+    cost = costmodel.decode_split(256, 512, 32, 8, 128, num_splits=2)
+    bd = costmodel.decode_split_breakdown(256, 512, 32, 8, 128,
+                                          num_splits=2)
+    row = roofline.stamp_row(
+        dict(phase="decode_splits", bs=256, ctx=512, us=900.0,
+             tbps=0.66),
+        cost, 900e-6, hwspec.spec("v5e"),
+        num_splits=2, merge_bytes=bd["merge_bytes"])
+    assert row["num_splits"] == 2
+    assert row["merge_bytes"] == bd["merge_bytes"]
+    assert 0 < row["pct_roofline"] <= 1.05
+    assert "merge_bytes" in bench_audit.MEASUREMENT_FIELDS
+    assert "num_splits" not in bench_audit.MEASUREMENT_FIELDS
+    auditor = bench_audit.RowAuditor([row])
+    s2 = auditor.stamp(dict(row, us=1000.0, tbps=0.6))
+    assert s2["quality"] == "ok"
+    # a different split factor is a different configuration: its row
+    # never competes with the S=2 history
+    s8 = auditor.stamp(dict(row, num_splits=8, tbps=0.1))
+    assert s8["quality"] == "ok"
+    # stamped rows are self-describing for obs perf
+    rec = costmodel.cost_from_stamped_row(row)
+    assert rec is not None and rec[0].flops == cost.flops
