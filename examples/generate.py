@@ -28,6 +28,13 @@ import jax
 # default_backend() probe would initialize the TPU plugin first)
 if "cpu" in sys.argv or not os.environ.get("EXAMPLE_USE_TPU"):
     jax.config.update("jax_platforms", "cpu")
+    # opt OUT of any inherited persistent XLA cache: this example's
+    # fused-vs-per-op parity assert compares two programs bit-for-bit,
+    # and this host's LLVM has a documented cache flake class
+    # (tests/conftest.py) where a cached executable's numerics differ
+    # from a fresh compile of the same key — everything here compiles
+    # in seconds, so fresh-compile determinism wins
+    jax.config.update("jax_enable_compilation_cache", False)
 
 import jax.numpy as jnp
 
@@ -38,9 +45,17 @@ from flashinfer_tpu.logits_processor import (
 from flashinfer_tpu.models import LlamaConfig, init_llama_params, llama_decode_step
 
 
-def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False):
+def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
+             fused_step=False):
     """Serving loop; ``int8_weights=True`` runs every projection on the
-    int8 MXU path (quantize_llama_weights) — the quantized serving mode."""
+    int8 MXU path (quantize_llama_weights) — the quantized serving mode.
+
+    ``fused_step=True`` ADDITIONALLY routes the decode loop through the
+    compile-once donated-buffer serving step (flashinfer_tpu.serve):
+    one jitted XLA program per token instead of a Python loop over ops,
+    with a token-for-token parity assert against the per-op loop — the
+    fused step must be a pure dispatch-structure change, never a
+    numerics change."""
     cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
     params = init_llama_params(jax.random.PRNGKey(seed), cfg)
     if int8_weights:
@@ -142,7 +157,54 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False):
     kv_lens = jnp.asarray(seq_lens)
     out_tokens = [[] for _ in range(B)]
 
-    # ---- decode loop with sampling pipeline
+    # ---- fused decode loop (serve/step.py): plan ONCE outside the
+    # loop — all statics (shapes, page geometry, sampling config,
+    # backend) freeze here, so the loop below is pure replay of one
+    # donated-buffer XLA program (the per-op loop's per-step op
+    # re-dispatch is hoisted into this single plan)
+    fused_out = None
+    if fused_step:
+        from flashinfer_tpu.serve import SamplingConfig, ServingStep
+
+        sstep = ServingStep()
+        sstep.plan(
+            cfg, page_table=page_table, kv_lens=kv_lens,
+            kv_dtype=caches[0][0].dtype,
+            sampling=SamplingConfig(temperature=0.8, top_k=40,
+                                    top_p=0.95),
+            use_pallas=use_pallas,
+        )
+        # the step DONATES page_table/kv_lens: keep host copies so the
+        # per-op parity loop below can rebuild its own starting state
+        pt_host = np.asarray(page_table)
+        lens_host = np.asarray(kv_lens)
+        state = sstep.make_state(caches, page_table, kv_lens, logits,
+                                 jax.random.PRNGKey(seed + 1))
+        fused_out = [[] for _ in range(B)]
+        for _ in range(max_new_tokens):
+            tokens, state = sstep.run(params, state)
+            for b in range(B):
+                fused_out[b].append(int(tokens[b]))
+        assert sstep.num_traces == 1, (
+            f"fused step traced {sstep.num_traces}x across "
+            f"{max_new_tokens} tokens — the compile-once contract broke")
+        # the donated post-prefill state was consumed by the fused
+        # loop; its FINAL caches are a valid restart state for the
+        # parity loop below (slots past each request's kv_len are
+        # masked by the attention, and the loop re-appends every
+        # position it reads), and page_table/kv_lens rebuild from the
+        # host copies
+        caches = list(state[1])
+        page_table = jnp.asarray(pt_host)
+        kv_lens = jnp.asarray(lens_host)
+
+    # ---- per-op decode loop with sampling pipeline.  The jitted step
+    # is hoisted OUT of the loop (one trace, then replay): re-entering
+    # llama_decode_step eagerly re-dispatched every op per token.
+    step_fn = jax.jit(
+        functools.partial(llama_decode_step, use_pallas=use_pallas),
+        static_argnums=(1,),  # cfg: frozen hashable dataclass
+    )
     pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
     key = jax.random.PRNGKey(seed + 1)
     for step in range(max_new_tokens):
@@ -150,11 +212,16 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False):
         tokens = pipe(logits, key=sk, temperature=0.8, top_k=40, top_p=0.95)
         for b in range(B):
             out_tokens[b].append(int(tokens[b]))
-        logits, caches = llama_decode_step(
+        logits, caches = step_fn(
             params, cfg, tokens, kv_lens, caches, page_table, kv_lens,
-            use_pallas=use_pallas,
         )
         kv_lens = kv_lens + 1
+    if fused_out is not None:
+        assert fused_out == out_tokens, (
+            f"fused-step tokens {fused_out} != per-op loop "
+            f"{out_tokens} — the fused step changed numerics")
+        print("# fused-step parity: "
+              f"{max_new_tokens} tokens/request identical, 1 trace")
     return out_tokens
 
 
@@ -239,14 +306,17 @@ def generate_stepwise(model: str, prompt_lens, max_new_tokens=8, seed=0):
 
 if __name__ == "__main__":
     int8 = "int8" in sys.argv
+    fused = "--fused-step" in sys.argv
     model = next((a for a in sys.argv[1:] if a in ("mixtral", "deepseek")),
                  None)
     if model:
         outs = generate_stepwise(model, [5, 9], max_new_tokens=6)
         label = model
     else:
-        outs = generate([5, 9], max_new_tokens=6, int8_weights=int8)
-        label = "llama" + (" int8 weights" if int8 else "")
+        outs = generate([5, 9], max_new_tokens=6, int8_weights=int8,
+                        fused_step=fused)
+        label = "llama" + (" int8 weights" if int8 else "") + \
+            (" fused-step" if fused else "")
     for b, toks in enumerate(outs):
         print(f"request {b}: generated {toks}")
     print(f"generate.py ok ({label})")

@@ -115,6 +115,12 @@ register_knob("moe_gmm.tiles", arity=3,
 register_knob("mla_decode.layout", kind="str",
               choices=("split", "packed"),
               description="MLA decode scratch layout")
+register_knob("serve.mixed_chunk",
+              description="chunked-prefill chunk size (tokens per "
+                          "prefilling request per mixed serving step) "
+                          "— serve.step.mixed_chunk_tokens; larger "
+                          "amortizes the step launch, smaller bounds "
+                          "decode-latency interference")
 
 
 def validate_tactic(op_name: str, value) -> Optional[str]:

@@ -53,6 +53,12 @@ MEASUREMENT_FIELDS = frozenset({
     # here — rows at different split factors are different
     # configurations and must not compete in the quality audit
     "merge_bytes", "pred_us",
+    # serving_fused A/B: the per-step host-dispatch residual (us_step
+    # minus the shared slope floor) — derived, never identity.
+    # step_mode (fused | per_op) is deliberately NOT here: the two
+    # dispatch structures are different configurations with separate
+    # banked histories, the num_splits precedent
+    "dispatch_residual_us",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

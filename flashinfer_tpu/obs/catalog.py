@@ -75,6 +75,14 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "(cost-model-guided, L009-feasibility-pruned; splits=1 means "
         "the unsplit kernel was predicted faster — a hot >1 label "
         "means the short-context split path is live)"),
+    # -- compile-once serving step (serve/step.py) ------------------------
+    "serve.step_retraces": (
+        "counter", ("wrapper",),
+        "fused serving-step traces beyond the first under a live plan "
+        "(ServingStep / MixedServingStep) — the compile-once contract "
+        "says this stays at ZERO: a non-zero count means the donated "
+        "state's pytree structure, a shape, or a dtype moved between "
+        "steps and every step is paying a retrace"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),
@@ -132,4 +140,6 @@ API_OPS = frozenset({
     "sampling_from_probs", "sampling_from_logits",
     "top_p_sampling_from_probs", "top_k_sampling_from_probs",
     "min_p_sampling_from_probs", "top_k_top_p_sampling_from_probs",
+    # serve/step.py (the compile-once fused serving steps)
+    "serve.step", "serve.mixed_step",
 })

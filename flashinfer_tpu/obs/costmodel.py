@@ -633,6 +633,11 @@ API_OP_COSTS: Dict[str, str] = {
     "top_k_sampling_from_probs": "sampling",
     "min_p_sampling_from_probs": "sampling",
     "top_k_top_p_sampling_from_probs": "sampling",
+    # the fused serving steps: whole-step cost is the phase-sum model
+    # (serving_step = norm_rope + attention + kv_append + moe_or_mlp +
+    # lm_head + sampling — the fused step EXCLUDES nothing)
+    "serve.step": "serving_step",
+    "serve.mixed_step": "serving_step",
 }
 
 

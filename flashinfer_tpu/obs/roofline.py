@@ -103,7 +103,8 @@ def attribute(cost: Cost, seconds: float, spec: ChipSpec) -> RooflineResult:
 
 def stamp_row(row: Dict, cost: Cost, seconds: float,
               spec: ChipSpec, *, num_splits: Optional[int] = None,
-              merge_bytes: Optional[float] = None) -> Dict:
+              merge_bytes: Optional[float] = None,
+              step_mode: Optional[str] = None) -> Dict:
     """Write the canonical roofline fields onto a bench row in place.
     Every bench.py routine stamps through here — the uniform schema is
     what makes ``obs perf`` and the auditor's roofline-fraction rule
@@ -114,12 +115,20 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
     configuration identity (rows at different split factors never
     compete in the quality audit); ``merge_bytes`` is the cost model's
     partial-state traffic term (``costmodel.decode_split_breakdown``),
-    a derived measurement field."""
+    a derived measurement field.
+
+    ``step_mode`` is the serving-loop dispatch-structure identity
+    (``"fused"`` — the compile-once donated serve/step.py program —
+    vs ``"per_op"``, the per-phase jitted micro-loop): like
+    num_splits it is CONFIGURATION, so the two serving-loop shapes
+    keep separate audit histories even at identical model shapes."""
     res = attribute(cost, seconds, spec)
     if num_splits is not None:
         row["num_splits"] = int(num_splits)
     if merge_bytes is not None:
         row["merge_bytes"] = float(merge_bytes)
+    if step_mode is not None:
+        row["step_mode"] = str(step_mode)
     row["flops"] = float(cost.flops)
     row["bytes_read"] = float(cost.bytes_read)
     row["bytes_written"] = float(cost.bytes_written)
@@ -184,7 +193,8 @@ def timeline_phase_mfu(events: Iterable[Mapping],
 def _row_group(row: Mapping) -> str:
     """Stable per-op grouping key for the efficiency table."""
     parts = [str(row.get("phase"))]
-    for f in ("kind", "op", "variant", "backend", "mode", "layout"):
+    for f in ("kind", "op", "variant", "backend", "mode", "layout",
+              "step_mode"):
         if row.get(f) is not None:
             parts.append(f"{row[f]}")
     return "/".join(parts)

@@ -893,6 +893,56 @@ class BatchPrefillWithPagedKVCacheWrapper:
             return None
         return dict(self._fused_stats)
 
+    @property
+    def plan_arrays(self) -> dict:
+        """Export the frozen gather-path plan arrays + statics for
+        closure into a compile-once mixed serving step
+        (``flashinfer_tpu.serve.step.MixedServingStep``): the flattened
+        token axes (``q_seg``/``q_pos``/``kv_seg``/``kv_pos``), the
+        flat paged-cache gather rows, the padded extents, and the
+        attention statics.  The light fused-path plan defers these
+        arrays; exporting materializes the gather plan once (same
+        contract as a ``return_lse`` fallback run), preserving any live
+        sm_scale / soft-cap rebind."""
+        if self._plan is None:
+            raise RuntimeError("plan() must be called before plan_arrays")
+        plan = self._materialize_gather_plan()
+        return dict(
+            q_seg=plan.q_seg, q_pos=plan.q_pos,
+            kv_seg=plan.kv_seg, kv_pos=plan.kv_pos,
+            kv_gather_rows=plan.kv_gather_rows,
+            total_q=plan.total_q, total_kv=plan.total_kv,
+            tq_pad=plan.tq_pad, tkv_pad=plan.tkv_pad,
+            batch_size=plan.batch_size,
+            num_qo_heads=plan.num_qo_heads,
+            num_kv_heads=plan.num_kv_heads,
+            head_dim=plan.head_dim, page_size=plan.page_size,
+            causal=plan.causal, sm_scale=plan.sm_scale,
+            logits_soft_cap=plan.logits_soft_cap,
+            window_left=plan.window_left,
+            kv_layout=self._kv_layout,
+        )
+
+    def _materialize_gather_plan(self) -> "_PrefillPlan":
+        """Materialize the deferred gather plan if the light fused-path
+        plan is live (the builder recomputes PLANNED values, so any
+        live sm_scale / logits_soft_cap rebind is carried over) — the
+        ONE copy of this logic, shared by run()'s return_lse fallback
+        and the ``plan_arrays`` export.  Returns the (possibly new)
+        live plan."""
+        plan = self._plan
+        if plan.kv_gather_rows is None:
+            new_plan = self._gather_plan_builder()
+            if new_plan.sm_scale != plan.sm_scale \
+                    or new_plan.logits_soft_cap != plan.logits_soft_cap:
+                import dataclasses
+
+                new_plan = dataclasses.replace(
+                    new_plan, sm_scale=plan.sm_scale,
+                    logits_soft_cap=plan.logits_soft_cap)
+            plan = self._plan = new_plan
+        return plan
+
     def _rebind_sm_scale(self, *, absolute=None, multiplier=None):
         """Per-call sm_scale override: swap in a plan with the new scale
         and return the plan to restore in the caller's ``finally`` (or
@@ -1079,18 +1129,9 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 pass  # fall through to the gather + flash path below
         if plan.kv_gather_rows is None:
             # fused plan was active but this call needs the gather path
-            # (return_lse): materialize the deferred plan once.  Preserve
-            # live sm_scale / logits_soft_cap rebinds (per-run overrides)
-            # — the builder recomputes the PLANNED values.
-            new_plan = self._gather_plan_builder()
-            if new_plan.sm_scale != plan.sm_scale \
-                    or new_plan.logits_soft_cap != plan.logits_soft_cap:
-                import dataclasses
-
-                new_plan = dataclasses.replace(
-                    new_plan, sm_scale=plan.sm_scale,
-                    logits_soft_cap=plan.logits_soft_cap)
-            plan = self._plan = new_plan
+            # (return_lse): materialize the deferred plan once, rebinds
+            # preserved (shared helper with the plan_arrays export)
+            plan = self._materialize_gather_plan()
         if check_kv_layout(self._kv_layout) == TensorLayout.HND:
             k_cache = jnp.swapaxes(k_cache, 1, 2)
             v_cache = jnp.swapaxes(v_cache, 1, 2)

@@ -1,0 +1,45 @@
+"""flashinfer_tpu.serve — the compile-once serving layer.
+
+The serving engine's fused-step substrate (ROADMAP items 1 and 3): a
+decode (or mixed chunked-prefill + decode) step compiled ONCE into a
+single donated-buffer XLA program, replacing the Python-loop-over-ops
+serving flow whose per-step host dispatch tax PR 2's
+``overhead_decomposition`` measured at 1.13-1.31x e2e-vs-slope.
+
+Reference analogue: ``fast_decode_plan`` + CUDAGraph capture (frozen
+shapes, replayed launches) and the persistent mixed-batch kernel
+(``persistent.cuh:682``) that runs a whole decode step as one launch.
+The TPU form: plan() freezes every static (shapes, page geometry,
+quantization mode, sampling config, backend) host-side, and run() is
+one ``jax.jit`` call with ``donate_argnums`` on the KV caches, page
+tables, and sampling key so XLA aliases the serving state in place and
+the step never retraces across tokens.
+
+- :mod:`~flashinfer_tpu.serve.step` — :class:`ServingStep` (decode
+  mega-step over the Llama model family), :class:`MixedServingStep`
+  (chunked prefill + decode in ONE step over the holistic
+  BatchAttention plan arrays), :class:`SamplingConfig`;
+- :mod:`~flashinfer_tpu.serve.shard` — the int8-weight 70B-shard step
+  pipeline bench.py's ``serving``/``serving_fused`` phases measure.
+
+See docs/performance.md ("Compile-once serving step") for the
+lifecycle and donation contract.
+"""
+
+from flashinfer_tpu.serve.step import (
+    MixedServingStep,
+    SamplingConfig,
+    ServingStep,
+    ServingStepPlan,
+    mixed_chunk_tokens,
+    sample_next_tokens,
+)
+
+__all__ = [
+    "MixedServingStep",
+    "SamplingConfig",
+    "ServingStep",
+    "ServingStepPlan",
+    "mixed_chunk_tokens",
+    "sample_next_tokens",
+]

@@ -213,11 +213,13 @@ def test_sharded_decode_step_int8_weights():
     )
 
 
-@pytest.mark.parametrize("mode", ["", "int8", "mixtral", "deepseek"])
+@pytest.mark.parametrize(
+    "mode", ["", "int8", "mixtral", "deepseek", "--fused-step"])
 def test_generate_example_all_families(mode):
     """examples/generate.py end-to-end for every model family (llama
     prefill-wrapper path, int8 serving mode, mixtral and deepseek
-    stepwise serving loops)."""
+    stepwise serving loops, and the compile-once fused-step decode
+    loop with its built-in parity assert)."""
     import os
     import subprocess
     import sys

@@ -1,0 +1,636 @@
+"""Compile-once donated-buffer serving step (flashinfer_tpu.serve).
+
+Pins the three contracts the fused step exists for (ISSUE 8):
+
+- **compile-once**: >= 8 decode steps, exactly ONE trace (the
+  fast_decode_plan/CUDAGraph analog — per-step host cost is replay);
+- **donation**: the donated KV buffers are aliased input->output in
+  the lowered program and invalidated after the call (no per-step
+  cache copy);
+- **bit-parity**: the fused step is a dispatch-structure change, not a
+  numerics change — token-for-token (and cache-bit-for-bit, incl. the
+  int8-KV scale folding of test_quant_kv.py's conventions) against the
+  per-op pipe + llama_decode_step loop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flashinfer_tpu as fi
+from flashinfer_tpu.logits_processor import (
+    LogitsPipe, Sample, Softmax, Temperature, TopK, TopP,
+)
+from flashinfer_tpu.models import (
+    LlamaConfig, init_llama_params, llama_decode_step,
+    quantize_llama_weights,
+)
+from flashinfer_tpu.serve import (
+    MixedServingStep, SamplingConfig, ServingStep, mixed_chunk_tokens,
+    sample_next_tokens,
+)
+
+B, PS, PPR = 2, 8, 4
+NPAGES = B * PPR
+SAMPLING = SamplingConfig(temperature=0.8, top_k=40, top_p=0.95)
+
+
+@pytest.fixture
+def all_obs_off(monkeypatch):
+    for var in ("FLASHINFER_TPU_METRICS", "FLASHINFER_TPU_LOGLEVEL",
+                "FLASHINFER_TPU_TRACE_DUMP", "FLASHINFER_TPU_TRACE_APPLY"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _cfg(**over):
+    return LlamaConfig.tiny(num_layers=2, dtype=jnp.float32, **over)
+
+
+def _caches(cfg, dtype=None):
+    dtype = dtype or cfg.dtype
+    return [
+        (jnp.zeros((NPAGES, cfg.num_kv_heads, PS, cfg.head_dim), dtype),
+         jnp.zeros((NPAGES, cfg.num_kv_heads, PS, cfg.head_dim), dtype))
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _page_table():
+    return jnp.arange(NPAGES, dtype=jnp.int32).reshape(B, PPR)
+
+
+def _start(cfg, seed=9):
+    lens = jnp.array([3, 5], jnp.int32)
+    logits = jax.random.normal(jax.random.PRNGKey(seed),
+                               (B, cfg.vocab_size), jnp.float32)
+    return lens, logits, jax.random.PRNGKey(7)
+
+
+def _per_op_loop(params, cfg, caches, lens, logits, key, steps):
+    """The existing serving flow: LogitsPipe sampling + per-op
+    llama_decode_step, one Python iteration per token."""
+    pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
+    pt = _page_table()
+    toks = []
+    for _ in range(steps):
+        key, sk = jax.random.split(key)
+        t = pipe(logits, key=sk, temperature=SAMPLING.temperature,
+                 top_k=SAMPLING.top_k, top_p=SAMPLING.top_p)
+        toks.append(np.asarray(t))
+        logits, caches = llama_decode_step(
+            params, cfg, t, lens, caches, pt, lens, use_pallas=False)
+        lens = lens + 1
+    return toks, logits, caches
+
+
+def _fused_loop(params, cfg, caches, lens, logits, key, steps,
+                kv_dtype=None, **plan_kw):
+    step = ServingStep()
+    step.plan(cfg, page_table=_page_table(), kv_lens=lens,
+              kv_dtype=kv_dtype or cfg.dtype, sampling=SAMPLING,
+              use_pallas=False, **plan_kw)
+    state = step.make_state(caches, _page_table(), lens, logits, key)
+    toks = []
+    for _ in range(steps):
+        t, state = step.run(params, state)
+        toks.append(np.asarray(t))
+    return step, toks, state
+
+
+# ---------------------------------------------------------------------------
+# compile-once + donation pins
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_compile_once_trace_counter():
+    """>= 8 decode steps through one plan: exactly ONE trace."""
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    step, toks, _ = _fused_loop(params, cfg, _caches(cfg), lens, logits,
+                                key, steps=9)
+    assert len(toks) == 9
+    assert step.num_traces == 1
+
+
+@pytest.mark.quick
+def test_donation_pin():
+    """Donated KV buffers are aliased in the lowered program and
+    invalidated after the step — the no-per-step-cache-copy proof."""
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    caches = _caches(cfg)
+    kc00, pt = caches[0][0], _page_table()
+
+    step = ServingStep()
+    step.plan(cfg, page_table=pt, kv_lens=lens, sampling=SAMPLING,
+              use_pallas=False)
+    # structural proof: the KV cache / page-table / lens / key inputs
+    # carry input->output aliasing annotations in the lowered program
+    lowered = step._step.lower(params, logits, caches, pt, lens, key)
+    txt = lowered.as_text()
+    n_aliased = txt.count("tf.aliasing_output")
+    # 2 arrays per layer cache + page_table + kv_lens + key
+    assert n_aliased >= 2 * cfg.num_layers + 3, txt[:2000]
+    # behavioral proof: the donated buffer is consumed by the call
+    state = step.make_state(caches, pt, lens, logits, key)
+    _, state = step.run(params, state)
+    assert kc00.is_deleted()
+    # a consumed state cannot be replayed (the donation contract);
+    # jax raises RuntimeError or ValueError depending on the dispatch
+    # path that notices the deleted buffer
+    with pytest.raises((RuntimeError, ValueError),
+                       match="deleted|donated"):
+        step._step(params, logits, caches, pt, lens, key)
+
+
+def test_plan_required_before_run():
+    step = ServingStep()
+    with pytest.raises(RuntimeError):
+        step.run({}, (None,) * 5)
+    with pytest.raises(RuntimeError):
+        MixedServingStep().run({}, jnp.zeros((1,), jnp.int32), [], None)
+
+
+def test_make_state_validates_geometry():
+    cfg = _cfg()
+    lens, logits, key = _start(cfg)
+    step = ServingStep()
+    step.plan(cfg, page_table=_page_table(), kv_lens=lens,
+              use_pallas=False)
+    bad_dtype = _caches(cfg, dtype=jnp.int8)
+    with pytest.raises(ValueError, match="dtype"):
+        step.make_state(bad_dtype, _page_table(), lens, logits, key)
+    with pytest.raises(ValueError, match="page_table"):
+        step.make_state(_caches(cfg), _page_table()[:1], lens, logits,
+                        key)
+    with pytest.raises(ValueError, match="layer caches"):
+        step.make_state(_caches(cfg)[:1], _page_table(), lens, logits,
+                        key)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: fused vs the unfused per-op pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_bit_parity_fused_vs_per_op_loop():
+    """f32 weights, f32 KV: tokens, final logits, and caches all
+    bitwise equal across 8 steps."""
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    ref_toks, ref_logits, ref_caches = _per_op_loop(
+        params, cfg, _caches(cfg), lens, logits, key, steps=8)
+    _, toks, state = _fused_loop(params, cfg, _caches(cfg), lens,
+                                 logits, key, steps=8)
+    for a, b in zip(ref_toks, toks):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(ref_logits),
+                                  np.asarray(state[0]))
+    for (a, b), (c, d) in zip(ref_caches, state[1]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(d))
+
+
+@pytest.mark.quick
+def test_bit_parity_int8_kv_scale_folding():
+    """int8 KV caches (quantizing append + sm_scale*k_scale folding +
+    *v_scale epilogue, the test_quant_kv.py conventions): the fused
+    step reproduces the per-op loop's quantized cache CODES and logits
+    bitwise."""
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    ref_toks, ref_logits, ref_caches = _per_op_loop(
+        params, cfg, _caches(cfg, jnp.int8), lens, logits, key, steps=8)
+    step, toks, state = _fused_loop(
+        params, cfg, _caches(cfg, jnp.int8), lens, logits, key, steps=8,
+        kv_dtype=jnp.int8)
+    assert step.num_traces == 1
+    for a, b in zip(ref_toks, toks):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(ref_logits),
+                                  np.asarray(state[0]))
+    for (a, b), (c, d) in zip(ref_caches, state[1]):
+        assert a.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(d))
+
+
+def test_parity_int8_weights():
+    """int8-weight MXU path: tokens and caches bitwise; the f32 logits
+    of the final lm_head may differ in fused-vs-per-op programs by
+    float-contraction reassociation (tolerated, like the int8 GEMM
+    tests)."""
+    cfg = _cfg()
+    params = quantize_llama_weights(
+        init_llama_params(jax.random.PRNGKey(0), cfg))
+    lens, logits, key = _start(cfg)
+    ref_toks, ref_logits, _ = _per_op_loop(
+        params, cfg, _caches(cfg, jnp.int8), lens, logits, key, steps=8)
+    _, toks, state = _fused_loop(
+        params, cfg, _caches(cfg, jnp.int8), lens, logits, key, steps=8,
+        kv_dtype=jnp.int8)
+    for a, b in zip(ref_toks, toks):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(np.asarray(ref_logits),
+                               np.asarray(state[0]), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sampling_epilogue_matches_pipe():
+    """sample_next_tokens == the LogitsPipe chain it mirrors, over
+    several keys and batch shapes."""
+    pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 257),
+                               jnp.float32) * 3.0
+    for i in range(4):
+        k = jax.random.PRNGKey(i)
+        ref = pipe(logits, key=k, temperature=0.8, top_k=40, top_p=0.95)
+        got = sample_next_tokens(logits, k, SAMPLING)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    # stage-skipping configs legalize too
+    greedy_ish = sample_next_tokens(
+        logits, jax.random.PRNGKey(9), SamplingConfig())
+    assert greedy_ish.shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# plan-array export (decode.py / prefill.py -> serve closure)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_wrapper_plan_export_into_step():
+    """ServingStep.plan(decode_wrapper=...) closes the wrapper's
+    frozen plan arrays; the wrapper's padded geometry becomes the
+    step's."""
+    cfg = _cfg(num_qo_heads=4, num_kv_heads=2, head_dim=32)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    bs = 8  # == the wrapper's minimum batch bucket: no pad mismatch
+    ppr = 8  # == minimum page bucket
+    npages = bs * ppr
+    indptr = np.arange(bs + 1, dtype=np.int32) * ppr
+    indices = np.arange(npages, dtype=np.int32)
+    last = np.full((bs,), PS, np.int32)
+    w = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    with pytest.raises(RuntimeError):
+        w.plan_arrays  # noqa: B018 - export before plan() must raise
+    w.plan(indptr, indices, last, cfg.num_qo_heads, cfg.num_kv_heads,
+           cfg.head_dim, PS)
+    arrays = w.plan_arrays
+    assert arrays["page_table"].shape == (bs, ppr)
+    assert arrays["kv_layout"] == "HND"
+
+    step = ServingStep()
+    step.plan(cfg, decode_wrapper=w, sampling=SAMPLING, use_pallas=False)
+    assert step.plan_statics.batch_size == bs
+    assert step.plan_statics.page_size == PS
+    caches = [
+        (jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim),
+                   cfg.dtype),
+         jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim),
+                   cfg.dtype))
+        for _ in range(cfg.num_layers)
+    ]
+    logits = jax.random.normal(jax.random.PRNGKey(1),
+                               (bs, cfg.vocab_size), jnp.float32)
+    state = step.make_state(caches, arrays["page_table"],
+                            arrays["kv_lens"], logits,
+                            jax.random.PRNGKey(2))
+    for _ in range(3):
+        toks, state = step.run(params, state)
+    assert step.num_traces == 1
+    assert toks.shape == (bs,)
+
+    # geometry mismatch against the model cfg raises loudly
+    bad = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    bad.plan(indptr, indices, last, 8, 2, 32, PS)
+    with pytest.raises(ValueError, match="heads/dim"):
+        ServingStep().plan(cfg, decode_wrapper=bad)
+    nhd = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="NHD")
+    nhd.plan(indptr, indices, last, cfg.num_qo_heads, cfg.num_kv_heads,
+             cfg.head_dim, PS)
+    with pytest.raises(ValueError, match="HND"):
+        ServingStep().plan(cfg, decode_wrapper=nhd)
+    # a non-bucket batch pads inside the wrapper; the fused step runs
+    # UNPADDED state, so the export must be rejected loudly at plan()
+    # (not as an opaque trace-time broadcast error)
+    padded = fi.BatchDecodeWithPagedKVCacheWrapper(kv_layout="HND")
+    padded.plan(np.arange(7, dtype=np.int32) * ppr,
+                np.arange(6 * ppr, dtype=np.int32),
+                np.full((6,), PS, np.int32), cfg.num_qo_heads,
+                cfg.num_kv_heads, cfg.head_dim, PS)
+    with pytest.raises(ValueError, match="bucket-aligned"):
+        ServingStep().plan(cfg, decode_wrapper=padded)
+    # and a wrong-batch logits is caught at make_state, not at trace
+    with pytest.raises(ValueError, match="logits batch"):
+        step.make_state(caches, arrays["page_table"], arrays["kv_lens"],
+                        logits[:2], jax.random.PRNGKey(4))
+
+
+def test_prefill_wrapper_plan_arrays_export():
+    """The paged prefill/BatchAttention export materializes the gather
+    plan (token axes + flat gather rows) with consistent extents."""
+    HQ, HKV, D = 4, 2, 32
+    bs = 2
+    qo_indptr = np.array([0, 3, 5], np.int32)
+    kv_indptr = np.arange(bs + 1, dtype=np.int32) * 2
+    kv_indices = np.arange(4, dtype=np.int32)
+    last = np.full((bs,), PS, np.int32)
+    w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+    w.plan(qo_indptr, kv_indptr, kv_indices, last, HQ, HKV, D, PS,
+           causal=True)
+    arrays = w.plan_arrays
+    assert arrays["kv_gather_rows"] is not None
+    assert arrays["q_seg"].shape == (arrays["tq_pad"],)
+    assert arrays["kv_gather_rows"].shape == (arrays["tkv_pad"],)
+    assert arrays["total_q"] == 5
+    assert arrays["total_kv"] == 2 * PS * bs
+    assert arrays["causal"] is True
+
+
+# ---------------------------------------------------------------------------
+# mixed chunked-prefill + decode step
+# ---------------------------------------------------------------------------
+
+
+def _mixed_setup(kv_dtype=None):
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    qo_lens = [4, 6, 1]  # two prefill chunks + one decoding request
+    kv0 = [0, 2, 9]
+    nb = len(qo_lens)
+    npages = nb * PPR
+    kv_page_indptr = np.arange(nb + 1) * PPR
+    kv_page_indices = np.arange(npages)
+
+    def mk():
+        dt = kv_dtype or cfg.dtype
+        return [
+            (jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim), dt),
+             jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim), dt))
+            for _ in range(cfg.num_layers)
+        ]
+
+    flat = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size,
+                                          sum(qo_lens)), jnp.int32)
+    ms = MixedServingStep()
+    ms.plan(cfg, qo_lens, kv0, kv_page_indptr, kv_page_indices, PS,
+            kv_dtype=kv_dtype, sampling=SAMPLING)
+    return cfg, params, ms, flat, mk
+
+
+@pytest.mark.quick
+def test_mixed_step_parity_and_compile_once():
+    """Mixed chunked-prefill + decode: the ONE-launch fused program ==
+    the eager unfused body bitwise; repeated same-geometry runs never
+    retrace; caches + key donate."""
+    cfg, params, ms, flat, mk = _mixed_setup()
+    t_ref, lg_ref, cc_ref, _ = ms.run_unfused(
+        params, flat, mk(), jax.random.PRNGKey(3))
+    caches = mk()
+    kc00 = caches[0][0]
+    key = jax.random.PRNGKey(3)
+    for i in range(3):
+        t, lg, caches, key = ms.run(
+            params, flat, caches if i == 0 else mk(), key)
+    assert ms.num_traces == 1
+    assert kc00.is_deleted()
+    t2, lg2, cc2, _ = ms.run(params, flat, mk(), jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(t2), np.asarray(t_ref))
+    np.testing.assert_array_equal(np.asarray(lg2), np.asarray(lg_ref))
+    for (a, b), (c, d) in zip(cc2, cc_ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(d))
+
+
+def test_mixed_step_int8_kv_parity():
+    cfg, params, ms, flat, mk = _mixed_setup(kv_dtype=jnp.int8)
+    t_ref, lg_ref, cc_ref, _ = ms.run_unfused(
+        params, flat, mk(), jax.random.PRNGKey(5))
+    t, lg, cc, _ = ms.run(params, flat, mk(), jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(t), np.asarray(t_ref))
+    np.testing.assert_array_equal(np.asarray(lg), np.asarray(lg_ref))
+    for (a, b), (c, d) in zip(cc, cc_ref):
+        assert a.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_mixed_step_rejects_zero_len_request():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match=">= 1"):
+        MixedServingStep().plan(cfg, [2, 0], [0, 0],
+                                np.array([0, 2, 4]), np.arange(4), PS)
+
+
+def test_mixed_chunk_knob():
+    """serve.mixed_chunk is a registered KNOWN_KNOBS tactic (L006's
+    contract) and the helper returns its default off-config."""
+    from flashinfer_tpu.autotuner import KNOWN_KNOBS, validate_tactic
+
+    assert "serve.mixed_chunk" in KNOWN_KNOBS
+    assert validate_tactic("serve.mixed_chunk", 128) is None
+    assert validate_tactic("serve.mixed_chunk", "big") is not None
+    assert mixed_chunk_tokens(3, PS, default=32) == 32
+
+
+# ---------------------------------------------------------------------------
+# obs: retrace counter + zero-overhead default + roofline stamp
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_counter_increments(monkeypatch):
+    """A retrace under a live plan (state geometry moved) lands in the
+    serve.step_retraces counter when metrics are on."""
+    from flashinfer_tpu import obs
+
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    obs.reset()
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    step, _, state = _fused_loop(params, cfg, _caches(cfg), lens, logits,
+                                 key, steps=2)
+    snap = obs.snapshot()
+    assert not any("serve.step_retraces" in k
+                   for k in snap["counters"])  # compile-once: zero
+    # force a geometry move THROUGH the same compiled handle: a wider
+    # batch retraces the jitted body
+    wide = 2 * B
+    pt = jnp.arange(NPAGES, dtype=jnp.int32).reshape(wide, PPR // 2)
+    big = (
+        jax.random.normal(jax.random.PRNGKey(1), (wide, cfg.vocab_size),
+                          jnp.float32),
+        [(jnp.zeros((NPAGES, cfg.num_kv_heads, PS, cfg.head_dim),
+                    cfg.dtype),
+          jnp.zeros((NPAGES, cfg.num_kv_heads, PS, cfg.head_dim),
+                    cfg.dtype))
+         for _ in range(cfg.num_layers)],
+        pt, jnp.zeros((wide,), jnp.int32), jax.random.PRNGKey(2),
+    )
+    step.run(params, big)
+    assert step.num_traces == 2
+    cells = obs.snapshot()["counters"].get("serve.step_retraces")
+    assert cells and sum(cells.values()) == 1
+
+
+def test_retrace_counter_zero_overhead_default(all_obs_off):
+    """Metrics off (the default): N fused steps leave the registry
+    untouched — the counter costs nothing unless asked for."""
+    from flashinfer_tpu import obs
+
+    obs.reset()
+    cfg = _cfg()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    lens, logits, key = _start(cfg)
+    _fused_loop(params, cfg, _caches(cfg), lens, logits, key, steps=3)
+    snap = obs.snapshot()
+    assert snap["counters"] == {}
+
+
+def test_step_mode_stamp_is_identity():
+    """roofline.stamp_row(step_mode=...) writes the serving-loop
+    dispatch-structure identity: rows differing only in step_mode are
+    DIFFERENT configurations to the audit (the num_splits precedent),
+    while dispatch_residual_us is a measurement field."""
+    from flashinfer_tpu.obs import bench_audit, costmodel, hwspec, roofline
+
+    cost = costmodel.serving_step(
+        4, 128, 2, **costmodel.SERVING_SHAPES["llama70b_tp8shard_int8"])
+    spec = hwspec.CHIP_SPECS["v5e"]
+    rows = []
+    for mode in ("fused", "per_op"):
+        row = roofline.stamp_row(
+            dict(phase="serving_fused", bs=4, ctx=128,
+                 us_step=5000.0, dispatch_residual_us=100.0),
+            cost, 5e-3, spec, step_mode=mode)
+        assert row["step_mode"] == mode
+        rows.append(row)
+    k0, k1 = (bench_audit.row_key(r) for r in rows)
+    assert k0 != k1
+    r2 = dict(rows[0])
+    r2["dispatch_residual_us"] = 999.0
+    assert bench_audit.row_key(r2) == k0
+
+
+def test_api_ops_and_cost_coverage():
+    """The fused-step ops are catalogued (L005) and cost-covered
+    (obs doctor's uncovered list stays empty)."""
+    from flashinfer_tpu.obs import costmodel
+    from flashinfer_tpu.obs.catalog import API_OPS, METRICS
+
+    assert "serve.step" in API_OPS
+    assert "serve.mixed_step" in API_OPS
+    assert "serve.step_retraces" in METRICS
+    assert costmodel.API_OP_COSTS["serve.step"] == "serving_step"
+    assert costmodel.uncovered_api_ops() == ()
+
+
+# ---------------------------------------------------------------------------
+# the int8 70B-shard pipeline (bench serving_fused's substrate)
+# ---------------------------------------------------------------------------
+
+
+def _shard_fixture():
+    from flashinfer_tpu.quantization import quantize_int8
+    from flashinfer_tpu.serve.shard import Int8ShardSpec
+
+    spec = Int8ShardSpec(bs=4, hidden=256, hq=4, hkv=1, hd=64, inter=512,
+                         vocab_shard=512, page_size=16, use_pallas=False)
+    L, ctx = 2, 64
+    ppr = ctx // spec.page_size
+    npages = spec.bs * ppr
+    key = jax.random.PRNGKey(0)
+
+    def qw(k, shape):
+        w = jax.random.normal(k, shape, jnp.float32) / np.sqrt(shape[0])
+        wq, ws = quantize_int8(w, axis=0)
+        return wq, ws.reshape(1, -1)
+
+    ks = jax.random.split(key, 6 * L + 2)
+    qdim, kvdim = spec.qdim, spec.kvdim
+    layer_ws = [(
+        *qw(ks[6 * i], (spec.hidden, qdim + 2 * kvdim)),
+        *qw(ks[6 * i + 1], (qdim, spec.hidden)),
+        *qw(ks[6 * i + 2], (spec.hidden, 2 * spec.inter)),
+        *qw(ks[6 * i + 3], (spec.inter, spec.hidden)),
+        jax.random.normal(ks[6 * i + 4], (spec.hidden,)) * 0.02 + 1.0,
+        jax.random.normal(ks[6 * i + 5], (spec.hidden,)) * 0.02 + 1.0,
+    ) for i in range(L)]
+
+    def mkc():
+        return [
+            (jax.random.randint(
+                jax.random.fold_in(ks[-2], i),
+                (npages, spec.hkv, spec.page_size, spec.hd), -127, 127,
+                jnp.int8),
+             jax.random.randint(
+                jax.random.fold_in(ks[-1], i),
+                (npages, spec.hkv, spec.page_size, spec.hd), -127, 127,
+                jnp.int8))
+            for i in range(L)
+        ]
+
+    head, head_s = qw(jax.random.fold_in(key, 999),
+                      (spec.hidden, spec.vocab_shard))
+    pt0 = (np.random.default_rng(0).permutation(npages)
+           .reshape(spec.bs, ppr).astype(np.int32))
+    x0 = jax.random.normal(jax.random.fold_in(key, 7),
+                           (spec.bs, spec.hidden), jnp.bfloat16)
+    return spec, ctx, layer_ws, mkc, head, head_s, pt0, x0
+
+
+@pytest.mark.quick
+def test_shard_fused_vs_per_op():
+    """The bench A/B substrate: the one-dispatch fused shard step and
+    the per-layer-jitted loop sample IDENTICAL tokens over chained
+    steps; int8 cache codes agree to <= 1 quantization code (the two
+    dispatch structures fuse the scale multiply differently)."""
+    from flashinfer_tpu.serve.shard import (build_fused_step,
+                                            build_per_op_step)
+
+    spec, ctx, layer_ws, mkc, head, head_s, pt0, x0 = _shard_fixture()
+
+    def chain(stepfn):
+        caches = mkc()
+        p = jnp.array(pt0)
+        l = jnp.full((spec.bs,), ctx - 1, jnp.int32)
+        sk = jax.random.PRNGKey(3)
+        toks = []
+        for _ in range(3):
+            tok, caches, p, l, sk = stepfn(
+                x0, layer_ws, caches, head, head_s, p, l, sk)
+            toks.append(np.asarray(tok))
+        return toks, caches
+
+    ta, ca = chain(build_fused_step(spec))
+    tb, cb = chain(build_per_op_step(spec))
+    for a, b in zip(ta, tb):
+        np.testing.assert_array_equal(a, b)
+    for (k1, v1), (k2, v2) in zip(ca, cb):
+        for x, y in ((k1, k2), (v1, v2)):
+            diff = np.abs(np.asarray(x, np.int32)
+                          - np.asarray(y, np.int32))
+            assert diff.max() <= 1
+            assert (diff > 0).mean() < 0.01
+
+
+def test_shard_fused_donates():
+    from flashinfer_tpu.serve.shard import build_fused_step
+
+    spec, ctx, layer_ws, mkc, head, head_s, pt0, x0 = _shard_fixture()
+    caches = mkc()
+    kc0 = caches[0][0]
+    p = jnp.array(pt0)
+    l = jnp.full((spec.bs,), ctx - 1, jnp.int32)
+    step = build_fused_step(spec)
+    tok, caches, p, l, sk = step(x0, layer_ws, caches, head, head_s, p,
+                                 l, jax.random.PRNGKey(3))
+    assert kc0.is_deleted()
+    # the returned state replays cleanly
+    step(x0, layer_ws, caches, head, head_s, p, l, sk)
