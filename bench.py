@@ -68,6 +68,9 @@ PHASE_TIMEOUT_S = {
     # fused + per-op + slope: three guarded first compiles of the same
     # step pipeline through the tunnel
     "serving_fused": 1800.0,
+    # sharded fused + per-op + slope over the whole mesh: three guarded
+    # first GSPMD compiles (collectives included) through the tunnel
+    "serving_sharded": 2400.0,
     "prefill": 1500.0,
     "prefill_sweep": 2400.0,
     "mla": 1200.0,
@@ -1403,6 +1406,199 @@ def phase_serving_fused(sweep: bool):
               f"{delta:+.1f} us/step", file=sys.stderr)
 
 
+def phase_serving_sharded(sweep: bool):
+    """A/B: the compile-once SHARDED serving step (``parallel/plan.py``
+    — GLOBAL 70B dims compiled ONCE under a (dp, tp) mesh with explicit
+    NamedShardings + donated KV state: one XLA program per step for the
+    WHOLE mesh) vs the SAME sharded math as per-layer jitted calls
+    chained by a host loop (the pre-fused dispatch structure, now with
+    ``layers + 1`` collective-bearing dispatches per step).
+
+    The slope denominator is the in-jit ``lax.scan`` steady state of
+    the same sharded body (zero host dispatch), so
+    ``dispatch_residual_us = us_step - slope_pred_us`` is the per-step
+    host tax each dispatch structure pays ON A MESH — the multi-chip
+    sequel to ``phase_serving_fused``'s single-chip A/B.
+
+    Rows carry BOTH identity stamps: ``step_mode`` (fused | per_op) and
+    ``mesh_axes`` (``ShardingPlan.mesh_axes``, e.g. "dp1.tp8") — a tp8
+    row must never compete with tp1 history — plus the new ICI
+    measurement fields (``ici_bytes`` / ``pct_ici_roofline``) from the
+    collective cost family.
+
+    CPU-mesh dryrun-capable: under BENCH_SMALL with no initialized
+    backend the phase forces an 8-virtual-device host platform, so the
+    whole A/B (compile-once, donation, collectives) runs off-hardware;
+    the timings are then structural, not performance claims — the
+    predicted multi-chip story is ``obs perf``'s scaling curve."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu.obs import costmodel
+    from flashinfer_tpu.parallel.plan import (build_sharded_fused_step,
+                                              build_sharded_per_op_step,
+                                              make_serving_mesh,
+                                              sharded_step_body,
+                                              split_shard_weights_for_spec,
+                                              validate_dp_page_table)
+    from flashinfer_tpu.quantization import quantize_int8
+    from flashinfer_tpu.serve.shard import Int8ShardSpec
+    from flashinfer_tpu.testing import bench_steps_device
+    from flashinfer_tpu.utils import is_tpu
+
+    if os.environ.get("BENCH_SMALL"):
+        bs, ctx, PS = 4, 128, 16
+        hidden, hq, hkv, hd, inter, vocab = 512, 8, 4, 128, 1024, 1024
+        L = 2
+    else:
+        # GLOBAL Llama-3-70B dims (the whole model — the plan shards it;
+        # tp8 of this is exactly phase_serving's per-chip shard)
+        bs, ctx, PS = 64, 4096, 16
+        hidden, hq, hkv, hd, inter, vocab = 8192, 64, 8, 128, 28672, 128256
+        L = 8
+    plan = make_serving_mesh(hidden=hidden, num_qo_heads=hq,
+                             num_kv_heads=hkv)
+    print(f"# serving_sharded mesh: {plan.mesh_axes} over "
+          f"{len(jax.devices())} device(s)", file=sys.stderr)
+    spec = Int8ShardSpec(bs=bs, hidden=hidden, hq=hq, hkv=hkv, hd=hd,
+                         inter=inter, vocab_shard=vocab, page_size=PS,
+                         use_pallas=is_tpu())
+    pages_per_req = ctx // PS
+    num_pages = bs * pages_per_req
+    qdim, kvdim = spec.qdim, spec.kvdim
+    key = jax.random.PRNGKey(0)
+
+    def qw(k, shape):
+        w = jax.random.normal(k, shape, jnp.float32) / np.sqrt(shape[0])
+        wq, ws = quantize_int8(w, axis=0)
+        return wq, ws.reshape(1, -1)
+
+    ks = jax.random.split(key, 6 * L + 2)
+    layer_ws = split_shard_weights_for_spec([(
+        *qw(ks[6 * i], (hidden, qdim + 2 * kvdim)),
+        *qw(ks[6 * i + 1], (qdim, hidden)),
+        *qw(ks[6 * i + 2], (hidden, 2 * inter)),
+        *qw(ks[6 * i + 3], (inter, hidden)),
+        jax.random.normal(ks[6 * i + 4], (hidden,)) * 0.02 + 1.0,
+        jax.random.normal(ks[6 * i + 5], (hidden,)) * 0.02 + 1.0,
+    ) for i in range(L)], spec)
+
+    def mk_caches():
+        return [(jax.random.randint(
+                    jax.random.fold_in(ks[-2], i),
+                    (num_pages, hkv, PS, hd), -127, 127, jnp.int8),
+                 jax.random.randint(
+                    jax.random.fold_in(ks[-1], i),
+                    (num_pages, hkv, PS, hd), -127, 127, jnp.int8))
+                for i in range(L)]
+
+    head, head_s = qw(jax.random.fold_in(key, 999), (hidden, vocab))
+    # DP page-pool contract: request b's pages come from its dp slab
+    bs_l = bs // plan.dp_size
+    pages_l = num_pages // plan.dp_size
+    rng = np.random.default_rng(0)
+    pt0 = np.stack([
+        rng.permutation(pages_l)[:pages_per_req]
+        + (b // bs_l) * pages_l
+        for b in range(bs)]).astype(np.int32)
+    validate_dp_page_table(pt0, num_pages, plan)
+    lens0 = np.full((bs,), ctx - 1, np.int32)
+    x0 = jax.random.normal(jax.random.fold_in(key, 7), (bs, hidden),
+                           jnp.bfloat16)
+    shape = dict(hidden=hidden, hq=hq, hkv=hkv, hd=hd, inter=inter,
+                 vocab_shard=vocab, page_size=PS, weight_bytes=1,
+                 kv_bytes=1)
+    # PER-CHIP cost on this mesh, collective ICI bytes included
+    cost = costmodel.serving_step_sharded(
+        bs, ctx, L, dp=plan.dp_size, tp=plan.tp_size, **shape)
+
+    # ---- shared slope floor: the SAME sharded step as an in-jit
+    # lax.scan steady state (zero host dispatch)
+    body = sharded_step_body(spec, plan)
+
+    def make_loop(n):
+        @jax.jit
+        def loop(x0, layer_ws, caches, head, head_s, pt, lens, skey):
+            def scan_body(carry, _):
+                caches, skey = carry
+                tok, caches, _, _, skey = body(
+                    x0, layer_ws, caches, head, head_s, pt, lens, skey)
+                return (caches, skey), tok[0]
+            (_, _), toks = jax.lax.scan(
+                scan_body, (caches, skey), None, length=n)
+            return toks.sum()
+        return loop
+
+    t_slope = _guard(
+        "bench.serving_sharded.slope",
+        (bs, ctx, L, hidden, plan.mesh_axes),
+        lambda: bench_steps_device(
+            make_loop, x0, layer_ws, mk_caches(), head, head_s,
+            jnp.asarray(pt0), jnp.asarray(lens0), jax.random.PRNGKey(3),
+            repeats=3,
+        ),
+    )
+    print(f"# serving_sharded slope floor: {t_slope*1e6:9.1f} us/step",
+          file=sys.stderr)
+
+    def wall(stepfn, warm=2, steps=12, repeats=3):
+        best = float("inf")
+        for _ in range(repeats):
+            caches = mk_caches()
+            p = jnp.asarray(pt0)
+            l = jnp.asarray(lens0)
+            sk = jax.random.PRNGKey(3)
+            for _ in range(warm):
+                tok, caches, p, l, sk = stepfn(
+                    x0, layer_ws, caches, head, head_s, p, l, sk)
+            float(tok[0])  # fence before the timed window
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                tok, caches, p, l, sk = stepfn(
+                    x0, layer_ws, caches, head, head_s, p, l, sk)
+            float(tok[0])  # execution fence (tunnel-safe)
+            best = min(best, (_time.perf_counter() - t0) / steps)
+        return best
+
+    fused = build_sharded_fused_step(spec, plan, num_layers=L)
+    variants = (
+        ("fused", fused),
+        ("per_op", build_sharded_per_op_step(spec, plan)),
+    )
+    residuals = {}
+    for name, stepfn in variants:
+        t = _guard_soft(f"bench.serving_sharded.{name}",
+                        (bs, ctx, L, hidden, plan.mesh_axes, name),
+                        lambda s=stepfn: wall(s))
+        if t is None:
+            print(f"# serving_sharded {name}: FAILED", file=sys.stderr)
+            continue
+        residual_us = (t - t_slope) * 1e6
+        residuals[name] = residual_us
+        _emit_row(**_stamp(
+            dict(phase="serving_sharded", model="llama70b_int8",
+                 variant=name, bs=bs, ctx=ctx, layers=L,
+                 us_step=round(t * 1e6, 1),
+                 slope_pred_us=round(t_slope * 1e6, 1),
+                 overhead_vs_slope=round(t / max(t_slope, 1e-9), 3),
+                 dispatch_residual_us=round(residual_us, 1),
+                 includes=["kv_append", "sampling", "collectives"]),
+            cost, t, step_mode=name, mesh_axes=plan.mesh_axes))
+        print(f"# serving_sharded {name:7s}: {t*1e6:9.1f} us/step "
+              f"({t/max(t_slope,1e-9):.3f}x slope, residual "
+              f"{residual_us:+.1f} us)", file=sys.stderr)
+    if fused.num_traces != 1:
+        print(f"# serving_sharded WARNING: fused step traced "
+              f"{fused.num_traces}x (compile-once broke)", file=sys.stderr)
+    if len(residuals) == 2:
+        delta = residuals["per_op"] - residuals["fused"]
+        print(f"# serving_sharded dispatch residual delta "
+              f"(per_op - fused): {delta:+.1f} us/step", file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -1421,6 +1617,7 @@ PHASES = {
     "scans": phase_scans,
     "serving": phase_serving,
     "serving_fused": phase_serving_fused,
+    "serving_sharded": phase_serving_sharded,
     "prefill": phase_prefill,
     "mla": phase_mla,
     "selftest": phase_selftest,
@@ -1442,8 +1639,13 @@ PHASES = {
 #   has never run on chip, and the headline serving rows above keep
 #   their banked identity (the fused rows carry step_mode so they can
 #   never shadow the per-phase history)
+#   serving_sharded rides after serving_fused (the very end): it is the
+#   first phase that occupies EVERY chip of a mesh, so a wedge there
+#   must cost nothing else; rows carry mesh_axes identity so they can
+#   never shadow single-chip history
 DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans",
-                  "prefill", "mla", "decode_splits", "serving_fused"]
+                  "prefill", "mla", "decode_splits", "serving_fused",
+                  "serving_sharded"]
 
 
 # --------------------------------------------------------------------------
@@ -1586,6 +1788,14 @@ def main():
                     help="skip the chip-health preamble (CPU smoke runs)")
     args = ap.parse_args()
     if args.phase:
+        if args.phase == "serving_sharded" \
+                and os.environ.get("BENCH_SMALL"):
+            # CPU-mesh dryrun: the virtual 8-device host platform must
+            # exist BEFORE the backend initializes (jax reads XLA_FLAGS
+            # at first device use; apply_platform_from_env imports jax)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8")
         from flashinfer_tpu.env import apply_platform_from_env
 
         apply_platform_from_env()

@@ -121,6 +121,21 @@ register_knob("serve.mixed_chunk",
                           "— serve.step.mixed_chunk_tokens; larger "
                           "amortizes the step launch, smaller bounds "
                           "decode-latency interference")
+# sharded serving mesh axes (parallel/plan.py plan_axes, shape key
+# world_hidden_hq_hkv): dp x tp must equal the world size and tp must
+# tile both head counts — invalid entries fall back to the all-tp
+# default instead of building an uncompilable mesh
+register_knob("parallel.dp",
+              description="serving mesh data-parallel axis size "
+                          "(batch + page-pool sharding)")
+register_knob("parallel.tp",
+              description="serving mesh tensor-parallel axis size "
+                          "(heads/inter/vocab sharding; must tile "
+                          "num_qo_heads and num_kv_heads)")
+register_knob("parallel.ep",
+              description="expert-parallel factor of the tp axis for "
+                          "MoE serving steps (1 = dense; must divide "
+                          "parallel.tp — the Mapping moe_ep contract)")
 
 
 def validate_tactic(op_name: str, value) -> Optional[str]:

@@ -23,9 +23,25 @@ import jax
 import jax.numpy as jnp
 
 
+def _record_allreduce_bytes(x: jax.Array, axis: str) -> None:
+    """Count the payload bytes of one allreduce call site.
+
+    Shapes are static even under a shard_map/jit trace, so this runs
+    host-side at TRACE time: the counter reads as the per-call traffic
+    of each compiled program (obs catalog ``comm.allreduce_bytes``) —
+    the measured-side join for the cost model's predicted ICI bytes.
+    Zero-overhead when the metrics gate is off (default, pinned)."""
+    from flashinfer_tpu import obs
+
+    if obs.metrics_enabled():
+        obs.counter_inc("comm.allreduce_bytes",
+                        int(x.size) * x.dtype.itemsize, axis=axis)
+
+
 def allreduce(x: jax.Array, axis: str = "tp") -> jax.Array:
     """Plain sum-allreduce over a mesh axis (reference
     ``allreduce``/trtllm_custom_all_reduce)."""
+    _record_allreduce_bytes(x, axis)
     return jax.lax.psum(x, axis)
 
 
@@ -54,6 +70,7 @@ def allreduce_fusion(
     - + quant_dtype                     -> kARResidualRMSNormFP8Quant:
           returns (quantized, scale, new_residual)
     """
+    _record_allreduce_bytes(x, axis)
     s = jax.lax.psum(x, axis)
     if residual is None and rms_weight is None:
         return (s,)

@@ -392,6 +392,8 @@ def fused_moe_ep(
         out = jax.lax.psum_scatter(partial, axis, tiled=True)
         return (out, jnp.zeros((1,), jnp.int32)) if return_dropped else out
     if dispatch == "alltoall":
+        _record_ep_a2a_bytes(hidden, topk_ids, axis, capacity_factor,
+                             dispatch)
         out, dropped = _fused_moe_ep_alltoall(
             hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
             axis, activation, capacity_factor,
@@ -405,12 +407,48 @@ def fused_moe_ep(
         obs.record_dropped_tokens(dropped, dispatch)
         return (out, dropped) if return_dropped else out
     if dispatch == "alltoall_exact":
+        _record_ep_a2a_bytes(hidden, topk_ids, axis, capacity_factor,
+                             dispatch)
         out, dropped = _fused_moe_ep_alltoall_exact(
             hidden, w_gate_up, w_down, topk_weights, topk_ids, num_experts,
             axis, activation, capacity_factor,
         )
         return (out, dropped) if return_dropped else out
     raise ValueError(f"unknown dispatch {dispatch!r}")
+
+
+def _record_ep_a2a_bytes(hidden, topk_ids, axis, capacity_factor,
+                         dispatch: str) -> None:
+    """Count this call site's all_to_all payload (dispatch + combine
+    activation buffers, ``2 * ep * cap * H`` elements at the hidden
+    dtype; eid/valid sideband excluded — noise against H-wide rows).
+
+    Shapes are static even under trace, so this runs host-side at
+    TRACE time: the counter is per-call traffic of the compiled
+    program (per-ROUND for alltoall_exact, whose round count is
+    data-dependent).  obs catalog ``moe.ep_a2a_bytes``; zero-overhead
+    with the gate off (default, pinned)."""
+    from flashinfer_tpu import obs
+
+    if not obs.metrics_enabled():
+        return
+    ep = lax_axis_size(axis)
+    if not isinstance(ep, int):  # outside shard_map (tests call eager)
+        return
+    T, K = topk_ids.shape
+    cap = _bucket_capacity(T * K, ep, capacity_factor)
+    nbytes = 2 * ep * cap * hidden.shape[1] * hidden.dtype.itemsize
+    obs.counter_inc("moe.ep_a2a_bytes", int(nbytes), dispatch=dispatch)
+
+
+def _bucket_capacity(routes: int, ep: int, capacity_factor: float) -> int:
+    """Per-destination bucket capacity of the all_to_all dispatch —
+    THE capacity rule (shared by :func:`_route_buckets` and the
+    ``moe.ep_a2a_bytes`` telemetry so the counted buffer sizes can
+    never drift from the exchanged ones)."""
+    import math
+
+    return max(1, int(math.ceil(routes / ep * capacity_factor)))
 
 
 def _route_buckets(topk_ids, e_local, ep, capacity_factor):
@@ -426,9 +464,7 @@ def _route_buckets(topk_ids, e_local, ep, capacity_factor):
     """
     T, K = topk_ids.shape
     TK = T * K
-    import math
-
-    cap = max(1, int(math.ceil(TK / ep * capacity_factor)))
+    cap = _bucket_capacity(TK, ep, capacity_factor)
     flat_ids = topk_ids.reshape(-1)
     dst = (flat_ids // e_local).astype(jnp.int32)
     order = jnp.argsort(dst, stable=True)

@@ -250,7 +250,8 @@ def main(argv=None) -> int:
                          "(default: the repo's BENCH_BANKED.md)")
     sp.add_argument("--json", action="store_true",
                     help="machine-readable report (schema "
-                         "flashinfer_tpu.obs.perf/1)")
+                         "flashinfer_tpu.obs.perf/2: + serving_ici / "
+                         "scaling_prediction ICI fields)")
     sp.add_argument("--chip", default=None,
                     help="default chip for rows that name none "
                          "(default: v5e, the banked history's chip)")

@@ -59,6 +59,13 @@ MEASUREMENT_FIELDS = frozenset({
     # dispatch structures are different configurations with separate
     # banked histories, the num_splits precedent
     "dispatch_residual_us",
+    # sharded serving step: predicted ICI wire bytes + the fraction of
+    # measured time the ICI floor explains (both derived from the cost
+    # model — recalibrate-able, never identity).  mesh_axes
+    # (ShardingPlan.mesh_axes, e.g. "dp1.tp8") is deliberately NOT
+    # here: mesh SHAPE is configuration, so a tp8 row never competes
+    # with tp1 history — the step_mode/num_splits precedent
+    "ici_bytes", "pct_ici_roofline",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

@@ -97,6 +97,22 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "capacity-dropped (token, choice) routes observed at EAGER "
         "fused_moe_ep calls (inside jit the count is a tracer and is "
         "skipped — use return_dropped=True there)"),
+    "moe.ep_a2a_bytes": (
+        "counter", ("dispatch",),
+        "EP all_to_all payload bytes per TRACED fused_moe_ep call "
+        "(dispatch + combine buffers; shapes are static, so this is "
+        "the per-call traffic of the compiled program — for "
+        "alltoall_exact it is the per-ROUND payload, rounds being "
+        "data-dependent).  Joins against the predicted ICI bytes of "
+        "costmodel.ep_all_to_all"),
+    # -- comm collectives --------------------------------------------------
+    "comm.allreduce_bytes": (
+        "counter", ("axis",),
+        "allreduce payload bytes per TRACED comm.allreduce/"
+        "allreduce_fusion call (static shapes: the per-call traffic of "
+        "the compiled program; wire bytes = 2(p-1)/p x payload, "
+        "costmodel.collective).  Joins measured collective traffic "
+        "against the roofline's predicted ICI bytes"),
     # -- serving-loop phase decomposition (bench.py) ----------------------
     "serving.phase_us": (
         "histogram", ("phase",),
@@ -142,4 +158,6 @@ API_OPS = frozenset({
     "min_p_sampling_from_probs", "top_k_top_p_sampling_from_probs",
     # serve/step.py (the compile-once fused serving steps)
     "serve.step", "serve.mixed_step",
+    # parallel/plan.py (the mesh-sharded fused serving step)
+    "parallel.sharded_step",
 })

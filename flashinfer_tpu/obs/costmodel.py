@@ -45,6 +45,10 @@ class Cost:
     flops_effective: Optional[float] = None
     dtype: str = "bf16"  # compute dtype -> which MXU peak applies
     op: str = ""
+    # per-chip ICI wire bytes (the collective cost family) — a THIRD
+    # roofline dimension alongside HBM bytes and FLOPs, priced against
+    # hwspec.ici_gbps by obs.roofline.  0 for every single-chip op.
+    ici_bytes: float = 0.0
 
     @property
     def bytes_total(self) -> float:
@@ -69,6 +73,7 @@ class Cost:
             if (self.flops_effective is not None
                 or other.flops_effective is not None) else None,
             dtype=self.dtype, op=self.op or other.op,
+            ici_bytes=self.ici_bytes + other.ici_bytes,
         )
 
 
@@ -592,7 +597,8 @@ def _scale(c: Cost, k: float) -> Cost:
         c, flops=c.flops * k, bytes_read=c.bytes_read * k,
         bytes_written=c.bytes_written * k,
         flops_effective=None if c.flops_effective is None
-        else c.flops_effective * k)
+        else c.flops_effective * k,
+        ici_bytes=c.ici_bytes * k)
 
 
 def serving_step(bs: int, ctx: int, layers: int, *,
@@ -610,6 +616,156 @@ def serving_step(bs: int, ctx: int, layers: int, *,
             continue
         total = phases[name] if total is None else total + phases[name]
     return dataclasses.replace(total, dtype="int8", op="serving_step")
+
+
+# -- ICI collective family (the sharded serving step's third dimension) ----
+
+# wire bytes each chip moves per payload byte for the canonical ring
+# algorithms over p ranks (scaling-book formulas; p=1 moves nothing):
+# allreduce = reduce-scatter + all-gather = 2(p-1)/p; gather/scatter
+# each (p-1)/p; all_to_all keeps 1/p local and sends the rest.
+_COLLECTIVE_WIRE_FACTOR = {
+    "allreduce": 2.0, "allgather": 1.0, "reducescatter": 1.0,
+    "alltoall": 1.0,
+}
+
+
+def collective(kind: str, payload_bytes: float, axis_size: int, *,
+               op: str = "") -> Cost:
+    """Per-chip cost of ONE collective over `axis_size` ranks.
+
+    Only the ICI dimension is charged: the payload's HBM staging traffic
+    already belongs to the producing op's write and the consuming op's
+    read (charging it again here would double-count the phase's HBM
+    bytes), and reduction adds are ~1 FLOP/element — noise against the
+    GEMMs they join, so FLOPs stay 0 to keep MFU honest."""
+    p = max(int(axis_size), 1)
+    factor = _COLLECTIVE_WIRE_FACTOR[kind]
+    wire = factor * (p - 1) / p * float(payload_bytes) if p > 1 else 0.0
+    return Cost(flops=0.0, bytes_read=0.0, bytes_written=0.0,
+                ici_bytes=wire, op=op or kind)
+
+
+def tp_allreduce(tokens: int, hidden: int, tp_size: int, *,
+                 act_bytes: int = 2) -> Cost:
+    """One TP partial-sum combine of a [tokens, hidden] activation (the
+    o_proj / down_proj epilogue — 2 of these per decoder layer)."""
+    return collective("allreduce", float(tokens) * hidden * act_bytes,
+                      tp_size, op="tp_allreduce")
+
+
+def ep_all_to_all(tokens: int, hidden: int, top_k: int, ep_size: int, *,
+                  act_bytes: int = 2) -> Cost:
+    """EP token exchange for one MoE layer: dispatch + combine, each an
+    all_to_all of the routed (token, choice) activations — the
+    ``fused_moe_ep`` "alltoall" mode's O(T*K*hidden) traffic (balanced
+    routing; capacity overflow rounds add multiples of this)."""
+    payload = float(tokens) * max(top_k, 1) * hidden * act_bytes
+    a2a = collective("alltoall", payload, ep_size, op="ep_all_to_all")
+    return dataclasses.replace(a2a, ici_bytes=2.0 * a2a.ici_bytes,
+                               op="ep_all_to_all")
+
+
+def sampling_gather(batch_local: int, vocab: int, tp_size: int, *,
+                    dp_size: int = 1, logits_bytes: int = 4) -> Cost:
+    """The sampling epilogue's gathers, per chip: the replicated-
+    sampler contract (parallel/plan.py) all-gathers the vocab-sharded
+    logits over tp AND the batch-sharded logits over dp, so every chip
+    holds the FULL [batch, vocab] f32 distribution before sampling
+    (this jax's threefry is not partitionable — a sharded sampler
+    would fork the random stream).  ``batch_local`` is the per-dp-shard
+    batch; the dp leg gathers all ``batch_local * dp`` rows."""
+    g_tp = collective("allgather",
+                      float(batch_local) * vocab * logits_bytes,
+                      tp_size, op="sampling_gather")
+    g_dp = collective("allgather",
+                      float(batch_local) * dp_size * vocab * logits_bytes,
+                      dp_size, op="sampling_gather")
+    return dataclasses.replace(g_tp + g_dp, op="sampling_gather")
+
+
+# GLOBAL dims of the sharded serving pipeline (the whole model, not the
+# per-chip shard): tp8 of this entry IS SERVING_SHAPES'
+# "llama70b_tp8shard_int8" (hq 64/8=8, hkv 8/8=1, inter 28672/8=3584,
+# vocab 128256/8=16032 — pinned by tests/test_sharded_step.py)
+SHARDED_SERVING_SHAPES: Dict[str, Dict[str, int]] = {
+    "llama70b_int8": dict(
+        hidden=8192, hq=64, hkv=8, hd=128, inter=28672,
+        vocab_shard=128256, page_size=16, weight_bytes=1, kv_bytes=1,
+    ),
+}
+
+
+def serving_phase_costs_sharded(
+    bs: int, ctx: int, layers: int, *, dp: int = 1, tp: int = 1,
+    ep: int = 1, moe_top_k: int = 0, hidden: int, hq: int, hkv: int,
+    hd: int, inter: int, vocab_shard: int, page_size: int = 16,
+    weight_bytes: int = 1, kv_bytes: int = 1, act_bytes: int = 2,
+) -> Dict[str, Cost]:
+    """PER-CHIP cost of each serving phase on a (dp, tp[, ep]) mesh,
+    from GLOBAL model dims: the single-chip formulas at the local shard
+    dims (batch/dp, heads+inter+vocab/tp — exactly the per-chip shard
+    bench.py measures at tp8), plus the collective family per phase:
+
+    - ``attention``  += one TP allreduce per layer (o_proj combine);
+    - ``moe_or_mlp`` += one TP allreduce per layer (down combine) and,
+      when ``moe_top_k > 0`` and ``ep > 1``, the EP all-to-all pair;
+    - ``sampling``   += the vocab all-gather (+ dp token exchange).
+
+    ``tp=dp=1`` degenerates exactly to :func:`serving_phase_costs` —
+    the single-chip model is the mesh model's fixed point."""
+    if hq % tp or hkv % tp or inter % tp or vocab_shard % tp or bs % dp:
+        raise ValueError(
+            f"global dims (hq {hq}, hkv {hkv}, inter {inter}, vocab "
+            f"{vocab_shard}, bs {bs}) do not tile (dp {dp}, tp {tp})")
+    bs_l = bs // dp
+    costs = serving_phase_costs(
+        bs_l, ctx, layers, hidden=hidden, hq=hq // tp, hkv=hkv // tp,
+        hd=hd, inter=inter // tp, vocab_shard=vocab_shard // tp,
+        page_size=page_size, weight_bytes=weight_bytes,
+        kv_bytes=kv_bytes, act_bytes=act_bytes)
+    L = float(layers)
+    ar = _scale(tp_allreduce(bs_l, hidden, tp, act_bytes=act_bytes), L)
+    costs["attention"] = costs["attention"] + ar
+    costs["moe_or_mlp"] = costs["moe_or_mlp"] + ar
+    if moe_top_k > 0 and ep > 1:
+        costs["moe_or_mlp"] = costs["moe_or_mlp"] + _scale(
+            ep_all_to_all(bs_l, hidden, moe_top_k, ep,
+                          act_bytes=act_bytes), L)
+    costs["sampling"] = costs["sampling"] + sampling_gather(
+        bs_l, vocab_shard, tp, dp_size=dp)
+    return costs
+
+
+def serving_step_sharded(bs: int, ctx: int, layers: int, *, dp: int = 1,
+                         tp: int = 1, ep: int = 1, moe_top_k: int = 0,
+                         **shape) -> Cost:
+    """Whole per-chip sharded decode step: phase sum with the
+    collective ICI bytes folded in (nothing excluded — the fused
+    sharded step dispatches kv_append and sampling too).  The cost
+    family of the ``parallel.sharded_step`` public op."""
+    phases = serving_phase_costs_sharded(
+        bs, ctx, layers, dp=dp, tp=tp, ep=ep, moe_top_k=moe_top_k,
+        **shape)
+    total = None
+    for name in SERVING_PHASES:
+        total = phases[name] if total is None else total + phases[name]
+    return dataclasses.replace(total, dtype="int8",
+                               op="serving_step_sharded")
+
+
+def predict_step_seconds(cost: Cost, *, hbm_tbps: float,
+                         peak_tflops: float, ici_gbps: float) -> float:
+    """Roofline-forward prediction of one step's wall time on one chip
+    of a mesh: HBM and MXU floors overlap (the deeper one binds), the
+    ICI floor adds serially — collectives on the serving critical path
+    overlap poorly with the dependent compute that waits on them (the
+    conservative no-overlap model; same physics ``obs perf`` attributes
+    with, used forward like ``predict_decode_seconds``)."""
+    t_mem = cost.bytes_total / (hbm_tbps * 1e12)
+    t_comp = cost.flops / (peak_tflops * 1e12)
+    t_ici = cost.ici_bytes / (ici_gbps * 1e9) if ici_gbps > 0 else 0.0
+    return max(t_mem, t_comp) + t_ici
 
 
 # -- @flashinfer_api coverage (obs doctor) --------------------------------
@@ -638,6 +794,9 @@ API_OP_COSTS: Dict[str, str] = {
     # lm_head + sampling — the fused step EXCLUDES nothing)
     "serve.step": "serving_step",
     "serve.mixed_step": "serving_step",
+    # the mesh twin: phase sum + the collective ICI family (tp
+    # allreduces, optional EP all-to-all, sampling gather)
+    "parallel.sharded_step": "serving_step_sharded",
 }
 
 
@@ -684,11 +843,13 @@ def cost_from_stamped_row(row: Mapping) -> Optional[Tuple[Cost, float]]:
     if seconds is None:
         return None
     eff = row.get("flops_effective")
+    ici = row.get("ici_bytes")
     return Cost(
         flops=flops, bytes_read=br, bytes_written=bw,
         flops_effective=float(eff) if isinstance(eff, (int, float))
         else None,
         dtype=str(row.get("dtype", "bf16")), op=str(row.get("phase", "")),
+        ici_bytes=float(ici) if isinstance(ici, (int, float)) else 0.0,
     ), seconds
 
 

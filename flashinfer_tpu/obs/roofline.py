@@ -66,26 +66,41 @@ class RooflineResult:
     achieved_tbps: float  # launched bytes / t
     intensity: float  # flops/byte, launched
     ridge: float  # chip ridge point at this dtype
-    bound: str  # "memory" | "compute"
+    bound: str  # "memory" | "compute" | "ici"
     pct_roofline: float  # fraction of the binding roofline, launched
     effective_pct_roofline: float  # same, useful work only
     mfu: float  # achieved_tflops / peak_tflops (launched)
     peak_tflops: float
     peak_tbps: float
+    # the ICI dimension (0 for single-chip ops): fraction of the
+    # measured time the predicted collective floor explains, and the
+    # chip's interconnect ceiling it was priced against
+    pct_ici_roofline: float = 0.0
+    peak_ici_gbps: float = 0.0
 
 
 def attribute(cost: Cost, seconds: float, spec: ChipSpec) -> RooflineResult:
-    """Join one cost with one measured wall time on one chip."""
+    """Join one cost with one measured wall time on one chip.
+
+    Three floors: HBM transfer, MXU compute, and — when the cost
+    carries collective traffic (``ici_bytes``, the sharded serving
+    families) — the ICI wire floor.  ``bound`` names the deepest one;
+    ``pct_roofline`` keeps its meaning (binding floor / measured) with
+    the ICI floor folded into the max."""
     if seconds <= 0:
         raise ValueError(f"seconds must be positive, got {seconds}")
     peak_tflops = spec.peak_tflops(cost.dtype)
     peak_tbps = spec.hbm_tbps
     t_mem = cost.bytes_total / (peak_tbps * 1e12)
     t_comp = cost.flops / (peak_tflops * 1e12)
-    bound = "memory" if t_mem >= t_comp else "compute"
+    t_ici = cost.ici_bytes / (spec.ici_gbps * 1e9) \
+        if cost.ici_bytes else 0.0
+    if t_ici > max(t_mem, t_comp):
+        bound = "ici"
+    else:
+        bound = "memory" if t_mem >= t_comp else "compute"
     eff_flops = cost.effective_flops
-    t_roof_eff = max(cost.bytes_total / (peak_tbps * 1e12),
-                     eff_flops / (peak_tflops * 1e12))
+    t_roof_eff = max(t_mem, eff_flops / (peak_tflops * 1e12), t_ici)
     return RooflineResult(
         chip=spec.name, dtype=hwspec.normalize_dtype(cost.dtype),
         achieved_tflops=cost.flops / seconds / 1e12,
@@ -94,17 +109,20 @@ def attribute(cost: Cost, seconds: float, spec: ChipSpec) -> RooflineResult:
         intensity=cost.intensity,
         ridge=spec.ridge_intensity(cost.dtype),
         bound=bound,
-        pct_roofline=max(t_mem, t_comp) / seconds,
+        pct_roofline=max(t_mem, t_comp, t_ici) / seconds,
         effective_pct_roofline=t_roof_eff / seconds,
         mfu=cost.flops / seconds / 1e12 / peak_tflops,
         peak_tflops=peak_tflops, peak_tbps=peak_tbps,
+        pct_ici_roofline=t_ici / seconds,
+        peak_ici_gbps=spec.ici_gbps,
     )
 
 
 def stamp_row(row: Dict, cost: Cost, seconds: float,
               spec: ChipSpec, *, num_splits: Optional[int] = None,
               merge_bytes: Optional[float] = None,
-              step_mode: Optional[str] = None) -> Dict:
+              step_mode: Optional[str] = None,
+              mesh_axes: Optional[str] = None) -> Dict:
     """Write the canonical roofline fields onto a bench row in place.
     Every bench.py routine stamps through here — the uniform schema is
     what makes ``obs perf`` and the auditor's roofline-fraction rule
@@ -121,7 +139,15 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
     (``"fused"`` — the compile-once donated serve/step.py program —
     vs ``"per_op"``, the per-phase jitted micro-loop): like
     num_splits it is CONFIGURATION, so the two serving-loop shapes
-    keep separate audit histories even at identical model shapes."""
+    keep separate audit histories even at identical model shapes.
+
+    ``mesh_axes`` is the mesh-shape identity of a SHARDED row
+    (``ShardingPlan.mesh_axes``, e.g. ``"dp1.tp8"``): configuration
+    like step_mode — a tp8 row must never compete with tp1 history.
+    Costs carrying collective traffic additionally stamp ``ici_bytes``
+    and ``pct_ici_roofline`` (measurement fields: the predicted ICI
+    wire bytes and the fraction of measured time the ICI floor
+    explains)."""
     res = attribute(cost, seconds, spec)
     if num_splits is not None:
         row["num_splits"] = int(num_splits)
@@ -129,6 +155,11 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
         row["merge_bytes"] = float(merge_bytes)
     if step_mode is not None:
         row["step_mode"] = str(step_mode)
+    if mesh_axes is not None:
+        row["mesh_axes"] = str(mesh_axes)
+    if cost.ici_bytes:
+        row["ici_bytes"] = float(cost.ici_bytes)
+        row["pct_ici_roofline"] = round(res.pct_ici_roofline, 4)
     row["flops"] = float(cost.flops)
     row["bytes_read"] = float(cost.bytes_read)
     row["bytes_written"] = float(cost.bytes_written)
@@ -194,10 +225,89 @@ def _row_group(row: Mapping) -> str:
     """Stable per-op grouping key for the efficiency table."""
     parts = [str(row.get("phase"))]
     for f in ("kind", "op", "variant", "backend", "mode", "layout",
-              "step_mode"):
+              "step_mode", "mesh_axes"):
         if row.get(f) is not None:
             parts.append(f"{row[f]}")
     return "/".join(parts)
+
+
+# -------------------------------------------------------------------------
+# ICI-aware predictions: per-phase collective attribution + the
+# tp1->tp8 scaling curve per chip generation (the before-hardware
+# multi-chip story: dryrun + model instead of blocked on the driver)
+# -------------------------------------------------------------------------
+
+SCALING_CHIPS = ("v5e", "v5p")
+SCALING_TPS = (1, 2, 4, 8)
+# the canonical sharded serving cell the predictions quote (the
+# BASELINE.md serving north star at full model depth)
+SCALING_CELL = dict(bs=64, ctx=4096, layers=80, model="llama70b_int8")
+
+
+def predict_serving_scaling(*, bs: int = 64, ctx: int = 4096,
+                            layers: int = 80,
+                            model: str = "llama70b_int8",
+                            chips: Sequence[str] = SCALING_CHIPS,
+                            tps: Sequence[int] = SCALING_TPS) -> dict:
+    """Predicted tp scaling of the sharded serving step per chip gen:
+    for each tp, the roofline-forward step time (HBM/MXU floor + serial
+    ICI floor, ``costmodel.predict_step_seconds``) of the PER-CHIP
+    shard, plus speedup vs tp1 and scaling efficiency (speedup/tp —
+    the number that says where ICI starts eating the linear win)."""
+    shape = costmodel.SHARDED_SERVING_SHAPES[model]
+    out: Dict[str, dict] = {}
+    for chip in chips:
+        spec = hwspec.spec(chip)
+        rows: Dict[str, dict] = {}
+        t1 = None
+        for tp in tps:
+            cost = costmodel.serving_step_sharded(
+                bs, ctx, layers, dp=1, tp=tp, **shape)
+            t = costmodel.predict_step_seconds(
+                cost, hbm_tbps=spec.hbm_tbps,
+                peak_tflops=spec.peak_tflops(cost.dtype),
+                ici_gbps=spec.ici_gbps)
+            t_ici = cost.ici_bytes / (spec.ici_gbps * 1e9)
+            if t1 is None:
+                t1 = t
+            res = attribute(cost, t, spec)
+            rows[str(tp)] = {
+                "pred_us": round(t * 1e6, 1),
+                "ici_us": round(t_ici * 1e6, 2),
+                "ici_bytes": cost.ici_bytes,
+                "bound": res.bound,
+                "speedup_vs_tp1": round(t1 / t, 3),
+                "scaling_efficiency": round(t1 / t / tp, 3),
+            }
+        out[spec.name] = rows
+    return out
+
+
+def predict_serving_ici(*, bs: int = 64, ctx: int = 4096,
+                        layers: int = 80, tp: int = 8, dp: int = 1,
+                        model: str = "llama70b_int8",
+                        chips: Sequence[str] = SCALING_CHIPS) -> dict:
+    """Per-serving-phase predicted collective traffic and wire time at
+    one mesh shape: which phase's collectives cost what, per chip gen —
+    the attribution half of the ICI dimension (`obs perf`)."""
+    shape = costmodel.SHARDED_SERVING_SHAPES[model]
+    phases = costmodel.serving_phase_costs_sharded(
+        bs, ctx, layers, dp=dp, tp=tp, **shape)
+    table: Dict[str, dict] = {}
+    for name in costmodel.SERVING_PHASES:
+        cost = phases[name]
+        if not cost.ici_bytes:
+            continue
+        table[name] = {
+            "ici_bytes": cost.ici_bytes,
+            "pred_ici_us": {
+                hwspec.spec(c).name: round(
+                    cost.ici_bytes / (hwspec.spec(c).ici_gbps * 1e9)
+                    * 1e6, 2)
+                for c in chips},
+        }
+    return {"model": model, "bs": bs, "ctx": ctx, "layers": layers,
+            "mesh_axes": f"dp{dp}.tp{tp}", "phases": table}
 
 
 def _attributed_rows(rows: Sequence[Mapping],
@@ -378,7 +488,7 @@ def build_perf_report(rows: Sequence[Mapping], *,
         })
 
     return {
-        "schema": "flashinfer_tpu.obs.perf/1",
+        "schema": "flashinfer_tpu.obs.perf/2",
         "chips": {name: dataclasses.asdict(s)
                   for name, s in sorted(hwspec.CHIP_SPECS.items())
                   if any(a["res"].chip == name for a in attributed)},
@@ -389,6 +499,12 @@ def build_perf_report(rows: Sequence[Mapping], *,
         "worst_offenders": offenders,
         "waste": waste,
         "serving_phase_mfu": serving,
+        # the ICI dimension (perf/2): model-predicted, so it exists
+        # before any multi-chip hardware does — per-phase collective
+        # attribution at the canonical sharded cell + the tp scaling
+        # curve per chip generation
+        "serving_ici": predict_serving_ici(**SCALING_CELL),
+        "scaling_prediction": predict_serving_scaling(**SCALING_CELL),
         "headline": _headline(attributed),
     }
 
@@ -444,6 +560,28 @@ def render_perf_report(report: Mapping) -> str:
                          f"mfu {p['mfu']:.3f}  "
                          f"pct_roofline {p['pct_roofline']:.3f} "
                          f"({p['bound']})")
+    si = report.get("serving_ici")
+    if si and si.get("phases"):
+        lines.append("")
+        lines.append(
+            f"predicted serving collectives ({si['model']} bs={si['bs']} "
+            f"ctx={si['ctx']} L={si['layers']}, {si['mesh_axes']}):")
+        for name, p in si["phases"].items():
+            per_chip = "  ".join(f"{c} {us:.1f} us"
+                                 for c, us in p["pred_ici_us"].items())
+            lines.append(f"  {name:12s} {p['ici_bytes'] / 1e6:10.2f} MB "
+                         f"ICI/step  {per_chip}")
+    sc = report.get("scaling_prediction")
+    if sc:
+        lines.append("")
+        lines.append("predicted tp scaling (sharded serving step, "
+                     "speedup vs tp1 / scaling efficiency):")
+        for chip, rows in sc.items():
+            cells = "  ".join(
+                f"tp{tp}: {r['speedup_vs_tp1']:.2f}x/"
+                f"{r['scaling_efficiency']:.2f}"
+                for tp, r in rows.items())
+            lines.append(f"  {chip}: {cells}")
     h = report.get("headline", {})
     if h:
         lines.append("")

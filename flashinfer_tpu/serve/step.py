@@ -109,6 +109,10 @@ class ServingStepPlan:
     sampling: SamplingConfig
     use_pallas: bool
     donate: bool
+    # mesh identity of a sharded plan ("" = single-device; "dp2.tp4" =
+    # compiled under parallel.plan.ShardingPlan shardings) — rides onto
+    # bench rows as configuration, never a measurement
+    mesh_axes: str = ""
 
 
 def _validate_state_geometry(plan: ServingStepPlan, caches, page_table,
@@ -199,6 +203,7 @@ class ServingStep:
         sampling: SamplingConfig = SamplingConfig(),
         donate: bool = True,
         use_pallas: Optional[bool] = None,
+        sharding_plan=None,  # parallel.plan.ShardingPlan
     ) -> None:
         """Freeze the step statics and build the jitted donated step.
 
@@ -206,7 +211,16 @@ class ServingStep:
         the traced body reads no environment (L003: the step closure
         is static).  ``decode_wrapper=`` imports the frozen attention
         plan (``plan_arrays``) instead of raw ``page_table``/
-        ``kv_lens``; geometry mismatches against ``cfg`` raise."""
+        ``kv_lens``; geometry mismatches against ``cfg`` raise.
+
+        ``sharding_plan=`` compiles the SAME body under a mesh with
+        explicit in/out shardings for every state leaf
+        (``parallel.plan.llama_step_shardings``: TP weight table, dp
+        batch state, dp-pages x tp-heads caches) — one sharded XLA
+        program per step, donation preserved.  dp-only plans are
+        tokens-bitwise with the unsharded step; tp>1 reorders the split
+        f32 contractions (documented tolerance,
+        tests/test_sharded_step.py)."""
         from flashinfer_tpu import obs
         from flashinfer_tpu.models.llama import llama_decode_step
         from flashinfer_tpu.utils import is_tpu
@@ -264,17 +278,34 @@ class ServingStep:
             else False,
             sampling=sampling, use_pallas=bool(use_pallas),
             donate=bool(donate),
+            mesh_axes=sharding_plan.mesh_axes if sharding_plan is not None
+            else "",
         )
         plan = self._plan
         self._traces = 0
         step_self = self
+        # the sampling chain must run REPLICATED under a mesh: this
+        # jax's threefry is not partitionable, so random bits generated
+        # over a sharded operand differ from the single-device stream.
+        # Pinning the logits alone is not enough — GSPMD BACK-propagates
+        # the embed-gather's dp sharding through the sampled tokens into
+        # the RNG — so the tokens are pinned too, fencing the sampler
+        # off from both sides (cost: one [B, vocab] f32 gather per step)
+        sample_sharding = (sharding_plan.replicated
+                           if sharding_plan is not None else None)
 
         def _body(params, logits, caches, page_table, kv_lens, key):
             # runs at TRACE time only: with a stable plan this counter
             # advances exactly once across the whole serving session
             step_self._traces += 1
             key, sk = jax.random.split(key)
+            if sample_sharding is not None:
+                logits = jax.lax.with_sharding_constraint(
+                    logits, sample_sharding)
             tokens = sample_next_tokens(logits, sk, plan.sampling)
+            if sample_sharding is not None:
+                tokens = jax.lax.with_sharding_constraint(
+                    tokens, sample_sharding)
             new_logits, new_caches = llama_decode_step(
                 params, plan.cfg, tokens, kv_lens, caches, page_table,
                 kv_lens, use_pallas=plan.use_pallas,
@@ -287,7 +318,19 @@ class ServingStep:
         # caller-owned (weights are shared across steps, logits feed
         # external parity/telemetry readers)
         donate_argnums = (2, 3, 4, 5) if donate else ()
-        self._step = jax.jit(_body, donate_argnums=donate_argnums)
+        if sharding_plan is not None:
+            from flashinfer_tpu.parallel.plan import (
+                compile_step_with_plan, llama_step_shardings)
+
+            in_sh, out_sh = llama_step_shardings(
+                sharding_plan, cfg, weights_int8=self._plan.weights_int8)
+            # out structure is (tokens, logits, caches, pt, lens, key);
+            # llama_step_shardings' out matches positionally
+            self._step = compile_step_with_plan(
+                _body, sharding_plan, in_shardings=in_sh,
+                out_shardings=out_sh, donate_argnums=donate_argnums)
+        else:
+            self._step = jax.jit(_body, donate_argnums=donate_argnums)
         obs.record_plan(self, replan=replan)
 
     def make_state(self, kv_caches: List[Tuple[jax.Array, jax.Array]],
