@@ -1216,11 +1216,19 @@ def phase_serving(sweep: bool):
         obs.observe("serving.phase_us", max(decomp["residual_us"], 0.0),
                     phase="residual")
 
+    # lifecycle stamps (ISSUE 10): in steady-state batch decode the
+    # per-step wall time IS each request's time-per-output-token, so
+    # the e2e row carries it under the serving-SLO name (a measurement
+    # field, bench_audit.MEASUREMENT_FIELDS).  TTFT needs a prefill ->
+    # first-token boundary this decode-only loop does not have; the
+    # serving_fused phase measures its first-step analog.
+    obs.observe("lifecycle.tpot_us", t_e2e * 1e6)
     _emit_row(**_stamp(
         dict(phase="serving", model="llama70b_tp8shard_int8",
              mode="e2e_measured", bs=bs, ctx=ctx,
              layers=L, us_step=round(t_e2e * 1e6, 1),
              tok_s_at_depth=round(bs / t_e2e, 1),
+             tpot_us=round(t_e2e * 1e6, 1),
              slope_pred_us=round(pred * 1e6, 1),
              overhead_vs_slope=round(t_e2e / max(pred, 1e-9), 3),
              overhead_decomposition=decomp,
@@ -1354,9 +1362,14 @@ def phase_serving_fused(sweep: bool):
           file=sys.stderr)
 
     # ---- wall-clock per-step of each dispatch structure: a REAL host
-    # loop (per-call dispatch included — that is the measured quantity)
+    # loop (per-call dispatch included — that is the measured quantity).
+    # Also times the FIRST post-warm step alone from a fresh serving
+    # state: the compiled-program first-token latency — the decode-side
+    # component of TTFT (prefill excluded; this bench has none), the
+    # ttft_us measurement stamp on each variant's row (ISSUE 10)
     def wall(stepfn, warm=2, steps=12, repeats=3):
         best = float("inf")
+        best_first = float("inf")
         for _ in range(repeats):
             caches = mk_caches()
             p = jnp.asarray(pt0)
@@ -1366,13 +1379,20 @@ def phase_serving_fused(sweep: bool):
                 tok, caches, p, l, sk = stepfn(
                     x0, layer_ws, caches, head, head_s, p, l, sk)
             float(tok[0])  # fence before the timed window
+            tf0 = _time.perf_counter()
+            tok, caches, p, l, sk = stepfn(
+                x0, layer_ws, caches, head, head_s, p, l, sk)
+            float(tok[0])  # first-step fence
+            best_first = min(best_first, _time.perf_counter() - tf0)
             t0 = _time.perf_counter()
             for _ in range(steps):
                 tok, caches, p, l, sk = stepfn(
                     x0, layer_ws, caches, head, head_s, p, l, sk)
             float(tok[0])  # execution fence (tunnel-safe, like testing/)
             best = min(best, (_time.perf_counter() - t0) / steps)
-        return best
+        return best, best_first
+
+    from flashinfer_tpu import obs
 
     variants = (
         ("fused", build_fused_step(spec)),
@@ -1380,18 +1400,27 @@ def phase_serving_fused(sweep: bool):
     )
     residuals = {}
     for name, stepfn in variants:
-        t = _guard_soft(f"bench.serving_fused.{name}",
-                        (bs, ctx, L, hidden, name),
-                        lambda s=stepfn: wall(s))
-        if t is None:
+        measured = _guard_soft(f"bench.serving_fused.{name}",
+                               (bs, ctx, L, hidden, name),
+                               lambda s=stepfn: wall(s))
+        if measured is None:
             print(f"# serving_fused {name}: FAILED", file=sys.stderr)
             continue
+        t, t_first = measured
         residual_us = (t - t_slope) * 1e6
         residuals[name] = residual_us
+        obs.observe("lifecycle.tpot_us", t * 1e6)
+        obs.observe("lifecycle.ttft_us", t_first * 1e6)
         _emit_row(**_stamp(
             dict(phase="serving_fused", model="llama70b_tp8shard_int8",
                  variant=name, bs=bs, ctx=ctx, layers=L,
                  us_step=round(t * 1e6, 1),
+                 # lifecycle stamps (measurement fields): steady-state
+                 # per-token latency + compiled first-token latency
+                 # from a fresh state (decode-side TTFT; no prefill
+                 # exists in this loop)
+                 tpot_us=round(t * 1e6, 1),
+                 ttft_us=round(t_first * 1e6, 1),
                  slope_pred_us=round(t_slope * 1e6, 1),
                  overhead_vs_slope=round(t / max(t_slope, 1e-9), 3),
                  dispatch_residual_us=round(residual_us, 1),
@@ -1399,7 +1428,8 @@ def phase_serving_fused(sweep: bool):
             cost, t, step_mode=name))
         print(f"# serving_fused {name:7s}: {t*1e6:9.1f} us/step "
               f"({t/max(t_slope,1e-9):.3f}x slope, residual "
-              f"{residual_us:+.1f} us)", file=sys.stderr)
+              f"{residual_us:+.1f} us, first-step {t_first*1e6:.1f} us)",
+              file=sys.stderr)
     if len(residuals) == 2:
         delta = residuals["per_op"] - residuals["fused"]
         print(f"# serving_fused dispatch residual delta (per_op - fused): "
@@ -1544,8 +1574,12 @@ def phase_serving_sharded(sweep: bool):
     print(f"# serving_sharded slope floor: {t_slope*1e6:9.1f} us/step",
           file=sys.stderr)
 
+    # first post-warm step timed alone from a fresh state: the mesh
+    # program's first-token latency — the decode-side ttft_us stamp
+    # (same protocol as phase_serving_fused's wall())
     def wall(stepfn, warm=2, steps=12, repeats=3):
         best = float("inf")
+        best_first = float("inf")
         for _ in range(repeats):
             caches = mk_caches()
             p = jnp.asarray(pt0)
@@ -1555,13 +1589,18 @@ def phase_serving_sharded(sweep: bool):
                 tok, caches, p, l, sk = stepfn(
                     x0, layer_ws, caches, head, head_s, p, l, sk)
             float(tok[0])  # fence before the timed window
+            tf0 = _time.perf_counter()
+            tok, caches, p, l, sk = stepfn(
+                x0, layer_ws, caches, head, head_s, p, l, sk)
+            float(tok[0])  # first-step fence
+            best_first = min(best_first, _time.perf_counter() - tf0)
             t0 = _time.perf_counter()
             for _ in range(steps):
                 tok, caches, p, l, sk = stepfn(
                     x0, layer_ws, caches, head, head_s, p, l, sk)
             float(tok[0])  # execution fence (tunnel-safe)
             best = min(best, (_time.perf_counter() - t0) / steps)
-        return best
+        return best, best_first
 
     fused = build_sharded_fused_step(spec, plan, num_layers=L)
     variants = (
@@ -1570,18 +1609,21 @@ def phase_serving_sharded(sweep: bool):
     )
     residuals = {}
     for name, stepfn in variants:
-        t = _guard_soft(f"bench.serving_sharded.{name}",
-                        (bs, ctx, L, hidden, plan.mesh_axes, name),
-                        lambda s=stepfn: wall(s))
-        if t is None:
+        measured = _guard_soft(f"bench.serving_sharded.{name}",
+                               (bs, ctx, L, hidden, plan.mesh_axes, name),
+                               lambda s=stepfn: wall(s))
+        if measured is None:
             print(f"# serving_sharded {name}: FAILED", file=sys.stderr)
             continue
+        t, t_first = measured
         residual_us = (t - t_slope) * 1e6
         residuals[name] = residual_us
         _emit_row(**_stamp(
             dict(phase="serving_sharded", model="llama70b_int8",
                  variant=name, bs=bs, ctx=ctx, layers=L,
                  us_step=round(t * 1e6, 1),
+                 tpot_us=round(t * 1e6, 1),
+                 ttft_us=round(t_first * 1e6, 1),
                  slope_pred_us=round(t_slope * 1e6, 1),
                  overhead_vs_slope=round(t / max(t_slope, 1e-9), 3),
                  dispatch_residual_us=round(residual_us, 1),
@@ -1589,7 +1631,8 @@ def phase_serving_sharded(sweep: bool):
             cost, t, step_mode=name, mesh_axes=plan.mesh_axes))
         print(f"# serving_sharded {name:7s}: {t*1e6:9.1f} us/step "
               f"({t/max(t_slope,1e-9):.3f}x slope, residual "
-              f"{residual_us:+.1f} us)", file=sys.stderr)
+              f"{residual_us:+.1f} us, first-step {t_first*1e6:.1f} us)",
+              file=sys.stderr)
     if fused.num_traces != 1:
         print(f"# serving_sharded WARNING: fused step traced "
               f"{fused.num_traces}x (compile-once broke)", file=sys.stderr)

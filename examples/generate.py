@@ -38,11 +38,38 @@ if "cpu" in sys.argv or not os.environ.get("EXAMPLE_USE_TPU"):
 
 import jax.numpy as jnp
 
+# the example demonstrates the metered serving lifecycle (ISSUE 10):
+# flight recorder ON by default here (an explicit FLASHINFER_TPU_SPANS=0
+# still wins — the library itself stays zero-overhead-by-default)
+os.environ.setdefault("FLASHINFER_TPU_SPANS", "1")
+
 import flashinfer_tpu as fi
+from flashinfer_tpu import obs
 from flashinfer_tpu.logits_processor import (
     LogitsPipe, Sample, Softmax, Temperature, TopK, TopP,
 )
 from flashinfer_tpu.models import LlamaConfig, init_llama_params, llama_decode_step
+
+
+def _print_lifecycle_summary(label: str) -> None:
+    """Per-run request-lifecycle summary out of the flight recorder's
+    histograms (TTFT / TPOT p50+p99, tok/s) — silent when the spans
+    gate is off."""
+    ls = obs.lifecycle_snapshot()
+    if not ls:
+        return
+
+    def pq(name):
+        h = ls.get(name)
+        if not h:
+            return "n/a"
+        return f"p50 {h.get('p50', 0):.0f} / p99 {h.get('p99', 0):.0f}"
+
+    toks = ls.get("lifecycle.tokens_per_s") or {}
+    print(f"# lifecycle[{label}]: ttft_us {pq('lifecycle.ttft_us')} | "
+          f"tpot_us {pq('lifecycle.tpot_us')} | "
+          f"tok/s p50 {toks.get('p50', 0):.1f} "
+          f"({toks.get('count', 0)} requests)")
 
 
 def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
@@ -86,6 +113,12 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
     from flashinfer_tpu.norm import rmsnorm
     from flashinfer_tpu.activation import silu_and_mul
     from flashinfer_tpu.rope import apply_rope_pos_ids
+
+    # request lifecycle (flight recorder): admitted here, queue window
+    # closed by the prefill chunk, TTFT at the first sampled token
+    rids = [f"req{b}" for b in range(B)]
+    for rid in rids:
+        obs.request_begin(rid)
 
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(1, cfg.vocab_size, l).tolist() for l in prompt_lens]
@@ -156,6 +189,10 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
     logits = all_logits[last_idx]
     kv_lens = jnp.asarray(seq_lens)
     out_tokens = [[] for _ in range(B)]
+    # the whole ragged batch prefilled in one pass: each request's
+    # prompt chunk lands now (closing its queue window)
+    for b, rid in enumerate(rids):
+        obs.prefill_chunk(rid, prompt_lens[b])
 
     # ---- fused decode loop (serve/step.py): plan ONCE outside the
     # loop — all statics (shapes, page geometry, sampling config,
@@ -180,11 +217,18 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
         lens_host = np.asarray(kv_lens)
         state = sstep.make_state(caches, page_table, kv_lens, logits,
                                  jax.random.PRNGKey(seed + 1))
+        # the fused loop IS the serving path, so it owns the real
+        # begin -> prefill -> decode lifecycle lanes (`rids`); the
+        # per-op loop below becomes the parity oracle and gets fresh
+        # decode-only lanes — otherwise its TTFT would absorb this
+        # whole fused replay's wall time
         fused_out = [[] for _ in range(B)]
         for _ in range(max_new_tokens):
             tokens, state = sstep.run(params, state)
             for b in range(B):
                 fused_out[b].append(int(tokens[b]))
+                obs.decode_step(rids[b])
+        fused_summaries = [obs.request_finish(rid) for rid in rids]
         assert sstep.num_traces == 1, (
             f"fused step traced {sstep.num_traces}x across "
             f"{max_new_tokens} tokens — the compile-once contract broke")
@@ -207,19 +251,38 @@ def generate(prompt_lens, max_new_tokens=8, seed=0, int8_weights=False,
     )
     pipe = LogitsPipe([Temperature(), Softmax(), TopK(), TopP(), Sample()])
     key = jax.random.PRNGKey(seed + 1)
+    if fused_out is not None:
+        # parity-oracle lanes: decode-only, begun NOW (the real
+        # request lifecycle already finished through the fused loop)
+        perop_rids = [f"req{b}.per_op" for b in range(B)]
+        for rid in perop_rids:
+            obs.request_begin(rid)
+    else:
+        perop_rids = rids
     for step in range(max_new_tokens):
         key, sk = jax.random.split(key)
         tokens = pipe(logits, key=sk, temperature=0.8, top_k=40, top_p=0.95)
         for b in range(B):
             out_tokens[b].append(int(tokens[b]))
+            obs.decode_step(perop_rids[b])
         logits, caches = step_fn(
             params, cfg, tokens, kv_lens, caches, page_table, kv_lens,
         )
         kv_lens = kv_lens + 1
+    summaries = [obs.request_finish(rid) for rid in perop_rids]
     if fused_out is not None:
         assert fused_out == out_tokens, (
             f"fused-step tokens {fused_out} != per-op loop "
             f"{out_tokens} — the fused step changed numerics")
+        if all(summaries) and all(fused_summaries):
+            # the SPAN LAYER's per-request token counts must agree
+            # between the two dispatch structures too — the lifecycle
+            # metering is part of the parity contract, not a bystander
+            fused_counts = [s["tokens"] for s in fused_summaries]
+            perop_counts = [s["tokens"] for s in summaries]
+            assert fused_counts == perop_counts, (
+                f"span-layer token counts diverge: fused {fused_counts} "
+                f"!= per-op {perop_counts}")
         print("# fused-step parity: "
               f"{max_new_tokens} tokens/request identical, 1 trace")
     return out_tokens
@@ -273,6 +336,9 @@ def generate_stepwise(model: str, prompt_lens, max_new_tokens=8, seed=0):
 
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(1, cfg.vocab_size, l) for l in prompt_lens]
+    rids = [f"{model}.req{b}" for b in range(B)]
+    for rid in rids:
+        obs.request_begin(rid)
     maxp = max(prompt_lens)
     kv_lens = jnp.zeros((B,), jnp.int32)
     # consume prompts; each request's HANDOFF logits are captured at its
@@ -286,6 +352,10 @@ def generate_stepwise(model: str, prompt_lens, max_new_tokens=8, seed=0):
         active = jnp.asarray([t < l for l in prompt_lens])
         positions = jnp.minimum(kv_lens, t)
         logits, caches = step(toks, positions, caches, page_table, kv_lens)
+        # stepwise prefill: each ACTIVE request advanced one prompt token
+        for b, rid in enumerate(rids):
+            if t < prompt_lens[b]:
+                obs.prefill_chunk(rid, 1)
         finished_now = jnp.asarray([t == l - 1 for l in prompt_lens])
         handoff = jnp.where(finished_now[:, None], logits, handoff)
         kv_lens = kv_lens + active.astype(jnp.int32)
@@ -299,8 +369,11 @@ def generate_stepwise(model: str, prompt_lens, max_new_tokens=8, seed=0):
         tokens = pipe(logits, key=sk, temperature=0.8, top_k=40, top_p=0.95)
         for b in range(B):
             out_tokens[b].append(int(tokens[b]))
+            obs.decode_step(rids[b])
         logits, caches = step(tokens, kv_lens, caches, page_table, kv_lens)
         kv_lens = kv_lens + 1
+    for rid in rids:
+        obs.request_finish(rid)
     return out_tokens
 
 
@@ -319,4 +392,21 @@ if __name__ == "__main__":
             (" fused-step" if fused else "")
     for b, toks in enumerate(outs):
         print(f"request {b}: generated {toks}")
+    _print_lifecycle_summary(label)
+    # FLASHINFER_TPU_SPANS_OUT=<path>: export this run's flight
+    # recorder as the unified chrome trace (spans + registry snapshot
+    # on the shared clock base) — the file `python -m
+    # flashinfer_tpu.obs trace` produces from its built-in loop, here
+    # from a REAL generate run
+    out_path = os.environ.get("FLASHINFER_TPU_SPANS_OUT")
+    if out_path and obs.spans_enabled():
+        from flashinfer_tpu.obs import export, spans
+
+        trace = export.write_unified_trace(out_path, obs.snapshot(),
+                                           None, spans.drain())
+        problems = export.validate_chrome_trace(trace,
+                                                require_lifecycle=True)
+        assert not problems, problems
+        print(f"# unified trace -> {out_path} "
+              f"({len(trace['traceEvents'])} events, schema-valid)")
     print(f"generate.py ok ({label})")

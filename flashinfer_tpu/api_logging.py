@@ -101,9 +101,9 @@ def _instrumentation_active() -> bool:
 
     if _trace._trace_enabled() or _trace._apply_enabled():
         return True
-    from flashinfer_tpu.obs.registry import metrics_enabled
+    from flashinfer_tpu.obs.registry import metrics_enabled, spans_enabled
 
-    return metrics_enabled()
+    return metrics_enabled() or spans_enabled()
 
 
 def _instrumented_call(f: Callable, api_name: str, args, kwargs):
@@ -160,6 +160,16 @@ def _instrumented_call(f: Callable, api_name: str, args, kwargs):
     if metrics_on:
         # host dispatch cost: wrapper entry to op return, no device sync
         reg.observe("api.dispatch_us", (t_host - t0) * 1e6, op=api_name)
+    if _registry.spans_enabled():
+        # flight-recorder dispatch span over the SAME window as the
+        # dispatch histogram; parented under whatever request/phase
+        # span is open on this thread (obs.spans nesting), so serving
+        # ops land inside their request's lifecycle on the unified
+        # trace.  Substituted calls are covered too — same rule as the
+        # timeline span below.
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.record(api_name, "dispatch", t0, t_host)
     if timeline_on:
         if os.environ.get("FLASHINFER_TPU_TIMELINE_SYNC") == "1":
             import jax

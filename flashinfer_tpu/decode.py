@@ -375,6 +375,10 @@ class BatchDecodeWithPagedKVCacheWrapper:
                 ("batch", b_bucket, batch),
                 ("pages", b_bucket * p_bucket, int(indices.size)),
             ),
+            # flight recorder (FLASHINFER_TPU_SPANS): a replan whose
+            # frozen statics moved forces a fresh kernel compile on the
+            # next run() — the diff names the exact static that changed
+            statics=self._plan,
         )
 
     @property

@@ -37,13 +37,20 @@ See docs/observability.md for the full catalog and env-var matrix.
 
 from __future__ import annotations
 
+import contextlib
+
 from flashinfer_tpu.obs import catalog
-from flashinfer_tpu.obs.registry import Registry, get, metrics_enabled
+from flashinfer_tpu.obs.registry import (Registry, get, metrics_enabled,
+                                         spans_enabled)
 
 __all__ = [
-    "Registry", "get", "metrics_enabled", "catalog",
+    "Registry", "get", "metrics_enabled", "spans_enabled", "catalog",
     "counter_inc", "gauge_set", "observe", "record_plan",
     "record_dropped_tokens", "snapshot", "reset",
+    "span", "record_retrace", "state_signature", "diff_statics",
+    "diff_state_sigs", "record_span",
+    "request_begin", "prefill_chunk", "decode_step", "request_finish",
+    "lifecycle_snapshot",
 ]
 
 _declared = False
@@ -76,26 +83,34 @@ def observe(name: str, value: float, **labels) -> None:
         _registry().observe(name, value, **labels)
 
 
-def record_plan(wrapper, *, replan: bool, padded_vs_actual=()) -> None:
+def record_plan(wrapper, *, replan: bool, padded_vs_actual=(),
+                statics=None) -> None:
     """Plan-lifecycle wiring shared by the decode/prefill/attention
     wrappers: one call per plan() with the padding-waste pairs.
 
     ``padded_vs_actual``: iterable of ``(axis_name, padded, actual)``.
+    ``statics``: the NEW frozen plan (dataclass/dict) — with the spans
+    gate on, replans diff it against the wrapper's previous plan and
+    attribute the retrace cause (obs.spans.note_plan); with the gate
+    off it costs nothing and loads nothing.
     """
-    if not metrics_enabled():
-        return
-    reg = _registry()
-    name = type(wrapper).__name__
-    reg.counter_inc("plan.calls", wrapper=name)
-    if replan:
-        reg.counter_inc("plan.replans", wrapper=name)
-    for axis, padded, actual in padded_vs_actual:
-        if padded > 0:
-            reg.observe(
-                "plan.padding_waste_pct",
-                100.0 * (1.0 - float(actual) / float(padded)),
-                wrapper=name, axis=axis,
-            )
+    if metrics_enabled():
+        reg = _registry()
+        name = type(wrapper).__name__
+        reg.counter_inc("plan.calls", wrapper=name)
+        if replan:
+            reg.counter_inc("plan.replans", wrapper=name)
+        for axis, padded, actual in padded_vs_actual:
+            if padded > 0:
+                reg.observe(
+                    "plan.padding_waste_pct",
+                    100.0 * (1.0 - float(actual) / float(padded)),
+                    wrapper=name, axis=axis,
+                )
+    if statics is not None and spans_enabled():
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.note_plan(wrapper, replan=replan, statics=statics)
 
 
 def record_dropped_tokens(dropped, dispatch: str) -> int:
@@ -132,3 +147,119 @@ def snapshot() -> dict:
 
 def reset() -> None:
     _registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder facade (obs.spans; FLASHINFER_TPU_SPANS gate).
+# Every helper below checks the gate BEFORE importing the spans module,
+# so plain library use never loads it (the subprocess pin in
+# tests/test_obs_spans.py) and an instrumented call site reads as one
+# line, the same contract as the metric helpers above.
+# ---------------------------------------------------------------------------
+
+_NULL_SPAN = contextlib.nullcontext()  # reusable + reentrant
+
+
+def span(name: str, cat: str = "host", **attrs):
+    """Nested host-side span context manager (no-op when gated off)."""
+    if not spans_enabled():
+        return _NULL_SPAN
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.span(name, cat, **attrs)
+
+
+def state_signature(tree, names=None):
+    """Trace signature (structure + shape/dtype) of a run-state pytree,
+    or None when the spans gate is off — callers keep it per step and
+    diff it on retrace (serve/step.py, parallel/plan.py)."""
+    if not spans_enabled():
+        return None
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.state_signature(tree, names)
+
+
+def diff_statics(old, new):
+    """Diff two plan signatures ({key: summary} dicts); {} when the
+    spans gate is off (never imports the machinery, like every helper
+    here)."""
+    if not spans_enabled():
+        return {}
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.diff_statics(old, new)
+
+
+def diff_state_sigs(old, new, tree):
+    """Diff two run-state signatures (obs.state_signature results),
+    rendering readable leaf keys from ``tree`` — retrace-path only."""
+    if not spans_enabled():
+        return {}
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.diff_state_sigs(old, new, tree)
+
+
+def record_retrace(wrapper_name: str, changed: dict) -> None:
+    """Attribute one retrace: a flight-recorder span with the full
+    static diff + `plan.retrace_cause{wrapper,key}` counts per key."""
+    if not spans_enabled():
+        return
+    from flashinfer_tpu.obs import spans as _spans
+
+    _spans.record_retrace(wrapper_name, changed)
+
+
+def record_span(name: str, cat: str, t0: float, t1: float,
+                **attrs) -> None:
+    """Record a completed span over an already-measured [t0, t1]
+    perf_counter window (no-op when gated off) — for call sites that
+    time the work themselves, e.g. the serving steps' trace+compile
+    span over a dispatch that traced."""
+    if spans_enabled():
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.record(name, cat, t0, t1, **attrs)
+
+
+def request_begin(rid: str, **kw) -> None:
+    if spans_enabled():
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.request_begin(rid, **kw)
+
+
+def prefill_chunk(rid: str, num_tokens: int, **kw) -> None:
+    if spans_enabled():
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.prefill_chunk(rid, num_tokens, **kw)
+
+
+def decode_step(rid: str, num_tokens: int = 1, **kw) -> None:
+    if spans_enabled():
+        from flashinfer_tpu.obs import spans as _spans
+
+        _spans.decode_step(rid, num_tokens, **kw)
+
+
+def request_finish(rid: str, **kw):
+    """Close a request's lifecycle; returns the per-request summary
+    dict (tokens, ttft_us, tokens_per_s, ...) or None when gated off."""
+    if not spans_enabled():
+        return None
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.request_finish(rid, **kw)
+
+
+def lifecycle_snapshot():
+    """The lifecycle histograms (TTFT/TPOT/queue/tok-s) unflattened, or
+    {} when gated off — the per-run summary examples/generate.py
+    prints."""
+    if not spans_enabled():
+        return {}
+    from flashinfer_tpu.obs import spans as _spans
+
+    return _spans.lifecycle_snapshot()

@@ -13,9 +13,11 @@ Commands:
 - ``doctor``: device/env/backend health — collect_env, the
   FLASHINFER_TPU_* flag matrix, backend resolution, compile-guard
   quarantine state, tuner cache, registry liveness, lint hygiene
-  (the reasonless-suppression count the analyzer would fail on), and
+  (the reasonless-suppression count the analyzer would fail on),
   cost-model coverage (``@flashinfer_api`` ops with no roofline
-  attribution formula).
+  attribution formula), flight-recorder state (span coverage of the
+  serving ops — the L005 rule extended to spans), and the ranked
+  top-retrace-causes table.
 - ``perf``: the roofline doctor — attribute banked bench rows
   (``--banked BENCH_BANKED.md``) through obs.costmodel/obs.roofline
   and print the per-op efficiency table, bound classification, worst
@@ -23,6 +25,17 @@ Commands:
   round-5 VERDICT computed by hand.  ``--json`` for the
   schema-stable machine form; exits non-zero on malformed banked
   blocks (the CI smoke gate).
+- ``trace``: the flight-recorder export (ISSUE 10) — run a small
+  compile-once fused serving loop (``--steps``, default 9) with the
+  spans gate + metrics + op timeline ALL on, a metered request
+  lifecycle per batch lane, and (unless ``--no-perturb``) one
+  deliberately perturbed static at the end, then write the UNIFIED
+  chrome trace (lifecycle spans + op events + registry snapshot on one
+  clock base) to ``--out``.  ``--selftest`` exits non-zero unless the
+  export is schema-valid, the loop held the compile-once retrace
+  budget (<= 1 trace), and the perturbed static was named in the
+  retrace-cause table — the CI gate (lint.yml), the perf/2 smoke-gate
+  precedent.
 """
 
 from __future__ import annotations
@@ -86,6 +99,152 @@ def _workload() -> None:
     wp.run(qp, (kc, vc))
 
 
+def _serving_workload(steps: int, perturb: bool) -> dict:
+    """A tiny compile-once fused serving loop (tiny Llama, CPU-safe)
+    with the request lifecycle metered per batch lane: begin ->
+    prefill chunk -> ``steps`` fused decode steps -> finish.  With
+    ``perturb``, one extra run afterwards moves EXACTLY ONE run-state
+    static (the carried logits dtype) so the retrace-cause attribution
+    has a known answer.  Returns the selftest facts."""
+    from flashinfer_tpu.env import apply_platform_from_env
+
+    apply_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+
+    from flashinfer_tpu import obs
+    from flashinfer_tpu.models import LlamaConfig, init_llama_params
+    from flashinfer_tpu.serve import SamplingConfig, ServingStep
+
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    B, PS, PPR = 2, 8, 4
+    npages = B * PPR
+
+    def mk_caches():
+        return [
+            (jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim),
+                       cfg.dtype),
+             jnp.zeros((npages, cfg.num_kv_heads, PS, cfg.head_dim),
+                       cfg.dtype))
+            for _ in range(cfg.num_layers)
+        ]
+
+    def mk_pt():
+        return jnp.arange(npages, dtype=jnp.int32).reshape(B, PPR)
+
+    prompt_lens = [3, 5]
+    rids = [f"req{b}" for b in range(B)]
+    for rid in rids:
+        obs.request_begin(rid)
+
+    step = ServingStep()
+    with obs.span("serving.plan", cat="plan"):
+        step.plan(cfg, page_table=mk_pt(),
+                  kv_lens=jnp.asarray(prompt_lens, jnp.int32),
+                  sampling=SamplingConfig(temperature=0.8, top_k=40,
+                                          top_p=0.95), use_pallas=False)
+    # stand-in prefill: seed each lane's handoff logits (the real
+    # prefill flow is examples/generate.py's; the lifecycle shape —
+    # queue window closed by the first chunk — is identical)
+    with obs.span("serving.prefill", cat="prefill"):
+        logits = jax.random.normal(jax.random.PRNGKey(1),
+                                   (B, cfg.vocab_size), jnp.float32)
+        for rid, n in zip(rids, prompt_lens):
+            obs.prefill_chunk(rid, n)
+    state = step.make_state(mk_caches(), mk_pt(),
+                            jnp.asarray(prompt_lens, jnp.int32), logits,
+                            jax.random.PRNGKey(2))
+    for _ in range(int(steps)):
+        tokens, state = step.run(params, state)
+        for rid in rids:
+            obs.decode_step(rid)
+    summaries = [obs.request_finish(rid) for rid in rids]
+    traces_loop = step.num_traces
+
+    cause_keys = []
+    if perturb:
+        # the deliberate perturbation: ONE static moves (logits dtype
+        # f32 -> bf16); the attribution must name exactly "logits"
+        bad = (jax.random.normal(jax.random.PRNGKey(3),
+                                 (B, cfg.vocab_size), jnp.bfloat16),
+               mk_caches(), mk_pt(),
+               jnp.asarray(prompt_lens, jnp.int32),
+               jax.random.PRNGKey(4))
+        step.run(params, bad)
+        from flashinfer_tpu.obs import spans as _spans
+
+        cause_keys = [r["key"] for r in
+                      _spans.top_retrace_causes(obs.snapshot())
+                      if r["wrapper"] == "ServingStep"]
+    return {
+        "num_traces_loop": traces_loop,
+        "steps": int(steps),
+        "cause_keys": cause_keys,
+        "requests": [s for s in summaries if s],
+    }
+
+
+def cmd_trace(args) -> int:
+    os.environ["FLASHINFER_TPU_SPANS"] = "1"
+    os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    from flashinfer_tpu import obs, profiler
+    from flashinfer_tpu.obs import export, spans
+
+    profiler.start_timeline()
+    facts = _serving_workload(args.steps, perturb=not args.no_perturb)
+    events = profiler.stop_timeline()
+    snap = obs.snapshot()
+    trace = export.write_unified_trace(args.out, snap, events,
+                                       spans.drain())
+    problems = export.validate_chrome_trace(trace,
+                                            require_lifecycle=True)
+    # the compile-once retrace budget over the fused serving loop
+    # (test_serve_step's 9-step pin, now CI-gated with attribution)
+    if facts["num_traces_loop"] > 1:
+        problems.append(
+            f"retrace budget: {facts['num_traces_loop']} traces across "
+            f"{facts['steps']} fused steps (budget: 1)")
+    if not args.no_perturb and facts["cause_keys"] != ["logits"]:
+        problems.append(
+            "deliberate logits-dtype perturb attributed to "
+            f"{facts['cause_keys']!r}, expected ['logits']")
+
+    ls = obs.lifecycle_snapshot()
+
+    def pcts(name):
+        h = ls.get(name)
+        if not h:
+            return "n/a"
+        return (f"p50={h.get('p50', 0):.0f} p99={h.get('p99', 0):.0f} "
+                f"(n={h['count']})")
+
+    print(f"# unified trace -> {args.out} "
+          f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    print(f"# lifecycle: ttft_us {pcts('lifecycle.ttft_us')} | "
+          f"tpot_us {pcts('lifecycle.tpot_us')} | "
+          f"queue_us {pcts('lifecycle.queue_us')}", file=sys.stderr)
+    causes = spans.top_retrace_causes(snap)
+    if causes:
+        print("# top retrace causes:", file=sys.stderr)
+        for r in causes:
+            print(f"#   {r['count']:4d}  {r['wrapper']}.{r['key']}",
+                  file=sys.stderr)
+    summary = {
+        "out": args.out,
+        "events": len(trace["traceEvents"]),
+        "num_traces_loop": facts["num_traces_loop"],
+        "retrace_causes": causes,
+        "problems": problems,
+    }
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    if problems and args.selftest:
+        for p in problems:
+            print(f"error: {p}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_report(args) -> int:
     from flashinfer_tpu import obs, profiler
     from flashinfer_tpu.obs import export
@@ -117,7 +276,8 @@ def cmd_doctor(args) -> int:
     report = {"env": collect_env()}
 
     flags = {}
-    for name in ("FLASHINFER_TPU_METRICS", "FLASHINFER_TPU_LOGLEVEL",
+    for name in ("FLASHINFER_TPU_METRICS", "FLASHINFER_TPU_SPANS",
+                 "FLASHINFER_TPU_SPANS_CAP", "FLASHINFER_TPU_LOGLEVEL",
                  "FLASHINFER_TPU_BACKEND", "FLASHINFER_TPU_INTERPRET",
                  "FLASHINFER_TPU_TIMELINE_SYNC", "FLASHINFER_TPU_TRACE_DUMP",
                  "FLASHINFER_TPU_TRACE_APPLY", "FLASHINFER_TPU_CACHE_DIR",
@@ -162,6 +322,30 @@ def cmd_doctor(args) -> int:
         "histograms": len(snap["histograms"]),
         "timeline_active": profiler.timeline_active(),
     }
+
+    # flight recorder (ISSUE 10): gate + ring state, serving-op span
+    # coverage (every catalog.SERVING_OPS op must declare its span
+    # category in spans.SPAN_CATEGORIES — the L005 ships-observed rule
+    # extended to the span layer, so the unspanned list must stay
+    # empty), and the ranked top-retrace-causes table from this
+    # process's plan.retrace_cause cells
+    try:
+        from flashinfer_tpu.obs import spans as _spans
+        from flashinfer_tpu.obs.catalog import SERVING_OPS
+
+        rec = _spans.get_recorder()
+        report["spans"] = {
+            "enabled": obs.spans_enabled(),
+            "capacity": rec.capacity,
+            "recorded": rec.total,
+            "dropped": rec.dropped(),
+            "serving_ops": sorted(SERVING_OPS),
+            "unspanned_serving_ops": sorted(
+                SERVING_OPS - set(_spans.SPAN_CATEGORIES)),
+        }
+        report["retrace_causes"] = _spans.top_retrace_causes(snap)
+    except Exception as e:  # doctor must never crash on a broken tree
+        report["spans"] = f"<unavailable: {type(e).__name__}>"
 
     # static-analysis hygiene: a reasonless `# graft-lint: ok` /
     # `# wedge-lint: ok` is an unreviewable waiver (L000/W000 — the
@@ -256,6 +440,23 @@ def main(argv=None) -> int:
                     help="default chip for rows that name none "
                          "(default: v5e, the banked history's chip)")
     sp.set_defaults(fn=cmd_perf)
+    sp = sub.add_parser("trace", help="flight-recorder export: unified "
+                                      "chrome trace of a metered fused "
+                                      "serving loop")
+    sp.add_argument("--out", metavar="PATH",
+                    default="/tmp/flashinfer_tpu_unified_trace.json",
+                    help="unified chrome-trace output path")
+    sp.add_argument("--steps", type=int, default=9,
+                    help="fused serving steps (retrace budget: <= 1 "
+                         "trace across all of them)")
+    sp.add_argument("--no-perturb", action="store_true",
+                    help="skip the deliberate one-static perturbation "
+                         "(and its attribution assert)")
+    sp.add_argument("--selftest", action="store_true",
+                    help="exit non-zero unless the export is "
+                         "schema-valid, the retrace budget held, and "
+                         "the perturbed static was named (the CI gate)")
+    sp.set_defaults(fn=cmd_trace)
     args = p.parse_args(argv)
     return args.fn(args)
 

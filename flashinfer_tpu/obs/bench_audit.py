@@ -66,6 +66,10 @@ MEASUREMENT_FIELDS = frozenset({
     # here: mesh SHAPE is configuration, so a tp8 row never competes
     # with tp1 history — the step_mode/num_splits precedent
     "ici_bytes", "pct_ici_roofline",
+    # request-lifecycle stamps on serving rows (ISSUE 10): steady-state
+    # time-per-output-token and first-step-from-fresh-state latency —
+    # measurements of the same run, never identity
+    "tpot_us", "ttft_us",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

@@ -83,6 +83,31 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "says this stays at ZERO: a non-zero count means the donated "
         "state's pytree structure, a shape, or a dtype moved between "
         "steps and every step is paying a retrace"),
+    "plan.retrace_cause": (
+        "counter", ("wrapper", "key"),
+        "retrace-cause attribution (FLASHINFER_TPU_SPANS gate): one "
+        "count per frozen static that changed when a serving step "
+        "retraced under a live plan or a wrapper replan moved its "
+        "statics — key names the exact static (the L003 staticness "
+        "contract makes the diff well-defined); the ranked table in "
+        "`obs doctor` reads these cells"),
+    # -- request lifecycle (obs.spans; FLASHINFER_TPU_SPANS gate) ---------
+    "lifecycle.queue_us": (
+        "histogram", (),
+        "request queue wait: enqueue to first work (first prefill "
+        "chunk, or first token for decode-only requests)"),
+    "lifecycle.ttft_us": (
+        "histogram", (),
+        "time to first token: enqueue to the first generated token "
+        "(explicit TTFT_BUCKETS_US boundaries, 1 ms - 60 s)"),
+    "lifecycle.tpot_us": (
+        "histogram", (),
+        "time per output token: inter-token gap per decode step after "
+        "the first (explicit TPOT_BUCKETS_US boundaries, 100 us - 1 s)"),
+    "lifecycle.tokens_per_s": (
+        "histogram", (),
+        "per-request generation rate at finish: generated tokens / "
+        "(finish - enqueue)"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),
@@ -129,11 +154,39 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
 # histograms whose values are percentages, not microseconds
 PERCENT_HISTOGRAMS = ("plan.padding_waste_pct",)
 
+# Explicit request-lifecycle bucket boundaries (ISSUE 10 satellite):
+# TTFT spans interactive-serving first-token latencies (1 ms) out to
+# the multi-second cold-compile outliers; TPOT spans per-token decode
+# cadences (100 us) up to a pathological 1 s/token.  Log-spaced like
+# DEFAULT_BUCKETS_US so interpolated p50/p99 stay tight at the scales
+# serving SLOs quote.
+TTFT_BUCKETS_US: Tuple[float, ...] = (
+    1e3, 2e3, 5e3, 1e4, 2e4, 5e4, 1e5, 2e5, 5e5,
+    1e6, 2e6, 5e6, 1e7, 2e7, 6e7,
+)
+TPOT_BUCKETS_US: Tuple[float, ...] = (
+    100.0, 200.0, 500.0, 1e3, 2e3, 5e3, 1e4, 2e4, 5e4,
+    1e5, 2e5, 5e5, 1e6,
+)
+TOKENS_PER_S_BUCKETS: Tuple[float, ...] = (
+    0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1e3, 2e3, 5e3, 1e4,
+)
+
+_LIFECYCLE_BUCKETS = {
+    "lifecycle.ttft_us": TTFT_BUCKETS_US,
+    "lifecycle.tpot_us": TPOT_BUCKETS_US,
+    "lifecycle.tokens_per_s": TOKENS_PER_S_BUCKETS,
+    # lifecycle.queue_us keeps DEFAULT_BUCKETS_US (host-latency scale)
+}
+
 
 def declare(registry) -> None:
     """Pin non-default bucket boundaries on `registry`."""
     for name in PERCENT_HISTOGRAMS:
         registry.declare_histogram(name, PERCENT_BUCKETS)
+    for name, buckets in _LIFECYCLE_BUCKETS.items():
+        registry.declare_histogram(name, buckets)
 
 
 # Decorated public-API op names (decorator name= or f.__qualname__).
@@ -160,4 +213,14 @@ API_OPS = frozenset({
     "serve.step", "serve.mixed_step",
     # parallel/plan.py (the mesh-sharded fused serving step)
     "parallel.sharded_step",
+})
+
+# The serving subset of the decorated surface: ops that drive whole
+# serving steps and therefore MUST open a flight-recorder span
+# (obs.spans.SPAN_CATEGORIES declares each one's category).  ``obs
+# doctor`` flags any op listed here that spans.SPAN_CATEGORIES does not
+# cover — the span-layer extension of the L005 ships-observed rule: a
+# new serving op cannot silently ship untraceable.
+SERVING_OPS = frozenset({
+    "serve.step", "serve.mixed_step", "parallel.sharded_step",
 })

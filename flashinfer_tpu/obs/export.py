@@ -94,12 +94,14 @@ def to_chrome_trace(snapshot: dict,
     """Merge op-timeline spans (``profiler.stop_timeline`` events) with
     the metrics snapshot into one chrome-trace dict (same span encoding
     as profiler.stop_timeline's file form, so tooling treats both
-    identically)."""
+    identically — including the shared epoch clock base)."""
+    from flashinfer_tpu.profiler import perf_to_epoch_us
+
     pid = os.getpid()
     events = [
         {
             "name": e["name"], "ph": "X", "pid": pid, "tid": 0,
-            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+            "ts": perf_to_epoch_us(e["ts"]), "dur": e["dur"] * 1e6,
             "cat": "flashinfer_tpu",
         }
         for e in (timeline_events or [])
@@ -117,3 +119,120 @@ def write_chrome_trace(path: str, snapshot: dict,
 
     atomic_write_text(path, json.dumps(
         to_chrome_trace(snapshot, timeline_events)))
+
+
+# ---------------------------------------------------------------------------
+# Unified flight-recorder trace (`obs trace`, ISSUE 10): lifecycle +
+# retrace spans (obs.spans) nested with the @flashinfer_api op timeline
+# and the metrics snapshot in ONE Perfetto-loadable file — possible
+# because every recorder stamps time.perf_counter and every exporter
+# converts through profiler.perf_to_epoch_us (one clock base).
+# ---------------------------------------------------------------------------
+
+
+def to_unified_chrome_trace(snapshot: dict,
+                            timeline_events: Optional[list] = None,
+                            spans: Optional[list] = None) -> dict:
+    """One trace: flight-recorder spans (dicts from ``obs.spans.drain``)
+    on per-thread tracks, op-timeline events on the ``ops`` track, the
+    registry snapshot as the self-describing metadata event."""
+    from flashinfer_tpu.profiler import perf_to_epoch_us
+
+    pid = os.getpid()
+    events: List[dict] = []
+    for s in (spans or []):
+        args = dict(s.get("attrs") or {})
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id") is not None:
+            args["parent_id"] = s["parent_id"]
+        ev = {
+            "name": s["name"], "pid": pid, "tid": int(s.get("tid", 0)),
+            "cat": s.get("cat", "host"),
+            "ts": perf_to_epoch_us(s["ts"]),
+            "args": args,
+        }
+        if s.get("dur", 0.0) > 0.0:
+            ev.update(ph="X", dur=s["dur"] * 1e6)
+        else:
+            ev.update(ph="i", s="t")
+        events.append(ev)
+    # the op timeline rides a dedicated synthetic track so dispatch
+    # spans (which cover the same wall window from the calling thread)
+    # don't visually collide with it
+    for e in (timeline_events or []):
+        events.append({
+            "name": e["name"], "ph": "X", "pid": pid, "tid": 0,
+            "cat": "op", "ts": perf_to_epoch_us(e["ts"]),
+            "dur": e["dur"] * 1e6,
+        })
+    events.append({
+        "name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "ops (@flashinfer_api timeline)"},
+    })
+    events.append({
+        "name": "flashinfer_tpu.obs.snapshot", "ph": "M", "pid": pid,
+        "tid": 0, "args": {"snapshot": snapshot},
+    })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_unified_trace(path: str, snapshot: dict,
+                        timeline_events: Optional[list] = None,
+                        spans: Optional[list] = None) -> dict:
+    from flashinfer_tpu.utils import atomic_write_text
+
+    trace = to_unified_chrome_trace(snapshot, timeline_events, spans)
+    atomic_write_text(path, json.dumps(trace))
+    return trace
+
+
+_VALID_PH = frozenset({"X", "i", "M", "B", "E"})
+
+
+def validate_chrome_trace(trace: dict, *,
+                          require_lifecycle: bool = False) -> List[str]:
+    """Schema check of a unified trace (the `obs trace --selftest` CI
+    gate): returns the list of violations, empty when valid.
+
+    ``require_lifecycle`` additionally demands at least one
+    request-lifecycle span and the TTFT/TPOT histograms in the embedded
+    snapshot — the acceptance shape of a metered serving run."""
+    problems: List[str] = []
+    if not isinstance(trace, dict) or not isinstance(
+            trace.get("traceEvents"), list):
+        return ["trace is not a dict with a traceEvents list"]
+    snapshot = None
+    cats = set()
+    for i, ev in enumerate(trace["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing name")
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            problems.append(f"{where}: bad ph {ph!r}")
+            continue
+        if ph != "M":
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: missing numeric ts")
+            if not isinstance(ev.get("pid"), int) \
+                    or not isinstance(ev.get("tid"), int):
+                problems.append(f"{where}: missing pid/tid")
+        if ph == "X" and (not isinstance(ev.get("dur"), (int, float))
+                          or ev["dur"] < 0):
+            problems.append(f"{where}: X event needs dur >= 0")
+        cats.add(ev.get("cat"))
+        if ev.get("name") == "flashinfer_tpu.obs.snapshot":
+            snapshot = (ev.get("args") or {}).get("snapshot")
+    if snapshot is None:
+        problems.append("no flashinfer_tpu.obs.snapshot metadata event")
+    if require_lifecycle:
+        if "request" not in cats:
+            problems.append("no request-lifecycle span (cat='request')")
+        hists = (snapshot or {}).get("histograms", {})
+        for name in ("lifecycle.ttft_us", "lifecycle.tpot_us"):
+            if name not in hists:
+                problems.append(f"snapshot missing histogram {name}")
+    return problems

@@ -60,6 +60,15 @@ def metrics_enabled() -> bool:
     return os.environ.get("FLASHINFER_TPU_METRICS", "0") not in ("", "0")
 
 
+def spans_enabled() -> bool:
+    """The ``FLASHINFER_TPU_SPANS`` gate (default off) for the serving
+    flight recorder (obs.spans).  Lives HERE, not in spans.py, so the
+    gate check never imports the spans machinery — with the flag unset,
+    plain library use must not load obs.spans at all (the subprocess
+    pin in tests/test_obs_spans.py, the costmodel precedent)."""
+    return os.environ.get("FLASHINFER_TPU_SPANS", "0") not in ("", "0")
+
+
 def _label_key(labels: Dict[str, object]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -658,11 +659,15 @@ class ShardedServingStep:
     live plan increments the ``serve.step_retraces`` obs counter (the
     same catalog contract as the single-chip step)."""
 
+    _STATE_NAMES = ("x0", "layer_ws", "caches", "head", "head_s",
+                    "page_table", "kv_lens", "key")
+
     def __init__(self):
         self._plan: Optional[ShardingPlan] = None
         self._spec: Optional[Int8ShardSpec] = None
         self._step: Optional[_CountingStep] = None
         self._mode = "pjit"
+        self._last_sig = None
 
     @property
     def num_traces(self) -> int:
@@ -685,7 +690,12 @@ class ShardedServingStep:
         self._spec, self._plan, self._mode = spec, plan, mode
         self._step = build_sharded_fused_step(
             spec, plan, num_layers=num_layers, donate=donate, mode=mode)
-        obs.record_plan(self, replan=replan)
+        self._last_sig = None
+        # the sharded plan's frozen statics for retrace-cause
+        # attribution: shard spec + mesh identity + step shape
+        obs.record_plan(self, replan=replan, statics=dict(
+            spec=spec, mesh_axes=plan.mesh_axes,
+            num_layers=int(num_layers), donate=bool(donate), mode=mode))
 
     @flashinfer_api(name="parallel.sharded_step")
     def run(self, x0, layer_ws, caches, head, head_s, pt, lens, skey):
@@ -693,12 +703,27 @@ class ShardedServingStep:
 
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
+        signed = (x0, layer_ws, caches, head, head_s, pt, lens, skey)
+        sig = obs.state_signature(signed, names=self._STATE_NAMES)
         before = self._step.num_traces
+        t0 = time.perf_counter() if sig is not None else 0.0
         out = self._step(x0, layer_ws, caches, head, head_s, pt, lens,
                          skey)
-        if self._step.num_traces > before and self._step.num_traces > 1:
-            obs.counter_inc("serve.step_retraces",
-                            wrapper=type(self).__name__)
+        if self._step.num_traces > before:
+            if sig is not None:
+                obs.record_span(f"{type(self).__name__}.trace_and_compile",
+                                "compile", t0, time.perf_counter(),
+                                wrapper=type(self).__name__,
+                                trace_index=self._step.num_traces)
+            if self._step.num_traces > 1:
+                obs.counter_inc("serve.step_retraces",
+                                wrapper=type(self).__name__)
+                if sig is not None:
+                    obs.record_retrace(
+                        type(self).__name__,
+                        obs.diff_state_sigs(self._last_sig, sig, signed))
+        if sig is not None:
+            self._last_sig = sig
         return out
 
 

@@ -534,6 +534,7 @@ class BatchPrefillWithRaggedKVCacheWrapper:
             self, replan=replan,
             padded_vs_actual=(("q_tokens", tq_pad, total_q),
                               ("kv_tokens", tkv_pad, total_kv)),
+            statics=self._plan,  # retrace-cause diff source (obs.spans)
         )
 
     def run(
@@ -871,6 +872,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             padded_vs_actual=(("q_tokens", tq_pad, int(qo_indptr[-1])),
                               ("kv_tokens", tkv_pad, int(kv_indptr[-1])),
                               *unit_axes),
+            statics=self._plan,  # retrace-cause diff source (obs.spans)
         )
 
     @property

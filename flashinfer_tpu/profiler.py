@@ -69,9 +69,33 @@ def scope(name: str) -> Iterator[None]:
 # ---------------------------------------------------------------------------
 
 import threading as _threading
+import time as _time
 
 _timeline_lock = _threading.Lock()
 _timeline_events: Optional[list] = None
+
+# ---- the shared clock base (ISSUE 10 satellite) ----------------------------
+# Every host-side recorder in the tree (this op timeline, obs.spans'
+# flight recorder) stamps time.perf_counter() values; chrome-trace
+# exports used to write those RAW (perf_counter epoch ~= process start)
+# while other tooling wrote wall-clock — two trace files whose
+# timelines could never merge.  One anchor, captured once at import,
+# converts every perf_counter stamp to a common epoch-based µs value,
+# so `obs trace` nests spans and op events on ONE timeline.  (The two
+# clocks drift only by NTP slew after import — harmless at trace
+# scale; what matters is that every exporter uses the SAME anchor.)
+_EPOCH_ANCHOR = _time.time() - _time.perf_counter()
+
+
+def epoch_anchor() -> float:
+    """Epoch seconds at ``time.perf_counter() == 0`` (this process)."""
+    return _EPOCH_ANCHOR
+
+
+def perf_to_epoch_us(t: float) -> float:
+    """A ``time.perf_counter`` stamp -> epoch-based microseconds on the
+    shared trace timeline."""
+    return (float(t) + _EPOCH_ANCHOR) * 1e6
 
 
 def timeline_active() -> bool:
@@ -107,7 +131,9 @@ def stop_timeline(path: Optional[str] = None) -> list:
             "traceEvents": [
                 {
                     "name": e["name"], "ph": "X", "pid": os.getpid(), "tid": 0,
-                    "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+                    # epoch-based µs via the shared anchor, so this file
+                    # and the obs.export traces share one clock base
+                    "ts": perf_to_epoch_us(e["ts"]), "dur": e["dur"] * 1e6,
                     "cat": "flashinfer_tpu",
                 }
                 for e in events

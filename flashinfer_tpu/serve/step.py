@@ -44,6 +44,7 @@ donation contract, and the retrace conditions.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -175,10 +176,17 @@ class ServingStep:
     attention once and the fused step inherits the same frozen padded
     table."""
 
+    # signed-argument component names for the flight recorder's trace
+    # signature: a retrace-cause diff reports e.g. "logits" or
+    # "caches[0][0]" instead of an opaque pytree path
+    _STATE_NAMES = ("params", "logits", "caches", "page_table",
+                    "kv_lens", "key")
+
     def __init__(self):
         self._plan: Optional[ServingStepPlan] = None
         self._step = None
         self._traces = 0
+        self._last_sig = None  # obs.spans state signature of last run
 
     @property
     def num_traces(self) -> int:
@@ -331,7 +339,20 @@ class ServingStep:
                 out_shardings=out_sh, donate_argnums=donate_argnums)
         else:
             self._step = jax.jit(_body, donate_argnums=donate_argnums)
-        obs.record_plan(self, replan=replan)
+        self._last_sig = None  # a fresh plan resets run-state tracking
+        # statics= hands the frozen plan to the flight recorder: a
+        # replan whose statics moved records the retrace cause
+        # (plan.retrace_cause{wrapper,key}) before the next run pays
+        # it.  page_size stays OUT of the plan signature: raw-geometry
+        # plans carry the 0 "derived at make_state" sentinel, so
+        # signing it would diff sentinel-vs-frozen across replans of
+        # identical geometry (a phantom cause); a REAL page-size move
+        # is still attributed — it changes every cache's shape, which
+        # the run-state signature covers
+        statics = {f.name: getattr(self._plan, f.name)
+                   for f in dataclasses.fields(self._plan)
+                   if f.name != "page_size"}
+        obs.record_plan(self, replan=replan, statics=statics)
 
     def make_state(self, kv_caches: List[Tuple[jax.Array, jax.Array]],
                    page_table: jax.Array, kv_lens: jax.Array,
@@ -345,7 +366,9 @@ class ServingStep:
         kv_lens = jnp.asarray(kv_lens, jnp.int32)
         if not plan.page_size:
             # raw-array plan: the page size is whatever the cache
-            # carries; freeze it on first state assembly
+            # carries; freeze it on first state assembly (the flight
+            # recorder's plan signature deliberately excludes
+            # page_size, so this late freeze never skews replan diffs)
             plan = dataclasses.replace(
                 plan, page_size=int(kv_caches[0][0].shape[2]))
             self._plan = plan
@@ -369,13 +392,36 @@ class ServingStep:
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
         logits, caches, page_table, kv_lens, key = state
+        # flight recorder (FLASHINFER_TPU_SPANS): the trace signature
+        # over EVERY jitted argument — params included, so a swapped
+        # weight dtype/pytree attributes too (shape/dtype/structure
+        # only, raw tuples: never a device transfer, no string work on
+        # the hot path)
+        signed = (params, logits, caches, page_table, kv_lens, key)
+        sig = obs.state_signature(signed, names=self._STATE_NAMES)
         before = self._traces
+        t0 = time.perf_counter() if sig is not None else 0.0
         out = self._step(params, logits, caches, page_table, kv_lens, key)
-        if self._traces > before and self._traces > 1:
-            # a retrace under a live plan means a state pytree/shape/
-            # dtype moved — the compile-once contract broke
-            obs.counter_inc("serve.step_retraces",
-                            wrapper=type(self).__name__)
+        if self._traces > before:
+            if sig is not None:
+                # this dispatch paid a trace + XLA compile: give the
+                # flight recorder the phase span (first trace is the
+                # planned one; later ones are the retraces below)
+                obs.record_span(f"{type(self).__name__}.trace_and_compile",
+                                "compile", t0, time.perf_counter(),
+                                wrapper=type(self).__name__,
+                                trace_index=self._traces)
+            if self._traces > 1:
+                # a retrace under a live plan means a state pytree/
+                # shape/dtype moved — the compile-once contract broke
+                obs.counter_inc("serve.step_retraces",
+                                wrapper=type(self).__name__)
+                if sig is not None:
+                    obs.record_retrace(
+                        type(self).__name__,
+                        obs.diff_state_sigs(self._last_sig, sig, signed))
+        if sig is not None:
+            self._last_sig = sig
         tokens, new_logits, new_caches, pt, lens, new_key = out
         return tokens, (new_logits, new_caches, pt, lens, new_key)
 
@@ -427,11 +473,14 @@ class MixedServingStep:
     ``run_unfused`` executes the identical body eagerly (no jit, no
     donation) — the bit-parity oracle for the fused program."""
 
+    _STATE_NAMES = ("params", "flat_tokens", "caches", "key")
+
     def __init__(self):
         self._plan: Optional[_MixedPlan] = None
         self._body = None
         self._step = None
         self._traces = 0
+        self._last_sig = None
 
     @property
     def num_traces(self) -> int:
@@ -605,7 +654,8 @@ class MixedServingStep:
         self._body = _body
         donate_argnums = (2, 3) if donate else ()  # caches + key
         self._step = jax.jit(_body, donate_argnums=donate_argnums)
-        obs.record_plan(self, replan=replan)
+        self._last_sig = None
+        obs.record_plan(self, replan=replan, statics=self._plan)
 
     @flashinfer_api(name="serve.mixed_step")
     def run(self, params, flat_tokens, caches, key):
@@ -615,12 +665,27 @@ class MixedServingStep:
 
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
+        flat_tokens = jnp.asarray(flat_tokens, jnp.int32)
+        signed = (params, flat_tokens, caches, key)
+        sig = obs.state_signature(signed, names=self._STATE_NAMES)
         before = self._traces
-        out = self._step(params, jnp.asarray(flat_tokens, jnp.int32),
-                         caches, key)
-        if self._traces > before and self._traces > 1:
-            obs.counter_inc("serve.step_retraces",
-                            wrapper=type(self).__name__)
+        t0 = time.perf_counter() if sig is not None else 0.0
+        out = self._step(params, flat_tokens, caches, key)
+        if self._traces > before:
+            if sig is not None:
+                obs.record_span(f"{type(self).__name__}.trace_and_compile",
+                                "compile", t0, time.perf_counter(),
+                                wrapper=type(self).__name__,
+                                trace_index=self._traces)
+            if self._traces > 1:
+                obs.counter_inc("serve.step_retraces",
+                                wrapper=type(self).__name__)
+                if sig is not None:
+                    obs.record_retrace(
+                        type(self).__name__,
+                        obs.diff_state_sigs(self._last_sig, sig, signed))
+        if sig is not None:
+            self._last_sig = sig
         return out
 
     def run_unfused(self, params, flat_tokens, caches, key):
