@@ -71,6 +71,9 @@ PHASE_TIMEOUT_S = {
     # sharded fused + per-op + slope over the whole mesh: three guarded
     # first GSPMD compiles (collectives included) through the tunnel
     "serving_sharded": 2400.0,
+    # 1000+ requests through the engine TWICE (sharing + the no-sharing
+    # bitwise oracle), thousands of host-scheduled step dispatches
+    "serving_engine": 2400.0,
     "prefill": 1500.0,
     "prefill_sweep": 2400.0,
     "mla": 1200.0,
@@ -1642,6 +1645,146 @@ def phase_serving_sharded(sweep: bool):
               f"(per_op - fused): {delta:+.1f} us/step", file=sys.stderr)
 
 
+def phase_serving_engine(sweep: bool):
+    """Continuous-batching serving ENGINE (``serve/engine.py``): 1000+
+    synthetic requests with Zipf-skewed shared prefixes driven through
+    the block pool + prefix trie + SLO scheduler on the compile-once
+    rung ladder.
+
+    What the row proves (all CPU-provable — this phase measures ENGINE
+    mechanics: scheduling, prefix reuse, retrace discipline; kernel
+    throughput has its own phases):
+
+    - span-layer TTFT/TPOT p50/p99 stamped from the PR 10 lifecycle
+      histograms (requests metered begin -> prefill chunks -> decode
+      steps -> finish);
+    - measured prefix-cache hit rate > 0 with the avoided prefill
+      FLOPs priced by ``costmodel.engine_step``;
+    - the whole run stays on the pre-compiled rung ladder (<= the
+      9-trace budget ``obs trace --selftest`` pins);
+    - engine tokens BITWISE-EQUAL to the no-sharing oracle (the same
+      requests, full per-request prefill) — the phase RAISES on any
+      mismatch, so a divergent row can never land.
+
+    The roofline stamp uses the run-aggregate ``engine_step`` cost
+    (shared-prefix KV reads deduped via kv_rows), so ``obs perf``
+    attributes the cascade win mechanically."""
+    import time as _time
+
+    os.environ["FLASHINFER_TPU_SPANS"] = "1"
+    os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu import obs
+    from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+    from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
+                                      SamplingConfig, ServingEngine)
+
+    if os.environ.get("BENCH_SMALL"):
+        n_requests, n_prefixes = 1000, 32
+        prefix_len, suffix_hi, max_new = 24, 8, 4
+        mcfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+        ecfg_kw = dict(num_pages=257, page_size=8, max_batch=8,
+                       prefill_budget_tokens=32, max_seq_tokens=64)
+    else:
+        n_requests, n_prefixes = 2000, 64
+        prefix_len, suffix_hi, max_new = 96, 16, 8
+        mcfg = LlamaConfig.tiny(num_layers=4, hidden_size=512,
+                                intermediate_size=1024)
+        ecfg_kw = dict(num_pages=1025, page_size=16, max_batch=16,
+                       prefill_budget_tokens=128, max_seq_tokens=192)
+    ecfg_kw["sampling"] = SamplingConfig(temperature=0.8, top_k=40)
+    params = init_llama_params(jax.random.PRNGKey(0), mcfg)
+
+    def workload():
+        rng = np.random.default_rng(7)
+        prefixes = [[int(t) for t in
+                     rng.integers(1, mcfg.vocab_size, prefix_len)]
+                    for _ in range(n_prefixes)]
+        ranks = np.minimum(rng.zipf(1.2, n_requests) - 1, n_prefixes - 1)
+        reqs = []
+        for i in range(n_requests):
+            suffix = [int(t) for t in rng.integers(
+                1, mcfg.vocab_size, int(rng.integers(1, suffix_hi + 1)))]
+            reqs.append((f"req{i}", prefixes[int(ranks[i])] + suffix))
+        return reqs
+
+    def serve(share: bool):
+        eng = ServingEngine(mcfg, params, EngineConfig(
+            enable_prefix_cache=share, **ecfg_kw))
+        for rid, prompt in workload():
+            eng.submit(EngineRequest(rid, list(prompt),
+                                     max_new_tokens=max_new))
+        t0 = _time.perf_counter()
+        results = _guard(f"bench.serving_engine.{'share' if share else 'oracle'}",
+                         (n_requests, mcfg.hidden_size, share),
+                         lambda: eng.run())
+        return results, _time.perf_counter() - t0, eng
+
+    obs.reset()
+    results, wall, eng = serve(True)
+    snap = obs.snapshot()
+    ls = obs.lifecycle_snapshot()
+    hits = sum(snap["counters"].get("engine.prefix_hit_tokens",
+                                    {}).values())
+    misses = sum(snap["counters"].get("engine.prefix_miss_tokens",
+                                      {}).values())
+    hit_rate = hits / max(hits + misses, 1)
+    gen_tokens = sum(len(v) for v in results.values())
+
+    # the no-sharing oracle: full per-request prefill, same requests.
+    # Bitwise token equality is the engine's correctness contract
+    # (docs/serving.md) — a mismatch aborts the phase before any row.
+    oracle_results, oracle_wall, oracle_eng = serve(False)
+    if oracle_results != results:
+        bad = [rid for rid in results
+               if results[rid] != oracle_results.get(rid)]
+        raise AssertionError(
+            f"engine-vs-oracle token mismatch on {len(bad)} of "
+            f"{n_requests} requests (first: {bad[:3]}) — the shared-"
+            "prefix cascade path diverged from full prefill")
+    if eng.num_traces > 9:
+        raise AssertionError(
+            f"retrace budget breached: {eng.num_traces} traces "
+            f"across {eng.steps} engine steps (budget: 9)")
+
+    def pct(name, p):
+        h = ls.get(name) or {}
+        return round(h.get(p, 0.0), 1)
+
+    row = dict(
+        phase="serving_engine", model="llama_tiny_engine",
+        requests=n_requests, zipf_prefixes=n_prefixes,
+        bs=ecfg_kw["max_batch"], page_size=ecfg_kw["page_size"],
+        prefill_budget=ecfg_kw["prefill_budget_tokens"],
+        layers=mcfg.num_layers, hidden=mcfg.hidden_size,
+        gen_tokens=gen_tokens, engine_steps=eng.steps,
+        us_step=round(wall / max(eng.steps, 1) * 1e6, 1),
+        tok_s=round(gen_tokens / max(wall, 1e-9), 1),
+        ttft_p50_us=pct("lifecycle.ttft_us", "p50"),
+        ttft_p99_us=pct("lifecycle.ttft_us", "p99"),
+        tpot_p50_us=pct("lifecycle.tpot_us", "p50"),
+        tpot_p99_us=pct("lifecycle.tpot_us", "p99"),
+        prefix_hit_rate=round(hit_rate, 4),
+        prefill_flops_avoided=eng.flops_avoided,
+        num_traces=eng.num_traces,
+        preemptions=sum(
+            snap["counters"].get("engine.preemptions", {}).values()),
+        evictions=sum(
+            snap["counters"].get("engine.evictions", {}).values()),
+        oracle="tokens-bitwise-equal",
+        oracle_speedup=round(oracle_wall / max(wall, 1e-9), 3),
+    )
+    _emit_row(**_stamp(row, eng.aggregate_cost(), wall))
+    print(f"# serving_engine: {n_requests} reqs in {wall:.1f}s "
+          f"({row['tok_s']} tok/s), hit rate {hit_rate:.1%}, "
+          f"{eng.num_traces} traces/{eng.steps} steps, "
+          f"oracle bitwise OK ({oracle_wall:.1f}s unshared, "
+          f"{row['oracle_speedup']}x)", file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -1661,6 +1804,7 @@ PHASES = {
     "serving": phase_serving,
     "serving_fused": phase_serving_fused,
     "serving_sharded": phase_serving_sharded,
+    "serving_engine": phase_serving_engine,
     "prefill": phase_prefill,
     "mla": phase_mla,
     "selftest": phase_selftest,
@@ -1686,9 +1830,13 @@ PHASES = {
 #   first phase that occupies EVERY chip of a mesh, so a wedge there
 #   must cost nothing else; rows carry mesh_axes identity so they can
 #   never shadow single-chip history
+#   serving_engine rides at the very end: it is a host-scheduling +
+#   reuse proof (CPU-provable mechanics), so a failure there must not
+#   cost any kernel-throughput row; its rows carry the engine config
+#   as identity and lifecycle/hit-rate fields as measurements
 DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans",
                   "prefill", "mla", "decode_splits", "serving_fused",
-                  "serving_sharded"]
+                  "serving_sharded", "serving_engine"]
 
 
 # --------------------------------------------------------------------------

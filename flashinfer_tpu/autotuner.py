@@ -136,6 +136,27 @@ register_knob("parallel.ep",
               description="expert-parallel factor of the tp axis for "
                           "MoE serving steps (1 = dense; must divide "
                           "parallel.tp — the Mapping moe_ep contract)")
+# continuous-batching engine scheduler statics (serve/engine.py,
+# EngineConfig.from_knobs; shape key = (hidden, hq, hkv, hd) of the
+# served model) — the shape ladder the engine compiles is derived from
+# these, so each chip generation can trade batch width against the
+# chunked-prefill budget
+register_knob("engine.block_size",
+              description="serving-engine KV block (page) size in "
+                          "tokens — the block-pool / prefix-cache "
+                          "sharing granularity (full blocks hash into "
+                          "the prefix trie)")
+register_knob("engine.prefill_budget_tokens",
+              description="chunked-prefill token budget per engine "
+                          "step — bounds prefill's latency "
+                          "interference on decode lanes; the marginal "
+                          "chunk is additionally priced by "
+                          "costmodel.predict_step_seconds against "
+                          "EngineConfig.slo_step_seconds")
+register_knob("engine.max_batch",
+              description="serving-engine batch slots (concurrent "
+                          "running requests); also the decode floor "
+                          "of the compile-once rung ladder")
 
 
 def validate_tactic(op_name: str, value) -> Optional[str]:

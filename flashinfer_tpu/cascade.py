@@ -113,3 +113,36 @@ def merge_state_with_shared_prefix(
     """Two-level convenience merge (reference's batch_attention-with-
     shared-prefix pattern)."""
     return merge_state(v_shared, s_shared, v_unique, s_unique)
+
+
+def compose_cascade_levels(
+    levels: Sequence[Tuple[jax.Array, jax.Array]],
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched shared-prefix composition: fold per-level attention
+    states ``[(out [T, H, D], lse [T, H]), ...]`` with the associative
+    merge operator (reference cascade.cuh:45-471 merge math).
+
+    The serving engine's cascade path (``serve/engine.py``): level 0 is
+    the shared-prefix state (gathered once per prefix GROUP), level 1
+    the per-request suffix state, both over rung-padded token axes.
+    Two exactness properties the engine's bitwise contract leans on,
+    both inherited from :func:`merge_state`'s guards:
+
+    - an EMPTY level (``lse = -inf`` rows — e.g. a request with no
+      shared prefix, or a suffix query still inside the shared span)
+      passes the other level through BIT-EXACTLY: its weight is a hard
+      0.0, the survivor's weight ``exp(0) = 1.0``, and ``(0*v_a +
+      1*v_b) / 1`` is exact in IEEE arithmetic;
+    - merging is performed in f32 LSE space regardless of the levels'
+      compute dtype, so composition order inside one call is fixed.
+
+    Returns ``(out, lse)`` in f32; callers cast once afterwards."""
+    if not levels:
+        raise ValueError("compose_cascade_levels needs >= 1 level")
+    out, lse = levels[0]
+    out = out.astype(jnp.float32)
+    lse = lse.astype(jnp.float32)
+    for o_i, s_i in levels[1:]:
+        out, lse = merge_state(out, lse, o_i.astype(jnp.float32),
+                               s_i.astype(jnp.float32))
+    return out, lse

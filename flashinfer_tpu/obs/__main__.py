@@ -185,6 +185,56 @@ def _serving_workload(steps: int, perturb: bool) -> dict:
     }
 
 
+def _engine_workload(num_requests: int) -> dict:
+    """A short Zipf-skewed continuous-batching run through the serving
+    engine (tiny Llama, CPU-safe) with the request lifecycle metered —
+    the ``obs trace --engine`` selftest workload.  Returns the facts
+    the selftest gates on: total traces vs the 9-step retrace budget
+    and the measured prefix-cache hit rate (must be non-zero under a
+    Zipf prompt mix, or the trie is dead)."""
+    from flashinfer_tpu.env import apply_platform_from_env
+
+    apply_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu import obs
+    from flashinfer_tpu.models import LlamaConfig, init_llama_params
+    from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
+                                      SamplingConfig, ServingEngine)
+
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(cfg, params, EngineConfig(
+        num_pages=96, page_size=8, max_batch=4,
+        prefill_budget_tokens=24, max_seq_tokens=64,
+        sampling=SamplingConfig(temperature=0.8, top_k=20)))
+    rng = np.random.default_rng(0)
+    prefixes = [[int(t) for t in rng.integers(1, cfg.vocab_size, 17)]
+                for _ in range(4)]
+    zipf = np.minimum(rng.zipf(1.5, num_requests) - 1, len(prefixes) - 1)
+    with obs.span("engine.workload", cat="request"):
+        for i in range(num_requests):
+            prompt = prefixes[int(zipf[i])] + [
+                int(t) for t in rng.integers(1, cfg.vocab_size, 4)]
+            eng.submit(EngineRequest(f"req{i}", prompt,
+                                     max_new_tokens=3))
+        eng.run()
+    snap = obs.snapshot()
+    hits = sum(snap["counters"].get(
+        "engine.prefix_hit_tokens", {}).values())
+    misses = sum(snap["counters"].get(
+        "engine.prefix_miss_tokens", {}).values())
+    return {
+        "num_traces": eng.num_traces,
+        "rungs": len(eng._rung_traced),
+        "requests": num_requests,
+        "prefix_hit_rate": hits / max(hits + misses, 1),
+        "flops_avoided": eng.flops_avoided,
+    }
+
+
 def cmd_trace(args) -> int:
     os.environ["FLASHINFER_TPU_SPANS"] = "1"
     os.environ["FLASHINFER_TPU_METRICS"] = "1"
@@ -192,23 +242,45 @@ def cmd_trace(args) -> int:
     from flashinfer_tpu.obs import export, spans
 
     profiler.start_timeline()
-    facts = _serving_workload(args.steps, perturb=not args.no_perturb)
+    if args.engine:
+        facts = _engine_workload(args.requests)
+    else:
+        facts = _serving_workload(args.steps, perturb=not args.no_perturb)
     events = profiler.stop_timeline()
     snap = obs.snapshot()
     trace = export.write_unified_trace(args.out, snap, events,
                                        spans.drain())
     problems = export.validate_chrome_trace(trace,
                                             require_lifecycle=True)
-    # the compile-once retrace budget over the fused serving loop
-    # (test_serve_step's 9-step pin, now CI-gated with attribution)
-    if facts["num_traces_loop"] > 1:
-        problems.append(
-            f"retrace budget: {facts['num_traces_loop']} traces across "
-            f"{facts['steps']} fused steps (budget: 1)")
-    if not args.no_perturb and facts["cause_keys"] != ["logits"]:
-        problems.append(
-            "deliberate logits-dtype perturb attributed to "
-            f"{facts['cause_keys']!r}, expected ['logits']")
+    if args.engine:
+        # the ENGINE retrace budget: the whole Zipf run must stay on
+        # the pre-compiled rung ladder (<= 9 traces, the same budget
+        # the fused-step loop pins), and the prefix cache must be LIVE
+        # (a zero hit rate under a Zipf prompt mix means the trie or
+        # the block-sharing path silently broke)
+        if facts["num_traces"] > 9:
+            problems.append(
+                f"engine retrace budget: {facts['num_traces']} traces "
+                f"across {facts['requests']} requests (budget: 9)")
+        if facts["num_traces"] > facts["rungs"]:
+            problems.append(
+                f"engine retraced: {facts['num_traces']} traces for "
+                f"{facts['rungs']} rungs (compile-once broke)")
+        if facts["prefix_hit_rate"] <= 0.0:
+            problems.append(
+                "prefix-cache hit rate is ZERO under a Zipf-shared "
+                "prompt mix — the prefix trie is not taking hits")
+    else:
+        # the compile-once retrace budget over the fused serving loop
+        # (test_serve_step's 9-step pin, now CI-gated with attribution)
+        if facts["num_traces_loop"] > 1:
+            problems.append(
+                f"retrace budget: {facts['num_traces_loop']} traces "
+                f"across {facts['steps']} fused steps (budget: 1)")
+        if not args.no_perturb and facts["cause_keys"] != ["logits"]:
+            problems.append(
+                "deliberate logits-dtype perturb attributed to "
+                f"{facts['cause_keys']!r}, expected ['logits']")
 
     ls = obs.lifecycle_snapshot()
 
@@ -233,9 +305,9 @@ def cmd_trace(args) -> int:
     summary = {
         "out": args.out,
         "events": len(trace["traceEvents"]),
-        "num_traces_loop": facts["num_traces_loop"],
         "retrace_causes": causes,
         "problems": problems,
+        **{k: v for k, v in facts.items() if k != "requests"},
     }
     print(json.dumps(summary, indent=1, sort_keys=True))
     if problems and args.selftest:
@@ -370,6 +442,39 @@ def cmd_doctor(args) -> int:
     except Exception as e:  # doctor must never crash on a broken tree
         report["lint"] = f"<unavailable: {type(e).__name__}>"
 
+    # continuous-batching engine (serve/engine.py): pool occupancy,
+    # prefix-cache hit rate, eviction/preemption pressure — read from
+    # this process's registry cells (zeros in a fresh process; the
+    # serving process's doctor shows the live numbers)
+    try:
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+
+        def cell(name):
+            return sum(counters.get(name, {}).values())
+
+        def gauge(name):
+            cells = gauges.get(name, {})
+            return cells.get("") if cells else None
+
+        hits = cell("engine.prefix_hit_tokens")
+        misses = cell("engine.prefix_miss_tokens")
+        report["engine"] = {
+            "requests": cell("engine.requests"),
+            "finished": cell("engine.finished"),
+            "steps": cell("engine.steps"),
+            "prefix_hit_tokens": hits,
+            "prefix_miss_tokens": misses,
+            "prefix_hit_rate": (hits / (hits + misses)
+                                if hits + misses else None),
+            "evictions": cell("engine.evictions"),
+            "preemptions": cell("engine.preemptions"),
+            "pool_pages_in_use": gauge("engine.pool_pages_in_use"),
+            "pool_pages_free": gauge("engine.pool_pages_free"),
+        }
+    except Exception as e:  # doctor must never crash on a broken tree
+        report["engine"] = f"<unavailable: {type(e).__name__}>"
+
     # cost-model coverage (mirrors analysis L005's obs-coverage idea):
     # a decorated public op with no obs.costmodel family can bench but
     # never roofline-attribute — new ops must not silently ship
@@ -452,6 +557,17 @@ def main(argv=None) -> int:
     sp.add_argument("--no-perturb", action="store_true",
                     help="skip the deliberate one-static perturbation "
                          "(and its attribution assert)")
+    sp.add_argument("--engine", action="store_true",
+                    help="run the continuous-batching ENGINE workload "
+                         "instead of the fused-step loop: a short "
+                         "Zipf-shared-prefix request mix through "
+                         "serve/engine.py; --selftest then fails on a "
+                         "retrace-budget breach (> 9 traces or any "
+                         "trace beyond the rung ladder) or a ZERO "
+                         "prefix-cache hit rate")
+    sp.add_argument("--requests", type=int, default=24,
+                    help="engine-mode request count (Zipf-skewed "
+                         "shared prefixes)")
     sp.add_argument("--selftest", action="store_true",
                     help="exit non-zero unless the export is "
                          "schema-valid, the retrace budget held, and "

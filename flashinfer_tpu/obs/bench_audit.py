@@ -70,6 +70,14 @@ MEASUREMENT_FIELDS = frozenset({
     # time-per-output-token and first-step-from-fresh-state latency —
     # measurements of the same run, never identity
     "tpot_us", "ttft_us",
+    # continuous-batching engine rows (serving_engine phase): the
+    # measured prefix-cache hit rate, the cost-model-priced prefill
+    # FLOPs the hits avoided, and the run's compile/retrace/preempt/
+    # evict outcomes — all measurements of the same workload replay
+    # (the Zipf skew + request mix ARE identity and stay so)
+    "prefix_hit_rate", "prefill_flops_avoided", "num_traces",
+    "preemptions", "evictions",
+    "ttft_p50_us", "ttft_p99_us", "tpot_p50_us", "tpot_p99_us",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

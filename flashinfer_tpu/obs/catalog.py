@@ -108,6 +108,47 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "histogram", (),
         "per-request generation rate at finish: generated tokens / "
         "(finish - enqueue)"),
+    # -- continuous-batching engine (serve/engine.py) ---------------------
+    "engine.requests": (
+        "counter", (),
+        "requests submitted to the serving engine"),
+    "engine.finished": (
+        "counter", (),
+        "requests completed (max_new_tokens generated)"),
+    "engine.steps": (
+        "counter", (),
+        "engine steps executed (one compiled rung dispatch each)"),
+    "engine.step_tokens": (
+        "counter", (),
+        "scheduled tokens across all engine steps (decode lanes + "
+        "prefill chunk tokens; padding excluded)"),
+    "engine.prefix_hit_tokens": (
+        "counter", (),
+        "prompt tokens whose prefill was SKIPPED via a prefix-cache "
+        "hit (full-page trie matches adopted at admission) — the "
+        "numerator of the prefix hit rate; each hit's avoided FLOPs "
+        "are priced by costmodel.engine_step into "
+        "ServingEngine.flops_avoided"),
+    "engine.prefix_miss_tokens": (
+        "counter", (),
+        "prompt tokens that had to prefill (no cached block) — the "
+        "hit-rate denominator's other half"),
+    "engine.evictions": (
+        "counter", (),
+        "prefix-cache pages LRU-evicted from the block pool (cache-"
+        "only pages reclaimed to admit new requests)"),
+    "engine.preemptions": (
+        "counter", (),
+        "running requests preempted-by-eviction (pages released, "
+        "recompute-on-resume) so a higher-priority request could "
+        "admit"),
+    "engine.pool_pages_in_use": (
+        "gauge", (),
+        "block-pool pages with a non-zero refcount after the latest "
+        "engine step (requests + prefix-cache ownership)"),
+    "engine.pool_pages_free": (
+        "gauge", (),
+        "block-pool free-list depth after the latest engine step"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),
@@ -211,6 +252,8 @@ API_OPS = frozenset({
     "min_p_sampling_from_probs", "top_k_top_p_sampling_from_probs",
     # serve/step.py (the compile-once fused serving steps)
     "serve.step", "serve.mixed_step",
+    # serve/engine.py (the continuous-batching engine step)
+    "engine.step",
     # parallel/plan.py (the mesh-sharded fused serving step)
     "parallel.sharded_step",
 })
@@ -223,4 +266,5 @@ API_OPS = frozenset({
 # new serving op cannot silently ship untraceable.
 SERVING_OPS = frozenset({
     "serve.step", "serve.mixed_step", "parallel.sharded_step",
+    "engine.step",
 })

@@ -618,6 +618,64 @@ def serving_step(bs: int, ctx: int, layers: int, *,
     return dataclasses.replace(total, dtype="int8", op="serving_step")
 
 
+def engine_step(num_tokens: int, batch: int, layers: int, *, hidden: int,
+                inter: int, hq: int, hkv: int, hd: int, vocab: int,
+                kv_tokens: float, kv_rows: Optional[float] = None,
+                kv_bytes: int = 2, weight_bytes: int = 2,
+                act_bytes: int = 2, dtype: str = "bf16") -> Cost:
+    """One continuous-batching ENGINE step (serve/engine.py): mixed
+    decode + chunked-prefill tokens on one flat axis.
+
+    Counted terms, per layer x ``layers``:
+
+    - projections / MLP / norms / rope / KV append over ``num_tokens``
+      flat tokens (q/k/v, o, gate/up/down GEMMs; weights stream once
+      per step);
+    - attention FLOPs over ``kv_tokens`` attended (query, kv) pairs —
+      the scheduler passes the EXACT per-token window sums (a decode
+      lane contributes ``kv_len + 1``, a prefill chunk
+      ``chunk*kv_before + chunk(chunk+1)/2``), so admission pricing
+      sees real traffic, not a shape bound;
+    - attention KV BYTES over ``kv_rows`` streamed cache rows (default
+      ``kv_tokens``).  A caller that dedupes shared-prefix reads — the
+      cascade level-0 group gather reads a shared page run ONCE per
+      group instead of once per request — passes the deduped row count
+      here, making the prefix-cache HBM win visible to ``obs perf``.
+      FLOPs are never deduped (every query still multiplies the shared
+      keys).
+
+    Plus the lm_head + per-lane sampling epilogue over ``batch`` lanes.
+    The engine's FLOPs-avoided metering prices skipped prefill spans
+    with this same formula (``ServingEngine._prefill_cost_flops``)."""
+    qdim, kvdim = hq * hd, hkv * hd
+    L = float(layers)
+    if kv_rows is None:
+        kv_rows = kv_tokens
+
+    def g(m, n, k):
+        return gemm(m, n, k, a_bytes=act_bytes, b_bytes=weight_bytes,
+                    out_bytes=act_bytes, dtype=dtype)
+
+    per_layer = (g(num_tokens, qdim + 2 * kvdim, hidden)
+                 + g(num_tokens, hidden, qdim)
+                 + g(num_tokens, 2 * inter, hidden)
+                 + g(num_tokens, hidden, inter)
+                 + norm(num_tokens, hidden, bytes_per=act_bytes)
+                 + norm(num_tokens, hidden, bytes_per=act_bytes)
+                 + rope(num_tokens, hq + hkv, hd, bytes_per=act_bytes)
+                 + page_append(num_tokens, hkv, hd, kv_bytes=kv_bytes))
+    attn = Cost(
+        flops=2.0 * kv_tokens * hq * (2 * hd),
+        bytes_read=(num_tokens * hq * hd * act_bytes
+                    + kv_rows * hkv * (2 * hd) * kv_bytes),
+        bytes_written=float(num_tokens) * hq * hd * act_bytes,
+        dtype=dtype, op="engine_attention",
+    )
+    total = _scale(per_layer, L) + _scale(attn, L)
+    total = total + g(batch, vocab, hidden) + sampling(batch, vocab)
+    return dataclasses.replace(total, dtype=dtype, op="engine_step")
+
+
 # -- ICI collective family (the sharded serving step's third dimension) ----
 
 # wire bytes each chip moves per payload byte for the canonical ring
@@ -797,6 +855,10 @@ API_OP_COSTS: Dict[str, str] = {
     # the mesh twin: phase sum + the collective ICI family (tp
     # allreduces, optional EP all-to-all, sampling gather)
     "parallel.sharded_step": "serving_step_sharded",
+    # the continuous-batching engine step: mixed decode + chunked
+    # prefill on one flat axis, exact attended-pair accounting and a
+    # deduped shared-prefix KV-row term (the cascade level-0 gather)
+    "engine.step": "engine_step",
 }
 
 

@@ -66,6 +66,7 @@ SPAN_CATEGORIES: Dict[str, str] = {
     "serve.step": "dispatch",
     "serve.mixed_step": "dispatch",
     "parallel.sharded_step": "dispatch",
+    "engine.step": "dispatch",
 }
 
 # small plan arrays get a content fingerprint in plan signatures (value

@@ -20,12 +20,25 @@ the step never retraces across tokens.
   (chunked prefill + decode in ONE step over the holistic
   BatchAttention plan arrays), :class:`SamplingConfig`;
 - :mod:`~flashinfer_tpu.serve.shard` — the int8-weight 70B-shard step
-  pipeline bench.py's ``serving``/``serving_fused`` phases measure.
+  pipeline bench.py's ``serving``/``serving_fused`` phases measure;
+- :mod:`~flashinfer_tpu.serve.engine` — the continuous-batching
+  serving ENGINE above the steps: ref-counted paged-KV block pool,
+  prefix-cache reuse via the cascade merge operator, and SLO-aware
+  scheduling on a pre-compiled rung ladder (:class:`ServingEngine`,
+  :class:`EngineConfig`, :class:`EngineRequest`, :class:`BlockPool`,
+  :class:`PrefixCache`).
 
-See docs/performance.md ("Compile-once serving step") for the
-lifecycle and donation contract.
+See docs/performance.md ("Compile-once serving step") for the step
+lifecycle and donation contract, and docs/serving.md for the engine.
 """
 
+from flashinfer_tpu.serve.engine import (
+    BlockPool,
+    EngineConfig,
+    EngineRequest,
+    PrefixCache,
+    ServingEngine,
+)
 from flashinfer_tpu.serve.step import (
     MixedServingStep,
     SamplingConfig,
@@ -36,8 +49,13 @@ from flashinfer_tpu.serve.step import (
 )
 
 __all__ = [
+    "BlockPool",
+    "EngineConfig",
+    "EngineRequest",
     "MixedServingStep",
+    "PrefixCache",
     "SamplingConfig",
+    "ServingEngine",
     "ServingStep",
     "ServingStepPlan",
     "mixed_chunk_tokens",

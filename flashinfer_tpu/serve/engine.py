@@ -1,0 +1,1044 @@
+"""Continuous-batching serving engine on the compile-once steps.
+
+The layer that serves *many concurrent requests* — what vLLM's
+PagedAttention block pool and SGLang's RadixAttention prefix reuse
+provide above a kernel library like the reference (SURVEY: "the
+'model' layer lives in its consumers").  Three parts:
+
+- :class:`BlockPool` — a paged-KV **block pool** over the existing
+  page-table geometry: allocation, free list, and eviction with
+  REF-COUNTED block sharing, so N requests holding the same prompt
+  prefix point at one physical page run.
+- :class:`PrefixCache` — a **prefix trie** keyed on token-block hashes
+  (one node per full KV page, hash chained through the parent so equal
+  blocks at different depths never collide).  Hits skip prefill for the
+  shared span entirely; the engine composes the shared-prefix attention
+  level with the per-request suffix level through the cascade merge
+  operator (:func:`flashinfer_tpu.cascade.compose_cascade_levels`,
+  reference ``cascade.cuh:45-471``).  Hit/miss traffic is metered as
+  ``engine.prefix_{hit,miss}_tokens``.
+- :class:`ServingEngine` — the **scheduler**: request admission with
+  priority/SLO-aware ordering, chunked-prefill token budgeting that
+  packs decode + prefill chunks onto ONE flat token axis, and
+  preemption-by-eviction with recompute-on-resume.  Admission chunk
+  sizing is priced by ``obs.costmodel.predict_step_seconds`` over the
+  analytic ``engine_step`` cost family — not by heuristics (the
+  ROADMAP item 5 direction).
+
+Compile-once contract (the retrace-budget story ``obs trace
+--selftest`` gates): the engine never re-plans per scheduling decision.
+The jitted step body takes the per-step schedule — flat tokens,
+positions, scatter targets, per-token window bounds, group page runs —
+as ARGUMENTS with rung-padded shapes, so schedule *values* change
+freely without retracing.  Padded shapes come from a small LADDER of
+token-axis sizes (:attr:`EngineConfig.ladder`); each rung traces
+exactly once and steady state replays compiled programs, keeping a
+whole serving session inside the 9-trace budget.
+
+Bitwise-reproducibility contract (the test anchor): attention uses
+per-request KV windows whose row offset of KV position ``j`` is always
+``j`` — POSITION-determined, never packing-determined.  Padding lanes
+contribute exact zeros (masked ``p = 0``), and ``x + 0.0`` is exact,
+so a token's attention state is bit-identical regardless of which
+other requests share its step.  That makes engine output with prefix
+sharing ON bitwise-equal to the no-sharing oracle (same requests, full
+per-request prefill) — pinned across f32 and int8-KV caches in
+tests/test_serve_engine.py.
+
+See docs/serving.md for lifecycle, pool invariants, prefix-cache
+semantics, scheduler knobs, and the retrace-budget contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashinfer_tpu.api_logging import flashinfer_api
+from flashinfer_tpu.serve.step import SamplingConfig
+
+_NEG_INF = -1e30  # matches ops/merge.py and ops/xla_ref.py
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+# ---------------------------------------------------------------------------
+
+
+class BlockPool:
+    """Ref-counted paged-KV block pool.
+
+    Physical pages are integer ids into the engine's cache arrays
+    ``[num_pages, Hkv, page_size, Hd]``.  Page 0 is the SCRATCH page:
+    padding lanes of every step scatter into it and it is never
+    allocated, so pad writes can never clobber live KV.
+
+    Invariants (stress-pinned in tests/test_serve_engine.py):
+
+    - a page is in the free list iff its refcount is 0;
+    - ``alloc`` never returns a page whose refcount is non-zero;
+    - ``decref`` below zero raises (double-free is a bug, not a state).
+    """
+
+    SCRATCH_PAGE = 0
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("BlockPool needs >= 2 pages (page 0 is "
+                             "the reserved scratch page)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._ref = np.zeros(num_pages, np.int32)
+        # LIFO free list: recently-freed pages are re-used first (their
+        # cache lines are warm and stale contents are masked anyway)
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def ref(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` free pages at refcount 1, or None (caller evicts /
+        preempts and retries — partial allocations never escape)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            assert self._ref[p] == 0, f"free-list page {p} has refs"
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"incref on unowned page {p}")
+            self._ref[p] += 1
+
+    def decref(self, pages: Sequence[int]) -> int:
+        """Drop one reference per page; pages reaching 0 return to the
+        free list.  Returns how many pages were freed."""
+        freed = 0
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"decref on free page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache (trie keyed on token-block hashes)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _TrieNode:
+    key: Tuple[int, int]  # (parent node id, block hash)
+    page: int
+    node_id: int
+    parent: Optional[int]
+    children: int = 0
+    last_use: int = 0
+
+
+def _block_hash(parent_hash: int, tokens: Sequence[int]) -> int:
+    """Chained token-block hash: equal token blocks under different
+    prefixes hash differently (the RadixAttention/vLLM block-hash
+    scheme), so a trie edge fully identifies prefix CONTENT."""
+    h = parent_hash
+    for t in tokens:
+        h = (h * 1000003 + int(t) + 1) & 0x7FFFFFFFFFFFFFFF
+    return h
+
+
+class PrefixCache:
+    """Prefix trie over full KV pages; holds one pool reference per
+    cached page (the "cache ownership" ref), so a cached page survives
+    the requests that built it and is evictable exactly when only the
+    cache still references it (refcount == 1)."""
+
+    def __init__(self, pool: BlockPool):
+        self._pool = pool
+        self._nodes: Dict[Tuple[int, int], _TrieNode] = {}
+        self._by_id: Dict[int, _TrieNode] = {}
+        self._next_id = 1
+        self._clock = 0
+
+    @property
+    def num_pages(self) -> int:
+        return len(self._nodes)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def lookup(self, prompt: Sequence[int], max_pages: int
+               ) -> Tuple[List[int], int]:
+        """Longest cached page-run for ``prompt`` (full pages only,
+        capped at ``max_pages``) -> (pages, hit_tokens).  Bumps LRU
+        clocks; takes NO references — the caller increfs the pages it
+        actually adopts."""
+        ps = self._pool.page_size
+        pages: List[int] = []
+        parent, phash = 0, 0
+        now = self._tick()
+        for i in range(max_pages):
+            blk = prompt[i * ps:(i + 1) * ps]
+            if len(blk) < ps:
+                break
+            phash = _block_hash(phash, blk)
+            node = self._nodes.get((parent, phash))
+            if node is None:
+                break
+            node.last_use = now
+            pages.append(node.page)
+            parent = node.node_id
+        return pages, len(pages) * ps
+
+    def insert(self, prompt: Sequence[int], pages: Sequence[int],
+               upto_pages: int) -> int:
+        """Register the first ``upto_pages`` full pages of ``prompt``.
+        Pages already cached (same content) keep the EXISTING node —
+        a concurrent private copy stays private.  Newly-adopted pages
+        get one cache-ownership incref.  Returns pages adopted."""
+        ps = self._pool.page_size
+        parent, phash = 0, 0
+        now = self._tick()
+        adopted = 0
+        for i in range(upto_pages):
+            blk = prompt[i * ps:(i + 1) * ps]
+            if len(blk) < ps:
+                break
+            phash = _block_hash(phash, blk)
+            node = self._nodes.get((parent, phash))
+            if node is None:
+                node = _TrieNode(key=(parent, phash), page=int(pages[i]),
+                                 node_id=self._next_id, parent=parent,
+                                 last_use=now)
+                self._next_id += 1
+                self._nodes[node.key] = node
+                self._by_id[node.node_id] = node
+                if parent:
+                    self._by_id[parent].children += 1
+                self._pool.incref([node.page])
+                adopted += 1
+            else:
+                node.last_use = now
+            parent = node.node_id
+        return adopted
+
+    def evict(self, pages_needed: int) -> int:
+        """LRU-evict leaf nodes whose page only the cache references
+        (pool refcount == 1) until ``pages_needed`` pages are freed or
+        no candidate remains.  Returns pages actually freed.
+
+        One scan gathers ALL current candidates sorted by LRU and
+        drains them in order (admission hot path: O(nodes log nodes)
+        per trie LEVEL, not O(nodes) per page); evicting a leaf can
+        expose its parent as a new candidate, so the outer loop
+        re-scans only when a full candidate batch was not enough."""
+        from flashinfer_tpu import obs
+
+        freed = 0
+        while freed < pages_needed:
+            candidates = sorted(
+                (n for n in self._nodes.values()
+                 if not n.children and self._pool.ref(n.page) == 1),
+                key=lambda n: n.last_use)
+            if not candidates:
+                break
+            for victim in candidates:
+                del self._nodes[victim.key]
+                del self._by_id[victim.node_id]
+                if victim.parent:
+                    self._by_id[victim.parent].children -= 1
+                freed += self._pool.decref([victim.page])
+                obs.counter_inc("engine.evictions")
+                if freed >= pages_needed:
+                    break
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# Requests + engine config
+# ---------------------------------------------------------------------------
+
+_WAITING, _RUNNING, _FINISHED = "waiting", "running", "finished"
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One serving request.  ``priority`` orders admission (lower is
+    more urgent); ``slo_ttft_s`` turns into an admission deadline so
+    SLO-pressed requests overtake equal-priority peers."""
+
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int = 8
+    priority: int = 0
+    slo_ttft_s: Optional[float] = None
+
+    # -- runtime state (engine-owned) --
+    state: str = _WAITING
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    pages: List[int] = dataclasses.field(default_factory=list)
+    kv_len: int = 0          # tokens whose KV is materialized
+    split: int = -1          # cascade level boundary (page-aligned);
+    #                          frozen at FIRST admission and preserved
+    #                          across preemptions, so the two-level
+    #                          decomposition — and therefore every
+    #                          logit bit — is identical whether or not
+    #                          the request was ever preempted
+    hit_tokens: int = 0      # prefix-cache tokens adopted at admission
+    inserted_pages: int = 0  # full pages registered in the trie so far
+    folded_out: int = 0      # out tokens folded into prompt on preempt
+    arrival: int = -1
+    enqueue_t: float = 0.0
+    deadline: float = float("inf")
+    preemptions: int = 0
+
+    def seq(self) -> List[int]:
+        """The token sequence as the model sees it: prompt (including
+        any generated tokens folded back by a preemption) plus the
+        not-yet-folded generated tail."""
+        return self.prompt + self.out_tokens[self.folded_out:]
+
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.out_tokens) - self.folded_out
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Frozen engine statics.  ``block_size`` / ``prefill_budget_tokens``
+    / ``max_batch`` are autotuner knobs (``engine.*`` in KNOWN_KNOBS,
+    shape-keyed on the model's (hidden, hq, hkv, hd)); ``from_knobs``
+    resolves them through the per-chip-gen tuning configs."""
+
+    num_pages: int                  # physical pages incl. scratch page 0
+    page_size: int = 16             # engine.block_size
+    max_batch: int = 8              # engine.max_batch (batch slots)
+    prefill_budget_tokens: int = 64  # engine.prefill_budget_tokens
+    max_seq_tokens: int = 256       # per-request context bound
+    ladder: Tuple[int, ...] = ()    # rung token sizes; () = derived
+    kv_dtype: Optional[object] = None   # default model cfg dtype
+    sampling: SamplingConfig = SamplingConfig()
+    enable_prefix_cache: bool = True
+    slo_step_seconds: Optional[float] = None  # predicted-step-time cap
+    donate: bool = True
+    seed: int = 0
+
+    @staticmethod
+    def from_knobs(model_cfg, *, num_pages: int, max_seq_tokens: int = 256,
+                   **over) -> "EngineConfig":
+        """Resolve the tunable statics through ``autotuner.KNOWN_KNOBS``
+        (engine.block_size / engine.prefill_budget_tokens /
+        engine.max_batch), shape-keyed on the model geometry so each
+        chip generation ships its own scheduler shape ladder."""
+        from flashinfer_tpu.autotuner import AutoTuner
+
+        t = AutoTuner.get()
+        key = (model_cfg.hidden_size, model_cfg.num_qo_heads,
+               model_cfg.num_kv_heads, model_cfg.head_dim)
+        knobs = dict(
+            page_size=int(t.lookup("engine.block_size", key, default=16)),
+            prefill_budget_tokens=int(t.lookup(
+                "engine.prefill_budget_tokens", key, default=64)),
+            max_batch=int(t.lookup("engine.max_batch", key, default=8)),
+        )
+        knobs.update(over)
+        return EngineConfig(num_pages=num_pages,
+                            max_seq_tokens=max_seq_tokens, **knobs)
+
+    def pages_per_req(self) -> int:
+        return -(-self.max_seq_tokens // self.page_size)
+
+    def rungs(self) -> Tuple[int, ...]:
+        """The shape ladder: power-of-two token-axis sizes from the
+        decode floor (max_batch) up to the full mixed budget.  Each
+        rung is one trace — the ladder is deliberately small (<= 8
+        rungs fits the 9-trace budget with room for a warmup)."""
+        if self.ladder:
+            return tuple(sorted(set(int(r) for r in self.ladder)))
+        lo = 1
+        while lo < self.max_batch:
+            lo *= 2
+        hi = lo
+        top = self.max_batch + self.prefill_budget_tokens
+        rungs = [lo]
+        while hi < top:
+            hi *= 2
+            rungs.append(hi)
+        return tuple(rungs[:8])
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+
+class ServingEngine:
+    """Continuous-batching engine over one jitted donated step body.
+
+    >>> eng = ServingEngine(cfg, params, EngineConfig(num_pages=65))
+    >>> eng.submit(EngineRequest("r0", prompt, max_new_tokens=8))
+    >>> results = eng.run()          # {rid: [token, ...]}
+
+    One ``jax.jit`` body serves every step; the per-step schedule rides
+    as rung-padded ARGUMENTS (values change per step, shapes only per
+    rung), and the KV caches are donated back into the engine state.
+    ``num_traces`` counts compiles: steady state equals the number of
+    distinct rungs exercised, and any trace beyond a rung's first is
+    counted in ``serve.step_retraces`` + cause-attributed through the
+    flight recorder (the PR 10 machinery)."""
+
+    _STATE_NAMES = ("params", "flat_tokens", "positions", "tok_req",
+                    "token_page", "token_slot", "page_table", "grp_pages",
+                    "tok_grp", "split", "last_rows", "sample_seeds",
+                    "caches")
+
+    def __init__(self, model_cfg, params, config: EngineConfig):
+        self.cfg = model_cfg
+        self.params = params
+        self.config = config
+        self.pool = BlockPool(config.num_pages, config.page_size)
+        self.prefix_cache = PrefixCache(self.pool)
+        self._waiting: List[EngineRequest] = []
+        self._running: List[EngineRequest] = []
+        self._finished: Dict[str, EngineRequest] = {}
+        self._arrivals = 0
+        self._slots: List[Optional[EngineRequest]] = \
+            [None] * config.max_batch
+        self._traces = 0
+        self._rung_traced: Dict[int, int] = {}  # rung tokens -> traces
+        self._last_sig: Dict[int, object] = {}
+        self._steps = 0
+        self.flops_avoided = 0.0  # prefill FLOPs skipped via prefix hits
+        # aggregate work accounting for roofline stamping
+        # (costmodel.engine_step over these totals == the run's cost):
+        self.tokens_total = 0     # scheduled tokens (padding excluded)
+        self.sampled_total = 0    # lm_head + sampling lanes paid
+        self.kv_pairs_total = 0.0  # attended (q, kv) pairs (FLOPs term)
+        self.kv_rows_total = 0.0   # KV rows streamed, shared-prefix
+        #                            group gathers counted ONCE (bytes)
+        kv_dtype = (jnp.dtype(config.kv_dtype)
+                    if config.kv_dtype is not None
+                    else jnp.dtype(model_cfg.dtype))
+        self.kv_dtype = kv_dtype
+        self._int8_kv = kv_dtype == jnp.int8
+        ps, ppr = config.page_size, config.pages_per_req()
+        self.caches = [
+            (jnp.zeros((config.num_pages, model_cfg.num_kv_heads, ps,
+                        model_cfg.head_dim), kv_dtype),
+             jnp.zeros((config.num_pages, model_cfg.num_kv_heads, ps,
+                        model_cfg.head_dim), kv_dtype))
+            for _ in range(model_cfg.num_layers)
+        ]
+        self._ppr = ppr
+        self._build_step()
+
+    # -- public surface ---------------------------------------------------
+
+    @property
+    def num_traces(self) -> int:
+        return self._traces
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    def submit(self, req: EngineRequest) -> None:
+        from flashinfer_tpu import obs
+
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if req.total_len() + req.max_new_tokens > self.config.max_seq_tokens:
+            raise ValueError(
+                f"request {req.rid}: prompt+generation "
+                f"{len(req.prompt) + req.max_new_tokens} exceeds "
+                f"max_seq_tokens {self.config.max_seq_tokens}")
+        pages = -(-(len(req.prompt) + req.max_new_tokens)
+                  // self.config.page_size)
+        usable = self.config.num_pages - 1  # page 0 is scratch
+        if pages > usable:
+            # reject HERE, not at admission: an unadmittable request
+            # would otherwise preempt every lower-priority running
+            # request (discarding their KV) before discovering it can
+            # never fit, then abort the whole run
+            raise ValueError(
+                f"request {req.rid}: needs {pages} pages but the pool "
+                f"has {usable} usable — grow num_pages or shrink the "
+                "request")
+        req.arrival = self._arrivals
+        self._arrivals += 1
+        req.enqueue_t = time.perf_counter()
+        if req.slo_ttft_s is not None:
+            req.deadline = req.enqueue_t + req.slo_ttft_s
+        req.state = _WAITING
+        self._waiting.append(req)
+        obs.request_begin(req.rid)
+        obs.counter_inc("engine.requests")
+
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._running)
+
+    def run(self, max_steps: int = 100000) -> Dict[str, List[int]]:
+        """Drive steps until every submitted request finished (or the
+        step cap trips — a scheduler deadlock guard, not a limiter)."""
+        while self.has_work():
+            if self._steps >= max_steps:
+                raise RuntimeError(
+                    f"engine exceeded {max_steps} steps with work left "
+                    f"({len(self._waiting)} waiting, "
+                    f"{len(self._running)} running)")
+            self.step()
+        return {rid: list(r.out_tokens)
+                for rid, r in self._finished.items()}
+
+    def aggregate_cost(self):
+        """The whole run's work as one ``costmodel.engine_step`` Cost
+        over the accumulated totals (the formula is linear in each
+        term) — what bench.py's ``serving_engine`` phase stamps its
+        rows with, shared-prefix KV dedup included via kv_rows."""
+        from flashinfer_tpu.obs import costmodel
+
+        return costmodel.engine_step(
+            num_tokens=self.tokens_total, batch=max(self.sampled_total, 1),
+            layers=self.cfg.num_layers, hidden=self.cfg.hidden_size,
+            inter=self.cfg.intermediate_size, hq=self.cfg.num_qo_heads,
+            hkv=self.cfg.num_kv_heads, hd=self.cfg.head_dim,
+            vocab=self.cfg.vocab_size, kv_tokens=self.kv_pairs_total,
+            kv_rows=self.kv_rows_total,
+            kv_bytes=1 if self._int8_kv else 2)
+
+    # -- admission + scheduling -------------------------------------------
+
+    def _order_key(self, r: EngineRequest):
+        return (r.priority, r.deadline, r.arrival)
+
+    def _pages_needed(self, r: EngineRequest) -> int:
+        return -(-(r.total_len() + self._remaining_new(r))
+                 // self.config.page_size)
+
+    def _remaining_new(self, r: EngineRequest) -> int:
+        return r.max_new_tokens - len(r.out_tokens)
+
+    def _release(self, r: EngineRequest) -> None:
+        """Drop every pool reference the request holds and vacate its
+        batch slot (finish and preemption share this path)."""
+        if r.pages:
+            self.pool.decref(r.pages)
+            r.pages = []
+        if r.slot >= 0:
+            self._slots[r.slot] = None
+            r.slot = -1
+
+    def _preempt(self, victim: EngineRequest) -> None:
+        """Preemption-by-eviction: release the victim's pages and
+        requeue it for recompute-on-resume — its generated tokens fold
+        into the resume prompt, so decoding continues where it stopped
+        (deterministic per-token sampling seeds make the continuation
+        reproducible; pinned in tests)."""
+        from flashinfer_tpu import obs
+
+        self._running.remove(victim)
+        self._release(victim)
+        victim.prompt = victim.prompt + \
+            victim.out_tokens[victim.folded_out:]
+        victim.folded_out = len(victim.out_tokens)
+        victim.kv_len = 0
+        victim.hit_tokens = 0
+        victim.inserted_pages = 0
+        victim.state = _WAITING
+        victim.preemptions += 1
+        self._waiting.append(victim)
+        obs.counter_inc("engine.preemptions")
+
+    def _try_admit_one(self, r: EngineRequest) -> bool:
+        from flashinfer_tpu import obs
+
+        cfg = self.config
+        if r.slot < 0:
+            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            if not free_slots:
+                return False
+            slot = free_slots[0]
+        else:  # pragma: no cover - slots are assigned here only
+            slot = r.slot
+        P = len(r.prompt)
+        # the shareable span: full pages of the prompt, capped so the
+        # LAST prompt token always prefills (its logits seed token 0).
+        # Frozen on FIRST admission: a resume prompt is longer (the
+        # generated tokens folded in), and recomputing the boundary
+        # would change the cascade decomposition — correct numerically,
+        # but no longer BIT-identical to the never-preempted run
+        if r.split < 0:
+            r.split = ((P - 1) // cfg.page_size) * cfg.page_size
+        split = r.split
+        hit_pages: List[int] = []
+        hit_tokens = 0
+        if cfg.enable_prefix_cache:
+            hit_pages, hit_tokens = self.prefix_cache.lookup(
+                r.prompt, split // cfg.page_size)
+        # adopt the shared run BEFORE any eviction: the hit pages must
+        # not be evictable while we make room (refcount >= 2 fences
+        # them out of evict()'s cache-only candidate set — otherwise an
+        # eviction pass could free a hit page and alloc() could hand it
+        # back as a "fresh" page, aliasing the request's own table)
+        self.pool.incref(hit_pages)
+        need = self._pages_needed(r) - len(hit_pages)
+        if need > self.pool.free_pages:
+            self.prefix_cache.evict(need - self.pool.free_pages)
+        if need > self.pool.free_pages:
+            self.pool.decref(hit_pages)  # admission failed: un-adopt
+            return False
+        fresh = self.pool.alloc(need)
+        assert fresh is not None
+        r.pages = hit_pages + fresh
+        r.slot = slot
+        self._slots[slot] = r
+        r.kv_len = hit_tokens
+        r.hit_tokens = hit_tokens
+        r.inserted_pages = len(hit_pages)
+        r.state = _RUNNING
+        self._running.append(r)
+        obs.counter_inc("engine.prefix_hit_tokens", hit_tokens)
+        obs.counter_inc("engine.prefix_miss_tokens", P - hit_tokens)
+        if hit_tokens:
+            self.flops_avoided += self._prefill_cost_flops(r, hit_tokens)
+        return True
+
+    def _admit(self) -> None:
+        """Admit waiting requests in (priority, deadline, arrival)
+        order.  A request that cannot fit may PREEMPT strictly
+        lower-priority running requests (recompute-on-resume) — at most
+        down to the point where preemption stops helping."""
+        self._waiting.sort(key=self._order_key)
+        admitted: List[EngineRequest] = []
+        for r in list(self._waiting):
+            if self._try_admit_one(r):
+                admitted.append(r)
+                continue
+            # eviction alone was not enough: preempt strictly-worse
+            # running requests while that can still free the shortfall
+            while True:
+                victims = [v for v in self._running
+                           if v.priority > r.priority]
+                if not victims:
+                    break
+                victims.sort(key=self._order_key)
+                self._preempt(victims[-1])
+                if self._try_admit_one(r):
+                    admitted.append(r)
+                    break
+            if r.state != _RUNNING:
+                break  # head-of-line blocking: keep FIFO fairness
+        for r in admitted:
+            self._waiting.remove(r)
+
+    def _prefill_cost_flops(self, r: EngineRequest, tokens: int) -> float:
+        """Prefill FLOPs the prefix hit avoided, from the shared cost
+        model (GEMM + attention terms of the skipped span)."""
+        from flashinfer_tpu.obs import costmodel
+
+        cost = costmodel.engine_step(
+            num_tokens=tokens, batch=1, layers=self.cfg.num_layers,
+            hidden=self.cfg.hidden_size, inter=self.cfg.intermediate_size,
+            hq=self.cfg.num_qo_heads, hkv=self.cfg.num_kv_heads,
+            hd=self.cfg.head_dim, vocab=self.cfg.vocab_size,
+            kv_tokens=tokens * (tokens + 1) // 2,
+            kv_bytes=1 if self._int8_kv else 2,
+        )
+        return cost.flops
+
+    def _predict_step_seconds(self, num_tokens: int, kv_tokens: int,
+                              batch: int) -> float:
+        from flashinfer_tpu.obs import costmodel, hwspec
+
+        spec = hwspec.current_spec()
+        cost = costmodel.engine_step(
+            num_tokens=num_tokens, batch=max(batch, 1),
+            layers=self.cfg.num_layers, hidden=self.cfg.hidden_size,
+            inter=self.cfg.intermediate_size, hq=self.cfg.num_qo_heads,
+            hkv=self.cfg.num_kv_heads, hd=self.cfg.head_dim,
+            vocab=self.cfg.vocab_size, kv_tokens=kv_tokens,
+            kv_bytes=1 if self._int8_kv else 2,
+        )
+        return costmodel.predict_step_seconds(
+            cost, hbm_tbps=spec.hbm_tbps,
+            peak_tflops=spec.peak_tflops(str(self.kv_dtype)),
+            ici_gbps=0.0)
+
+    def _schedule(self) -> List[Tuple[EngineRequest, int]]:
+        """Pack this step: every decoding request advances 1 token;
+        prefilling requests get chunks under the token budget, with the
+        marginal chunk PRICED by ``predict_step_seconds`` against the
+        SLO step-latency cap (``slo_step_seconds``) instead of a
+        heuristic cutoff."""
+        cfg = self.config
+        sched: List[Tuple[EngineRequest, int]] = []
+        total = 0
+        kv_tokens = 0
+        decoding = [r for r in self._running
+                    if r.kv_len >= len(r.prompt)]
+        prefilling = [r for r in self._running
+                      if r.kv_len < len(r.prompt)]
+        for r in decoding:
+            sched.append((r, 1))
+            total += 1
+            kv_tokens += r.kv_len + 1
+        rung_cap = max(self.config.rungs())
+        budget = cfg.prefill_budget_tokens
+        prefilling.sort(key=self._order_key)
+        for r in prefilling:
+            room = min(budget, rung_cap - total)
+            if room <= 0:
+                break
+            chunk = min(len(r.prompt) - r.kv_len, room)
+            # cost-model-priced admission: shrink the chunk until the
+            # predicted step latency clears the SLO cap (never below 0;
+            # decode lanes always run)
+            if cfg.slo_step_seconds is not None:
+                while chunk > 0:
+                    attended = chunk * r.kv_len + chunk * (chunk + 1) // 2
+                    pred = self._predict_step_seconds(
+                        total + chunk, kv_tokens + attended,
+                        len(self._running))
+                    if pred <= cfg.slo_step_seconds:
+                        break
+                    chunk //= 2
+            if chunk <= 0:
+                continue
+            sched.append((r, chunk))
+            total += chunk
+            budget -= chunk
+            kv_tokens += chunk * r.kv_len + chunk * (chunk + 1) // 2
+        if not sched and prefilling:
+            # forced-progress floor: an SLO cap tighter than the
+            # smallest possible step must not starve prefill forever —
+            # one token of the most urgent request always runs
+            sched.append((prefilling[0], 1))
+        return sched
+
+    # -- the jitted step body ---------------------------------------------
+
+    def _build_step(self):
+        cfg, mcfg = self.config, self.cfg
+        ps, ppr = cfg.page_size, self._ppr
+        K = ppr * ps          # per-request KV window rows
+        int8_kv = self._int8_kv
+        sm_scale = (1.0 / float(mcfg.head_dim) ** 0.5
+                    * (mcfg.kv_k_scale if int8_kv else 1.0))
+        sampling = cfg.sampling
+        base_key = jax.random.PRNGKey(cfg.seed)
+        engine_self = self
+
+        def _window(c, table):
+            # [pages, Hkv, PS, D] -> [rows, K, Hkv, D]: the row offset
+            # of KV position j is ALWAYS j (position-determined — the
+            # bitwise-reproducibility contract in the module doc)
+            w = c[table]  # [rows, ppr, Hkv, PS, D]
+            n = w.shape[0]
+            return jnp.swapaxes(w, 2, 3).reshape(
+                n, K, mcfg.num_kv_heads, mcfg.head_dim)
+
+        def _attend(q, kw, vw, lo, hi):
+            # per-token windowed attention: q [T, H, D], kw/vw
+            # [T, K, Hkv, D], valid rows j in [lo, hi] per token.
+            # Masked lanes contribute exact zeros, so window CONTENT
+            # beyond the mask (stale pages, scratch) never perturbs a
+            # bit.  Returns (out f32 [T, H, D], lse f32 [T, H]).
+            T = q.shape[0]
+            G = mcfg.num_qo_heads // mcfg.num_kv_heads
+            qg = q.reshape(T, mcfg.num_kv_heads, G,
+                           mcfg.head_dim).astype(jnp.float32)
+            kf = kw.astype(jnp.float32)
+            vf = vw.astype(jnp.float32)
+            s = jnp.einsum("tngd,tknd->tngk", qg, kf) * sm_scale
+            j = jnp.arange(kw.shape[1])
+            valid = (j[None, :] >= lo[:, None]) & (j[None, :] <= hi[:, None])
+            vm = valid[:, None, None, :]
+            s = jnp.where(vm, s, _NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.where(vm, jnp.exp(s - m), 0.0)
+            l = jnp.sum(p, axis=-1)
+            out = jnp.einsum(
+                "tngk,tknd->tngd", p / jnp.where(l > 0, l, 1.0)[..., None],
+                vf)
+            lse = jnp.where(l > 0, m[..., 0] + jnp.log(l), _NEG_INF)
+            H = mcfg.num_qo_heads
+            return out.reshape(T, H, mcfg.head_dim), lse.reshape(T, H)
+
+        def _body(params, flat_tokens, positions, tok_req, token_page,
+                  token_slot, page_table, grp_pages, tok_grp, split,
+                  last_rows, sample_seeds, caches):
+            from flashinfer_tpu.activation import silu_and_mul
+            from flashinfer_tpu.cascade import compose_cascade_levels
+            from flashinfer_tpu.models.llama import _mm, _pre_quant
+            from flashinfer_tpu.norm import rmsnorm
+            from flashinfer_tpu.rope import apply_rope_pos_ids
+
+            engine_self._traces += 1
+            T = flat_tokens.shape[0]
+            x = params["embed"][flat_tokens].astype(mcfg.dtype)
+            new_caches = []
+            for li, layer in enumerate(params["layers"]):
+                h = rmsnorm(x, layer["input_norm"], mcfg.rms_eps)
+                pre = _pre_quant(h, layer)
+                q = _mm(h, layer, "q_proj", pre).reshape(
+                    T, mcfg.num_qo_heads, mcfg.head_dim)
+                k = _mm(h, layer, "k_proj", pre).reshape(
+                    T, mcfg.num_kv_heads, mcfg.head_dim)
+                v = _mm(h, layer, "v_proj", pre).reshape(
+                    T, mcfg.num_kv_heads, mcfg.head_dim)
+                q, k = apply_rope_pos_ids(q, k, positions,
+                                          rope_theta=mcfg.rope_theta)
+                kc, vc = caches[li]
+                if int8_kv:
+                    from flashinfer_tpu.quantization import (
+                        quantize_symmetric_int8)
+
+                    k_w = quantize_symmetric_int8(k, mcfg.kv_k_scale)
+                    v_w = quantize_symmetric_int8(v, mcfg.kv_v_scale)
+                else:
+                    k_w = k.astype(kc.dtype)
+                    v_w = v.astype(vc.dtype)
+                # pad lanes scatter into the scratch page (pool page 0)
+                kc = kc.at[token_page, :, token_slot, :].set(k_w)
+                vc = vc.at[token_page, :, token_slot, :].set(v_w)
+                new_caches.append((kc, vc))
+                # level 1: the request's own window, rows [split, pos]
+                k1 = _window(kc, page_table)[tok_req]
+                v1 = _window(vc, page_table)[tok_req]
+                o1, lse1 = _attend(q, k1, v1, split, positions)
+                # level 0: the SHARED prefix run, gathered once per
+                # group slot, rows [0, min(split, pos + 1)) — causal by
+                # position so a leader mid-prefill never sees ahead
+                k0 = _window(kc, grp_pages)[tok_grp]
+                v0 = _window(vc, grp_pages)[tok_grp]
+                hi0 = jnp.minimum(split - 1, positions)
+                o0, lse0 = _attend(q, k0, v0, jnp.zeros_like(split), hi0)
+                # cascade composition (reference cascade.cuh merge):
+                # empty levels pass through exactly via the lse guard
+                o, _ = compose_cascade_levels([(o0, lse0), (o1, lse1)])
+                if int8_kv:
+                    o = o * mcfg.kv_v_scale
+                attn = o.astype(mcfg.dtype)
+                x = x + _mm(attn.reshape(T, -1), layer,
+                            "o_proj").astype(mcfg.dtype)
+                h2 = rmsnorm(x, layer["post_norm"], mcfg.rms_eps)
+                pre2 = _pre_quant(h2, layer, "gate_proj")
+                mlp = jnp.concatenate(
+                    [_mm(h2, layer, "gate_proj", pre2),
+                     _mm(h2, layer, "up_proj", pre2)], -1)
+                x = x + _mm(silu_and_mul(mlp), layer,
+                            "down_proj").astype(mcfg.dtype)
+            x_last = x[last_rows]
+            xf = rmsnorm(x_last, params["final_norm"], mcfg.rms_eps)
+            logits = _mm(xf, params, "lm_head").astype(jnp.float32)
+            # per-lane deterministic sampling: the key depends only on
+            # (request arrival id, token index), never on scheduling —
+            # the same request samples the same stream under any
+            # packing, preemption, or sharing mode
+            t = jnp.maximum(jnp.asarray(sampling.temperature, jnp.float32),
+                            1e-6)
+            probs = jax.nn.softmax((logits / t).astype(jnp.float32), -1)
+            if sampling.top_k:
+                from flashinfer_tpu import sampling as S
+
+                probs = S.top_k_renorm_probs(probs, sampling.top_k)
+            if sampling.top_p < 1.0:
+                from flashinfer_tpu import sampling as S
+
+                probs = S.top_p_renorm_probs(probs, sampling.top_p)
+            keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
+                sample_seeds)
+            tokens = jax.vmap(
+                lambda p, kk: jax.random.categorical(
+                    kk, jnp.log(jnp.maximum(p, 1e-30))))(probs, keys)
+            return tokens.astype(jnp.int32), new_caches
+
+        donate = (12,) if cfg.donate else ()
+        self._step = jax.jit(_body, donate_argnums=donate)
+
+    # -- step construction + execution ------------------------------------
+
+    def _sample_seed(self, r: EngineRequest, token_index: int) -> int:
+        return (r.arrival * 131071 + token_index) & 0x7FFFFFFF
+
+    def _rung_for(self, tokens: int) -> int:
+        for rung in self.config.rungs():
+            if tokens <= rung:
+                return rung
+        raise RuntimeError(
+            f"scheduled {tokens} tokens > largest rung "
+            f"{max(self.config.rungs())} — scheduler bug")
+
+    @flashinfer_api(name="engine.step")
+    def step(self) -> dict:
+        """One engine step: admit, schedule, run the compiled rung,
+        scatter results.  Returns step facts (rung, tokens scheduled,
+        requests sampled/finished)."""
+        from flashinfer_tpu import obs
+
+        self._admit()
+        sched = self._schedule()
+        if not sched:
+            if self._waiting and not self._running:
+                r = min(self._waiting, key=self._order_key)
+                raise RuntimeError(
+                    f"request {r.rid} can never be admitted: needs "
+                    f"{self._pages_needed(r)} pages, pool has "
+                    f"{self.pool.num_pages - 1} (evictable cache pages "
+                    "included) — grow num_pages or shrink the request")
+            return {"rung": 0, "tokens": 0, "sampled": 0, "finished": 0}
+        cfg, mcfg = self.config, self.cfg
+        ps, ppr = cfg.page_size, self._ppr
+        Bpad = cfg.max_batch
+        total = sum(n for _, n in sched)
+        rung = self._rung_for(total)
+
+        flat = np.zeros(rung, np.int32)
+        pos = np.zeros(rung, np.int32)
+        tok_req = np.zeros(rung, np.int32)
+        token_page = np.zeros(rung, np.int32)  # scratch page 0 for pads
+        token_slot = np.zeros(rung, np.int32)
+        split = np.zeros(rung, np.int32)
+        tok_grp = np.zeros(rung, np.int32)
+        page_table = np.zeros((Bpad, ppr), np.int32)
+        grp_pages = np.zeros((Bpad, ppr), np.int32)
+        last_rows = np.zeros(Bpad, np.int32)
+        sample_seeds = np.zeros(Bpad, np.int32)
+        samplers: List[EngineRequest] = []
+
+        # group slots: one per distinct shared page-run prefix this
+        # step (sharing mode: every full hit of one cached run lands in
+        # ONE group, so the run's pages are gathered once — the cascade
+        # HBM dedup; oracle mode degenerates to one group per request)
+        groups: Dict[Tuple[int, ...], int] = {}
+        for r in self._running:
+            page_table[r.slot, :len(r.pages)] = r.pages
+        row = 0
+        for r, n in sched:
+            prefix_run = tuple(r.pages[:r.split // ps])
+            if prefix_run and prefix_run in groups:
+                g = groups[prefix_run]
+            else:
+                g = len(groups)
+                groups[prefix_run or (-1 - r.slot,)] = g
+                grp_pages[g, :len(prefix_run)] = prefix_run
+            decoding = r.kv_len >= len(r.prompt)
+            seq = r.seq()
+            for i in range(n):
+                p = r.kv_len + i
+                flat[row] = seq[p]
+                pos[row] = p
+                tok_req[row] = r.slot
+                token_page[row] = r.pages[p // ps]
+                token_slot[row] = p % ps
+                split[row] = r.split
+                tok_grp[row] = g
+                row += 1
+                # work accounting: every token attends [0, p] (pairs);
+                # its level-1 rows [split, p] stream per request, its
+                # level-0 rows are charged once per GROUP below
+                self.kv_pairs_total += p + 1
+                self.kv_rows_total += max(p + 1 - r.split, 0)
+            r.kv_len += n
+            if decoding or r.kv_len >= len(r.prompt):
+                last_rows[r.slot] = row - 1
+                sample_seeds[r.slot] = self._sample_seed(
+                    r, len(r.out_tokens))
+                samplers.append(r)
+            if not decoding:
+                obs.prefill_chunk(r.rid, n)
+        # level-0 group gathers: one stream of each shared page run per
+        # step regardless of how many requests ride it — the cascade
+        # HBM dedup the cost model surfaces via kv_rows
+        for run_key in groups:
+            if run_key and run_key[0] >= 0:  # real runs, not sentinels
+                self.kv_rows_total += len(run_key) * ps
+        self.tokens_total += total
+        self.sampled_total += len(samplers)
+
+        full_args = (self.params, jnp.asarray(flat), jnp.asarray(pos),
+                     jnp.asarray(tok_req), jnp.asarray(token_page),
+                     jnp.asarray(token_slot), jnp.asarray(page_table),
+                     jnp.asarray(grp_pages), jnp.asarray(tok_grp),
+                     jnp.asarray(split), jnp.asarray(last_rows),
+                     jnp.asarray(sample_seeds), self.caches)
+        sig = obs.state_signature(full_args, names=self._STATE_NAMES)
+        seen = self._rung_traced.get(rung, 0)
+        before = self._traces
+        t0 = time.perf_counter() if sig is not None else 0.0
+        tokens_dev, self.caches = self._step(*full_args)
+        if self._traces > before:
+            self._rung_traced[rung] = seen + 1
+            if sig is not None:
+                obs.record_span("ServingEngine.trace_and_compile",
+                                "compile", t0, time.perf_counter(),
+                                wrapper="ServingEngine", rung=rung,
+                                trace_index=self._traces)
+            if seen:
+                # a rung that already compiled traced AGAIN: the
+                # compile-once contract broke — count + attribute
+                obs.counter_inc("serve.step_retraces",
+                                wrapper="ServingEngine")
+                if sig is not None:
+                    obs.record_retrace(
+                        "ServingEngine",
+                        obs.diff_state_sigs(self._last_sig.get(rung),
+                                            sig, full_args))
+        if sig is not None:
+            self._last_sig[rung] = sig
+        tokens = np.asarray(tokens_dev)
+
+        # register freshly-completed full pages of each shareable span
+        # FIRST (post-run: the page KV is materialized now, and a
+        # request finishing this very step must still donate its span
+        # to the cache before its own references are released)
+        if cfg.enable_prefix_cache:
+            for r, _ in sched:
+                upto = min(r.kv_len, r.split) // ps
+                if upto > r.inserted_pages:
+                    self.prefix_cache.insert(r.prompt, r.pages, upto)
+                    r.inserted_pages = upto
+        finished = 0
+        for r in samplers:
+            tok = int(tokens[r.slot])
+            r.out_tokens.append(tok)
+            obs.decode_step(r.rid)
+            if len(r.out_tokens) >= r.max_new_tokens:
+                self._finish(r)
+                finished += 1
+        self._steps += 1
+        obs.counter_inc("engine.steps")
+        obs.counter_inc("engine.step_tokens", total)
+        obs.gauge_set("engine.pool_pages_in_use", self.pool.used_pages)
+        obs.gauge_set("engine.pool_pages_free", self.pool.free_pages)
+        return {"rung": rung, "tokens": total, "sampled": len(samplers),
+                "finished": finished}
+
+    def _finish(self, r: EngineRequest) -> None:
+        from flashinfer_tpu import obs
+
+        self._running.remove(r)
+        self._release(r)
+        r.state = _FINISHED
+        self._finished[r.rid] = r
+        obs.request_finish(r.rid)
+        obs.counter_inc("engine.finished")

@@ -573,6 +573,7 @@ def test_doctor_reports_spans_and_retrace_causes():
     report = json.loads(p.stdout)
     assert report["spans"]["unspanned_serving_ops"] == []
     assert set(report["spans"]["serving_ops"]) == {
-        "serve.step", "serve.mixed_step", "parallel.sharded_step"}
+        "serve.step", "serve.mixed_step", "parallel.sharded_step",
+        "engine.step"}
     assert report["retrace_causes"] == []  # fresh process: nothing hot
     assert "FLASHINFER_TPU_SPANS" in report["flags"]

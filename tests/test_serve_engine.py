@@ -1,0 +1,494 @@
+"""Continuous-batching serving engine (serve/engine.py) — ISSUE 11.
+
+The contracts under pin:
+
+- **block pool**: refcounted alloc/free/evict invariants, and the
+  alloc-free-realloc stress proof that a freed-and-reallocated page can
+  never alias a LIVE block;
+- **prefix trie**: full-page chained-hash lookup/insert/evict
+  semantics, hit metering;
+- **bitwise parity**: engine tokens with prefix sharing ON are
+  bit-identical to the no-sharing oracle (full per-request prefill),
+  across f32 AND int8-KV caches — the cascade composition + the
+  position-determined KV-window layout make this exact, not
+  approximate (docs/serving.md "bitwise contract");
+- **compile-once**: a whole serving session traces once per rung of
+  the shape ladder and never again (the 9-trace budget);
+- **scheduler**: priority-ordered admission, preemption-by-eviction
+  with bitwise recompute-on-resume, SLO-priced chunking that can only
+  shrink chunks (never deadlock), knob-resolved config.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashinfer_tpu.models.llama import (LlamaConfig, init_llama_params,
+                                         llama_decode_step)
+from flashinfer_tpu.serve import (BlockPool, EngineConfig, EngineRequest,
+                                  PrefixCache, SamplingConfig,
+                                  ServingEngine)
+
+CFG = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_llama_params(jax.random.PRNGKey(0), CFG)
+
+
+def _mk_engine(params, share=True, **over):
+    kw = dict(num_pages=64, page_size=8, max_batch=4,
+              prefill_budget_tokens=16, max_seq_tokens=64,
+              sampling=SamplingConfig(top_k=1),
+              enable_prefix_cache=share)
+    kw.update(over)
+    return ServingEngine(CFG, params, EngineConfig(**kw))
+
+
+def _prompts(rng, n, shared_len=17, suffix_hi=6, n_shared=2):
+    shared = [[int(t) for t in rng.integers(1, CFG.vocab_size, shared_len)]
+              for _ in range(n_shared)]
+    out = []
+    for i in range(n):
+        sfx = [int(t) for t in rng.integers(
+            1, CFG.vocab_size, int(rng.integers(1, suffix_hi)))]
+        out.append(shared[i % n_shared] + sfx)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_block_pool_invariants():
+    pool = BlockPool(num_pages=8, page_size=16)
+    assert pool.free_pages == 7  # page 0 is the reserved scratch page
+    a = pool.alloc(3)
+    assert a is not None and 0 not in a and len(set(a)) == 3
+    assert pool.used_pages == 3
+    pool.incref(a[:1])
+    assert pool.ref(a[0]) == 2
+    assert pool.decref(a) == 2  # a[0] survives at ref 1
+    assert pool.ref(a[0]) == 1
+    assert pool.decref(a[:1]) == 1
+    assert pool.free_pages == 7
+    with pytest.raises(ValueError):
+        pool.decref(a[:1])  # double free raises, never corrupts
+    with pytest.raises(ValueError):
+        pool.incref([a[0]])  # incref on a free page raises
+    assert pool.alloc(8) is None  # over-ask: nothing leaks out
+
+
+def test_block_pool_alloc_free_realloc_stress():
+    """The satellite-required aliasing proof: across a random
+    alloc/incref/decref churn, a page handed out by alloc() is NEVER
+    one a live holder still references."""
+    rng = np.random.default_rng(0)
+    pool = BlockPool(num_pages=33, page_size=8)
+    live = {}  # page -> refs we hold
+    for _ in range(2000):
+        op = rng.integers(0, 3)
+        if op == 0:
+            n = int(rng.integers(1, 5))
+            got = pool.alloc(n)
+            if got is None:
+                assert pool.free_pages < n
+                continue
+            for p in got:
+                assert p != BlockPool.SCRATCH_PAGE
+                assert p not in live, f"alloc aliased live page {p}"
+                live[p] = 1
+        elif op == 1 and live:
+            p = int(rng.choice(list(live)))
+            pool.incref([p])
+            live[p] += 1
+        elif op == 2 and live:
+            p = int(rng.choice(list(live)))
+            pool.decref([p])
+            live[p] -= 1
+            if live[p] == 0:
+                del live[p]
+        # global invariant: live refcounts match, free count complements
+        for p, n in live.items():
+            assert pool.ref(p) == n
+        assert pool.free_pages == (pool.num_pages - 1) - len(live)
+
+
+# ---------------------------------------------------------------------------
+# Prefix trie
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_prefix_trie_lookup_insert_semantics():
+    pool = BlockPool(num_pages=32, page_size=4)
+    trie = PrefixCache(pool)
+    prompt = list(range(100, 111))  # 11 tokens = 2 full pages + tail
+    pages = pool.alloc(3)
+    assert trie.insert(prompt, pages, upto_pages=2) == 2
+    assert pool.ref(pages[0]) == 2  # cache ownership ref taken
+    hit, tokens = trie.lookup(prompt, max_pages=2)
+    assert hit == pages[:2] and tokens == 8  # full pages only
+    # a longer ask still caps at what is cached
+    hit, tokens = trie.lookup(prompt + [1, 2, 3, 4], max_pages=3)
+    assert hit == pages[:2]
+    # same block content under a DIFFERENT parent must not collide
+    other = [9] * 4 + prompt[4:8]
+    assert trie.lookup(other, max_pages=2) == ([], 0)
+    # concurrent private copy: insert of equal content keeps the
+    # existing node and adopts nothing
+    dup = pool.alloc(2)
+    assert trie.insert(prompt, dup + [pages[2]], upto_pages=2) == 0
+    assert pool.ref(dup[0]) == 1
+
+
+def test_prefix_trie_eviction_lru_and_liveness():
+    pool = BlockPool(num_pages=32, page_size=4)
+    trie = PrefixCache(pool)
+    pa = pool.alloc(2)
+    pb = pool.alloc(2)
+    trie.insert([1, 2, 3, 4, 5, 6, 7, 8], pa, 2)
+    trie.insert([9, 10, 11, 12, 13, 14, 15, 16], pb, 2)
+    pool.decref(pa)
+    pool.decref(pb)  # now cache-only (ref 1 each)
+    # bump B's whole chain -> A's LEAF is the LRU eviction candidate
+    trie.lookup([9, 10, 11, 12, 13, 14, 15, 16], 2)
+    assert trie.evict(1) == 1
+    assert trie.lookup([1, 2, 3, 4, 5, 6, 7, 8], 2)[1] == 4  # leaf gone
+    assert trie.lookup([9, 10, 11, 12, 13, 14, 15, 16], 2)[1] == 8
+    # a page a live request still references is never evicted
+    hit, _ = trie.lookup([9, 10, 11, 12, 13, 14, 15, 16], 2)
+    pool.incref(hit)  # simulate a running request holding the chain
+    assert trie.evict(10) == 1  # only A's remaining cache-only page
+    pool.decref(hit)
+    assert trie.evict(10) == 2  # B's chain drains leaf-first
+
+
+# ---------------------------------------------------------------------------
+# Engine correctness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_engine_matches_stepwise_reference(params):
+    """Anchor against an INDEPENDENT oracle: feed the prompt token by
+    token through llama_decode_step (the per-op reference path) and
+    greedy-decode; the engine (chunked prefill + two-level cascade
+    windows) must produce the same greedy tokens."""
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, 13)]
+    max_new = 4
+
+    PS, PPR = 8, 8
+    npages = PPR
+    caches = [(jnp.zeros((npages + 1, CFG.num_kv_heads, PS, CFG.head_dim),
+                         CFG.dtype),
+               jnp.zeros((npages + 1, CFG.num_kv_heads, PS, CFG.head_dim),
+                         CFG.dtype)) for _ in range(CFG.num_layers)]
+    pt = jnp.arange(1, npages + 1, dtype=jnp.int32)[None, :]
+    seq = list(prompt)
+    logits = None
+    for p, tok in enumerate(seq):
+        logits, caches = llama_decode_step(
+            params, CFG, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([p], jnp.int32), caches, pt,
+            jnp.asarray([p], jnp.int32), use_pallas=False)
+    oracle = []
+    for _ in range(max_new):
+        tok = int(np.argmax(np.asarray(logits)[0]))
+        oracle.append(tok)
+        p = len(seq)
+        logits, caches = llama_decode_step(
+            params, CFG, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([p], jnp.int32), caches, pt,
+            jnp.asarray([p], jnp.int32), use_pallas=False)
+        seq.append(tok)
+
+    eng = _mk_engine(params, page_size=PS)
+    eng.submit(EngineRequest("r", list(prompt), max_new_tokens=max_new))
+    assert eng.run()["r"] == oracle
+
+
+def _parity_case(params, kv_dtype):
+    rng = np.random.default_rng(11)
+    prompts = _prompts(rng, 6)
+    res = {}
+    for share in (True, False):
+        eng = _mk_engine(params, share=share, kv_dtype=kv_dtype,
+                         sampling=SamplingConfig(temperature=0.8,
+                                                 top_k=20, top_p=0.95))
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=4))
+        res[share] = (eng.run(), eng)
+    shared_run, eng = res[True]
+    oracle_run, _ = res[False]
+    assert shared_run == oracle_run  # token-bitwise, every request
+    assert sum(r.hit_tokens for r in eng._finished.values()) > 0
+    assert eng.flops_avoided > 0
+
+
+@pytest.mark.quick
+def test_shared_prefix_bitwise_parity_f32(params):
+    """THE acceptance pin: prefix-shared serving == full per-request
+    prefill, token-bitwise (real sampling config, not greedy)."""
+    _parity_case(params, None)
+
+
+def test_shared_prefix_bitwise_parity_int8_kv(params):
+    _parity_case(params, jnp.int8)
+
+
+def test_eviction_stress_preserves_tokens(params):
+    """End-to-end aliasing proof: a pool sized to force continuous
+    trie eviction + preemption must still produce exactly the big-pool
+    tokens (any freed-page aliasing would corrupt KV and diverge)."""
+    rng = np.random.default_rng(13)
+    prompts = _prompts(rng, 10, shared_len=9, n_shared=3)
+
+    def run(npages):
+        eng = _mk_engine(params, num_pages=npages, max_batch=2)
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=3))
+        return eng.run(), eng
+
+    small, es = run(9)    # 8 usable pages: one request at a time
+    big, _ = run(64)
+    assert small == big
+    # the small pool actually exercised the reclaim machinery
+    assert es.prefix_cache.num_pages <= 8
+
+
+# ---------------------------------------------------------------------------
+# Compile-once / retrace budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_retrace_budget_and_steady_state(params):
+    rng = np.random.default_rng(17)
+    eng = _mk_engine(params)
+    for i, p in enumerate(_prompts(rng, 6)):
+        eng.submit(EngineRequest(f"a{i}", list(p), max_new_tokens=3))
+    eng.run()
+    first_wave = eng.num_traces
+    assert first_wave == len(eng._rung_traced) <= 9
+    assert all(n == 1 for n in eng._rung_traced.values())
+    # steady state: a second wave of NEW requests compiles nothing
+    for i, p in enumerate(_prompts(rng, 6)):
+        eng.submit(EngineRequest(f"b{i}", list(p), max_new_tokens=3))
+    eng.run()
+    assert eng.num_traces == first_wave
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_priority_admission_order(params):
+    """One batch slot: the later-submitted HIGHER-priority request is
+    admitted (and finishes) first."""
+    rng = np.random.default_rng(19)
+    pa, pb = _prompts(rng, 2, n_shared=1)
+    eng = _mk_engine(params, max_batch=1)
+    eng.submit(EngineRequest("low", list(pa), max_new_tokens=2,
+                             priority=5))
+    eng.submit(EngineRequest("high", list(pb), max_new_tokens=2,
+                             priority=0))
+    finish_order = []
+    while eng.has_work():
+        eng.step()
+        for rid in eng._finished:
+            if rid not in finish_order:
+                finish_order.append(rid)
+    assert finish_order == ["high", "low"]
+
+
+def test_preemption_resume_bitwise(params):
+    """Preemption-by-eviction with recompute-on-resume: the preempted
+    request's final tokens equal the never-preempted run's, bitwise."""
+    rng = np.random.default_rng(23)
+    pA = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+    pB = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+
+    def run(npages):
+        eng = _mk_engine(params, num_pages=npages, max_batch=2,
+                         max_seq_tokens=48)
+        eng.submit(EngineRequest("A", list(pA), max_new_tokens=8,
+                                 priority=5))
+        # 6 steps: prefill (2) + 4 decoded tokens, so the preempted
+        # resume prompt (prompt + generated) CROSSES a page boundary —
+        # pins that the cascade split stays frozen at its first-
+        # admission value instead of being recomputed from the longer
+        # resume prompt (which would change the level decomposition
+        # and break bitwise resume)
+        for _ in range(6):
+            eng.step()  # A is mid-decode when B arrives
+        eng.submit(EngineRequest("B", list(pB), max_new_tokens=4,
+                                 priority=0))
+        return eng.run(), eng
+
+    small, es = run(7)   # 6 usable pages: B (pri 0) must preempt A
+    big, eb = run(32)
+    assert es._finished["A"].preemptions == 1
+    assert eb._finished["A"].preemptions == 0
+    assert small == big
+
+
+def test_slo_pricing_shrinks_chunks_without_deadlock(params):
+    """costmodel-priced admission: an SLO step-latency cap tighter
+    than a full-budget chunk splits prefill into more, smaller steps;
+    an impossibly tight cap still makes forced 1-token progress."""
+    rng = np.random.default_rng(29)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, 40)]
+
+    def steps_with(slo):
+        eng = _mk_engine(params, prefill_budget_tokens=40,
+                         slo_step_seconds=slo)
+        eng.submit(EngineRequest("r", list(prompt), max_new_tokens=2))
+        res = eng.run()
+        return eng.steps, res["r"]
+
+    free_steps, free_toks = steps_with(None)
+    tight_steps, tight_toks = steps_with(1e-7)
+    impossible_steps, impossible_toks = steps_with(1e-30)
+    assert tight_steps > free_steps
+    assert impossible_steps >= tight_steps
+    # chunking never changes the tokens (packing-invariance contract)
+    assert free_toks == tight_toks == impossible_toks
+
+
+def test_unadmittable_request_rejected_at_submit(params):
+    """An oversized request is rejected at submit() — BEFORE it can
+    preempt lower-priority running work it could never benefit from."""
+    eng = _mk_engine(params, num_pages=4)  # 3 usable pages
+    with pytest.raises(ValueError, match="needs .* pages"):
+        eng.submit(EngineRequest("big", list(range(1, 40)),
+                                 max_new_tokens=4))
+    assert not eng.has_work()  # nothing enqueued, nothing disturbed
+
+
+# ---------------------------------------------------------------------------
+# Knobs, catalog, obs wiring
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_engine_knobs_registered_and_resolved():
+    from flashinfer_tpu.autotuner import KNOWN_KNOBS
+
+    for name in ("engine.block_size", "engine.prefill_budget_tokens",
+                 "engine.max_batch"):
+        assert name in KNOWN_KNOBS, name
+    cfg = EngineConfig.from_knobs(CFG, num_pages=64, max_seq_tokens=128,
+                                  prefill_budget_tokens=32)
+    assert cfg.prefill_budget_tokens == 32  # explicit override wins
+    assert cfg.page_size >= 1 and cfg.max_batch >= 1
+    rungs = cfg.rungs()
+    assert 1 <= len(rungs) <= 8  # the 9-trace budget leaves headroom
+    assert rungs[0] >= cfg.max_batch
+
+
+@pytest.mark.quick
+def test_engine_obs_coverage_closed():
+    """engine.step ships observed: catalog + span category + cost
+    family all present, so L005/doctor coverage stays empty-pinned."""
+    from flashinfer_tpu.obs import costmodel
+    from flashinfer_tpu.obs.catalog import API_OPS, METRICS, SERVING_OPS
+    from flashinfer_tpu.obs.spans import SPAN_CATEGORIES
+
+    assert "engine.step" in API_OPS
+    assert "engine.step" in SERVING_OPS
+    assert "engine.step" in SPAN_CATEGORIES
+    assert costmodel.API_OP_COSTS["engine.step"] == "engine_step"
+    assert callable(getattr(costmodel, "engine_step"))
+    assert not costmodel.uncovered_api_ops()
+    for name in ("engine.requests", "engine.finished", "engine.steps",
+                 "engine.step_tokens", "engine.prefix_hit_tokens",
+                 "engine.prefix_miss_tokens", "engine.evictions",
+                 "engine.preemptions", "engine.pool_pages_in_use",
+                 "engine.pool_pages_free"):
+        assert name in METRICS, name
+
+
+def test_engine_counters_and_doctor_section(params, monkeypatch):
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    from flashinfer_tpu import obs
+
+    obs.reset()
+    rng = np.random.default_rng(31)
+    # max_batch=2 staggers admission so later requests find the first
+    # wave's prefix pages already in the trie (simultaneous admission
+    # of a cold cache legitimately takes zero hits)
+    eng = _mk_engine(params, max_batch=2)
+    for i, p in enumerate(_prompts(rng, 4)):
+        eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=2))
+    eng.run()
+    snap = obs.snapshot()
+
+    def total(name):
+        return sum(snap["counters"].get(name, {}).values())
+
+    assert total("engine.requests") == 4
+    assert total("engine.finished") == 4
+    assert total("engine.steps") == eng.steps
+    assert total("engine.prefix_hit_tokens") > 0
+    assert total("engine.prefix_miss_tokens") > 0
+    assert snap["gauges"]["engine.pool_pages_free"][""] == \
+        float(eng.pool.free_pages)
+    obs.reset()
+
+
+@pytest.mark.quick
+def test_cascade_compose_exact_passthrough():
+    """compose_cascade_levels: an empty level (lse = -inf) passes the
+    other level through BIT-exactly — the guard the engine's bitwise
+    parity rests on."""
+    from flashinfer_tpu.cascade import compose_cascade_levels
+
+    rng = np.random.default_rng(37)
+    o = jnp.asarray(rng.standard_normal((5, 4, 8)), jnp.float32)
+    lse = jnp.asarray(rng.standard_normal((5, 4)), jnp.float32)
+    empty_o = jnp.zeros_like(o)
+    empty_lse = jnp.full_like(lse, -1e30)
+    out, s = compose_cascade_levels([(empty_o, empty_lse), (o, lse)])
+    assert (np.asarray(out) == np.asarray(o)).all()
+    assert (np.asarray(s) == np.asarray(lse)).all()
+    out, s = compose_cascade_levels([(o, lse), (empty_o, empty_lse)])
+    assert (np.asarray(out) == np.asarray(o)).all()
+    # merge math sanity: two equal states keep the value, lse + ln 2
+    out, s = compose_cascade_levels([(o, lse), (o, lse)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.asarray(lse) + np.log(2.0), rtol=1e-6)
+
+
+def test_engine_lifecycle_spans(params, monkeypatch):
+    """Request lifecycle rides the PR 10 span layer: TTFT/TPOT
+    histograms fill from engine-served requests."""
+    monkeypatch.setenv("FLASHINFER_TPU_SPANS", "1")
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    from flashinfer_tpu import obs
+    from flashinfer_tpu.obs import spans
+
+    obs.reset()
+    spans.reset()
+    rng = np.random.default_rng(41)
+    eng = _mk_engine(params)
+    for i, p in enumerate(_prompts(rng, 3)):
+        eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=3))
+    eng.run()
+    ls = obs.lifecycle_snapshot()
+    assert ls["lifecycle.ttft_us"]["count"] == 3
+    assert ls["lifecycle.tpot_us"]["count"] == 3 * 2  # gaps after 1st
+    assert ls["lifecycle.tokens_per_s"]["count"] == 3
+    obs.reset()
+    spans.reset()
