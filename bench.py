@@ -1666,6 +1666,28 @@ def phase_serving_engine(sweep: bool):
       requests, full per-request prefill) — the phase RAISES on any
       mismatch, so a divergent row can never land.
 
+    Backend A/B (ISSUE 12): the phase emits PAIRED rows — the same
+    shared-prefix workload served by ``attention_backend="reference"``
+    (the dense XLA oracle tier) and by ``attention_backend="kernel"``
+    (the Pallas work-unit lowering, interpret-mode on CPU) — stamped
+    with ``attention_backend`` as a RowAuditor IDENTITY field so the
+    tiers keep separate banked histories.  The kernel row's cost comes
+    from the REAL unit stats (``ServingEngine.unit_stats`` →
+    ``costmodel.engine_step`` launched-vs-effective), its
+    ``prefill_flops_avoided`` is planner-derived (unit stats for the
+    skipped spans), and cross-tier token agreement is GATED by model
+    dtype: on f32 models (BENCH_SMALL) the tiers agree exactly (the
+    tests/test_engine_kernels.py contract) and the phase raises on
+    >0.2% drift; on bf16 models the kernel tier's whole point is bf16
+    MXU dots where the reference upcasts to f32, and one benign token
+    flip diverges the rest of that request's sequence — so the phase
+    only records the WHOLE-REQUEST agreement rate
+    (``backend_token_match``) and never gates on it (lowering bugs
+    are caught exactly by the f32 interpret tier).
+    On CPU the kernel row's wall time measures INTERPRET-mode
+    emulation, not kernel speed: read the A/B for plan mechanics +
+    parity here, for throughput on chip (BENCH_BANKED.md note).
+
     The roofline stamp uses the run-aggregate ``engine_step`` cost
     (shared-prefix KV reads deduped via kv_rows), so ``obs perf``
     attributes the cascade win mechanically."""
@@ -1711,15 +1733,17 @@ def phase_serving_engine(sweep: bool):
             reqs.append((f"req{i}", prefixes[int(ranks[i])] + suffix))
         return reqs
 
-    def serve(share: bool):
+    def serve(share: bool, backend: str = "reference"):
         eng = ServingEngine(mcfg, params, EngineConfig(
-            enable_prefix_cache=share, **ecfg_kw))
+            enable_prefix_cache=share, attention_backend=backend,
+            **ecfg_kw))
         for rid, prompt in workload():
             eng.submit(EngineRequest(rid, list(prompt),
                                      max_new_tokens=max_new))
         t0 = _time.perf_counter()
-        results = _guard(f"bench.serving_engine.{'share' if share else 'oracle'}",
-                         (n_requests, mcfg.hidden_size, share),
+        tag = "share" if share else "oracle"
+        results = _guard(f"bench.serving_engine.{tag}.{backend}",
+                         (n_requests, mcfg.hidden_size, share, backend),
                          lambda: eng.run())
         return results, _time.perf_counter() - t0, eng
 
@@ -1750,39 +1774,91 @@ def phase_serving_engine(sweep: bool):
             f"retrace budget breached: {eng.num_traces} traces "
             f"across {eng.steps} engine steps (budget: 9)")
 
-    def pct(name, p):
-        h = ls.get(name) or {}
-        return round(h.get(p, 0.0), 1)
+    def engine_row(e, w, ls_, snap_, hit_rate_, gen_tokens_):
+        def pct(name, p):
+            h = ls_.get(name) or {}
+            return round(h.get(p, 0.0), 1)
 
-    row = dict(
-        phase="serving_engine", model="llama_tiny_engine",
-        requests=n_requests, zipf_prefixes=n_prefixes,
-        bs=ecfg_kw["max_batch"], page_size=ecfg_kw["page_size"],
-        prefill_budget=ecfg_kw["prefill_budget_tokens"],
-        layers=mcfg.num_layers, hidden=mcfg.hidden_size,
-        gen_tokens=gen_tokens, engine_steps=eng.steps,
-        us_step=round(wall / max(eng.steps, 1) * 1e6, 1),
-        tok_s=round(gen_tokens / max(wall, 1e-9), 1),
-        ttft_p50_us=pct("lifecycle.ttft_us", "p50"),
-        ttft_p99_us=pct("lifecycle.ttft_us", "p99"),
-        tpot_p50_us=pct("lifecycle.tpot_us", "p50"),
-        tpot_p99_us=pct("lifecycle.tpot_us", "p99"),
-        prefix_hit_rate=round(hit_rate, 4),
-        prefill_flops_avoided=eng.flops_avoided,
-        num_traces=eng.num_traces,
-        preemptions=sum(
-            snap["counters"].get("engine.preemptions", {}).values()),
-        evictions=sum(
-            snap["counters"].get("engine.evictions", {}).values()),
-        oracle="tokens-bitwise-equal",
-        oracle_speedup=round(oracle_wall / max(wall, 1e-9), 3),
-    )
-    _emit_row(**_stamp(row, eng.aggregate_cost(), wall))
+        return dict(
+            phase="serving_engine", model="llama_tiny_engine",
+            requests=n_requests, zipf_prefixes=n_prefixes,
+            bs=ecfg_kw["max_batch"], page_size=ecfg_kw["page_size"],
+            prefill_budget=ecfg_kw["prefill_budget_tokens"],
+            layers=mcfg.num_layers, hidden=mcfg.hidden_size,
+            gen_tokens=gen_tokens_, engine_steps=e.steps,
+            us_step=round(w / max(e.steps, 1) * 1e6, 1),
+            tok_s=round(gen_tokens_ / max(w, 1e-9), 1),
+            ttft_p50_us=pct("lifecycle.ttft_us", "p50"),
+            ttft_p99_us=pct("lifecycle.ttft_us", "p99"),
+            tpot_p50_us=pct("lifecycle.tpot_us", "p50"),
+            tpot_p99_us=pct("lifecycle.tpot_us", "p99"),
+            prefix_hit_rate=round(hit_rate_, 4),
+            prefill_flops_avoided=e.flops_avoided,
+            num_traces=e.num_traces,
+            preemptions=sum(
+                snap_["counters"].get("engine.preemptions", {}).values()),
+            evictions=sum(
+                snap_["counters"].get("engine.evictions", {}).values()),
+        )
+
+    row = engine_row(eng, wall, ls, snap, hit_rate, gen_tokens)
+    row["oracle"] = "tokens-bitwise-equal"
+    row["oracle_speedup"] = round(oracle_wall / max(wall, 1e-9), 3)
+    _emit_row(**_stamp(row, eng.aggregate_cost(), wall,
+                       attention_backend="reference"))
     print(f"# serving_engine: {n_requests} reqs in {wall:.1f}s "
           f"({row['tok_s']} tok/s), hit rate {hit_rate:.1%}, "
           f"{eng.num_traces} traces/{eng.steps} steps, "
           f"oracle bitwise OK ({oracle_wall:.1f}s unshared, "
           f"{row['oracle_speedup']}x)", file=sys.stderr)
+
+    # ---- kernel-tier A/B (ISSUE 12): same workload, Pallas work-unit
+    # attention; on CPU this measures interpret-mode mechanics, the
+    # throughput half of the A/B is the first on-chip session's
+    obs.reset()
+    kresults, kwall, keng = serve(True, backend="kernel")
+    ksnap = obs.snapshot()
+    kls = obs.lifecycle_snapshot()
+    khits = sum(ksnap["counters"].get("engine.prefix_hit_tokens",
+                                      {}).values())
+    kmisses = sum(ksnap["counters"].get("engine.prefix_miss_tokens",
+                                        {}).values())
+    match = sum(1 for rid in results
+                if kresults.get(rid) == results[rid])
+    # f32 models: exact agreement is the pinned contract (0.2% slack
+    # for a knife-edge argmax flip).  bf16 models: the kernel tier
+    # computes bf16 MXU dots where the reference upcasts to f32, and
+    # ONE benign token flip diverges the rest of that request's
+    # sequence, so WHOLE-REQUEST agreement can legitimately land
+    # anywhere below 1.0 — record the rate, never raise (the f32
+    # interpret tier is where lowering bugs are caught exactly)
+    strict = mcfg.dtype == jnp.float32
+    if strict and match < n_requests * 0.998:
+        bad = [rid for rid in results
+               if kresults.get(rid) != results[rid]]
+        raise AssertionError(
+            f"kernel-vs-reference token mismatch on {len(bad)} of "
+            f"{n_requests} requests (first: {bad[:3]}) — the work-unit "
+            "lowering diverged from the oracle tier")
+    if keng.num_traces > 9:
+        raise AssertionError(
+            f"kernel-tier retrace budget breached: {keng.num_traces} "
+            f"traces across {keng.steps} engine steps (budget: 9)")
+    kgen = sum(len(v) for v in kresults.values())
+    krow = engine_row(keng, kwall, kls, ksnap,
+                      khits / max(khits + kmisses, 1), kgen)
+    krow["backend_tokens_equal"] = bool(match == n_requests)
+    krow["backend_token_match"] = round(match / max(n_requests, 1), 4)
+    kcost = keng.aggregate_cost()
+    _emit_row(**_stamp(krow, kcost, kwall, attention_backend="kernel"))
+    us = keng.unit_stats
+    print(f"# serving_engine[kernel]: {kwall:.1f}s interpret-mode, "
+          f"{keng.num_traces} traces/{keng.steps} steps, tokens "
+          f"{'EQUAL' if match == n_requests else f'{match}/{n_requests}'}"
+          f" vs reference; launched/effective flops "
+          f"{kcost.flops:.3g}/{kcost.effective_flops:.3g} "
+          f"({us['prefill_units']} real prefill units of "
+          f"{us['prefill_units_launched']} launched)", file=sys.stderr)
 
 
 def phase_selftest(sweep: bool):

@@ -55,6 +55,14 @@ CODE = "L007"
 PLANNER_KERNELS: Dict[str, str] = {
     "build_prefill_work_units": "_fused_prefill_kernel",
     "build_decode_split_units": "_decode_split_kernel_fused_heads",
+    # the serving engine's schedule lowering (serve/engine_kernels.py)
+    # feeds BOTH kernels above through their own planners, so its
+    # plan-array contract is enforced transitively by the two entries
+    # that precede it; this entry records the binding (planner lookup
+    # is by-kernel and first-match, so the direct planners keep owning
+    # the key checks) — see the PR 4 NOTE: unregistered planners are
+    # silently skipped.
+    "build_engine_work_units": "_fused_prefill_kernel",
 }
 
 

@@ -121,6 +121,24 @@ register_knob_launch(KnobLaunch(
                  "__dtype__"),
 ))
 
+# key: (hidden, hq, hkv, hd) — serve/engine.py EngineConfig.from_knobs.
+# The engine's KERNEL attention tier launches through
+# fused_paged_prefill (both cascade levels) and
+# paged_decode_attention_split; the tactic value is the backend NAME
+# (string), which never enters scratch arithmetic, and the engine's
+# block_q/pages_per_chunk launch statics are derived at engine build
+# (serve/engine_kernels.py EngineKernelGeom), so this binding registers
+# the launch without a standalone VMEM proof — the compile-feasibility
+# gate rides the fused_prefill.blocks and decode.splits bindings the
+# engine's geometry is clamped to (the same 512-token chunk / 8 MiB
+# double-buffer clamps those knobs' evaluations prove).
+register_knob_launch(KnobLaunch(
+    knob="engine.attention_backend",
+    launcher="fused_paged_prefill",
+    value_names=("attention_backend",),
+    shape_names=("hidden", "H", "Hkv", "D"),
+))
+
 
 class _Unevaluable(Exception):
     pass

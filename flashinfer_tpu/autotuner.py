@@ -157,6 +157,15 @@ register_knob("engine.max_batch",
               description="serving-engine batch slots (concurrent "
                           "running requests); also the decode floor "
                           "of the compile-once rung ladder")
+register_knob("engine.attention_backend", kind="str",
+              choices=("reference", "kernel"),
+              description="serving-engine attention tier: 'reference' "
+                          "= the dense XLA oracle form (bitwise-"
+                          "provable, interpret-mode correctness "
+                          "anchor), 'kernel' = the Pallas work-unit "
+                          "lowering (serve/engine_kernels.py — PR 3 "
+                          "prefill mainloop + PR 6 split-KV decode "
+                          "composed by the cascade merge)")
 
 
 def validate_tactic(op_name: str, value) -> Optional[str]:

@@ -185,13 +185,17 @@ def _serving_workload(steps: int, perturb: bool) -> dict:
     }
 
 
-def _engine_workload(num_requests: int) -> dict:
+def _engine_workload(num_requests: int,
+                     backend: str = "reference") -> dict:
     """A short Zipf-skewed continuous-batching run through the serving
     engine (tiny Llama, CPU-safe) with the request lifecycle metered —
     the ``obs trace --engine`` selftest workload.  Returns the facts
-    the selftest gates on: total traces vs the 9-step retrace budget
-    and the measured prefix-cache hit rate (must be non-zero under a
-    Zipf prompt mix, or the trie is dead)."""
+    the selftest gates on: total traces vs the 9-step retrace budget,
+    the measured prefix-cache hit rate (must be non-zero under a Zipf
+    prompt mix, or the trie is dead), and the served tokens — the
+    selftest replays the SAME seeded workload on both attention
+    backends and fails on any token divergence (the kernel tier's
+    parity gate)."""
     from flashinfer_tpu.env import apply_platform_from_env
 
     apply_platform_from_env()
@@ -204,34 +208,43 @@ def _engine_workload(num_requests: int) -> dict:
     from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
                                       SamplingConfig, ServingEngine)
 
+    snap0 = obs.snapshot()
+
+    def _hits(snap):
+        return (sum(snap["counters"].get(
+                    "engine.prefix_hit_tokens", {}).values()),
+                sum(snap["counters"].get(
+                    "engine.prefix_miss_tokens", {}).values()))
+
+    h0, m0 = _hits(snap0)
     cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
     params = init_llama_params(jax.random.PRNGKey(0), cfg)
     eng = ServingEngine(cfg, params, EngineConfig(
         num_pages=96, page_size=8, max_batch=4,
         prefill_budget_tokens=24, max_seq_tokens=64,
-        sampling=SamplingConfig(temperature=0.8, top_k=20)))
+        sampling=SamplingConfig(temperature=0.8, top_k=20),
+        attention_backend=backend))
     rng = np.random.default_rng(0)
     prefixes = [[int(t) for t in rng.integers(1, cfg.vocab_size, 17)]
                 for _ in range(4)]
     zipf = np.minimum(rng.zipf(1.5, num_requests) - 1, len(prefixes) - 1)
-    with obs.span("engine.workload", cat="request"):
+    with obs.span("engine.workload", cat="request",
+                  backend=backend):
         for i in range(num_requests):
             prompt = prefixes[int(zipf[i])] + [
                 int(t) for t in rng.integers(1, cfg.vocab_size, 4)]
             eng.submit(EngineRequest(f"req{i}", prompt,
                                      max_new_tokens=3))
-        eng.run()
-    snap = obs.snapshot()
-    hits = sum(snap["counters"].get(
-        "engine.prefix_hit_tokens", {}).values())
-    misses = sum(snap["counters"].get(
-        "engine.prefix_miss_tokens", {}).values())
+        results = eng.run()
+    h1, m1 = _hits(obs.snapshot())
+    hits, misses = h1 - h0, m1 - m0
     return {
         "num_traces": eng.num_traces,
         "rungs": len(eng._rung_traced),
         "requests": num_requests,
         "prefix_hit_rate": hits / max(hits + misses, 1),
         "flops_avoided": eng.flops_avoided,
+        "results": results,
     }
 
 
@@ -242,8 +255,13 @@ def cmd_trace(args) -> int:
     from flashinfer_tpu.obs import export, spans
 
     profiler.start_timeline()
+    kfacts = None
     if args.engine:
         facts = _engine_workload(args.requests)
+        # the kernel attention tier over the SAME seeded workload: the
+        # selftest gates BOTH backends on the retrace budget and pins
+        # cross-tier token parity (docs/serving.md backend matrix)
+        kfacts = _engine_workload(args.requests, backend="kernel")
     else:
         facts = _serving_workload(args.steps, perturb=not args.no_perturb)
     events = profiler.stop_timeline()
@@ -270,6 +288,24 @@ def cmd_trace(args) -> int:
             problems.append(
                 "prefix-cache hit rate is ZERO under a Zipf-shared "
                 "prompt mix — the prefix trie is not taking hits")
+        # the kernel tier: same budget, plus token parity vs the
+        # reference tier (everything is seeded, so agreement is exact)
+        if kfacts["num_traces"] > 9:
+            problems.append(
+                f"kernel-tier retrace budget: {kfacts['num_traces']} "
+                f"traces across {kfacts['requests']} requests "
+                "(budget: 9)")
+        if kfacts["num_traces"] > kfacts["rungs"]:
+            problems.append(
+                f"kernel tier retraced: {kfacts['num_traces']} traces "
+                f"for {kfacts['rungs']} rungs (compile-once broke)")
+        if kfacts["results"] != facts["results"]:
+            bad = [rid for rid in facts["results"]
+                   if kfacts["results"].get(rid) != facts["results"][rid]]
+            problems.append(
+                f"kernel-vs-reference token mismatch on {len(bad)} "
+                f"request(s) (first: {bad[:3]}) — the work-unit "
+                "lowering diverged from the oracle tier")
     else:
         # the compile-once retrace budget over the fused serving loop
         # (test_serve_step's 9-step pin, now CI-gated with attribution)
@@ -307,8 +343,15 @@ def cmd_trace(args) -> int:
         "events": len(trace["traceEvents"]),
         "retrace_causes": causes,
         "problems": problems,
-        **{k: v for k, v in facts.items() if k != "requests"},
+        **{k: v for k, v in facts.items()
+           if k not in ("requests", "results")},
     }
+    if kfacts is not None:
+        summary["kernel_backend"] = {
+            k: v for k, v in kfacts.items()
+            if k not in ("requests", "results")}
+        summary["kernel_backend"]["tokens_equal"] = \
+            kfacts["results"] == facts["results"]
     print(json.dumps(summary, indent=1, sort_keys=True))
     if problems and args.selftest:
         for p in problems:

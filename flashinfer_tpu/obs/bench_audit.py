@@ -78,6 +78,17 @@ MEASUREMENT_FIELDS = frozenset({
     "prefix_hit_rate", "prefill_flops_avoided", "num_traces",
     "preemptions", "evictions",
     "ttft_p50_us", "ttft_p99_us", "tpot_p50_us", "tpot_p99_us",
+    # attention_backend ("reference" — the dense XLA oracle tier — vs
+    # "kernel" — the Pallas work-unit lowering) is deliberately NOT
+    # here: the two attention tiers are different configurations with
+    # separate banked histories even at identical engine shapes, so a
+    # kernel-tier row never competes with reference-row history — the
+    # step_mode/mesh_axes precedent (roofline.stamp_row stamps it)
+    # backend-token agreement of the serving_engine A/B pair — derived
+    # cross-row check results, never identity (exact on f32 models,
+    # rate-reported on bf16 where the kernel tier's bf16 MXU dots
+    # legitimately round differently from the f32-upcast reference)
+    "backend_tokens_equal", "backend_token_match",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

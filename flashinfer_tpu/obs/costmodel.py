@@ -622,7 +622,9 @@ def engine_step(num_tokens: int, batch: int, layers: int, *, hidden: int,
                 inter: int, hq: int, hkv: int, hd: int, vocab: int,
                 kv_tokens: float, kv_rows: Optional[float] = None,
                 kv_bytes: int = 2, weight_bytes: int = 2,
-                act_bytes: int = 2, dtype: str = "bf16") -> Cost:
+                act_bytes: int = 2, dtype: str = "bf16",
+                kv_pairs_launched: Optional[float] = None,
+                kv_rows_launched: Optional[float] = None) -> Cost:
     """One continuous-batching ENGINE step (serve/engine.py): mixed
     decode + chunked-prefill tokens on one flat axis.
 
@@ -646,7 +648,19 @@ def engine_step(num_tokens: int, batch: int, layers: int, *, hidden: int,
 
     Plus the lm_head + per-lane sampling epilogue over ``batch`` lanes.
     The engine's FLOPs-avoided metering prices skipped prefill spans
-    with this same formula (``ServingEngine._prefill_cost_flops``)."""
+    with this same formula (``ServingEngine._prefill_cost_flops``).
+
+    Launched-vs-effective (the KERNEL attention backend): when the
+    engine runs the Pallas work-unit tier, ``kv_pairs_launched`` /
+    ``kv_rows_launched`` carry the REAL unit stats — padded unit
+    grids, chunk-aligned page walks, scratch-page DMAs included
+    (``ServingEngine.unit_stats``).  The attention term then prices
+    ``flops`` from launched pairs and ``bytes`` from launched rows,
+    with ``flops_effective`` holding the exact attended-pair work, so
+    ``obs perf`` exposes the tier's true padding waste instead of the
+    dense window the reference tier attends through.  Left ``None``
+    (the reference tier, and every pre-graduation caller) the formula
+    is unchanged: launched == effective attended pairs."""
     qdim, kvdim = hq * hd, hkv * hd
     L = float(layers)
     if kv_rows is None:
@@ -664,11 +678,16 @@ def engine_step(num_tokens: int, batch: int, layers: int, *, hidden: int,
                  + norm(num_tokens, hidden, bytes_per=act_bytes)
                  + rope(num_tokens, hq + hkv, hd, bytes_per=act_bytes)
                  + page_append(num_tokens, hkv, hd, kv_bytes=kv_bytes))
+    attn_pairs = (kv_tokens if kv_pairs_launched is None
+                  else kv_pairs_launched)
+    attn_rows = kv_rows if kv_rows_launched is None else kv_rows_launched
     attn = Cost(
-        flops=2.0 * kv_tokens * hq * (2 * hd),
+        flops=2.0 * attn_pairs * hq * (2 * hd),
         bytes_read=(num_tokens * hq * hd * act_bytes
-                    + kv_rows * hkv * (2 * hd) * kv_bytes),
+                    + attn_rows * hkv * (2 * hd) * kv_bytes),
         bytes_written=float(num_tokens) * hq * hd * act_bytes,
+        flops_effective=(None if kv_pairs_launched is None
+                         else 2.0 * kv_tokens * hq * (2 * hd)),
         dtype=dtype, op="engine_attention",
     )
     total = _scale(per_layer, L) + _scale(attn, L)

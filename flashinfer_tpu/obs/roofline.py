@@ -122,7 +122,8 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
               spec: ChipSpec, *, num_splits: Optional[int] = None,
               merge_bytes: Optional[float] = None,
               step_mode: Optional[str] = None,
-              mesh_axes: Optional[str] = None) -> Dict:
+              mesh_axes: Optional[str] = None,
+              attention_backend: Optional[str] = None) -> Dict:
     """Write the canonical roofline fields onto a bench row in place.
     Every bench.py routine stamps through here — the uniform schema is
     what makes ``obs perf`` and the auditor's roofline-fraction rule
@@ -147,7 +148,14 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
     Costs carrying collective traffic additionally stamp ``ici_bytes``
     and ``pct_ici_roofline`` (measurement fields: the predicted ICI
     wire bytes and the fraction of measured time the ICI floor
-    explains)."""
+    explains).
+
+    ``attention_backend`` is the serving-engine attention-tier
+    identity (``"reference"`` — the dense XLA oracle — vs
+    ``"kernel"`` — the Pallas work-unit lowering,
+    serve/engine_kernels.py): configuration like step_mode, so a
+    kernel-tier row never competes with reference-row history in the
+    quality audit."""
     res = attribute(cost, seconds, spec)
     if num_splits is not None:
         row["num_splits"] = int(num_splits)
@@ -157,6 +165,8 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
         row["step_mode"] = str(step_mode)
     if mesh_axes is not None:
         row["mesh_axes"] = str(mesh_axes)
+    if attention_backend is not None:
+        row["attention_backend"] = str(attention_backend)
     if cost.ici_bytes:
         row["ici_bytes"] = float(cost.ici_bytes)
         row["pct_ici_roofline"] = round(res.pct_ici_roofline, 4)

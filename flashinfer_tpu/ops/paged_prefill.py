@@ -154,6 +154,7 @@ def build_prefill_work_units(
     window_left: int = -1,
     pack_tiles: bool = True,
     prune: bool = True,
+    num_units_pad: Optional[int] = None,
 ):
     """Host-side plan: flatten (qo-tile, request, kv-chunk) work units.
 
@@ -163,6 +164,13 @@ def build_prefill_work_units(
     pages_per_chunk) the arrays were built for and a ``stats`` dict
     (unit counts before/after pruning, row/MXU-cell fill — the
     padding-waste numbers the obs histograms report).
+
+    ``num_units_pad`` overrides the power-of-two padding with an exact
+    unit count (>= the real units, else ValueError): callers that
+    re-plan every step against ONE compiled launch — the serving
+    engine's rung ladder (serve/engine_kernels.py) — pad every plan of
+    a rung to the same cap so the plan-array SHAPES never retrace while
+    the values change freely.
 
     Per-unit fields: ``qstart`` (q-tile token start), ``rowlo``/``rowhi``
     (this unit's request's row span within the tile), ``qpos0``
@@ -319,7 +327,16 @@ def build_prefill_work_units(
     assert starts == sorted(starts), "work units must be qstart-ordered"
 
     n_real = len(units)
-    U = max(next_power_of_two(max(n_real, 1)), 8)
+    if num_units_pad is not None:
+        if n_real > num_units_pad:
+            raise ValueError(
+                f"num_units_pad={num_units_pad} but the plan needs "
+                f"{n_real} work units — the caller's per-rung unit cap "
+                "is undersized (serve/engine_kernels.py computes it "
+                "from the rung statics; a schedule can never exceed it)")
+        U = max(int(num_units_pad), 1)
+    else:
+        U = max(next_power_of_two(max(n_real, 1)), 8)
     stats = {
         "units": n_real,
         "units_canonical": canon_idx,
@@ -434,6 +451,7 @@ def _fused_prefill_kernel(
     causal: bool,
     num_units: int,
     has_mask: bool,
+    return_lse: bool,
     trace_events: bool,
 ):
     i = 3
@@ -442,10 +460,12 @@ def _fused_prefill_kernel(
     i += 1 if has_mask else 0
     o_hbm = refs[i]
     i += 1
+    lse_hbm = refs[i] if return_lse else None
+    i += 1 if return_lse else 0
     ev_ref = refs[i] if trace_events else None
     i += 1 if trace_events else 0
     (qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref,
-     qsem, ksem, vsem, osem) = refs[i:]
+     qsem, ksem, vsem, osem, lsebuf, lsesem) = refs[i:]
     hkv = pl.program_id(0)
     u = pl.program_id(1)
     chunk_tokens = ppc * page_size
@@ -529,6 +549,14 @@ def _fused_prefill_kernel(
     k = kbuf[slot]
     v = vbuf[slot]
     qm = qbuf[qslot].reshape(bqg, k.shape[-1])  # [bq*group, D]
+    if k.dtype != qm.dtype:
+        # quantized (int8/fp8) KV cache: bytes cross HBM at the narrow
+        # width, dequant is an in-register cast; scalar k_scale/v_scale
+        # are folded into sm_scale / the caller's output (the decode
+        # kernels' scale-folding contract).  Same-dtype caches take the
+        # untouched original path bit-for-bit.
+        k = k.astype(qm.dtype)
+        v = v.astype(qm.dtype)
     s = jax.lax.dot_general(
         qm, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -632,13 +660,30 @@ def _fused_prefill_kernel(
         )
         out_dma.start()
         out_dma.wait()
+        if return_lse:
+            # per-row log-sum-exp for downstream state merges (cascade
+            # composition / split-KV reduction): rows that attended
+            # nothing emit the _NEG_INF empty-state sentinel, which
+            # merge_state treats as a hard-zero weight
+            m = m_ref[...][:, :1]
+            lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
+            lsebuf[...] = jnp.broadcast_to(lse, (bqg, 128)).reshape(
+                lsebuf.shape)
+            lse_dma = pltpu.make_async_copy(
+                lsebuf,
+                lse_hbm.at[hkv, pl.ds(qstart_ref[u], bq)],
+                lsesem,
+            )
+            lse_dma.start()
+            lse_dma.wait()
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "num_units", "block_q", "pages_per_chunk", "sm_scale",
-        "logits_soft_cap", "window_left", "causal", "trace_events",
+        "logits_soft_cap", "window_left", "causal", "return_lse",
+        "trace_events",
     ),
 )
 def fused_paged_prefill(
@@ -654,6 +699,7 @@ def fused_paged_prefill(
     logits_soft_cap: float = 0.0,
     window_left: int = -1,
     causal: bool = True,
+    return_lse: bool = False,
     trace_events: bool = False,
 ):
     total_q, H, D = q.shape
@@ -692,6 +738,16 @@ def fused_paged_prefill(
     out_shape = jax.ShapeDtypeStruct(
         (Hkv, total_q + block_q, group, D), q.dtype
     )
+    if return_lse:
+        # lse rides the same manual-DMA write-back as the output (lane
+        # dim broadcast to 128 — the decode kernels' lse layout); rows
+        # no unit covered keep the zero-init (callers that need the
+        # empty-state sentinel cover every row with a plan segment, the
+        # engine-planner contract)
+        out_specs = [out_specs, pl.BlockSpec(memory_space=pl.ANY)]
+        out_shape = [out_shape, jax.ShapeDtypeStruct(
+            (Hkv, total_q + block_q, group, 128), jnp.float32
+        )]
     if trace_events:
         # one tag row per grid step (reference profiler.cuh device tag
         # buffer, TPU form: see flashinfer_tpu.profiler module docs);
@@ -702,12 +758,16 @@ def fused_paged_prefill(
                 "trace_events supports plans up to 4096 work units "
                 f"(12-bit tag block field), got {num_units}"
             )
-        out_specs = [out_specs, pl.BlockSpec(
+        ev_spec = pl.BlockSpec(
             (None, None, 8, 128), lambda h, u, *prefetch: (h, u // 8, 0, 0)
-        )]
-        out_shape = [out_shape, jax.ShapeDtypeStruct(
+        )
+        ev_shape = jax.ShapeDtypeStruct(
             (Hkv, cdiv(num_units, 8), 8, 128), jnp.int32
-        )]
+        )
+        out_specs = (out_specs if isinstance(out_specs, list)
+                     else [out_specs]) + [ev_spec]
+        out_shape = (out_shape if isinstance(out_shape, list)
+                     else [out_shape]) + [ev_shape]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=11,
         grid=(Hkv, num_units),
@@ -725,6 +785,14 @@ def fused_paged_prefill(
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
             pltpu.SemaphoreType.DMA((2, pages_per_chunk)),
             pltpu.SemaphoreType.DMA(()),
+            # lse write-back staging + its DMA sem.  The ENTRY exists
+            # on both paths so the scratch list stays a statically
+            # countable literal (the L007 arity / L009 VMEM-evaluator
+            # contracts); the SHAPE degenerates to one sublane row
+            # when lse is off so non-lse launches reclaim the VMEM
+            pltpu.VMEM((block_q, group, 128) if return_lse
+                       else (1, 1, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
         ],
     )
     operands = [q_pad, k_cache, v_cache]
@@ -736,7 +804,8 @@ def fused_paged_prefill(
             bq=block_q, ppc=pages_per_chunk, page_size=page_size,
             group=group, sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
             window_left=window_left, causal=causal, num_units=num_units,
-            has_mask=has_mask, trace_events=trace_events,
+            has_mask=has_mask, return_lse=return_lse,
+            trace_events=trace_events,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -751,12 +820,25 @@ def fused_paged_prefill(
         plan["qslot"], plan["code"], plan["pages"],
         *operands,
     )
-    if trace_events:
+    lse = None
+    if return_lse and trace_events:
+        out, lse_raw, ev = out
+    elif return_lse:
+        out, lse_raw = out
+    elif trace_events:
         out, ev = out
+    if trace_events:
         # [Hkv, ceil(U/8), 8, 128] -> [Hkv, num_units] tags, grid order
         events = ev[..., 0].reshape(Hkv, -1)[:, :num_units]
+    if return_lse:
+        # [Hkv, tq_pad, group, 128] -> [tq, H] (lane 0 carries the value)
+        lse = jnp.transpose(lse_raw[:, :total_q, :, 0], (1, 0, 2)).reshape(
+            total_q, H
+        )
     # [Hkv, tq_pad, group, D] -> [tq, H, D]
     result = jnp.transpose(out[:, :total_q], (1, 0, 2, 3)).reshape(
         total_q, H, D
     )
-    return (result, events) if trace_events else result
+    ret = (result,) + ((lse,) if return_lse else ())
+    ret = ret + ((events,) if trace_events else ())
+    return ret if len(ret) > 1 else result

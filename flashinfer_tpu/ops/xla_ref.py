@@ -6,6 +6,15 @@ kernel has an "xla" twin with identical semantics, used as the correctness
 oracle in tests and as the fallback backend off-TPU or for exotic shapes.
 These are dense (padded) computations — O(total_q * total_kv) — so they are
 for correctness, not speed.
+
+This dense form is the ORACLE TIER everywhere it appears, never the
+serving path: the serving engine's ``attention_backend="reference"``
+runs its own in-body equivalent of these semantics (position-determined
+windows, serve/engine.py) purely as the interpret-mode correctness
+anchor, while production attention rides the Pallas work-unit kernels
+(``attention_backend="kernel"`` — serve/engine_kernels.py lowers the
+engine schedule onto ops/paged_prefill.py + ops/paged_decode.py, with
+this tier pinning every token it serves).
 """
 
 from __future__ import annotations

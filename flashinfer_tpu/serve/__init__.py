@@ -26,7 +26,13 @@ the step never retraces across tokens.
   prefix-cache reuse via the cascade merge operator, and SLO-aware
   scheduling on a pre-compiled rung ladder (:class:`ServingEngine`,
   :class:`EngineConfig`, :class:`EngineRequest`, :class:`BlockPool`,
-  :class:`PrefixCache`).
+  :class:`PrefixCache`);
+- :mod:`~flashinfer_tpu.serve.engine_kernels` — the engine's KERNEL
+  attention tier (``EngineConfig.attention_backend="kernel"``): the
+  host planner that lowers each step's schedule onto the work-unit
+  prefill mainloop + split-KV decode plan arrays, and the in-jit
+  cascade-merged composition (docs/performance.md §"Engine kernel
+  graduation").
 
 See docs/performance.md ("Compile-once serving step") for the step
 lifecycle and donation contract, and docs/serving.md for the engine.

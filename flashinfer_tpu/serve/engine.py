@@ -45,6 +45,25 @@ sharing ON bitwise-equal to the no-sharing oracle (same requests, full
 per-request prefill) — pinned across f32 and int8-KV caches in
 tests/test_serve_engine.py.
 
+Attention backends (``EngineConfig.attention_backend``, the
+``engine.attention_backend`` autotuner knob):
+
+- ``"reference"`` — the dense XLA windowed form above.  This is the
+  engine's INTERPRET-MODE ORACLE TIER: O(T x K) through masked lanes,
+  but provably bitwise vs the no-sharing oracle on CPU, so it anchors
+  every correctness claim the kernel tier is measured against.
+- ``"kernel"`` — the graduated path (ROADMAP item 1): the per-step
+  schedule lowers through
+  :func:`~flashinfer_tpu.serve.engine_kernels.build_engine_work_units`
+  onto the PR 3 work-unit prefill mainloop and the PR 6 split-KV
+  decode units, composed by the same cascade merge fold.  Plan arrays
+  ride as rung-padded ARGUMENTS (shapes are rung statics), so the
+  kernel tier keeps the compile-once ladder; it skips the masked-lane
+  HBM/FLOP waste the reference tier pays.  Tokens are pinned equal to
+  the reference tier (tests/test_engine_kernels.py), and interpret
+  mode makes the whole path CPU-testable before the first on-chip
+  session (``bench.py --only serving_engine`` A/Bs the two).
+
 See docs/serving.md for lifecycle, pool invariants, prefix-cache
 semantics, scheduler knobs, and the retrace-budget contract.
 """
@@ -325,9 +344,10 @@ class EngineRequest:
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Frozen engine statics.  ``block_size`` / ``prefill_budget_tokens``
-    / ``max_batch`` are autotuner knobs (``engine.*`` in KNOWN_KNOBS,
-    shape-keyed on the model's (hidden, hq, hkv, hd)); ``from_knobs``
-    resolves them through the per-chip-gen tuning configs."""
+    / ``max_batch`` / ``attention_backend`` are autotuner knobs
+    (``engine.*`` in KNOWN_KNOBS, shape-keyed on the model's
+    (hidden, hq, hkv, hd)); ``from_knobs`` resolves them through the
+    per-chip-gen tuning configs."""
 
     num_pages: int                  # physical pages incl. scratch page 0
     page_size: int = 16             # engine.block_size
@@ -341,14 +361,20 @@ class EngineConfig:
     slo_step_seconds: Optional[float] = None  # predicted-step-time cap
     donate: bool = True
     seed: int = 0
+    # engine.attention_backend: "reference" = the dense XLA oracle
+    # tier (bitwise-provable on CPU), "kernel" = the Pallas work-unit
+    # lowering (serve/engine_kernels.py; interpret-mode off-TPU)
+    attention_backend: str = "reference"
+    decode_num_splits: int = 1      # kernel tier's split-KV factor
 
     @staticmethod
     def from_knobs(model_cfg, *, num_pages: int, max_seq_tokens: int = 256,
                    **over) -> "EngineConfig":
         """Resolve the tunable statics through ``autotuner.KNOWN_KNOBS``
         (engine.block_size / engine.prefill_budget_tokens /
-        engine.max_batch), shape-keyed on the model geometry so each
-        chip generation ships its own scheduler shape ladder."""
+        engine.max_batch / engine.attention_backend), shape-keyed on
+        the model geometry so each chip generation ships its own
+        scheduler shape ladder + attention tier."""
         from flashinfer_tpu.autotuner import AutoTuner
 
         t = AutoTuner.get()
@@ -359,6 +385,8 @@ class EngineConfig:
             prefill_budget_tokens=int(t.lookup(
                 "engine.prefill_budget_tokens", key, default=64)),
             max_batch=int(t.lookup("engine.max_batch", key, default=8)),
+            attention_backend=str(t.lookup(
+                "engine.attention_backend", key, default="reference")),
         )
         knobs.update(over)
         return EngineConfig(num_pages=num_pages,
@@ -409,9 +437,13 @@ class ServingEngine:
     _STATE_NAMES = ("params", "flat_tokens", "positions", "tok_req",
                     "token_page", "token_slot", "page_table", "grp_pages",
                     "tok_grp", "split", "last_rows", "sample_seeds",
-                    "caches")
+                    "kplans", "caches")
 
     def __init__(self, model_cfg, params, config: EngineConfig):
+        if config.attention_backend not in ("reference", "kernel"):
+            raise ValueError(
+                f"attention_backend must be 'reference' or 'kernel', "
+                f"got {config.attention_backend!r}")
         self.cfg = model_cfg
         self.params = params
         self.config = config
@@ -449,6 +481,29 @@ class ServingEngine:
             for _ in range(model_cfg.num_layers)
         ]
         self._ppr = ppr
+        self._kernel_backend = config.attention_backend == "kernel"
+        self._geom = None
+        # launched-vs-effective unit accounting (kernel tier): what the
+        # padded work-unit grids actually execute vs the attended pairs
+        # — costmodel.engine_step prices the bench A/B from these
+        self.unit_stats = {
+            "prefill_units": 0, "prefill_units_launched": 0,
+            "prefill_cells_launched": 0.0, "prefill_cells_valid": 0.0,
+            "decode_pages_real": 0, "decode_pages_launched": 0,
+            "kv_pairs_launched": 0.0, "kv_rows_launched": 0.0,
+        }
+        if self._kernel_backend:
+            from flashinfer_tpu.serve.engine_kernels import EngineKernelGeom
+
+            self._geom = EngineKernelGeom.build(
+                page_size=ps, pages_per_req=ppr,
+                max_batch=config.max_batch,
+                max_rung=max(config.rungs()),
+                num_kv_heads=model_cfg.num_kv_heads,
+                head_dim=model_cfg.head_dim,
+                kv_itemsize=kv_dtype.itemsize,
+                num_splits=config.decode_num_splits,
+            )
         self._build_step()
 
     # -- public surface ---------------------------------------------------
@@ -513,9 +568,22 @@ class ServingEngine:
         """The whole run's work as one ``costmodel.engine_step`` Cost
         over the accumulated totals (the formula is linear in each
         term) — what bench.py's ``serving_engine`` phase stamps its
-        rows with, shared-prefix KV dedup included via kv_rows."""
+        rows with, shared-prefix KV dedup included via kv_rows.
+
+        On the KERNEL backend the launched terms come from the REAL
+        work-unit stats (padded unit grids, scratch-page chunk DMAs and
+        all — ``ServingEngine.unit_stats``) while the effective terms
+        stay the exact attended-pair accounting, so the stamped
+        ``flops`` vs ``flops_effective`` gap is the tier's true
+        padding/pruning waste, not the dense-window fiction the
+        reference tier pays."""
         from flashinfer_tpu.obs import costmodel
 
+        launched = {}
+        if self._kernel_backend:
+            launched = dict(
+                kv_pairs_launched=self.unit_stats["kv_pairs_launched"],
+                kv_rows_launched=self.unit_stats["kv_rows_launched"])
         return costmodel.engine_step(
             num_tokens=self.tokens_total, batch=max(self.sampled_total, 1),
             layers=self.cfg.num_layers, hidden=self.cfg.hidden_size,
@@ -523,7 +591,7 @@ class ServingEngine:
             hkv=self.cfg.num_kv_heads, hd=self.cfg.head_dim,
             vocab=self.cfg.vocab_size, kv_tokens=self.kv_pairs_total,
             kv_rows=self.kv_rows_total,
-            kv_bytes=1 if self._int8_kv else 2)
+            kv_bytes=1 if self._int8_kv else 2, **launched)
 
     # -- admission + scheduling -------------------------------------------
 
@@ -651,17 +719,49 @@ class ServingEngine:
             self._waiting.remove(r)
 
     def _prefill_cost_flops(self, r: EngineRequest, tokens: int) -> float:
-        """Prefill FLOPs the prefix hit avoided, from the shared cost
-        model (GEMM + attention terms of the skipped span)."""
+        """Prefill FLOPs the prefix hit avoided.
+
+        Reference backend: the analytic ``engine_step`` formula over
+        the skipped span (its dense attention IS the formula).  Kernel
+        backend: the attention term comes from the REAL planner —
+        ``build_prefill_work_units`` is run for the skipped span and
+        its launched MXU-cell stats price the work the kernel tier
+        would actually have executed (bench.py's
+        ``prefill_flops_avoided`` is therefore unit-stats-derived, not
+        a dense-window estimate)."""
         from flashinfer_tpu.obs import costmodel
 
+        kv_pairs = tokens * (tokens + 1) // 2
+        launched = {}
+        if self._kernel_backend:
+            from flashinfer_tpu.ops.paged_prefill import (
+                build_prefill_work_units)
+
+            g = self._geom
+            pages = np.asarray(
+                r.pages[:-(-tokens // g.page_size)], np.int64)
+            plan = build_prefill_work_units(
+                np.asarray([0, tokens], np.int64),
+                np.asarray([0, len(pages)], np.int64), pages,
+                np.asarray([tokens], np.int64),
+                g.block_q, g.prefill_ppc, g.page_size,
+                causal=True, window_left=-1, pack_tiles=True, prune=True)
+            # REAL units only (plan["stats"]["units"]): the skipped
+            # span's work is priced at what its units would execute,
+            # not at the pow2 padding of a standalone plan — padding
+            # waste belongs to the steps that actually launch it
+            real_units = plan["stats"]["units"]
+            chunk = g.prefill_ppc * g.page_size
+            launched = dict(
+                kv_pairs_launched=float(real_units * g.block_q * chunk),
+                kv_rows_launched=float(real_units * chunk))
         cost = costmodel.engine_step(
             num_tokens=tokens, batch=1, layers=self.cfg.num_layers,
             hidden=self.cfg.hidden_size, inter=self.cfg.intermediate_size,
             hq=self.cfg.num_qo_heads, hkv=self.cfg.num_kv_heads,
             hd=self.cfg.head_dim, vocab=self.cfg.vocab_size,
-            kv_tokens=tokens * (tokens + 1) // 2,
-            kv_bytes=1 if self._int8_kv else 2,
+            kv_tokens=kv_pairs,
+            kv_bytes=1 if self._int8_kv else 2, **launched,
         )
         return cost.flops
 
@@ -783,9 +883,12 @@ class ServingEngine:
             H = mcfg.num_qo_heads
             return out.reshape(T, H, mcfg.head_dim), lse.reshape(T, H)
 
+        kernel_backend = self._kernel_backend
+        geom = self._geom
+
         def _body(params, flat_tokens, positions, tok_req, token_page,
                   token_slot, page_table, grp_pages, tok_grp, split,
-                  last_rows, sample_seeds, caches):
+                  last_rows, sample_seeds, kplans, caches):
             from flashinfer_tpu.activation import silu_and_mul
             from flashinfer_tpu.cascade import compose_cascade_levels
             from flashinfer_tpu.models.llama import _mm, _pre_quant
@@ -821,20 +924,41 @@ class ServingEngine:
                 kc = kc.at[token_page, :, token_slot, :].set(k_w)
                 vc = vc.at[token_page, :, token_slot, :].set(v_w)
                 new_caches.append((kc, vc))
-                # level 1: the request's own window, rows [split, pos]
-                k1 = _window(kc, page_table)[tok_req]
-                v1 = _window(vc, page_table)[tok_req]
-                o1, lse1 = _attend(q, k1, v1, split, positions)
-                # level 0: the SHARED prefix run, gathered once per
-                # group slot, rows [0, min(split, pos + 1)) — causal by
-                # position so a leader mid-prefill never sees ahead
-                k0 = _window(kc, grp_pages)[tok_grp]
-                v0 = _window(vc, grp_pages)[tok_grp]
-                hi0 = jnp.minimum(split - 1, positions)
-                o0, lse0 = _attend(q, k0, v0, jnp.zeros_like(split), hi0)
-                # cascade composition (reference cascade.cuh merge):
-                # empty levels pass through exactly via the lse guard
-                o, _ = compose_cascade_levels([(o0, lse0), (o1, lse1)])
+                if kernel_backend:
+                    # the graduated path: the same two-level cascade,
+                    # but level 1 rides the work-unit prefill mainloop
+                    # + split-KV decode units and level 0 the
+                    # group-masked prefill plan — all composed by the
+                    # same merge fold (serve/engine_kernels.py)
+                    from flashinfer_tpu.serve.engine_kernels import (
+                        engine_kernel_attention)
+
+                    o = engine_kernel_attention(
+                        q, kc, vc, kplans, geom=geom, sm_scale=sm_scale)
+                else:
+                    # the dense XLA oracle tier (interpret-mode
+                    # reference): position-determined windows attended
+                    # through masked lanes — O(T x K) but bitwise-
+                    # provable vs the no-sharing oracle on CPU
+                    # level 1: the request's own window, rows
+                    # [split, pos]
+                    k1 = _window(kc, page_table)[tok_req]
+                    v1 = _window(vc, page_table)[tok_req]
+                    o1, lse1 = _attend(q, k1, v1, split, positions)
+                    # level 0: the SHARED prefix run, gathered once per
+                    # group slot, rows [0, min(split, pos + 1)) —
+                    # causal by position so a leader mid-prefill never
+                    # sees ahead
+                    k0 = _window(kc, grp_pages)[tok_grp]
+                    v0 = _window(vc, grp_pages)[tok_grp]
+                    hi0 = jnp.minimum(split - 1, positions)
+                    o0, lse0 = _attend(q, k0, v0, jnp.zeros_like(split),
+                                       hi0)
+                    # cascade composition (reference cascade.cuh
+                    # merge): empty levels pass through exactly via the
+                    # lse guard
+                    o, _ = compose_cascade_levels([(o0, lse0),
+                                                   (o1, lse1)])
                 if int8_kv:
                     o = o * mcfg.kv_v_scale
                 attn = o.astype(mcfg.dtype)
@@ -872,7 +996,7 @@ class ServingEngine:
                     kk, jnp.log(jnp.maximum(p, 1e-30))))(probs, keys)
             return tokens.astype(jnp.int32), new_caches
 
-        donate = (12,) if cfg.donate else ()
+        donate = (13,) if cfg.donate else ()
         self._step = jax.jit(_body, donate_argnums=donate)
 
     # -- step construction + execution ------------------------------------
@@ -932,16 +1056,36 @@ class ServingEngine:
         groups: Dict[Tuple[int, ...], int] = {}
         for r in self._running:
             page_table[r.slot, :len(r.pages)] = r.pages
+
+        def _grp_key(r):
+            run = tuple(r.pages[:r.split // ps])
+            return run or (-1 - r.slot,)
+
+        for r, _n in sched:
+            key = _grp_key(r)
+            if key not in groups:
+                g = len(groups)
+                groups[key] = g
+                run = tuple(r.pages[:r.split // ps])
+                grp_pages[g, :len(run)] = run
+        # same-group requests pack ADJACENT flat rows (stable sort, so
+        # within-group order is the scheduler's): the kernel backend's
+        # level-0 plan gathers each shared run once per contiguous
+        # group span, and tokens are packing-invariant bitwise (the
+        # module-doc contract), so the reference backend is unmoved
+        sched.sort(key=lambda e: groups[_grp_key(e[0])])
+        segs = []
         row = 0
         for r, n in sched:
-            prefix_run = tuple(r.pages[:r.split // ps])
-            if prefix_run and prefix_run in groups:
-                g = groups[prefix_run]
-            else:
-                g = len(groups)
-                groups[prefix_run or (-1 - r.slot,)] = g
-                grp_pages[g, :len(prefix_run)] = prefix_run
+            g = groups[_grp_key(r)]
             decoding = r.kv_len >= len(r.prompt)
+            if self._kernel_backend:
+                from flashinfer_tpu.serve.engine_kernels import SchedSeg
+
+                segs.append(SchedSeg(
+                    row0=row, n=n, pages=tuple(r.pages), split=r.split,
+                    kv_after=r.kv_len + n, decoding=decoding,
+                    slot=r.slot, group=g))
             seq = r.seq()
             for i in range(n):
                 p = r.kv_len + i
@@ -975,12 +1119,32 @@ class ServingEngine:
         self.tokens_total += total
         self.sampled_total += len(samplers)
 
+        kplans: dict = {}
+        if self._kernel_backend:
+            from flashinfer_tpu.serve import engine_kernels as _ek
+
+            plans = _ek.build_engine_work_units(segs, rung=rung,
+                                                geom=self._geom)
+            st = plans["stats"]
+            us = self.unit_stats
+            us["prefill_units"] += st["prefill_units"]
+            us["prefill_units_launched"] += st["prefill_units_launched"]
+            us["prefill_cells_launched"] += st["prefill_cells_launched"]
+            us["prefill_cells_valid"] += st["prefill_cells_valid"]
+            us["decode_pages_real"] += st["decode_pages_real"]
+            us["decode_pages_launched"] += st["decode_pages_launched"]
+            us["kv_pairs_launched"] += (st["prefill_cells_launched"]
+                                        + st["decode_cells_launched"])
+            us["kv_rows_launched"] += (st["prefill_rows_launched"]
+                                       + st["decode_rows_launched"])
+            kplans = _ek.plans_to_device(plans)
+
         full_args = (self.params, jnp.asarray(flat), jnp.asarray(pos),
                      jnp.asarray(tok_req), jnp.asarray(token_page),
                      jnp.asarray(token_slot), jnp.asarray(page_table),
                      jnp.asarray(grp_pages), jnp.asarray(tok_grp),
                      jnp.asarray(split), jnp.asarray(last_rows),
-                     jnp.asarray(sample_seeds), self.caches)
+                     jnp.asarray(sample_seeds), kplans, self.caches)
         sig = obs.state_signature(full_args, names=self._STATE_NAMES)
         seen = self._rung_traced.get(rung, 0)
         before = self._traces

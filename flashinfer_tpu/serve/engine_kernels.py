@@ -1,0 +1,368 @@
+"""Pallas work-unit lowering of the serving engine's attention step.
+
+The graduation ROADMAP item 1 names: the continuous-batching engine
+(serve/engine.py) schedules mixed decode + chunked-prefill tokens on one
+flat axis with a two-level cascade decomposition, and PR 11 deliberately
+ran that attention in the dense XLA reference form so the bitwise
+no-sharing contract could be proved on CPU.  This module lowers the
+SAME per-step schedule onto the proven work-unit kernels instead:
+
+- :func:`build_engine_work_units` — the host-side planner.  It takes
+  the engine's flat-row schedule segments (tokens/positions, group page
+  runs, per-token window bounds, the cascade split) and lowers them
+  into the existing plan-array forms:
+
+  * **level 0** (the shared-prefix span, gathered once per prefix
+    GROUP) and **level 1 chunked prefill** (each request's suffix
+    window, ``q_len >= 1``) both become
+    :func:`~flashinfer_tpu.ops.paged_prefill.build_prefill_work_units`
+    plans — level 0 with a per-token custom mask encoding the
+    causal-by-global-position rule ``kv_row <= pos`` over the group
+    run, level 1 with the planner's native causal rule (suffix-local
+    positions, negative ``qpos0`` rows inside the shared span attend
+    nothing and emit the empty-state sentinel);
+  * **decode tokens** (``q_len == 1``) become
+    :func:`~flashinfer_tpu.ops.paged_decode.build_decode_split_units`
+    units over their suffix page runs (PR 6's split-KV partials +
+    ``merge_states`` reduction).
+
+- :func:`engine_kernel_attention` — the in-jit composition: the three
+  kernel launches produce per-level ``(out, lse)`` states and fold
+  through the SAME :func:`~flashinfer_tpu.cascade.compose_cascade_levels`
+  merge operator the reference backend uses, so the cascade
+  decomposition — and the shared-run HBM dedup it exists for — is
+  identical across backends.
+
+Retrace contract (the engine's rung ladder): every plan-array shape is
+a pure function of the RUNG, never the schedule values — prefill plans
+pad to :meth:`EngineKernelGeom.prefill_unit_cap` via the planner's
+``num_units_pad``, the decode plan is ``max_batch x num_splits`` units
+over a fixed-width page table, and the level-0 custom-mask operand is
+always present (all-ones windows demote to the maskless PARTIAL code
+in-plan, so steady-state decode pays no expansion).  One rung == one
+trace, the same <= 9-trace budget the reference backend pins.
+
+Every flat row — scheduled or rung padding — is covered by a plan
+segment (padding rides trailing ``kv_len = 0`` segments), so both
+levels emit defined ``(0, -inf)`` empty states for unused rows instead
+of uninitialized HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from flashinfer_tpu.utils import cdiv, next_power_of_two, round_up
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedSeg:
+    """One scheduled (request, chunk) of the engine's step, in flat-row
+    order: rows ``[row0, row0 + n)`` of the rung-padded token axis.
+
+    ``pages`` is the request's full page run, ``split`` the page-aligned
+    cascade boundary frozen at first admission, ``kv_after`` the
+    request's KV length AFTER this step's append (the last row's
+    position + 1).  ``group`` is the step-local shared-prefix group id —
+    the engine orders segments so equal groups are adjacent, which is
+    what lets level 0 gather each shared run once per contiguous span."""
+
+    row0: int
+    n: int
+    pages: Tuple[int, ...]
+    split: int
+    kv_after: int
+    decoding: bool
+    slot: int
+    group: int
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineKernelGeom:
+    """The kernel backend's frozen launch geometry — every static the
+    three launches need, derived ONCE from the engine config so plan
+    shapes (and therefore traces) are functions of the rung alone."""
+
+    page_size: int
+    pages_per_req: int
+    max_batch: int
+    block_q: int
+    prefill_ppc: int     # kv pages per DMA chunk, both prefill levels
+    decode_ppc: int      # split-decode chunk pages (split_pages_per_chunk)
+    num_splits: int      # decode split factor (1 = unsplit degenerate)
+    single_chunk: bool   # config-level certificate: every decode unit
+    #                      is at most one DMA chunk for EVERY schedule
+    dec_width: int       # fixed decode page-table width (chunk-aligned)
+
+    @staticmethod
+    def build(*, page_size: int, pages_per_req: int, max_batch: int,
+              max_rung: int, num_kv_heads: int, head_dim: int,
+              kv_itemsize: int, num_splits: int = 1) -> "EngineKernelGeom":
+        from flashinfer_tpu.ops.paged_decode import split_pages_per_chunk
+
+        block_q = min(128, next_power_of_two(max(max_rung, 1)))
+        prefill_ppc = max(1, min(512 // page_size, 16))
+        decode_ppc = split_pages_per_chunk(
+            page_size, num_kv_heads, head_dim, kv_itemsize)
+        per_unit_pages = round_up(cdiv(pages_per_req, num_splits),
+                                  decode_ppc)
+        return EngineKernelGeom(
+            page_size=page_size,
+            pages_per_req=pages_per_req,
+            max_batch=max_batch,
+            block_q=block_q,
+            prefill_ppc=prefill_ppc,
+            decode_ppc=decode_ppc,
+            num_splits=num_splits,
+            single_chunk=cdiv(pages_per_req, num_splits) <= decode_ppc,
+            dec_width=max(round_up(pages_per_req, decode_ppc),
+                          per_unit_pages * num_splits, decode_ppc),
+        )
+
+    @property
+    def max_prefill_chunks(self) -> int:
+        return max(cdiv(self.pages_per_req, self.prefill_ppc), 1)
+
+    def prefill_unit_cap(self, rung: int) -> int:
+        """Worst-case work units either prefill-level plan can need at
+        one rung: each of the <= max_batch scheduled segments (plus the
+        trailing padding segment) overlaps at most ``cdiv(n, block_q) +
+        1`` qo tiles, each (tile, segment) span walks at most
+        ``max_prefill_chunks`` KV chunks, and pruned spans keep one
+        zero-write fallback unit.  The cap is a RUNG static, so a rung's
+        plan shape can never vary step to step."""
+        spans = cdiv(rung, self.block_q) + 2 * (self.max_batch + 1)
+        return max(next_power_of_two(spans * self.max_prefill_chunks), 8)
+
+
+# plan-dict keys that ride into the jitted engine body (arrays only —
+# statics and stats stay host-side so the traced pytree is rung-stable)
+PREFILL_ARRAY_KEYS = ("qstart", "rowlo", "rowhi", "qpos0", "kvstart",
+                      "kvlen", "first", "wout", "qslot", "code", "pages",
+                      "mask_bytes")
+DECODE_ARRAY_KEYS = ("pages", "kvlen", "wu_req", "wu_page0", "wu_kvlen")
+
+
+def build_engine_work_units(
+    segs: Sequence[SchedSeg],
+    *,
+    rung: int,
+    geom: EngineKernelGeom,
+):
+    """Lower one engine step's schedule into the three plan-array forms.
+
+    ``segs`` must tile ``[0, total)`` of the flat axis contiguously and
+    keep equal ``group`` ids adjacent (the engine's group-sorted
+    schedule).  Returns a dict::
+
+        prefill0  — level-0 shared-prefix plan (custom mask, causal by
+                    global position; one qo segment per contiguous
+                    group span, so the run's pages stream once per
+                    (tile, chunk) for ALL riders — the cascade dedup)
+        prefill1  — level-1 suffix plan (causal; decode rows and
+                    fully-in-span chunks ride kv_len=0 segments that
+                    emit the empty state)
+        decode    — split-KV decode plan over per-slot suffix tables
+        dec_rows  — [max_batch] flat row of each decode lane's token
+                    (== rung for idle slots: gathers clip to a harmless
+                    row, scatters drop)
+        stats     — launched-vs-effective unit accounting for the
+                    ``engine_step`` cost family (padding INCLUDED in
+                    launched work: pad prefill units still stream their
+                    scratch-page chunk and run the masked MXU update)
+
+    Every array shape depends only on ``rung`` and ``geom`` — the
+    engine's compile-once contract.
+    """
+    from flashinfer_tpu.ops.paged_decode import build_decode_split_units
+    from flashinfer_tpu.ops.paged_prefill import build_prefill_work_units
+
+    ps = geom.page_size
+    total = segs[-1].row0 + segs[-1].n if segs else 0
+    if total > rung:
+        raise ValueError(f"schedule has {total} rows > rung {rung}")
+    row = 0
+    for s in segs:
+        if s.row0 != row:
+            raise ValueError("schedule segments must tile the flat axis "
+                             f"contiguously (row {row} != seg.row0 "
+                             f"{s.row0})")
+        row += s.n
+    U = geom.prefill_unit_cap(rung)
+
+    # ---- level 0: shared-prefix groups, causal-by-global-position ----
+    qo0 = [0]
+    kv0_lens: List[int] = []
+    pi0 = [0]
+    pages0: List[int] = []
+    mask_parts: List[np.ndarray] = []
+
+    def _close_seg0(n_rows, kv_len, pages, pos):
+        qo0.append(qo0[-1] + n_rows)
+        kv0_lens.append(kv_len)
+        pages0.extend(pages)
+        pi0.append(len(pages0))
+        if kv_len > 0:
+            cols = np.arange(kv_len)
+            mask_parts.append(
+                (cols[None, :] <= pos[:, None]).reshape(-1))
+
+    i = 0
+    while i < len(segs):
+        s = segs[i]
+        run = s.pages[:s.split // ps]
+        j = i
+        rows = 0
+        pos: List[int] = []
+        while j < len(segs) and segs[j].group == s.group:
+            e = segs[j]
+            pos.extend(range(e.kv_after - e.n, e.kv_after))
+            rows += e.n
+            j += 1
+        if run:
+            _close_seg0(rows, s.split, run, np.asarray(pos, np.int64))
+        else:
+            # no shared span (split == 0): level 0 is empty for these
+            # rows — a kv_len=0 segment emits the (0, -inf) pass-through
+            _close_seg0(rows, 0, (), np.zeros(0, np.int64))
+        i = j
+    if total < rung:  # rung padding rows: defined zeros, empty state
+        _close_seg0(rung - total, 0, (), np.zeros(0, np.int64))
+    mask0 = (np.concatenate(mask_parts) if mask_parts
+             else np.zeros(0, bool))
+    plan0 = build_prefill_work_units(
+        np.asarray(qo0, np.int64), np.asarray(pi0, np.int64),
+        np.asarray(pages0, np.int64), np.asarray(kv0_lens, np.int64),
+        geom.block_q, geom.prefill_ppc, ps,
+        mask_flat=mask0, causal=False, window_left=-1,
+        pack_tiles=True, prune=True, num_units_pad=U,
+    )
+
+    # ---- level 1: per-segment suffix windows, native causal rule ----
+    qo1 = [0]
+    kv1_lens: List[int] = []
+    pi1 = [0]
+    pages1: List[int] = []
+    for s in segs:
+        qo1.append(qo1[-1] + s.n)
+        suffix = s.kv_after - s.split
+        if s.decoding or suffix <= 0:
+            kv1_lens.append(0)
+            pi1.append(len(pages1))
+            continue
+        kv1_lens.append(suffix)
+        pages1.extend(s.pages[s.split // ps: cdiv(s.kv_after, ps)])
+        pi1.append(len(pages1))
+    if total < rung:
+        qo1.append(rung)
+        kv1_lens.append(0)
+        pi1.append(len(pages1))
+    plan1 = build_prefill_work_units(
+        np.asarray(qo1, np.int64), np.asarray(pi1, np.int64),
+        np.asarray(pages1, np.int64), np.asarray(kv1_lens, np.int64),
+        geom.block_q, geom.prefill_ppc, ps,
+        causal=True, window_left=-1,
+        pack_tiles=True, prune=True, num_units_pad=U,
+    )
+
+    # ---- decode lanes: split-KV units over per-slot suffix tables ----
+    dec_table = np.zeros((geom.max_batch, geom.dec_width), np.int32)
+    dec_lens = np.zeros(geom.max_batch, np.int64)
+    dec_rows = np.full(geom.max_batch, rung, np.int32)  # rung == idle
+    for s in segs:
+        if not s.decoding:
+            continue
+        suffix_pages = s.pages[s.split // ps: cdiv(s.kv_after, ps)]
+        dec_table[s.slot, :len(suffix_pages)] = suffix_pages
+        dec_lens[s.slot] = s.kv_after - s.split
+        dec_rows[s.slot] = s.row0
+    dplan = build_decode_split_units(
+        dec_table, dec_lens, num_splits=geom.num_splits,
+        page_size=ps, pages_per_chunk=geom.decode_ppc,
+    )
+    assert dplan["pages"].shape == (geom.max_batch, geom.dec_width), \
+        (dplan["pages"].shape, geom.dec_width)
+    assert not geom.single_chunk or dplan["single_chunk"]
+
+    chunk_tokens = geom.prefill_ppc * ps
+    stats = {
+        # launched work counts the PADDED unit grid: pad units still
+        # DMA their scratch-page chunk and run the masked MXU update,
+        # which is exactly the waste effective_pct_roofline exposes
+        "prefill_units": plan0["stats"]["units"] + plan1["stats"]["units"],
+        "prefill_units_launched": 2 * U,
+        "prefill_cells_launched": 2.0 * U * geom.block_q * chunk_tokens,
+        "prefill_cells_valid": float(plan0["stats"]["mxu_cells_valid"]
+                                     + plan1["stats"]["mxu_cells_valid"]),
+        "prefill_rows_launched": 2.0 * U * chunk_tokens,
+        "decode_pages_real": dplan["stats"]["pages_real"],
+        "decode_pages_launched": dplan["stats"]["pages_launched"],
+        "decode_rows_launched": float(
+            dplan["stats"]["pages_launched"] * ps),
+        "decode_cells_launched": float(
+            dplan["stats"]["pages_launched"] * ps),
+        "decode_cells_valid": float(dec_lens.sum()),
+    }
+    return dict(prefill0=plan0, prefill1=plan1, decode=dplan,
+                dec_rows=dec_rows, stats=stats)
+
+
+def plans_to_device(plans: Dict) -> Dict:
+    """The rung-stable traced pytree of a plan bundle: array leaves
+    only (statics/stats stripped), in a FIXED key layout so the jitted
+    body never sees a structure change."""
+    import jax.numpy as jnp
+
+    return dict(
+        prefill0={k: jnp.asarray(plans["prefill0"][k])
+                  for k in PREFILL_ARRAY_KEYS},
+        prefill1={k: jnp.asarray(plans["prefill1"][k])
+                  for k in PREFILL_ARRAY_KEYS if k != "mask_bytes"},
+        decode={k: jnp.asarray(plans["decode"][k])
+                for k in DECODE_ARRAY_KEYS},
+        dec_rows=jnp.asarray(plans["dec_rows"]),
+    )
+
+
+def engine_kernel_attention(q, k_cache, v_cache, kplans, *,
+                            geom: EngineKernelGeom, sm_scale: float):
+    """One layer's engine attention on the Pallas work units (traced
+    inside the engine's jitted body): level-0 + level-1 prefill
+    launches, the split-KV decode launch scattered into level 1, and
+    the cascade merge fold.  Returns f32 ``[T, H, D]`` (the same
+    contract as the reference backend's compose output; int8-KV
+    v_scale is applied by the caller after the merge, which is exact
+    because merging is linear in V)."""
+    from flashinfer_tpu.cascade import compose_cascade_levels
+    from flashinfer_tpu.ops.paged_decode import paged_decode_attention_split
+    from flashinfer_tpu.ops.paged_prefill import fused_paged_prefill
+
+    p0, p1, pd = kplans["prefill0"], kplans["prefill1"], kplans["decode"]
+    o0, lse0 = fused_paged_prefill(
+        q, k_cache, v_cache, p0,
+        num_units=p0["qstart"].shape[0], block_q=geom.block_q,
+        pages_per_chunk=geom.prefill_ppc, sm_scale=sm_scale,
+        causal=False, return_lse=True)
+    o1, lse1 = fused_paged_prefill(
+        q, k_cache, v_cache, p1,
+        num_units=p1["qstart"].shape[0], block_q=geom.block_q,
+        pages_per_chunk=geom.prefill_ppc, sm_scale=sm_scale,
+        causal=True, return_lse=True)
+    dec_rows = kplans["dec_rows"]
+    # idle lanes carry row == rung: the gather clips to a real row whose
+    # q is then attended against a kv_len=0 table (empty state), and
+    # the scatter back drops out-of-bounds lanes entirely
+    qd = q[dec_rows]
+    od, lsed = paged_decode_attention_split(
+        qd, k_cache, v_cache, pd,
+        num_units=pd["wu_req"].shape[0], num_splits=geom.num_splits,
+        single_chunk=geom.single_chunk,
+        pages_per_chunk=geom.decode_ppc, sm_scale=sm_scale,
+        return_lse=True)
+    o1 = o1.at[dec_rows].set(od.astype(o1.dtype), mode="drop")
+    lse1 = lse1.at[dec_rows].set(lsed.astype(lse1.dtype), mode="drop")
+    out, _ = compose_cascade_levels([(o0, lse0), (o1, lse1)])
+    return out

@@ -1,0 +1,370 @@
+"""Engine attention on the Pallas work-unit kernels — ISSUE 12.
+
+The graduation contracts under pin:
+
+- **cross-tier token parity** (THE acceptance anchor): the engine with
+  ``attention_backend="kernel"`` (schedule lowered onto the PR 3
+  work-unit prefill mainloop + PR 6 split-KV decode units, composed by
+  the cascade merge fold — serve/engine_kernels.py, interpret mode on
+  CPU) serves token-for-token what the ``"reference"`` tier serves —
+  and the reference tier is bitwise-equal to the no-sharing oracle, so
+  the kernel tier is transitively oracle-equal.  Pinned across
+  {f32, int8-KV} x {prefix-hit, miss, preemption-resume, mixed
+  chunked-prefill + decode rungs}, real sampling configs included
+  (everything is seeded, so agreement is exact).
+- **compile-once**: the kernel tier's plan-array shapes are rung
+  statics (planner ``num_units_pad``, fixed decode-table width, the
+  always-present level-0 mask operand), so a whole serving session
+  stays on the <= 9-trace rung ladder exactly like the reference tier.
+- **planner geometry**: rung-stable plan shapes across different
+  schedules, the unit-cap overflow guard, and the ``return_lse``
+  prefill output against the dense oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
+                                  SamplingConfig, ServingEngine)
+from flashinfer_tpu.serve.engine_kernels import (EngineKernelGeom,
+                                                 SchedSeg,
+                                                 build_engine_work_units)
+
+CFG = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+SAMPLING = SamplingConfig(temperature=0.8, top_k=20)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_llama_params(jax.random.PRNGKey(0), CFG)
+
+
+def _mk_engine(params, backend, share=True, **over):
+    kw = dict(num_pages=64, page_size=8, max_batch=4,
+              prefill_budget_tokens=16, max_seq_tokens=64,
+              sampling=SAMPLING, enable_prefix_cache=share,
+              attention_backend=backend)
+    kw.update(over)
+    return ServingEngine(CFG, params, EngineConfig(**kw))
+
+
+def _prompts(rng, n, shared_len=17, suffix_hi=6, n_shared=2):
+    shared = [[int(t) for t in rng.integers(1, CFG.vocab_size, shared_len)]
+              for _ in range(n_shared)]
+    out = []
+    for i in range(n):
+        sfx = [int(t) for t in rng.integers(
+            1, CFG.vocab_size, int(rng.integers(1, suffix_hi)))]
+        out.append(shared[i % n_shared] + sfx)
+    return out
+
+
+def _serve(params, prompts, backend, share=True, max_new=4, **over):
+    eng = _mk_engine(params, backend, share=share, **over)
+    for i, p in enumerate(prompts):
+        eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=max_new))
+    return eng.run(), eng
+
+
+def _tier_pair(params, seed, kv_dtype=None, share=True, **kw):
+    rng = np.random.default_rng(seed)
+    prompts = _prompts(rng, 8)
+    ref, _ = _serve(params, prompts, "reference", share=share,
+                    kv_dtype=kv_dtype, **kw)
+    ker, eng = _serve(params, prompts, "kernel", share=share,
+                      kv_dtype=kv_dtype, **kw)
+    return ref, ker, eng
+
+
+# ---------------------------------------------------------------------------
+# Cross-tier token parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_kernel_parity_prefix_hit_f32(params):
+    """THE graduation pin: kernel-tier tokens == reference-tier tokens
+    on a prefix-shared workload (real sampling config), and the
+    reference tier is bitwise vs the no-sharing oracle — so the kernel
+    tier is transitively oracle-equal."""
+    ref, ker, eng = _tier_pair(params, seed=3)
+    assert ker == ref
+    # the oracle chain: reference with sharing OFF serves the same
+    # tokens (PR 11's bitwise contract), closing kernel == oracle
+    rng = np.random.default_rng(3)
+    prompts = _prompts(rng, 8)
+    oracle, _ = _serve(params, prompts, "reference", share=False)
+    assert oracle == ref
+    # the kernel tier actually ran the work-unit planner
+    assert eng.unit_stats["prefill_units"] > 0
+    assert eng.unit_stats["decode_pages_real"] > 0
+
+
+@pytest.mark.quick
+def test_kernel_parity_prefix_hit_int8_kv(params):
+    ref, ker, _ = _tier_pair(params, seed=5, kv_dtype=jnp.int8)
+    assert ker == ref
+
+
+def test_kernel_parity_miss(params):
+    """Prefix cache disabled (every request a miss, one group per
+    request): the cascade degenerates but tokens must not move."""
+    ref, ker, _ = _tier_pair(params, seed=7, share=False)
+    assert ker == ref
+
+
+def test_kernel_parity_mixed_chunked_prefill_rungs(params):
+    """Long prompts against a tiny prefill budget: every step mixes
+    decode lanes with prefill chunks, chunks straddle the cascade
+    split boundary (negative-qpos0 level-1 rows + partial level-0 mask
+    windows), and the session walks multiple rungs."""
+    rng = np.random.default_rng(11)
+    prompts = _prompts(rng, 6, shared_len=33, suffix_hi=9)
+    kw = dict(num_pages=96, prefill_budget_tokens=12, max_new=5)
+    ref, eref = _serve(params, prompts, "reference", **kw)
+    ker, eker = _serve(params, prompts, "kernel", **kw)
+    assert ker == ref
+    assert len(eker._rung_traced) >= 2  # the mix actually spans rungs
+    assert eker.num_traces == eref.num_traces
+
+
+def test_kernel_parity_preemption_resume(params):
+    """Preemption-by-eviction with recompute-on-resume on the KERNEL
+    tier: the preempted small-pool run serves the never-preempted
+    big-pool tokens, and both match the reference tier."""
+    rng = np.random.default_rng(23)
+    pA = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+    pB = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+
+    def run(backend, npages):
+        eng = _mk_engine(params, backend, num_pages=npages, max_batch=2,
+                         max_seq_tokens=48)
+        eng.submit(EngineRequest("A", list(pA), max_new_tokens=8,
+                                 priority=5))
+        for _ in range(6):
+            eng.step()  # A is mid-decode when B arrives
+        eng.submit(EngineRequest("B", list(pB), max_new_tokens=4,
+                                 priority=0))
+        return eng.run(), eng
+
+    small_k, es = run("kernel", 7)   # 6 usable pages: B preempts A
+    big_k, eb = run("kernel", 32)
+    small_r, _ = run("reference", 7)
+    assert es._finished["A"].preemptions == 1
+    assert eb._finished["A"].preemptions == 0
+    assert small_k == big_k          # resume is reproducible in-tier
+    assert small_k == small_r        # and cross-tier
+
+
+# ---------------------------------------------------------------------------
+# Compile-once / retrace budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_kernel_retrace_budget_and_steady_state(params):
+    """The kernel tier keeps the rung-ladder contract: traces == rungs
+    exercised (<= 9), every plan-array shape a rung static, and a
+    second wave of fresh requests compiles NOTHING."""
+    rng = np.random.default_rng(17)
+    eng = _mk_engine(params, "kernel")
+    for i, p in enumerate(_prompts(rng, 6)):
+        eng.submit(EngineRequest(f"a{i}", list(p), max_new_tokens=3))
+    eng.run()
+    first_wave = eng.num_traces
+    assert first_wave == len(eng._rung_traced) <= 9
+    assert all(n == 1 for n in eng._rung_traced.values())
+    for i, p in enumerate(_prompts(rng, 6)):
+        eng.submit(EngineRequest(f"b{i}", list(p), max_new_tokens=3))
+    eng.run()
+    assert eng.num_traces == first_wave
+
+
+# ---------------------------------------------------------------------------
+# Planner geometry
+# ---------------------------------------------------------------------------
+
+
+def _geom(rung=16, ppr=8, max_batch=4, ps=8):
+    return EngineKernelGeom.build(
+        page_size=ps, pages_per_req=ppr, max_batch=max_batch,
+        max_rung=rung, num_kv_heads=CFG.num_kv_heads,
+        head_dim=CFG.head_dim, kv_itemsize=4)
+
+
+@pytest.mark.quick
+def test_planner_rung_stable_shapes():
+    """Two very different schedules at ONE rung must produce plan
+    bundles with IDENTICAL array shapes — the compile-once contract
+    the engine's jit relies on."""
+    g = _geom()
+
+    def shapes(segs):
+        plans = build_engine_work_units(segs, rung=16, geom=g)
+        return {
+            lvl: {k: np.asarray(v).shape
+                  for k, v in plans[lvl].items()
+                  if isinstance(v, np.ndarray)}
+            for lvl in ("prefill0", "prefill1", "decode")
+        }
+
+    # one decoding request past its prompt vs a mixed 3-request step
+    a = [SchedSeg(row0=0, n=1, pages=(1, 2, 3), split=16, kv_after=20,
+                  decoding=True, slot=0, group=0)]
+    b = [SchedSeg(row0=0, n=1, pages=(1, 2, 3), split=16, kv_after=19,
+                  decoding=True, slot=0, group=0),
+         SchedSeg(row0=1, n=1, pages=(1, 2, 4), split=16, kv_after=21,
+                  decoding=True, slot=1, group=0),
+         SchedSeg(row0=2, n=9, pages=(5, 6, 7), split=16, kv_after=9,
+                  decoding=False, slot=2, group=1)]
+    sa, sb = shapes(a), shapes(b)
+    assert sa == sb
+    # and the level-0 mask operand is ALWAYS present (a mask-less step
+    # would otherwise flip the jit pytree structure and retrace)
+    assert "mask_bytes" in sa["prefill0"]
+
+
+@pytest.mark.quick
+def test_planner_unit_cap_overflow_raises():
+    from flashinfer_tpu.ops.paged_prefill import build_prefill_work_units
+
+    with pytest.raises(ValueError, match="num_units_pad"):
+        build_prefill_work_units(
+            np.asarray([0, 64], np.int64), np.asarray([0, 8], np.int64),
+            np.arange(8, dtype=np.int64), np.asarray([64], np.int64),
+            16, 2, 8, causal=True, num_units_pad=1)
+
+
+def test_planner_covers_every_rung_row():
+    """Padding rows beyond the scheduled total ride kv_len=0 segments:
+    both prefill plans must span [0, rung) so no output row is ever
+    uninitialized HBM."""
+    g = _geom()
+    segs = [SchedSeg(row0=0, n=3, pages=(1, 2), split=8, kv_after=7,
+                     decoding=False, slot=0, group=0)]
+    plans = build_engine_work_units(segs, rung=16, geom=g)
+    for lvl in ("prefill0", "prefill1"):
+        p = plans[lvl]
+        real = p["stats"]["units"]
+        bq = p["block_q"]
+        covered = set()
+        for u in range(real):
+            if p["wout"][u]:  # the tile write-back covers the block
+                qs = int(p["qstart"][u])
+                covered |= set(range(qs, qs + bq))
+        assert covered >= set(range(16)), (lvl, sorted(covered))
+
+
+def test_schedule_gap_raises():
+    g = _geom()
+    segs = [SchedSeg(row0=1, n=1, pages=(1,), split=0, kv_after=3,
+                     decoding=True, slot=0, group=0)]
+    with pytest.raises(ValueError, match="contiguously"):
+        build_engine_work_units(segs, rung=16, geom=g)
+
+
+def test_fused_prefill_return_lse_matches_oracle():
+    """The new ``return_lse`` prefill output against the dense oracle
+    (the cascade composition consumes these states, so a wrong lse
+    silently skews every merged logit)."""
+    from flashinfer_tpu.ops.paged_prefill import (build_prefill_work_units,
+                                                  fused_paged_prefill)
+
+    rng = np.random.default_rng(0)
+    HQ, HKV, D, PS = 4, 2, 64, 8
+    qo_lens, kv_lens = [5, 1, 0, 7], [24, 16, 8, 7]
+    qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]).astype(np.int64)
+    pages_per = [-(-l // PS) for l in kv_lens]
+    pindptr = np.concatenate([[0], np.cumsum(pages_per)]).astype(np.int64)
+    npages = int(pindptr[-1])
+    pidx = rng.permutation(npages).astype(np.int64)
+    q = jax.random.normal(jax.random.PRNGKey(1),
+                          (int(qo_indptr[-1]), HQ, D), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(2), (npages, HKV, PS, D),
+                           jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(3), (npages, HKV, PS, D),
+                           jnp.float32)
+    plan_np = build_prefill_work_units(
+        qo_indptr, pindptr, pidx, np.asarray(kv_lens, np.int64),
+        16, 2, PS, causal=True)
+    statics = dict(num_units=plan_np.pop("num_units"),
+                   block_q=plan_np.pop("block_q"),
+                   pages_per_chunk=plan_np.pop("pages_per_chunk"))
+    plan_np.pop("stats")
+    plan = {k: jnp.asarray(v) for k, v in plan_np.items()}
+    out, lse = fused_paged_prefill(q, kc, vc, plan, sm_scale=D ** -0.5,
+                                   causal=True, return_lse=True,
+                                   **statics)
+    # dense oracle per request (bottom-right causal alignment)
+    for r in range(len(qo_lens)):
+        qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
+        if qe <= qs:
+            continue
+        pages = pidx[pindptr[r]:pindptr[r + 1]]
+        kr = np.asarray(kc)[pages].transpose(0, 2, 1, 3).reshape(
+            -1, HKV, D)[:kv_lens[r]]
+        vr = np.asarray(vc)[pages].transpose(0, 2, 1, 3).reshape(
+            -1, HKV, D)[:kv_lens[r]]
+        kg = np.repeat(kr, HQ // HKV, axis=1)
+        vg = np.repeat(vr, HQ // HKV, axis=1)
+        qr = np.asarray(q)[qs:qe]
+        qpos = kv_lens[r] - (qe - qs) + np.arange(qe - qs)
+        s = np.einsum("qhd,khd->qhk", qr, kg) * (D ** -0.5)
+        valid = np.arange(kv_lens[r])[None, :] <= qpos[:, None]
+        s = np.where(valid[:, None, :], s, -np.inf)
+        mx = s.max(-1, keepdims=True)
+        has = np.isfinite(mx)
+        p = np.where(valid[:, None, :],
+                     np.exp(s - np.where(has, mx, 0.0)), 0.0)
+        l = p.sum(-1, keepdims=True)
+        o_ref = np.einsum("qhk,khd->qhd", p / np.where(l > 0, l, 1.0), vg)
+        lse_ref = np.where(l[..., 0] > 0,
+                           mx[..., 0] + np.log(np.maximum(l[..., 0],
+                                                          1e-30)),
+                           -1e30)
+        np.testing.assert_allclose(np.asarray(out)[qs:qe], o_ref,
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse)[qs:qe], lse_ref,
+                                   rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Knob + config surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_attention_backend_knob_registered(params):
+    from flashinfer_tpu.autotuner import KNOWN_KNOBS, validate_tactic
+
+    spec = KNOWN_KNOBS["engine.attention_backend"]
+    assert spec.kind == "str"
+    assert set(spec.choices) == {"reference", "kernel"}
+    assert validate_tactic("engine.attention_backend", "kernel") is None
+    assert validate_tactic("engine.attention_backend", "cuda") is not None
+    # EngineConfig.from_knobs resolves it (default: the oracle tier)
+    cfg = EngineConfig.from_knobs(CFG, num_pages=64)
+    assert cfg.attention_backend in ("reference", "kernel")
+    with pytest.raises(ValueError, match="attention_backend"):
+        ServingEngine(CFG, params, EngineConfig(
+            num_pages=64, page_size=8, attention_backend="vulkan"))
+
+
+def test_kernel_tier_cost_is_launched_vs_effective(params):
+    """The kernel tier's aggregate cost prices launched work from the
+    REAL unit stats (padded grids included) with the exact attended
+    pairs as flops_effective — never equal unless padding was zero."""
+    rng = np.random.default_rng(29)
+    prompts = _prompts(rng, 6)
+    _, eng = _serve(params, prompts, "kernel")
+    cost = eng.aggregate_cost()
+    assert cost.flops_effective is not None
+    assert cost.flops > cost.flops_effective
+    us = eng.unit_stats
+    assert us["kv_pairs_launched"] >= us["prefill_cells_valid"]
+    # the reference tier keeps the launched == effective convention
+    _, ref_eng = _serve(params, prompts, "reference")
+    assert ref_eng.aggregate_cost().flops_effective is None
