@@ -74,6 +74,9 @@ PHASE_TIMEOUT_S = {
     # 1000+ requests through the engine TWICE (sharing + the no-sharing
     # bitwise oracle), thousands of host-scheduled step dispatches
     "serving_engine": 2400.0,
+    # unified vs disagg (two full engine runs) + the spill-capacity
+    # leg, all host-scheduled CPU-provable mechanics
+    "serving_disagg": 1800.0,
     "prefill": 1500.0,
     "prefill_sweep": 2400.0,
     "mla": 1200.0,
@@ -1861,6 +1864,220 @@ def phase_serving_engine(sweep: bool):
           f"{us['prefill_units_launched']} launched)", file=sys.stderr)
 
 
+def phase_serving_disagg(sweep: bool):
+    """Tiered-KV subsystem (``serve/kv_tier.py``): the disaggregated
+    prefill→decode handoff and the host-RAM spill tier, both proven
+    on CPU and priced by the cost model (the PR 8 before-hardware
+    pattern).  Three row modes (``mode`` is RowAuditor identity —
+    separate banked histories):
+
+    - ``handoff``: the same shared-prefix workload served UNIFIED vs
+      DISAGGREGATED (prefill pool + decode pool joined by
+      ``kv_migrate``); the phase RAISES on any token mismatch, then
+      stamps the disagg row with both pools' engine_step cost PLUS the
+      summed ``kv_migrate`` cost — migration count/bytes/wall ride as
+      measurement fields, ``ici_bytes`` lands on the stamp.
+    - ``kv_migrate``: the handoff traffic alone attributed over its
+      measured host-copy wall — ``bound == "ici"`` by construction
+      (the wire floor is the deepest on every registered chip), the
+      migration row the ISSUE asks ``roofline.stamp_row`` to surface.
+      On CPU the "measured" time is a host memcpy (interpret-mode
+      caveat: read the predicted-vs-measured join in ``obs perf``
+      serving_disagg for mechanics, on-chip wire time pending).
+    - ``spill``: a pool SMALLER than the working set under
+      ``spill_policy="spill"`` — effective KV capacity beyond the
+      device budget.  The phase raises unless the run completes with
+      ZERO recomputes and tokens bitwise-equal to the big-pool
+      never-preempted oracle (the restore-path contract)."""
+    import time as _time
+
+    os.environ["FLASHINFER_TPU_SPANS"] = "1"
+    os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+    from flashinfer_tpu.serve import (DisaggServing, EngineConfig,
+                                      EngineRequest, SamplingConfig,
+                                      ServingEngine)
+
+    if os.environ.get("BENCH_SMALL"):
+        n_requests, n_prefixes = 120, 8
+        prefix_len, suffix_hi, max_new = 24, 8, 4
+        mcfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+        ecfg_kw = dict(num_pages=129, page_size=8, max_batch=4,
+                       prefill_budget_tokens=32, max_seq_tokens=64)
+    else:
+        n_requests, n_prefixes = 400, 16
+        prefix_len, suffix_hi, max_new = 48, 16, 6
+        mcfg = LlamaConfig.tiny(num_layers=4, hidden_size=512,
+                                intermediate_size=1024,
+                                dtype=jnp.float32)
+        ecfg_kw = dict(num_pages=513, page_size=16, max_batch=8,
+                       prefill_budget_tokens=64, max_seq_tokens=128)
+    ecfg_kw["sampling"] = SamplingConfig(temperature=0.8, top_k=40)
+    params = init_llama_params(jax.random.PRNGKey(0), mcfg)
+
+    def workload():
+        rng = np.random.default_rng(17)
+        prefixes = [[int(t) for t in
+                     rng.integers(1, mcfg.vocab_size, prefix_len)]
+                    for _ in range(n_prefixes)]
+        ranks = np.minimum(rng.zipf(1.2, n_requests) - 1, n_prefixes - 1)
+        reqs = []
+        for i in range(n_requests):
+            suffix = [int(t) for t in rng.integers(
+                1, mcfg.vocab_size, int(rng.integers(1, suffix_hi + 1)))]
+            reqs.append((f"req{i}", prefixes[int(ranks[i])] + suffix))
+        return reqs
+
+    # ---- leg 1+2: unified vs disaggregated (the handoff A/B) ----------
+    eng = ServingEngine(mcfg, params, EngineConfig(**ecfg_kw))
+    for rid, prompt in workload():
+        eng.submit(EngineRequest(rid, list(prompt),
+                                 max_new_tokens=max_new))
+    t0 = _time.perf_counter()
+    uni = _guard("bench.serving_disagg.unified",
+                 (n_requests, mcfg.hidden_size),
+                 lambda: eng.run())
+    uni_wall = _time.perf_counter() - t0
+
+    disagg = DisaggServing(mcfg, params, EngineConfig(**ecfg_kw))
+    for rid, prompt in workload():
+        disagg.submit(EngineRequest(rid, list(prompt),
+                                    max_new_tokens=max_new))
+    t0 = _time.perf_counter()
+    dis = _guard("bench.serving_disagg.disagg",
+                 (n_requests, mcfg.hidden_size),
+                 lambda: disagg.run())
+    dis_wall = _time.perf_counter() - t0
+    if dis != uni:
+        bad = [rid for rid in uni if dis.get(rid) != uni[rid]]
+        raise AssertionError(
+            f"disagg-vs-unified token mismatch on {len(bad)} of "
+            f"{n_requests} requests (first: {bad[:3]}) — the "
+            "prefill→decode handoff diverged from the unified engine")
+    for e, tag in ((disagg.prefill, "prefill"), (disagg.decode,
+                                                 "decode")):
+        if e.num_traces > 9:
+            raise AssertionError(
+                f"disagg {tag}-pool retrace budget breached: "
+                f"{e.num_traces} traces (budget: 9)")
+    ms = disagg.migration_stats
+    gen_tokens = sum(len(v) for v in dis.values())
+    row = dict(
+        phase="serving_disagg", mode="handoff",
+        model="llama_tiny_engine", requests=n_requests,
+        zipf_prefixes=n_prefixes, bs=ecfg_kw["max_batch"],
+        page_size=ecfg_kw["page_size"], layers=mcfg.num_layers,
+        hidden=mcfg.hidden_size, gen_tokens=gen_tokens,
+        tok_s=round(gen_tokens / max(dis_wall, 1e-9), 1),
+        migrations=int(ms["migrations"]),
+        migrate_bytes=float(ms["bytes"]),
+        migrate_us=round(ms["seconds"] * 1e6, 1),
+        disagg_tokens_equal=True,
+        unified_wall_s=round(uni_wall, 2),
+    )
+    _emit_row(**_stamp(row, disagg.aggregate_cost(), dis_wall))
+    print(f"# serving_disagg handoff: {n_requests} reqs, tokens "
+          f"BITWISE == unified ({uni_wall:.1f}s unified / "
+          f"{dis_wall:.1f}s disagg), {row['migrations']} migrations "
+          f"{row['migrate_bytes'] / 1e6:.1f} MB", file=sys.stderr)
+
+    # the migration traffic alone: the ici-bound handoff row
+    if disagg._migration_cost is not None and ms["seconds"] > 0:
+        mrow = dict(
+            phase="serving_disagg", mode="kv_migrate",
+            model="llama_tiny_engine", requests=n_requests,
+            page_size=ecfg_kw["page_size"], layers=mcfg.num_layers,
+            hidden=mcfg.hidden_size,
+            migrations=int(ms["migrations"]),
+            migrate_bytes=float(ms["bytes"]),
+            migrate_us=round(ms["seconds"] * 1e6, 1),
+        )
+        _emit_row(**_stamp(mrow, disagg._migration_cost,
+                           ms["seconds"]))
+        print(f"# serving_disagg kv_migrate: "
+              f"{mrow['migrate_bytes'] / 1e6:.1f} MB in "
+              f"{ms['seconds'] * 1e3:.1f} ms host-copy "
+              f"(bound={mrow['bound']}, interpret-mode wall — wire "
+              f"proof pending on chip)", file=sys.stderr)
+
+    # ---- leg 3: host-RAM spill raises effective capacity --------------
+    def serve_spill(npages, **tier):
+        eng = ServingEngine(mcfg, params, EngineConfig(
+            **{**ecfg_kw, "num_pages": npages, "max_batch": 2}, **tier))
+        rng = np.random.default_rng(29)
+        prompts = [[int(t) for t in rng.integers(
+            1, mcfg.vocab_size, prefix_len)] for _ in range(8)]
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"s{i}", list(p),
+                                     max_new_tokens=max_new,
+                                     priority=5))
+        for _ in range(4):
+            eng.step()
+        for i, p in enumerate(prompts[:4]):
+            eng.submit(EngineRequest(f"hi{i}", list(p[::-1]),
+                                     max_new_tokens=max_new,
+                                     priority=0))
+        t0 = _time.perf_counter()
+        res = eng.run()
+        return res, _time.perf_counter() - t0, eng
+
+    # small pool: fewer pages than the 12-request working set needs
+    small_pages = 4 * (-(-(prefix_len + max_new)
+                         // ecfg_kw["page_size"])) + 1
+    oracle_res, _, _ = serve_spill(ecfg_kw["num_pages"])
+    spill_res, spill_wall, seng = _guard(
+        "bench.serving_disagg.spill", (small_pages, mcfg.hidden_size),
+        lambda: serve_spill(small_pages, kv_offload="host",
+                            spill_policy="spill", host_gib=1))
+    st = seng.kv_tier_stats
+    if spill_res != oracle_res:
+        bad = [rid for rid in oracle_res
+               if spill_res.get(rid) != oracle_res[rid]]
+        raise AssertionError(
+            f"spill-restore token mismatch on {len(bad)} requests "
+            f"(first: {bad[:3]}) — the restore path is not bit-exact")
+    if st["spills"] == 0:
+        raise AssertionError(
+            "capacity-pressure run never spilled — the pool was not "
+            "smaller than the working set, the capacity claim is "
+            "unproven")
+    if st["recomputes"] != 0:
+        raise AssertionError(
+            f"{st['recomputes']} resumes RECOMPUTED under "
+            "spill_policy=spill — the host tier dropped entries")
+    from flashinfer_tpu.obs import costmodel
+
+    io_pages = int(st["spill_bytes"]
+                   / max(costmodel.kv_page_bytes(
+                       1, page_size=ecfg_kw["page_size"],
+                       num_kv_heads=mcfg.num_kv_heads,
+                       head_dim=mcfg.head_dim,
+                       layers=mcfg.num_layers, kv_bytes=4), 1))
+    srow = dict(
+        phase="serving_disagg", mode="spill",
+        model="llama_tiny_engine", pool_pages=small_pages,
+        page_size=ecfg_kw["page_size"], layers=mcfg.num_layers,
+        hidden=mcfg.hidden_size,
+        spills=int(st["spills"]), restores=int(st["restores"]),
+        spill_bytes=float(st["spill_bytes"]),
+        restore_bytes=float(st["restore_bytes"]),
+        recomputes=int(st["recomputes"]),
+        host_evictions=int(seng.host_store.evictions),
+        spill_tokens_equal=True,
+        tok_s=round(sum(len(v) for v in spill_res.values())
+                    / max(spill_wall, 1e-9), 1),
+    )
+    _emit_row(**_stamp(srow, seng.aggregate_cost(), spill_wall))
+    print(f"# serving_disagg spill: pool {small_pages} pages < working "
+          f"set, {srow['spills']} spills/{srow['restores']} restores "
+          f"({io_pages} page-spills), ZERO recomputes, tokens BITWISE "
+          f"== big-pool oracle", file=sys.stderr)
+
+
 def phase_selftest(sweep: bool):
     """Orchestration self-test: emits rows then hangs (no TPU touched) —
     lets CI assert that a hung phase still yields its landed rows."""
@@ -1881,6 +2098,7 @@ PHASES = {
     "serving_fused": phase_serving_fused,
     "serving_sharded": phase_serving_sharded,
     "serving_engine": phase_serving_engine,
+    "serving_disagg": phase_serving_disagg,
     "prefill": phase_prefill,
     "mla": phase_mla,
     "selftest": phase_selftest,
@@ -1910,9 +2128,13 @@ PHASES = {
 #   reuse proof (CPU-provable mechanics), so a failure there must not
 #   cost any kernel-throughput row; its rows carry the engine config
 #   as identity and lifecycle/hit-rate fields as measurements
+#   serving_disagg rides after serving_engine (the tail of the tail):
+#   the tiered-KV proof is also CPU-provable mechanics (handoff
+#   bitwise parity, spill capacity, migration pricing) and its rows
+#   carry mode identity so they can never shadow engine history
 DEFAULT_PHASES = ["decode", "serving", "sampling", "moe", "topk", "scans",
                   "prefill", "mla", "decode_splits", "serving_fused",
-                  "serving_sharded", "serving_engine"]
+                  "serving_sharded", "serving_engine", "serving_disagg"]
 
 
 # --------------------------------------------------------------------------

@@ -157,6 +157,34 @@ register_knob("engine.max_batch",
               description="serving-engine batch slots (concurrent "
                           "running requests); also the decode floor "
                           "of the compile-once rung ladder")
+# tiered-KV statics (serve/kv_tier.py; same (hidden, hq, hkv, hd)
+# shape key as the other engine.* knobs): whether the host-RAM tier
+# below the block pool is attached, how preemption resumes, and how
+# much host RAM the tier may hold — each chip generation trades its
+# HBM GiB budget against host capacity + restore bandwidth here
+register_knob("engine.kv_offload", kind="str",
+              choices=("off", "host"),
+              description="serving-engine KV offload tier: 'off' = "
+                          "device-only (PR 11 behavior), 'host' = "
+                          "attach a HostKVStore so preempted/idle "
+                          "requests spill their page runs to host RAM "
+                          "and restore bit-exactly on resume — "
+                          "effective KV capacity exceeds hwspec "
+                          "hbm_gib")
+register_knob("engine.spill_policy", kind="str",
+              choices=("recompute", "spill", "auto"),
+              description="preemption resume policy: 'recompute' = "
+                          "fold + re-prefill (PR 11), 'spill' = "
+                          "always offload to the host tier, 'auto' = "
+                          "per-victim cost-model comparison (restore "
+                          "bytes over the HBM roofline vs recompute "
+                          "FLOPs via predict_step_seconds — the "
+                          "choose_decode_splits pattern)")
+register_knob("engine.host_gib",
+              description="host-RAM KV store capacity in GiB "
+                          "(HostKVStore; LRU-evicts spilled entries "
+                          "over this budget, downgrading their resume "
+                          "to recompute — counted, never silent)")
 register_knob("engine.attention_backend", kind="str",
               choices=("reference", "kernel"),
               description="serving-engine attention tier: 'reference' "

@@ -34,7 +34,7 @@ Commands:
   clock base) to ``--out``.  ``--selftest`` exits non-zero unless the
   export is schema-valid, the loop held the compile-once retrace
   budget (<= 1 trace), and the perturbed static was named in the
-  retrace-cause table — the CI gate (lint.yml), the perf/2 smoke-gate
+  retrace-cause table — the CI gate (lint.yml), the perf/3 smoke-gate
   precedent.
 """
 
@@ -248,6 +248,56 @@ def _engine_workload(num_requests: int,
     }
 
 
+def _engine_spill_workload(spill: bool) -> dict:
+    """The ``obs trace --engine --spill`` workload: low-priority
+    requests mid-decode are preempted by later high-priority arrivals
+    under a pool sized to force it.  ``spill=True`` runs the tiered
+    engine (kv_offload=host, spill_policy=spill — every resume must
+    RESTORE); ``spill=False`` runs the never-preempted oracle (big
+    pool, no tier) over the SAME seeded requests, so the selftest can
+    pin token equality.  Returns the tier facts the gates read."""
+    from flashinfer_tpu.env import apply_platform_from_env
+
+    apply_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flashinfer_tpu.models import LlamaConfig, init_llama_params
+    from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
+                                      SamplingConfig, ServingEngine)
+
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg)
+    tier = (dict(num_pages=9, kv_offload="host", spill_policy="spill",
+                 host_gib=1) if spill else dict(num_pages=64))
+    eng = ServingEngine(cfg, params, EngineConfig(
+        page_size=8, max_batch=2, prefill_budget_tokens=16,
+        max_seq_tokens=48,
+        sampling=SamplingConfig(temperature=0.8, top_k=20), **tier))
+    rng = np.random.default_rng(3)
+    lo = [[int(t) for t in rng.integers(1, cfg.vocab_size, 20)]
+          for _ in range(2)]
+    hi = [[int(t) for t in rng.integers(1, cfg.vocab_size, 20)]
+          for _ in range(2)]
+    for i, p in enumerate(lo):
+        eng.submit(EngineRequest(f"lo{i}", p, max_new_tokens=6,
+                                 priority=5))
+    for _ in range(5):
+        eng.step()  # low-priority lanes are mid-decode
+    for i, p in enumerate(hi):
+        eng.submit(EngineRequest(f"hi{i}", p, max_new_tokens=4,
+                                 priority=0))
+    results = eng.run()
+    return {
+        "num_traces": eng.num_traces,
+        "rungs": len(eng._rung_traced),
+        "results": results,
+        **{k: eng.kv_tier_stats[k]
+           for k in ("spills", "restores", "recomputes")},
+    }
+
+
 def cmd_trace(args) -> int:
     os.environ["FLASHINFER_TPU_SPANS"] = "1"
     os.environ["FLASHINFER_TPU_METRICS"] = "1"
@@ -256,7 +306,11 @@ def cmd_trace(args) -> int:
 
     profiler.start_timeline()
     kfacts = None
-    if args.engine:
+    if args.engine and args.spill:
+        facts = _engine_spill_workload(spill=True)
+        kfacts = None
+        oracle = _engine_spill_workload(spill=False)
+    elif args.engine:
         facts = _engine_workload(args.requests)
         # the kernel attention tier over the SAME seeded workload: the
         # selftest gates BOTH backends on the retrace budget and pins
@@ -270,7 +324,43 @@ def cmd_trace(args) -> int:
                                        spans.drain())
     problems = export.validate_chrome_trace(trace,
                                             require_lifecycle=True)
-    if args.engine:
+    if args.engine and args.spill:
+        # the TIERED-KV gates (docs/serving.md §"Tiered KV &
+        # disaggregation"): forced preemption under spill_policy=spill
+        # must actually SPILL (a zero count means the tier silently
+        # fell back), every resume must RESTORE (zero recomputes),
+        # restored tokens must equal the never-preempted oracle's
+        # bitwise, and the rung ladder must hold
+        if facts["spills"] <= 0:
+            problems.append(
+                "silent spill: preemption was forced under "
+                "spill_policy=spill but zero page runs reached the "
+                "host tier")
+        if facts["restores"] <= 0:
+            problems.append(
+                "spilled requests resumed without a restore — the "
+                "staged-entry admission path is dead")
+        if facts["recomputes"] > 0:
+            problems.append(
+                f"{facts['recomputes']} resume(s) RECOMPUTED under "
+                "spill_policy=spill — the host tier lost entries it "
+                "had capacity for")
+        if facts["results"] != oracle["results"]:
+            bad = [rid for rid in oracle["results"]
+                   if facts["results"].get(rid) != oracle["results"][rid]]
+            problems.append(
+                f"spill-restore token mismatch on {len(bad)} "
+                f"request(s) (first: {bad[:3]}) vs the never-preempted "
+                "oracle — the restore path is not bit-exact")
+        if facts["num_traces"] > 9:
+            problems.append(
+                f"spill-mode retrace budget: {facts['num_traces']} "
+                "traces (budget: 9)")
+        if facts["num_traces"] > facts["rungs"]:
+            problems.append(
+                f"spill mode retraced: {facts['num_traces']} traces "
+                f"for {facts['rungs']} rungs (compile-once broke)")
+    elif args.engine:
         # the ENGINE retrace budget: the whole Zipf run must stay on
         # the pre-compiled rung ladder (<= 9 traces, the same budget
         # the fused-step loop pins), and the prefix cache must be LIVE
@@ -515,8 +605,28 @@ def cmd_doctor(args) -> int:
             "pool_pages_in_use": gauge("engine.pool_pages_in_use"),
             "pool_pages_free": gauge("engine.pool_pages_free"),
         }
+        # tiered KV (serve/kv_tier.py): per-tier occupancy + the
+        # spill/restore/migration traffic and resume-miss attribution
+        # — zeros in a fresh process, live numbers in the serving one
+        restores = cell("engine.kv_tier.restores")
+        recomputes = cell("engine.kv_tier.recomputes")
+        report["kv_tier"] = {
+            "spills": cell("engine.kv_tier.spills"),
+            "spill_bytes": cell("engine.kv_tier.spill_bytes"),
+            "restores": restores,
+            "restore_bytes": cell("engine.kv_tier.restore_bytes"),
+            "migrations": cell("engine.kv_tier.migrations"),
+            "migrate_bytes": cell("engine.kv_tier.migrate_bytes"),
+            "recomputes": recomputes,
+            "restore_rate": (restores / (restores + recomputes)
+                             if restores + recomputes else None),
+            "host_evictions": cell("engine.kv_tier.host_evictions"),
+            "host_pages": gauge("engine.kv_tier.host_pages"),
+            "host_bytes": gauge("engine.kv_tier.host_bytes"),
+        }
     except Exception as e:  # doctor must never crash on a broken tree
         report["engine"] = f"<unavailable: {type(e).__name__}>"
+        report["kv_tier"] = f"<unavailable: {type(e).__name__}>"
 
     # cost-model coverage (mirrors analysis L005's obs-coverage idea):
     # a decorated public op with no obs.costmodel family can bench but
@@ -582,8 +692,9 @@ def main(argv=None) -> int:
                          "(default: the repo's BENCH_BANKED.md)")
     sp.add_argument("--json", action="store_true",
                     help="machine-readable report (schema "
-                         "flashinfer_tpu.obs.perf/2: + serving_ici / "
-                         "scaling_prediction ICI fields)")
+                         "flashinfer_tpu.obs.perf/3: + serving_ici / "
+                         "scaling_prediction ICI fields + the "
+                         "serving_disagg kv_migrate join)")
     sp.add_argument("--chip", default=None,
                     help="default chip for rows that name none "
                          "(default: v5e, the banked history's chip)")
@@ -611,6 +722,14 @@ def main(argv=None) -> int:
     sp.add_argument("--requests", type=int, default=24,
                     help="engine-mode request count (Zipf-skewed "
                          "shared prefixes)")
+    sp.add_argument("--spill", action="store_true",
+                    help="with --engine: run the TIERED-KV workload "
+                         "instead — forced preemption under "
+                         "spill_policy=spill; --selftest then fails "
+                         "on token divergence vs the never-preempted "
+                         "oracle, a silent spill (zero spills/"
+                         "restores), any recompute fallback, or a "
+                         "retrace breach")
     sp.add_argument("--selftest", action="store_true",
                     help="exit non-zero unless the export is "
                          "schema-valid, the retrace budget held, and "

@@ -89,6 +89,17 @@ MEASUREMENT_FIELDS = frozenset({
     # rate-reported on bf16 where the kernel tier's bf16 MXU dots
     # legitimately round differently from the f32-upcast reference)
     "backend_tokens_equal", "backend_token_match",
+    # tiered-KV rows (serving_disagg phase): migration/spill/restore
+    # traffic, host-copy wall time, and the resume-miss count — all
+    # measurements of the same workload replay.  ``mode``
+    # (handoff | kv_migrate | spill) is deliberately NOT here: the
+    # three tier exercises are different configurations with separate
+    # banked histories (the step_mode/mesh_axes precedent), and so is
+    # the engine/pool geometry that shapes them
+    "migrations", "migrate_bytes", "migrate_us", "unified_wall_s",
+    "spills", "restores", "spill_bytes", "restore_bytes",
+    "recomputes", "host_evictions", "disagg_tokens_equal",
+    "spill_tokens_equal",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

@@ -149,6 +149,54 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
     "engine.pool_pages_free": (
         "gauge", (),
         "block-pool free-list depth after the latest engine step"),
+    # -- tiered KV: host offload + disaggregated handoff (serve/kv_tier.py)
+    "engine.kv_tier.spills": (
+        "counter", (),
+        "requests whose KV page runs were offloaded to the host-RAM "
+        "tier (preemption under spill_policy spill/auto, or an "
+        "explicit offload_idle) — the restore path resumes them "
+        "bit-exactly"),
+    "engine.kv_tier.spill_bytes": (
+        "counter", (),
+        "KV bytes moved device -> host across all spills (pages at "
+        "the cache's storage dtype — int8/fp8 caches spill at 1 "
+        "byte/element, the compressed host format)"),
+    "engine.kv_tier.restores": (
+        "counter", (),
+        "staged KV entries restored into fresh device pages at "
+        "admission (host-tier spills AND in-flight kv_migrate "
+        "handoffs — both ride the same restore path)"),
+    "engine.kv_tier.restore_bytes": (
+        "counter", (),
+        "KV bytes moved host -> device across all restores"),
+    "engine.kv_tier.migrations": (
+        "counter", (),
+        "prefill-pool -> decode-pool KV handoffs (kv_migrate; the "
+        "disaggregated serving collective, ICI-priced by "
+        "costmodel.kv_migrate)"),
+    "engine.kv_tier.migrate_bytes": (
+        "counter", (),
+        "KV payload bytes handed prefill -> decode across all "
+        "migrations (== the predicted ICI wire bytes at hops=1)"),
+    "engine.kv_tier.recomputes": (
+        "counter", (),
+        "preempted/offloaded requests resumed by RECOMPUTE instead of "
+        "restore (spill disabled, policy chose recompute, or the host "
+        "store LRU-evicted the entry) — the tier's miss attribution; "
+        "a spill-policy bench asserts this stays ZERO when the host "
+        "tier absorbed every resume"),
+    "engine.kv_tier.host_evictions": (
+        "counter", (),
+        "host-store entries LRU-evicted under capacity pressure (each "
+        "one downgrades that request's resume to recompute — never "
+        "silent)"),
+    "engine.kv_tier.host_pages": (
+        "gauge", (),
+        "KV pages currently resident in the host-RAM tier"),
+    "engine.kv_tier.host_bytes": (
+        "gauge", (),
+        "bytes currently resident in the host-RAM tier (capacity is "
+        "EngineConfig.host_gib — the engine.host_gib knob)"),
     # -- trace.py solution substitution -----------------------------------
     "trace.solution_hits": (
         "counter", ("op",),
@@ -254,6 +302,9 @@ API_OPS = frozenset({
     "serve.step", "serve.mixed_step",
     # serve/engine.py (the continuous-batching engine step)
     "engine.step",
+    # serve/kv_tier.py (the tiered-KV movements: host spill/restore +
+    # the disaggregated prefill->decode handoff)
+    "engine.kv_spill", "engine.kv_restore", "engine.kv_migrate",
     # parallel/plan.py (the mesh-sharded fused serving step)
     "parallel.sharded_step",
 })
@@ -267,4 +318,5 @@ API_OPS = frozenset({
 SERVING_OPS = frozenset({
     "serve.step", "serve.mixed_step", "parallel.sharded_step",
     "engine.step",
+    "engine.kv_spill", "engine.kv_restore", "engine.kv_migrate",
 })

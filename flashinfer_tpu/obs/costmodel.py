@@ -695,6 +695,71 @@ def engine_step(num_tokens: int, batch: int, layers: int, *, hidden: int,
     return dataclasses.replace(total, dtype=dtype, op="engine_step")
 
 
+# -- tiered-KV family (serve/kv_tier.py: host offload + disagg handoff) ---
+
+
+def kv_page_bytes(pages: int, *, page_size: int, num_kv_heads: int,
+                  head_dim: int, layers: int, kv_bytes: int = 2) -> float:
+    """Payload bytes of one request's KV page run across all layers —
+    the counted term of every tier movement (spill, restore, migrate):
+    K and V planes, ``pages * page_size`` rows of ``num_kv_heads *
+    head_dim`` lanes at the cache's storage width (quantized caches
+    move at 1 byte/element — the compressed wire/host format)."""
+    return (2.0 * layers * pages * page_size * num_kv_heads * head_dim
+            * kv_bytes)
+
+
+def kv_page_io(pages: int, *, page_size: int, num_kv_heads: int,
+               head_dim: int, layers: int, kv_bytes: int = 2,
+               direction: str = "spill", dtype: str = "bf16") -> Cost:
+    """One host-tier page movement (``HostKVStore``): ``spill`` reads
+    the page run out of HBM (the host-RAM write is not HBM traffic),
+    ``restore`` writes it back.  Zero FLOPs — the tier moves bytes, it
+    computes nothing — so attribution is pure bandwidth.  The cost
+    family of the ``engine.kv_spill`` / ``engine.kv_restore`` ops."""
+    if direction not in ("spill", "restore"):
+        raise ValueError(f"direction must be spill|restore, "
+                         f"got {direction!r}")
+    payload = kv_page_bytes(pages, page_size=page_size,
+                            num_kv_heads=num_kv_heads, head_dim=head_dim,
+                            layers=layers, kv_bytes=kv_bytes)
+    return Cost(
+        flops=0.0,
+        bytes_read=payload if direction == "spill" else 0.0,
+        bytes_written=payload if direction == "restore" else 0.0,
+        dtype=dtype, op="kv_page_io",
+    )
+
+
+def kv_migrate(tokens: Optional[int] = None, *, pages: Optional[int] = None,
+               page_size: int = 16, num_kv_heads: int, head_dim: int,
+               layers: int, kv_bytes: int = 2, hops: int = 1,
+               dtype: str = "bf16") -> Cost:
+    """One prefill-pool -> decode-pool KV handoff (the disaggregated
+    serving collective, ``engine.kv_migrate``): the finished prefill's
+    page run crosses the ICI once per hop — point-to-point, so wire
+    bytes equal the payload (no ring (p-1)/p discount; a multi-hop
+    route multiplies).  The HBM legs are real on both ends: the source
+    chip reads the run out, the destination writes it in.  Per-request
+    page-run x kv-byte-width wire formula — what
+    ``roofline.predict_serving_ici`` prices per chip generation and the
+    ``serving_disagg`` bench phase stamps on migration rows
+    (``bound == "ici"`` wherever the interconnect is the deepest
+    floor, which it is on every registered chip)."""
+    if pages is None:
+        if tokens is None:
+            raise ValueError("kv_migrate needs tokens or pages")
+        pages = _cdiv(max(int(tokens), 1), page_size)
+    payload = kv_page_bytes(pages, page_size=page_size,
+                            num_kv_heads=num_kv_heads, head_dim=head_dim,
+                            layers=layers, kv_bytes=kv_bytes)
+    return Cost(
+        flops=0.0, bytes_read=payload, bytes_written=payload,
+        ici_bytes=payload * max(int(hops), 1),
+        dtype=dtype, op="kv_migrate",
+    )
+
+
 # -- ICI collective family (the sharded serving step's third dimension) ----
 
 # wire bytes each chip moves per payload byte for the canonical ring
@@ -878,6 +943,12 @@ API_OP_COSTS: Dict[str, str] = {
     # prefill on one flat axis, exact attended-pair accounting and a
     # deduped shared-prefix KV-row term (the cascade level-0 gather)
     "engine.step": "engine_step",
+    # the tiered-KV subsystem (serve/kv_tier.py): host-RAM page
+    # movements are pure-bandwidth page-run formulas; the disagg
+    # handoff adds the point-to-point ICI wire leg
+    "engine.kv_spill": "kv_page_io",
+    "engine.kv_restore": "kv_page_io",
+    "engine.kv_migrate": "kv_migrate",
 }
 
 

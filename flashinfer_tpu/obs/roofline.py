@@ -293,13 +293,42 @@ def predict_serving_scaling(*, bs: int = 64, ctx: int = 4096,
     return out
 
 
+def predict_kv_migrate(*, ctx: int = 4096, layers: int = 80,
+                       model: str = "llama70b_int8",
+                       chips: Sequence[str] = SCALING_CHIPS) -> dict:
+    """Predicted prefill->decode KV handoff cost per REQUEST at one
+    context length: the ``costmodel.kv_migrate`` page-run x
+    kv-byte-width wire formula priced per chip generation — the
+    before-hardware half of the disaggregated serving story
+    (serve/kv_tier.py), joined against measured migration stamps by
+    the perf/3 ``serving_disagg`` section."""
+    shape = costmodel.SHARDED_SERVING_SHAPES[model]
+    cost = costmodel.kv_migrate(
+        ctx, page_size=shape["page_size"], num_kv_heads=shape["hkv"],
+        head_dim=shape["hd"], layers=layers,
+        kv_bytes=shape["kv_bytes"])
+    return {
+        "model": model, "ctx": ctx, "layers": layers,
+        "ici_bytes_per_request": cost.ici_bytes,
+        "pred_ici_us": {
+            hwspec.spec(c).name: round(
+                cost.ici_bytes / (hwspec.spec(c).ici_gbps * 1e9) * 1e6,
+                2)
+            for c in chips},
+    }
+
+
 def predict_serving_ici(*, bs: int = 64, ctx: int = 4096,
                         layers: int = 80, tp: int = 8, dp: int = 1,
                         model: str = "llama70b_int8",
                         chips: Sequence[str] = SCALING_CHIPS) -> dict:
     """Per-serving-phase predicted collective traffic and wire time at
     one mesh shape: which phase's collectives cost what, per chip gen —
-    the attribution half of the ICI dimension (`obs perf`)."""
+    the attribution half of the ICI dimension (`obs perf`).  The
+    ``kv_migrate`` key rides alongside the per-step phases: the
+    PER-REQUEST prefill->decode handoff wire cost of the disaggregated
+    tier at the same cell (it is not a per-step collective, so it
+    never joins the phase sum)."""
     shape = costmodel.SHARDED_SERVING_SHAPES[model]
     phases = costmodel.serving_phase_costs_sharded(
         bs, ctx, layers, dp=dp, tp=tp, **shape)
@@ -317,7 +346,9 @@ def predict_serving_ici(*, bs: int = 64, ctx: int = 4096,
                 for c in chips},
         }
     return {"model": model, "bs": bs, "ctx": ctx, "layers": layers,
-            "mesh_axes": f"dp{dp}.tp{tp}", "phases": table}
+            "mesh_axes": f"dp{dp}.tp{tp}", "phases": table,
+            "kv_migrate": predict_kv_migrate(
+                ctx=ctx, layers=layers, model=model, chips=chips)}
 
 
 def _attributed_rows(rows: Sequence[Mapping],
@@ -400,6 +431,39 @@ def _headline(attributed: List[dict]) -> dict:
         h["mla_pct_roofline"] = {"min": round(mla[0], 4),
                                  "max": round(mla[-1], 4)}
     return h
+
+
+def _serving_disagg(attributed: Sequence[Mapping]) -> dict:
+    """The perf/3 disaggregation section: the predicted per-request
+    ``kv_migrate`` wire cost at the canonical cell, joined against
+    every banked ``serving_disagg`` row's MEASURED migration stamps
+    (``migrate_bytes`` / ``migrate_us`` are measurement fields the
+    bench phase emits).  ``measured_vs_pred_wire`` > 1 means the real
+    handoff ran slower than the ICI floor — the gap is scheduling +
+    staging overhead, exactly what the disagg session must shrink."""
+    pred = predict_kv_migrate(
+        ctx=SCALING_CELL["ctx"], layers=SCALING_CELL["layers"],
+        model=SCALING_CELL["model"])
+    measured: List[dict] = []
+    for a in attributed:
+        row = a["row"]
+        if row.get("phase") != "serving_disagg":
+            continue
+        m = {k: row[k] for k in (
+            "mode", "migrations", "migrate_bytes", "migrate_us",
+            "spills", "restores", "recomputes", "ici_bytes",
+            "pct_ici_roofline", "bound", "chip")
+            if row.get(k) is not None}
+        mb = row.get("migrate_bytes")
+        if isinstance(mb, (int, float)) and mb > 0:
+            spec = spec_for_row(row)
+            wire_us = mb / (spec.ici_gbps * 1e9) * 1e6
+            m["pred_wire_us"] = round(wire_us, 2)
+            mu = row.get("migrate_us")
+            if isinstance(mu, (int, float)) and mu > 0 and wire_us > 0:
+                m["measured_vs_pred_wire"] = round(mu / wire_us, 3)
+        measured.append(m)
+    return {"predicted_kv_migrate": pred, "rows": measured}
 
 
 def build_perf_report(rows: Sequence[Mapping], *,
@@ -498,7 +562,7 @@ def build_perf_report(rows: Sequence[Mapping], *,
         })
 
     return {
-        "schema": "flashinfer_tpu.obs.perf/2",
+        "schema": "flashinfer_tpu.obs.perf/3",
         "chips": {name: dataclasses.asdict(s)
                   for name, s in sorted(hwspec.CHIP_SPECS.items())
                   if any(a["res"].chip == name for a in attributed)},
@@ -515,6 +579,10 @@ def build_perf_report(rows: Sequence[Mapping], *,
         # curve per chip generation
         "serving_ici": predict_serving_ici(**SCALING_CELL),
         "scaling_prediction": predict_serving_scaling(**SCALING_CELL),
+        # the tiered-KV dimension (perf/3): predicted per-request
+        # kv_migrate wire cost + the measured migration stamps of
+        # banked serving_disagg rows, joined
+        "serving_disagg": _serving_disagg(attributed),
         "headline": _headline(attributed),
     }
 
@@ -581,6 +649,24 @@ def render_perf_report(report: Mapping) -> str:
                                  for c, us in p["pred_ici_us"].items())
             lines.append(f"  {name:12s} {p['ici_bytes'] / 1e6:10.2f} MB "
                          f"ICI/step  {per_chip}")
+    sd = report.get("serving_disagg")
+    if sd:
+        p = sd["predicted_kv_migrate"]
+        per_chip = "  ".join(f"{c} {us:.1f} us"
+                             for c, us in p["pred_ici_us"].items())
+        lines.append("")
+        lines.append(
+            f"predicted kv_migrate handoff ({p['model']} ctx={p['ctx']} "
+            f"L={p['layers']}): "
+            f"{p['ici_bytes_per_request'] / 1e6:.2f} MB/request  "
+            f"{per_chip}")
+        for m in sd.get("rows", []):
+            ratio = m.get("measured_vs_pred_wire")
+            lines.append(
+                f"  measured {m.get('mode', '?'):10s} "
+                f"{m.get('migrations', 0):5d} migrations, "
+                f"{float(m.get('migrate_bytes', 0)) / 1e6:10.2f} MB"
+                + (f"  {ratio:.2f}x pred wire" if ratio else ""))
     sc = report.get("scaling_prediction")
     if sc:
         lines.append("")

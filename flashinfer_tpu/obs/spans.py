@@ -67,6 +67,10 @@ SPAN_CATEGORIES: Dict[str, str] = {
     "serve.mixed_step": "dispatch",
     "parallel.sharded_step": "dispatch",
     "engine.step": "dispatch",
+    # tiered-KV movements (serve/kv_tier.py): host-side page copies
+    "engine.kv_spill": "host",
+    "engine.kv_restore": "host",
+    "engine.kv_migrate": "host",
 }
 
 # small plan arrays get a content fingerprint in plan signatures (value

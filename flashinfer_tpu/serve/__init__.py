@@ -27,6 +27,13 @@ the step never retraces across tokens.
   scheduling on a pre-compiled rung ladder (:class:`ServingEngine`,
   :class:`EngineConfig`, :class:`EngineRequest`, :class:`BlockPool`,
   :class:`PrefixCache`);
+- :mod:`~flashinfer_tpu.serve.kv_tier` — the TIERED KV subsystem:
+  :class:`HostKVStore` (host-RAM offload below the block pool —
+  spill/restore with bit-exact resume, so effective KV capacity
+  exceeds the chip's HBM GiB) and :class:`DisaggServing`
+  (prefill-pool → decode-pool disaggregation joined by the
+  ICI-priced ``kv_migrate`` handoff; docs/serving.md §"Tiered KV &
+  disaggregation");
 - :mod:`~flashinfer_tpu.serve.engine_kernels` — the engine's KERNEL
   attention tier (``EngineConfig.attention_backend="kernel"``): the
   host planner that lowers each step's schedule onto the work-unit
@@ -45,6 +52,11 @@ from flashinfer_tpu.serve.engine import (
     PrefixCache,
     ServingEngine,
 )
+from flashinfer_tpu.serve.kv_tier import (
+    DisaggServing,
+    HostKVStore,
+    migrate_request,
+)
 from flashinfer_tpu.serve.step import (
     MixedServingStep,
     SamplingConfig,
@@ -56,14 +68,17 @@ from flashinfer_tpu.serve.step import (
 
 __all__ = [
     "BlockPool",
+    "DisaggServing",
     "EngineConfig",
     "EngineRequest",
+    "HostKVStore",
     "MixedServingStep",
     "PrefixCache",
     "SamplingConfig",
     "ServingEngine",
     "ServingStep",
     "ServingStepPlan",
+    "migrate_request",
     "mixed_chunk_tokens",
     "sample_next_tokens",
 ]

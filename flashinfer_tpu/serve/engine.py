@@ -326,6 +326,11 @@ class EngineRequest:
     hit_tokens: int = 0      # prefix-cache tokens adopted at admission
     inserted_pages: int = 0  # full pages registered in the trie so far
     folded_out: int = 0      # out tokens folded into prompt on preempt
+    vacated: bool = False    # left the device mid-flight (preempt /
+    #                          spill / idle offload) and not yet
+    #                          re-admitted — the recompute-vs-restore
+    #                          miss attribution keys on this, so even a
+    #                          zero-token mid-prefill vacate counts
     arrival: int = -1
     enqueue_t: float = 0.0
     deadline: float = float("inf")
@@ -366,15 +371,30 @@ class EngineConfig:
     # lowering (serve/engine_kernels.py; interpret-mode off-TPU)
     attention_backend: str = "reference"
     decode_num_splits: int = 1      # kernel tier's split-KV factor
+    # tiered-KV statics (serve/kv_tier.py): the engine's ROLE in a
+    # disaggregated pair ("prefill" keeps finished KV pages alive for
+    # the kv_migrate handoff; "decode" accepts migrated continuations;
+    # "unified" = the single-pool default), the host-RAM offload tier
+    # (engine.kv_offload: "host" attaches a HostKVStore of
+    # engine.host_gib GiB), and the preemption policy
+    # (engine.spill_policy: "recompute" = PR 11's fold + re-prefill,
+    # "spill" = always offload, "auto" = cost-model comparison of
+    # restore bytes vs recompute FLOPs per victim)
+    role: str = "unified"
+    kv_offload: str = "off"         # engine.kv_offload: off | host
+    spill_policy: str = "recompute"  # engine.spill_policy
+    host_gib: float = 4.0           # engine.host_gib (host tier GiB)
 
     @staticmethod
     def from_knobs(model_cfg, *, num_pages: int, max_seq_tokens: int = 256,
                    **over) -> "EngineConfig":
         """Resolve the tunable statics through ``autotuner.KNOWN_KNOBS``
         (engine.block_size / engine.prefill_budget_tokens /
-        engine.max_batch / engine.attention_backend), shape-keyed on
-        the model geometry so each chip generation ships its own
-        scheduler shape ladder + attention tier."""
+        engine.max_batch / engine.attention_backend, plus the tiered-KV
+        knobs engine.kv_offload / engine.spill_policy /
+        engine.host_gib), shape-keyed on the model geometry so each
+        chip generation ships its own scheduler shape ladder,
+        attention tier, and KV tiering."""
         from flashinfer_tpu.autotuner import AutoTuner
 
         t = AutoTuner.get()
@@ -387,6 +407,11 @@ class EngineConfig:
             max_batch=int(t.lookup("engine.max_batch", key, default=8)),
             attention_backend=str(t.lookup(
                 "engine.attention_backend", key, default="reference")),
+            kv_offload=str(t.lookup(
+                "engine.kv_offload", key, default="off")),
+            spill_policy=str(t.lookup(
+                "engine.spill_policy", key, default="recompute")),
+            host_gib=float(t.lookup("engine.host_gib", key, default=4)),
         )
         knobs.update(over)
         return EngineConfig(num_pages=num_pages,
@@ -444,6 +469,21 @@ class ServingEngine:
             raise ValueError(
                 f"attention_backend must be 'reference' or 'kernel', "
                 f"got {config.attention_backend!r}")
+        if config.role not in ("prefill", "decode", "unified"):
+            raise ValueError(f"role must be prefill|decode|unified, "
+                             f"got {config.role!r}")
+        if config.kv_offload not in ("off", "host"):
+            raise ValueError(f"kv_offload must be off|host, "
+                             f"got {config.kv_offload!r}")
+        if config.spill_policy not in ("recompute", "spill", "auto"):
+            raise ValueError(
+                f"spill_policy must be recompute|spill|auto, "
+                f"got {config.spill_policy!r}")
+        if config.spill_policy != "recompute" \
+                and config.kv_offload == "off":
+            raise ValueError(
+                f"spill_policy {config.spill_policy!r} needs a host "
+                "tier — set kv_offload='host' (engine.kv_offload)")
         self.cfg = model_cfg
         self.params = params
         self.config = config
@@ -460,6 +500,22 @@ class ServingEngine:
         self._last_sig: Dict[int, object] = {}
         self._steps = 0
         self.flops_avoided = 0.0  # prefill FLOPs skipped via prefix hits
+        # tiered KV (serve/kv_tier.py): the host-RAM store below the
+        # block pool, the in-flight migration staging of a decode-role
+        # pool, and per-engine movement totals (what the serving_disagg
+        # bench rows read without the metrics gate)
+        self.host_store = None
+        if config.kv_offload == "host":
+            from flashinfer_tpu.serve.kv_tier import HostKVStore
+
+            self.host_store = HostKVStore(
+                int(config.host_gib * (1 << 30)))
+        self._migrated: Dict[str, object] = {}  # rid -> HostKVEntry
+        self.kv_tier_stats = {
+            "spills": 0, "restores": 0, "recomputes": 0,
+            "migrations": 0, "spill_bytes": 0.0, "restore_bytes": 0.0,
+            "migrate_bytes": 0.0,
+        }
         # aggregate work accounting for roofline stamping
         # (costmodel.engine_step over these totals == the run's cost):
         self.tokens_total = 0     # scheduled tokens (padding excluded)
@@ -615,25 +671,47 @@ class ServingEngine:
             self._slots[r.slot] = None
             r.slot = -1
 
+    def _fold_and_requeue(self, r: EngineRequest) -> None:
+        """Release a running request's device state and requeue it for
+        resume — THE one site enforcing the unconditional-fold
+        invariant: generated tokens fold into the resume prompt on
+        EVERY vacate-the-device path (preemption, spill, idle
+        offload), because a spilled host entry can be LRU-evicted
+        before resume and the fallback recompute must see the full
+        sequence.  A path that skipped the fold would silently drop
+        every mid-sequence generated token on that fallback (the
+        tiered-KV regression tests/test_kv_tier.py pins: spill-restore
+        == recompute-on-resume == never-preempted, bitwise)."""
+        self._release(r)
+        r.prompt = r.prompt + r.out_tokens[r.folded_out:]
+        r.folded_out = len(r.out_tokens)
+        r.kv_len = 0
+        r.hit_tokens = 0
+        r.inserted_pages = 0
+        r.vacated = True
+        r.state = _WAITING
+        self._waiting.append(r)
+
     def _preempt(self, victim: EngineRequest) -> None:
-        """Preemption-by-eviction: release the victim's pages and
-        requeue it for recompute-on-resume — its generated tokens fold
-        into the resume prompt, so decoding continues where it stopped
-        (deterministic per-token sampling seeds make the continuation
-        reproducible; pinned in tests)."""
+        """Preemption-by-eviction: spill-on-preempt when the tier +
+        policy allow (serve/kv_tier.py; resume then RESTORES the exact
+        KV bits), recompute-on-resume otherwise — either way the
+        victim folds + requeues through :meth:`_fold_and_requeue`.
+        Deterministic per-token sampling seeds make the continuation
+        reproducible under every policy."""
         from flashinfer_tpu import obs
 
         self._running.remove(victim)
-        self._release(victim)
-        victim.prompt = victim.prompt + \
-            victim.out_tokens[victim.folded_out:]
-        victim.folded_out = len(victim.out_tokens)
-        victim.kv_len = 0
-        victim.hit_tokens = 0
-        victim.inserted_pages = 0
-        victim.state = _WAITING
+        if self.host_store is not None \
+                and self.config.spill_policy != "recompute":
+            from flashinfer_tpu.serve import kv_tier
+
+            if self.config.spill_policy == "spill" \
+                    or kv_tier.spill_beats_recompute(self, victim):
+                # copy to host BEFORE the release frees the pages
+                kv_tier.spill_request(self, victim)
+        self._fold_and_requeue(victim)
         victim.preemptions += 1
-        self._waiting.append(victim)
         obs.counter_inc("engine.preemptions")
 
     def _try_admit_one(self, r: EngineRequest) -> bool:
@@ -657,9 +735,16 @@ class ServingEngine:
         if r.split < 0:
             r.split = ((P - 1) // cfg.page_size) * cfg.page_size
         split = r.split
+        # a staged restore source (host-tier spill, or an in-flight
+        # kv_migrate handoff on a decode-role pool) supersedes the
+        # prefix cache: the entry already holds the request's OWN KV
+        # bits up to its spilled kv_len — at least the shareable span
+        from flashinfer_tpu.serve import kv_tier
+
+        staged = kv_tier.staged_entry(self, r.rid)
         hit_pages: List[int] = []
         hit_tokens = 0
-        if cfg.enable_prefix_cache:
+        if staged is None and cfg.enable_prefix_cache:
             hit_pages, hit_tokens = self.prefix_cache.lookup(
                 r.prompt, split // cfg.page_size)
         # adopt the shared run BEFORE any eviction: the hit pages must
@@ -679,13 +764,32 @@ class ServingEngine:
         r.pages = hit_pages + fresh
         r.slot = slot
         self._slots[slot] = r
-        r.kv_len = hit_tokens
-        r.hit_tokens = hit_tokens
-        r.inserted_pages = len(hit_pages)
+        if staged is not None:
+            # restore path: copy the staged KV bits into the fresh
+            # pages and resume from the spilled kv_len — neither a
+            # prefix hit nor a miss (no prefill happens for the span)
+            kv_tier.restore_request(self, r)
+            r.hit_tokens = 0
+            r.inserted_pages = 0
+        else:
+            if r.vacated:
+                # resume WITHOUT a restore source: PR 11's
+                # recompute-on-resume (spill disabled, the policy
+                # chose recompute, or the host store evicted the
+                # entry) — counted so a spill-policy bench can assert
+                # the tier absorbed every resume.  The flag (set by
+                # _fold_and_requeue) covers zero-token mid-prefill
+                # vacates too, where preemptions/folded_out can't
+                self.kv_tier_stats["recomputes"] += 1
+                obs.counter_inc("engine.kv_tier.recomputes")
+            r.kv_len = hit_tokens
+            r.hit_tokens = hit_tokens
+            r.inserted_pages = len(hit_pages)
+            obs.counter_inc("engine.prefix_hit_tokens", hit_tokens)
+            obs.counter_inc("engine.prefix_miss_tokens", P - hit_tokens)
+        r.vacated = False
         r.state = _RUNNING
         self._running.append(r)
-        obs.counter_inc("engine.prefix_hit_tokens", hit_tokens)
-        obs.counter_inc("engine.prefix_miss_tokens", P - hit_tokens)
         if hit_tokens:
             self.flops_avoided += self._prefill_cost_flops(r, hit_tokens)
         return True
@@ -1201,8 +1305,94 @@ class ServingEngine:
         from flashinfer_tpu import obs
 
         self._running.remove(r)
-        self._release(r)
+        if self.config.role == "prefill":
+            # disaggregated prefill pool: the finished KV pages stay
+            # alive for the kv_migrate handoff (the coordinator owns
+            # releasing them via kv_tier.migrate_request); only the
+            # batch slot frees
+            if r.slot >= 0:
+                self._slots[r.slot] = None
+                r.slot = -1
+        else:
+            self._release(r)
+        if self.host_store is not None:
+            self.host_store.drop(r.rid)  # a stale spill is dead weight
         r.state = _FINISHED
         self._finished[r.rid] = r
         obs.request_finish(r.rid)
         obs.counter_inc("engine.finished")
+
+    # -- tiered-KV surface (serve/kv_tier.py) ------------------------------
+
+    def harvest_finished(self) -> List[EngineRequest]:
+        """Drain the finished set — the disaggregation coordinator's
+        hook: on a prefill-role engine the drained requests still hold
+        their KV pages (the caller owns releasing them, normally via
+        ``kv_tier.migrate_request``)."""
+        out = list(self._finished.values())
+        self._finished.clear()
+        return out
+
+    def adopt_migrated(self, req: EngineRequest, entry) -> None:
+        """Accept a migrated continuation (the prefill→decode
+        handoff): the request queues for admission and its KV entry
+        stages for the restore path — same machinery as a host-tier
+        resume.  The request keeps its ORIGINAL ``arrival`` (the
+        sampling-seed stream) and frozen cascade ``split``."""
+        from flashinfer_tpu import obs
+
+        if self.config.role == "prefill":
+            raise ValueError("a prefill-role pool cannot adopt "
+                             "migrated requests")
+        if req.rid in self._migrated:
+            raise ValueError(f"double migration: {req.rid!r} already "
+                             "staged on this pool")
+        if req.arrival < 0:
+            raise ValueError("migrated request must carry its source "
+                             "arrival (the sampling-seed identity)")
+        total = req.total_len() + req.max_new_tokens - len(req.out_tokens)
+        if total > self.config.max_seq_tokens:
+            raise ValueError(
+                f"migrated request {req.rid}: {total} tokens exceed "
+                f"this pool's max_seq_tokens "
+                f"{self.config.max_seq_tokens} (the per-request KV "
+                "window bound)")
+        pages = -(-total // self.config.page_size)
+        if pages > self.config.num_pages - 1:
+            raise ValueError(
+                f"migrated request {req.rid}: needs {pages} pages but "
+                f"the decode pool has {self.config.num_pages - 1} "
+                "usable")
+        self._migrated[req.rid] = entry
+        req.enqueue_t = time.perf_counter()
+        if req.slo_ttft_s is not None:
+            req.deadline = req.enqueue_t + req.slo_ttft_s
+        req.state = _WAITING
+        self._waiting.append(req)
+        obs.request_begin(req.rid)
+        obs.counter_inc("engine.requests")
+
+    def offload_idle(self, rid: str) -> None:
+        """Voluntarily spill a RUNNING request's KV to the host tier
+        (the idle-request path: a conversation between turns frees its
+        device pages now and restores bit-exactly when it next
+        schedules).  The request re-queues as waiting; admission
+        pressure decides when it returns."""
+        if self.host_store is None:
+            raise ValueError("offload_idle needs kv_offload='host'")
+        r = next((x for x in self._running if x.rid == rid), None)
+        if r is None:
+            raise ValueError(f"offload_idle: {rid!r} is not running")
+        from flashinfer_tpu.serve import kv_tier
+
+        if r.kv_len <= 0 or not r.pages:
+            raise ValueError(
+                f"offload_idle: {rid!r} has no materialized KV to "
+                "spill yet (admitted but not stepped)")
+        if not kv_tier.spill_request(self, r):
+            raise RuntimeError(
+                f"offload_idle: host store rejected {rid!r} "
+                f"({-(-r.kv_len // self.config.page_size)} pages "
+                "exceed its capacity — grow engine.host_gib)")
+        self._running.remove(r)
+        self._fold_and_requeue(r)

@@ -1,0 +1,642 @@
+"""Tiered KV subsystem (serve/kv_tier.py) — ISSUE 13.
+
+The contracts under pin:
+
+- **host store**: LRU capacity semantics, double-spill / bad-restore
+  raises, bytes accounting under a 2000-op spill/restore/migrate
+  aliasing stress (the BlockPool stress precedent);
+- **bitwise restore** (the satellite regression): spill-restore ==
+  recompute-on-resume == never-preempted tokens, across f32 AND
+  int8-KV caches with REAL sampling configs — the fold-on-spill fix
+  means a host-evicted entry degrades to exactly the pinned recompute
+  path instead of silently dropping mid-sequence generated tokens;
+- **capacity**: a pool smaller than the working set completes with
+  ZERO recomputes under spill_policy="spill" (effective KV capacity
+  beyond the device budget), and an undersized HOST store falls back
+  to recompute — counted, still bitwise;
+- **disaggregation**: DisaggServing (prefill pool + decode pool joined
+  by kv_migrate) serves tokens BITWISE-equal to the unified engine,
+  f32 + int8-KV, with the handoff traffic cost-model-priced
+  (hand-computed formula pin) and ``bound == "ici"`` on the stamp;
+- **policy**: spill_beats_recompute picks restore whenever moving
+  bytes beats recomputing FLOPs (and the reverse on contrived shapes);
+- **registration**: knobs choices-validated in KNOWN_KNOBS + resolved
+  by EngineConfig.from_knobs, the shipped kv_tier tuning sections
+  L006-valid, obs coverage (API_OPS / API_OP_COSTS / SPAN_CATEGORIES /
+  catalog metrics) closed, perf/3 serving_disagg section present.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+from flashinfer_tpu.serve import (DisaggServing, EngineConfig,
+                                  EngineRequest, SamplingConfig,
+                                  ServingEngine)
+from flashinfer_tpu.serve.kv_tier import HostKVStore
+
+CFG = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+SAMPLING = SamplingConfig(temperature=0.8, top_k=20, top_p=0.95)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_llama_params(jax.random.PRNGKey(0), CFG)
+
+
+def _mk_engine(params, **over):
+    kw = dict(num_pages=64, page_size=8, max_batch=2,
+              prefill_budget_tokens=16, max_seq_tokens=48,
+              sampling=SAMPLING)
+    kw.update(over)
+    return ServingEngine(CFG, params, EngineConfig(**kw))
+
+
+def _entry_layers(rng, pages, nbytes_per=None, dtype=np.float32):
+    k = rng.standard_normal((pages, 2, 8, 16)).astype(dtype)
+    v = rng.standard_normal((pages, 2, 8, 16)).astype(dtype)
+    return [(k, v)]
+
+
+# ---------------------------------------------------------------------------
+# Host store
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_host_store_basics():
+    rng = np.random.default_rng(0)
+    store = HostKVStore(capacity_bytes=1 << 20)
+    layers = _entry_layers(rng, pages=2)
+    e = store.put("a", layers, kv_len=13)
+    assert e is not None and e.num_pages == 2 and e.kv_len == 13
+    assert store.bytes_used == e.nbytes and len(store) == 1
+    assert store.pages_used == 2
+    # double-spill raises, never corrupts
+    with pytest.raises(ValueError):
+        store.put("a", _entry_layers(rng, 1), kv_len=5)
+    # restore of pages nobody spilled raises
+    with pytest.raises(KeyError):
+        store.pop("ghost")
+    got = store.pop("a")
+    assert got.kv_len == 13 and store.bytes_used == 0
+    np.testing.assert_array_equal(got.layers[0][0], layers[0][0])
+    # an entry bigger than the whole store is rejected, not admitted
+    tiny = HostKVStore(capacity_bytes=16)
+    assert tiny.put("big", _entry_layers(rng, 4), kv_len=32) is None
+    assert tiny.bytes_used == 0
+
+
+@pytest.mark.quick
+def test_host_store_capacity_forces_lru_eviction():
+    """At capacity the store evicts the LEAST-recENTLY-used entries
+    first (the trie leaf-first LRU precedent, flat here) and the
+    accounting never drifts."""
+    rng = np.random.default_rng(1)
+    one = _entry_layers(rng, 1)
+    per = sum(k.nbytes + v.nbytes for k, v in one)
+    store = HostKVStore(capacity_bytes=3 * per)
+    for rid in ("a", "b", "c"):
+        assert store.put(rid, _entry_layers(rng, 1), kv_len=8)
+    store.peek("a")  # bump a: b becomes the LRU victim
+    assert store.put("d", _entry_layers(rng, 1), kv_len=8)
+    assert store.evictions == 1
+    assert not store.has("b") and store.has("a") and store.has("c")
+    # two more admissions drain in LRU order: c then a
+    assert store.put("e", _entry_layers(rng, 1), kv_len=8)
+    assert not store.has("c")
+    assert store.put("f", _entry_layers(rng, 1), kv_len=8)
+    assert not store.has("a")
+    assert store.bytes_used == 3 * per and len(store) == 3
+
+
+def test_host_store_aliasing_stress():
+    """The satellite 2000-op stress (the BlockPool alloc-free-realloc
+    precedent): random spill/restore/drop churn with per-rid payload
+    fingerprints — a restore must always return the exact bytes ITS
+    spill stored (any cross-entry aliasing or accounting drift
+    diverges), and bytes_used must stay the sum of live entries."""
+    rng = np.random.default_rng(7)
+    store = HostKVStore(capacity_bytes=64 * (2 * 2 * 8 * 16 * 4))
+    live = {}  # rid -> (first k plane checksum, nbytes)
+    next_rid = 0
+    for _ in range(2000):
+        op = rng.integers(0, 3)
+        if op == 0:
+            rid = f"r{next_rid}"
+            next_rid += 1
+            layers = _entry_layers(rng, int(rng.integers(1, 4)))
+            e = store.put(rid, layers, kv_len=8)
+            if e is not None and store.has(rid):
+                live[rid] = (float(layers[0][0].sum()), e.nbytes)
+        elif op == 1 and live:
+            rid = str(rng.choice(list(live)))
+            if store.has(rid):  # may have been LRU-evicted
+                got = store.pop(rid)
+                assert float(got.layers[0][0].sum()) == live[rid][0], \
+                    f"restore of {rid} returned aliased bytes"
+            live.pop(rid)
+        elif op == 2 and live:
+            rid = str(rng.choice(list(live)))
+            store.drop(rid)
+            live.pop(rid)
+        # eviction can remove live-tracked rids; resync the view
+        live = {rid: v for rid, v in live.items() if store.has(rid)}
+        assert store.bytes_used == sum(n for _, n in live.values())
+        assert len(store) == len(live)
+        assert store.bytes_used <= store.capacity_bytes
+
+
+# ---------------------------------------------------------------------------
+# Bitwise restore (the satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def _preempt_case(params, kv_dtype, policy_kw):
+    """A preemption mid-decode (generated tokens already folded into
+    the prompt when the victim resumes — the mid-sequence fold the
+    satellite names), under the given tier policy."""
+    rng = np.random.default_rng(23)
+    pA = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+    pB = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+    eng = _mk_engine(params, num_pages=policy_kw.pop("num_pages", 7),
+                     kv_dtype=kv_dtype, **policy_kw)
+    eng.submit(EngineRequest("A", list(pA), max_new_tokens=8,
+                             priority=5))
+    for _ in range(6):
+        eng.step()  # A is mid-decode when B preempts it
+    eng.submit(EngineRequest("B", list(pB), max_new_tokens=4,
+                             priority=0))
+    return eng.run(), eng
+
+
+@pytest.mark.quick
+def test_spill_restore_equals_recompute_equals_oracle_f32(params):
+    """THE satellite pin: spill-restore == recompute-on-resume ==
+    never-preempted, token-bitwise, real sampling config.  The spill
+    path folds generated tokens into the prompt exactly like the
+    recompute path (ServingEngine._preempt), so all three runs share
+    one sequence bookkeeping and the restored KV bits close the
+    loop."""
+    oracle, _ = _preempt_case(params, None, dict(num_pages=32))
+    rec, er = _preempt_case(params, None, dict())
+    spl, es = _preempt_case(params, None, dict(
+        kv_offload="host", spill_policy="spill", host_gib=1))
+    assert er._finished["A"].preemptions == 1
+    assert es._finished["A"].preemptions == 1
+    assert rec == oracle
+    assert spl == oracle
+    assert es.kv_tier_stats["spills"] == 1
+    assert es.kv_tier_stats["restores"] == 1
+    assert es.kv_tier_stats["recomputes"] == 0
+    assert er.kv_tier_stats["recomputes"] == 1
+
+
+def test_spill_restore_equals_recompute_equals_oracle_int8_kv(params):
+    """Same triple pin with a QUANTIZED cache: the spill stores the
+    int8 bits the KV quant appends produced (dtype-preserving — the
+    compressed host format), so restore is bit-exact there too."""
+    oracle, _ = _preempt_case(params, jnp.int8, dict(num_pages=32))
+    rec, _ = _preempt_case(params, jnp.int8, dict())
+    spl, es = _preempt_case(params, jnp.int8, dict(
+        kv_offload="host", spill_policy="spill", host_gib=1))
+    assert rec == oracle and spl == oracle
+    assert es.kv_tier_stats["spills"] == 1
+    # int8 cache: the host format is the quantized bits, so the spill
+    # payload is a whole multiple of the 1-byte/element page plane
+    per_page = 2 * CFG.num_layers * CFG.num_kv_heads * 8 * CFG.head_dim
+    assert es.kv_tier_stats["spill_bytes"] > 0
+    assert es.kv_tier_stats["spill_bytes"] % per_page == 0
+
+
+def test_host_eviction_falls_back_to_recompute_bitwise(params):
+    """A host store too small for the spilled run: the entry is
+    rejected (or LRU-evicted), the resume RECOMPUTES — counted, and
+    still bitwise-equal (the unconditional fold keeps the full
+    sequence in the resume prompt)."""
+    oracle, _ = _preempt_case(params, None, dict(num_pages=32))
+    # capacity one page short of the victim's run: put() rejects
+    tiny = 2 * CFG.num_layers * CFG.num_kv_heads * 8 * CFG.head_dim * 4
+    spl, es = _preempt_case(params, None, dict(
+        kv_offload="host", spill_policy="spill",
+        host_gib=tiny / (1 << 30)))
+    assert spl == oracle
+    assert es.kv_tier_stats["spills"] == 0  # rejected, not silent
+    assert es.kv_tier_stats["recomputes"] == 1
+
+
+def test_offload_idle_roundtrip_bitwise(params):
+    """The idle-request path: voluntarily spill a mid-decode request,
+    let it resume via restore — tokens equal the uninterrupted run."""
+    rng = np.random.default_rng(31)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+
+    def run(idle):
+        eng = _mk_engine(params, num_pages=32, kv_offload="host",
+                         spill_policy="spill", host_gib=1)
+        eng.submit(EngineRequest("r", list(prompt), max_new_tokens=6))
+        for _ in range(5):
+            eng.step()
+        if idle:
+            eng.offload_idle("r")
+            assert eng.kv_tier_stats["spills"] == 1
+            assert not eng._running
+        return eng.run(), eng
+
+    plain, _ = run(False)
+    idled, eng = run(True)
+    assert idled == plain
+    assert eng.kv_tier_stats["restores"] == 1
+    assert eng.kv_tier_stats["recomputes"] == 0
+    with pytest.raises(ValueError):
+        eng.offload_idle("nope")
+
+
+def test_pool_smaller_than_working_set_zero_recomputes(params):
+    """The capacity acceptance pin: a device pool far smaller than the
+    working set, spill_policy="spill" — the run completes with ZERO
+    recompute fallbacks (every resume restored) and tokens bitwise
+    equal to the big-pool never-preempted run."""
+    rng = np.random.default_rng(37)
+    prompts = [[int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+               for _ in range(6)]
+
+    def run(npages, **tier):
+        eng = _mk_engine(params, num_pages=npages, **tier)
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"r{i}", list(p), max_new_tokens=6,
+                                     priority=5))
+        for _ in range(4):
+            eng.step()
+        for i, p in enumerate(prompts[:3]):
+            eng.submit(EngineRequest(f"hi{i}", list(p[::-1]),
+                                     max_new_tokens=4, priority=0))
+        return eng.run(), eng
+
+    big, _ = run(64)
+    small, es = run(8, kv_offload="host", spill_policy="spill",
+                    host_gib=1)
+    assert small == big
+    assert es.kv_tier_stats["spills"] >= 1
+    assert es.kv_tier_stats["restores"] == es.kv_tier_stats["spills"]
+    assert es.kv_tier_stats["recomputes"] == 0
+    # the device pool really was smaller than the working set
+    working_pages = sum(-(-(len(p) + 6) // 8) for p in prompts)
+    assert working_pages > 7
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill -> decode handoff
+# ---------------------------------------------------------------------------
+
+
+def _disagg_case(params, kv_dtype):
+    rng = np.random.default_rng(11)
+    shared = [[int(t) for t in rng.integers(1, CFG.vocab_size, 17)]
+              for _ in range(2)]
+    prompts = [shared[i % 2] + [int(t) for t in rng.integers(
+        1, CFG.vocab_size, int(rng.integers(1, 6)))] for i in range(6)]
+    cfg = EngineConfig(num_pages=64, page_size=8, max_batch=4,
+                       prefill_budget_tokens=16, max_seq_tokens=64,
+                       sampling=SAMPLING, kv_dtype=kv_dtype)
+    uni = ServingEngine(CFG, params, cfg)
+    dis = DisaggServing(CFG, params, cfg)
+    for eng in (uni, dis):
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"r{i}", list(p),
+                                     max_new_tokens=4))
+    return uni.run(), dis.run(), dis
+
+
+@pytest.mark.quick
+def test_disagg_handoff_bitwise_parity_f32(params):
+    """THE disaggregation acceptance pin: prefill-pool -> decode-pool
+    serving == the unified engine, token-bitwise, real sampling (the
+    handoff carries arrival/split/KV bits, so the seed stream and the
+    position-determined windows are identical)."""
+    uni, dis, d = _disagg_case(params, None)
+    assert dis == uni
+    assert d.migration_stats["migrations"] == 6
+    assert d.decode.kv_tier_stats["restores"] == 6
+    # every migrated byte is priced: stats bytes == the cost model's
+    # wire bytes at hops=1
+    assert d.migration_stats["ici_bytes"] == \
+        d.migration_stats["bytes"] > 0
+    # both pools held the compile-once ladder
+    assert d.prefill.num_traces <= 9 and d.decode.num_traces <= 9
+
+
+def test_disagg_handoff_bitwise_parity_int8_kv(params):
+    uni, dis, d = _disagg_case(params, jnp.int8)
+    assert dis == uni
+    # int8 cache: the wire format is the quantized bits — 1 B/elem
+    per_page = 2 * CFG.num_layers * CFG.num_kv_heads * 8 * CFG.head_dim
+    assert d.migration_stats["bytes"] % per_page == 0
+
+
+def test_disagg_single_token_requests_skip_migration(params):
+    rng = np.random.default_rng(13)
+    prompts = [[int(t) for t in rng.integers(1, CFG.vocab_size, 10)]
+               for _ in range(3)]
+    cfg = EngineConfig(num_pages=64, page_size=8, max_batch=4,
+                       prefill_budget_tokens=16, max_seq_tokens=32,
+                       sampling=SAMPLING)
+    uni = ServingEngine(CFG, params, cfg)
+    dis = DisaggServing(CFG, params, cfg)
+    for eng in (uni, dis):
+        for i, p in enumerate(prompts):
+            eng.submit(EngineRequest(f"r{i}", list(p),
+                                     max_new_tokens=1))
+    assert dis.run() == uni.run()
+    assert dis.migration_stats["migrations"] == 0
+    # nothing leaked: every surviving ref is the prefix trie's cache
+    # ownership (evictable), no request still pins a page
+    assert dis.prefill.pool.used_pages == \
+        dis.prefill.prefix_cache.num_pages
+
+
+def test_disagg_rejected_handoff_leaves_source_intact(params):
+    """A decode pool that rejects the continuation (max_seq/capacity
+    bounds) must raise BEFORE the source pages are released — the
+    request's KV stays intact on the prefill side, nothing is
+    destroyed mid-handoff."""
+    from flashinfer_tpu.serve import kv_tier
+
+    rng = np.random.default_rng(41)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, 20)]
+    pre = ServingEngine(CFG, params, EngineConfig(
+        num_pages=32, page_size=8, max_batch=2, max_seq_tokens=64,
+        sampling=SAMPLING, role="prefill"))
+    dec = ServingEngine(CFG, params, EngineConfig(
+        num_pages=32, page_size=8, max_batch=2, max_seq_tokens=24,
+        sampling=SAMPLING, role="decode"))
+    pre.submit(EngineRequest("r", list(prompt), max_new_tokens=1))
+    while pre.has_work():
+        pre.step()
+    (req,) = pre.harvest_finished()
+    pages_before = list(req.pages)
+    assert pages_before
+    with pytest.raises(ValueError):
+        # 20 + 8 tokens exceed the decode pool's max_seq_tokens 24
+        kv_tier.migrate_request(pre, dec, req, max_new_tokens=8)
+    assert req.pages == pages_before  # source untouched
+    assert all(pre.pool.ref(p) >= 1 for p in pages_before)
+    assert not dec._waiting and not dec._migrated
+
+
+def test_disagg_role_validation(params):
+    cfg = EngineConfig(num_pages=16, page_size=8, max_batch=2,
+                       max_seq_tokens=32)
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, dataclasses.replace(cfg, role="bogus"))
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params,
+                      dataclasses.replace(cfg, kv_offload="nvme"))
+    with pytest.raises(ValueError):
+        # spill policy without a host tier is a config bug, not a
+        # silent recompute
+        ServingEngine(CFG, params,
+                      dataclasses.replace(cfg, spill_policy="spill"))
+    pre = ServingEngine(CFG, params,
+                        dataclasses.replace(cfg, role="prefill"))
+    with pytest.raises(ValueError):
+        pre.adopt_migrated(EngineRequest("x", [1, 2, 3]), None)
+
+
+# ---------------------------------------------------------------------------
+# Cost model + policy + perf/3
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_kv_migrate_cost_formula_and_ici_bound():
+    """Hand-computed pin of the per-request page-run x kv-byte-width
+    wire formula, and the stamp contract: a kv_migrate row is
+    ICI-bound on every registered chip (wire floor deeper than both
+    HBM legs)."""
+    from flashinfer_tpu.obs import costmodel, hwspec, roofline
+
+    # 13 pages of 16 tokens, 8 kv heads x hd 128, 80 layers, int8
+    c = costmodel.kv_migrate(pages=13, page_size=16, num_kv_heads=8,
+                             head_dim=128, layers=80, kv_bytes=1)
+    expect = 2 * 80 * 13 * 16 * 8 * 128 * 1
+    assert c.ici_bytes == expect
+    assert c.bytes_read == expect and c.bytes_written == expect
+    assert c.flops == 0.0 and c.op == "kv_migrate"
+    # tokens form rounds up to whole pages
+    c2 = costmodel.kv_migrate(tokens=13 * 16 - 5, page_size=16,
+                              num_kv_heads=8, head_dim=128, layers=80,
+                              kv_bytes=1)
+    assert c2.ici_bytes == expect
+    # hops multiply the wire leg only
+    c3 = costmodel.kv_migrate(pages=13, page_size=16, num_kv_heads=8,
+                              head_dim=128, layers=80, kv_bytes=1,
+                              hops=3)
+    assert c3.ici_bytes == 3 * expect and c3.bytes_read == expect
+    for name, spec in hwspec.CHIP_SPECS.items():
+        res = roofline.attribute(c, 1.0, spec)
+        assert res.bound == "ici", name
+    row = roofline.stamp_row({"phase": "serving_disagg"}, c, 1e-3,
+                             hwspec.spec("v5e"))
+    assert row["bound"] == "ici" and row["ici_bytes"] == expect
+
+    # kv_page_io: the pure-bandwidth host-tier legs
+    sp = costmodel.kv_page_io(13, page_size=16, num_kv_heads=8,
+                              head_dim=128, layers=80, kv_bytes=1)
+    assert sp.bytes_read == expect and sp.bytes_written == 0
+    rs = costmodel.kv_page_io(13, page_size=16, num_kv_heads=8,
+                              head_dim=128, layers=80, kv_bytes=1,
+                              direction="restore")
+    assert rs.bytes_written == expect and rs.bytes_read == 0
+    with pytest.raises(ValueError):
+        costmodel.kv_page_io(1, page_size=16, num_kv_heads=8,
+                             head_dim=128, layers=1, direction="sideways")
+
+
+@pytest.mark.quick
+def test_spill_beats_recompute_directionality(params):
+    """The auto-policy decision is the cost model used forward: at any
+    real model shape the prefill FLOPs dwarf the restore bytes, so
+    spill wins; a request with nothing materialized never spills."""
+    from flashinfer_tpu.serve import kv_tier
+
+    eng = _mk_engine(params, num_pages=32, kv_offload="host",
+                     spill_policy="auto", host_gib=1)
+    r = EngineRequest("r", [1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=2)
+    r.kv_len = 0
+    assert not kv_tier.spill_beats_recompute(eng, r)
+    r.kv_len = 24
+    assert kv_tier.spill_beats_recompute(eng, r)
+
+
+@pytest.mark.quick
+def test_perf3_serving_disagg_section():
+    """perf/3: the report carries the predicted per-request kv_migrate
+    wire cost and joins measured serving_disagg rows against it."""
+    from flashinfer_tpu.obs import hwspec, roofline
+    from flashinfer_tpu.obs.costmodel import kv_migrate
+
+    cost = kv_migrate(pages=120, page_size=16, num_kv_heads=8,
+                      head_dim=128, layers=2, kv_bytes=4)
+    row = dict(phase="serving_disagg", mode="kv_migrate",
+               migrations=120, migrate_bytes=cost.ici_bytes,
+               migrate_us=5000.0, us=5000.0)
+    roofline.stamp_row(row, cost, 5e-3, hwspec.spec("v5e"))
+    rep = roofline.build_perf_report([row])
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/3"
+    sd = rep["serving_disagg"]
+    pred = sd["predicted_kv_migrate"]
+    assert pred["ici_bytes_per_request"] > 0
+    assert set(pred["pred_ici_us"]) == {"v5e", "v5p"}
+    assert pred["pred_ici_us"]["v5p"] < pred["pred_ici_us"]["v5e"]
+    assert len(sd["rows"]) == 1
+    m = sd["rows"][0]
+    assert m["mode"] == "kv_migrate" and m["migrations"] == 120
+    assert m["pred_wire_us"] > 0
+    assert m["measured_vs_pred_wire"] == pytest.approx(
+        5000.0 / m["pred_wire_us"], rel=1e-3)
+    # the per-request prediction also rides predict_serving_ici
+    si = roofline.predict_serving_ici()
+    assert si["kv_migrate"]["ici_bytes_per_request"] > 0
+    # rendering covers the new section
+    text = roofline.render_perf_report(rep)
+    assert "predicted kv_migrate handoff" in text
+
+
+# ---------------------------------------------------------------------------
+# Registration: knobs, configs, obs coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_kv_tier_knobs_registered_and_resolved(monkeypatch):
+    from flashinfer_tpu import autotuner
+
+    for name, bad, good in (
+            ("engine.kv_offload", "nvme", "host"),
+            ("engine.spill_policy", "maybe", "auto"),
+            ("engine.host_gib", 0, 32)):
+        spec = autotuner.KNOWN_KNOBS[name]
+        assert spec.validate(bad) is not None
+        assert spec.validate(good) is None
+
+    # the shipped kv_tier sections are L006-valid (every key names a
+    # registered knob and every value passes its spec)
+    import json
+    from pathlib import Path
+
+    root = Path(autotuner.__file__).parent / "tuning_configs"
+    for stem in ("v5e", "v5p"):
+        data = json.loads((root / f"{stem}.json").read_text())
+        sec = data["kv_tier"]
+        assert sec["seed"] is True and sec["seed_keys"]
+        for key, val in sec["tactics"].items():
+            op = key.split("|", 1)[0]
+            assert autotuner.validate_tactic(op, val) is None, (stem, key)
+
+    # from_knobs resolves the tier statics through the tuner
+    calls = {}
+
+    class FakeTuner:
+        def lookup(self, op, key, default=None):
+            calls[op] = key
+            return {"engine.kv_offload": "host",
+                    "engine.spill_policy": "auto",
+                    "engine.host_gib": 8}.get(op, default)
+
+    monkeypatch.setattr(autotuner.AutoTuner, "get",
+                        classmethod(lambda cls: FakeTuner()))
+    cfg = EngineConfig.from_knobs(CFG, num_pages=64)
+    assert cfg.kv_offload == "host"
+    assert cfg.spill_policy == "auto"
+    assert cfg.host_gib == 8.0
+    assert "engine.kv_offload" in calls
+    assert calls["engine.host_gib"] == (CFG.hidden_size,
+                                        CFG.num_qo_heads,
+                                        CFG.num_kv_heads, CFG.head_dim)
+
+
+@pytest.mark.quick
+def test_kv_tier_obs_coverage_closed():
+    """The L005-extension closure: every kv_tier op is in API_OPS (the
+    decorated surface), SERVING_OPS (must span), SPAN_CATEGORIES (has
+    a category), and API_OP_COSTS (roofline-attributable); every
+    engine.kv_tier.* metric is cataloged."""
+    from flashinfer_tpu.obs import costmodel
+    from flashinfer_tpu.obs.catalog import API_OPS, METRICS, SERVING_OPS
+    from flashinfer_tpu.obs.spans import SPAN_CATEGORIES
+
+    ops = {"engine.kv_spill", "engine.kv_restore", "engine.kv_migrate"}
+    assert ops <= API_OPS
+    assert ops <= SERVING_OPS
+    assert ops <= set(SPAN_CATEGORIES)
+    assert all(SPAN_CATEGORIES[o] == "host" for o in ops)
+    assert costmodel.API_OP_COSTS["engine.kv_spill"] == "kv_page_io"
+    assert costmodel.API_OP_COSTS["engine.kv_restore"] == "kv_page_io"
+    assert costmodel.API_OP_COSTS["engine.kv_migrate"] == "kv_migrate"
+    assert costmodel.uncovered_api_ops() == ()
+    for name in ("engine.kv_tier.spills", "engine.kv_tier.spill_bytes",
+                 "engine.kv_tier.restores",
+                 "engine.kv_tier.restore_bytes",
+                 "engine.kv_tier.migrations",
+                 "engine.kv_tier.migrate_bytes",
+                 "engine.kv_tier.recomputes",
+                 "engine.kv_tier.host_evictions",
+                 "engine.kv_tier.host_pages",
+                 "engine.kv_tier.host_bytes"):
+        assert name in METRICS, name
+
+
+def test_kv_tier_counters_and_doctor_section(params, monkeypatch):
+    """The engine.kv_tier.* counters land with the metrics gate on, and
+    obs doctor's kv_tier section reads them back."""
+    monkeypatch.setenv("FLASHINFER_TPU_METRICS", "1")
+    from flashinfer_tpu import obs
+
+    obs.reset()
+    _preempt_case(params, None, dict(kv_offload="host",
+                                     spill_policy="spill", host_gib=1))
+    snap = obs.snapshot()
+
+    def cell(name):
+        return sum(snap["counters"].get(name, {}).values())
+
+    assert cell("engine.kv_tier.spills") == 1
+    assert cell("engine.kv_tier.restores") == 1
+    assert cell("engine.kv_tier.spill_bytes") == \
+        cell("engine.kv_tier.restore_bytes") > 0
+    assert cell("engine.kv_tier.recomputes") == 0
+
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "flashinfer_tpu.obs", "doctor"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    rep = json.loads(p.stdout)
+    assert isinstance(rep["kv_tier"], dict)
+    for key in ("spills", "restores", "migrations", "recomputes",
+                "restore_rate", "host_evictions", "host_pages"):
+        assert key in rep["kv_tier"], key
+
+
+def test_kv_tier_measurement_fields_not_identity():
+    """The serving_disagg row fields audit as MEASUREMENTS (mode stays
+    identity, so handoff/spill/kv_migrate histories never compete)."""
+    from flashinfer_tpu.obs import bench_audit
+
+    a = dict(phase="serving_disagg", mode="spill", spills=3, restores=3,
+             spill_bytes=1e6, restore_bytes=1e6, recomputes=0,
+             migrate_us=10.0, tok_s=100.0)
+    b = dict(a, spills=9, restore_bytes=2e6, tok_s=120.0)
+    assert bench_audit.row_key(a) == bench_audit.row_key(b)
+    c = dict(a, mode="handoff")
+    assert bench_audit.row_key(a) != bench_audit.row_key(c)

@@ -574,6 +574,8 @@ def test_doctor_reports_spans_and_retrace_causes():
     assert report["spans"]["unspanned_serving_ops"] == []
     assert set(report["spans"]["serving_ops"]) == {
         "serve.step", "serve.mixed_step", "parallel.sharded_step",
-        "engine.step"}
+        "engine.step",
+        # the tiered-KV movements (serve/kv_tier.py, ISSUE 13)
+        "engine.kv_spill", "engine.kv_restore", "engine.kv_migrate"}
     assert report["retrace_causes"] == []  # fresh process: nothing hot
     assert "FLASHINFER_TPU_SPANS" in report["flags"]
