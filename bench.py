@@ -412,6 +412,72 @@ def phase_prefill(sweep: bool):
               f"{cost.effective_flops/t/1e12:6.2f} TFLOP/s",
               file=sys.stderr)
 
+        # fused-ingest A/B pair (ISSUE 14): the SAME run_ingest entry
+        # with the plan static flipped — rows carry the fused_ingest
+        # IDENTITY stamp (separate banked histories) and the cost
+        # model's predicted avoided-HBM delta as a measurement, so
+        # `obs perf` joins predicted-vs-measured per shape
+        if cfg:
+            k_new = jax.random.normal(jax.random.fold_in(key, 3),
+                                      (bs * ctx, HKV, D), jnp.bfloat16)
+            v_new = jax.random.normal(jax.random.fold_in(key, 4),
+                                      (bs * ctx, HKV, D), jnp.bfloat16)
+            kc0 = jnp.zeros_like(kc)
+            vc0 = jnp.zeros_like(vc)
+            bd = costmodel.prefill_ingest_breakdown(
+                bs * qlen, bs * ctx, HQ, HKV, D)
+            pair = {}
+            for mode in (True, False):
+                wi = fi.BatchPrefillWithPagedKVCacheWrapper(
+                    kv_layout="HND")
+                wi.plan(
+                    np.arange(bs + 1, dtype=np.int32) * qlen,
+                    np.arange(bs + 1, dtype=np.int32) * ppr,
+                    np.random.default_rng(0).permutation(npages)
+                    .astype(np.int32),
+                    np.full((bs,), PS, np.int32),
+                    HQ, HKV, D, PS, causal=True, fused_ingest=mode,
+                )
+                ti = _guard_soft(
+                    "bench.prefill.ingest",
+                    (bs, qlen, ctx, HQ, HKV, D, PS, mode),
+                    lambda: bench_fn_device(
+                        lambda qq, kk, vv, kc_, vc_: wi.run_ingest(
+                            qq, kk, vv, (kc_, vc_)),
+                        q, k_new, v_new, kc0, vc0, repeats=3,
+                    ),
+                )
+                if ti is None:
+                    continue
+                icost = (costmodel.prefill_ingest(
+                    bs * qlen, bs * ctx, HQ, HKV, D,
+                    stats=getattr(wi, "_ingest_stats", None),
+                    block_q=cfg.get("block_q"),
+                    pages_per_chunk=cfg.get("pages_per_chunk"),
+                    page_size=PS) if mode
+                    # the separate row's wall covers rope + append +
+                    # attention: price the three-pass traffic, not
+                    # attention alone
+                    else costmodel.prefill_ingest_separate(
+                        bs * qlen, bs * ctx, HQ, HKV, D, causal=True))
+                _emit_row(**_stamp(
+                    dict(phase="prefill", kind="paged_ingest", bs=bs,
+                         qlen=qlen, ctx=ctx, us=round(ti * 1e6, 1),
+                         tflops=round(
+                             icost.effective_flops / ti / 1e12, 2)),
+                    icost, ti, fused_ingest=mode,
+                    ingest_bytes_avoided=bd["bytes_avoided"]))
+                pair[mode] = ti
+                print(f"# prefill ingest bs={bs} qlen={qlen} ctx={ctx} "
+                      f"{'fused   ' if mode else 'separate'}: "
+                      f"{ti*1e6:9.1f} us", file=sys.stderr)
+            if True in pair and False in pair:
+                print(f"# prefill ingest bs={bs} qlen={qlen} ctx={ctx}: "
+                      f"predicted {bd['bytes_avoided']/1e6:.1f} MB "
+                      f"avoided ({bd['avoided_fraction']:.0%} of "
+                      f"separate-op bytes); measured oracle/fused "
+                      f"{pair[False]/pair[True]:.2f}x", file=sys.stderr)
+
     for T in ragged_ts:
         key = jax.random.PRNGKey(1)
         q = jax.random.normal(key, (T, HQ, D), jnp.bfloat16)

@@ -175,6 +175,99 @@ def sweep(smoke: bool, repeats: int):
     return winners
 
 
+def sweep_ingest(smoke: bool, repeats: int):
+    """The fused-ingest A/B (ISSUE 14): for each sweep shape, time the
+    fused RoPE+quantize-append+attention launch against the separate-op
+    composition THROUGH THE SAME ``run_ingest`` entry point (the plan
+    static flips the mode), emit paired rows carrying the
+    ``fused_ingest`` identity stamp + the cost model's
+    ``ingest_bytes_avoided`` measurement, and return per-shape
+    ``prefill.fused_ingest`` winners for ``--emit-config``."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import flashinfer_tpu as fi
+    from flashinfer_tpu.obs import costmodel, hwspec, roofline
+    from flashinfer_tpu.testing import bench_fn_device
+
+    if smoke:
+        # the wrapper's fused work-unit path (run_ingest's requirement)
+        # needs the pallas tier; interpret mode serves it off-chip
+        os.environ.setdefault("FLASHINFER_TPU_BACKEND", "pallas")
+    chip = hwspec.current_spec()
+    winners = {}
+    for bs, qlen, ctx, HQ, HKV, D, PS in shape_grid(smoke):
+        ppr = ctx // PS
+        npages = bs * ppr
+        rng = np.random.default_rng(0)
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (bs * qlen, HQ, D), jnp.bfloat16)
+        k_new = jax.random.normal(jax.random.fold_in(key, 1),
+                                  (bs * ctx, HKV, D), jnp.bfloat16)
+        v_new = jax.random.normal(jax.random.fold_in(key, 2),
+                                  (bs * ctx, HKV, D), jnp.bfloat16)
+        kc = jnp.zeros((npages, HKV, PS, D), jnp.bfloat16)
+        vc = jnp.zeros((npages, HKV, PS, D), jnp.bfloat16)
+        qo_indptr = np.arange(bs + 1, dtype=np.int32) * qlen
+        kv_page_indptr = np.arange(bs + 1, dtype=np.int32) * ppr
+        kv_page_indices = rng.permutation(npages).astype(np.int32)
+        fused_key = "_".join(map(str, (
+            bs, max(1 << (bs * qlen - 1).bit_length(), 128), HQ, HKV, D,
+            PS)))
+        bd = costmodel.prefill_ingest_breakdown(
+            bs * qlen, bs * ctx, HQ, HKV, D)
+        pair = {}
+        for mode in (True, False):
+            w = fi.BatchPrefillWithPagedKVCacheWrapper(kv_layout="HND")
+            w.plan(qo_indptr, kv_page_indptr, kv_page_indices,
+                   np.full((bs,), PS, np.int32), HQ, HKV, D, PS,
+                   causal=True, fused_ingest=mode)
+            try:
+                t = bench_fn_device(
+                    lambda qq, kk, vv, kc_, vc_: w.run_ingest(
+                        qq, kk, vv, (kc_, vc_)),
+                    q, k_new, v_new, kc, vc, repeats=repeats)
+            except Exception as e:  # noqa: BLE001 - one cell, not the sweep
+                first = (str(e).splitlines() or ["?"])[0][:120]
+                print(f"# ingest mode={mode} FAILED "
+                      f"{type(e).__name__}: {first}", file=sys.stderr)
+                continue
+            if mode:
+                cost = costmodel.prefill_ingest(
+                    bs * qlen, bs * ctx, HQ, HKV, D,
+                    stats=getattr(w, "_ingest_stats", None),
+                    block_q=(w.fused_prefill_config or {}).get("block_q"),
+                    pages_per_chunk=(w.fused_prefill_config or {}).get(
+                        "pages_per_chunk"),
+                    page_size=PS)
+            else:
+                # the separate row's wall covers rope + append +
+                # attention: price the three-pass traffic (same op
+                # family as the fused row), not attention alone
+                cost = costmodel.prefill_ingest_separate(
+                    bs * qlen, bs * ctx, HQ, HKV, D, causal=True)
+            _emit_row(**roofline.stamp_row(
+                dict(phase="prefill_blocks", kind="ingest_ab", bs=bs,
+                     qlen=qlen, ctx=ctx, us=round(t * 1e6, 1),
+                     tflops=round(cost.effective_flops / t / 1e12, 2)),
+                cost, t, chip, fused_ingest=mode,
+                ingest_bytes_avoided=bd["bytes_avoided"]))
+            pair[mode] = t
+            print(f"# ingest bs={bs} qlen={qlen} ctx={ctx} "
+                  f"{'fused   ' if mode else 'separate'}: "
+                  f"{t*1e6:9.1f} us  (pred avoided "
+                  f"{bd['bytes_avoided']/1e6:.1f} MB)", file=sys.stderr)
+        if True in pair and False in pair:
+            win = pair[True] < pair[False] * 0.98
+            winners[f"prefill.fused_ingest|{fused_key}"] = \
+                "on" if win else "off"
+            print(f"# ingest bs={bs} qlen={qlen} ctx={ctx} winner: "
+                  f"{'fused' if win else 'separate'} "
+                  f"({pair[False]/pair[True]:.2f}x)", file=sys.stderr)
+    return winners
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -183,21 +276,37 @@ def main():
     ap.add_argument("--emit-config", action="store_true",
                     help="print a tuning_configs 'prefill' section with "
                          "each shape's winner on stdout")
+    ap.add_argument("--sweep-ingest", action="store_true",
+                    help="also A/B the fused prefill ingest "
+                         "(prefill.fused_ingest) per shape")
     args = ap.parse_args()
     if not args.smoke:
         from flashinfer_tpu.env import apply_platform_from_env
 
         apply_platform_from_env()
     winners = sweep(args.smoke, args.repeats)
+    ingest_winners = (sweep_ingest(args.smoke, args.repeats)
+                      if args.sweep_ingest else {})
     if args.emit_config:
-        print(json.dumps({"prefill": {
+        out = {"prefill": {
             "comment": "measured by benchmarks/bench_prefill_blocks.py "
                        "(replace the shipped seed section with this)",
             "seed": bool(args.smoke),
             "tactics": winners,
-        }}, indent=1))
+        }}
+        if ingest_winners:
+            out["prefill_ingest"] = {
+                "comment": "measured by benchmarks/bench_prefill_blocks"
+                           ".py --sweep-ingest (replace the shipped "
+                           "seed section with this)",
+                "seed": bool(args.smoke),
+                "tactics": ingest_winners,
+            }
+        print(json.dumps(out, indent=1))
     else:
-        print(json.dumps({"winners": winners}))
+        print(json.dumps({"winners": winners,
+                          **({"ingest_winners": ingest_winners}
+                             if ingest_winners else {})}))
     return 0
 
 

@@ -54,6 +54,10 @@ CODE = "L007"
 # kernel's scalar-prefetch operands.
 PLANNER_KERNELS: Dict[str, str] = {
     "build_prefill_work_units": "_fused_prefill_kernel",
+    # the ingest-mode pair (ISSUE 14): build_prefill_ingest_units is
+    # the explicit-dict re-emission of build_prefill_work_units(
+    # fused_ingest=...) so its 14-key emission is statically decidable
+    "build_prefill_ingest_units": "_fused_prefill_ingest_kernel",
     "build_decode_split_units": "_decode_split_kernel_fused_heads",
     # the serving engine's schedule lowering (serve/engine_kernels.py)
     # feeds BOTH kernels above through their own planners, so its

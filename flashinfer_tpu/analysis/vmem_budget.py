@@ -139,6 +139,21 @@ register_knob_launch(KnobLaunch(
     shape_names=("hidden", "H", "Hkv", "D"),
 ))
 
+# key: (batch, tq_pad, num_qo_heads, num_kv_heads, head_dim, page_size)
+# — prefill.py fused_key, shared with fused_prefill.blocks.  The tactic
+# value is the mode STRING ("on"/"off"), which never enters scratch
+# arithmetic, and the ingest launcher's block_q/pages_per_chunk arrive
+# from the fused_prefill.blocks tactic for the same key — so this
+# binding registers the launch (the ISSUE 14 satellite contract) while
+# the compile-feasibility proof rides the fused_prefill.blocks
+# evaluation of the shared chunk/tile shapes.
+register_knob_launch(KnobLaunch(
+    knob="prefill.fused_ingest",
+    launcher="fused_paged_prefill_ingest",
+    value_names=("fused_ingest",),
+    shape_names=(None, "total_q", "H", "Hkv", "D", "page_size"),
+))
+
 
 class _Unevaluable(Exception):
     pass

@@ -107,6 +107,17 @@ register_knob("fused_prefill.blocks", arity=2,
               description="fused work-unit prefill (block_q, "
                           "pages_per_chunk) — the qo-tile/kv-chunk "
                           "shapes of the pipelined mainloop")
+register_knob("prefill.fused_ingest", kind="str",
+              choices=("off", "on"),
+              description="fused prefill INGEST mode (ISSUE 14): 'on' "
+                          "folds RoPE + KV-quantize-append into the "
+                          "work-unit prefill mainloop "
+                          "(ops/paged_prefill.fused_paged_prefill_"
+                          "ingest) where geometry allows; absent "
+                          "entries default via costmodel."
+                          "predict_prefill_ingest_win (>2% predicted "
+                          "win required, the choose_decode_splits "
+                          "pattern)")
 register_knob("flash_attention.blocks", arity=2,
               description="ragged flash kernel (block_q, block_kv) "
                           "grid blocks")

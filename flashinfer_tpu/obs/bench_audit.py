@@ -100,6 +100,14 @@ MEASUREMENT_FIELDS = frozenset({
     "spills", "restores", "spill_bytes", "restore_bytes",
     "recomputes", "host_evictions", "disagg_tokens_equal",
     "spill_tokens_equal",
+    # prefill ingest A/B (ISSUE 14): the cost model's predicted
+    # avoided-HBM delta for the row's shape — derived like merge_bytes,
+    # never identity.  ``fused_ingest`` (the ingest-mode flag itself)
+    # is deliberately NOT here: fused and separate rows of the A/B
+    # pair are different configurations with separate banked histories
+    # (the step_mode/attention_backend precedent;
+    # roofline.stamp_row stamps it)
+    "ingest_bytes_avoided",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

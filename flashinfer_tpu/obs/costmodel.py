@@ -402,6 +402,185 @@ def paged_prefill(batch: int, qo_len: int, kv_len: int,
                                op="paged_prefill")
 
 
+def prefill_ingest_breakdown(
+    total_q: int, total_kv: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim: int, *, q_bytes: int = 2, kv_bytes: int = 2,
+    cache_bytes: int = 2, out_bytes: int = 2,
+) -> Dict[str, float]:
+    """Algorithmic HBM traffic of the prefill INGEST fusion vs the
+    separate-op composition (ISSUE 14): per the module convention these
+    are algorithmic bytes — every operand read once, outputs written
+    once — so the avoided terms are exactly the round trips the fusion
+    structurally removes, independent of kernel re-streaming (which the
+    measured-vs-roofline gap exposes separately).
+
+    Separate path (rope -> quantize-append -> attention re-read):
+
+    - rope_q: read raw q + write rotated q            = 2 Q
+    - rope_k: read raw k + write rotated k            = 2 K
+    - append: read rotated k + raw v, write cache     = K + V + Kc + Vc
+    - attention: read rotated q + cache k/v, write o  = Q + Kc + Vc + O
+
+    Fused path: read raw q + raw k + raw v, write cache + o.
+
+    Avoided = 2 Q + 2 K + Kc + Vc — the rope round trips and the
+    attention's cache re-read (the quantize-append write survives: the
+    cache must exist for decode either way)."""
+    Q = float(total_q) * num_qo_heads * head_dim * q_bytes
+    K = float(total_kv) * num_kv_heads * head_dim * kv_bytes
+    V = K
+    Kc = float(total_kv) * num_kv_heads * head_dim * cache_bytes
+    Vc = Kc
+    O = float(total_q) * num_qo_heads * head_dim * out_bytes
+    separate = 3 * Q + 3 * K + V + 2 * Kc + 2 * Vc + O
+    fused = Q + K + V + Kc + Vc + O
+    return {
+        "separate_bytes": separate,
+        "fused_bytes": fused,
+        "bytes_avoided": separate - fused,
+        "avoided_fraction": (separate - fused) / separate if separate
+        else 0.0,
+        # per-launch traffic of the separate composition (the chooser
+        # prices these as SEQUENTIAL memory passes — rope and append
+        # are elementwise and cannot hide under attention's MXU floor)
+        "rope_bytes": 2 * Q + 2 * K,
+        "append_bytes": K + V + Kc + Vc,
+        "attention_bytes": Q + Kc + Vc + O,
+    }
+
+
+def prefill_ingest(
+    total_q: int, total_kv: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim: int, *, causal: bool = True,
+    stats: Optional[Mapping] = None, block_q: Optional[int] = None,
+    pages_per_chunk: Optional[int] = None, page_size: int = 16,
+    q_bytes: int = 2, kv_bytes: int = 2, cache_bytes: int = 2,
+    out_bytes: int = 2, dtype: str = "bf16",
+) -> Cost:
+    """The fused prefill-ingest launch's cost: attention FLOPs (plus
+    the ~6 FLOP/element rotation and 2 FLOP/element quantize riding
+    in-register) over ONE raw q/k/v read + one quantized-page write +
+    the output.  With live plan ``stats`` the launched work comes from
+    the real work-unit grid (``fused_prefill_from_stats`` MXU cells;
+    raw chunks stream once per unit, finished pages write once per
+    ``ingest_chunks`` owner) and effective work is the attended pairs;
+    without, the cost is the algorithmic fused-path traffic."""
+    att = attention(total_q, total_kv, num_qo_heads, num_kv_heads,
+                    head_dim, causal=causal, q_bytes=q_bytes,
+                    kv_bytes=kv_bytes, out_bytes=out_bytes, dtype=dtype)
+    rope_flops = 6.0 * (total_q * num_qo_heads
+                        + total_kv * num_kv_heads) * head_dim
+    quant_flops = 2.0 * 2.0 * total_kv * num_kv_heads * head_dim
+    bd = prefill_ingest_breakdown(
+        total_q, total_kv, num_qo_heads, num_kv_heads, head_dim,
+        q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes,
+        out_bytes=out_bytes)
+    cache_w = 2.0 * total_kv * num_kv_heads * head_dim * cache_bytes
+    out_w = float(total_q) * num_qo_heads * head_dim * out_bytes
+    if stats is not None and block_q and pages_per_chunk:
+        chunk_tokens = pages_per_chunk * page_size
+        per_cell = 2.0 * num_qo_heads * (head_dim + head_dim)
+        flops = (stats["mxu_cells_total"] * per_cell + rope_flops
+                 + quant_flops)
+        # effective follows the fused_prefill_from_stats convention:
+        # the in-bounds MXU cells (plus the rotate/quantize work,
+        # which is useful on every real row) — never att.flops, whose
+        # causal accounting can exceed a tightly-pruned launch
+        effective = (stats["mxu_cells_valid"] * per_cell + rope_flops
+                     + quant_flops)
+        reads = (
+            stats["tiles"] * block_q * num_qo_heads * head_dim * q_bytes
+            + stats["units"] * chunk_tokens * num_kv_heads
+            * (head_dim + head_dim) * kv_bytes)
+        return Cost(
+            flops=flops, flops_effective=min(effective, flops),
+            bytes_read=reads, bytes_written=cache_w + out_w,
+            dtype=dtype, op="prefill_ingest")
+    return Cost(
+        flops=att.flops + rope_flops + quant_flops,
+        flops_effective=att.flops,
+        bytes_read=bd["fused_bytes"] - cache_w - out_w,
+        bytes_written=cache_w + out_w,
+        dtype=dtype, op="prefill_ingest")
+
+
+def prefill_ingest_separate(
+    total_q: int, total_kv: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim: int, *, causal: bool = True, q_bytes: int = 2,
+    kv_bytes: int = 2, cache_bytes: int = 2, out_bytes: int = 2,
+    dtype: str = "bf16",
+) -> Cost:
+    """The separate-op composition (rope → quantize-append → attention)
+    priced at the SAME ``prefill_ingest`` op family as the fused launch
+    — the A/B's separate-mode rows.  FLOPs are identical (the same
+    rotate/quantize/attend work executes, just split over three
+    launches); bytes are the three-pass traffic
+    :func:`prefill_ingest_breakdown` itemizes (``separate_bytes``), so
+    a separate-mode row's roofline fraction rates the composition
+    against what it actually moved, not attention alone."""
+    att = attention(total_q, total_kv, num_qo_heads, num_kv_heads,
+                    head_dim, causal=causal, q_bytes=q_bytes,
+                    kv_bytes=kv_bytes, out_bytes=out_bytes, dtype=dtype)
+    rope_flops = 6.0 * (total_q * num_qo_heads
+                        + total_kv * num_kv_heads) * head_dim
+    quant_flops = 2.0 * 2.0 * total_kv * num_kv_heads * head_dim
+    Q = float(total_q) * num_qo_heads * head_dim * q_bytes
+    K = float(total_kv) * num_kv_heads * head_dim * kv_bytes
+    V = K
+    Kc = float(total_kv) * num_kv_heads * head_dim * cache_bytes
+    Vc = Kc
+    O = float(total_q) * num_qo_heads * head_dim * out_bytes
+    # rope reads Q+K / writes Q+K; append reads K+V / writes Kc+Vc;
+    # attention reads Q+Kc+Vc / writes O  (sum == separate_bytes)
+    return Cost(
+        flops=att.flops + rope_flops + quant_flops,
+        flops_effective=att.flops,
+        bytes_read=2 * Q + 2 * K + V + Kc + Vc,
+        bytes_written=Q + K + Kc + Vc + O,
+        dtype=dtype, op="prefill_ingest")
+
+
+def predict_prefill_ingest_win(
+    total_q: int, total_kv: int, num_qo_heads: int, num_kv_heads: int,
+    head_dim: int, *, hbm_tbps: float, peak_tflops: float = 0.0,
+    causal: bool = True, q_bytes: int = 2, kv_bytes: int = 2,
+    cache_bytes: int = 2,
+) -> Tuple[bool, Dict[str, float]]:
+    """Plan-time fused-ingest selection (the ``choose_decode_splits``
+    pattern, ISSUE 14): roofline-forward seconds of the separate-op
+    composition vs the fused launch; fused must beat separate by >2%
+    predicted time or the knob default stays OFF — ties and noise keep
+    the proven composition.
+
+    The separate path is THREE sequential launches — rope and
+    quantize-append are elementwise memory passes that cannot hide
+    under the attention launch's MXU floor — so it is priced as
+    ``rope_bytes/bw + append_bytes/bw + max(attention_bytes/bw,
+    t_flops)``, while the fused launch overlaps everything under one
+    roofline (the rotation/quantize FLOPs ride the VPU inside the DMA
+    shadow).  Compute-bound shapes therefore still show the win of the
+    two deleted memory passes; tiny shapes where everything rounds to
+    noise keep the proven composition via the 2% bar.  Returns
+    ``(use_fused, evidence_table)``."""
+    bd = prefill_ingest_breakdown(
+        total_q, total_kv, num_qo_heads, num_kv_heads, head_dim,
+        q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes)
+    att = attention(total_q, total_kv, num_qo_heads, num_kv_heads,
+                    head_dim, causal=causal)
+    bw = hbm_tbps * 1e12
+    t_flops = (att.flops / (peak_tflops * 1e12)) if peak_tflops > 0 \
+        else 0.0
+    t_sep = (bd["rope_bytes"] / bw + bd["append_bytes"] / bw
+             + max(bd["attention_bytes"] / bw, t_flops))
+    t_fused = max(bd["fused_bytes"] / bw, t_flops)
+    use = t_fused < t_sep * 0.98
+    return use, {
+        "separate_s": t_sep, "fused_s": t_fused,
+        "bytes_avoided": bd["bytes_avoided"],
+        "avoided_fraction": bd["avoided_fraction"],
+    }
+
+
 def moe_gmm(tokens: int, num_experts: int, hidden: int, inter: int,
             top_k: int, *, weight_bytes: int = 2, act_bytes: int = 2,
             experts_loaded: Optional[int] = None,

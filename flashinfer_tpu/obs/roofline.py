@@ -123,7 +123,9 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
               merge_bytes: Optional[float] = None,
               step_mode: Optional[str] = None,
               mesh_axes: Optional[str] = None,
-              attention_backend: Optional[str] = None) -> Dict:
+              attention_backend: Optional[str] = None,
+              fused_ingest: Optional[bool] = None,
+              ingest_bytes_avoided: Optional[float] = None) -> Dict:
     """Write the canonical roofline fields onto a bench row in place.
     Every bench.py routine stamps through here — the uniform schema is
     what makes ``obs perf`` and the auditor's roofline-fraction rule
@@ -155,7 +157,15 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
     ``"kernel"`` — the Pallas work-unit lowering,
     serve/engine_kernels.py): configuration like step_mode, so a
     kernel-tier row never competes with reference-row history in the
-    quality audit."""
+    quality audit.
+
+    ``fused_ingest`` is the prefill ingest-mode identity (the ISSUE 14
+    RoPE + quantize-append fusion, ops/paged_prefill.py): configuration
+    like step_mode/attention_backend, so an A/B pair's fused and
+    separate rows keep separate banked histories and never compete.
+    ``ingest_bytes_avoided`` is the cost model's predicted avoided-HBM
+    delta for the row's shape (``costmodel.prefill_ingest_breakdown``)
+    — derived, a MEASUREMENT field like merge_bytes."""
     res = attribute(cost, seconds, spec)
     if num_splits is not None:
         row["num_splits"] = int(num_splits)
@@ -167,6 +177,10 @@ def stamp_row(row: Dict, cost: Cost, seconds: float,
         row["mesh_axes"] = str(mesh_axes)
     if attention_backend is not None:
         row["attention_backend"] = str(attention_backend)
+    if fused_ingest is not None:
+        row["fused_ingest"] = bool(fused_ingest)
+    if ingest_bytes_avoided is not None:
+        row["ingest_bytes_avoided"] = float(ingest_bytes_avoided)
     if cost.ici_bytes:
         row["ici_bytes"] = float(cost.ici_bytes)
         row["pct_ici_roofline"] = round(res.pct_ici_roofline, 4)
@@ -316,6 +330,71 @@ def predict_kv_migrate(*, ctx: int = 4096, layers: int = 80,
                 2)
             for c in chips},
     }
+
+
+# the headline prefill cells' ingest geometry: (name, total_q,
+# total_kv, HQ, HKV, D) — the bench.py prefill phase shapes the VERDICT
+# fractions quote (HEADLINE_CELLS), flattened to token totals
+_INGEST_CELLS = (
+    ("paged_bs8_q512_ctx4096", 8 * 512, 8 * 4096, 32, 8, 128),
+    ("ragged_T8192", 8192, 8192, 32, 8, 128),
+)
+
+
+def predict_prefill_ingest(*, chips: Sequence[str] = SCALING_CHIPS,
+                           cells: Sequence[tuple] = _INGEST_CELLS) -> dict:
+    """The perf/4 prefill-ingest section, predicted half: for each
+    headline prefill cell, the separate-vs-fused modeled HBM bytes
+    (``costmodel.prefill_ingest_breakdown``) and the per-chip chooser
+    verdict (``predict_prefill_ingest_win`` — the rule that decides the
+    ``prefill.fused_ingest`` knob default).  The ISSUE 14 acceptance
+    bar — headline shapes drop >= 20% of modeled HBM bytes — is read
+    straight off ``avoided_fraction`` here."""
+    out: Dict[str, dict] = {}
+    for name, tq, tkv, hq, hkv, hd in cells:
+        bd = costmodel.prefill_ingest_breakdown(tq, tkv, hq, hkv, hd)
+        verdicts = {}
+        for chip in chips:
+            spec = hwspec.spec(chip)
+            use, ev = costmodel.predict_prefill_ingest_win(
+                tq, tkv, hq, hkv, hd, hbm_tbps=spec.hbm_tbps,
+                peak_tflops=spec.peak_tflops("bf16"))
+            verdicts[spec.name] = {
+                "use_fused": use,
+                "pred_sep_us": round(ev["separate_s"] * 1e6, 1),
+                "pred_fused_us": round(ev["fused_s"] * 1e6, 1),
+            }
+        out[name] = {
+            "separate_bytes": bd["separate_bytes"],
+            "fused_bytes": bd["fused_bytes"],
+            "bytes_avoided": bd["bytes_avoided"],
+            "avoided_fraction": round(bd["avoided_fraction"], 4),
+            "chips": verdicts,
+        }
+    return out
+
+
+def _prefill_ingest(attributed: Sequence[Mapping]) -> dict:
+    """The perf/4 prefill-ingest section: the predicted byte drop per
+    headline cell joined with every banked prefill row that carries the
+    ingest identity stamp (``fused_ingest`` + ``ingest_bytes_avoided``,
+    the bench prefill A/B pair) — so the MFU table's effective-vs-
+    launched story shows what the fusion accounted for."""
+    measured: List[dict] = []
+    for a in attributed:
+        row = a["row"]
+        # both A/B harnesses join: bench.py's prefill phase pair AND
+        # the bench_prefill_blocks.py --sweep-ingest cells
+        if row.get("phase") not in ("prefill", "prefill_blocks") \
+                or row.get("fused_ingest") is None:
+            continue
+        m = {k: row[k] for k in (
+            "kind", "bs", "qlen", "ctx", "fused_ingest",
+            "ingest_bytes_avoided", "us", "tflops", "bound",
+            "pct_roofline", "effective_pct_roofline", "chip")
+            if row.get(k) is not None}
+        measured.append(m)
+    return {"predicted": predict_prefill_ingest(), "rows": measured}
 
 
 def predict_serving_ici(*, bs: int = 64, ctx: int = 4096,
@@ -562,7 +641,7 @@ def build_perf_report(rows: Sequence[Mapping], *,
         })
 
     return {
-        "schema": "flashinfer_tpu.obs.perf/3",
+        "schema": "flashinfer_tpu.obs.perf/4",
         "chips": {name: dataclasses.asdict(s)
                   for name, s in sorted(hwspec.CHIP_SPECS.items())
                   if any(a["res"].chip == name for a in attributed)},
@@ -583,6 +662,10 @@ def build_perf_report(rows: Sequence[Mapping], *,
         # kv_migrate wire cost + the measured migration stamps of
         # banked serving_disagg rows, joined
         "serving_disagg": _serving_disagg(attributed),
+        # the prefill-ingest dimension (perf/4): predicted separate-vs-
+        # fused byte drop at the headline prefill cells + the banked
+        # ingest A/B rows, joined (ISSUE 14)
+        "prefill_ingest": _prefill_ingest(attributed),
         "headline": _headline(attributed),
     }
 
@@ -667,6 +750,27 @@ def render_perf_report(report: Mapping) -> str:
                 f"{m.get('migrations', 0):5d} migrations, "
                 f"{float(m.get('migrate_bytes', 0)) / 1e6:10.2f} MB"
                 + (f"  {ratio:.2f}x pred wire" if ratio else ""))
+    pi = report.get("prefill_ingest")
+    if pi:
+        lines.append("")
+        lines.append("predicted prefill-ingest byte drop (separate-op "
+                     "vs fused, headline cells):")
+        for name, cell in pi["predicted"].items():
+            chips = "  ".join(
+                f"{c} {'ON' if v['use_fused'] else 'off'}"
+                for c, v in cell["chips"].items())
+            lines.append(
+                f"  {name:24s} {cell['separate_bytes'] / 1e6:9.1f} -> "
+                f"{cell['fused_bytes'] / 1e6:9.1f} MB  "
+                f"(-{cell['avoided_fraction']:.0%})  knob: {chips}")
+        for m in pi.get("rows", []):
+            lines.append(
+                f"  measured {'fused ' if m.get('fused_ingest') else 'separate'}"
+                f" {m.get('kind', '?')} qlen={m.get('qlen')}: "
+                f"{m.get('us', 0):.1f} us"
+                + (f"  ({float(m['ingest_bytes_avoided']) / 1e6:.1f} MB"
+                   f" avoided pred)" if m.get("ingest_bytes_avoided")
+                   else ""))
     sc = report.get("scaling_prediction")
     if sc:
         lines.append("")

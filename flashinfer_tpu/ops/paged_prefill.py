@@ -81,6 +81,9 @@ _NEG_INF = -1e30
 CODE_FULL = 0  # every (row, col) valid — no mask math in-kernel
 CODE_PARTIAL = 1  # bounds/causal/window recomputed in-register
 CODE_PARTIAL_MASK = 2  # PARTIAL + packed custom-mask bitmap expansion
+CODE_WRITE_ONLY = 3  # ingest mode only: quantize-append the chunk, no
+#                      attention (empty row span; the chunk was pruned
+#                      from every tile but its K/V must reach the cache)
 
 _POPCNT = np.array([bin(i).count("1") for i in range(256)], np.int64)
 
@@ -155,6 +158,7 @@ def build_prefill_work_units(
     pack_tiles: bool = True,
     prune: bool = True,
     num_units_pad: Optional[int] = None,
+    fused_ingest=None,
 ):
     """Host-side plan: flatten (qo-tile, request, kv-chunk) work units.
 
@@ -199,8 +203,52 @@ def build_prefill_work_units(
     the hottest host-plan loop; when the unit enumeration is canonical
     (``pack_tiles=False`` or every qo_len a multiple of ``block_q``) it
     runs in the C++ planner (csrc/planner.cpp prefill_mask_plan) and the
-    per-unit bitmaps are row-selected from its output after pruning."""
+    per-unit bitmaps are row-selected from its output after pruning.
+
+    ``fused_ingest`` (keyword-only) switches the plan into INGEST mode
+    for :func:`fused_paged_prefill_ingest`: the kernel streams RAW
+    pre-RoPE K/V rows (contiguous per request on one flat axis) instead
+    of cache pages, rotates + quantizes them in-register, and writes the
+    finished pages back to the paged cache from the same launch.  Three
+    extra per-unit arrays are emitted:
+
+    - ``kvbase`` — flat raw-KV row of the unit's request's kv position
+      0 (default: the running cumsum of ``kv_lens``; callers whose raw
+      rows live elsewhere on the axis — the engine's rung-padded flat
+      token axis — override via ``fused_ingest={"kv_bases": ...}``);
+    - ``posoff`` — per-request GLOBAL position offset added to the
+      plan-local q/kv positions for the in-kernel RoPE (0 for a
+      from-scratch prefill; the engine passes the cascade ``split``,
+      the append reroute the first append position);
+    - ``wkv`` — 1 on the single unit that owns each (request, chunk)'s
+      quantize-append write-back (the FIRST unit touching the chunk in
+      stream order, so the rotated values are written exactly once).
+
+    Chunks that attention pruned from EVERY tile (sliding-window /
+    all-zero-mask chunks) still must reach the cache: they come back as
+    ``CODE_WRITE_ONLY`` units (empty row span, no MXU work, prepended
+    ahead of the qstart-ordered stream with ``first=wout=0`` so they
+    disturb neither the q pipeline nor the tile parity).
+
+    ``fused_ingest`` accepts ``True`` (defaults for both arrays) or a
+    mapping with optional ``"pos_offsets"`` / ``"kv_bases"`` ([B] int
+    arrays)."""
     chunk_tokens = pages_per_chunk * page_size
+    ingest = bool(fused_ingest) if not isinstance(fused_ingest, dict) \
+        else True
+    if ingest:
+        opts = fused_ingest if isinstance(fused_ingest, dict) else {}
+        nB = len(qo_indptr) - 1
+        pos_offsets = np.asarray(
+            opts.get("pos_offsets")
+            if opts.get("pos_offsets") is not None else np.zeros(nB),
+            np.int64)
+        kv_bases = np.asarray(
+            opts.get("kv_bases")
+            if opts.get("kv_bases") is not None
+            else np.concatenate(
+                [[0], np.cumsum(np.asarray(kv_lens, np.int64))])[:-1],
+            np.int64)
     if mask_flat is not None:
         causal = False  # MaskMode::CUSTOM replaces causal (window ANDs)
         mask_bits, mask_native, mask_total_bits, mask_offsets = \
@@ -236,11 +284,13 @@ def build_prefill_work_units(
     # ---- classify + prune (canonical index kept for the native-mask
     #      row selection) ---------------------------------------------------
     # unit: [qstart, rowlo, rowhi, qpos0, kvstart, kvlen, code, pages,
-    #        tile_key, canon_idx]
+    #        tile_key, canon_idx, request]
     units = []
     canon_idx = 0
     n_pruned = 0
     wl = int(window_left)
+    wkv = []  # ingest: 1 on the unit owning each chunk's write-back
+    covered = set()  # ingest: (request, chunk) pairs some kept unit owns
     for ts, rowlo, rowhi, r in spans:
         qs, qe = int(qo_indptr[r]), int(qo_indptr[r + 1])
         kv_len = int(kv_lens[r])
@@ -294,7 +344,12 @@ def build_prefill_work_units(
             pg = pages[c * pages_per_chunk : (c + 1) * pages_per_chunk]
             pg = np.pad(pg, (0, pages_per_chunk - len(pg)))
             units.append([ts, rowlo, rowhi, qpos0, kvstart, kv_len, code,
-                          pg, ts if packed else (ts, r), ci])
+                          pg, ts if packed else (ts, r), ci, r])
+            if ingest and (r, c) not in covered:
+                covered.add((r, c))
+                wkv.append(1)
+            else:
+                wkv.append(0)
             kept_any = True
         if not kept_any:
             # every chunk pruned (e.g. kv_len == 0): the tile still needs
@@ -302,7 +357,8 @@ def build_prefill_work_units(
             # (attention over the empty set) instead of uninitialized HBM
             units.append([ts, rowlo, rowlo, qpos0, 0, 0, CODE_PARTIAL,
                           np.zeros(pages_per_chunk, np.int64),
-                          ts if packed else (ts, r), -1])
+                          ts if packed else (ts, r), -1, r])
+            wkv.append(0)
 
     # ---- first/wout flags + q slots per tile -----------------------------
     first = [0] * len(units)
@@ -321,6 +377,39 @@ def build_prefill_work_units(
     if units:
         wout[-1] = 1
 
+    # ---- ingest: write-only units for chunks attention never kept ----
+    # (window / custom-mask pruning can drop a chunk from EVERY tile;
+    # its raw K/V still must reach the cache).  Prepended AFTER the
+    # flag pass with first=wout=0 so they fetch no q, write no output,
+    # and leave the tile parity untouched; qstart <= the first real
+    # unit's keeps the ascending-order invariant.
+    n_write_only = 0
+    if ingest:
+        wo_units = []
+        for r in range(B):
+            kv_len = int(kv_lens[r])
+            if kv_len <= 0:
+                continue
+            for c in range(cdiv(kv_len, chunk_tokens)):
+                if (r, c) in covered:
+                    continue
+                pages = kv_page_indices[
+                    int(kv_page_indptr[r]) : int(kv_page_indptr[r + 1])
+                ]
+                pg = pages[c * pages_per_chunk : (c + 1) * pages_per_chunk]
+                pg = np.pad(pg, (0, pages_per_chunk - len(pg)))
+                wo_units.append(
+                    [units[0][0] if units else 0, 0, 0, 0,
+                     c * chunk_tokens, kv_len, CODE_WRITE_ONLY, pg,
+                     None, -1, r])
+        n_write_only = len(wo_units)
+        if wo_units:
+            units = wo_units + units
+            first = [0] * n_write_only + first
+            wout = [0] * n_write_only + wout
+            qslot = [0] * n_write_only + qslot
+            wkv = [1] * n_write_only + wkv
+
     # the (unpacked) partial-tile write-back rewrite depends on ascending
     # qstart order; packed tiles are disjoint but keep the same ordering
     starts = [u[0] for u in units]
@@ -337,29 +426,36 @@ def build_prefill_work_units(
         U = max(int(num_units_pad), 1)
     else:
         U = max(next_power_of_two(max(n_real, 1)), 8)
+    n_mxu = n_real - n_write_only  # write-only units run no MXU dot
     stats = {
         "units": n_real,
         "units_canonical": canon_idx,
         "units_pruned": n_pruned,
         "tiles": tile_ord + 1,
         "packed": bool(packed),
-        "unit_rows_total": n_real * block_q,
+        "unit_rows_total": n_mxu * block_q,
         "unit_rows_valid": int(sum(u[2] - u[1] for u in units)),
-        "mxu_cells_total": n_real * block_q * chunk_tokens,
+        "mxu_cells_total": n_mxu * block_q * chunk_tokens,
         "mxu_cells_valid": int(sum(
             (u[2] - u[1]) * max(min(chunk_tokens, u[5] - u[4]), 0)
             for u in units
         )),
     }
+    if ingest:
+        stats["ingest_write_only_units"] = n_write_only
+        # chunks the ingest launch writes back (== the append traffic
+        # the cost model prices): one owner unit per (request, chunk)
+        stats["ingest_chunks"] = int(sum(wkv))
     # pad units: first=0 (no q fetch/wait), wout=0 (never write), empty
     # row span + kvlen 0 (identity online-softmax steps)
     pad_unit = [0, 0, 0, 0, 0, 0, CODE_PARTIAL,
-                np.zeros(pages_per_chunk, np.int64), None, -1]
+                np.zeros(pages_per_chunk, np.int64), None, -1, -1]
     while len(units) < U:
         units.append(pad_unit)
         first.append(0)
         wout.append(0)
         qslot.append(0)
+        wkv.append(0)
 
     arr = lambda i, dt: np.asarray([u[i] for u in units], dt)
     plan = dict(
@@ -374,6 +470,16 @@ def build_prefill_work_units(
         pages_per_chunk=pages_per_chunk,
         stats=stats,
     )
+    if ingest:
+        # per-unit raw-row base + global-position offset (pad units and
+        # kv-less fallbacks read harmless row 0 / offset 0)
+        plan["kvbase"] = np.asarray(
+            [int(kv_bases[u[10]]) if u[10] >= 0 else 0 for u in units],
+            np.int32)
+        plan["posoff"] = np.asarray(
+            [int(pos_offsets[u[10]]) if u[10] >= 0 else 0 for u in units],
+            np.int32)
+        plan["wkv"] = np.asarray(wkv, np.int32)
     if mask_flat is not None:
         plan["mask_bytes"] = _build_unit_masks(
             units, U, qo_indptr, kv_lens, mask_bits, mask_native,
@@ -411,7 +517,8 @@ def _build_unit_masks(units, U, qo_indptr, kv_lens, mask_bits, mask_native,
                 out[i] = canon[u[9]]
         return out
     for i, u in enumerate(units):
-        ts, rowlo, rowhi, _qpos0, kvstart, kv_len, _code, _pg, key, ci = u
+        ts, rowlo, rowhi, _qpos0, kvstart, kv_len, _code, _pg, key, ci = \
+            u[:10]
         if ci < 0 or rowhi <= rowlo or kv_len <= kvstart:
             continue
         r = key[1] if isinstance(key, tuple) else None
@@ -432,6 +539,401 @@ def _build_unit_masks(units, U, qo_indptr, kv_lens, mask_bits, mask_native,
         packed_tile = np.packbits(tile, axis=-1, bitorder="little")
         out[i, :, : packed_tile.shape[-1]] = packed_tile
     return out
+
+
+def build_prefill_ingest_units(
+    qo_indptr: np.ndarray,
+    kv_page_indptr: np.ndarray,
+    kv_page_indices: np.ndarray,
+    kv_lens: np.ndarray,
+    block_q: int,
+    pages_per_chunk: int,
+    page_size: int,
+    mask_flat: Optional[np.ndarray] = None,
+    mask_total_bits: Optional[int] = None,
+    *,
+    causal: bool = True,
+    window_left: int = -1,
+    pack_tiles: bool = True,
+    prune: bool = True,
+    num_units_pad: Optional[int] = None,
+    fused_ingest=True,
+):
+    """The ingest-mode planner entry (the L007 ``PLANNER_KERNELS`` name
+    for :func:`_fused_prefill_ingest_kernel`): the same work-unit plan
+    machinery as :func:`build_prefill_work_units` with ``fused_ingest``
+    forced on, re-emitted as one explicit dict so the analyzer's
+    consumed-keys-vs-emitted-keys contract stays statically decidable
+    against THIS function (docs/static_analysis.md L007)."""
+    base = build_prefill_work_units(
+        qo_indptr, kv_page_indptr, kv_page_indices, kv_lens,
+        block_q, pages_per_chunk, page_size, mask_flat, mask_total_bits,
+        causal=causal, window_left=window_left, pack_tiles=pack_tiles,
+        prune=prune, num_units_pad=num_units_pad,
+        fused_ingest=fused_ingest,
+    )
+    plan = dict(
+        qstart=base["qstart"], rowlo=base["rowlo"], rowhi=base["rowhi"],
+        qpos0=base["qpos0"], kvstart=base["kvstart"], kvlen=base["kvlen"],
+        first=base["first"], wout=base["wout"], qslot=base["qslot"],
+        code=base["code"], pages=base["pages"], kvbase=base["kvbase"],
+        posoff=base["posoff"], wkv=base["wkv"],
+        num_units=base["num_units"], block_q=base["block_q"],
+        pages_per_chunk=base["pages_per_chunk"], stats=base["stats"],
+    )
+    if "mask_bytes" in base:
+        plan["mask_bytes"] = base["mask_bytes"]
+    return plan
+
+
+def ingest_pages_per_chunk(page_size: int) -> int:
+    """The ~512-KV-row DMA chunk recipe every fused-ingest adopter
+    shares (``EngineKernelGeom.build``, ``MixedServingStep.plan``, the
+    rope reroute) — ONE place to retune the chunk width so the three
+    launch sites can never drift onto different tile geometry for the
+    same hardware."""
+    return max(1, min(512 // int(page_size), 16))
+
+
+def ingest_block_q(max_rows: int) -> int:
+    """The qo-tile recipe shared with :func:`ingest_pages_per_chunk`:
+    a pow2 tile, no wider than 128 or the qo axis."""
+    from flashinfer_tpu.utils import next_power_of_two
+
+    return min(128, next_power_of_two(max(int(max_rows), 1)))
+
+
+def _fused_prefill_ingest_kernel(
+    # scalar prefetch (the ingest plan: the 11 base arrays + kvbase /
+    # posoff / wkv — see build_prefill_work_units(fused_ingest=...))
+    qstart_ref, rowlo_ref, rowhi_ref, qpos0_ref, kvstart_ref, kvlen_ref,
+    first_ref, wout_ref, qslot_ref, code_ref, pages_ref, kvbase_ref,
+    posoff_ref, wkv_ref,
+    *refs,
+    bq: int,
+    ppc: int,
+    page_size: int,
+    group: int,
+    head_dim: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    causal: bool,
+    num_units: int,
+    has_mask: bool,
+    return_lse: bool,
+    attend: bool,
+    rope_scale: float,
+    rope_theta: float,
+    rope_interleave: bool,
+    kv_quant: str,
+    k_scale: float,
+    v_scale: float,
+):
+    """The fused-INGEST work-unit mainloop (ISSUE 14 tentpole): the same
+    pipelined online-softmax walk as :func:`_fused_prefill_kernel`, but
+    K/V stream as RAW pre-RoPE rows from one flat axis (ONE contiguous
+    DMA per chunk — raw rows are request-contiguous, no page gather on
+    the read side), RoPE is applied in-register (q at its plan row
+    provenance ``posoff + qpos0 + row``, each KV chunk at its global
+    positions ``posoff + kvstart + col`` — bitwise the XLA
+    ``rotate_at_positions`` math), K/V quantize to the cache storage
+    dtype with exactly the quant-append formulas
+    (``append_paged_kv_cache_quant_{int8,fp8}``; passthrough caches cast
+    bit-untouched), and each chunk's finished pages DMA OUT to the paged
+    cache from its single ``wkv`` owner unit — so prefill's KV cache
+    traffic is one raw read + one quantized-page write, with attention
+    consuming the in-register values instead of re-reading HBM.
+
+    Attention consumes the QUANTIZED codes (dequant rides the caller's
+    scale folding, the decode kernels' contract), so the output is
+    bitwise the separate-op composition's on every cache dtype, not
+    just within the quant bound.  ``attend=False`` is the append-only
+    form (the ``rope_quantize_fp8_append_paged_kv_cache`` reroute): no
+    q operand, no softmax, just the rotate-quantize-append stream.
+
+    Write-back granularity is whole pages: rows of a chunk's last
+    partially-filled page past ``kvlen`` are written as ZERO codes (a
+    deterministic value; the composed append preserves prior bits
+    there, but those rows sit beyond the request's sequence and are
+    rewritten by any later append before they can be read).
+
+    NOTE for the on-chip session: the in-kernel rotation slices the
+    lane dim at ``head_dim // 2`` (and stride-2 for interleave) —
+    interpret-proven; Mosaic lane-slice support at 64 needs the first
+    hardware run before this kernel leaves the committed tier."""
+    i = 0
+    q_hbm = refs[0] if attend else None
+    i += 1 if attend else 0
+    k_hbm, v_hbm = refs[i], refs[i + 1]
+    i += 2
+    mask_ref = refs[i] if has_mask else None
+    i += 1 if has_mask else 0
+    i += 2  # aliased k/v cache INPUT refs: unread (writes go to the
+    #         aliased outputs; aliasing only preserves untouched pages)
+    o_hbm = refs[i] if attend else None
+    i += 1 if attend else 0
+    kc_out, vc_out = refs[i], refs[i + 1]
+    i += 2
+    lse_hbm = refs[i] if return_lse else None
+    i += 1 if return_lse else 0
+    (qbuf, kbuf, vbuf, obuf, acc_ref, m_ref, l_ref, kqbuf, vqbuf,
+     qsem, ksem, vsem, osem, kwsem, vwsem, lsebuf, lsesem) = refs[i:]
+    hkv = pl.program_id(0)
+    u = pl.program_id(1)
+    chunk_tokens = ppc * page_size
+    bqg = bq * group
+    half = head_dim // 2
+
+    # trace-time constant inverse frequencies — the _rope_freqs formula
+    # verbatim (so the in-kernel rotation is bitwise rotate_at_positions)
+    # on a [1, half] 2-D iota (Mosaic has no 1-D iota)
+    inv = 1.0 / (rope_scale * rope_theta ** (
+        2.0 * jax.lax.broadcasted_iota(jnp.float32, (1, half), 1)
+        / head_dim))
+
+    def _rot(x, pos):
+        """RoPE x [rows, head_dim] at integer positions [rows, 1] —
+        the _apply_rotary math op for op (f32 compute, cast back)."""
+        xf = x.astype(jnp.float32)
+        ang = pos.astype(jnp.float32) * inv
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        if rope_interleave:
+            x1, x2 = xf[:, 0::2], xf[:, 1::2]
+            rot = jnp.stack(
+                [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+            ).reshape(xf.shape)
+        else:
+            x1, x2 = xf[:, :half], xf[:, half:]
+            rot = jnp.concatenate(
+                [x1 * c - x2 * s, x2 * c + x1 * s], -1)
+        return rot.astype(x.dtype)
+
+    if kv_quant == "int8":
+        def _quant(x, scale):  # quantize_symmetric_int8, verbatim
+            return jnp.clip(
+                jnp.round(x.astype(jnp.float32) / scale), -127, 127
+            ).astype(kc_out.dtype)
+    elif kv_quant == "fp8":
+        _finfo = jnp.finfo(kc_out.dtype)
+
+        def _quant(x, scale):  # append_paged_kv_cache_quant_fp8, verbatim
+            return jnp.clip(
+                x.astype(jnp.float32) / scale, float(_finfo.min),
+                float(_finfo.max)).astype(kc_out.dtype)
+    else:
+        def _quant(x, scale):  # passthrough: the cache-dtype cast only
+            return x.astype(kc_out.dtype)
+
+    def kv_dmas(unit, slot):
+        # ONE contiguous DMA per chunk and tensor: raw rows live at
+        # [kvbase + kvstart, +chunk) of the flat axis — no page walk
+        src = kvbase_ref[unit] + kvstart_ref[unit]
+        return [
+            pltpu.make_async_copy(
+                k_hbm.at[hkv, pl.ds(src, chunk_tokens)], kbuf.at[slot],
+                ksem.at[slot]),
+            pltpu.make_async_copy(
+                v_hbm.at[hkv, pl.ds(src, chunk_tokens)], vbuf.at[slot],
+                vsem.at[slot]),
+        ]
+
+    def q_dma(unit, slot):
+        return pltpu.make_async_copy(
+            q_hbm.at[hkv, pl.ds(qstart_ref[unit], bq)],
+            qbuf.at[slot], qsem.at[slot],
+        )
+
+    nxt = jnp.minimum(u + 1, num_units - 1)
+
+    if attend:
+        @pl.when(jnp.logical_and(u == 0, first_ref[0] == 1))
+        def _():
+            q_dma(0, qslot_ref[0]).start()
+
+    @pl.when(u == 0)
+    def _():
+        for d in kv_dmas(0, 0):
+            d.start()
+
+    if attend:
+        @pl.when(jnp.logical_and(u + 1 < num_units, first_ref[nxt] == 1))
+        def _():
+            q_dma(nxt, qslot_ref[nxt]).start()
+
+    @pl.when(u + 1 < num_units)
+    def _():
+        for d in kv_dmas(nxt, jax.lax.rem(u + 1, 2)):
+            d.start()
+
+    slot = jax.lax.rem(u, 2)
+    qslot = qslot_ref[u]
+
+    if attend:
+        @pl.when(first_ref[u] == 1)
+        def _():
+            q_dma(u, qslot).wait()
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+    for d in kv_dmas(u, slot):
+        d.wait()
+
+    # ---- the ingest core: rotate + quantize this chunk in-register ----
+    kv_pos = (posoff_ref[u] + kvstart_ref[u]
+              + jax.lax.broadcasted_iota(jnp.int32, (chunk_tokens, 1), 0))
+    krot = _rot(kbuf[slot], kv_pos)
+    kq = _quant(krot, k_scale)
+    vq = _quant(vbuf[slot], v_scale)
+
+    if attend:
+        # per-unit q rotation at absolute positions posoff + qpos0 +
+        # row: rows outside [rowlo, rowhi) rotate at a neighbouring
+        # request's offset but contribute only masked identity steps
+        # (CODE_FULL tiles span one request, so every row is correct);
+        # recomputing per chunk instead of once per tile keeps the plan
+        # at 14 scalars and the VPU work fully DMA-overlapped
+        rows_q = jax.lax.broadcasted_iota(jnp.int32, (bqg, 1), 0) // group
+        qm = qbuf[qslot].reshape(bqg, head_dim)
+        qrot = _rot(qm, posoff_ref[u] + qpos0_ref[u] + rows_q)
+        kd = kq if kq.dtype == qrot.dtype else kq.astype(qrot.dtype)
+        vd = vq if vq.dtype == qrot.dtype else vq.astype(qrot.dtype)
+        s = jax.lax.dot_general(
+            qrot, kd, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if logits_soft_cap > 0.0:
+            s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+
+        def online_update(valid):
+            s_ = s if valid is None else jnp.where(valid, s, _NEG_INF)
+            m_prev = m_ref[...][:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1,
+                                                keepdims=True))
+            p = jnp.exp(s_ - m_new)
+            if valid is not None:
+                p = jnp.where(valid, p, 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[...] = jnp.broadcast_to(
+                alpha * l_ref[...][:, :1] + jnp.sum(p, -1, keepdims=True),
+                (bqg, 128),
+            )
+            pv = jax.lax.dot_general(
+                p.astype(vd.dtype), vd, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, (bqg, 128))
+
+        def bounds_valid():
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (1, chunk_tokens), 1)
+            q_pos = qpos0_ref[u] + rows_q
+            kv_po = kvstart_ref[u] + cols
+            valid = (
+                (rows_q >= rowlo_ref[u]) & (rows_q < rowhi_ref[u])
+                & (kv_po < kvlen_ref[u])
+            )
+            if causal:
+                valid = valid & (kv_po <= q_pos)
+            if window_left >= 0:
+                valid = valid & (kv_po >= q_pos - window_left)
+            return valid
+
+        def mask_bits():
+            mb = mask_ref.shape[-1]
+            bytes_f = mask_ref[...].astype(jnp.int32).astype(jnp.float32)
+            sel = (
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (mb, chunk_tokens), 1) // 8
+                == jax.lax.broadcasted_iota(
+                    jnp.int32, (mb, chunk_tokens), 0)
+            ).astype(jnp.float32)
+            byte_col = jax.lax.dot_general(
+                bytes_f, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            shift = jax.lax.broadcasted_iota(
+                jnp.int32, (1, chunk_tokens), 1
+            ) % 8
+            bit = (byte_col.astype(jnp.int32) >> shift) & 1
+            return jnp.broadcast_to(
+                (bit > 0).reshape(bq, 1, chunk_tokens),
+                (bq, group, chunk_tokens),
+            ).reshape(bqg, chunk_tokens)
+
+        code = code_ref[u]
+
+        @pl.when(code == CODE_FULL)
+        def _():
+            online_update(None)
+
+        if has_mask:
+            @pl.when(code == CODE_PARTIAL)
+            def _():
+                online_update(bounds_valid())
+
+            @pl.when(code == CODE_PARTIAL_MASK)
+            def _():
+                online_update(bounds_valid() & mask_bits())
+        else:
+            @pl.when(code == CODE_PARTIAL)
+            def _():
+                online_update(bounds_valid())
+
+        @pl.when(wout_ref[u] == 1)
+        def _():
+            l = l_ref[...][:, :1]
+            o = (acc_ref[...] / jnp.where(l > 0, l, 1.0)).astype(
+                obuf.dtype)
+            obuf[...] = o.reshape(obuf.shape)
+            out_dma = pltpu.make_async_copy(
+                obuf, o_hbm.at[hkv, pl.ds(qstart_ref[u], bq)], osem)
+            out_dma.start()
+            out_dma.wait()
+            if return_lse:
+                m = m_ref[...][:, :1]
+                lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
+                lsebuf[...] = jnp.broadcast_to(lse, (bqg, 128)).reshape(
+                    lsebuf.shape)
+                lse_dma = pltpu.make_async_copy(
+                    lsebuf, lse_hbm.at[hkv, pl.ds(qstart_ref[u], bq)],
+                    lsesem)
+                lse_dma.start()
+                lse_dma.wait()
+
+    # ---- the append write-back: this unit owns the chunk's pages ----
+    # (exactly one wkv unit per (request, chunk); rows past kvlen in
+    # the last partial page write deterministic zero codes)
+    @pl.when(wkv_ref[u] == 1)
+    def _():
+        w = kvlen_ref[u] - kvstart_ref[u]
+        keep = jax.lax.broadcasted_iota(
+            jnp.int32, (chunk_tokens, 1), 0) < w
+        kqbuf[...] = jnp.where(keep, kq, jnp.zeros_like(kq))
+        vqbuf[...] = jnp.where(keep, vq, jnp.zeros_like(vq))
+
+        def page_dmas(j):
+            page = pages_ref[u * ppc + j]
+            dst = pl.ds(j * page_size, page_size)
+            return [
+                pltpu.make_async_copy(
+                    kqbuf.at[dst], kc_out.at[page, hkv], kwsem.at[j]),
+                pltpu.make_async_copy(
+                    vqbuf.at[dst], vc_out.at[page, hkv], vwsem.at[j]),
+            ]
+
+        for j in range(ppc):
+            @pl.when(kvstart_ref[u] + j * page_size < kvlen_ref[u])
+            def _(j=j):
+                for d in page_dmas(j):
+                    d.start()
+        for j in range(ppc):
+            @pl.when(kvstart_ref[u] + j * page_size < kvlen_ref[u])
+            def _(j=j):
+                for d in page_dmas(j):
+                    d.wait()
 
 
 def _fused_prefill_kernel(
@@ -842,3 +1344,197 @@ def fused_paged_prefill(
     ret = (result,) + ((lse,) if return_lse else ())
     ret = ret + ((events,) if trace_events else ())
     return ret if len(ret) > 1 else result
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_units", "block_q", "pages_per_chunk", "sm_scale",
+        "logits_soft_cap", "window_left", "causal", "return_lse",
+        "attend", "rope_scale", "rope_theta", "rope_interleave",
+        "kv_quant", "k_scale", "v_scale",
+    ),
+)
+def fused_paged_prefill_ingest(
+    q: Optional[jax.Array],  # [tq_pad, H, D] PRE-PADDED; None if attend=False
+    k_new: jax.Array,  # [total_kv, Hkv, D] RAW pre-RoPE rows, flat axis
+    v_new: jax.Array,  # [total_kv, Hkv, D]
+    k_cache: jax.Array,  # [pages, Hkv, page_size, D] (HND) — ALIASED out
+    v_cache: jax.Array,
+    plan: dict,  # jnp arrays from build_prefill_ingest_units
+    *,
+    num_units: int,
+    block_q: int = 128,
+    pages_per_chunk: int = 8,
+    sm_scale: float = 1.0,
+    logits_soft_cap: float = 0.0,
+    window_left: int = -1,
+    causal: bool = True,
+    return_lse: bool = False,
+    attend: bool = True,
+    rope_scale: float = 1.0,
+    rope_theta: float = 1e4,
+    rope_interleave: bool = False,
+    kv_quant: str = "none",  # "none" | "int8" | "fp8"
+    k_scale: float = 1.0,  # quant-append scales: high_precision = code * scale
+    v_scale: float = 1.0,
+):
+    """Fused prefill INGEST launch: RoPE + KV-quantize-append folded
+    into the work-unit prefill mainloop (ISSUE 14 tentpole; the TPU
+    analogue of the reference's ``rope_quantize_fp8_append_paged_kv_
+    cache`` fused op, rope.py:1504, EXTENDED through attention).
+
+    Consumes RAW pre-RoPE q / k / v; returns the attention output over
+    the rotated values AND the updated caches holding exactly the bits
+    ``append_paged_kv_cache_quant_{int8,fp8}`` (or a plain cast append)
+    would have written — the caches are input/output ALIASED, so under
+    caller donation the append happens in place.  ``sm_scale`` is the
+    PLAIN softmax scale: the launcher folds ``k_scale`` into it and
+    applies ``v_scale`` to the output for quantized caches (the decode
+    kernels' scale-folding contract), so callers pass reference
+    semantics.  ``attend=False`` is the append-only form: no q, no
+    output — returns just the updated ``(k_cache, v_cache)``.
+
+    Rotation covers the FULL head_dim (``rotary_dim == head_dim``);
+    partial-rotary callers stay on the separate-op composition."""
+    total_kv, Hkv, D = k_new.shape
+    page_size = k_cache.shape[2]
+    chunk_tokens = pages_per_chunk * page_size
+    mask_bytes = plan.get("mask_bytes")
+    has_mask = mask_bytes is not None
+    if has_mask:
+        causal = False  # MaskMode::CUSTOM replaces causal (window ANDs)
+    # pad raw rows so full-chunk DMAs at the tail stay in bounds, and
+    # lay both out [Hkv, tkv, D] so the per-chunk DMA indexes the head
+    k_pad = jnp.transpose(
+        jnp.pad(k_new, ((0, chunk_tokens), (0, 0), (0, 0))), (1, 0, 2))
+    v_pad = jnp.transpose(
+        jnp.pad(v_new, ((0, chunk_tokens), (0, 0), (0, 0))), (1, 0, 2))
+    if attend:
+        total_q, H, _ = q.shape
+        group = H // Hkv
+        qdtype = q.dtype
+        q_op = jnp.transpose(
+            jnp.pad(q, ((0, block_q), (0, 0), (0, 0))).reshape(
+                total_q + block_q, Hkv, group, D), (1, 0, 2, 3))
+    else:
+        total_q, group, qdtype, q_op = 0, 1, k_new.dtype, None
+    sm_eff = float(sm_scale) * (float(k_scale) if kv_quant != "none"
+                                else 1.0)
+
+    in_specs = []
+    operands = []
+    if attend:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(q_op)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    operands += [k_pad, v_pad]
+    if has_mask:
+        mb = mask_bytes.shape[-1]
+        in_specs.append(pl.BlockSpec(
+            (None, block_q, mb), lambda h, u, *prefetch: (u, 0, 0)))
+        operands.append(mask_bytes)
+    # the aliased cache inputs ride LAST so their flat input indices are
+    # a fixed function of the operand list length
+    kc_in_idx = 14 + len(in_specs)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    operands += [k_cache, v_cache]
+
+    out_specs = []
+    out_shape = []
+    if attend:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(jax.ShapeDtypeStruct(
+            (Hkv, total_q + block_q, group, D), qdtype))
+    kc_out_idx = len(out_specs)
+    out_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)]
+    out_shape += [
+        jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+        jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+    ]
+    if return_lse:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(jax.ShapeDtypeStruct(
+            (Hkv, total_q + block_q, group, 128), jnp.float32))
+    aliases = {kc_in_idx: kc_out_idx, kc_in_idx + 1: kc_out_idx + 1}
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=14,
+        grid=(Hkv, num_units),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((2, block_q, group, D) if attend else (1, 1, 1, 1),
+                       qdtype),
+            pltpu.VMEM((2, chunk_tokens, D), k_new.dtype),
+            pltpu.VMEM((2, chunk_tokens, D), v_new.dtype),
+            pltpu.VMEM((block_q, group, D) if attend else (1, 1, 1),
+                       qdtype),
+            pltpu.VMEM((block_q * group, D) if attend else (1, 128),
+                       jnp.float32),
+            pltpu.VMEM((block_q * group, 128) if attend else (1, 128),
+                       jnp.float32),
+            pltpu.VMEM((block_q * group, 128) if attend else (1, 128),
+                       jnp.float32),
+            pltpu.VMEM((chunk_tokens, D), k_cache.dtype),
+            pltpu.VMEM((chunk_tokens, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((pages_per_chunk,)),
+            pltpu.SemaphoreType.DMA((pages_per_chunk,)),
+            pltpu.VMEM((block_q, group, 128) if return_lse
+                       else (1, 1, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _fused_prefill_ingest_kernel,
+            bq=block_q, ppc=pages_per_chunk, page_size=page_size,
+            group=group, head_dim=D, sm_scale=sm_eff,
+            logits_soft_cap=logits_soft_cap, window_left=window_left,
+            causal=causal, num_units=num_units, has_mask=has_mask,
+            return_lse=return_lse, attend=attend,
+            rope_scale=rope_scale, rope_theta=rope_theta,
+            rope_interleave=rope_interleave, kv_quant=kv_quant,
+            k_scale=float(k_scale), v_scale=float(v_scale),
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=64 * 1024 * 1024,
+            has_side_effects=True,
+        ),
+        interpret=use_interpret(),
+        input_output_aliases=aliases,
+    )(
+        plan["qstart"], plan["rowlo"], plan["rowhi"], plan["qpos0"],
+        plan["kvstart"], plan["kvlen"], plan["first"], plan["wout"],
+        plan["qslot"], plan["code"], plan["pages"], plan["kvbase"],
+        plan["posoff"], plan["wkv"],
+        *operands,
+    )
+    if not attend:
+        kc2, vc2 = out
+        return kc2, vc2
+    if return_lse:
+        o_raw, kc2, vc2, lse_raw = out
+    else:
+        o_raw, kc2, vc2 = out
+    result = jnp.transpose(o_raw[:, :total_q], (1, 0, 2, 3)).reshape(
+        total_q, H, D)
+    if kv_quant != "none":
+        # the quantized-cache scale-folding epilogue: v codes attended,
+        # real output = codes-output * v_scale (linear in V, so exact)
+        result = (result.astype(jnp.float32) * float(v_scale)).astype(
+            qdtype)
+    if return_lse:
+        lse = jnp.transpose(lse_raw[:, :total_q, :, 0], (1, 0, 2)).reshape(
+            total_q, H)
+        return result, lse, (kc2, vc2)
+    return result, (kc2, vc2)

@@ -420,6 +420,40 @@ class _PrefillPlan:
     # pos_encoding_mode="ROPE_LLAMA": (rope_scale, rope_theta) — q/k are
     # rotated at plan positions in run() (any backend)
     rope: Optional[Tuple[float, float]] = None
+    # ISSUE 14 ingest-mode plan static: True = run_ingest() launches the
+    # fused RoPE+quantize-append+attention kernel, False = it composes
+    # the separate ops, None = resolve lazily (knob -> cost-model
+    # chooser) on first run_ingest()
+    fused_ingest: Optional[bool] = None
+
+
+def resolve_prefill_ingest(
+    fused_key, *, total_q: int, total_kv: int, num_qo_heads: int,
+    num_kv_heads: int, head_dim: int, q_bytes: int = 2,
+    kv_bytes: int = 2, cache_bytes: int = 2,
+) -> bool:
+    """Resolve the ``prefill.fused_ingest`` knob for one shape: a
+    shipped/tuned config entry wins; absent entries default via the
+    cost-model chooser (``costmodel.predict_prefill_ingest_win`` — the
+    ``choose_decode_splits`` pattern: the fused launch must beat the
+    separate-op composition by >2% predicted time or the proven
+    composition stays).  THE single resolution point — the wrapper,
+    MixedServingStep, and the engine all route here so the knob can
+    never mean different things per surface."""
+    from flashinfer_tpu.autotuner import AutoTuner
+
+    v = AutoTuner.get().lookup("prefill.fused_ingest", fused_key,
+                               default=None)
+    if v is not None:
+        return str(v) == "on"
+    from flashinfer_tpu.obs import costmodel, hwspec
+
+    spec = hwspec.current_spec()
+    use, _ = costmodel.predict_prefill_ingest_win(
+        total_q, total_kv, num_qo_heads, num_kv_heads, head_dim,
+        hbm_tbps=spec.hbm_tbps, peak_tflops=spec.peak_tflops("bf16"),
+        q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes)
+    return use
 
 
 def _build_token_axis(
@@ -657,6 +691,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
         self._backend = normalize_backend(backend)
         self._plan: Optional[_PrefillPlan] = None
         self._fused_plan = None  # work-unit plan for backend="pallas_fused"
+        self._ingest_plan = None  # lazy ingest-mode plan (run_ingest)
 
     def plan(
         self,
@@ -679,6 +714,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
         kv_data_type=None,
         rope_scale: Optional[float] = None,
         rope_theta: Optional[float] = None,
+        fused_ingest: Optional[bool] = None,
         **_unused,
     ) -> None:
         check_pos_encoding_mode(pos_encoding_mode)  # typos raise KeyError
@@ -688,6 +724,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
             (rope_scale or 1.0, rope_theta or 1e4)
             if pos_encoding_mode == "ROPE_LLAMA" else None
         )
+        self._ingest_plan = None  # rebuilt lazily per plan geometry
         qo_indptr = np.asarray(qo_indptr)
         kv_indptr_pages = np.asarray(paged_kv_indptr)
         kv_indices = np.asarray(paged_kv_indices)
@@ -838,6 +875,7 @@ class BatchPrefillWithPagedKVCacheWrapper:
                 causal=causal, sm_scale=get_sm_scale(head_dim, sm_scale),
                 logits_soft_cap=logits_soft_cap or 0.0,
                 window_left=window_left,
+                fused_ingest=fused_ingest,
             )
         else:
             self._fused_plan = None
@@ -1179,6 +1217,202 @@ class BatchPrefillWithPagedKVCacheWrapper:
         return out[: plan.total_q]
 
     forward = run
+
+    def _resolve_ingest(self) -> bool:
+        """The plan's ``fused_ingest`` static, resolved at most once:
+        an explicit plan(fused_ingest=) wins; None routes through
+        :func:`resolve_prefill_ingest` (knob -> cost-model chooser).
+        The resolution is frozen back onto the plan so the flight
+        recorder's replan diffs see which mode served."""
+        plan = self._plan
+        if plan.fused_ingest is None:
+            fkey = self._fused_raw[5]
+            resolved = resolve_prefill_ingest(
+                fkey, total_q=plan.total_q, total_kv=plan.total_kv,
+                num_qo_heads=plan.num_qo_heads,
+                num_kv_heads=plan.num_kv_heads, head_dim=plan.head_dim)
+            import dataclasses
+
+            self._plan = plan = dataclasses.replace(
+                plan, fused_ingest=resolved)
+        return bool(plan.fused_ingest)
+
+    def _ingest_positions(self):
+        """Host-side (q_pos, kv_pos, kv_req) of the planned geometry —
+        the separate-op composition's rotation/append coordinates
+        (kv positions 0..kv_len-1 per request: run_ingest serves the
+        from-scratch prefill form, where the raw rows ARE the kv)."""
+        qo_i, _, _, kvl_i = self._fused_raw[:4]
+        B = len(qo_i) - 1
+        qo_lens = (qo_i[1:] - qo_i[:-1]).astype(np.int64)
+        kvl = np.asarray(kvl_i, np.int64)
+        kv_pos = np.concatenate(
+            [np.arange(n) for n in kvl] or [np.zeros(0)]).astype(np.int32)
+        kv_req = np.repeat(np.arange(B), kvl).astype(np.int32)
+        q_pos = np.concatenate(
+            [np.arange(n) + (kvl[r] - n)
+             for r, n in enumerate(qo_lens)] or [np.zeros(0)]
+        ).astype(np.int32)
+        return q_pos, kv_pos, kv_req
+
+    def run_ingest(
+        self,
+        q: jax.Array,  # [total_q, num_qo_heads, head_dim] RAW (pre-RoPE)
+        k_new: jax.Array,  # [total_kv, num_kv_heads, head_dim] RAW
+        v_new: jax.Array,
+        paged_kv_cache: Tuple[jax.Array, jax.Array],
+        *,
+        rope_scale: float = 1.0,
+        rope_theta: float = 1e4,
+        rope_interleave: bool = False,
+        k_scale: Optional[float] = None,
+        v_scale: Optional[float] = None,
+        return_lse: bool = False,
+    ):
+        """Fused prefill INGEST (ISSUE 14): RoPE + KV-quantize-append +
+        attention over RAW pre-RoPE q/k/v in one launch.  The raw k/v
+        rows ARE the planned KV axis (from-scratch prefill: positions
+        0..kv_len-1 per request); returns ``(out, (k_cache, v_cache))``
+        (+ ``lse`` in the middle with ``return_lse``) with the caches
+        updated to exactly the bits the separate rotate -> quant-append
+        composition writes (bit-for-bit, tests/test_prefill_ingest.py;
+        rows past each sequence's end in its last partial page are
+        deterministically zeroed — see fused_paged_prefill_ingest).
+
+        Dispatch follows the ``fused_ingest`` plan static (explicit
+        plan(fused_ingest=), else knob -> chooser): OFF composes the
+        separate ops through the SAME entry point — the oracle tier —
+        so A/B and fallback share one call shape.  ``k_scale`` /
+        ``v_scale`` are the quant-append scales (high = code * scale)
+        and are REQUIRED for int8/fp8 caches."""
+        plan = self._plan
+        if plan is None:
+            raise RuntimeError("plan() must be called before run_ingest()")
+        if self._fused_plan is None:
+            raise NotImplementedError(
+                "run_ingest needs the fused work-unit path (HND layout, "
+                "no ALIBI/ROPE_LLAMA plan mode, TPU or "
+                "FLASHINFER_TPU_BACKEND=pallas) — this plan resolved to "
+                "the gather fallback")
+        k_cache, v_cache = paged_kv_cache
+        kv_quant = (
+            "int8" if k_cache.dtype == jnp.int8 else
+            "fp8" if k_cache.dtype in (jnp.float8_e4m3fn, jnp.float8_e5m2)
+            else "none")
+        if kv_quant != "none" and (k_scale is None or v_scale is None):
+            raise ValueError(
+                f"{kv_quant} KV cache needs explicit k_scale/v_scale "
+                "(the quant-append scales: high_precision = code * scale)")
+        ks = float(k_scale) if k_scale is not None else 1.0
+        vs = float(v_scale) if v_scale is not None else 1.0
+        if k_new.shape[0] != plan.total_kv:
+            raise ValueError(
+                f"k_new has {k_new.shape[0]} raw rows; the plan's kv "
+                f"axis is {plan.total_kv} tokens (run_ingest ingests "
+                "the WHOLE planned KV — from-scratch prefill)")
+
+        if self._resolve_ingest():
+            from flashinfer_tpu import compile_guard
+            from flashinfer_tpu.ops import paged_prefill as _pp_module
+            from flashinfer_tpu.ops.paged_prefill import (
+                build_prefill_ingest_units, fused_paged_prefill_ingest,
+            )
+
+            _, statics = self._fused_plan
+            if self._ingest_plan is None:
+                (qo_i, kvp_i, kvi_i, kvl_i, ps, _fkey, mflat, mbits,
+                 causal_p, wl_p) = self._fused_raw
+                up = build_prefill_ingest_units(
+                    qo_i, kvp_i, kvi_i, kvl_i,
+                    block_q=statics["block_q"],
+                    pages_per_chunk=statics["pages_per_chunk"],
+                    page_size=ps, mask_flat=mflat, mask_total_bits=mbits,
+                    causal=causal_p, window_left=wl_p,
+                )
+                ist = dict(
+                    num_units=up.pop("num_units"),
+                    block_q=up.pop("block_q"),
+                    pages_per_chunk=up.pop("pages_per_chunk"),
+                )
+                self._ingest_stats = up.pop("stats")
+                self._ingest_plan = (
+                    {k2: jnp.asarray(v2) for k2, v2 in up.items()}, ist)
+            unit_plan, ist = self._ingest_plan
+            total_q = q.shape[0]
+            if total_q != plan.tq_pad:
+                q = jnp.pad(q, ((0, plan.tq_pad - total_q), (0, 0),
+                                (0, 0)))
+            try:
+                res = compile_guard.guarded(
+                    "fused_paged_prefill_ingest",
+                    (q.shape, k_new.shape, str(q.dtype),
+                     str(k_cache.dtype), plan.causal, plan.window_left,
+                     float(plan.sm_scale), float(plan.logits_soft_cap),
+                     rope_scale, rope_theta, rope_interleave, kv_quant,
+                     ks, vs, return_lse,
+                     "mask_bytes" in unit_plan,
+                     tuple(sorted(ist.items()))),
+                    lambda: fused_paged_prefill_ingest(
+                        q, k_new, v_new, k_cache, v_cache, unit_plan,
+                        sm_scale=plan.sm_scale,
+                        logits_soft_cap=plan.logits_soft_cap,
+                        window_left=plan.window_left, causal=plan.causal,
+                        return_lse=return_lse, rope_scale=rope_scale,
+                        rope_theta=rope_theta,
+                        rope_interleave=rope_interleave,
+                        kv_quant=kv_quant, k_scale=ks, v_scale=vs,
+                        **ist,
+                    ),
+                    module=_pp_module,
+                )
+                if return_lse:
+                    out, lse, caches = res
+                    return out[:total_q], lse[:total_q], caches
+                out, caches = res
+                return out[:total_q], caches
+            except compile_guard.KernelQuarantined:
+                q = q[:total_q]  # fall through to the composed oracle
+
+        # ---- the separate-op composition (the oracle tier) ----
+        from flashinfer_tpu.page import (
+            append_paged_kv_cache, append_paged_kv_cache_quant_fp8,
+            append_paged_kv_cache_quant_int8,
+        )
+        from flashinfer_tpu.rope import rotate_at_positions_static
+
+        q_pos, kv_pos, kv_req = self._ingest_positions()
+        # static-scale/theta rotation — bitwise what the ingest kernel
+        # computes (rotate_at_positions_static docstring: a traced
+        # theta's runtime pow would drift the oracle ~1 ULP)
+        q_rot = rotate_at_positions_static(
+            q, jnp.asarray(q_pos), rope_scale=rope_scale,
+            rope_theta=rope_theta, interleave=rope_interleave)
+        k_rot = rotate_at_positions_static(
+            k_new, jnp.asarray(kv_pos), rope_scale=rope_scale,
+            rope_theta=rope_theta, interleave=rope_interleave)
+        kvi = jnp.asarray(self._fused_raw[2])
+        kvp = jnp.asarray(self._fused_raw[1])
+        if kv_quant == "int8":
+            caches = append_paged_kv_cache_quant_int8(
+                k_rot, v_new, jnp.asarray(kv_req), jnp.asarray(kv_pos),
+                (k_cache, v_cache), kvi, kvp, jnp.float32(ks),
+                jnp.float32(vs), self._kv_layout)
+        elif kv_quant == "fp8":
+            caches = append_paged_kv_cache_quant_fp8(
+                k_rot, v_new, jnp.asarray(kv_req), jnp.asarray(kv_pos),
+                (k_cache, v_cache), kvi, kvp, jnp.float32(ks),
+                jnp.float32(vs), self._kv_layout)
+        else:
+            caches = append_paged_kv_cache(
+                k_rot, v_new, jnp.asarray(kv_req), jnp.asarray(kv_pos),
+                (k_cache, v_cache), kvi, kvp, None, self._kv_layout)
+        scale_kw = {}
+        if kv_quant != "none":
+            scale_kw = dict(k_scale=ks, v_scale=vs)
+        res = self.run(q_rot, caches, return_lse=return_lse, **scale_kw)
+        if return_lse:
+            return res[0], res[1], caches
+        return res, caches
 
     def run_return_lse(self, q, paged_kv_cache, **kw):
         """Reference ``run_return_lse`` (prefill.py:4075, partialmethod

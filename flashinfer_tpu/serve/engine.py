@@ -371,6 +371,15 @@ class EngineConfig:
     # lowering (serve/engine_kernels.py; interpret-mode off-TPU)
     attention_backend: str = "reference"
     decode_num_splits: int = 1      # kernel tier's split-KV factor
+    # prefill.fused_ingest (ISSUE 14): "on" = kernel-tier from-scratch
+    # prefill steps ride the fused RoPE + quantize-append + attention
+    # ingest launch (serve/engine_kernels.engine_kernel_ingest_
+    # attention; value-level lax.cond dispatch, so one-trace-per-rung
+    # holds); "off" = the composed rope -> scatter -> cascade tier.
+    # Ignored under the reference backend (the oracle tier stays
+    # composed by contract).  from_knobs resolves absent entries via
+    # the costmodel.predict_prefill_ingest_win chooser.
+    fused_ingest: str = "off"
     # tiered-KV statics (serve/kv_tier.py): the engine's ROLE in a
     # disaggregated pair ("prefill" keeps finished KV pages alive for
     # the kv_migrate handoff; "decode" accepts migrated continuations;
@@ -414,8 +423,33 @@ class EngineConfig:
             host_gib=float(t.lookup("engine.host_gib", key, default=4)),
         )
         knobs.update(over)
-        return EngineConfig(num_pages=num_pages,
-                            max_seq_tokens=max_seq_tokens, **knobs)
+        cfg = EngineConfig(num_pages=num_pages,
+                           max_seq_tokens=max_seq_tokens, **knobs)
+        if "fused_ingest" not in over \
+                and cfg.attention_backend == "kernel":
+            # shape-key the ingest knob the way the prefill wrapper
+            # does (batch, tq_pad, H, Hkv, D, page_size) at the
+            # ladder's top rung — the from-scratch prefill step the
+            # fusion serves; resolve_prefill_ingest is the shared
+            # knob -> cost-model-chooser resolution point
+            from flashinfer_tpu.prefill import resolve_prefill_ingest
+
+            top = max(cfg.rungs())
+            kv_bytes = jnp.dtype(
+                cfg.kv_dtype if cfg.kv_dtype is not None
+                else model_cfg.dtype).itemsize
+            use = resolve_prefill_ingest(
+                (cfg.max_batch, top, model_cfg.num_qo_heads,
+                 model_cfg.num_kv_heads, model_cfg.head_dim,
+                 cfg.page_size),
+                total_q=top, total_kv=top,
+                num_qo_heads=model_cfg.num_qo_heads,
+                num_kv_heads=model_cfg.num_kv_heads,
+                head_dim=model_cfg.head_dim,
+                cache_bytes=int(kv_bytes))
+            cfg = dataclasses.replace(
+                cfg, fused_ingest="on" if use else "off")
+        return cfg
 
     def pages_per_req(self) -> int:
         return -(-self.max_seq_tokens // self.page_size)
@@ -472,6 +506,9 @@ class ServingEngine:
         if config.role not in ("prefill", "decode", "unified"):
             raise ValueError(f"role must be prefill|decode|unified, "
                              f"got {config.role!r}")
+        if config.fused_ingest not in ("off", "on"):
+            raise ValueError(f"fused_ingest must be 'off' or 'on', "
+                             f"got {config.fused_ingest!r}")
         if config.kv_offload not in ("off", "host"):
             raise ValueError(f"kv_offload must be off|host, "
                              f"got {config.kv_offload!r}")
@@ -548,6 +585,10 @@ class ServingEngine:
             "decode_pages_real": 0, "decode_pages_launched": 0,
             "kv_pairs_launched": 0.0, "kv_rows_launched": 0.0,
         }
+        # fused ingest is a kernel-tier concept: the reference backend
+        # is the composed oracle by contract, so "on" there is inert
+        self._ingest = (self._kernel_backend
+                        and config.fused_ingest == "on")
         if self._kernel_backend:
             from flashinfer_tpu.serve.engine_kernels import EngineKernelGeom
 
@@ -559,6 +600,7 @@ class ServingEngine:
                 head_dim=model_cfg.head_dim,
                 kv_itemsize=kv_dtype.itemsize,
                 num_splits=config.decode_num_splits,
+                fused_ingest=self._ingest,
             )
         self._build_step()
 
@@ -989,6 +1031,8 @@ class ServingEngine:
 
         kernel_backend = self._kernel_backend
         geom = self._geom
+        use_ingest = self._ingest
+        sm_plain = 1.0 / float(mcfg.head_dim) ** 0.5
 
         def _body(params, flat_tokens, positions, tok_req, token_page,
                   token_slot, page_table, grp_pages, tok_grp, split,
@@ -1012,60 +1056,98 @@ class ServingEngine:
                     T, mcfg.num_kv_heads, mcfg.head_dim)
                 v = _mm(h, layer, "v_proj", pre).reshape(
                     T, mcfg.num_kv_heads, mcfg.head_dim)
-                q, k = apply_rope_pos_ids(q, k, positions,
-                                          rope_theta=mcfg.rope_theta)
                 kc, vc = caches[li]
-                if int8_kv:
-                    from flashinfer_tpu.quantization import (
-                        quantize_symmetric_int8)
 
-                    k_w = quantize_symmetric_int8(k, mcfg.kv_k_scale)
-                    v_w = quantize_symmetric_int8(v, mcfg.kv_v_scale)
-                else:
-                    k_w = k.astype(kc.dtype)
-                    v_w = v.astype(vc.dtype)
-                # pad lanes scatter into the scratch page (pool page 0)
-                kc = kc.at[token_page, :, token_slot, :].set(k_w)
-                vc = vc.at[token_page, :, token_slot, :].set(v_w)
-                new_caches.append((kc, vc))
-                if kernel_backend:
-                    # the graduated path: the same two-level cascade,
-                    # but level 1 rides the work-unit prefill mainloop
-                    # + split-KV decode units and level 0 the
-                    # group-masked prefill plan — all composed by the
-                    # same merge fold (serve/engine_kernels.py)
+                def _composed_attn(q, k, v, kc, vc):
+                    """The ONE composed sequence — rope -> quantize ->
+                    scatter-append -> attend -> v-scale epilogue.  The
+                    non-ingest path and the ingest cond's false branch
+                    run exactly this function, so a fix to the
+                    quantize/scale/scatter logic can never reach one
+                    fused_ingest setting and miss the other."""
+                    q, k = apply_rope_pos_ids(q, k, positions,
+                                              rope_theta=mcfg.rope_theta)
+                    if int8_kv:
+                        from flashinfer_tpu.quantization import (
+                            quantize_symmetric_int8)
+
+                        k_w = quantize_symmetric_int8(k, mcfg.kv_k_scale)
+                        v_w = quantize_symmetric_int8(v, mcfg.kv_v_scale)
+                    else:
+                        k_w = k.astype(kc.dtype)
+                        v_w = v.astype(vc.dtype)
+                    # pad lanes scatter into the scratch page (pool
+                    # page 0)
+                    kc = kc.at[token_page, :, token_slot, :].set(k_w)
+                    vc = vc.at[token_page, :, token_slot, :].set(v_w)
+                    if kernel_backend:
+                        # the graduated path: the same two-level
+                        # cascade, but level 1 rides the work-unit
+                        # prefill mainloop + split-KV decode units and
+                        # level 0 the group-masked prefill plan — all
+                        # composed by the same merge fold
+                        # (serve/engine_kernels.py)
+                        from flashinfer_tpu.serve.engine_kernels import (
+                            engine_kernel_attention)
+
+                        o = engine_kernel_attention(
+                            q, kc, vc, kplans, geom=geom,
+                            sm_scale=sm_scale)
+                    else:
+                        # the dense XLA oracle tier (interpret-mode
+                        # reference): position-determined windows
+                        # attended through masked lanes — O(T x K) but
+                        # bitwise-provable vs the no-sharing oracle on
+                        # CPU
+                        # level 1: the request's own window, rows
+                        # [split, pos]
+                        k1 = _window(kc, page_table)[tok_req]
+                        v1 = _window(vc, page_table)[tok_req]
+                        o1, lse1 = _attend(q, k1, v1, split, positions)
+                        # level 0: the SHARED prefix run, gathered once
+                        # per group slot, rows [0, min(split, pos + 1))
+                        # — causal by position so a leader mid-prefill
+                        # never sees ahead
+                        k0 = _window(kc, grp_pages)[tok_grp]
+                        v0 = _window(vc, grp_pages)[tok_grp]
+                        hi0 = jnp.minimum(split - 1, positions)
+                        o0, lse0 = _attend(q, k0, v0,
+                                           jnp.zeros_like(split), hi0)
+                        # cascade composition (reference cascade.cuh
+                        # merge): empty levels pass through exactly via
+                        # the lse guard
+                        o, _ = compose_cascade_levels([(o0, lse0),
+                                                       (o1, lse1)])
+                    if int8_kv:
+                        o = o * mcfg.kv_v_scale
+                    return o.astype(mcfg.dtype), kc, vc
+
+                if use_ingest:
+                    # ISSUE 14: per-step VALUE dispatch between the
+                    # fused-ingest launch and the composed cascade —
+                    # lax.cond, so both branches live in the SAME
+                    # per-rung program and the one-trace-per-rung
+                    # budget is untouched.  ingest_on certifies a
+                    # from-scratch prefill schedule (level 0 + decode
+                    # structurally empty) at plan-build time.
                     from flashinfer_tpu.serve.engine_kernels import (
-                        engine_kernel_attention)
+                        engine_kernel_ingest_attention)
 
-                    o = engine_kernel_attention(
-                        q, kc, vc, kplans, geom=geom, sm_scale=sm_scale)
+                    def _ingest_branch(q, k, v, kc, vc):
+                        return engine_kernel_ingest_attention(
+                            q, k, v, kc, vc, kplans, geom=geom,
+                            sm_scale=sm_plain,
+                            rope_theta=mcfg.rope_theta,
+                            kv_quant="int8" if int8_kv else "none",
+                            k_scale=mcfg.kv_k_scale if int8_kv else 1.0,
+                            v_scale=mcfg.kv_v_scale if int8_kv else 1.0)
+
+                    attn, kc, vc = jax.lax.cond(
+                        kplans["ingest_on"] > 0, _ingest_branch,
+                        _composed_attn, q, k, v, kc, vc)
                 else:
-                    # the dense XLA oracle tier (interpret-mode
-                    # reference): position-determined windows attended
-                    # through masked lanes — O(T x K) but bitwise-
-                    # provable vs the no-sharing oracle on CPU
-                    # level 1: the request's own window, rows
-                    # [split, pos]
-                    k1 = _window(kc, page_table)[tok_req]
-                    v1 = _window(vc, page_table)[tok_req]
-                    o1, lse1 = _attend(q, k1, v1, split, positions)
-                    # level 0: the SHARED prefix run, gathered once per
-                    # group slot, rows [0, min(split, pos + 1)) —
-                    # causal by position so a leader mid-prefill never
-                    # sees ahead
-                    k0 = _window(kc, grp_pages)[tok_grp]
-                    v0 = _window(vc, grp_pages)[tok_grp]
-                    hi0 = jnp.minimum(split - 1, positions)
-                    o0, lse0 = _attend(q, k0, v0, jnp.zeros_like(split),
-                                       hi0)
-                    # cascade composition (reference cascade.cuh
-                    # merge): empty levels pass through exactly via the
-                    # lse guard
-                    o, _ = compose_cascade_levels([(o0, lse0),
-                                                   (o1, lse1)])
-                if int8_kv:
-                    o = o * mcfg.kv_v_scale
-                attn = o.astype(mcfg.dtype)
+                    attn, kc, vc = _composed_attn(q, k, v, kc, vc)
+                new_caches.append((kc, vc))
                 x = x + _mm(attn.reshape(T, -1), layer,
                             "o_proj").astype(mcfg.dtype)
                 h2 = rmsnorm(x, layer["post_norm"], mcfg.rms_eps)

@@ -454,6 +454,11 @@ class _MixedPlan:
     sampling: SamplingConfig
     donate: bool
     backend: str  # eagerly-resolved attention backend ("pallas"|"xla")
+    # ISSUE 14: True = the step's per-layer RoPE + KV-quantize-append +
+    # attention ride ONE fused-ingest launch (from-scratch prefill
+    # steps only — every request at kv_before == 0); False = the
+    # rope -> scatter-append -> gather-attend composition
+    fused_ingest: bool = False
 
 
 class MixedServingStep:
@@ -499,6 +504,7 @@ class MixedServingStep:
         sampling: SamplingConfig = SamplingConfig(),
         donate: bool = True,
         backend: str = "auto",
+        fused_ingest: Optional[bool] = None,
     ) -> None:
         from flashinfer_tpu import obs
         from flashinfer_tpu.attention import BatchAttention
@@ -510,6 +516,18 @@ class MixedServingStep:
         if np.any(qo_lens < 1):
             raise ValueError("every request advances >= 1 token per "
                              "mixed step")
+        # ISSUE 14 fused-ingest adoption: eligible iff this step is a
+        # from-scratch prefill (every request at kv_before == 0 — the
+        # first mixed step of a batch, where prefill cost concentrates);
+        # None resolves via the prefill.fused_ingest knob -> cost-model
+        # chooser (THE shared resolution point, prefill.py)
+        ingest_eligible = bool(np.all(kv0 == 0)) and len(qo_lens) > 0
+        if fused_ingest and not ingest_eligible:
+            raise ValueError(
+                "fused_ingest=True needs a from-scratch prefill step "
+                "(every kv_lens_before == 0): chunked continuations "
+                "attend cached prefixes the ingest kernel does not "
+                "re-read — keep the composed step for them")
         B = len(qo_lens)
         qo_indptr = np.concatenate([[0], np.cumsum(qo_lens)]) \
             .astype(np.int32)
@@ -553,12 +571,63 @@ class MixedServingStep:
         kv_dtype = jnp.dtype(kv_dtype) if kv_dtype is not None \
             else jnp.dtype(cfg.dtype)
         int8_kv = kv_dtype == jnp.int8
+        sm_plain = float(arrays["sm_scale"])
         sm_scale = arrays["sm_scale"] * (cfg.kv_k_scale if int8_kv
                                          else 1.0)
+        # fused-ingest resolution (ISSUE 14): an explicit request wins
+        # (but must be eligible AND on the pallas tier — the ingest
+        # kernel IS the work-unit mainloop); None routes through the
+        # prefill.fused_ingest knob -> cost-model chooser, the same
+        # single resolution point the wrapper uses (prefill.py)
+        if fused_ingest is None:
+            use_ingest = False
+            if ingest_eligible and resolved == "pallas":
+                from flashinfer_tpu.prefill import resolve_prefill_ingest
+
+                fkey = (B, int(arrays["tq_pad"]), cfg.num_qo_heads,
+                        cfg.num_kv_heads, cfg.head_dim, int(page_size))
+                use_ingest = resolve_prefill_ingest(
+                    fkey, total_q=total_q,
+                    total_kv=int(seq_after.sum()),
+                    num_qo_heads=cfg.num_qo_heads,
+                    num_kv_heads=cfg.num_kv_heads,
+                    head_dim=cfg.head_dim,
+                    cache_bytes=int(kv_dtype.itemsize))
+        else:
+            use_ingest = bool(fused_ingest)
+            if use_ingest and resolved != "pallas":
+                raise ValueError(
+                    "fused_ingest=True needs the pallas attention tier "
+                    f"(backend resolved to {resolved!r}) — the ingest "
+                    "kernel is the work-unit prefill mainloop")
+        ingest_plan = None
+        ingest_statics = None
+        if use_ingest:
+            from flashinfer_tpu.ops.paged_prefill import (
+                build_prefill_ingest_units, ingest_block_q,
+                ingest_pages_per_chunk)
+
+            ibq = ingest_block_q(total_q)
+            ippc = ingest_pages_per_chunk(page_size)
+            iplan_np = build_prefill_ingest_units(
+                qo_indptr.astype(np.int64), kvp_indptr, kvp_idx,
+                seq_after.astype(np.int64),
+                block_q=ibq, pages_per_chunk=ippc,
+                page_size=int(page_size), causal=True,
+            )
+            ingest_statics = dict(
+                num_units=iplan_np.pop("num_units"),
+                block_q=iplan_np.pop("block_q"),
+                pages_per_chunk=iplan_np.pop("pages_per_chunk"),
+            )
+            iplan_np.pop("stats")
+            ingest_plan = {k2: jnp.asarray(v2)
+                           for k2, v2 in iplan_np.items()}
         self._plan = _MixedPlan(
             cfg=cfg, batch_size=B, total_q=total_q,
             page_size=int(page_size), kv_dtype=str(kv_dtype),
             sampling=sampling, donate=bool(donate), backend=resolved,
+            fused_ingest=use_ingest,
         )
         plan = self._plan
         self._traces = 0
@@ -619,22 +688,44 @@ class MixedServingStep:
                     total_q, cfg.num_kv_heads, cfg.head_dim)
                 v = _mm(h, layer, "v_proj", pre).reshape(
                     total_q, cfg.num_kv_heads, cfg.head_dim)
-                q, k = apply_rope_pos_ids(q, k, j_positions,
-                                          rope_theta=cfg.rope_theta)
                 kc, vc = caches[li]
-                if int8_kv:
-                    from flashinfer_tpu.quantization import (
-                        quantize_symmetric_int8)
+                if use_ingest:
+                    # ISSUE 14 fused ingest: RoPE + quantize-append +
+                    # attention in ONE work-unit launch over the RAW
+                    # q/k/v — the scatter-append and the cache re-read
+                    # below disappear.  The launcher owns the int8
+                    # scale folding (k into sm, v on the output), so
+                    # it gets the PLAIN sm_scale and the raw scales
+                    from flashinfer_tpu.ops.paged_prefill import (
+                        fused_paged_prefill_ingest)
 
-                    k_w = quantize_symmetric_int8(k, cfg.kv_k_scale)
-                    v_w = quantize_symmetric_int8(v, cfg.kv_v_scale)
+                    attn, (kc, vc) = fused_paged_prefill_ingest(
+                        q, k, v, kc, vc, ingest_plan,
+                        sm_scale=sm_plain, causal=True,
+                        rope_theta=float(cfg.rope_theta),
+                        kv_quant="int8" if int8_kv else "none",
+                        k_scale=float(cfg.kv_k_scale) if int8_kv
+                        else 1.0,
+                        v_scale=float(cfg.kv_v_scale) if int8_kv
+                        else 1.0,
+                        **ingest_statics,
+                    )
                 else:
-                    k_w = k.astype(kc.dtype)
-                    v_w = v.astype(vc.dtype)
-                kc = kc.at[j_token_page, :, j_token_slot, :].set(k_w)
-                vc = vc.at[j_token_page, :, j_token_slot, :].set(v_w)
+                    q, k = apply_rope_pos_ids(q, k, j_positions,
+                                              rope_theta=cfg.rope_theta)
+                    if int8_kv:
+                        from flashinfer_tpu.quantization import (
+                            quantize_symmetric_int8)
+
+                        k_w = quantize_symmetric_int8(k, cfg.kv_k_scale)
+                        v_w = quantize_symmetric_int8(v, cfg.kv_v_scale)
+                    else:
+                        k_w = k.astype(kc.dtype)
+                        v_w = v.astype(vc.dtype)
+                    kc = kc.at[j_token_page, :, j_token_slot, :].set(k_w)
+                    vc = vc.at[j_token_page, :, j_token_slot, :].set(v_w)
+                    attn = _attend(q, kc, vc)
                 new_caches.append((kc, vc))
-                attn = _attend(q, kc, vc)
                 x = x + _mm(attn.reshape(total_q, -1), layer,
                             "o_proj").astype(cfg.dtype)
                 h2 = rmsnorm(x, layer["post_norm"], cfg.rms_eps)

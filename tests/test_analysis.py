@@ -940,8 +940,10 @@ def test_l007_flags_injected_num_scalar_prefetch_skew():
 
 
 def test_l007_flags_dropped_plan_array_operand():
-    """Dropping one plan array from the launch invocation (10 operands
-    vs num_scalar_prefetch=11) must fail."""
+    """Dropping one plan array from the launch invocation must fail.
+    The operand prefix is shared by BOTH work-unit launchers (the
+    attention launch and the ISSUE 14 ingest launch), so the mutation
+    breaks both and each must flag independently."""
     real = open(OPS_PREFILL).read()
     drop = real.replace(
         'plan["qslot"], plan["code"], plan["pages"],',
@@ -950,8 +952,10 @@ def test_l007_flags_dropped_plan_array_operand():
     from flashinfer_tpu.analysis import pallas_contract
 
     findings = pallas_contract.run(_prefill_project(drop))
-    assert [f.code for f in findings] == ["L007"], findings
-    assert "passes 10 plan array(s)" in findings[0].message
+    assert [f.code for f in findings] == ["L007", "L007"], findings
+    assert {f.func for f in findings} == {
+        "fused_paged_prefill", "fused_paged_prefill_ingest"}, findings
+    assert all("plan array(s)" in f.message for f in findings)
 
 
 def test_l007_flags_plan_key_the_planner_never_emits():
