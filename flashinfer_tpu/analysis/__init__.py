@@ -46,9 +46,28 @@ L010  kernel_init_guard      accumulator refs written only under
                              first-step-EXCLUDING pl.when guards (no
                              step-0 init: stale-scratch numerics), and
                              out-of-range input_output_aliases
+L011  donation_lifetime      donated-buffer lifetime violations at the
+                             compile-once serving steps: use-after-
+                             donate, donated args the jitted body also
+                             closes over, and the both-or-neither
+                             in/out-shardings contract (ISSUE 15)
+L012  static_flow            per-step schedule values flowing into
+                             compile-once statics (frozen plan fields,
+                             plan-shape planner kwargs, jit
+                             static_argnums/static_argnames,
+                             trace-keying branches) —
+                             the static complement of the PR 10
+                             retrace-cause attribution
+L013  registry_coverage      registry completeness: every KNOWN_KNOBS
+                             knob bound in KNOB_LAUNCHES or explicitly
+                             waived, every plan-consuming kernel's
+                             planner in PLANNER_KERNELS, and the obs
+                             span/cost-family catalogs complete (the
+                             one implementation ``obs doctor``
+                             delegates to)
 ====  =====================  ==========================================
 
-L007–L010 are interprocedural: they resolve planners/kernels through
+L007–L013 are interprocedural: they resolve planners/kernels through
 the project symbol index in ``core.py``, so the planner in one module
 and the kernel in another are checked as one contract.
 
@@ -79,11 +98,12 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Set, Tuple
 
-from flashinfer_tpu.analysis import (alias_rebind, jit_staticness,
-                                     kernel_init_guard, obs_coverage,
-                                     pallas_contract, signature_parity,
-                                     tracer_leak, tuning_schema,
-                                     vmem_budget, wedge)
+from flashinfer_tpu.analysis import (alias_rebind, donation_lifetime,
+                                     jit_staticness, kernel_init_guard,
+                                     obs_coverage, pallas_contract,
+                                     registry_coverage, signature_parity,
+                                     static_flow, tracer_leak,
+                                     tuning_schema, vmem_budget, wedge)
 from flashinfer_tpu.analysis import sarif as sarif_mod
 from flashinfer_tpu.analysis.core import (Finding, Project,  # noqa: F401
                                           SourceFile, iter_python_files,
@@ -98,7 +118,8 @@ __all__ = [
 
 PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
           obs_coverage, tuning_schema, pallas_contract, tracer_leak,
-          vmem_budget, kernel_init_guard)
+          vmem_budget, kernel_init_guard, donation_lifetime,
+          static_flow, registry_coverage)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")

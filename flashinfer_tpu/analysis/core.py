@@ -311,6 +311,14 @@ def _walk_defs(tree: ast.Module):
     return out
 
 
+# basenames that compile a step body into a compile-once callable —
+# the ONE registry the serving-contract passes (L011 donation_lifetime,
+# L012 static_flow) share, so a future compile wrapper registered here
+# is seen by both (registering it in only one pass would silently
+# under-report in the other)
+JIT_LIKE_NAMES = frozenset({"jit", "compile_step_with_plan"})
+
+
 def expr_basename(expr: ast.expr) -> str:
     """Last dotted component: ``pltpu.PrefetchScalarGridSpec`` ->
     ``PrefetchScalarGridSpec``; bare names return themselves."""

@@ -1,0 +1,334 @@
+"""L013 — registry completeness: the silent-skip extension points closed.
+
+PR 4 documented two deliberate soft spots: an autotuner knob with no
+``KNOB_LAUNCHES`` binding silently skips the L009 VMEM proof, and a
+planner/kernel pair missing from ``PLANNER_KERNELS`` silently skips the
+L007 plan-array contract.  Both were fine while the registries were
+young; by the PR 13/14 era (``engine.*`` tier knobs,
+``prefill.fused_ingest``) "silently skipped" is indistinguishable from
+"checked and clean" at review time.  This pass turns coverage itself
+into a lint invariant:
+
+1. **Knob coverage.**  Every knob in ``autotuner.KNOWN_KNOBS`` must
+   have a ``vmem_budget.KNOB_LAUNCHES`` binding or an explicit
+   ``vmem_budget.KNOB_WAIVERS`` entry with a reason (host-side /
+   scheduler-only knobs have no VMEM launch by design — the waiver
+   SAYS so, reviewably).  A reasonless waiver, a waiver shadowing a
+   real binding, and a waiver for an unregistered knob are all
+   findings.  Findings anchor to the knob's ``register_knob(...)``
+   call (or the stale waiver's registry), so the fix site is one
+   click away.
+2. **Planner coverage.**  A ``PrefetchScalarGridSpec`` launch whose
+   leading operands are ``plan["key"]`` subscripts is consuming a
+   host planner's plan arrays; if its resolved kernel is bound to no
+   ``PLANNER_KERNELS`` entry, the whole L007 planner contract skips
+   it.  Additionally any statically-resolvable ``build_*`` project
+   function whose emitted keys cover the consumed set must itself be
+   registered — matched only for launches consuming >= 3 plan keys (a
+   deliberate noise floor: one- or two-key overlaps with a generic
+   ``build_*`` helper are coincidence, not a planner relationship).
+3. **Obs registry coverage.**  The scattered ``obs doctor`` checks —
+   ``catalog.SERVING_OPS`` vs ``spans.SPAN_CATEGORIES`` (every serving
+   op opens a span), ``catalog.API_OPS`` vs ``costmodel.API_OP_COSTS``
+   (every public op roofline-attributes) — unify HERE as the one
+   implementation; the doctor delegates to
+   :func:`unspanned_serving_ops` / :func:`uncovered_api_ops` and its
+   output is unchanged.  Stale entries (a span category or cost family
+   for an op the catalog no longer lists, an invalid span category)
+   are findings too — a stale registry silently shrinks the observed
+   surface.
+
+Registry checks are gated on the project actually containing the
+defining module (``register_knob`` calls for 1, ``obs/spans.py`` /
+``obs/costmodel.py`` for 3), so synthetic test projects and
+``--changed-only`` subsets can only under-report, never false-fail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from flashinfer_tpu.analysis.core import (Finding, Project,
+                                          expr_basename, project_relpath)
+
+CODE = "L013"
+
+
+# -- live-registry views (the ONE implementation obs doctor delegates to) --
+
+
+def unspanned_serving_ops() -> List[str]:
+    """Serving ops that declare no flight-recorder span category — the
+    ``obs doctor`` ``spans.unspanned_serving_ops`` field (must stay
+    empty; the L005 ships-observed rule extended to the span layer)."""
+    from flashinfer_tpu.obs.catalog import SERVING_OPS
+    from flashinfer_tpu.obs.spans import SPAN_CATEGORIES
+
+    return sorted(SERVING_OPS - set(SPAN_CATEGORIES))
+
+
+def uncovered_api_ops() -> Tuple[str, ...]:
+    """Decorated public ops with no cost-model family — the ``obs
+    doctor`` ``costmodel.uncovered_api_ops`` field (must stay empty)."""
+    from flashinfer_tpu.obs.catalog import API_OPS
+    from flashinfer_tpu.obs.costmodel import API_OP_COSTS
+
+    return tuple(sorted(API_OPS - set(API_OP_COSTS)))
+
+
+def unbound_knobs(knobs: Optional[Dict] = None,
+                  launches: Optional[Dict] = None,
+                  waivers: Optional[Dict] = None) -> List[str]:
+    """Registered knobs with neither a KNOB_LAUNCHES binding nor an
+    explicit waiver — the gaps check 1 reports (must stay empty)."""
+    if knobs is None:
+        from flashinfer_tpu.autotuner import KNOWN_KNOBS as knobs
+    if launches is None:
+        from flashinfer_tpu.analysis.vmem_budget import \
+            KNOB_LAUNCHES as launches
+    if waivers is None:
+        from flashinfer_tpu.analysis.vmem_budget import \
+            KNOB_WAIVERS as waivers
+    return sorted(set(knobs) - set(launches) - set(waivers))
+
+
+# -- finding anchors ------------------------------------------------------
+
+
+def _register_knob_lines(project: Project) -> Dict[str, Tuple[str, int]]:
+    """knob name -> (file, line) of its ``register_knob("name", ...)``
+    call in the analyzed set; empty when the registry module is not in
+    the project (subset runs skip check 1)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Call) \
+                    and expr_basename(n.func) == "register_knob" \
+                    and n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                out[n.args[0].value] = (sf.path, n.lineno)
+    return out
+
+
+def _assign_line(project: Project, relpath: str,
+                 name: str) -> Optional[Tuple[str, int]]:
+    """(file, line) of the top-level ``name = ...`` / ``name: T = ...``
+    assignment in the project file at `relpath`, if analyzed."""
+    for sf in project.files:
+        if sf.tree is None or project_relpath(sf.path) != relpath:
+            continue
+        for n in sf.tree.body:
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, ast.AnnAssign):
+                targets = [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return sf.path, n.lineno
+    return None
+
+
+def _waiver_call_lines(project: Project) -> Dict[str, Tuple[str, int]]:
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Call) \
+                    and expr_basename(n.func) == "waive_knob_launch" \
+                    and n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                out[n.args[0].value] = (sf.path, n.lineno)
+    return out
+
+
+# -- check 1: knob coverage ----------------------------------------------
+
+
+def _check_knobs(project: Project, findings: List[Finding],
+                 knobs: Optional[Dict], launches: Optional[Dict],
+                 waivers: Optional[Dict]) -> None:
+    anchors = _register_knob_lines(project)
+    if not anchors:
+        return  # registry module not analyzed: skip, never guess
+    if knobs is None:
+        from flashinfer_tpu.autotuner import KNOWN_KNOBS as knobs
+    if launches is None:
+        from flashinfer_tpu.analysis.vmem_budget import \
+            KNOB_LAUNCHES as launches
+    if waivers is None:
+        from flashinfer_tpu.analysis.vmem_budget import \
+            KNOB_WAIVERS as waivers
+    waiver_anchors = _waiver_call_lines(project)
+    for knob in unbound_knobs(knobs, launches, waivers):
+        path, line = anchors.get(knob, next(iter(anchors.values())))
+        findings.append(Finding(
+            CODE, path, line, knob,
+            f"knob '{knob}' is registered in KNOWN_KNOBS but has "
+            "neither a KNOB_LAUNCHES binding (the L009 VMEM proof) nor "
+            "an explicit KNOB_WAIVERS entry — an unbound knob's config "
+            "entries are silently skipped by the feasibility check; "
+            "bind the launcher or waive it with a reason "
+            "(analysis/vmem_budget.py)"))
+    for knob, reason in sorted(waivers.items()):
+        anchor = waiver_anchors.get(knob) \
+            or anchors.get(knob, next(iter(anchors.values())))
+        path, line = anchor
+        if not str(reason).strip():
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"KNOB_WAIVERS entry for '{knob}' has no reason — an "
+                "unreviewable waiver is worse than the gap it hides "
+                "(the L000 rule, applied to registries)"))
+        if knob in launches:
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"knob '{knob}' is BOTH bound in KNOB_LAUNCHES and "
+                "waived in KNOB_WAIVERS — delete the stale waiver so "
+                "the binding's proof visibly owns the knob"))
+        if knob not in knobs:
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"KNOB_WAIVERS entry for '{knob}' names no registered "
+                "knob — a renamed/retired knob left a stale waiver; "
+                "prune it"))
+
+
+# -- check 2: planner coverage -------------------------------------------
+
+
+def _check_planners(project: Project, findings: List[Finding],
+                    planner_kernels: Optional[Dict]) -> None:
+    from flashinfer_tpu.analysis.pallas_contract import (
+        _leading_plan_keys, _planner_emitted_keys)
+
+    if planner_kernels is None:
+        from flashinfer_tpu.analysis.pallas_contract import \
+            PLANNER_KERNELS as planner_kernels
+    covered_kernels = set(planner_kernels.values())
+    # (kernel name, consumed keyset) per covered launch — collected
+    # once so the build_* sweep below runs ONCE per planner, not per
+    # site (a planner feeding several launches must flag exactly once
+    # or the count-keyed baseline goes brittle)
+    consumed: List[Tuple[str, Set[str]]] = []
+    for site in project.pallas_sites:
+        if site.kernel is None or not site.is_prefetch_spec:
+            continue
+        keys = _leading_plan_keys(site)
+        if not keys:
+            continue
+        func = site.enclosing.name if site.enclosing else "<module>"
+        if site.kernel.name not in covered_kernels:
+            findings.append(Finding(
+                CODE, site.file.path,
+                site.invocation.lineno if site.invocation else site.line,
+                func,
+                f"kernel '{site.kernel.name}' consumes plan array(s) "
+                f"({', '.join(keys[:4])}{', …' if len(keys) > 4 else ''}) "
+                "but no PLANNER_KERNELS entry binds it — the L007 "
+                "planner contract silently skips this launch; register "
+                "the planner→kernel pair "
+                "(analysis/pallas_contract.py)"))
+            continue
+        if len(keys) >= 3:
+            consumed.append((site.kernel.name, set(keys)))
+    # a resolvable build_* planner whose emission covers the consumed
+    # keys must itself be registered (the engine lowering precedent:
+    # transitively-enforced planners still get entries)
+    for name, infos in sorted(project.function_index.items()):
+        if not name.startswith("build_") or name in planner_kernels:
+            continue
+        for info in infos:
+            emitted = _planner_emitted_keys(info)
+            if emitted is None:
+                continue
+            hit = next((kname for kname, keyset in consumed
+                        if keyset <= emitted), None)
+            if hit is not None:
+                findings.append(Finding(
+                    CODE, info.file.path, info.node.lineno, name,
+                    f"planner '{name}' emits every plan key the "
+                    f"'{hit}' launch consumes but is "
+                    "not in PLANNER_KERNELS — its plan-schema "
+                    "changes would skip the L007 contract; "
+                    "register the pair"))
+                break
+
+
+# -- check 3: obs registry coverage --------------------------------------
+
+_SPANS_RELPATH = "flashinfer_tpu/obs/spans.py"
+_COSTMODEL_RELPATH = "flashinfer_tpu/obs/costmodel.py"
+
+
+def _check_obs_registries(project: Project,
+                          findings: List[Finding]) -> None:
+    spans_anchor = _assign_line(project, _SPANS_RELPATH,
+                                "SPAN_CATEGORIES")
+    costs_anchor = _assign_line(project, _COSTMODEL_RELPATH,
+                                "API_OP_COSTS")
+    if spans_anchor is not None:
+        try:
+            from flashinfer_tpu.obs.catalog import SERVING_OPS
+            from flashinfer_tpu.obs.spans import (SPAN_CATEGORIES,
+                                                  SPAN_CATEGORIES_VALID)
+        except Exception:
+            # broken spans tree: L999/import errors own THIS block;
+            # the independent costmodel check below still runs
+            spans_anchor = None
+    if spans_anchor is not None:
+        path, line = spans_anchor
+        for op in unspanned_serving_ops():
+            findings.append(Finding(
+                CODE, path, line, op,
+                f"serving op '{op}' (catalog.SERVING_OPS) has no "
+                "spans.SPAN_CATEGORIES entry — it would serve whole "
+                "steps the flight recorder cannot trace; declare its "
+                "span category"))
+        for op, cat in sorted(SPAN_CATEGORIES.items()):
+            if op not in SERVING_OPS:
+                findings.append(Finding(
+                    CODE, path, line, op,
+                    f"spans.SPAN_CATEGORIES names '{op}' which is not "
+                    "in catalog.SERVING_OPS — a renamed/retired op "
+                    "left a stale span declaration; prune it"))
+            if cat not in SPAN_CATEGORIES_VALID:
+                findings.append(Finding(
+                    CODE, path, line, op,
+                    f"span category {cat!r} for '{op}' is not in "
+                    "SPAN_CATEGORIES_VALID — the chrome-trace export "
+                    "would carry an undeclared category"))
+    if costs_anchor is not None:
+        try:
+            from flashinfer_tpu.obs.catalog import API_OPS
+            from flashinfer_tpu.obs.costmodel import API_OP_COSTS
+        except Exception:
+            return  # broken costmodel tree: L999/import errors own it
+        path, line = costs_anchor
+        for op in uncovered_api_ops():
+            findings.append(Finding(
+                CODE, path, line, op,
+                f"public op '{op}' (catalog.API_OPS) has no "
+                "costmodel.API_OP_COSTS family — it can bench but "
+                "never roofline-attribute; map it to a cost family"))
+        for op in sorted(set(API_OP_COSTS) - set(API_OPS)):
+            findings.append(Finding(
+                CODE, path, line, op,
+                f"costmodel.API_OP_COSTS names '{op}' which is not in "
+                "catalog.API_OPS — a renamed/retired op left a stale "
+                "cost mapping; prune it"))
+
+
+def run(project: Project, *, knobs: Optional[Dict] = None,
+        launches: Optional[Dict] = None,
+        waivers: Optional[Dict] = None,
+        planner_kernels: Optional[Dict] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_knobs(project, findings, knobs, launches, waivers)
+    _check_planners(project, findings, planner_kernels)
+    _check_obs_registries(project, findings)
+    return findings

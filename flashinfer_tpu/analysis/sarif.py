@@ -31,6 +31,9 @@ RULE_DESCRIPTIONS: Dict[str, str] = {
     "L008": "traced value leaking into Python control flow",
     "L009": "tuning-config blocks exceeding the VMEM budget",
     "L010": "unguarded accumulator init / bad input_output_aliases",
+    "L011": "donated-buffer lifetime violation at a compile-once step",
+    "L012": "per-step schedule value flowing into a compile-once static",
+    "L013": "incomplete knob/planner/obs registry coverage",
     "L999": "unparseable source",
     "W000": "wedge-lint suppression without a reason",
     "W001": "strided-gather lowering wedge",

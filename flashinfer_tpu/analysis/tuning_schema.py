@@ -23,6 +23,17 @@ every named section carrying its own ``"tactics"`` (the schema
 ``autotuner._flatten_config`` consumes).  An unparseable config file is
 itself a finding — the runtime loader swallows it silently by design,
 which is exactly when lint must speak up.
+
+Provenance (ROADMAP item 5, ISSUE 15 satellite): every named section
+must label where its entries came from — ``"provenance"`` in
+:data:`VALID_PROVENANCE` (``seed`` = derived off-chip, ``measured`` =
+banked on-chip winners, ``model-derived`` = cost-model-chosen).  The
+shipped pre-provenance sections carry the legacy ``"seed": true`` flag
+and are grandfathered (only the affirmative ``true`` counts — a
+``"seed": false`` section has disclaimed the label);
+NEW sections with neither are findings — an unlabeled tactic table
+can't be audited against the 0.35x/1.05x poison rules or graduated by
+the hardware session.
 """
 
 from __future__ import annotations
@@ -34,6 +45,10 @@ from typing import Dict, List
 from flashinfer_tpu.analysis.core import Finding, Project
 
 CODE = "L006"
+
+# section provenance labels (ROADMAP item 5): where a tactic table's
+# values came from, so the perf gates know what they may trust
+VALID_PROVENANCE = ("seed", "measured", "model-derived")
 
 
 def _config_paths(project: Project) -> List[str]:
@@ -95,6 +110,43 @@ def run(project: Project) -> List[Finding]:
                 "tuning config root must be a JSON object with a "
                 "'tactics' table"))
             continue
+        # section-level checks run on EVERY named dict section — not
+        # just the ones _tables() admits — so a malformed tactics table
+        # cannot shield a section from the provenance gate (the loader
+        # drops such sections silently, which is exactly when lint must
+        # speak up)
+        for section in sorted(data):
+            sec = data[section]
+            if section == "tactics" or not isinstance(sec, dict):
+                continue
+            if not isinstance(sec.get("tactics"), dict):
+                findings.append(Finding(
+                    CODE, path, _key_line(src, section), section,
+                    f"section {section!r} has no 'tactics' object — "
+                    "the runtime loader drops the whole section "
+                    "silently, so every entry in it is dead"))
+            prov = sec.get("provenance")
+            # only an affirmative `"seed": true` grandfathers — a
+            # section declaring `"seed": false` has disclaimed the
+            # legacy label and must carry real provenance
+            legacy_seed = sec.get("seed") is True
+            if prov is not None and prov not in VALID_PROVENANCE:
+                findings.append(Finding(
+                    CODE, path, _key_line(src, section), section,
+                    f"section provenance {prov!r} is not one of "
+                    f"{list(VALID_PROVENANCE)} — the perf gates "
+                    "cannot classify what these tactics may be "
+                    "trusted for"))
+            elif prov is None and not legacy_seed:
+                findings.append(Finding(
+                    CODE, path, _key_line(src, section), section,
+                    f"section {section!r} carries no provenance "
+                    "label: add \"provenance\": "
+                    "\"seed\"|\"measured\"|\"model-derived\" "
+                    "(the shipped pre-provenance sections are "
+                    "grandfathered via their \"seed\": true "
+                    "flag) — unlabeled tactics cannot be audited "
+                    "or graduated (ROADMAP item 5)"))
         for section, table in _tables(data).items():
             if not isinstance(table, dict):
                 findings.append(Finding(

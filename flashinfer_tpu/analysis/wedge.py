@@ -1,11 +1,11 @@
 """L004 — the wedge-pattern lint, behind the shared analysis driver.
 
 This is the implementation that used to live in
-``flashinfer_tpu/wedge_lint.py`` (that module is now a thin compat shim
-over this one, so ``compile_guard.check_module`` and existing callers
-keep working).  It encodes two real chip-wedge incidents as AST
-heuristics; finding codes keep their original W prefix because they are
-committed in suppressions and docs.
+``flashinfer_tpu/wedge_lint.py`` (that shim is retired — importing it
+raises ``ModuleNotFoundError``; ``compile_guard`` and every caller
+import from here, docs/migration.md).  It encodes two real chip-wedge
+incidents as AST heuristics; finding codes keep their original W
+prefix because they are committed in suppressions and docs.
 
 This project has twice wedged the shared TPU compile server with kernel
 contents that HANG Mosaic (not fail cleanly): round 1 (flash-kernel

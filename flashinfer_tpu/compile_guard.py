@@ -209,9 +209,9 @@ def guarded(
         # static wedge-pattern lint runs once per module per process,
         # BEFORE the first hardware compile: a kernel matching a
         # known-wedging Mosaic pattern refuses to compile in strict mode
-        # (default on real TPU) rather than risking the chip.  Imported
-        # from the analyzer package directly — the wedge_lint module is
-        # a deprecated shim and warns on import
+        # (default on real TPU) rather than risking the chip.  The
+        # wedge lint lives in the analyzer package (the old wedge_lint
+        # shim is retired — docs/migration.md)
         from flashinfer_tpu.analysis import wedge
 
         wedge.check_module(module)

@@ -539,14 +539,26 @@ def cmd_doctor(args) -> int:
         from flashinfer_tpu.obs.catalog import SERVING_OPS
 
         rec = _spans.get_recorder()
+        # delegated to the L013 registry_coverage pass — the ONE
+        # implementation of the span-coverage rule (ISSUE 15); same
+        # sorted-list output as the pre-delegation inline set
+        # difference, byte for byte.  The fallback mirrors the
+        # delegated implementation so the spans section stays alive
+        # when the ANALYSIS package is the broken part of the tree
+        # (importing the pass runs the full package init) — the pass
+        # remains the enforcement point.
+        try:
+            from flashinfer_tpu.analysis import registry_coverage as _rc
+            unspanned = _rc.unspanned_serving_ops()
+        except Exception:
+            unspanned = sorted(SERVING_OPS - set(_spans.SPAN_CATEGORIES))
         report["spans"] = {
             "enabled": obs.spans_enabled(),
             "capacity": rec.capacity,
             "recorded": rec.total,
             "dropped": rec.dropped(),
             "serving_ops": sorted(SERVING_OPS),
-            "unspanned_serving_ops": sorted(
-                SERVING_OPS - set(_spans.SPAN_CATEGORIES)),
+            "unspanned_serving_ops": unspanned,
         }
         report["retrace_causes"] = _spans.top_retrace_causes(snap)
     except Exception as e:  # doctor must never crash on a broken tree

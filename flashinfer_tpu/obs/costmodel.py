@@ -1132,10 +1132,23 @@ API_OP_COSTS: Dict[str, str] = {
 
 
 def uncovered_api_ops() -> Tuple[str, ...]:
-    """Decorated public ops with no cost-model family (doctor check)."""
-    from flashinfer_tpu.obs.catalog import API_OPS
+    """Decorated public ops with no cost-model family (doctor check).
 
-    return tuple(sorted(API_OPS - set(API_OP_COSTS)))
+    Delegates to the L013 ``registry_coverage`` pass — the ONE
+    implementation of the coverage rule, shared by ``obs doctor`` and
+    the static analyzer (ISSUE 15): the lint gate and the doctor can
+    never disagree about what "covered" means.  The fallback mirrors
+    the delegated implementation so this obs-internal surface survives
+    a broken ANALYSIS package (importing the pass runs the full
+    package init); the pass remains the enforcement point."""
+    try:
+        from flashinfer_tpu.analysis.registry_coverage import \
+            uncovered_api_ops as _impl
+    except Exception:
+        from flashinfer_tpu.obs.catalog import API_OPS
+
+        return tuple(sorted(API_OPS - set(API_OP_COSTS)))
+    return _impl()
 
 
 # -- banked-row reconstruction (obs perf on pre-roofline history) ---------

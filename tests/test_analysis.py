@@ -800,19 +800,6 @@ def test_write_baseline_refuses_reasonless_suppression_findings(
     assert all(code != "L000" for code, _, _ in analysis.load_baseline(path))
 
 
-def test_wedge_lint_shim_surface():
-    """compile_guard and the historical tests import these names from
-    flashinfer_tpu.wedge_lint — the shim must keep them working."""
-    from flashinfer_tpu import wedge_lint as wl
-    from flashinfer_tpu.analysis import wedge
-
-    assert wl.lint_source is wedge.lint_source
-    assert wl.check_module is wedge.check_module
-    assert wl.WedgeLintError is wedge.WedgeLintError
-    assert wl.Finding is analysis.Finding
-    assert wl.DOT_UNROLL_LIMIT == wedge.DOT_UNROLL_LIMIT
-
-
 # ------------------------------------------------------ L006 tuning_schema --
 
 
@@ -1772,30 +1759,21 @@ def test_batch_attention_soft_cap_rebind_counted(monkeypatch):
         obs.reset()
 
 
-# --------------------------------- satellite: wedge_lint shim --
+# ----------------------------- satellite: wedge_lint shim retired --
 
 
-def test_wedge_lint_import_warns_deprecation():
-    import importlib
-    import sys as _sys
-    import warnings
-
-    _sys.modules.pop("flashinfer_tpu.wedge_lint", None)
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        importlib.import_module("flashinfer_tpu.wedge_lint")
-    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)
-           and "flashinfer_tpu.analysis" in str(w.message)]
-    assert dep, [str(w.message) for w in caught]
-
-
-def test_compile_guard_does_not_import_the_deprecated_shim():
-    """The runtime path goes straight to analysis.wedge — importing
-    compile_guard and running its lint hook must not pull wedge_lint
-    in (no DeprecationWarning for normal kernel launches)."""
+def test_wedge_lint_shim_is_retired():
+    """The PR 4 DeprecationWarning shim is gone (ISSUE 15): the wedge
+    lint is importable ONLY from analysis.wedge, and compile_guard's
+    runtime hook already goes there directly."""
     import ast as _ast
+    import importlib
     import inspect as _inspect
 
+    import pytest as _pytest
+
+    with _pytest.raises(ModuleNotFoundError):
+        importlib.import_module("flashinfer_tpu.wedge_lint")
     from flashinfer_tpu import compile_guard
 
     src = _inspect.getsource(compile_guard)
@@ -1803,22 +1781,24 @@ def test_compile_guard_does_not_import_the_deprecated_shim():
     for node in _ast.walk(_ast.parse(src)):
         if isinstance(node, _ast.ImportFrom):
             assert not any(a.name == "wedge_lint" for a in node.names), \
-                "compile_guard must not import the wedge_lint shim"
+                "compile_guard must not import the retired shim"
 
 
-# -------------------------------------- driver: all ten passes --
+# ---------------------------------- driver: all thirteen passes --
 
 
-def test_driver_runs_all_ten_passes():
-    """Clean-tree pin for the grown driver: L001–L010 all registered,
-    and the four new passes return NOTHING on the shipped tree (no
-    baseline absorption)."""
-    from flashinfer_tpu.analysis import (kernel_init_guard,
-                                         pallas_contract, tracer_leak,
-                                         vmem_budget)
+def test_driver_runs_all_thirteen_passes():
+    """Registration pin for the grown driver: L001–L013 all behind the
+    one driver (a pass that exists but is not in PASSES silently never
+    runs — exactly the silent-skip failure mode L013 exists to kill)."""
+    from flashinfer_tpu.analysis import (donation_lifetime,
+                                         kernel_init_guard,
+                                         pallas_contract,
+                                         registry_coverage, static_flow,
+                                         tracer_leak, vmem_budget)
 
-    assert pallas_contract in analysis.PASSES
-    assert tracer_leak in analysis.PASSES
-    assert vmem_budget in analysis.PASSES
-    assert kernel_init_guard in analysis.PASSES
-    assert len(analysis.PASSES) == 10
+    for p in (pallas_contract, tracer_leak, vmem_budget,
+              kernel_init_guard, donation_lifetime, static_flow,
+              registry_coverage):
+        assert p in analysis.PASSES, p.__name__
+    assert len(analysis.PASSES) == 13

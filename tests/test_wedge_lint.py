@@ -1,14 +1,18 @@
 """Wedge-pattern lint (round-5 verdict item 8): the static checker must
 flag each known chip-wedging Mosaic pattern on a deliberately-bad
 fixture, honor reasoned suppressions (and reject reasonless ones), pass
-the current ops/ tree, and be wired into compile_guard."""
+the current ops/ tree, and be wired into compile_guard.
+
+The lint lives in ``flashinfer_tpu.analysis.wedge`` (the L004 pass);
+the historical ``flashinfer_tpu.wedge_lint`` shim is retired
+(docs/migration.md)."""
 
 import os
 import textwrap
 
 import pytest
 
-from flashinfer_tpu import wedge_lint
+from flashinfer_tpu.analysis import wedge as wedge_lint
 
 BAD_FIXTURE = textwrap.dedent(
     """
@@ -138,7 +142,7 @@ def test_compile_guard_wiring(monkeypatch):
 
     mod = types.ModuleType("fake_bad_kernels")
     mod.__name__ = "fake_bad_kernels_" + str(id(mod))
-    import flashinfer_tpu.wedge_lint as wl
+    from flashinfer_tpu.analysis import wedge as wl
 
     monkeypatch.setattr(
         wl.inspect, "getsource", lambda m: BAD_FIXTURE, raising=True)
