@@ -65,9 +65,21 @@ L013  registry_coverage      registry completeness: every KNOWN_KNOBS
                              span/cost-family catalogs complete (the
                              one implementation ``obs doctor``
                              delegates to)
+L014  dma_race               DMA/semaphore happens-before inside kernel
+                             bodies: read-before-wait, slot overwrite
+                             while a copy may be in flight, start/wait
+                             imbalance along any guard path (the
+                             BENCH_r04/r05 wedge shape), and
+                             cross-grid-iteration carry hazards
+L015  mosaic_lowering        interpret-proven-only constructs in kernel
+                             bodies: non-128-aligned or strided lane
+                             (last-axis) slices, in-kernel cast-to-
+                             match/gather — waived in place or triaged
+                             into the baseline's ``mosaic_risks``
+                             hardware bring-up checklist
 ====  =====================  ==========================================
 
-L007–L013 are interprocedural: they resolve planners/kernels through
+L007–L015 are interprocedural: they resolve planners/kernels through
 the project symbol index in ``core.py``, so the planner in one module
 and the kernel in another are checked as one contract.
 
@@ -98,8 +110,9 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Set, Tuple
 
-from flashinfer_tpu.analysis import (alias_rebind, donation_lifetime,
-                                     jit_staticness, kernel_init_guard,
+from flashinfer_tpu.analysis import (alias_rebind, dma_race,
+                                     donation_lifetime, jit_staticness,
+                                     kernel_init_guard, mosaic_lowering,
                                      obs_coverage, pallas_contract,
                                      registry_coverage, signature_parity,
                                      static_flow, tracer_leak,
@@ -119,7 +132,7 @@ __all__ = [
 PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
           obs_coverage, tuning_schema, pallas_contract, tracer_leak,
           vmem_budget, kernel_init_guard, donation_lifetime,
-          static_flow, registry_coverage)
+          static_flow, registry_coverage, dma_race, mosaic_lowering)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")
@@ -203,6 +216,12 @@ def load_baseline(path: Optional[str] = None) -> Dict[Tuple, int]:
             continue  # hand-edited in: still never honored
         key = (e["code"], e["path"], e["func"])
         out[key] = out.get(key, 0) + int(e.get("count", 1))
+    # triaged Mosaic-lowering risks live in their own machine-readable
+    # section (the hardware bring-up checklist) but budget exactly like
+    # ordinary baselined findings — one L015 per counted instance
+    for e in data.get("mosaic_risks", []):
+        key = ("L015", e["path"], e["func"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
     return out
 
 
@@ -235,6 +254,13 @@ def partition_against_baseline(
 _UNBASELINEABLE = frozenset({"L000", "W000"})
 
 
+def _l015_rule(f: Finding) -> str:
+    """'[lane-slice] ...' -> 'lane-slice' (the mosaic_lowering tag)."""
+    if f.message.startswith("["):
+        return f.message[1:].split("]", 1)[0]
+    return "unknown"
+
+
 def write_baseline(findings: List[Finding], path: str) -> None:
     skipped = [f for f in findings if f.code in _UNBASELINEABLE]
     if skipped:
@@ -242,6 +268,22 @@ def write_baseline(findings: List[Finding], path: str) -> None:
             print(f"refusing to baseline (fix the suppression reason "
                   f"instead): {f}")
         findings = [f for f in findings if f.code not in _UNBASELINEABLE]
+    # L015 findings route to the mosaic_risks section: same budget
+    # semantics, but keyed one level finer ((path, func, rule)) and
+    # carrying a human triage note that regeneration must preserve —
+    # the note IS the hardware bring-up checklist entry
+    notes: Dict[Tuple, str] = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                prev = json.load(fh)
+            for e in prev.get("mosaic_risks", []):
+                notes[(e["path"], e["func"], e.get("rule", "unknown"))] \
+                    = e.get("note", "")
+        except (OSError, ValueError, KeyError):
+            pass
+    risks = [f for f in findings if f.code == "L015"]
+    findings = [f for f in findings if f.code != "L015"]
     counts: Dict[Tuple, int] = {}
     lines: Dict[Tuple, List[int]] = {}
     for f in findings:
@@ -249,10 +291,22 @@ def write_baseline(findings: List[Finding], path: str) -> None:
         counts[key] = counts.get(key, 0) + 1
         lines.setdefault(key, []).append(f.line)
     entries = [
-        {"code": code, "path": path, "func": func,
-         "count": counts[(code, path, func)],
-         "lines_at_capture": lines[(code, path, func)]}
-        for code, path, func in sorted(counts)]
+        {"code": code, "path": path_, "func": func,
+         "count": counts[(code, path_, func)],
+         "lines_at_capture": lines[(code, path_, func)]}
+        for code, path_, func in sorted(counts)]
+    rcounts: Dict[Tuple, int] = {}
+    rlines: Dict[Tuple, List[int]] = {}
+    for f in risks:
+        key = (project_relpath(f.filename), f.func, _l015_rule(f))
+        rcounts[key] = rcounts.get(key, 0) + 1
+        rlines.setdefault(key, []).append(f.line)
+    risk_entries = [
+        {"rule": rule, "path": rpath, "func": func,
+         "count": rcounts[(rpath, func, rule)],
+         "lines_at_capture": sorted(rlines[(rpath, func, rule)]),
+         "note": notes.get((rpath, func, rule), "TRIAGE PENDING")}
+        for rpath, func, rule in sorted(rcounts)]
     with open(path, "w") as f:
         json.dump({
             "comment": (
@@ -261,13 +315,34 @@ def write_baseline(findings: List[Finding], path: str) -> None:
                 "Regenerate with `python -m flashinfer_tpu.analysis "
                 "--write-baseline` AFTER triaging that every new entry "
                 "is a documented deviation, not a bug "
-                "(docs/static_analysis.md)."),
+                "(docs/static_analysis.md).  mosaic_risks is the L015 "
+                "section: the machine-readable hardware bring-up "
+                "checklist — every entry's note must say what on-chip "
+                "proof retires it; notes survive regeneration."),
             "findings": entries,
+            "mosaic_risks": risk_entries,
         }, f, indent=1, sort_keys=False)
         f.write("\n")
 
 
 # -- CLI -----------------------------------------------------------------
+
+
+def _mosaic_risk_props(project: Project) -> List[dict]:
+    """Current whole-tree L015 findings serialized for the SARIF run
+    property (suppression-filtered like the driver, baseline NOT
+    applied — triaged risks stay on the checklist by design)."""
+    by_path = {sf.path: sf for sf in project.files}
+    out = []
+    for f in mosaic_lowering.run(project):
+        sf = by_path.get(f.filename)
+        if sf is not None and sf.suppression_for(f.line) is not None:
+            continue
+        out.append({"rule": _l015_rule(f),
+                    "path": project_relpath(f.filename),
+                    "line": f.line, "func": f.func,
+                    "message": f.message})
+    return out
 
 
 def _default_paths() -> List[str]:
@@ -406,8 +481,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("--changed-only: no analyzed files changed vs "
                       f"{args.changed_base}")
                 if args.sarif:
+                    # no changed files ≠ no current risks: the
+                    # mosaic_risks checklist is a whole-tree property,
+                    # so recompute it rather than emit an empty bag
+                    risks = _mosaic_risk_props(
+                        Project.from_paths(_default_paths()))
                     with open(args.sarif, "w") as fh:
-                        json.dump(sarif_mod.to_sarif([]), fh, indent=1)
+                        json.dump(sarif_mod.to_sarif([], risks),
+                                  fh, indent=1)
                 return 0
     project = Project.from_paths(files)
     findings = analyze_project(project, bank)
@@ -450,8 +531,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not saw_whole_tree:
             stale = []
     if args.sarif:
+        # run property = EVERY current L015 finding (baselined/triaged
+        # included), not just the new ones in "results" — the hardware
+        # session reads the full checklist from one artifact.  On a
+        # subset run the subset's findings are all we saw; fall back to
+        # a whole-tree scan only when the subset saw no kernels at all.
+        risks = [{"rule": _l015_rule(f),
+                  "path": project_relpath(f.filename),
+                  "line": f.line, "func": f.func,
+                  "message": f.message}
+                 for f in findings if f.code == "L015"]
+        if not saw_whole_tree and not risks:
+            risks = _mosaic_risk_props(
+                Project.from_paths(_default_paths()))
         with open(args.sarif, "w") as fh:
-            json.dump(sarif_mod.to_sarif(new), fh, indent=1)
+            json.dump(sarif_mod.to_sarif(new, risks), fh, indent=1)
             fh.write("\n")
         print(f"# sarif ({len(new)} result(s)) -> {args.sarif}",
               file=sys.stderr)

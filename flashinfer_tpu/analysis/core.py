@@ -442,21 +442,31 @@ _PARTIAL_CALL_NAMES = {"partial"}
 
 
 def _unwrap_partial(
-        expr: ast.expr, locals_: FnLocals,
-        _depth: int = 0) -> Tuple[Optional[ast.expr], Set[str], int]:
+        expr: ast.expr, locals_: FnLocals, _depth: int = 0,
+) -> Tuple[Optional[ast.expr], Set[str], int,
+           Dict[str, ast.expr], List[ast.expr]]:
     """(innermost callable expr, keyword names bound along the partial
     chain, count of POSITIONALLY-bound partial args — they consume the
-    kernel's leading params).  Resolves through once-assigned local
-    names."""
+    kernel's leading params, kwarg name -> bound VALUE expr, positional
+    bound value exprs in order).  Resolves through once-assigned local
+    names.  The value exprs are what L014 evaluates (in the launcher's
+    scope) to recover static kernel parameters; innermost partial wins
+    a kwarg collision, matching functools semantics."""
     bound: Set[str] = set()
     npos = 0
+    kw_exprs: Dict[str, ast.expr] = {}
+    pos_exprs: List[ast.expr] = []
     while _depth < 8:
         _depth += 1
         if isinstance(expr, ast.Call) \
                 and expr_basename(expr.func) in _PARTIAL_CALL_NAMES \
                 and expr.args:
             bound |= {k.arg for k in expr.keywords if k.arg}
+            for k in expr.keywords:
+                if k.arg:  # inner partial (seen later) overrides
+                    kw_exprs[k.arg] = k.value
             npos += len(expr.args) - 1
+            pos_exprs = list(expr.args[1:]) + pos_exprs
             expr = expr.args[0]
             continue
         if isinstance(expr, ast.Name):
@@ -465,7 +475,7 @@ def _unwrap_partial(
                 expr = v
                 continue
         break
-    return expr, bound, npos
+    return expr, bound, npos, kw_exprs, pos_exprs
 
 
 @dataclasses.dataclass
@@ -490,6 +500,15 @@ class PallasCallSite:
     io_aliases_expr: Optional[ast.expr]
     vmem_limit_bytes: Optional[int]
     locals_: FnLocals
+    # the bound VALUE exprs behind kernel_bound_kwargs/posargs, and the
+    # grid tuple's element exprs — both evaluated (in the launcher's
+    # scope) by L014 to seed static kernel parameters.  None grid_exprs
+    # mirrors grid_rank=None: not statically visible here.
+    kernel_bound_kwarg_exprs: Dict[str, ast.expr] = dataclasses.field(
+        default_factory=dict)
+    kernel_bound_posarg_exprs: List[ast.expr] = dataclasses.field(
+        default_factory=list)
+    grid_exprs: Optional[List[ast.expr]] = None
 
     @property
     def line(self) -> int:
@@ -610,13 +629,16 @@ def _build_site(project: "Project", sf: SourceFile,
         nsp_expr = spec_kwargs.get("num_scalar_prefetch")
         nsp = const_int(nsp_expr) if nsp_expr is not None else 0
     grid_rank = None
+    grid_exprs: Optional[List[ast.expr]] = None
     grid_expr = spec_kwargs.get("grid")
     if isinstance(grid_expr, ast.Name):
         grid_expr = locals_.value_of(grid_expr.id)
     if isinstance(grid_expr, (ast.Tuple, ast.List)):
         grid_rank = len(grid_expr.elts)
+        grid_exprs = list(grid_expr.elts)
     elif grid_expr is not None and const_int(grid_expr) is not None:
         grid_rank = 1
+        grid_exprs = [grid_expr]
 
     in_specs = _spec_list(spec_kwargs.get("in_specs"), locals_)
     out_specs = _spec_list(spec_kwargs.get("out_specs"), locals_)
@@ -633,8 +655,11 @@ def _build_site(project: "Project", sf: SourceFile,
     kernel_info = None
     bound: Set[str] = set()
     bound_pos = 0
+    bound_kw_exprs: Dict[str, ast.expr] = {}
+    bound_pos_exprs: List[ast.expr] = []
     if call.args:
-        target, bound, bound_pos = _unwrap_partial(call.args[0], locals_)
+        (target, bound, bound_pos, bound_kw_exprs,
+         bound_pos_exprs) = _unwrap_partial(call.args[0], locals_)
         if target is not None:
             base = expr_basename(target)
             if base:
@@ -663,4 +688,7 @@ def _build_site(project: "Project", sf: SourceFile,
         grid_rank=grid_rank, in_spec_exprs=in_specs,
         out_spec_exprs=out_specs, scratch_exprs=scratch,
         io_aliases_expr=kwargs.get("input_output_aliases"),
-        vmem_limit_bytes=vmem, locals_=locals_)
+        vmem_limit_bytes=vmem, locals_=locals_,
+        kernel_bound_kwarg_exprs=bound_kw_exprs,
+        kernel_bound_posarg_exprs=bound_pos_exprs,
+        grid_exprs=grid_exprs)

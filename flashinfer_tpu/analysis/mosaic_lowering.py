@@ -1,0 +1,279 @@
+"""L015 mosaic_lowering — interpret-proven-only construct lint for
+Pallas kernel bodies.
+
+The kernels in this tree are developed and regression-tested under
+``interpret=True`` (CPU); Mosaic — the actual TPU lowering — supports
+a narrower set of shapes on the lane (last) axis, which it tiles in
+128s.  PR 14's in-register rotation slices the lane dim at
+``head_dim//2`` and interleaves with stride-2 slices, both annotated in
+prose as "interpret-proven only, Mosaic support unknown".  Risks like
+that must be machine-tracked findings, not notes a hardware session has
+to rediscover, so this pass walks every resolved kernel body and flags:
+
+``[lane-slice]``
+    last-axis slicing whose bounds are not PROVABLY 0 mod 128
+    (``x[:, half:]`` with ``half = head_dim // 2``), including
+    ``pl.ds(start, size)`` in the last index slot with an unprovable
+    start/size.  Full slices (``:``) and width-1 slices (``[:, :1]`` —
+    the online-softmax running-stat idiom, a supported lane broadcast
+    shape) are exempt.
+``[strided-lane]``
+    non-unit-stride last-axis slicing (``xf[:, 0::2]`` — the rotation
+    interleave).
+``[cast]``
+    in-kernel dtype cast-to-match (``p.astype(v.dtype)``): the target
+    dtype is data-dependent, so there is no single committed lowering
+    to point at.  Casts to a LITERAL dtype (``jnp.float32``) are exempt
+    — those lower through one fixed, testable path.
+``[gather]``
+    in-kernel ``jnp.take`` / ``take_along_axis`` — dynamic gather has
+    no committed Mosaic proof at any shape in this tree.
+
+Every finding is either ``# graft-lint: ok``-waived in place with a
+reason, or triaged into the machine-readable ``mosaic_risks`` section
+of the baseline (and echoed as a SARIF run property), so the hardware
+bring-up session starts from a checklist instead of CHANGES.md
+archaeology.  The pass is purely syntactic over RESOLVED kernels — it
+executes nothing, so unlike L014 it has no skip path; unresolved
+``pallas_call`` sites are counted (``stats()`` feeds ``obs doctor``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from flashinfer_tpu.analysis.core import (ChainLocals, Finding,
+                                          FunctionInfo, Project,
+                                          const_int, expr_basename)
+
+LANE = 128  # Mosaic lane-dim tile width
+
+# rule registry: tag -> one-line contract (docs/static_analysis.md and
+# the SARIF rule description render from the pass docstring; this table
+# is what `stats()` enumerates so a new rule cannot ship uncounted)
+RULES: Dict[str, str] = {
+    "lane-slice": "last-axis slice bounds not provably 0 mod 128",
+    "strided-lane": "non-unit-stride last-axis slice",
+    "cast": "dtype cast-to-match (data-dependent target dtype)",
+    "gather": "dynamic gather (jnp.take / take_along_axis)",
+}
+
+_GATHER_NAMES = {"take", "take_along_axis"}
+_DS_NAMES = {"ds", "dslice"}
+_MAX_FOLD_DEPTH = 8
+
+
+def _fold_int(expr: Optional[ast.expr], loc: ChainLocals,
+              depth: int = 0) -> Optional[int]:
+    """const_int extended one level: once-assigned local names resolve
+    through the kernel's lexical scope chain (``half = head_dim // 2``
+    resolves the ``// 2`` but stops at the ``head_dim`` param)."""
+    if expr is None or depth > _MAX_FOLD_DEPTH:
+        return None
+    v = const_int(expr)
+    if v is not None:
+        return v
+    if isinstance(expr, ast.Name):
+        return _fold_int(loc.value_of(expr.id), loc, depth + 1)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = _fold_int(expr.operand, loc, depth + 1)
+        return -v if v is not None else None
+    if isinstance(expr, ast.BinOp):
+        lo = _fold_int(expr.left, loc, depth + 1)
+        hi = _fold_int(expr.right, loc, depth + 1)
+        if lo is None or hi is None:
+            return None
+        if isinstance(expr.op, ast.Add):
+            return lo + hi
+        if isinstance(expr.op, ast.Sub):
+            return lo - hi
+        if isinstance(expr.op, ast.Mult):
+            return lo * hi
+        if isinstance(expr.op, ast.FloorDiv):
+            return lo // hi if hi else None
+        if isinstance(expr.op, ast.LShift):
+            return lo << hi
+    return None
+
+
+def _snippet(node: ast.AST, limit: int = 64) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.10
+        s = f"<{type(node).__name__}>"
+    return s if len(s) <= limit else s[: limit - 1] + "…"
+
+
+def _aligned(v: Optional[int]) -> bool:
+    return v is not None and v % LANE == 0
+
+
+class _Linter:
+    def __init__(self, kernel: FunctionInfo):
+        self.kernel = kernel
+        self.findings: List[Finding] = []
+
+    def _emit(self, tag: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            "L015", self.kernel.file.path,
+            getattr(node, "lineno", self.kernel.node.lineno),
+            self.kernel.qualname, f"[{tag}] {msg}"))
+
+    def run(self) -> List[Finding]:
+        self._lint_scope(self.kernel.node, [self.kernel.node])
+        return self.findings
+
+    def _lint_scope(self, fn_node: ast.AST,
+                    chain: List[ast.AST]) -> None:
+        loc = ChainLocals(chain)
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs (the pl.when/_rot/_quant helpers) are part
+                # of the kernel body but resolve names in their own
+                # scope first — recurse with the extended chain
+                self._lint_scope(n, [n] + chain)
+                continue
+            if isinstance(n, ast.Subscript):
+                self._check_subscript(n, loc)
+            elif isinstance(n, ast.Call):
+                self._check_call(n, loc)
+            stack.extend(ast.iter_child_nodes(n))
+
+    # -- [lane-slice] / [strided-lane] ------------------------------------
+
+    def _check_subscript(self, n: ast.Subscript,
+                         loc: ChainLocals) -> None:
+        # only multi-dim subscripts: a bare `seq[a:b]` is usually python
+        # tuple/list plumbing (ref unpacking), not an array lane op, and
+        # a 1-D array in a kernel is a sublane-only shape anyway
+        idx = n.slice
+        if not isinstance(idx, ast.Tuple) or not idx.elts:
+            return
+        # `.at[...]` builds a REF VIEW for make_async_copy — the DMA
+        # engine copies arbitrary windows (alignment is a perf concern,
+        # not a lowerability one), and on partially-indexed HBM refs the
+        # tuple's last slot isn't the array's lane axis anyway.  Lane
+        # tiling constrains VECTOR ops: plain subscripts on loaded
+        # arrays.
+        if isinstance(n.value, ast.Attribute) and n.value.attr == "at":
+            return
+        last = idx.elts[-1]
+        if isinstance(last, ast.Slice):
+            self._check_lane_slice(n, last, loc)
+        elif isinstance(last, ast.Call) \
+                and expr_basename(last.func) in _DS_NAMES:
+            self._check_lane_ds(n, last, loc)
+
+    def _check_lane_slice(self, sub: ast.Subscript, sl: ast.Slice,
+                          loc: ChainLocals) -> None:
+        step = _fold_int(sl.step, loc) if sl.step is not None else 1
+        if step != 1:
+            self._emit(
+                "strided-lane", sub,
+                f"strided last-axis slice `{_snippet(sub)}` "
+                f"(step {_snippet(sl.step)}) — lane interleave is "
+                f"interpret-proven only; no committed Mosaic lowering")
+            return
+        if sl.lower is None and sl.upper is None:
+            return  # full slice: the lane-preserving identity
+        lo = 0 if sl.lower is None else _fold_int(sl.lower, loc)
+        hi = _fold_int(sl.upper, loc) if sl.upper is not None else None
+        if lo is not None and hi is not None and hi - lo == 1:
+            return  # width-1 ([:, :1]): supported lane-broadcast shape
+        lo_ok = _aligned(lo)
+        # an omitted upper bound is the array end — whatever the extent,
+        # the slice START being lane-aligned is the testable obligation
+        hi_ok = sl.upper is None or _aligned(hi)
+        if lo_ok and hi_ok:
+            return
+        self._emit(
+            "lane-slice", sub,
+            f"last-axis slice `{_snippet(sub)}` has bound(s) not "
+            f"provably 0 mod {LANE} — interpret-proven only; Mosaic "
+            f"tiles the lane dim in {LANE}s")
+
+    def _check_lane_ds(self, sub: ast.Subscript, ds: ast.Call,
+                       loc: ChainLocals) -> None:
+        args = [a for a in ds.args if not isinstance(a, ast.Starred)]
+        start = _fold_int(args[0], loc) if args else None
+        size = _fold_int(args[1], loc) if len(args) > 1 else None
+        if _aligned(start) and (len(args) < 2 or _aligned(size)):
+            return
+        if size == 1 and start is not None:
+            return  # width-1 dynamic slice: lane-broadcast shape
+        self._emit(
+            "lane-slice", sub,
+            f"last-axis dynamic slice `{_snippet(sub)}` has "
+            f"start/size not provably 0 mod {LANE} — interpret-proven "
+            f"only; Mosaic tiles the lane dim in {LANE}s")
+
+    # -- [cast] / [gather] -------------------------------------------------
+
+    def _check_call(self, n: ast.Call, loc: ChainLocals) -> None:
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "astype" \
+                and n.args:
+            target = n.args[0]
+            # cast-to-match: `.astype(<something>.dtype)` — the target
+            # is whatever dtype the launch bound, so there is no single
+            # lowering to test.  A literal (`jnp.float32`) is one fixed
+            # path and exempt: Attribute whose attr is the dtype name,
+            # not `.dtype`.
+            if isinstance(target, ast.Attribute) \
+                    and target.attr == "dtype":
+                self._emit(
+                    "cast", n,
+                    f"in-kernel cast-to-match `{_snippet(n)}` — target "
+                    f"dtype is data-dependent (bound at launch); no "
+                    f"committed on-chip lowering proof per dtype pair")
+            return
+        if expr_basename(n.func) in _GATHER_NAMES:
+            self._emit(
+                "gather", n,
+                f"in-kernel gather `{_snippet(n)}` — dynamic gather "
+                f"has no committed Mosaic lowering proof")
+
+
+# -- pass driver ----------------------------------------------------------
+
+
+def _analyze(project: Project) -> Tuple[List[Finding], dict]:
+    """-> (findings, stats) shared by run() and stats()."""
+    findings: List[Finding] = []
+    st = {"kernels_linted": 0, "sites_unresolved": 0,
+          "findings_by_rule": {tag: 0 for tag in RULES}}
+    seen: Set[int] = set()
+    emitted: Set[tuple] = set()
+    for site in project.pallas_sites:
+        if site.kernel is None:
+            st["sites_unresolved"] += 1
+            continue
+        if id(site.kernel.node) in seen:
+            continue
+        seen.add(id(site.kernel.node))
+        st["kernels_linted"] += 1
+        for f in _Linter(site.kernel).run():
+            fkey = (f.filename, f.line, f.message)
+            if fkey in emitted:
+                continue
+            emitted.add(fkey)
+            findings.append(f)
+            tag = f.message[1:].split("]", 1)[0]
+            if tag in st["findings_by_rule"]:
+                st["findings_by_rule"][tag] += 1
+    return findings, st
+
+
+def run(project: Project) -> List[Finding]:
+    findings, _st = _analyze(project)
+    return findings
+
+
+def stats(project: Project) -> dict:
+    """linted-vs-unresolved counts for ``obs doctor`` — the L013
+    no-silent-skip rule applied to kernel bodies (L015 itself never
+    skips a resolved kernel: the walk is total)."""
+    _findings, st = _analyze(project)
+    return st

@@ -9,7 +9,7 @@ any checkout regardless of where the CLI ran.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from flashinfer_tpu.analysis.core import Finding, project_relpath
 
@@ -34,6 +34,8 @@ RULE_DESCRIPTIONS: Dict[str, str] = {
     "L011": "donated-buffer lifetime violation at a compile-once step",
     "L012": "per-step schedule value flowing into a compile-once static",
     "L013": "incomplete knob/planner/obs registry coverage",
+    "L014": "DMA/semaphore race inside a Pallas kernel body",
+    "L015": "interpret-proven-only construct (Mosaic lowering risk)",
     "L999": "unparseable source",
     "W000": "wedge-lint suppression without a reason",
     "W001": "strided-gather lowering wedge",
@@ -44,7 +46,8 @@ RULE_DESCRIPTIONS: Dict[str, str] = {
 }
 
 
-def to_sarif(findings: List[Finding]) -> dict:
+def to_sarif(findings: List[Finding],
+             mosaic_risks: Optional[List[dict]] = None) -> dict:
     codes = sorted({f.code for f in findings})
     rules = [
         {
@@ -82,24 +85,29 @@ def to_sarif(findings: List[Finding]) -> dict:
         }
         for f in findings
     ]
+    run: dict = {
+        "tool": {
+            "driver": {
+                "name": "graft-lint",
+                "informationUri": (
+                    "https://github.com/flashinfer-ai/flashinfer"),
+                "rules": rules,
+            },
+        },
+        "originalUriBaseIds": {
+            "SRCROOT": {"description": {
+                "text": "repository root"}},
+        },
+        "results": results,
+    }
+    if mosaic_risks is not None:
+        # machine-readable hardware bring-up checklist: EVERY current
+        # L015 finding (baselined/triaged ones included — "results"
+        # above only carries the NEW ones), so the item-1 hardware
+        # session reads one property bag instead of CHANGES.md
+        run["properties"] = {"mosaic_risks": mosaic_risks}
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
-        "runs": [
-            {
-                "tool": {
-                    "driver": {
-                        "name": "graft-lint",
-                        "informationUri": (
-                            "https://github.com/flashinfer-ai/flashinfer"),
-                        "rules": rules,
-                    },
-                },
-                "originalUriBaseIds": {
-                    "SRCROOT": {"description": {
-                        "text": "repository root"}},
-                },
-                "results": results,
-            },
-        ],
+        "runs": [run],
     }

@@ -157,18 +157,6 @@ waive_knob_launch(
     "mla_decode.layout",
     "scratch-LAYOUT enum (split/packed) over a fixed scratch budget — "
     "the layout choice moves no bytes")
-# visible binding debt: no shaped tuning_configs entries ship for
-# these yet, so there is nothing for L009 to prove; promote to a
-# KNOB_LAUNCHES binding before shipping a config section
-waive_knob_launch(
-    "paged_decode.pages_per_chunk",
-    "no shipped config entries yet; the split-path twin decode.splits "
-    "binding proves the shared (ppc, Hkv, PS, D) chunk-pair scratch — "
-    "bind this knob before a paged_decode section ships")
-waive_knob_launch(
-    "moe_gmm.tiles",
-    "no shipped config entries yet — nothing for L009 to prove; bind "
-    "the gmm launcher before a moe section ships")
 
 
 # fkey: (batch, tq_pad, num_qo_heads, num_kv_heads, head_dim,
@@ -207,6 +195,36 @@ register_knob_launch(KnobLaunch(
     shape_names=("batch", "max_pages", "num_qo_heads", "num_kv_heads",
                  "head_dim", "page_size", "pages_per_chunk",
                  "__dtype__"),
+))
+
+# key: (batch, max_pages, num_qo_heads, num_kv_heads, head_dim,
+# page_size, dtype) — ops/paged_decode.py decode_tactic_key.  The tactic
+# VALUE is the pages-per-chunk itself, which directly sizes the
+# double-buffered (2, ppc, Hkv, PS, D) K+V chunk-pair scratch of the
+# head-fused HND launch — the unsplit twin of decode.splits (whose key
+# carries ppc as a SHAPE field instead).  The launcher's runtime 8 MiB
+# clamp lives in paged_decode_attention, upstream of this launch, so
+# the proof evaluates the RAW shipped value: an entry this binding
+# rejects would only ever run clamped, i.e. the tactic silently would
+# not be what the config promised — exactly what L009 must say.
+register_knob_launch(KnobLaunch(
+    knob="paged_decode.pages_per_chunk",
+    launcher="_paged_decode_hnd_launch",
+    value_names=("pages_per_chunk",),
+    shape_names=("batch", None, "num_qo_heads", "num_kv_heads",
+                 "head_dim", "page_size", "__dtype__"),
+))
+
+# key: (m, k, n, dtype) — ops/moe_gmm.py tune_tiles / fused_moe.  The
+# (tm, tn, tk) tactic sizes the lhs/rhs/out blocks and the f32/int32
+# accumulator scratch of the one gmm pallas_call; the quantized-path
+# extra scale blocks are tiny and branch-gated, so the evaluator's
+# min-merge keeps the estimate a lower bound (L009 semantics).
+register_knob_launch(KnobLaunch(
+    knob="moe_gmm.tiles",
+    launcher="gmm",
+    value_names=("tm", "tn", "tk"),
+    shape_names=("m", "k", "n", "__dtype__"),
 ))
 
 # key: (hidden, hq, hkv, hd) — serve/engine.py EngineConfig.from_knobs.

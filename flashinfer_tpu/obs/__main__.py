@@ -584,6 +584,28 @@ def cmd_doctor(args) -> int:
             "suppressions": total,
             "reasonless_suppressions": reasonless,
         }
+
+        # L014/L015 kernel coverage: a silently-skipped kernel body is
+        # an unanalyzed DMA pipeline — surface analyzed-vs-skipped here
+        # so the skip count is visible without reading analyzer output
+        # (docs/static_analysis.md §"L014 hazard classes")
+        from flashinfer_tpu.analysis import dma_race as _dma
+        from flashinfer_tpu.analysis import mosaic_lowering as _mosaic
+
+        proj = _acore.Project.from_paths([pkg])
+        d14 = _dma.stats(proj)
+        d15 = _mosaic.stats(proj)
+        report["lint"]["l014_kernels"] = {
+            "analyzed": d14["kernels_analyzed"],
+            "skipped": d14["kernels_skipped"],
+            "no_dma": d14["kernels_no_dma"],
+            "sites_unresolved": d14["sites_unresolved"],
+        }
+        report["lint"]["l015_kernels"] = {
+            "linted": d15["kernels_linted"],
+            "sites_unresolved": d15["sites_unresolved"],
+            "findings_by_rule": dict(d15["findings_by_rule"]),
+        }
     except Exception as e:  # doctor must never crash on a broken tree
         report["lint"] = f"<unavailable: {type(e).__name__}>"
 

@@ -365,6 +365,156 @@ def decode_tactic_key(batch, max_pages, num_qo_heads, num_kv_heads,
             page_size, str(q_dtype))
 
 
+def _paged_decode_hnd_launch(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, Hkv, PS, D]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, P_padded] int32
+    kv_lens: jax.Array,  # [batch] int32
+    *,
+    page_size: int,
+    pages_per_chunk: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+    cross_step_prefetch,
+):
+    """Head-fused HND fast path: one 32KB page DMA serves all KV heads.
+
+    Module-level (not a branch body of ``paged_decode_attention``) so
+    the ``paged_decode.pages_per_chunk`` KNOB_LAUNCHES binding can
+    resolve ONE launch with a once-assigned grid spec and prove shipped
+    config entries fit the double-buffered chunk-pair scratch (L009) —
+    the same hoist ``paged_decode_attention_split`` already has.
+    Returns the padded-group ``(out, lse)`` pair; the caller slices the
+    group padding off."""
+    batch, num_qo_heads, head_dim = q.shape
+    _num_pages, num_kv_heads, _ps, _ = k_cache.shape
+    group = num_qo_heads // num_kv_heads
+    gp = round_up(group, 8)
+    # [B, Hq, D] -> [B, Hkv, Gp, D] with zero padding in the group dim
+    qg = q.reshape(batch, num_kv_heads, group, head_dim)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    kernel = functools.partial(
+        _decode_kernel_fused_heads,
+        page_size=page_size,
+        ppc=pages_per_chunk,
+        sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap,
+        window_left=window_left,
+        num_kv_heads=num_kv_heads,
+        cross_step_prefetch=cross_step_prefetch,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, head_dim),
+                lambda b, *_: (b, 0, 0, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, head_dim),
+                lambda b, *_: (b, 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (None, num_kv_heads, gp, 128), lambda b, *_: (b, 0, 0, 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                k_cache.dtype,
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
+                v_cache.dtype,
+            ),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
+
+
+def _paged_decode_nhd_launch(
+    q: jax.Array,  # [batch, num_qo_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, PS, Hkv, D]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, P_padded] int32
+    kv_lens: jax.Array,  # [batch] int32
+    *,
+    page_size: int,
+    pages_per_chunk: int,
+    sm_scale: float,
+    logits_soft_cap: float,
+    window_left: int,
+):
+    """Per-head NHD launch (the layout-general slow path); module-level
+    for the same launch-resolution reason as ``_paged_decode_hnd_launch``.
+    Returns the padded-group ``(out, lse)`` pair."""
+    batch, num_qo_heads, head_dim = q.shape
+    _num_pages, _ps, num_kv_heads, _ = k_cache.shape
+    group = num_qo_heads // num_kv_heads
+    gp = round_up(group, 8)
+    qg = q.reshape(batch, num_kv_heads, group, head_dim)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    chunk_tokens = pages_per_chunk * page_size
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        ppc=pages_per_chunk,
+        sm_scale=sm_scale,
+        logits_soft_cap=logits_soft_cap,
+        window_left=window_left,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, num_kv_heads),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
+            ),
+            pl.BlockSpec((None, None, gp, 128), lambda b, h, *_: (b, h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_tokens, head_dim), k_cache.dtype),
+            pltpu.VMEM((2, chunk_tokens, head_dim), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -411,7 +561,6 @@ def paged_decode_attention(
         num_pages, page_size, num_kv_heads, _ = k_cache.shape
     assert num_qo_heads % num_kv_heads == 0
     group = num_qo_heads // num_kv_heads
-    gp = round_up(group, 8)
 
     if pages_per_chunk is None:
         pages_per_chunk = max(1, min(512 // page_size, 16))
@@ -429,97 +578,22 @@ def paged_decode_attention(
     if p_padded != max_pages:
         page_table = jnp.pad(page_table, ((0, 0), (0, p_padded - max_pages)))
 
-    # [B, Hq, D] -> [B, Hkv, Gp, D] with zero padding in the group dim
-    qg = q.reshape(batch, num_kv_heads, group, head_dim)
-    if gp != group:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
-
-    chunk_tokens = pages_per_chunk * page_size
     if kv_layout == "HND":
         # head-fused fast path: one 32KB page DMA serves all KV heads
-        kernel = functools.partial(
-            _decode_kernel_fused_heads,
-            page_size=page_size,
-            ppc=pages_per_chunk,
-            sm_scale=sm_scale,
-            logits_soft_cap=logits_soft_cap,
+        out, lse = _paged_decode_hnd_launch(
+            q, k_cache, v_cache, page_table, kv_lens,
+            page_size=page_size, pages_per_chunk=pages_per_chunk,
+            sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
             window_left=window_left,
-            num_kv_heads=num_kv_heads,
             cross_step_prefetch=cross_step_prefetch,
         )
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(batch,),
-            in_specs=[
-                pl.BlockSpec(
-                    (None, num_kv_heads, gp, head_dim),
-                    lambda b, *_: (b, 0, 0, 0),
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (None, num_kv_heads, gp, head_dim),
-                    lambda b, *_: (b, 0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (None, num_kv_heads, gp, 128), lambda b, *_: (b, 0, 0, 0)
-                ),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM(
-                    (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
-                    k_cache.dtype,
-                ),
-                pltpu.VMEM(
-                    (2, pages_per_chunk, num_kv_heads, page_size, head_dim),
-                    v_cache.dtype,
-                ),
-                pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
-                pltpu.SMEM((1,), jnp.int32),
-            ],
-        )
     else:
-        kernel = functools.partial(
-            _decode_kernel,
-            page_size=page_size,
-            ppc=pages_per_chunk,
-            sm_scale=sm_scale,
-            logits_soft_cap=logits_soft_cap,
+        out, lse = _paged_decode_nhd_launch(
+            q, k_cache, v_cache, page_table, kv_lens,
+            page_size=page_size, pages_per_chunk=pages_per_chunk,
+            sm_scale=sm_scale, logits_soft_cap=logits_soft_cap,
             window_left=window_left,
         )
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(batch, num_kv_heads),
-            in_specs=[
-                pl.BlockSpec(
-                    (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (None, None, gp, head_dim), lambda b, h, *_: (b, h, 0, 0)
-                ),
-                pl.BlockSpec((None, None, gp, 128), lambda b, h, *_: (b, h, 0, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((2, chunk_tokens, head_dim), k_cache.dtype),
-                pltpu.VMEM((2, chunk_tokens, head_dim), v_cache.dtype),
-                pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
-            ],
-        )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((batch, num_kv_heads, gp, 128), jnp.float32),
-        ],
-        interpret=use_interpret(),
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
 
     out = out[:, :, :group, :].reshape(batch, num_qo_heads, head_dim)
     if return_lse:

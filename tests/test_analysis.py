@@ -1787,18 +1787,18 @@ def test_wedge_lint_shim_is_retired():
 # ---------------------------------- driver: all thirteen passes --
 
 
-def test_driver_runs_all_thirteen_passes():
-    """Registration pin for the grown driver: L001–L013 all behind the
+def test_driver_runs_all_fifteen_passes():
+    """Registration pin for the grown driver: L001–L015 all behind the
     one driver (a pass that exists but is not in PASSES silently never
     runs — exactly the silent-skip failure mode L013 exists to kill)."""
-    from flashinfer_tpu.analysis import (donation_lifetime,
+    from flashinfer_tpu.analysis import (dma_race, donation_lifetime,
                                          kernel_init_guard,
-                                         pallas_contract,
+                                         mosaic_lowering, pallas_contract,
                                          registry_coverage, static_flow,
                                          tracer_leak, vmem_budget)
 
     for p in (pallas_contract, tracer_leak, vmem_budget,
               kernel_init_guard, donation_lifetime, static_flow,
-              registry_coverage):
+              registry_coverage, dma_race, mosaic_lowering):
         assert p in analysis.PASSES, p.__name__
-    assert len(analysis.PASSES) == 13
+    assert len(analysis.PASSES) == 15
