@@ -153,6 +153,73 @@ def _guard_soft(name, statics, thunk):
         return None
 
 
+def _probed_overlap(stepfn, x0, layer_ws, caches, head, head_s, p, l, sk,
+                    steps=8, warm=2):
+    """Host/device overlap of a raw serving-step callable, measured with
+    a PER-STEP completion probe (the obs.steploop gate-ON protocol) in a
+    window SEPARATE from wall(): the pipelined us_step throughput number
+    must not pay the probe tax.  Returns the ``host_gap_us`` /
+    ``host_frac`` measurement stamps (ISSUE 17) — gap = dispatch(N+1)
+    return minus step N's completion, host_frac = Σgap/(Σgap+Σdevice)
+    over the steady-state pairs, same math as ``steploop.summarize``."""
+    import jax
+
+    for _ in range(warm):
+        tok, caches, p, l, sk = stepfn(x0, layer_ws, caches, head,
+                                       head_s, p, l, sk)
+    jax.block_until_ready(tok)
+    marks = []
+    for _ in range(steps):
+        tok, caches, p, l, sk = stepfn(x0, layer_ws, caches, head,
+                                       head_s, p, l, sk)
+        td = time.perf_counter()
+        jax.block_until_ready(tok)
+        marks.append((td, time.perf_counter()))
+    gaps = [max(marks[i][0] - marks[i - 1][1], 0.0)
+            for i in range(1, len(marks))]
+    devs = [marks[i][1] - marks[i][0] for i in range(1, len(marks))]
+    gap_sum, dev_sum = sum(gaps), sum(devs)
+    srt = sorted(gaps)
+    return {
+        "host_gap_us": round(srt[len(srt) // 2] * 1e6, 1),
+        "host_frac": round(gap_sum / max(gap_sum + dev_sum, 1e-12), 4),
+    }
+
+
+def _host_loop_stamps(summary):
+    """``obs.steploop.summarize()`` -> the serving-row measurement
+    stamps.  ``pred_step_ratio`` is the drift join's p50 (predicted /
+    measured step wall) when the surface priced its steps (the engine
+    does); absent otherwise."""
+    if not summary or not summary.get("steps"):
+        return {}
+    out = {}
+    if summary.get("host_frac") is not None:
+        out["host_frac"] = round(summary["host_frac"], 4)
+    gap = (summary.get("gap_us") or {}).get("p50")
+    if gap is not None:
+        out["host_gap_us"] = round(gap, 1)
+    drift = (summary.get("drift") or {}).get("p50")
+    if drift:
+        out["pred_step_ratio"] = round(drift, 4)
+    return out
+
+
+def _pred_step_ratio(cost, seconds, dtype="int8", ici=False):
+    """predicted / measured step wall for a raw-step serving row — the
+    same forward predictor the engine's online drift join uses.  On CPU
+    the ratio is structural (the predictor prices the detected chip),
+    exactly like the kv_migrate predicted-vs-measured join."""
+    from flashinfer_tpu.obs import costmodel, hwspec
+
+    spec = hwspec.current_spec()
+    pred = costmodel.predict_step_seconds(
+        cost, hbm_tbps=spec.hbm_tbps,
+        peak_tflops=spec.peak_tflops(dtype),
+        ici_gbps=spec.ici_gbps if ici else 0.0)
+    return round(pred / max(seconds, 1e-12), 4)
+
+
 def phase_decode(sweep: bool):
     import jax
     import jax.numpy as jnp
@@ -1483,6 +1550,15 @@ def phase_serving_fused(sweep: bool):
         residuals[name] = residual_us
         obs.observe("lifecycle.tpot_us", t * 1e6)
         obs.observe("lifecycle.ttft_us", t_first * 1e6)
+        # host/device overlap probe (ISSUE 17): its own short window so
+        # the per-step sync never taxes the us_step throughput number
+        overlap = _guard_soft(
+            f"bench.serving_fused.{name}.overlap",
+            (bs, ctx, L, hidden, name),
+            lambda s=stepfn: _probed_overlap(
+                s, x0, layer_ws, mk_caches(), head, head_s,
+                jnp.asarray(pt0), jnp.asarray(lens0),
+                jax.random.PRNGKey(3))) or {}
         _emit_row(**_stamp(
             dict(phase="serving_fused", model="llama70b_tp8shard_int8",
                  variant=name, bs=bs, ctx=ctx, layers=L,
@@ -1496,7 +1572,8 @@ def phase_serving_fused(sweep: bool):
                  slope_pred_us=round(t_slope * 1e6, 1),
                  overhead_vs_slope=round(t / max(t_slope, 1e-9), 3),
                  dispatch_residual_us=round(residual_us, 1),
-                 includes=["kv_append", "sampling"]),
+                 pred_step_ratio=_pred_step_ratio(cost, t),
+                 includes=["kv_append", "sampling"], **overlap),
             cost, t, step_mode=name))
         print(f"# serving_fused {name:7s}: {t*1e6:9.1f} us/step "
               f"({t/max(t_slope,1e-9):.3f}x slope, residual "
@@ -1690,6 +1767,15 @@ def phase_serving_sharded(sweep: bool):
         t, t_first = measured
         residual_us = (t - t_slope) * 1e6
         residuals[name] = residual_us
+        # host/device overlap probe (ISSUE 17): separate window, the
+        # serving_fused protocol, on the mesh program
+        overlap = _guard_soft(
+            f"bench.serving_sharded.{name}.overlap",
+            (bs, ctx, L, hidden, plan.mesh_axes, name),
+            lambda s=stepfn: _probed_overlap(
+                s, x0, layer_ws, mk_caches(), head, head_s,
+                jnp.asarray(pt0), jnp.asarray(lens0),
+                jax.random.PRNGKey(3))) or {}
         _emit_row(**_stamp(
             dict(phase="serving_sharded", model="llama70b_int8",
                  variant=name, bs=bs, ctx=ctx, layers=L,
@@ -1699,7 +1785,9 @@ def phase_serving_sharded(sweep: bool):
                  slope_pred_us=round(t_slope * 1e6, 1),
                  overhead_vs_slope=round(t / max(t_slope, 1e-9), 3),
                  dispatch_residual_us=round(residual_us, 1),
-                 includes=["kv_append", "sampling", "collectives"]),
+                 pred_step_ratio=_pred_step_ratio(cost, t, ici=True),
+                 includes=["kv_append", "sampling", "collectives"],
+                 **overlap),
             cost, t, step_mode=name, mesh_axes=plan.mesh_axes))
         print(f"# serving_sharded {name:7s}: {t*1e6:9.1f} us/step "
               f"({t/max(t_slope,1e-9):.3f}x slope, residual "
@@ -1764,12 +1852,18 @@ def phase_serving_engine(sweep: bool):
 
     os.environ["FLASHINFER_TPU_SPANS"] = "1"
     os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    # step-loop flight deck ON for the run (ISSUE 17): the engine's
+    # step() is wired, so the ledger prices every dispatch — the probe
+    # tax is part of this phase's measured quantity (phases run in
+    # their own subprocess, the gate never leaks)
+    os.environ["FLASHINFER_TPU_STEPLOOP"] = "1"
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from flashinfer_tpu import obs
     from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+    from flashinfer_tpu.obs import steploop
     from flashinfer_tpu.serve import (EngineConfig, EngineRequest,
                                       SamplingConfig, ServingEngine)
 
@@ -1817,7 +1911,9 @@ def phase_serving_engine(sweep: bool):
         return results, _time.perf_counter() - t0, eng
 
     obs.reset()
+    steploop.reset()
     results, wall, eng = serve(True)
+    sl = steploop.summarize()  # before the oracle run pollutes it
     snap = obs.snapshot()
     ls = obs.lifecycle_snapshot()
     hits = sum(snap["counters"].get("engine.prefix_hit_tokens",
@@ -1873,11 +1969,15 @@ def phase_serving_engine(sweep: bool):
     row = engine_row(eng, wall, ls, snap, hit_rate, gen_tokens)
     row["oracle"] = "tokens-bitwise-equal"
     row["oracle_speedup"] = round(oracle_wall / max(wall, 1e-9), 3)
+    # steploop ledger stamps: real host-gap decomposition + the online
+    # predicted-vs-measured drift join (the engine prices its steps)
+    row.update(_host_loop_stamps(sl))
     _emit_row(**_stamp(row, eng.aggregate_cost(), wall,
                        attention_backend="reference"))
     print(f"# serving_engine: {n_requests} reqs in {wall:.1f}s "
           f"({row['tok_s']} tok/s), hit rate {hit_rate:.1%}, "
           f"{eng.num_traces} traces/{eng.steps} steps, "
+          f"host_frac {row.get('host_frac', 'n/a')}, "
           f"oracle bitwise OK ({oracle_wall:.1f}s unshared, "
           f"{row['oracle_speedup']}x)", file=sys.stderr)
 
@@ -1885,7 +1985,9 @@ def phase_serving_engine(sweep: bool):
     # attention; on CPU this measures interpret-mode mechanics, the
     # throughput half of the A/B is the first on-chip session's
     obs.reset()
+    steploop.reset()
     kresults, kwall, keng = serve(True, backend="kernel")
+    ksl = steploop.summarize()
     ksnap = obs.snapshot()
     kls = obs.lifecycle_snapshot()
     khits = sum(ksnap["counters"].get("engine.prefix_hit_tokens",
@@ -1918,6 +2020,7 @@ def phase_serving_engine(sweep: bool):
                       khits / max(khits + kmisses, 1), kgen)
     krow["backend_tokens_equal"] = bool(match == n_requests)
     krow["backend_token_match"] = round(match / max(n_requests, 1), 4)
+    krow.update(_host_loop_stamps(ksl))
     kcost = keng.aggregate_cost()
     _emit_row(**_stamp(krow, kcost, kwall, attention_backend="kernel"))
     us = keng.unit_stats
@@ -1959,11 +2062,15 @@ def phase_serving_disagg(sweep: bool):
 
     os.environ["FLASHINFER_TPU_SPANS"] = "1"
     os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    # step-loop flight deck ON (the serving_engine rule): both pools'
+    # engines are wired, so the disagg rows carry real host-gap stamps
+    os.environ["FLASHINFER_TPU_STEPLOOP"] = "1"
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from flashinfer_tpu.models.llama import LlamaConfig, init_llama_params
+    from flashinfer_tpu.obs import steploop
     from flashinfer_tpu.serve import (DisaggServing, EngineConfig,
                                       EngineRequest, SamplingConfig,
                                       ServingEngine)
@@ -2013,11 +2120,13 @@ def phase_serving_disagg(sweep: bool):
     for rid, prompt in workload():
         disagg.submit(EngineRequest(rid, list(prompt),
                                     max_new_tokens=max_new))
+    steploop.reset()  # the handoff row's ledger window: disagg only
     t0 = _time.perf_counter()
     dis = _guard("bench.serving_disagg.disagg",
                  (n_requests, mcfg.hidden_size),
                  lambda: disagg.run())
     dis_wall = _time.perf_counter() - t0
+    dsl = steploop.summarize()
     if dis != uni:
         bad = [rid for rid in uni if dis.get(rid) != uni[rid]]
         raise AssertionError(
@@ -2044,6 +2153,7 @@ def phase_serving_disagg(sweep: bool):
         migrate_us=round(ms["seconds"] * 1e6, 1),
         disagg_tokens_equal=True,
         unified_wall_s=round(uni_wall, 2),
+        **_host_loop_stamps(dsl),
     )
     _emit_row(**_stamp(row, disagg.aggregate_cost(), dis_wall))
     print(f"# serving_disagg handoff: {n_requests} reqs, tokens "
@@ -2095,10 +2205,12 @@ def phase_serving_disagg(sweep: bool):
     small_pages = 4 * (-(-(prefix_len + max_new)
                          // ecfg_kw["page_size"])) + 1
     oracle_res, _, _ = serve_spill(ecfg_kw["num_pages"])
+    steploop.reset()  # the spill row's ledger window
     spill_res, spill_wall, seng = _guard(
         "bench.serving_disagg.spill", (small_pages, mcfg.hidden_size),
         lambda: serve_spill(small_pages, kv_offload="host",
                             spill_policy="spill", host_gib=1))
+    ssl = steploop.summarize()
     st = seng.kv_tier_stats
     if spill_res != oracle_res:
         bad = [rid for rid in oracle_res
@@ -2136,6 +2248,7 @@ def phase_serving_disagg(sweep: bool):
         spill_tokens_equal=True,
         tok_s=round(sum(len(v) for v in spill_res.values())
                     / max(spill_wall, 1e-9), 1),
+        **_host_loop_stamps(ssl),
     )
     _emit_row(**_stamp(srow, seng.aggregate_cost(), spill_wall))
     print(f"# serving_disagg spill: pool {small_pages} pages < working "

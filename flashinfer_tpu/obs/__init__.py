@@ -41,16 +41,18 @@ import contextlib
 
 from flashinfer_tpu.obs import catalog
 from flashinfer_tpu.obs.registry import (Registry, get, metrics_enabled,
-                                         spans_enabled)
+                                         spans_enabled, steploop_enabled)
 
 __all__ = [
-    "Registry", "get", "metrics_enabled", "spans_enabled", "catalog",
+    "Registry", "get", "metrics_enabled", "spans_enabled",
+    "steploop_enabled", "catalog",
     "counter_inc", "gauge_set", "observe", "record_plan",
     "record_dropped_tokens", "snapshot", "reset",
     "span", "record_retrace", "state_signature", "diff_statics",
     "diff_state_sigs", "record_span",
     "request_begin", "prefill_chunk", "decode_step", "request_finish",
     "lifecycle_snapshot",
+    "steploop_begin", "steploop_summary",
 ]
 
 _declared = False
@@ -263,3 +265,35 @@ def lifecycle_snapshot():
     from flashinfer_tpu.obs import spans as _spans
 
     return _spans.lifecycle_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Step-loop flight deck facade (obs.steploop; FLASHINFER_TPU_STEPLOOP
+# gate).  Same contract as the spans facade above: the gate is checked
+# BEFORE the module is imported, so plain library use never loads the
+# ledger (the zero-overhead subprocess pin in tests/test_steploop.py)
+# and a gated-off step surface pays one function call + one env lookup
+# + one `if tick is not None` branch per stamp.
+# ---------------------------------------------------------------------------
+
+
+def steploop_begin(surface: str):
+    """Open a step-loop ticket for one serving-step dispatch, or None
+    when the gate is off — call sites keep the ticket local and guard
+    every stamp with ``if tick is not None`` (see serve/step.py)."""
+    if not steploop_enabled():
+        return None
+    from flashinfer_tpu.obs import steploop as _steploop
+
+    return _steploop.begin(surface)
+
+
+def steploop_summary():
+    """The aggregated host-loop report over the retained ledger window
+    (host_frac, worst sub-phase, drift tails — obs.steploop.summarize),
+    or None when the gate is off."""
+    if not steploop_enabled():
+        return None
+    from flashinfer_tpu.obs import steploop as _steploop
+
+    return _steploop.summarize()

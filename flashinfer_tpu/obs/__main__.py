@@ -36,6 +36,14 @@ Commands:
   budget (<= 1 trace), and the perturbed static was named in the
   retrace-cause table — the CI gate (lint.yml), the perf/3 smoke-gate
   precedent.
+- ``steploop``: the step-loop flight deck (obs.steploop) — run the
+  compile-once fused serving loop with the ``FLASHINFER_TPU_STEPLOOP``
+  gate on, write the unified trace with the host/device step lanes
+  merged in, and print the ledger summary (host_frac, Amdahl ceiling,
+  sub-phase decomposition, drift).  ``--selftest`` exits non-zero on a
+  missing device lane, any negative gap (clock-base skew), host time
+  the named sub-phases did not attribute, or a ledger decomposition
+  that does not sum to the measured loop wall within 5% — the CI gate.
 """
 
 from __future__ import annotations
@@ -155,10 +163,14 @@ def _serving_workload(steps: int, perturb: bool) -> dict:
     state = step.make_state(mk_caches(), mk_pt(),
                             jnp.asarray(prompt_lens, jnp.int32), logits,
                             jax.random.PRNGKey(2))
+    import time as _time
+
+    loop_t0 = _time.perf_counter()
     for _ in range(int(steps)):
         tokens, state = step.run(params, state)
         for rid in rids:
             obs.decode_step(rid)
+    loop_wall_s = _time.perf_counter() - loop_t0
     summaries = [obs.request_finish(rid) for rid in rids]
     traces_loop = step.num_traces
 
@@ -180,6 +192,7 @@ def _serving_workload(steps: int, perturb: bool) -> dict:
     return {
         "num_traces_loop": traces_loop,
         "steps": int(steps),
+        "loop_wall_s": loop_wall_s,
         "cause_keys": cause_keys,
         "requests": [s for s in summaries if s],
     }
@@ -450,6 +463,89 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_steploop(args) -> int:
+    """Step-loop flight deck selftest (ISSUE 17): drive the compile-once
+    fused serving loop with the steploop gate ON, merge the host/device
+    step lanes into the unified trace, and gate on the ledger's internal
+    consistency — every step must carry a device window (the completion
+    probe ran), no gap may be negative (both edges share one
+    perf_counter base, so a negative gap means the clock math broke),
+    the named sub-phases must attribute the host window, and the
+    gap/device decomposition must sum to the EXTERNALLY measured loop
+    wall within 5% (dropped records or clock skew cannot hide)."""
+    os.environ["FLASHINFER_TPU_STEPLOOP"] = "1"
+    os.environ["FLASHINFER_TPU_SPANS"] = "1"
+    os.environ["FLASHINFER_TPU_METRICS"] = "1"
+    from flashinfer_tpu import obs, profiler
+    from flashinfer_tpu.obs import export, spans, steploop
+
+    steploop.reset()
+    profiler.start_timeline()
+    facts = _serving_workload(args.steps, perturb=False)
+    events = profiler.stop_timeline()
+    snap = obs.snapshot()
+    recs = steploop.ledger().records()
+    trace = export.write_unified_trace(
+        args.out, snap, events, spans.drain(),
+        extra_events=steploop.trace_events(recs))
+    problems = export.validate_chrome_trace(trace)
+    s = steploop.summarize(recs)
+
+    if s["steps"] < int(args.steps):
+        problems.append(
+            f"ledger recorded {s['steps']} steps across a "
+            f"{args.steps}-step loop — the ServingStep wiring is dead")
+    if s["missing_device_lane"]:
+        problems.append(
+            f"{s['missing_device_lane']} step(s) missing the device "
+            "window — the completion probe did not run")
+    if s["negative_gaps"]:
+        problems.append(
+            f"{s['negative_gaps']} negative gap(s) — dispatch/done "
+            "stamps disagree on the clock base")
+    if s["unattributed_frac"] is not None \
+            and s["unattributed_frac"] > 0.10:
+        problems.append(
+            f"{s['unattributed_frac']:.1%} of host time unattributed "
+            "(> 10%) — a call site skipped a sub-phase mark")
+    if not any(ev.get("cat") == "steploop"
+               and ev.get("tid") == steploop.TRACE_TID_DEVICE
+               for ev in trace["traceEvents"]):
+        problems.append("no steploop device lane in the unified trace")
+    # the wall check: host(first) + device + gap covers begin(first) ->
+    # done(last) by construction, so it must match the externally timed
+    # loop wall — a mismatch means records were lost or clocks skewed
+    comp_us = 0.0
+    for r in recs:
+        if r["idle"]:
+            continue
+        comp_us += r["host_us"] if r["gap_us"] is None \
+            else max(r["gap_us"], 0.0)
+        comp_us += r["device_us"] or 0.0
+    wall = facts["loop_wall_s"]
+    if wall > 0 and abs(comp_us / 1e6 - wall) / wall > 0.05:
+        problems.append(
+            f"ledger decomposition {comp_us / 1e6:.4f}s vs measured "
+            f"loop wall {wall:.4f}s — more than 5% apart")
+
+    print(f"# unified trace -> {args.out} "
+          f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    summary = {
+        "out": args.out,
+        "events": len(trace["traceEvents"]),
+        "loop_wall_s": wall,
+        "decomposed_s": comp_us / 1e6,
+        "problems": problems,
+        "steploop": s,
+    }
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    if problems and args.selftest:
+        for p in problems:
+            print(f"error: {p}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_report(args) -> int:
     from flashinfer_tpu import obs, profiler
     from flashinfer_tpu.obs import export
@@ -482,7 +578,8 @@ def cmd_doctor(args) -> int:
 
     flags = {}
     for name in ("FLASHINFER_TPU_METRICS", "FLASHINFER_TPU_SPANS",
-                 "FLASHINFER_TPU_SPANS_CAP", "FLASHINFER_TPU_LOGLEVEL",
+                 "FLASHINFER_TPU_SPANS_CAP", "FLASHINFER_TPU_STEPLOOP",
+                 "FLASHINFER_TPU_STEPLOOP_CAP", "FLASHINFER_TPU_LOGLEVEL",
                  "FLASHINFER_TPU_BACKEND", "FLASHINFER_TPU_INTERPRET",
                  "FLASHINFER_TPU_TIMELINE_SYNC", "FLASHINFER_TPU_TRACE_DUMP",
                  "FLASHINFER_TPU_TRACE_APPLY", "FLASHINFER_TPU_CACHE_DIR",
@@ -662,6 +759,32 @@ def cmd_doctor(args) -> int:
         report["engine"] = f"<unavailable: {type(e).__name__}>"
         report["kv_tier"] = f"<unavailable: {type(e).__name__}>"
 
+    # step-loop flight deck (obs.steploop): gate state plus the live
+    # ledger summary — looked up via sys.modules, never imported, so
+    # doctor itself cannot defeat the zero-overhead default (the same
+    # rule roofline's live join follows); zeros/absent in a fresh
+    # process, live host_frac / worst sub-phase / drift tails in the
+    # serving one
+    try:
+        report["host_loop"] = {"enabled": obs.steploop_enabled()}
+        _sl = sys.modules.get("flashinfer_tpu.obs.steploop")
+        if _sl is not None:
+            s = _sl.summarize()
+            report["host_loop"].update(
+                steps=s["steps"], idle_ticks=s["idle_ticks"],
+                dropped=s["dropped"], surfaces=s["surfaces"],
+                host_frac=s["host_frac"],
+                overlap_efficiency=s["overlap_efficiency"],
+                amdahl_ceiling=s["amdahl_ceiling"],
+                worst_phase=s["worst_phase"],
+                phases_us=s["phases"],
+                unattributed_frac=s["unattributed_frac"],
+                negative_gaps=s["negative_gaps"],
+                missing_device_lane=s["missing_device_lane"],
+                drift=s["drift"])
+    except Exception as e:  # doctor must never crash on a broken tree
+        report["host_loop"] = f"<unavailable: {type(e).__name__}>"
+
     # cost-model coverage (mirrors analysis L005's obs-coverage idea):
     # a decorated public op with no obs.costmodel family can bench but
     # never roofline-attribute — new ops must not silently ship
@@ -769,6 +892,22 @@ def main(argv=None) -> int:
                          "schema-valid, the retrace budget held, and "
                          "the perturbed static was named (the CI gate)")
     sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("steploop",
+                        help="step-loop flight deck: host/device "
+                             "overlap ledger over the fused serving "
+                             "loop (gate forced ON for the run)")
+    sp.add_argument("--out", metavar="PATH",
+                    default="/tmp/flashinfer_tpu_steploop_trace.json",
+                    help="unified chrome-trace output path (host/"
+                         "device step lanes merged in)")
+    sp.add_argument("--steps", type=int, default=9,
+                    help="fused serving steps to ledger")
+    sp.add_argument("--selftest", action="store_true",
+                    help="exit non-zero on a missing device lane, a "
+                         "negative gap, unattributed host time, or a "
+                         "decomposition that misses the measured loop "
+                         "wall by > 5% (the CI gate)")
+    sp.set_defaults(fn=cmd_steploop)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -108,6 +108,12 @@ MEASUREMENT_FIELDS = frozenset({
     # (the step_mode/attention_backend precedent;
     # roofline.stamp_row stamps it)
     "ingest_bytes_avoided",
+    # step-loop flight-deck stamps on serving rows (ISSUE 17): device
+    # idle per step, the host-serialization fraction of the cadence,
+    # and the cost model's predicted/measured step-time ratio — all
+    # measurements of the same run (the tpot_us/ttft_us precedent),
+    # never identity; perf/5's host_loop section joins on them
+    "host_gap_us", "host_frac", "pred_step_ratio",
 })
 
 # primary throughput metric, in preference order; all higher-is-better

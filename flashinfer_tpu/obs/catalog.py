@@ -149,6 +149,49 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
     "engine.pool_pages_free": (
         "gauge", (),
         "block-pool free-list depth after the latest engine step"),
+    "engine.idle_steps": (
+        "counter", (),
+        "engine.step calls that returned on the empty-schedule early "
+        "path (nothing runnable this tick: no dispatch, no device "
+        "work) — previously a silent return; counted so host-gap math "
+        "and step accounting never mis-attribute idle polls as device "
+        "time (the steploop ledger records the same tick as idle)"),
+    # -- step-loop flight deck (obs.steploop; FLASHINFER_TPU_STEPLOOP) ----
+    "steploop.steps": (
+        "counter", ("surface",),
+        "serving-step dispatches recorded by the step-loop ledger, per "
+        "step surface (ServingEngine / ServingStep / MixedServingStep "
+        "/ ShardedServingStep)"),
+    "steploop.idle_ticks": (
+        "counter", ("surface",),
+        "idle ticks recorded by the step-loop ledger (empty-schedule "
+        "engine polls — no dispatch, no device lane)"),
+    "steploop.host_us": (
+        "histogram", ("surface",),
+        "per-step host window: step entry to async-dispatch return "
+        "(the sum of the named sub-phases)"),
+    "steploop.phase_us": (
+        "histogram", ("surface", "phase"),
+        "named host sub-phase durations per step (engine: admit / "
+        "schedule / assemble / lower / dispatch; fused step wrappers: "
+        "signature / dispatch) — the host-gap decomposition ROADMAP "
+        "item 4's pipeline refactor is judged against"),
+    "steploop.device_us": (
+        "histogram", ("surface",),
+        "per-step device execution window: async-dispatch return to "
+        "completion-probe return (the gate-ON path adds the probe — a "
+        "per-step device sync this measurement mode pays)"),
+    "steploop.gap_us": (
+        "histogram", ("surface",),
+        "device idle between step N completion and step N+1 dispatch "
+        "per (surface, thread) lane — the host gap; host_frac = "
+        "gap / (gap + device), Amdahl ceiling = 1/(1-host_frac)"),
+    "steploop.pred_vs_measured": (
+        "histogram", ("surface",),
+        "online predicted-vs-measured drift: costmodel."
+        "predict_step_seconds over the measured step wall (ratio; "
+        "explicit DRIFT_RATIO_BUCKETS around the perfect-model 1.0) — "
+        "the automatic form of the bench pred_step_ratio join"),
     # -- tiered KV: host offload + disaggregated handoff (serve/kv_tier.py)
     "engine.kv_tier.spills": (
         "counter", (),
@@ -269,6 +312,16 @@ _LIFECYCLE_BUCKETS = {
     # lifecycle.queue_us keeps DEFAULT_BUCKETS_US (host-latency scale)
 }
 
+# Drift-ratio boundaries for steploop.pred_vs_measured (predicted /
+# measured step wall): log-spaced around the perfect-model 1.0 so both
+# "model optimistic" (<1) and "model pessimistic" (>1) tails resolve.
+# Defined HERE (not in obs.steploop) so declaring buckets never imports
+# the ledger machinery — the zero-overhead pin covers catalog.declare.
+DRIFT_RATIO_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 0.8, 0.9, 1.0,
+    1.1, 1.25, 2.0, 5.0, 10.0, 20.0, 100.0,
+)
+
 
 def declare(registry) -> None:
     """Pin non-default bucket boundaries on `registry`."""
@@ -276,6 +329,8 @@ def declare(registry) -> None:
         registry.declare_histogram(name, PERCENT_BUCKETS)
     for name, buckets in _LIFECYCLE_BUCKETS.items():
         registry.declare_histogram(name, buckets)
+    registry.declare_histogram("steploop.pred_vs_measured",
+                               DRIFT_RATIO_BUCKETS)
 
 
 # Decorated public-API op names (decorator name= or f.__qualname__).

@@ -132,10 +132,16 @@ def write_chrome_trace(path: str, snapshot: dict,
 
 def to_unified_chrome_trace(snapshot: dict,
                             timeline_events: Optional[list] = None,
-                            spans: Optional[list] = None) -> dict:
+                            spans: Optional[list] = None,
+                            extra_events: Optional[list] = None) -> dict:
     """One trace: flight-recorder spans (dicts from ``obs.spans.drain``)
     on per-thread tracks, op-timeline events on the ``ops`` track, the
-    registry snapshot as the self-describing metadata event."""
+    registry snapshot as the self-describing metadata event.
+
+    ``extra_events``: pre-built chrome-trace event dicts appended
+    verbatim — already on the epoch clock base (the contract of
+    ``obs.steploop.trace_events``, whose host/device step lanes merge
+    here)."""
     from flashinfer_tpu.profiler import perf_to_epoch_us
 
     pid = os.getpid()
@@ -169,6 +175,7 @@ def to_unified_chrome_trace(snapshot: dict,
         "name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
         "args": {"name": "ops (@flashinfer_api timeline)"},
     })
+    events.extend(extra_events or [])
     events.append({
         "name": "flashinfer_tpu.obs.snapshot", "ph": "M", "pid": pid,
         "tid": 0, "args": {"snapshot": snapshot},
@@ -178,10 +185,12 @@ def to_unified_chrome_trace(snapshot: dict,
 
 def write_unified_trace(path: str, snapshot: dict,
                         timeline_events: Optional[list] = None,
-                        spans: Optional[list] = None) -> dict:
+                        spans: Optional[list] = None,
+                        extra_events: Optional[list] = None) -> dict:
     from flashinfer_tpu.utils import atomic_write_text
 
-    trace = to_unified_chrome_trace(snapshot, timeline_events, spans)
+    trace = to_unified_chrome_trace(snapshot, timeline_events, spans,
+                                    extra_events)
     atomic_write_text(path, json.dumps(trace))
     return trace
 

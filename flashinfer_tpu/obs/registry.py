@@ -69,6 +69,18 @@ def spans_enabled() -> bool:
     return os.environ.get("FLASHINFER_TPU_SPANS", "0") not in ("", "0")
 
 
+def steploop_enabled() -> bool:
+    """The ``FLASHINFER_TPU_STEPLOOP`` gate (default off) for the
+    step-loop flight deck (obs.steploop): per-step host/device overlap
+    ledger + predicted-vs-measured drift join.  Same placement rule as
+    :func:`spans_enabled` — the gate lives HERE so checking it never
+    imports the steploop machinery (the zero-overhead subprocess pin in
+    tests/test_steploop.py).  Gate-ON steps pay a completion probe
+    (device sync per step), so this is a measurement mode, never a
+    production default."""
+    return os.environ.get("FLASHINFER_TPU_STEPLOOP", "0") not in ("", "0")
+
+
 def _label_key(labels: Dict[str, object]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 

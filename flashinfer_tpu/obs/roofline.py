@@ -545,6 +545,66 @@ def _serving_disagg(attributed: Sequence[Mapping]) -> dict:
     return {"predicted_kv_migrate": pred, "rows": measured}
 
 
+def _host_loop(attributed: Sequence[Mapping]) -> dict:
+    """The perf/5 host-loop section: the step-loop flight deck's
+    host-gap decomposition joined from two directions.
+
+    Banked side: serving rows stamped with ``host_frac`` /
+    ``host_gap_us`` / ``pred_step_ratio`` (bench measurement fields,
+    never identity) each get the Amdahl projection ``1 / (1 -
+    host_frac)`` — the speedup CEILING ROADMAP item 4's host/device
+    pipeline refactor can buy for that cell (the host work still
+    exists, it just overlaps; real wins land below the ceiling).
+
+    Live side: when the calling process has already loaded the steploop
+    ledger (``obs steploop --selftest``, an instrumented run ending in
+    ``obs perf``), its summary joins as ``live`` — real ledger data.
+    The module is looked up, NEVER imported: a plain ``obs perf`` over
+    banked rows keeps the zero-overhead default intact."""
+    import sys as _sys
+
+    measured: List[dict] = []
+    for a in attributed:
+        row = a["row"]
+        hf = row.get("host_frac")
+        if hf is None or not str(row.get("phase", "")).startswith(
+                "serving"):
+            continue
+        m = {k: row[k] for k in (
+            "phase", "model", "mode", "variant", "step_mode",
+            "attention_backend", "bs", "ctx", "us_step", "host_gap_us",
+            "host_frac", "pred_step_ratio", "chip")
+            if row.get(k) is not None}
+        m["amdahl_ceiling"] = round(1.0 / max(1.0 - float(hf), 1e-3), 3)
+        measured.append(m)
+    out: dict = {"rows": measured}
+    if measured:
+        worst = max(measured, key=lambda m: float(m["host_frac"]))
+        fracs = sorted(float(m["host_frac"]) for m in measured)
+        out["host_frac_median"] = round(fracs[len(fracs) // 2], 4)
+        out["worst"] = {
+            "phase": worst.get("phase"), "mode": worst.get("mode"),
+            "host_frac": worst["host_frac"],
+            "amdahl_ceiling": worst["amdahl_ceiling"],
+        }
+    sl = _sys.modules.get("flashinfer_tpu.obs.steploop")
+    if sl is not None:
+        s = sl.summarize()
+        if s.get("steps"):
+            out["live"] = {
+                "steps": s["steps"],
+                "idle_ticks": s["idle_ticks"],
+                "surfaces": s["surfaces"],
+                "host_frac": s["host_frac"],
+                "overlap_efficiency": s["overlap_efficiency"],
+                "amdahl_ceiling": s["amdahl_ceiling"],
+                "worst_phase": s["worst_phase"],
+                "phases_us": s["phases"],
+                "drift": s["drift"],
+            }
+    return out
+
+
 def build_perf_report(rows: Sequence[Mapping], *,
                       chip: Optional[str] = None) -> dict:
     """The ``obs perf`` report over bench rows (typically the banked
@@ -641,7 +701,7 @@ def build_perf_report(rows: Sequence[Mapping], *,
         })
 
     return {
-        "schema": "flashinfer_tpu.obs.perf/4",
+        "schema": "flashinfer_tpu.obs.perf/5",
         "chips": {name: dataclasses.asdict(s)
                   for name, s in sorted(hwspec.CHIP_SPECS.items())
                   if any(a["res"].chip == name for a in attributed)},
@@ -666,6 +726,10 @@ def build_perf_report(rows: Sequence[Mapping], *,
         # fused byte drop at the headline prefill cells + the banked
         # ingest A/B rows, joined (ISSUE 14)
         "prefill_ingest": _prefill_ingest(attributed),
+        # the host-loop dimension (perf/5): step-loop flight-deck
+        # host-gap decomposition + the Amdahl projection, from banked
+        # host_frac stamps and (when present) the live steploop ledger
+        "host_loop": _host_loop(attributed),
         "headline": _headline(attributed),
     }
 
@@ -771,6 +835,32 @@ def render_perf_report(report: Mapping) -> str:
                 + (f"  ({float(m['ingest_bytes_avoided']) / 1e6:.1f} MB"
                    f" avoided pred)" if m.get("ingest_bytes_avoided")
                    else ""))
+    hl = report.get("host_loop")
+    if hl and (hl.get("rows") or hl.get("live")):
+        lines.append("")
+        lines.append("host loop (step-loop flight deck — Amdahl ceiling "
+                     "= max speedup a perfect host/device pipeline buys):")
+        for m in hl.get("rows", []):
+            tag = m.get("mode") or m.get("variant") \
+                or m.get("step_mode") or ""
+            lines.append(
+                f"  {m.get('phase', '?'):16s} {str(tag):12s} "
+                f"host_frac {float(m['host_frac']):.3f}  "
+                f"gap {float(m.get('host_gap_us', 0)):9.1f} us  "
+                f"ceiling {m['amdahl_ceiling']:.2f}x"
+                + (f"  pred/meas {float(m['pred_step_ratio']):.3f}"
+                   if m.get("pred_step_ratio") is not None else ""))
+        live = hl.get("live")
+        if live:
+            drift = live.get("drift") or {}
+            lines.append(
+                f"  live ledger: {live['steps']} steps "
+                f"({live['idle_ticks']} idle), host_frac "
+                f"{live['host_frac']:.3f}, ceiling "
+                f"{live['amdahl_ceiling']:.2f}x, worst sub-phase "
+                f"{live['worst_phase']}"
+                + (f", drift p50 {drift.get('p50', 0):.3f}"
+                   if drift else ""))
     sc = report.get("scaling_prediction")
     if sc:
         lines.append("")

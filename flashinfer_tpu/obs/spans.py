@@ -52,10 +52,12 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 # categories a span may carry (the chrome-trace ``cat`` field); the
-# request-lifecycle phases are the ISSUE 10 tentpole set
+# request-lifecycle phases are the ISSUE 10 tentpole set, ``steploop``
+# is the step-loop flight deck's lane category (obs.steploop emits its
+# host/device lanes with it so unified-trace tooling can filter them)
 SPAN_CATEGORIES_VALID = frozenset({
     "plan", "trace", "compile", "dispatch", "request", "prefill",
-    "decode", "retrace", "host",
+    "decode", "retrace", "host", "steploop",
 })
 
 # Serving-op -> span category: the span analog of

@@ -703,12 +703,17 @@ class ShardedServingStep:
 
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
+        tick = obs.steploop_begin("ShardedServingStep")
         signed = (x0, layer_ws, caches, head, head_s, pt, lens, skey)
         sig = obs.state_signature(signed, names=self._STATE_NAMES)
+        if tick is not None:
+            tick.mark("signature")
         before = self._step.num_traces
         t0 = time.perf_counter() if sig is not None else 0.0
         out = self._step(x0, layer_ws, caches, head, head_s, pt, lens,
                          skey)
+        if tick is not None:
+            tick.dispatched()
         if self._step.num_traces > before:
             if sig is not None:
                 obs.record_span(f"{type(self).__name__}.trace_and_compile",
@@ -724,6 +729,10 @@ class ShardedServingStep:
                         obs.diff_state_sigs(self._last_sig, sig, signed))
         if sig is not None:
             self._last_sig = sig
+        if tick is not None:
+            jax.block_until_ready(out[0])  # completion probe (gate-ON)
+            tick.done()
+            tick.commit(tokens=int(x0.shape[0]))
         return out
 
 

@@ -536,6 +536,7 @@ class ServingEngine:
         self._rung_traced: Dict[int, int] = {}  # rung tokens -> traces
         self._last_sig: Dict[int, object] = {}
         self._steps = 0
+        self.idle_steps = 0  # empty-schedule polls (no dispatch)
         self.flops_avoided = 0.0  # prefill FLOPs skipped via prefix hits
         # tiered KV (serve/kv_tier.py): the host-RAM store below the
         # block pool, the in-flight migration staging of a decode-role
@@ -1205,8 +1206,13 @@ class ServingEngine:
         requests sampled/finished)."""
         from flashinfer_tpu import obs
 
+        tick = obs.steploop_begin("ServingEngine")
         self._admit()
+        if tick is not None:
+            tick.mark("admit")
         sched = self._schedule()
+        if tick is not None:
+            tick.mark("schedule")
         if not sched:
             if self._waiting and not self._running:
                 r = min(self._waiting, key=self._order_key)
@@ -1215,12 +1221,20 @@ class ServingEngine:
                     f"{self._pages_needed(r)} pages, pool has "
                     f"{self.pool.num_pages - 1} (evictable cache pages "
                     "included) — grow num_pages or shrink the request")
+            # explicit idle tick: nothing runnable, no dispatch — count
+            # it so host-gap math and step accounting never read an
+            # idle poll as device time (previously a silent return)
+            self.idle_steps += 1
+            obs.counter_inc("engine.idle_steps")
+            if tick is not None:
+                tick.commit(idle=True)
             return {"rung": 0, "tokens": 0, "sampled": 0, "finished": 0}
         cfg, mcfg = self.config, self.cfg
         ps, ppr = cfg.page_size, self._ppr
         Bpad = cfg.max_batch
         total = sum(n for _, n in sched)
         rung = self._rung_for(total)
+        kv_pairs_before = self.kv_pairs_total
 
         flat = np.zeros(rung, np.int32)
         pos = np.zeros(rung, np.int32)
@@ -1304,6 +1318,8 @@ class ServingEngine:
                 self.kv_rows_total += len(run_key) * ps
         self.tokens_total += total
         self.sampled_total += len(samplers)
+        if tick is not None:
+            tick.mark("assemble")
 
         kplans: dict = {}
         if self._kernel_backend:
@@ -1324,6 +1340,8 @@ class ServingEngine:
             us["kv_rows_launched"] += (st["prefill_rows_launched"]
                                        + st["decode_rows_launched"])
             kplans = _ek.plans_to_device(plans)
+        if tick is not None:
+            tick.mark("lower")
 
         full_args = (self.params, jnp.asarray(flat), jnp.asarray(pos),
                      jnp.asarray(tok_req), jnp.asarray(token_page),
@@ -1336,6 +1354,8 @@ class ServingEngine:
         before = self._traces
         t0 = time.perf_counter() if sig is not None else 0.0
         tokens_dev, self.caches = self._step(*full_args)
+        if tick is not None:
+            tick.dispatched()
         if self._traces > before:
             self._rung_traced[rung] = seen + 1
             if sig is not None:
@@ -1356,6 +1376,16 @@ class ServingEngine:
         if sig is not None:
             self._last_sig[rung] = sig
         tokens = np.asarray(tokens_dev)
+        if tick is not None:
+            # np.asarray above IS the completion probe (tokens cross to
+            # host); join the predicted step time online — the drift
+            # histogram ROADMAP items 1/7 wanted automated
+            tick.done()
+            tick.commit(
+                tokens=total, rung=rung,
+                predicted_s=self._predict_step_seconds(
+                    total, self.kv_pairs_total - kv_pairs_before,
+                    len(self._running)))
 
         # register freshly-completed full pages of each shareable span
         # FIRST (post-run: the page KV is materialized now, and a

@@ -391,6 +391,7 @@ class ServingStep:
 
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
+        tick = obs.steploop_begin("ServingStep")
         logits, caches, page_table, kv_lens, key = state
         # flight recorder (FLASHINFER_TPU_SPANS): the trace signature
         # over EVERY jitted argument — params included, so a swapped
@@ -399,9 +400,13 @@ class ServingStep:
         # the hot path)
         signed = (params, logits, caches, page_table, kv_lens, key)
         sig = obs.state_signature(signed, names=self._STATE_NAMES)
+        if tick is not None:
+            tick.mark("signature")
         before = self._traces
         t0 = time.perf_counter() if sig is not None else 0.0
         out = self._step(params, logits, caches, page_table, kv_lens, key)
+        if tick is not None:
+            tick.dispatched()
         if self._traces > before:
             if sig is not None:
                 # this dispatch paid a trace + XLA compile: give the
@@ -423,6 +428,12 @@ class ServingStep:
         if sig is not None:
             self._last_sig = sig
         tokens, new_logits, new_caches, pt, lens, new_key = out
+        if tick is not None:
+            # completion probe (gate-ON measurement tax: one device
+            # sync per step) — the OUTPUT blocks, never a donated input
+            jax.block_until_ready(tokens)
+            tick.done()
+            tick.commit(tokens=int(tokens.shape[0]))
         return tokens, (new_logits, new_caches, pt, lens, new_key)
 
 
@@ -756,12 +767,17 @@ class MixedServingStep:
 
         if self._step is None:
             raise RuntimeError("plan() must be called before run()")
+        tick = obs.steploop_begin("MixedServingStep")
         flat_tokens = jnp.asarray(flat_tokens, jnp.int32)
         signed = (params, flat_tokens, caches, key)
         sig = obs.state_signature(signed, names=self._STATE_NAMES)
+        if tick is not None:
+            tick.mark("signature")
         before = self._traces
         t0 = time.perf_counter() if sig is not None else 0.0
         out = self._step(params, flat_tokens, caches, key)
+        if tick is not None:
+            tick.dispatched()
         if self._traces > before:
             if sig is not None:
                 obs.record_span(f"{type(self).__name__}.trace_and_compile",
@@ -777,6 +793,10 @@ class MixedServingStep:
                         obs.diff_state_sigs(self._last_sig, sig, signed))
         if sig is not None:
             self._last_sig = sig
+        if tick is not None:
+            jax.block_until_ready(out[0])  # completion probe (gate-ON)
+            tick.done()
+            tick.commit(tokens=int(flat_tokens.shape[0]))
         return out
 
     def run_unfused(self, params, flat_tokens, caches, key):

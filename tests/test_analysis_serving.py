@@ -54,11 +54,9 @@ def test_l011_flags_post_call_donated_reuse_in_real_step():
     ONLY L011 of the three new passes."""
     real = _real("serve/step.py")
     skew = real.replace(
-        "tokens, new_logits, new_caches, pt, lens, new_key = out\n"
-        "        return tokens, (new_logits, new_caches, pt, lens, "
+        "return tokens, (new_logits, new_caches, pt, lens, "
         "new_key)",
-        "tokens, new_logits, new_caches, pt, lens, new_key = out\n"
-        "        return tokens, (new_logits, new_caches, pt, kv_lens, "
+        "return tokens, (new_logits, new_caches, pt, kv_lens, "
         "new_key)")
     assert skew != real
     by_pass = _new_pass_findings(_project(("serve/step.py", skew)))
