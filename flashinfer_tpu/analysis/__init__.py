@@ -77,9 +77,22 @@ L015  mosaic_lowering        interpret-proven-only constructs in kernel
                              match/gather — waived in place or triaged
                              into the baseline's ``mosaic_risks``
                              hardware bring-up checklist
+L016  cost_parity            kernel-vs-costmodel physics parity: the
+                             L014 symbolic walk re-run in cost mode
+                             accumulates DMA bytes + MXU FLOPs per
+                             grid step and must agree with the
+                             registered cost family under each
+                             COST_LAUNCH_BINDINGS scenario — proved
+                             drift is fixed, never baselined
+L017  chooser_coverage       priced-choice coverage: every chooser
+                             prunes through the L009 VMEM evaluator
+                             (structurally + wired at a call site),
+                             every KNOWN_KNOBS surface priced or
+                             reasonably waived, every parity binding's
+                             family/adapter intact
 ====  =====================  ==========================================
 
-L007–L015 are interprocedural: they resolve planners/kernels through
+L007–L017 are interprocedural: they resolve planners/kernels through
 the project symbol index in ``core.py``, so the planner in one module
 and the kernel in another are checked as one contract.
 
@@ -110,7 +123,8 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Set, Tuple
 
-from flashinfer_tpu.analysis import (alias_rebind, dma_race,
+from flashinfer_tpu.analysis import (alias_rebind, chooser_coverage,
+                                     cost_parity, dma_race,
                                      donation_lifetime, jit_staticness,
                                      kernel_init_guard, mosaic_lowering,
                                      obs_coverage, pallas_contract,
@@ -132,7 +146,8 @@ __all__ = [
 PASSES = (alias_rebind, signature_parity, jit_staticness, wedge,
           obs_coverage, tuning_schema, pallas_contract, tracer_leak,
           vmem_budget, kernel_init_guard, donation_lifetime,
-          static_flow, registry_coverage, dma_race, mosaic_lowering)
+          static_flow, registry_coverage, dma_race, mosaic_lowering,
+          cost_parity, chooser_coverage)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")
@@ -250,8 +265,12 @@ def partition_against_baseline(
 
 # findings that may NEVER be baselined: a reasonless suppression is by
 # definition un-triageable — the whole point of L000/W000 is that it
-# must be fixed (add the reason), not accepted
-_UNBASELINEABLE = frozenset({"L000", "W000"})
+# must be fixed (add the reason), not accepted.  L016 joins them: a
+# machine-proved kernel-vs-costmodel divergence means either the
+# kernel's traffic changed without the formula or the formula drifted
+# from the kernel; one of the two is wrong TODAY, and a baselined
+# wrong cost model silently mis-prices every chooser race.
+_UNBASELINEABLE = frozenset({"L000", "W000", "L016"})
 
 
 def _l015_rule(f: Finding) -> str:

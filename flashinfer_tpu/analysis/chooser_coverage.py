@@ -1,0 +1,337 @@
+"""L017 — chooser coverage: every priced choice prunes through the proof.
+
+The PR 6/14 chooser pattern (``choose_decode_splits``,
+``predict_prefill_ingest_win``) races candidates through the analytic
+cost model at plan time — but a candidate the compiler would reject is
+not a candidate, so every chooser must first prune through the L009
+VMEM-feasibility evaluator (decode.py ``_split_vmem_feasible``,
+prefill.py ``_ingest_vmem_feasible``).  A chooser that prices without
+pruning can select a tactic that fails to compile; a knob surface with
+no chooser and no waiver silently reverts to hand-tuning.  Both are
+exactly the "silently skipped == checked and clean" failure L013
+closed for registries, applied to the choice layer:
+
+1. **Chooser prune discipline.**  Every chooser named in
+   ``costmodel.KNOB_CHOOSERS`` must resolve in the analyzed tree, take
+   a ``feasible`` parameter, and guard its pricing loop with the
+   ``feasible is not None and not feasible(...)`` prune.  The check is
+   structural (AST), so deleting the prune — even while the signature
+   keeps the parameter — is a finding.
+2. **Call-site wiring.**  A prune parameter nobody passes is dead
+   code: at least one project call site of each chooser must wire
+   ``feasible=`` (advisory callers like roofline explainers may omit
+   it; the PLAN path must not).  Gated on the project containing call
+   sites at all, so ``--changed-only`` subsets under-report, never
+   false-fail.
+3. **Knob coverage.**  Every ``autotuner.KNOWN_KNOBS`` surface is
+   either priced (``KNOB_CHOOSERS``) or carries a reasoned
+   ``CHOOSER_WAIVERS`` entry saying WHY no pricing loop exists
+   (measured-beats-modeled, geometry derivation, topology contract…).
+   Reasonless waivers, waivers shadowing a real chooser, and
+   waivers/choosers naming retired knobs are findings — the L013
+   staleness rules verbatim.
+4. **Binding-family integrity.**  Every ``COST_LAUNCH_BINDINGS`` entry
+   (the L016 parity registry) must reference a family formula that
+   exists in the costmodel snapshot, and its adapter must actually
+   produce every category the binding's ``compare`` tolerances name —
+   otherwise L016 "passes" by comparing against nothing.
+
+Like L016, the registries are read from the PROJECT's
+``obs/costmodel.py`` executed as a snapshot (cost_parity's loader), so
+a mutated tree is judged against its own registrations, not the
+installed package.  All checks are anchor-gated: no
+``register_knob_chooser`` / ``register_knob`` calls in the analyzed
+set means the registry module is out of scope and the check skips.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from flashinfer_tpu.analysis.core import (Finding, Project,
+                                          expr_basename)
+from flashinfer_tpu.analysis.cost_parity import _load_snapshot
+
+CODE = "L017"
+
+
+# -- anchors ---------------------------------------------------------------
+
+
+def _call_lines(project: Project, fname: str,
+                key_arg: int = 0) -> Dict[str, Tuple[str, int]]:
+    """first-string-arg -> (file, line) for every ``fname("...", ...)``
+    call in the analyzed set; the finding anchors land on the
+    registration that needs editing."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Call) \
+                    and expr_basename(n.func) == fname \
+                    and len(n.args) > key_arg \
+                    and isinstance(n.args[key_arg], ast.Constant) \
+                    and isinstance(n.args[key_arg].value, str):
+                out[n.args[key_arg].value] = (sf.path, n.lineno)
+    return out
+
+
+def _binding_lines(project: Project) -> Dict[str, Tuple[str, int]]:
+    """launcher -> (file, line) of its ``CostLaunchBinding(launcher=…)``
+    construction."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for n in ast.walk(sf.tree):
+            if not (isinstance(n, ast.Call)
+                    and expr_basename(n.func) == "CostLaunchBinding"):
+                continue
+            for kw in n.keywords:
+                if kw.arg == "launcher" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out[kw.value.value] = (sf.path, n.lineno)
+    return out
+
+
+# -- check 1+2: chooser prune discipline and wiring ------------------------
+
+
+def _has_feasible_param(node) -> bool:
+    a = node.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    return "feasible" in names
+
+
+def _has_prune_guard(node) -> bool:
+    """the structural signature of the prune: a ``feasible is not
+    None`` comparison AND a ``feasible(...)`` call somewhere in the
+    chooser body — deleting either half disarms the prune."""
+    has_cmp = has_call = False
+    for n in ast.walk(node):
+        if isinstance(n, ast.Compare) and isinstance(n.left, ast.Name) \
+                and n.left.id == "feasible" \
+                and any(isinstance(op, (ast.IsNot, ast.NotEq))
+                        for op in n.ops):
+            has_cmp = True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "feasible":
+            has_call = True
+    return has_cmp and has_call
+
+
+def _chooser_call_sites(project: Project,
+                        chooser: str) -> List[Tuple[str, int, bool]]:
+    """(file, line, passes_feasible) per project call of `chooser`,
+    excluding its own definition module's registration line."""
+    out: List[Tuple[str, int, bool]] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Call) \
+                    and expr_basename(n.func) == chooser:
+                wired = any(kw.arg == "feasible" for kw in n.keywords)
+                out.append((sf.path, n.lineno, wired))
+    return out
+
+
+def _check_choosers(project: Project, findings: List[Finding],
+                    choosers: Dict[str, str]) -> None:
+    anchors = _call_lines(project, "register_knob_chooser")
+    if not anchors:
+        return  # registry module not analyzed: skip, never guess
+    for knob, chooser in sorted(choosers.items()):
+        anchor = anchors.get(knob, next(iter(anchors.values())))
+        fi = project.resolve_function(chooser)
+        if fi is None:
+            path, line = anchor
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"KNOB_CHOOSERS binds '{knob}' to '{chooser}' but no "
+                "such function exists in the analyzed tree — a renamed "
+                "chooser left a dangling registration; re-point it"))
+            continue
+        if not _has_feasible_param(fi.node):
+            findings.append(Finding(
+                CODE, fi.file.path, fi.node.lineno, chooser,
+                f"chooser '{chooser}' (knob '{knob}') takes no "
+                "``feasible`` parameter — it prices candidates the "
+                "L009 VMEM evaluator could reject; thread the prune "
+                "through (the choose_decode_splits pattern)"))
+            continue
+        if not _has_prune_guard(fi.node):
+            findings.append(Finding(
+                CODE, fi.file.path, fi.node.lineno, chooser,
+                f"chooser '{chooser}' (knob '{knob}') accepts "
+                "``feasible`` but never prunes with it (no ``feasible "
+                "is not None`` guard + ``feasible(...)`` call) — an "
+                "uncompilable candidate can win the pricing race; "
+                "restore the prune before pricing"))
+            continue
+        sites = _chooser_call_sites(project, chooser)
+        if sites and not any(w for _, _, w in sites):
+            path, line, _ = sites[0]
+            findings.append(Finding(
+                CODE, path, line, chooser,
+                f"no call site of chooser '{chooser}' passes "
+                "``feasible=`` — the VMEM prune is dead code and every "
+                "plan prices unproven candidates; wire the evaluator "
+                "at the plan-path call (decode.py "
+                "_split_vmem_feasible / prefill.py "
+                "_ingest_vmem_feasible precedent)"))
+
+
+# -- check 3: knob coverage ------------------------------------------------
+
+
+def _check_knob_coverage(project: Project, findings: List[Finding],
+                         knobs: Optional[Dict],
+                         choosers: Dict[str, str],
+                         waivers: Dict[str, str]) -> None:
+    knob_anchors = _call_lines(project, "register_knob")
+    if not knob_anchors:
+        return  # autotuner registry not analyzed: subset run
+    if knobs is None:
+        from flashinfer_tpu.autotuner import KNOWN_KNOBS as knobs
+    chooser_anchors = _call_lines(project, "register_knob_chooser")
+    waiver_anchors = _call_lines(project, "waive_chooser")
+    if not (chooser_anchors or waiver_anchors):
+        return  # chooser registry module not analyzed
+    fallback = next(iter(knob_anchors.values()))
+    for knob in sorted(set(knobs) - set(choosers) - set(waivers)):
+        path, line = knob_anchors.get(knob, fallback)
+        findings.append(Finding(
+            CODE, path, line, knob,
+            f"knob '{knob}' is registered in KNOWN_KNOBS but has "
+            "neither a KNOB_CHOOSERS pricing chooser nor a "
+            "CHOOSER_WAIVERS entry — an unpriced knob silently "
+            "reverts to hand-tuning; register the chooser or waive "
+            "with the reason pricing does not apply "
+            "(obs/costmodel.py)"))
+    for knob, reason in sorted(waivers.items()):
+        path, line = waiver_anchors.get(
+            knob, knob_anchors.get(knob, fallback))
+        if not str(reason).strip():
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"CHOOSER_WAIVERS entry for '{knob}' has no reason — "
+                "an unreviewable waiver is worse than the gap it "
+                "hides (the L000 rule, applied to the choice layer)"))
+        if knob in choosers:
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"knob '{knob}' is BOTH priced in KNOB_CHOOSERS and "
+                "waived in CHOOSER_WAIVERS — delete the stale waiver "
+                "so the chooser visibly owns the knob"))
+        if knob not in knobs:
+            findings.append(Finding(
+                CODE, path, line, knob,
+                f"CHOOSER_WAIVERS entry for '{knob}' names no "
+                "registered knob — a renamed/retired knob left a "
+                "stale waiver; prune it"))
+    for knob in sorted(set(choosers) - set(knobs)):
+        path, line = chooser_anchors.get(knob, fallback)
+        findings.append(Finding(
+            CODE, path, line, knob,
+            f"KNOB_CHOOSERS entry for '{knob}' names no registered "
+            "knob — a renamed/retired knob left a stale chooser "
+            "registration; prune or re-point it"))
+
+
+# -- check 4: binding-family integrity -------------------------------------
+
+
+def _check_binding_families(project: Project, findings: List[Finding],
+                            bindings: Dict, families_mod) -> None:
+    anchors = _binding_lines(project)
+    fallback = next(iter(anchors.values())) if anchors else None
+    for launcher in sorted(bindings):
+        b = bindings[launcher]
+        anchor = anchors.get(launcher, fallback)
+        if anchor is None:
+            continue  # registration text not in the analyzed set
+        path, line = anchor
+        family = getattr(b, "family", None)
+        if families_mod is not None \
+                and not callable(getattr(families_mod, str(family),
+                                         None)):
+            findings.append(Finding(
+                CODE, path, line, launcher,
+                f"cost-launch binding for '{launcher}' prices against "
+                f"family '{family}' which is not a callable in "
+                "obs/costmodel.py — the L016 parity check would "
+                "compare kernel traffic against nothing; fix the "
+                "family name or add the formula"))
+            continue
+        try:
+            expected = b.adapter(dict(b.scenario))
+        except Exception as e:
+            findings.append(Finding(
+                CODE, path, line, launcher,
+                f"cost-launch binding for '{launcher}': adapter "
+                f"crashed on its own declared scenario ({e!r}) — "
+                "the binding can never be evaluated; the scenario "
+                "and the family signature drifted apart"))
+            continue
+        missing = sorted(set(getattr(b, "compare", {}) or {})
+                         - set(expected or {}))
+        if missing:
+            findings.append(Finding(
+                CODE, path, line, launcher,
+                f"cost-launch binding for '{launcher}': adapter "
+                f"omits compared categor{'ies' if len(missing) > 1 else 'y'} "
+                f"{', '.join(missing)} — a tolerance with no expected "
+                "value is a check that never runs; emit the category "
+                "or drop it from `compare`"))
+
+
+# -- pass driver -----------------------------------------------------------
+
+
+def _registries(project: Project, choosers, waivers, bindings,
+                families_mod):
+    if choosers is not None and waivers is not None \
+            and bindings is not None:
+        return choosers, waivers, bindings, families_mod
+    mod, _err = _load_snapshot(project)
+    if mod is None:
+        return (choosers or {}, waivers or {}, bindings or {},
+                families_mod)
+    return (choosers if choosers is not None
+            else getattr(mod, "KNOB_CHOOSERS", {}),
+            waivers if waivers is not None
+            else getattr(mod, "CHOOSER_WAIVERS", {}),
+            bindings if bindings is not None
+            else getattr(mod, "COST_LAUNCH_BINDINGS", {}),
+            families_mod if families_mod is not None else mod)
+
+
+def run(project: Project, *, knobs: Optional[Dict] = None,
+        choosers: Optional[Dict] = None,
+        waivers: Optional[Dict] = None,
+        bindings: Optional[Dict] = None,
+        families_mod=None) -> List[Finding]:
+    findings: List[Finding] = []
+    choosers, waivers, bindings, families_mod = _registries(
+        project, choosers, waivers, bindings, families_mod)
+    _check_choosers(project, findings, choosers)
+    _check_knob_coverage(project, findings, knobs, choosers, waivers)
+    _check_binding_families(project, findings, bindings, families_mod)
+    return findings
+
+
+def stats(project: Project) -> dict:
+    """counts for ``obs doctor`` — chooser/waiver surface + findings."""
+    choosers, waivers, bindings, _mod = _registries(
+        project, None, None, None, None)
+    return {
+        "choosers": len(choosers),
+        "waivers": len(waivers),
+        "bindings": len(bindings),
+        "findings": len(run(project)),
+    }

@@ -17,6 +17,7 @@ an unreviewable waiver is worse than the finding it hides.
 from __future__ import annotations
 
 import ast
+import copy
 import dataclasses
 import os
 import re
@@ -413,6 +414,16 @@ class FnLocals:
             return None
         return vals[0]
 
+    def values_of(self, name: str) -> List[ast.expr]:
+        """Every assignment of an unmutated, non-param local — the
+        both-arms-of-an-if selection (``kernel = partial(_a, ...)`` /
+        ``kernel = partial(_b, ...)``) that ``value_of`` rightly refuses
+        to pick a winner from.  Callers FORK one analysis per candidate
+        instead of guessing (or skipping)."""
+        if name in self._params or name in self._mutated:
+            return []
+        return list(self._assigns.get(name, ()))
+
     def seq_elements(self, expr: ast.expr,
                      _depth: int = 0) -> Optional[List[ast.expr]]:
         """Statically-known elements of a list/tuple expression: a
@@ -434,6 +445,71 @@ class FnLocals:
             if v is not None:
                 return self.seq_elements(v, _depth + 1)
         return None
+
+
+_EVAL_INT_FNS = {
+    "min": min, "max": max, "abs": abs, "int": int,
+    "cdiv": lambda a, b: -(-a // b),
+    "round_up": lambda x, m: -(-x // m) * m,
+    "next_power_of_two": lambda x: 1 << max(int(x) - 1, 0).bit_length(),
+}
+
+
+def eval_int_expr(expr: Optional[ast.expr], env: Dict[str, int],
+                  locals_: Optional[FnLocals] = None,
+                  _depth: int = 0) -> Optional[int]:
+    """Fold an expression to a concrete int under a known-int env:
+    literals, env names (env wins — it carries the binding scenario),
+    once-assigned locals, +-*//%min/max/cdiv/round_up.  None, never a
+    guess, for anything else — the shared arithmetic behind grid
+    trip-count resolution (L016) and chooser scenario plumbing."""
+    if expr is None or _depth > 12:
+        return None
+    v = const_int(expr)
+    if v is not None:
+        return v
+    if isinstance(expr, ast.Name):
+        if expr.id in env and isinstance(env[expr.id], int) \
+                and not isinstance(env[expr.id], bool):
+            return env[expr.id]
+        if locals_ is not None:
+            return eval_int_expr(locals_.value_of(expr.id), env,
+                                 locals_, _depth + 1)
+        return None
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = eval_int_expr(expr.operand, env, locals_, _depth + 1)
+        return -v if v is not None else None
+    if isinstance(expr, ast.BinOp):
+        lo = eval_int_expr(expr.left, env, locals_, _depth + 1)
+        hi = eval_int_expr(expr.right, env, locals_, _depth + 1)
+        if lo is None or hi is None:
+            return None
+        if isinstance(expr.op, ast.Add):
+            return lo + hi
+        if isinstance(expr.op, ast.Sub):
+            return lo - hi
+        if isinstance(expr.op, ast.Mult):
+            return lo * hi
+        if isinstance(expr.op, ast.FloorDiv):
+            return lo // hi if hi else None
+        if isinstance(expr.op, ast.Mod):
+            return lo % hi if hi else None
+        if isinstance(expr.op, ast.LShift):
+            return lo << hi
+        return None
+    if isinstance(expr, ast.Call) and not expr.keywords:
+        fn = _EVAL_INT_FNS.get(expr_basename(expr.func))
+        if fn is None:
+            return None
+        args = [eval_int_expr(a, env, locals_, _depth + 1)
+                for a in expr.args]
+        if any(a is None for a in args):
+            return None
+        try:
+            return int(fn(*args))
+        except (TypeError, ValueError, ZeroDivisionError):
+            return None
+    return None
 
 
 _PALLAS_CALL_NAMES = {"pallas_call"}
@@ -509,10 +585,32 @@ class PallasCallSite:
     kernel_bound_posarg_exprs: List[ast.expr] = dataclasses.field(
         default_factory=list)
     grid_exprs: Optional[List[ast.expr]] = None
+    # when the kernel resolved through a CALLER of the launcher (the
+    # trampoline shape: the kernel is a launcher parameter), the bound
+    # value exprs live in the caller's scope — evaluate them there, not
+    # against the launcher's locals.  None means `locals_` is correct.
+    bound_expr_locals: Optional[FnLocals] = None
 
     @property
     def line(self) -> int:
         return self.call.lineno
+
+    def resolve_trip_counts(
+            self, env: Dict[str, int]) -> Optional[List[int]]:
+        """Concrete per-axis grid trip counts under a known-int
+        environment (a chooser/cost binding's shape scenario), or None
+        when any axis stays symbolic.  Grid exprs are evaluated with
+        ``eval_int_expr`` — env names win, then once-assigned launcher
+        locals, then literal arithmetic; anything else is not a guess."""
+        if not self.grid_exprs:
+            return None
+        trips: List[int] = []
+        for e in self.grid_exprs:
+            v = eval_int_expr(e, env, self.locals_)
+            if v is None or v <= 0:
+                return None
+            trips.append(v)
+        return trips
 
 
 def _spec_list(expr: Optional[ast.expr],
@@ -571,6 +669,16 @@ class ChainLocals(FnLocals):
                 return None
         return None
 
+    def values_of(self, name: str) -> List[ast.expr]:
+        for loc in self._chain:
+            vals = loc.values_of(name)
+            if vals:
+                return vals
+            if name in loc._assigns or name in loc._mutated \
+                    or name in loc._params:
+                return []
+        return []
+
 
 def collect_pallas_sites(project: "Project") -> List[PallasCallSite]:
     sites: List[PallasCallSite] = []
@@ -596,7 +704,7 @@ def collect_pallas_sites(project: "Project") -> List[PallasCallSite]:
                             enclosing = FunctionInfo(
                                 s.name, s.name, sf, s)
                             break
-                    sites.append(_build_site(
+                    sites.extend(_build_site(
                         project, sf, enclosing, node,
                         ChainLocals(chain or [sf.tree]),
                         chain[0] if chain else sf.tree))
@@ -605,9 +713,126 @@ def collect_pallas_sites(project: "Project") -> List[PallasCallSite]:
     return sites
 
 
+_MAX_KERNEL_FORKS = 4
+
+
+def _param_arg_exprs(project: "Project", enclosing: FunctionInfo,
+                     pname: str) -> List[Tuple[ast.expr, FnLocals]]:
+    """Argument exprs bound to launcher parameter ``pname`` at every
+    project-wide call of the launcher, each paired with the CALLER's
+    scope locals (a partial chain unwraps in the scope that wrote it).
+    Feeds the trampoline kernel shape: ``_launch(kernel, ...)`` where
+    the real kernel arrives from the wrapper one frame up."""
+    node = enclosing.node
+    a = node.args
+    pos_params = [p.arg for p in (a.posonlyargs + a.args)]
+    idx = pos_params.index(pname) if pname in pos_params else None
+    out: List[Tuple[ast.expr, FnLocals]] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+
+        def _scan(scope: ast.AST, chain: List[ast.AST],
+                  tree: ast.AST) -> None:
+            for n in walk_own_scope(scope):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if n is not node:  # the def itself is not a call site
+                        _scan(n, [n] + chain, tree)
+                elif isinstance(n, ast.Call) \
+                        and expr_basename(n.func) == enclosing.name:
+                    expr = None
+                    for k in n.keywords:
+                        if k.arg == pname:
+                            expr = k.value
+                    if expr is None and idx is not None \
+                            and idx < len(n.args) \
+                            and not any(isinstance(p, ast.Starred)
+                                        for p in n.args[: idx + 1]):
+                        expr = n.args[idx]
+                    if expr is not None:
+                        out.append((expr, ChainLocals(chain or [tree])))
+
+        _scan(f.tree, [], f.tree)
+    return out
+
+
+def _kernel_candidates(
+        project: "Project", sf: SourceFile,
+        enclosing: Optional[FunctionInfo], call: ast.Call,
+        locals_: FnLocals,
+) -> List[Tuple[FunctionInfo, Set[str], int, Dict[str, ast.expr],
+                List[ast.expr], Optional[FnLocals]]]:
+    """Statically-possible kernels behind the pallas_call's first
+    argument.  Beyond the single-resolution ``_unwrap_partial`` chase,
+    two shapes FORK one candidate per possibility instead of failing:
+    a name assigned once per branch of an if/else (the moe gather
+    rowcache-vs-plain kernel select), and a name that is a PARAMETER of
+    the enclosing launcher, resolved through every project-wide caller
+    (the sampling bisect trampoline).  Each entry is (kernel info,
+    bound kwarg names, bound posarg count, kwarg exprs, posarg exprs,
+    expr locals or None when the launch scope is already correct)."""
+    if not call.args:
+        return []
+    root = call.args[0]
+    exprs: List[Tuple[ast.expr, Optional[FnLocals]]] = [(root, None)]
+    if isinstance(root, ast.Name):
+        multi = locals_.values_of(root.id)
+        if len(multi) > 1:
+            if len(multi) > _MAX_KERNEL_FORKS:
+                return []  # too many rebinds: stay honestly unresolved
+            exprs = [(v, None) for v in multi]
+        elif not multi and enclosing is not None:
+            callers = _param_arg_exprs(project, enclosing, root.id)
+            if callers:
+                if len(callers) > _MAX_KERNEL_FORKS:
+                    return []
+                exprs = [(v, loc) for v, loc in callers]
+    out = []
+    seen: Set[int] = set()
+    for expr, expr_loc in exprs:
+        target, bound, npos, kw_exprs, pos_exprs = _unwrap_partial(
+            expr, expr_loc if expr_loc is not None else locals_)
+        info = None
+        if target is not None:
+            base = expr_basename(target)
+            if base:
+                info = project.resolve_function(base, prefer_file=sf)
+        if info is None or id(info.node) in seen:
+            continue
+        seen.add(id(info.node))
+        out.append((info, bound, npos, kw_exprs, pos_exprs, expr_loc))
+    return out
+
+
+def _lambda_grid_elts(lam: ast.Lambda,
+                      call: ast.Call) -> Optional[List[ast.expr]]:
+    """Substitute a grid-builder lambda's call args for its params in
+    its tuple body: ``grid = lambda nt: (nt, tiles_n, tiles_k)`` then
+    ``grid=grid(num_tiles)`` yields ``(num_tiles, tiles_n, tiles_k)``.
+    Positional-only and arity-exact; anything fancier returns None —
+    the rank may still be known while the element exprs are not."""
+    params = [p.arg for p in lam.args.args]
+    if (lam.args.posonlyargs or lam.args.kwonlyargs or lam.args.vararg
+            or lam.args.kwarg or lam.args.defaults or call.keywords
+            or len(call.args) != len(params)):
+        return None
+    sub = {p: a for p, a in zip(params, call.args)}
+
+    class _Subst(ast.NodeTransformer):
+        def visit_Name(self, n: ast.Name) -> ast.expr:
+            return sub.get(n.id, n)
+
+    return [_Subst().visit(copy.deepcopy(e)) for e in lam.body.elts
+            ] if isinstance(lam.body, ast.Tuple) else None
+
+
 def _build_site(project: "Project", sf: SourceFile,
                 enclosing: Optional[FunctionInfo], call: ast.Call,
-                locals_: FnLocals, scope_node: ast.AST) -> PallasCallSite:
+                locals_: FnLocals,
+                scope_node: ast.AST) -> List[PallasCallSite]:
+    """Sites for one pallas_call — usually one; one per candidate when
+    the kernel resolution legitimately forks (branch-selected kernel
+    locals, trampoline launchers)."""
     kwargs = {k.arg: k.value for k in call.keywords if k.arg}
 
     # grid spec: inline call, once-assigned local, or direct kwargs
@@ -639,6 +864,25 @@ def _build_site(project: "Project", sf: SourceFile,
     elif grid_expr is not None and const_int(grid_expr) is not None:
         grid_rank = 1
         grid_exprs = [grid_expr]
+    elif isinstance(grid_expr, ast.Call) \
+            and isinstance(grid_expr.func, ast.Name):
+        # grid built by a local helper lambda — ``grid = lambda nt:
+        # (nt, tiles_n, tiles_k)`` then ``grid=grid(num_tiles)``.  The
+        # rank is statically visible whenever EVERY candidate lambda
+        # (branch-selected rebinds included) returns a tuple of the
+        # same arity; the element exprs are kept only when the lambda
+        # is unambiguous, since branch candidates may order the axes
+        # differently and a guessed axis order is worse than none.
+        builders = locals_.values_of(grid_expr.func.id)
+        if builders and all(
+                isinstance(b, ast.Lambda)
+                and isinstance(b.body, ast.Tuple) for b in builders):
+            ranks = {len(b.body.elts) for b in builders}
+            if len(ranks) == 1:
+                grid_rank = ranks.pop()
+                if len(builders) == 1:
+                    grid_exprs = _lambda_grid_elts(
+                        builders[0], grid_expr)
 
     in_specs = _spec_list(spec_kwargs.get("in_specs"), locals_)
     out_specs = _spec_list(spec_kwargs.get("out_specs"), locals_)
@@ -651,20 +895,19 @@ def _build_site(project: "Project", sf: SourceFile,
         # the real kwargs are invisible) keeps it unknown
         scratch = []
 
-    # kernel: first positional arg, through partial and local names
-    kernel_info = None
-    bound: Set[str] = set()
-    bound_pos = 0
-    bound_kw_exprs: Dict[str, ast.expr] = {}
-    bound_pos_exprs: List[ast.expr] = []
-    if call.args:
-        (target, bound, bound_pos, bound_kw_exprs,
-         bound_pos_exprs) = _unwrap_partial(call.args[0], locals_)
-        if target is not None:
-            base = expr_basename(target)
-            if base:
-                kernel_info = project.resolve_function(
-                    base, prefer_file=sf)
+    # kernel: first positional arg, through partial chains, local
+    # names (forking on branch-selected rebinds), and trampoline params
+    cands = _kernel_candidates(project, sf, enclosing, call, locals_)
+    if not cands:
+        bound: Set[str] = set()
+        bound_pos = 0
+        bound_kw_exprs: Dict[str, ast.expr] = {}
+        bound_pos_exprs: List[ast.expr] = []
+        if call.args:
+            (_target, bound, bound_pos, bound_kw_exprs,
+             bound_pos_exprs) = _unwrap_partial(call.args[0], locals_)
+        cands = [(None, bound, bound_pos, bound_kw_exprs,
+                  bound_pos_exprs, None)]
 
     # the immediately-applied operand call, if any
     invocation = None
@@ -680,7 +923,7 @@ def _build_site(project: "Project", sf: SourceFile,
             if k.arg == "vmem_limit_bytes":
                 vmem = const_int(k.value)
 
-    return PallasCallSite(
+    return [PallasCallSite(
         file=sf, enclosing=enclosing, call=call, invocation=invocation,
         kernel=kernel_info, kernel_bound_kwargs=bound,
         kernel_bound_posargs=bound_pos,
@@ -691,4 +934,6 @@ def _build_site(project: "Project", sf: SourceFile,
         vmem_limit_bytes=vmem, locals_=locals_,
         kernel_bound_kwarg_exprs=bound_kw_exprs,
         kernel_bound_posarg_exprs=bound_pos_exprs,
-        grid_exprs=grid_exprs)
+        grid_exprs=grid_exprs, bound_expr_locals=expr_locals)
+        for (kernel_info, bound, bound_pos, bound_kw_exprs,
+             bound_pos_exprs, expr_locals) in cands]

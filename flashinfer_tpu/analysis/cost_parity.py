@@ -1,0 +1,905 @@
+"""L016 ``cost_parity`` — physics parity between kernels and formulas.
+
+The cost model (`obs/costmodel.py`) is load-bearing: ``choose_decode_
+splits``, ``predict_prefill_ingest_win``, the engine's SLO chunk
+budgeting and the perf/5 drift watchdog all trust its analytic
+bytes/FLOPs.  Nothing else checks that those formulas match the DMA
+traffic the Pallas kernels actually issue, so a kernel rewrite (PR 14's
+fused ingest rewrote prefill traffic wholesale) can silently skew every
+chooser and SLO decision downstream.  This pass is the static mirror of
+the paper's plan-time cost accounting: it re-runs the L014 symbolic
+small-step walk under a *concrete binding scenario* and accumulates
+
+- **bytes read / written** from every modeled ``make_async_copy``
+  (copy extent x declared dtype width, double-buffer warmup counted
+  once), plus the BlockSpec pipeline's implicit operand traffic
+  (block shape x index-map fetch count x grid trips), and
+- **MXU FLOPs** from every ``dot`` / ``dot_general`` site at its block
+  shapes (2 x batch x free_lhs x free_rhs x contract),
+
+extrapolates the three modeled grid steps to the scenario's real trip
+counts as ``t0 + t1*(T-2) + t2`` (warmup step + steady state + epilogue
+step — the per-step guards key on ``program_id == 0`` and
+``pid + 1 < num_programs``, which the model's N_STEPS tie reproduces
+exactly), and compares against the registered ``costmodel`` family via
+the ``COST_LAUNCH_BINDINGS`` adapter within the binding's declared
+tolerance band.
+
+A deviation beyond tolerance is a machine-proved cost-model drift:
+**fixed, never baselined** (the code is in the driver's unbaselineable
+set, like L014 races).  Anything the model cannot prove — unresolvable
+copy extents, non-literal ``dimension_numbers``, disagreeing surviving
+worlds, ``einsum`` — is a *counted skip* surfaced through ``obs
+doctor``'s ``l016_kernels`` section, never a guess.
+
+Two soundness rules inherited from L014 and sharpened here:
+
+- the walk runs with a raised unroll ceiling and *skips* (rather than
+  models-short) any loop longer than it, because a shortened loop
+  silently drops bytes;
+- the formulas are evaluated from the **project's own source snapshot**
+  (the ``obs/costmodel.py`` file in the analyzed tree, executed in a
+  scratch module), not the installed package — so the pass sees exactly
+  the formula text it is vouching for, and the skew tests' mutated
+  copies are diagnosed against themselves.
+
+A third finding family, ``[binding-drift]``, cross-checks each
+binding's declared ``vmem_shapes`` against the launch site's
+``scratch_shapes`` exprs through the L009 evaluator: a registry whose
+declared shapes disagree with the launch it prices would make the
+parity proof vacuous.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+import types
+from typing import Dict, List, Optional, Tuple
+
+from .core import (Finding, FunctionInfo, PallasCallSite, Project,
+                   eval_int_expr, expr_basename)
+from . import dma_race
+from .dma_race import (DS, KernelSkip, Ref, View, _ELL, _FULL, _Sim,
+                       _as_term, _subst)
+from .vmem_budget import _DTYPE_SIZES, _site_of
+
+_COSTMODEL_SUFFIX = "obs/costmodel.py"
+_TOL_EPS = 1e-9
+_COST_UNROLL = 16   # real chunk loops must unroll, not model short
+
+# calls whose result shape the walk must track so dot operands resolve.
+# Method-style receivers are folded into the term (the base walk's
+# uninterpreted fallthrough drops them, which would alias every
+# `.astype(f32)` into one term).  Names the base walk special-cases
+# (where/minimum/maximum/when/ds/...) are deliberately absent.
+_SHAPE_CALLS = frozenset({
+    "astype", "reshape", "transpose", "swapaxes", "repeat", "clip",
+    "sum", "max", "min", "mean", "prod", "cumsum",
+    "exp", "exp2", "tanh", "cos", "sin", "sqrt", "rsqrt", "log",
+    "log2", "square", "negative", "erf", "sigmoid",
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "broadcast_to", "broadcasted_iota", "iota",
+    "stack", "concatenate",
+})
+_ELEMWISE = frozenset({
+    "astype", "clip", "exp", "exp2", "tanh", "cos", "sin", "sqrt",
+    "rsqrt", "log", "log2", "square", "negative", "erf", "sigmoid",
+    "cumsum", "copy",
+})
+_REDUCTIONS = frozenset({"sum", "max", "min", "mean", "prod"})
+_LIKE_CTORS = frozenset({"zeros_like", "ones_like", "full_like"})
+_SHAPE_CTORS = frozenset({"zeros", "ones", "empty"})
+
+_CONFLICT = object()
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+class _CostSim(_Sim):
+    """The L014 simulator re-targeted at byte/FLOP accounting.
+
+    Scenario constants replace opaque statics, scalar-prefetch loads
+    are seeded concrete, every DMA issue is logged into the world's
+    traffic with its resolved extent, and ``dot``/``dot_general``
+    sites contribute MXU FLOPs at shapes tracked through a small
+    result-shape algebra.  Anything unresolvable raises KernelSkip.
+    """
+
+    def __init__(self, project: Project, site: PallasCallSite,
+                 kernel: FunctionInfo, final_axis: int, binding):
+        super().__init__(project, site, kernel, final_axis)
+        self.binding = binding
+        self.scenario: Dict[str, object] = dict(binding.scenario)
+        self.vshapes: Dict[str, tuple] = {
+            name: tuple(int(d) for d in shape)
+            for name, shape in binding.vmem_shapes(self.scenario).items()}
+        self.static_overrides = dict(binding.statics)
+        self.max_unroll = _COST_UNROLL
+        self._seeds = dict(binding.seeds)
+        self._termshapes: Dict[tuple, object] = {}
+        self.on_copy_start = self._record_copy
+        self.load_seed = self._seed_load
+
+    # -- seeding ----------------------------------------------------------
+
+    def _seed_load(self, name: str, idx):
+        return self._seeds.get(name)
+
+    def _itemsize(self, name: str) -> int:
+        return int(self.binding.itemsizes.get(
+            name, self.binding.default_itemsize))
+
+    def _conc(self, world, v) -> Optional[int]:
+        t = _subst(_as_term(v), world.bindings)
+        if isinstance(t, bool):
+            return int(t)
+        if isinstance(t, int):
+            return t
+        return None
+
+    # -- traffic ----------------------------------------------------------
+
+    def _record_copy(self, world, copy, line: int):
+        src = self._label(world, copy.src.ref)
+        dst = self._label(world, copy.dst.ref)
+        src_v, dst_v = src in self.vshapes, dst in self.vshapes
+        if src_v and dst_v:
+            return  # VMEM-to-VMEM staging moves no HBM bytes
+        if dst_v:
+            world.traffic.append(
+                (self.step, "r", self._view_bytes(world, dst,
+                                                  copy.dst, line)))
+        elif src_v:
+            world.traffic.append(
+                (self.step, "w", self._view_bytes(world, src,
+                                                  copy.src, line)))
+        else:
+            raise KernelSkip(
+                f"DMA at line {line}: neither `{src}` nor `{dst}` has "
+                f"a declared VMEM shape — binding vmem_shapes "
+                f"incomplete")
+
+    def _view_bytes(self, world, name: str, view: View,
+                    line: int) -> float:
+        rshape = self._index_shape(world, self.vshapes[name], view.idx)
+        if rshape is None:
+            raise KernelSkip(
+                f"copy extent on `{name}` at line {line} is not "
+                f"concrete under the binding scenario")
+        return float(_prod(rshape) * self._itemsize(name))
+
+    # -- index / shape algebra -------------------------------------------
+
+    def _index_shape(self, world, shape: tuple,
+                     idx) -> Optional[tuple]:
+        """Result shape of ``shape[idx]``; dropped scalar dims vanish,
+        ``None`` (newaxis) inserts 1, so element count is the product."""
+        idx = list(idx)
+        ndim = len(shape)
+        consumed = sum(1 for e in idx
+                       if not (isinstance(e, tuple) and e == _ELL)
+                       and e is not None)
+        if any(isinstance(e, tuple) and e == _ELL for e in idx):
+            flat = []
+            for e in idx:
+                if isinstance(e, tuple) and e == _ELL:
+                    flat.extend([_FULL] * (ndim - consumed))
+                else:
+                    flat.append(e)
+            idx = flat
+        else:
+            idx = idx + [_FULL] * (ndim - consumed)
+        out: List[int] = []
+        dims = list(shape)
+        for e in idx:
+            if e is None:
+                out.append(1)
+                continue
+            if not dims:
+                return None
+            dim = dims.pop(0)
+            if isinstance(e, tuple) and e == _FULL:
+                out.append(dim)
+            elif isinstance(e, DS) or (isinstance(e, tuple)
+                                       and len(e) == 3
+                                       and e[0] == "ds"):
+                size = e.size if isinstance(e, DS) else e[2]
+                sz = self._conc(world, size)
+                if sz is None:
+                    return None
+                out.append(sz)
+            elif isinstance(e, tuple) and len(e) == 4 \
+                    and e[0] == "slice":
+                if e[3] is not None:
+                    return None
+                lo = 0 if e[1] is None else self._conc(world, e[1])
+                hi = dim if e[2] is None else self._conc(world, e[2])
+                if lo is None or hi is None:
+                    return None
+                if lo < 0:
+                    lo += dim
+                if hi < 0:
+                    hi += dim
+                out.append(max(0, min(hi, dim) - max(0, lo)))
+            else:
+                pass  # scalar index (concrete or symbolic): dim drops
+        if dims:
+            return None
+        return tuple(out)
+
+    def _key_name(self, world, key: str) -> str:
+        for name, v in world.kenv.items():
+            if isinstance(v, Ref) and v.key == key:
+                return name
+        return key
+
+    def _broadcast(self, *shapes) -> Optional[tuple]:
+        if any(s is None or s is _CONFLICT for s in shapes):
+            return None
+        width = max(len(s) for s in shapes)
+        out = []
+        for i in range(width):
+            m = 1
+            for s in shapes:
+                j = i - (width - len(s))
+                if j < 0:
+                    continue
+                d = int(s[j])
+                if d != 1 and m != 1 and d != m:
+                    return None
+                m = max(m, d)
+            out.append(m)
+        return tuple(out)
+
+    def _shape_of(self, world, v) -> Optional[tuple]:
+        t = _as_term(v)
+        if isinstance(t, (int, float, bool)) or t is None \
+                or isinstance(t, str):
+            return ()
+        if isinstance(t, DS):
+            return ()
+        if not isinstance(t, tuple):
+            return None
+        cached = self._termshapes.get(t)
+        if cached is _CONFLICT:
+            return None
+        if cached is not None:
+            return cached
+        tag = t[0] if t else None
+        if tag == "refval":
+            return self.vshapes.get(self._key_name(world, t[1]))
+        if tag == "viewval":
+            sh = self.vshapes.get(self._key_name(world, t[1][0]))
+            if sh is None:
+                return None
+            return self._index_shape(world, sh, t[1][1])
+        if tag == "load":
+            sh = self.vshapes.get(self._key_name(world, t[1]))
+            if sh is None:
+                return None
+            return self._index_shape(world, sh, t[2])
+        if tag == "op":
+            if t[1] == "index":
+                bs = self._shape_of(world, t[2])
+                if bs is None:
+                    return None
+                return self._index_shape(world, bs, t[3])
+            return self._broadcast(self._shape_of(world, t[2]),
+                                   self._shape_of(world, t[3]))
+        if tag in ("and", "or"):
+            return self._broadcast(self._shape_of(world, t[1]),
+                                   self._shape_of(world, t[2]))
+        if tag == "not":
+            return self._shape_of(world, t[1])
+        if tag == "cmp":
+            return self._broadcast(self._shape_of(world, t[2]),
+                                   self._shape_of(world, t[3]))
+        if tag == "call":
+            if t[1] == "where" and isinstance(t[2], tuple) \
+                    and len(t[2]) == 3:
+                return self._broadcast(
+                    *[self._shape_of(world, a) for a in t[2]])
+            if t[1] in ("int", "bool", "abs", "float"):
+                return ()
+            return None
+        if tag == "static":
+            return ()
+        return None
+
+    def _reg_shape(self, term, shape):
+        if shape is None:
+            return
+        old = self._termshapes.get(term)
+        if old is None:
+            self._termshapes[term] = shape
+        elif old is not _CONFLICT and old != shape:
+            self._termshapes[term] = _CONFLICT
+
+    # -- evaluation overrides --------------------------------------------
+
+    _EQ_OPS = (ast.Eq, ast.Is)
+    _NE_OPS = (ast.NotEq, ast.IsNot)
+
+    @staticmethod
+    def _is_dtype_term(v) -> bool:
+        return isinstance(v, tuple) and len(v) == 3 \
+            and v[0] == "attr" and v[2] == "dtype"
+
+    def eval(self, node: ast.expr, env, world):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0],
+                               self._EQ_OPS + self._NE_OPS):
+            a = self.eval(node.left, env, world)
+            b = self.eval(node.comparators[0], env, world)
+            eq = None
+            if self._is_dtype_term(a) or self._is_dtype_term(b):
+                # dtype guards only pick shape-preserving cast
+                # branches — traffic/FLOP neutral — and every binding
+                # scenario computes at the storage dtype, so "equal"
+                # is both the truth here and fork-free
+                eq = True
+            elif dma_race._is_concrete(a) \
+                    and dma_race._is_concrete(b) \
+                    and type(a) is not type(b):
+                eq = bool(a == b)  # `False == "static"` enum dispatch
+            if eq is not None:
+                return eq if isinstance(node.ops[0], self._EQ_OPS) \
+                    else not eq
+            return super().eval(node, env, world)
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            base = self.eval(node.value, env, world)
+            if isinstance(base, Ref):
+                sh = self.vshapes.get(self._label(world, base))
+                if sh is not None:
+                    return [int(d) for d in sh]
+            else:
+                sh = self._shape_of(world, base)
+                if sh is not None:
+                    return [int(d) for d in sh]
+            return ("attr", _as_term(base), "shape")
+        return super().eval(node, env, world)
+
+    def _eval_subscript(self, node: ast.Subscript, env, world):
+        # a symbolic TERM (tuple) is an array value here, not a python
+        # sequence: `q[h]` on a loaded block must stay an indexed array
+        # (the base walk's seq-index fallback would python-index the
+        # term tuple itself)
+        base = self.eval(node.value, env, world)
+        if isinstance(base, tuple) and not isinstance(base, DS) \
+                and base[:1] not in (("mod",), ("sym",)):
+            idx = self._eval_index(node.slice, env, world)
+            return ("op", "index", _as_term(base),
+                    tuple(dma_race._idx_key(i) for i in idx))
+        return super()._eval_subscript(node, env, world)
+
+    def _eval_call(self, node: ast.Call, env, world):
+        base = expr_basename(node.func)
+        if base == "einsum":
+            raise KernelSkip("einsum FLOPs not modeled")
+        if base in ("dot", "dot_general"):
+            return self._eval_dot(node, env, world, base)
+        if base in _SHAPE_CALLS and isinstance(node.func, ast.Attribute):
+            return self._eval_shape_call(node, base, env, world)
+        val = super()._eval_call(node, env, world)
+        return val
+
+    def _eval_dot(self, node: ast.Call, env, world, base: str):
+        args = [self.eval(a, env, world) for a in node.args]
+        if len(args) < 2:
+            raise KernelSkip(f"{base} with < 2 operands")
+        sa = self._shape_of(world, args[0])
+        sb = self._shape_of(world, args[1])
+        if sa is None or sb is None:
+            raise KernelSkip(
+                f"{base} at line {node.lineno}: operand shape unknown "
+                f"(lhs={sa} rhs={sb})")
+        if base == "dot":
+            if len(sa) != 2 or len(sb) != 2 or sa[1] != sb[0]:
+                raise KernelSkip(
+                    f"dot at line {node.lineno} on shapes {sa} x {sb}")
+            flops = 2.0 * sa[0] * sa[1] * sb[1]
+            out = (sa[0], sb[1])
+        else:
+            dn_node = node.args[2] if len(node.args) > 2 else next(
+                (k.value for k in node.keywords
+                 if k.arg == "dimension_numbers"), None)
+            try:
+                dn = ast.literal_eval(dn_node)
+                (ca, cb), (ba, bb) = dn
+            except Exception:
+                raise KernelSkip(
+                    f"dot_general at line {node.lineno}: "
+                    f"dimension_numbers not a literal")
+            ca, cb, ba, bb = (tuple(int(i) for i in d)
+                              for d in (ca, cb, ba, bb))
+            try:
+                contract = [sa[i] for i in ca]
+                batch = [sa[i] for i in ba]
+                if contract != [sb[i] for i in cb] \
+                        or batch != [sb[i] for i in bb]:
+                    raise KernelSkip(
+                        f"dot_general at line {node.lineno}: "
+                        f"contraction shapes disagree ({sa} x {sb})")
+            except IndexError:
+                raise KernelSkip(
+                    f"dot_general at line {node.lineno}: "
+                    f"dimension_numbers out of range for {sa} x {sb}")
+            free_a = [sa[i] for i in range(len(sa))
+                      if i not in ca and i not in ba]
+            free_b = [sb[i] for i in range(len(sb))
+                      if i not in cb and i not in bb]
+            flops = 2.0 * _prod(batch) * _prod(free_a) \
+                * _prod(free_b) * _prod(contract)
+            out = tuple(batch) + tuple(free_a) + tuple(free_b)
+        world.traffic.append((self.step, "f", flops))
+        term = ("call", base, tuple(_as_term(a) for a in args))
+        self._reg_shape(term, out)
+        return term
+
+    def _eval_shape_call(self, node: ast.Call, base: str, env, world):
+        """jnp/method calls whose result shape downstream dots need.
+        The receiver joins the term so distinct `.astype(f32)` sites
+        stay distinct; ref operands keep MUST-read checking."""
+        recv = None
+        if isinstance(node.func, ast.Attribute):
+            rv = self.eval(node.func.value, env, world)
+            if not (isinstance(rv, tuple) and rv[:1] == ("mod",)):
+                recv = rv
+        args = [self.eval(a, env, world) for a in node.args]
+        kwargs = {k.arg: self.eval(k.value, env, world)
+                  for k in node.keywords if k.arg}
+        for v in [recv] + args:
+            if isinstance(v, Ref):
+                self._check_read(world, View(v, (_ELL,)), node.lineno)
+        operands = ([recv] if recv is not None else []) + args
+        shape = self._shape_call_shape(world, base, recv, args, kwargs)
+        term = ("call", base, tuple(_as_term(v) for v in operands))
+        self._reg_shape(term, shape)
+        return term
+
+    def _shape_call_shape(self, world, base: str, recv, args,
+                          kwargs) -> Optional[tuple]:
+        def first():
+            return recv if recv is not None else (
+                args[0] if args else None)
+
+        def conc_tuple(v) -> Optional[tuple]:
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, (list, tuple)):
+                out = []
+                for e in v:
+                    c = self._conc(world, e)
+                    if c is None:
+                        return None
+                    out.append(c)
+                return tuple(out)
+            return None
+
+        if base in _ELEMWISE:
+            return self._shape_of(world, first())
+        if base in _REDUCTIONS:
+            src = self._shape_of(world, first())
+            if src is None:
+                return None
+            axis = kwargs.get("axis")
+            if axis is None and recv is None and len(args) > 1:
+                axis = args[1]
+            elif axis is None and recv is not None and args:
+                axis = args[0]
+            keep = bool(kwargs.get("keepdims", False))
+            if axis is None:
+                return (1,) * len(src) if keep else ()
+            axes = [axis] if isinstance(axis, int) else \
+                ([int(a) for a in axis]
+                 if isinstance(axis, (list, tuple))
+                 and all(isinstance(a, int) for a in axis) else None)
+            if axes is None:
+                return None
+            axes = [a % len(src) for a in axes]
+            return tuple(1 if i in axes else d
+                         for i, d in enumerate(src)
+                         if keep or i not in axes)
+        if base == "reshape":
+            new = args if recv is not None else args[1:]
+            if len(new) == 1 and isinstance(new[0], (list, tuple)):
+                new = list(new[0])
+            dims = []
+            for v in new:
+                c = self._conc(world, v)
+                if c is None:
+                    return None
+                dims.append(c)
+            if dims.count(-1) == 1:
+                src = self._shape_of(
+                    world, recv if recv is not None else args[0])
+                if src is None:
+                    return None
+                rest = _prod(d for d in dims if d != -1)
+                dims[dims.index(-1)] = _prod(src) // max(rest, 1)
+            elif -1 in dims:
+                return None
+            return tuple(dims)
+        if base in ("transpose", "swapaxes"):
+            src = self._shape_of(world, first())
+            if src is None:
+                return None
+            if base == "swapaxes" and len(args) >= (2 if recv is None
+                                                    else 2):
+                ax = args[-2:] if recv is not None else args[1:3]
+                a, b = (self._conc(world, ax[0]),
+                        self._conc(world, ax[1]))
+                if a is None or b is None:
+                    return None
+                out = list(src)
+                out[a], out[b] = out[b], out[a]
+                return tuple(out)
+            perm = conc_tuple(args[0] if recv is not None and args
+                              else (args[1] if len(args) > 1 else None))
+            if perm is None:
+                return tuple(reversed(src))
+            return tuple(src[p] for p in perm)
+        if base == "repeat":
+            src = self._shape_of(world, first())
+            n = self._conc(world, args[1] if recv is None else args[0])
+            axis = self._conc(world, kwargs.get("axis"))
+            if src is None or n is None or axis is None:
+                return None
+            out = list(src)
+            out[axis % len(out)] *= n
+            return tuple(out)
+        if base in _LIKE_CTORS:
+            return self._shape_of(world, first())
+        if base in _SHAPE_CTORS or base == "full":
+            return conc_tuple(args[0]) if args else None
+        if base == "broadcast_to":
+            return conc_tuple(args[1] if recv is None and len(args) > 1
+                              else (args[0] if args else None))
+        if base in ("broadcasted_iota", "iota"):
+            for a in args:
+                sh = conc_tuple(a) if isinstance(a, (list, tuple)) \
+                    else None
+                if sh is not None and len(sh) > 1:
+                    return sh
+            return conc_tuple(args[1]) if len(args) > 1 else None
+        if base in ("stack", "concatenate"):
+            seq = args[0] if args else None
+            if not isinstance(seq, (list, tuple)) or not seq:
+                return None
+            shapes = [self._shape_of(world, e) for e in seq]
+            if any(s is None for s in shapes) \
+                    or len(set(shapes)) != 1:
+                return None
+            axis = self._conc(world, kwargs.get("axis"))
+            if axis is None and recv is None and len(args) > 1:
+                axis = self._conc(world, args[1])  # positional axis
+            if axis is None:
+                axis = 0
+            if base == "stack":
+                out = list(shapes[0])
+                out.insert(axis % (len(out) + 1), len(seq))
+                return tuple(out)
+            out = list(shapes[0])
+            out[axis % len(out)] *= len(seq)
+            return tuple(out)
+        return None
+
+
+# -- implicit BlockSpec pipeline traffic ----------------------------------
+
+
+def _spec_side_bytes(site: PallasCallSite, binding, trips: List[int],
+                     which: str) -> float:
+    """Operand bytes moved by the BlockSpec grid pipeline for one side
+    (``in`` / ``out``): block elements x index-map fetch count.  A
+    spec list the resolver cannot see (flag-conditional appends) falls
+    back to the binding's declared ``implicit_fallback`` — declared,
+    not guessed, and ignored whenever the machine CAN resolve."""
+    exprs = site.in_spec_exprs if which == "in" else site.out_spec_exprs
+    scenario = dict(binding.scenario)
+    if exprs is None:
+        fb = binding.implicit_fallback
+        if fb is None:
+            raise KernelSkip(
+                f"{which}_specs not statically resolvable and the "
+                f"binding declares no implicit_fallback")
+        d = fb(scenario)
+        return float(d.get("bytes_read" if which == "in"
+                           else "bytes_written", 0.0))
+    total = 0.0
+    rank = site.grid_rank or 0
+    for i, call in enumerate(exprs):
+        if not isinstance(call, ast.Call):
+            raise KernelSkip(f"{which}{i} spec is not a BlockSpec call")
+        if any(k.arg == "memory_space" for k in call.keywords):
+            continue  # ANY operand: its traffic is the modeled DMA
+        if not call.args:
+            raise KernelSkip(f"{which}{i} bare BlockSpec not modeled")
+        shape_node = call.args[0]
+        if not isinstance(shape_node, ast.Tuple):
+            raise KernelSkip(
+                f"{which}{i} block shape is not a literal tuple")
+        elems = 1
+        for d_ast in shape_node.elts:
+            if isinstance(d_ast, ast.Constant) and d_ast.value is None:
+                continue
+            dv = eval_int_expr(d_ast, scenario, site.locals_)
+            if dv is None:
+                raise KernelSkip(
+                    f"{which}{i} block dim not evaluable under the "
+                    f"binding scenario")
+            elems *= dv
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Lambda):
+            params = [p.arg for p in call.args[1].args.args[:rank]]
+            dmax = -1
+            for n in ast.walk(call.args[1].body):
+                if isinstance(n, ast.Name) and n.id in params:
+                    dmax = max(dmax, params.index(n.id))
+            fetches = _prod(trips[:dmax + 1]) if dmax >= 0 else 1
+        else:
+            fetches = _prod(trips)  # default index map visits the grid
+        isz = int(binding.spec_itemsizes.get(
+            f"{which}{i}", binding.default_itemsize))
+        total += float(elems) * isz * fetches
+    return total
+
+
+# -- per-binding check ----------------------------------------------------
+
+
+def _extrapolate(agg: Dict[tuple, float], kind: str, t_final: int,
+                 outer: int) -> float:
+    t = [agg.get((kind, s), 0.0) for s in range(dma_race.N_STEPS)]
+    if t_final >= 3:
+        total = t[0] + t[1] * (t_final - 2) + t[2]
+    else:  # == 2: warmup step + epilogue step, no steady state
+        total = t[0] + t[2]
+    return total * outer
+
+
+def _scratch_drift(site: PallasCallSite, fi: FunctionInfo,
+                   binding) -> List[Finding]:
+    """Declared vmem_shapes vs the launch's scratch_shapes exprs.
+
+    The L009 evaluator is a deliberate LOWER bound (itemsize 1 for
+    non-literal dtypes, min over IfExp) — good for fit proofs, wrong
+    for an equality check.  Parity needs exactness, so dims go through
+    ``eval_int_expr`` (exact or None) and the itemsize is compared
+    only when the launch declares a literal dtype name."""
+    out: List[Finding] = []
+    scenario = dict(binding.scenario)
+    sexprs = site.scratch_exprs
+    if not binding.scratch_names or sexprs is None:
+        return out
+    shapes = binding.vmem_shapes(scenario)
+    for name, idx in sorted(binding.scratch_names.items()):
+        bad = name not in shapes or idx >= len(sexprs)
+        expr = None if bad else sexprs[idx]
+        if not bad and not (isinstance(expr, ast.Call)
+                            and expr_basename(expr.func) == "VMEM"
+                            and expr.args):
+            bad = True  # index points at a semaphore / SMEM operand
+        if bad:
+            out.append(Finding(
+                "L016", fi.file.path, site.line, fi.qualname,
+                f"[binding-drift] COST_LAUNCH_BINDINGS"
+                f"[{binding.launcher!r}].scratch_names[{name!r}] -> "
+                f"{idx} does not name a VMEM scratch of the launch "
+                f"(scratch arity {len(sexprs)})"))
+            continue
+        shape_node = expr.args[0]
+        if not isinstance(shape_node, (ast.Tuple, ast.List)):
+            continue
+        elems, exact = 1, True
+        for dim in shape_node.elts:
+            if isinstance(dim, ast.Constant) and dim.value is None:
+                continue
+            dv = eval_int_expr(dim, scenario, site.locals_)
+            if dv is None:
+                exact = False
+                break
+            elems *= dv
+        if exact and elems != _prod(shapes[name]):
+            out.append(Finding(
+                "L016", fi.file.path, site.line, fi.qualname,
+                f"[binding-drift] `{name}`: the binding declares "
+                f"{_prod(shapes[name])} elements but the launch's "
+                f"scratch_shapes[{idx}] evaluates to {elems} under "
+                f"the same scenario — the registry no longer "
+                f"describes the kernel it prices"))
+        if len(expr.args) > 1:
+            sz = _DTYPE_SIZES.get(expr_basename(expr.args[1]))
+            want_sz = int(binding.itemsizes.get(
+                name, binding.default_itemsize))
+            if sz is not None and sz != want_sz:
+                out.append(Finding(
+                    "L016", fi.file.path, site.line, fi.qualname,
+                    f"[binding-drift] `{name}`: the binding prices "
+                    f"{want_sz} bytes/element but the launch declares "
+                    f"a {sz}-byte dtype"))
+    return out
+
+
+def _check_binding(project: Project, site: PallasCallSite,
+                   fi: FunctionInfo,
+                   binding) -> Tuple[List[Finding], float]:
+    scenario = dict(binding.scenario)
+    trips = site.resolve_trip_counts(scenario)
+    if trips is None:
+        raise KernelSkip("grid trip counts unresolved under scenario")
+    if trips[-1] < 2:
+        raise KernelSkip(
+            "scenario must give >= 2 final-axis grid trips (warmup + "
+            "epilogue must both be real steps)")
+    outer = _prod(trips[:-1]) if len(trips) > 1 else 1
+
+    sim = _CostSim(project, site, site.kernel,
+                   final_axis=site.grid_rank - 1, binding=binding)
+    worlds = sim._run_worlds()
+    per: List[Dict[tuple, float]] = []
+    for w in worlds:
+        agg: Dict[tuple, float] = {}
+        for (step, kind, amt) in w.traffic:
+            agg[(kind, step)] = agg.get((kind, step), 0.0) + float(amt)
+        per.append(agg)
+    if len({tuple(sorted(a.items())) for a in per}) > 1:
+        raise KernelSkip(
+            "surviving model worlds disagree on per-step traffic "
+            "totals (data-dependent DMA extent)")
+    agg = per[0] if per else {}
+
+    t_final = int(trips[-1])
+    dma_r = _extrapolate(agg, "r", t_final, outer)
+    dma_w = _extrapolate(agg, "w", t_final, outer)
+    flops = _extrapolate(agg, "f", t_final, outer)
+    imp_r = _spec_side_bytes(site, binding, trips, "in")
+    imp_w = _spec_side_bytes(site, binding, trips, "out")
+    model = {
+        "bytes_read": dma_r + imp_r,
+        "bytes_written": dma_w + imp_w,
+        "bytes_total": dma_r + imp_r + dma_w + imp_w,
+        "flops": flops,
+    }
+
+    try:
+        expected = binding.adapter(scenario)
+    except Exception as e:
+        raise KernelSkip(f"cost adapter raised: {e!r}")
+
+    findings = _scratch_drift(site, fi, binding)
+    maxdev = 0.0
+    for cat, tol in sorted(binding.compare.items()):
+        if cat not in expected:
+            findings.append(Finding(
+                "L016", fi.file.path, site.line, fi.qualname,
+                f"[binding-drift] adapter for family "
+                f"`{binding.family}` returned no `{cat}` even though "
+                f"the binding compares it"))
+            continue
+        exp = float(expected[cat])
+        got = float(model[cat])
+        dev = abs(got - exp) / max(abs(exp), 1.0)
+        maxdev = max(maxdev, dev)
+        if dev > float(tol) + _TOL_EPS:
+            findings.append(Finding(
+                "L016", fi.file.path, site.line, fi.qualname,
+                f"[cost-drift] {binding.family}.{cat}: the kernel's "
+                f"machine-derived {cat} is {got:,.0f} but the "
+                f"costmodel family prices {exp:,.0f} (deviation "
+                f"{dev:.2%} > tolerance {float(tol):.1%}) — either "
+                f"the kernel's traffic changed without the formula "
+                f"(update `{binding.family}`) or the formula drifted "
+                f"from the kernel; fix one, never baseline this"))
+    return findings, maxdev
+
+
+# -- project costmodel snapshot -------------------------------------------
+
+
+def _load_snapshot(project: Project):
+    """Execute the PROJECT's obs/costmodel.py (pure-Python by its own
+    import contract) in a scratch module and return it — the formulas
+    checked are exactly the formula text in the analyzed tree, not
+    whatever package happens to be installed.  Shared with L017, which
+    checks the chooser registries of the same snapshot."""
+    sf = None
+    for f in project.files:
+        if f.path.replace("\\", "/").endswith(_COSTMODEL_SUFFIX):
+            sf = f
+            break
+    if sf is None:
+        return None, None
+    mod = types.ModuleType("_l016_costmodel_snapshot")
+    mod.__file__ = sf.path
+    # dataclass construction resolves cls.__module__ through
+    # sys.modules, so the scratch module must be registered while the
+    # snapshot executes
+    sys.modules[mod.__name__] = mod
+    try:
+        exec(compile(sf.src, sf.path, "exec"), mod.__dict__)
+    except Exception as e:
+        return None, f"costmodel snapshot failed to execute: {e!r}"
+    finally:
+        sys.modules.pop(mod.__name__, None)
+    return mod, None
+
+
+def _load_bindings(project: Project):
+    mod, err = _load_snapshot(project)
+    if mod is None:
+        return None, err
+    return getattr(mod, "COST_LAUNCH_BINDINGS", {}), None
+
+
+# -- pass driver ----------------------------------------------------------
+
+_MEMO_CAP = 8
+_memo: Dict[tuple, tuple] = {}
+
+
+def _analyze(project: Project, bindings=None):
+    if bindings is not None:
+        return _analyze_uncached(project, bindings)
+    key = tuple(sorted((sf.path, hash(sf.src)) for sf in project.files))
+    hit = _memo.get(key)
+    if hit is not None:
+        return hit
+    result = _analyze_uncached(project, None)
+    if len(_memo) >= _MEMO_CAP:
+        _memo.pop(next(iter(_memo)))
+    _memo[key] = result
+    return result
+
+
+def _analyze_uncached(project: Project, bindings):
+    findings: List[Finding] = []
+    stats = {"families_total": 0, "families_checked": 0,
+             "families_skipped": 0, "max_deviation": 0.0,
+             "skip_reasons": {}}
+    if bindings is None:
+        bindings, err = _load_bindings(project)
+        if bindings is None:
+            if err is not None:
+                stats["families_skipped"] = 1
+                stats["skip_reasons"]["<costmodel>"] = err
+            return findings, stats  # registry out of scope: pass gated
+    for launcher in sorted(bindings):
+        binding = bindings[launcher]
+        stats["families_total"] += 1
+        try:
+            fi = project.resolve_function(launcher)
+            if fi is None:
+                raise KernelSkip("launcher not found in project")
+            site = _site_of(project, fi)
+            if site is None:
+                raise KernelSkip("no pallas_call site inside launcher")
+            if site.kernel is None:
+                raise KernelSkip("kernel reference not resolved")
+            if site.grid_rank is None:
+                raise KernelSkip("grid rank not statically visible")
+            fnds, dev = _check_binding(project, site, fi, binding)
+            findings.extend(fnds)
+            stats["families_checked"] += 1
+            stats["max_deviation"] = max(stats["max_deviation"], dev)
+        except KernelSkip as e:
+            stats["families_skipped"] += 1
+            stats["skip_reasons"][launcher] = str(e) or "unmodelable"
+    return findings, stats
+
+
+def run(project: Project, bindings=None) -> List[Finding]:
+    findings, _stats = _analyze(project, bindings)
+    return list(findings)
+
+
+def stats(project: Project) -> dict:
+    """families checked/skipped + max observed deviation for
+    ``obs doctor`` — the no-silent-skip rule applied to cost parity."""
+    _findings, st = _analyze(project)
+    return {**st, "skip_reasons": dict(st["skip_reasons"])}

@@ -284,6 +284,14 @@ class ModuleEnv:
                 elif isinstance(expr, ast.Constant) and isinstance(
                         expr.value, (str, float, bool)):
                     val = expr.value
+                elif isinstance(expr, ast.UnaryOp) \
+                        and isinstance(expr.op, ast.USub) \
+                        and isinstance(expr.operand, ast.Constant) \
+                        and isinstance(expr.operand.value,
+                                       (int, float)) \
+                        and not isinstance(expr.operand.value, bool):
+                    # `_NEG_INF = -1e30`: the sentinel-constant idiom
+                    val = -expr.operand.value
         if val is _MISSING:
             fi = self.project.resolve_function(name, prefer_file=self.file)
             if fi is not None and fi.file is self.file \
@@ -347,6 +355,12 @@ class World:
         self.stores: Dict[tuple, object] = {}
         self.findings: Set[tuple] = set()  # (line, tag, msg)
         self.activity = 0  # start/wait operations executed so far
+        # per-copy records appended by an installed `on_copy_start`
+        # hook (L016 byte accounting).  Like `stores`, EXCLUDED from
+        # state_key: the merge keeps the higher-activity (max-DMA)
+        # representative, so the surviving traffic log is a feasible
+        # world's full copy stream, never a mix.
+        self.traffic: List[tuple] = []
 
     def clone(self) -> "World":
         w = World.__new__(World)
@@ -357,6 +371,7 @@ class World:
         w.stores = dict(self.stores)
         w.findings = set(self.findings)
         w.activity = self.activity
+        w.traffic = list(self.traffic)
         return w
 
     def state_key(self, _cache: Optional[dict] = None):
@@ -606,6 +621,19 @@ class _Sim:
         self.kernel_env = WorldEnv(self.module_env)
         self.ops = 0
         self.step = 0
+        # extension points for the L016 cost walk (cost_parity), which
+        # re-runs this simulator under a concrete binding scenario:
+        # `on_copy_start(world, copy, line)` observes every DMA issue;
+        # `load_seed(refname, idx)` supplies concrete scalar-prefetch
+        # values (else loads stay symbolic terms); `static_overrides`
+        # replaces OPAQUE `_static_env` entries with scenario constants;
+        # `max_unroll` is raised so real chunk loops aren't modeled
+        # short (the `hi = lo + _MODEL_INT` clamp would silently drop
+        # bytes).  All default to L014's exact behavior.
+        self.on_copy_start = None
+        self.load_seed = None
+        self.static_overrides: Dict[str, object] = {}
+        self.max_unroll = MAX_UNROLL
 
     def _fuel(self):
         self.ops += 1
@@ -662,6 +690,8 @@ class _Sim:
         self._check_write(world, copy.dst, line)
         world.in_flight.append(_InFlight(copy, self.step))
         world.activity += 1
+        if self.on_copy_start is not None:
+            self.on_copy_start(world, copy, line)
 
     def _do_wait(self, world: World, copy: Copy, line: int):
         world.activity += 1
@@ -913,6 +943,10 @@ class _Sim:
             skey = (base.key, tuple(_idx_key(i) for i in idx))
             if skey in world.stores:
                 return world.stores[skey]
+            if self.load_seed is not None:
+                seeded = self.load_seed(self._label(world, base), idx)
+                if seeded is not None:
+                    return seeded
             return ("load", base.key, tuple(_idx_key(i) for i in idx))
         if isinstance(base, (list, tuple)):
             sl = node.slice
@@ -1097,7 +1131,13 @@ class _Sim:
                 raise KernelSkip("range() with unknown start")
             if not isinstance(hi, int):
                 hi = self._bind_int(hi, world, [_MODEL_INT])
-            if hi - lo > MAX_UNROLL:
+            if hi - lo > self.max_unroll:
+                if self.on_copy_start is not None:
+                    # a short model silently DROPS bytes — in a cost
+                    # walk that is a guess, so it must be a skip
+                    raise KernelSkip(
+                        f"range({hi - lo}) exceeds the cost-walk "
+                        f"unroll ceiling {self.max_unroll}")
                 hi = lo + _MODEL_INT  # model a long static loop short
             return RangeVal(lo, hi)
         if base == "len" and isinstance(func, ast.Name):
@@ -1176,7 +1216,7 @@ class _Sim:
         hi = _subst(hi, world.bindings)
         if isinstance(hi, (int, bool)):
             trips = int(hi) - lo
-            if trips > MAX_UNROLL:
+            if trips > self.max_unroll:
                 raise KernelSkip(
                     f"fori_loop with {trips} static iterations")
         else:
@@ -1266,11 +1306,19 @@ class _Sim:
                     env, world)
             return
         if isinstance(stmt, ast.AugAssign):
-            if not isinstance(stmt.target, ast.Name):
+            if isinstance(stmt.target, ast.Name):
+                load: ast.expr = ast.Name(id=stmt.target.id,
+                                          ctx=ast.Load())
+            elif isinstance(stmt.target, ast.Subscript):
+                # `acc_ref[...] += dot(...)` — the MXU accumulate
+                # idiom: desugar to load, fold, store so the read is
+                # hazard-checked and the stored term stays typed
+                load = ast.Subscript(value=stmt.target.value,
+                                     slice=stmt.target.slice,
+                                     ctx=ast.Load())
+            else:
                 raise KernelSkip("augmented assign to non-name")
-            cur = self.eval(ast.copy_location(
-                ast.Name(id=stmt.target.id, ctx=ast.Load()), stmt),
-                env, world)
+            cur = self.eval(ast.copy_location(load, stmt), env, world)
             rhs = self.eval(stmt.value, env, world)
             opname = self._BINOPS.get(type(stmt.op))
             if opname in _FOLD_OPS or opname in ("add", "sub", "mul",
@@ -1279,7 +1327,10 @@ class _Sim:
             else:
                 nv = ("op", opname or "unknown", _as_term(cur),
                       _as_term(rhs))
-            env.assign(stmt.target.id, nv, world)
+            if isinstance(stmt.target, ast.Name):
+                env.assign(stmt.target.id, nv, world)
+            else:
+                self._assign_target(stmt.target, nv, env, world)
             return
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             when_cond = None
@@ -1466,11 +1517,22 @@ class _Sim:
                 raise KernelSkip("model world explosion")
         return worlds
 
-    def run(self) -> List[Finding]:
+    def _run_worlds(self) -> List[World]:
+        """The small-step walk itself: N_STEPS modeled grid steps over
+        every surviving world, returned WITH their per-world state so
+        subclasses (the L016 cost model) can read accumulated traffic
+        before finding extraction."""
         node = self.kernel.node
         a = node.args
         self.grid_rank = self.site.grid_rank or 1
         statics = _static_env(self.site, self.kernel)
+        for name, val in self.static_overrides.items():
+            # scenario constants replace only OPAQUE statics: literal
+            # binds and the final-grid-axis N_STEPS tie stay the model's
+            cur = statics.get(name)
+            if cur is None or (isinstance(cur, tuple)
+                               and cur[:1] == ("static",)):
+                statics[name] = val
         pos_params = [p.arg for p in a.posonlyargs + a.args]
 
         base = World()
@@ -1492,6 +1554,10 @@ class _Sim:
             # Ref equality is by key (the param name), so re-creating
             # them per step above is identity-preserving per world.
             worlds = self._run_block_forked(node.body, worlds)
+        return worlds
+
+    def run(self) -> List[Finding]:
+        worlds = self._run_worlds()
         findings: Set[tuple] = set()
         for w in worlds:
             for ent in w.in_flight:
@@ -1540,6 +1606,8 @@ def _static_env(site: PallasCallSite,
     grid_last = None
     if site.grid_exprs:
         grid_last = ast.dump(site.grid_exprs[-1])
+    # trampoline forks carry bound exprs written in the CALLER's scope
+    expr_locals = site.bound_expr_locals or site.locals_
 
     def _value(name: str, expr: ast.expr):
         if grid_last is not None and ast.dump(expr) == grid_last:
@@ -1551,7 +1619,7 @@ def _static_env(site: PallasCallSite,
                 expr.value, (str, float, bool)):
             return expr.value
         if isinstance(expr, ast.Name):
-            v = site.locals_.value_of(expr.id)
+            v = expr_locals.value_of(expr.id)
             if v is not None:
                 return _value(name, v)
         if isinstance(expr, ast.UnaryOp) \

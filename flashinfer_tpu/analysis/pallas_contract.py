@@ -51,7 +51,12 @@ CODE = "L007"
 
 # planner -> kernel pairs whose plan-array contract L007 enforces
 # end-to-end (check 4).  Extend when a new build_* planner feeds a
-# kernel's scalar-prefetch operands.
+# kernel's scalar-prefetch operands.  Sibling registries, same
+# no-silent-skip rule: vmem_budget.KNOB_LAUNCHES (the L009 VMEM proof
+# per knob) and obs/costmodel.COST_LAUNCH_BINDINGS (the L016
+# kernel-vs-formula parity scenario per priced launcher) — a launcher
+# registered in none of the three is invisible to the analyzer, which
+# L013/L017 exist to flag.
 PLANNER_KERNELS: Dict[str, str] = {
     "build_prefill_work_units": "_fused_prefill_kernel",
     # the ingest-mode pair (ISSUE 14): build_prefill_ingest_units is

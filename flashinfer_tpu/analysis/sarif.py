@@ -36,6 +36,8 @@ RULE_DESCRIPTIONS: Dict[str, str] = {
     "L013": "incomplete knob/planner/obs registry coverage",
     "L014": "DMA/semaphore race inside a Pallas kernel body",
     "L015": "interpret-proven-only construct (Mosaic lowering risk)",
+    "L016": "kernel traffic diverges from its registered cost family",
+    "L017": "priced choice missing its VMEM prune or knob coverage",
     "L999": "unparseable source",
     "W000": "wedge-lint suppression without a reason",
     "W001": "strided-gather lowering wedge",

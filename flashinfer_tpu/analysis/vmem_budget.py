@@ -76,6 +76,13 @@ class KnobLaunch:
     aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
+# Sibling registries, same no-silent-skip rule:
+# pallas_contract.PLANNER_KERNELS (the L007 plan-array contract) and
+# obs/costmodel.COST_LAUNCH_BINDINGS (the L016 parity scenario that
+# proves a priced launcher's traffic against its cost family).  A
+# launcher whose candidates this registry's L009 proof gates should
+# also carry a parity binding — the proof says a tactic FITS, the
+# binding says the model PRICING it is honest (L017 checks both).
 KNOB_LAUNCHES: Dict[str, KnobLaunch] = {}
 
 

@@ -703,6 +703,28 @@ def cmd_doctor(args) -> int:
             "sites_unresolved": d15["sites_unresolved"],
             "findings_by_rule": dict(d15["findings_by_rule"]),
         }
+
+        # L016/L017 cost-parity coverage: a skipped family is a cost
+        # model nothing checks, an unpriced knob a choice nothing
+        # proves — surface checked-vs-skipped and the worst observed
+        # deviation so drift headroom is visible at a glance
+        from flashinfer_tpu.analysis import chooser_coverage as _chz
+        from flashinfer_tpu.analysis import cost_parity as _cpar
+
+        d16 = _cpar.stats(proj)
+        report["lint"]["l016_kernels"] = {
+            "families_checked": d16["families_checked"],
+            "families_skipped": d16["families_skipped"],
+            "max_deviation": d16["max_deviation"],
+            "skip_reasons": dict(d16["skip_reasons"]),
+        }
+        d17 = _chz.stats(proj)
+        report["lint"]["l017"] = {
+            "choosers": d17["choosers"],
+            "waivers": d17["waivers"],
+            "bindings": d17["bindings"],
+            "findings": d17["findings"],
+        }
     except Exception as e:  # doctor must never crash on a broken tree
         report["lint"] = f"<unavailable: {type(e).__name__}>"
 

@@ -544,7 +544,7 @@ def predict_prefill_ingest_win(
     total_q: int, total_kv: int, num_qo_heads: int, num_kv_heads: int,
     head_dim: int, *, hbm_tbps: float, peak_tflops: float = 0.0,
     causal: bool = True, q_bytes: int = 2, kv_bytes: int = 2,
-    cache_bytes: int = 2,
+    cache_bytes: int = 2, feasible=None,
 ) -> Tuple[bool, Dict[str, float]]:
     """Plan-time fused-ingest selection (the ``choose_decode_splits``
     pattern, ISSUE 14): roofline-forward seconds of the separate-op
@@ -560,11 +560,25 @@ def predict_prefill_ingest_win(
     roofline (the rotation/quantize FLOPs ride the VPU inside the DMA
     shadow).  Compute-bound shapes therefore still show the win of the
     two deleted memory passes; tiny shapes where everything rounds to
-    noise keep the proven composition via the 2% bar.  Returns
-    ``(use_fused, evidence_table)``."""
+    noise keep the proven composition via the 2% bar.  ``feasible``
+    is the L009 VMEM-feasibility evaluator of the fused launch at the
+    caller's shape (the ``choose_decode_splits`` prune applied to a
+    two-candidate choice): when it rejects, the fused candidate is
+    pruned before the roofline race and the proven separate
+    composition wins unconditionally.  Returns ``(use_fused,
+    evidence_table)``."""
     bd = prefill_ingest_breakdown(
         total_q, total_kv, num_qo_heads, num_kv_heads, head_dim,
         q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes)
+    if feasible is not None and not feasible():
+        # fused scratch does not fit VMEM at this shape: candidate
+        # pruned pre-pricing, evidence records why OFF was forced
+        return False, {
+            "separate_s": 0.0, "fused_s": 0.0,
+            "bytes_avoided": bd["bytes_avoided"],
+            "avoided_fraction": bd["avoided_fraction"],
+            "pruned_infeasible": 1.0,
+        }
     att = attention(total_q, total_kv, num_qo_heads, num_kv_heads,
                     head_dim, causal=causal)
     bw = hbm_tbps * 1e12
@@ -1280,3 +1294,431 @@ def _serving_row_cost(row: Mapping) -> Optional[Tuple[Cost, float]]:
                              include_sampling=False, **shape),
                 float(row["us_step_80l"]) * 1e-6)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Cost-launch bindings: the L016 cost-parity registry (launcher -> family)
+# ---------------------------------------------------------------------------
+#
+# Registration contract (the extension point every newly PRICED kernel
+# must feed — the costmodel side of the ``PLANNER_KERNELS`` /
+# ``KNOB_LAUNCHES`` triple; see analysis/pallas_contract.py and
+# analysis/vmem_budget.py for the other two):
+#
+# A :class:`CostLaunchBinding` ties one Pallas *launcher* (the function
+# whose ``pl.pallas_call`` the analyzer resolves) to the cost-model
+# *family* that prices it, plus ONE concrete scenario under which the
+# L016 ``cost_parity`` pass replays the kernel symbolically and proves
+# the formula's bytes/FLOPs against the DMA traffic and MXU dots the
+# kernel body actually issues.  Scenarios must (a) make every grid
+# trip count and BlockSpec dimension evaluable from ``scenario``
+# alone, (b) keep the grid's final axis >= 3 trips so warmup /
+# steady-state / epilogue steps are all distinguished (the
+# double-buffer warmup is counted once, not per step), and (c) keep
+# every in-kernel unrolled loop within the model's unroll ceiling.
+# ``adapter`` returns the family's EXPECTED totals for exactly the
+# traffic the launch itself moves — terms belonging to sibling
+# launches (e.g. the split-decode merge pass) are excluded, and the
+# exclusion must be justified in ``notes``.  A deviation beyond
+# ``compare``'s tolerance is a machine-proved cost-model drift:
+# fix the formula or the kernel, NEVER baseline it (L016 is in the
+# analyzer's unbaselineable set, like L014 races).
+
+
+@dataclasses.dataclass(frozen=True)
+class CostLaunchBinding:
+    """One launcher's parity contract against its pricing family.
+
+    ``launcher``/``family`` are names (resolved by the pass /
+    checked by L017), the callables are scenario -> concrete values:
+
+    - ``vmem_shapes(scenario)``: kernel-visible shape of every VMEM
+      ref the kernel's DMAs or dots touch, keyed by KERNEL param (or
+      scratch-unpack) name.  Cross-checked against the launch's
+      ``scratch_shapes`` exprs via the L009 evaluator where
+      ``scratch_names`` maps a name to its scratch index — a
+      disagreement is its own L016 finding (binding drift).
+    - ``adapter(scenario)``: expected totals per compared category
+      (``bytes_read`` / ``bytes_written`` / ``bytes_total`` /
+      ``flops``), computed by calling the family formula.
+    - ``compare``: category -> relative tolerance (0.0 = exact).
+    - ``implicit_fallback(scenario)``: declared BlockSpec-machinery
+      bytes, used ONLY for the spec side(s) the analyzer cannot
+      statically resolve (flag-conditional spec lists); sides the
+      analyzer CAN resolve are always machine-derived and the
+      declaration is ignored.  ``notes`` must say why resolution
+      fails.
+    """
+
+    launcher: str
+    family: str
+    scenario: Mapping[str, object]
+    statics: Mapping[str, object]
+    seeds: Mapping[str, int]
+    vmem_shapes: object  # Callable[[Mapping], Dict[str, tuple]]
+    adapter: object  # Callable[[Mapping], Dict[str, float]]
+    compare: Mapping[str, float]
+    itemsizes: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+    default_itemsize: int = 2
+    spec_itemsizes: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+    scratch_names: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+    implicit_fallback: Optional[object] = None
+    notes: str = ""
+
+
+COST_LAUNCH_BINDINGS: Dict[str, CostLaunchBinding] = {}
+
+
+def register_cost_launch(binding: CostLaunchBinding) -> CostLaunchBinding:
+    COST_LAUNCH_BINDINGS[binding.launcher] = binding
+    return binding
+
+
+# -- knob -> chooser coverage (L017) ----------------------------------------
+#
+# Every KNOWN_KNOBS surface must either be resolved by a registered
+# plan-time chooser (a ``choose_*`` / ``predict_*_win`` function that
+# prunes candidates through the real L009 VMEM evaluator before
+# pricing them) or carry a REASONED waiver below.  A waiver that
+# shadows a registered chooser, or names a knob that no longer
+# exists, is itself an L017 finding — same staleness rules as the
+# L013 KNOB_WAIVERS idiom.
+
+KNOB_CHOOSERS: Dict[str, str] = {}
+CHOOSER_WAIVERS: Dict[str, str] = {}
+
+
+def register_knob_chooser(knob: str, chooser: str) -> None:
+    KNOB_CHOOSERS[knob] = chooser
+
+
+def waive_chooser(knob: str, reason: str) -> None:
+    CHOOSER_WAIVERS[knob] = reason
+
+
+register_knob_chooser("decode.splits", "choose_decode_splits")
+register_knob_chooser("prefill.fused_ingest", "predict_prefill_ingest_win")
+
+waive_chooser("paged_decode.pages_per_chunk",
+              "resolved by the shared split_chunk_pages formula "
+              "(512/page clamp + 8 MiB double-buffer scratch bound), "
+              "a geometry derivation, not a priced choice")
+waive_chooser("paged_decode.prefetch",
+              "boolean pipeline toggle whose safety is proven by the "
+              "L014 race model; perf delta is A/B'd on-chip, no "
+              "analytic candidate race exists")
+waive_chooser("fused_prefill.blocks",
+              "(block_q, pages_per_chunk) is tuned by the offline "
+              "banked sweep (scripts/exp_prefill_blocks.py) and "
+              "gated by the L009 VMEM proof of the launch binding; "
+              "no plan-time pricing loop")
+waive_chooser("flash_attention.blocks",
+              "offline-swept grid blocks, L009-gated via its "
+              "KNOB_LAUNCHES binding; not priced at plan time")
+waive_chooser("moe_gmm.tiles",
+              "chosen by tune_tiles MEASURED profiling with the "
+              "VMEM-ceiling candidate filter — measurement beats the "
+              "model where both exist (docs/performance.md)")
+waive_chooser("mla_decode.layout",
+              "dictated by the latent-cache layout contract of the "
+              "serving cache, not a priced per-shape choice")
+waive_chooser("rmsnorm.row_block",
+              "bandwidth-bound elementwise kernel: row block is a "
+              "VMEM-fit resolution (L009 launch binding), every "
+              "fitting value moves the same bytes")
+waive_chooser("fused_add_rmsnorm.row_block",
+              "same as rmsnorm.row_block: VMEM-fit resolution of a "
+              "bandwidth-bound elementwise kernel")
+waive_chooser("serve.mixed_chunk",
+              "priced per-step by predict_step_seconds against the "
+              "SLO budget inside the engine scheduler (serve/step), "
+              "not by a standalone candidate chooser")
+waive_chooser("parallel.dp",
+              "mesh topology knob: validity (dp x tp == world) and "
+              "capacity math live in parallel/plan.py; no kernel "
+              "candidate set to price")
+waive_chooser("parallel.tp",
+              "mesh topology knob, see parallel.dp")
+waive_chooser("parallel.ep",
+              "mesh topology knob (must divide parallel.tp), see "
+              "parallel.dp")
+waive_chooser("engine.block_size",
+              "page-pool sharing granularity: a capacity/prefix-"
+              "cache trade priced by serving capacity math, not a "
+              "kernel-candidate race")
+waive_chooser("engine.prefill_budget_tokens",
+              "the marginal chunk is priced ONLINE by "
+              "predict_step_seconds against slo_step_seconds in the "
+              "engine scheduler; the static is a ceiling, not a "
+              "candidate choice")
+waive_chooser("engine.max_batch",
+              "compile-once rung-ladder width: a memory-capacity "
+              "ceiling from the HBM budget, not a priced choice")
+waive_chooser("engine.kv_offload",
+              "deployment capacity toggle (host tier attached or "
+              "not); spill pricing happens per-victim under "
+              "engine.spill_policy")
+waive_chooser("engine.spill_policy",
+              "'auto' performs the per-victim restore-vs-recompute "
+              "cost comparison inline in the engine (via "
+              "predict_step_seconds); the knob selects the policy, "
+              "the pricing is not a choose_* surface")
+waive_chooser("engine.host_gib",
+              "host-RAM capacity budget; LRU eviction over it is "
+              "counted, there is no candidate set to price")
+waive_chooser("engine.attention_backend",
+              "correctness-tier dispatch (reference oracle vs Pallas "
+              "kernels); the kernel tier's internal choices are "
+              "priced by decode.splits / prefill.fused_ingest")
+
+
+# -- the five priced kernel families ----------------------------------------
+
+
+def _gmm_vmem_shapes(sc):
+    tm, tk, tn = sc["tm"], sc["tk"], sc["tn"]
+    return {"lhs_ref": (tm, tk), "rhs_ref": (tk, tn),
+            "out_ref": (tm, tn), "acc_ref": (tm, tn)}
+
+
+def _gmm_adapter(sc):
+    c = gemm(sc["m"], sc["n"], sc["k"])
+    return {"bytes_read": c.bytes_read,
+            "bytes_written": c.bytes_written, "flops": c.flops}
+
+
+def _gmm_implicit(sc):
+    # in_specs is extended under the quantized flag, so the analyzer
+    # cannot statically resolve the list; the bf16 scenario's two
+    # operands are declared here: lhs re-streamed per k-tile sweep
+    # (tiles_n == 1 in the scenario so lhs streams once), rhs panels
+    # once per (group, k) visit.
+    return {"bytes_read": float(sc["m"]) * sc["k"] * 2
+            + float(sc["k"]) * sc["n"] * 2}
+
+
+register_cost_launch(CostLaunchBinding(
+    launcher="gmm",
+    family="gemm",
+    scenario=dict(tiles_n=1, num_tiles=1, tiles_k=2, tm=128, tk=512,
+                  tn=128, m=128, k=1024, n=128),
+    statics=dict(tm=128, tiles_k=2, quantized=False),
+    seeds=dict(offsets_s=0, tile_group_s=0, tile_m_s=0),
+    vmem_shapes=_gmm_vmem_shapes,
+    adapter=_gmm_adapter,
+    compare={"bytes_read": 0.0, "bytes_written": 0.0, "flops": 0.0},
+    itemsizes={"acc_ref": 4},
+    spec_itemsizes={"out0": 2},
+    scratch_names={"acc_ref": 0},
+    implicit_fallback=_gmm_implicit,
+    notes="One expert tile, one n-tile, two k-tiles of the bf16 "
+          "grouped matmul: exactly one gemm(m, n, k) with every "
+          "operand streamed once, so parity is exact (tol 0). The "
+          "masked-partial-store epilogue re-reads the resident out "
+          "block in VMEM, not HBM.",
+))
+
+
+def _paged_decode_vmem_shapes(sc):
+    ppc, hkv = sc["pages_per_chunk"], sc["num_kv_heads"]
+    ps, d, gp = sc["page_size"], sc["head_dim"], sc["gp"]
+    return {"k_buf": (2, ppc, hkv, ps, d), "v_buf": (2, ppc, hkv, ps, d),
+            "q_ref": (hkv, gp, d), "o_ref": (hkv, gp, d),
+            "lse_ref": (hkv, gp, 128)}
+
+
+def _paged_decode_adapter(sc):
+    c = paged_decode(sc["batch"], sc["ctx"], sc["num_qo_heads"],
+                     sc["num_kv_heads"], sc["head_dim"])
+    return {"bytes_read": c.bytes_read, "flops": c.flops,
+            "bytes_total": c.bytes_total}
+
+
+register_cost_launch(CostLaunchBinding(
+    launcher="_paged_decode_hnd_launch",
+    family="paged_decode",
+    scenario=dict(batch=4, ctx=512, num_qo_heads=16, num_kv_heads=2,
+                  group=8, gp=8, head_dim=128, page_size=16,
+                  pages_per_chunk=8),
+    statics=dict(page_size=16, ppc=8, sm_scale=1.0,
+                 logits_soft_cap=0.0, window_left=-1, num_kv_heads=2,
+                 cross_step_prefetch=False, compute_dtype="bfloat16"),
+    seeds=dict(pages_ref=0, kvlen_ref=512, base_smem=0),
+    vmem_shapes=_paged_decode_vmem_shapes,
+    adapter=_paged_decode_adapter,
+    compare={"bytes_read": 0.0, "flops": 0.0, "bytes_total": 0.02},
+    itemsizes={"lse_ref": 4},
+    spec_itemsizes={"in0": 2, "out0": 2, "out1": 4},
+    scratch_names={"k_buf": 0, "v_buf": 1},
+    notes="Full-cache HND decode at 4 requests x 512 ctx: reads and "
+          "FLOPs are exact; bytes_total carries a 2% band because "
+          "the kernel also writes the f32 LSE block (B*Hkv*Gp*128*4 "
+          "= +1.5% here) which the algorithmic formula folds into "
+          "the outputs-written-once convention (LSE is consumed by "
+          "the cascade merge, not a decode deliverable).",
+))
+
+
+def _decode_split_vmem_shapes(sc):
+    ppc, hkv = sc["pages_per_chunk"], sc["num_kv_heads"]
+    ps, d, gp = sc["page_size"], sc["head_dim"], sc["gp"]
+    return {"k_buf": (2, ppc, hkv, ps, d), "v_buf": (2, ppc, hkv, ps, d),
+            "q_ref": (hkv, gp, d), "o_ref": (hkv, gp, d),
+            "lse_ref": (hkv, gp, 128)}
+
+
+def _decode_split_adapter(sc):
+    bd = decode_split_breakdown(
+        sc["batch"], sc["ctx"], sc["num_qo_heads"],
+        sc["num_kv_heads"], sc["head_dim"],
+        num_splits=sc["num_splits"], page_size=sc["page_size"],
+        pages_per_chunk=sc["pages_per_chunk"])
+    per_tok = 2.0 * sc["num_qo_heads"] * 2 * sc["head_dim"]
+    return {"bytes_read": bd["kv_bytes"] + bd["q_bytes"],
+            "bytes_written": bd["merge_bytes"] / 2.0,
+            "flops": bd["kv_tokens_launched"] * per_tok}
+
+
+register_cost_launch(CostLaunchBinding(
+    launcher="paged_decode_attention_split",
+    family="decode_split",
+    scenario=dict(batch=4, ctx=256, num_splits=2, num_units=8,
+                  num_qo_heads=16, num_kv_heads=2, group=8, gp=8,
+                  head_dim=128, page_size=16, pages_per_chunk=8),
+    statics=dict(page_size=16, ppc=8, sm_scale=1.0,
+                 logits_soft_cap=0.0, window_left=-1, num_kv_heads=2,
+                 single_chunk=True),
+    seeds=dict(pages_ref=0, kvlen_ref=256, req_ref=0, page0_ref=0,
+               uklen_ref=128),
+    vmem_shapes=_decode_split_vmem_shapes,
+    adapter=_decode_split_adapter,
+    compare={"bytes_read": 0.0, "bytes_written": 0.0, "flops": 0.0},
+    itemsizes={"o_ref": 4, "lse_ref": 4},
+    spec_itemsizes={"in0": 2, "out0": 4, "out1": 4},
+    scratch_names={"k_buf": 0, "v_buf": 1},
+    notes="4 requests x 256 ctx split 2 ways = 8 single-chunk work "
+          "units. The kernel's share of decode_split is exact (tol "
+          "0): reads = kv_bytes + q_bytes, writes = merge_bytes/2 "
+          "(the f32 partial out+lse), flops = the whole-chunk KV "
+          "walk. The OTHER half of the family's totals — the "
+          "merge_bytes/2 read-back, the merged out_bytes write and "
+          "the 2*merge_elems reduction FLOPs — belongs to the "
+          "merge_states launch and is excluded here.",
+))
+
+
+def _fused_prefill_stats(sc):
+    u = sc["num_units"]
+    chunk = sc["ppc"] * sc["page_size"]
+    cells = u * sc["bq"] * chunk
+    return {"tiles": u, "units": u, "mxu_cells_total": cells,
+            "mxu_cells_valid": cells}
+
+
+def _fused_prefill_vmem_shapes(sc):
+    bq, g, d = sc["bq"], sc["group"], sc["head_dim"]
+    chunk = sc["ppc"] * sc["page_size"]
+    return {"qbuf": (2, bq, g, d), "kbuf": (2, chunk, d),
+            "vbuf": (2, chunk, d), "obuf": (bq, g, d),
+            "acc_ref": (bq * g, d), "m_ref": (bq * g, 128),
+            "l_ref": (bq * g, 128), "lsebuf": (bq, g, 128)}
+
+
+def _fused_prefill_adapter(sc):
+    c = fused_prefill_from_stats(
+        _fused_prefill_stats(sc), block_q=sc["bq"],
+        pages_per_chunk=sc["ppc"], page_size=sc["page_size"],
+        num_qo_heads=sc["num_qo_heads"], num_kv_heads=sc["Hkv"],
+        head_dim=sc["head_dim"], total_q=sc["num_units"] * sc["bq"])
+    return {"bytes_read": c.bytes_read,
+            "bytes_written": c.bytes_written, "flops": c.flops}
+
+
+def _fused_prefill_implicit(sc):
+    # every q/k/v/o operand is ANY (manual DMA); the spec lists are
+    # extended under has_mask / return_lse / trace_events flags (all
+    # pinned off by the scenario), hence statically unresolvable.
+    return {"bytes_read": 0.0, "bytes_written": 0.0}
+
+
+register_cost_launch(CostLaunchBinding(
+    launcher="fused_paged_prefill",
+    family="fused_prefill_from_stats",
+    scenario=dict(Hkv=2, num_units=4, num_qo_heads=16, bq=128,
+                  group=8, head_dim=128, page_size=16, ppc=8),
+    statics=dict(bq=128, ppc=8, page_size=16, group=8, sm_scale=1.0,
+                 logits_soft_cap=0.0, window_left=-1, causal=True,
+                 has_mask=False, return_lse=False, trace_events=False),
+    seeds=dict(qstart_ref=0, rowlo_ref=0, rowhi_ref=128, qpos0_ref=0,
+               kvstart_ref=0, kvlen_ref=128, first_ref=1, wout_ref=1,
+               qslot_ref=0, code_ref=0, pages_ref=0),
+    vmem_shapes=_fused_prefill_vmem_shapes,
+    adapter=_fused_prefill_adapter,
+    compare={"bytes_read": 0.0, "bytes_written": 0.0, "flops": 0.0},
+    itemsizes={"acc_ref": 4, "m_ref": 4, "l_ref": 4, "lsebuf": 4},
+    implicit_fallback=_fused_prefill_implicit,
+    notes="4 work units, each its own q tile and single full KV "
+          "chunk (first=wout=1, CODE_FULL): the stats adapter's "
+          "tiles/units/cells mirror the plan exactly, so parity is "
+          "exact (tol 0) on reads, writes and MXU FLOPs.",
+))
+
+
+def _prefill_ingest_vmem_shapes(sc):
+    bq, g, d = sc["bq"], sc["group"], sc["head_dim"]
+    chunk = sc["ppc"] * sc["page_size"]
+    return {"qbuf": (2, bq, g, d), "kbuf": (2, chunk, d),
+            "vbuf": (2, chunk, d), "obuf": (bq, g, d),
+            "kqbuf": (chunk, d), "vqbuf": (chunk, d),
+            "acc_ref": (bq * g, d), "m_ref": (bq * g, 128),
+            "l_ref": (bq * g, 128), "lsebuf": (bq, g, 128)}
+
+
+def _prefill_ingest_adapter(sc):
+    u = sc["num_units"]
+    chunk = sc["ppc"] * sc["page_size"]
+    total_q, total_kv = u * sc["bq"], u * chunk
+    hq, hkv, d = sc["num_qo_heads"], sc["Hkv"], sc["head_dim"]
+    c = prefill_ingest(
+        total_q, total_kv, hq, hkv, d, stats=_fused_prefill_stats(sc),
+        block_q=sc["bq"], pages_per_chunk=sc["ppc"],
+        page_size=sc["page_size"])
+    rope_flops = 6.0 * (total_q * hq + total_kv * hkv) * d
+    quant_flops = 2.0 * 2.0 * total_kv * hkv * d
+    return {"bytes_read": c.bytes_read,
+            "bytes_written": c.bytes_written,
+            "flops": c.flops - rope_flops - quant_flops}
+
+
+register_cost_launch(CostLaunchBinding(
+    launcher="fused_paged_prefill_ingest",
+    family="prefill_ingest",
+    scenario=dict(Hkv=2, num_units=4, num_qo_heads=16, bq=128,
+                  group=8, head_dim=128, page_size=16, ppc=8),
+    statics=dict(bq=128, ppc=8, page_size=16, group=8, head_dim=128,
+                 sm_scale=1.0, logits_soft_cap=0.0, window_left=-1,
+                 causal=True, has_mask=False, return_lse=False,
+                 attend=True, rope_scale=1.0, rope_theta=10000.0,
+                 rope_interleave=False, kv_quant="none", k_scale=1.0,
+                 v_scale=1.0),
+    seeds=dict(qstart_ref=0, rowlo_ref=0, rowhi_ref=128, qpos0_ref=0,
+               kvstart_ref=0, kvlen_ref=128, first_ref=1, wout_ref=1,
+               qslot_ref=0, code_ref=0, pages_ref=0, kvbase_ref=0,
+               posoff_ref=0, wkv_ref=1),
+    vmem_shapes=_prefill_ingest_vmem_shapes,
+    adapter=_prefill_ingest_adapter,
+    compare={"bytes_read": 0.0, "bytes_written": 0.0, "flops": 0.0},
+    itemsizes={"acc_ref": 4, "m_ref": 4, "l_ref": 4, "lsebuf": 4},
+    implicit_fallback=_fused_prefill_implicit,
+    notes="4 single-chunk work units owning their cache pages "
+          "(wkv=1): raw q/k/v stream in once, quantized pages write "
+          "out once, so the stats-mode prefill_ingest reads/writes "
+          "are exact (tol 0) — this is the binding whose read side "
+          "deletes if the fused-ingest 'avoided Kc re-read' term "
+          "regresses.  FLOPs compare MXU dots only: the family's "
+          "rope (6/elt) and quantize (4/elt) terms are VPU work the "
+          "MXU dot walk never sees, subtracted in the adapter.",
+))

@@ -49,6 +49,17 @@ from flashinfer_tpu.utils import cdiv, round_up, use_interpret
 
 _NEG_INF = -1e30
 
+# Plan-static cast targets: the launch knows every dtype in play at
+# trace time, so the decode kernels take the cast TARGET as a static
+# name selecting from this literal map.  An unsupported dtype fails at
+# trace instead of lowering through an unproven Mosaic cast path — the
+# enumerable, per-pair-testable set the L015 [cast] lint asks for.
+_CAST_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
 
 def _decode_kernel(
     # scalar prefetch
@@ -71,6 +82,7 @@ def _decode_kernel(
     sm_scale: float,
     logits_soft_cap: float,
     window_left: int,
+    out_dtype: str,  # o_ref's dtype name, from _CAST_DTYPES
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -148,7 +160,7 @@ def _decode_kernel(
     m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
 
     l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    o_ref[...] = (acc / l_safe).astype(_CAST_DTYPES[out_dtype])
     lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
     lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
@@ -177,6 +189,7 @@ def _decode_kernel_fused_heads(
     window_left: int,
     num_kv_heads: int,
     cross_step_prefetch: bool,
+    compute_dtype: str,  # q's (== o's) dtype name, from _CAST_DTYPES
 ):
     """HND fast path: one DMA per whole page serves every KV head.
 
@@ -271,6 +284,7 @@ def _decode_kernel_fused_heads(
     q = q_ref[...]  # [Hkv, Gp, D] native dtype
     gp = q.shape[1]
     head_dim = q.shape[2]
+    cdt = _CAST_DTYPES[compute_dtype]  # literal cast target (== q.dtype)
 
     def body(i, carry):
         m, l, acc = carry  # [Hkv, Gp, 1] x2, [Hkv, Gp, D]
@@ -297,7 +311,7 @@ def _decode_kernel_fused_heads(
                 # width, dequant is an in-register cast; the scalar
                 # k_scale/v_scale are folded into sm_scale / output by the
                 # wrapper (reference decode.py:2004 scale folding)
-                kh = kh.astype(q.dtype)
+                kh = kh.astype(cdt)
             s = jax.lax.dot_general(
                 q[h], kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -314,10 +328,10 @@ def _decode_kernel_fused_heads(
         for h in range(num_kv_heads):  # wedge-lint: ok bounded by num_kv_heads; on-chip validated round 2
             vh = v_buf[slot, :, h, :, :].reshape(chunk_tokens, head_dim)
             if vh.dtype != q.dtype:
-                vh = vh.astype(q.dtype)
+                vh = vh.astype(cdt)
             pvs.append(
                 jax.lax.dot_general(
-                    p_all[h].astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    p_all[h].astype(cdt), vh, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
             )
@@ -350,7 +364,7 @@ def _decode_kernel_fused_heads(
             start_chunk(b + 1, 0, 0)
 
     l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    o_ref[...] = (acc / l_safe).astype(cdt)
     lse = jnp.where(l > 0, m + jnp.log(l), _NEG_INF)
     lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
@@ -405,6 +419,7 @@ def _paged_decode_hnd_launch(
         window_left=window_left,
         num_kv_heads=num_kv_heads,
         cross_step_prefetch=cross_step_prefetch,
+        compute_dtype=jnp.dtype(q.dtype).name,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -481,6 +496,7 @@ def _paged_decode_nhd_launch(
         sm_scale=sm_scale,
         logits_soft_cap=logits_soft_cap,
         window_left=window_left,
+        out_dtype=jnp.dtype(q.dtype).name,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -427,6 +427,51 @@ class _PrefillPlan:
     fused_ingest: Optional[bool] = None
 
 
+_INGEST_PROJECT_CACHE: list = []  # one-element AST-project cache
+
+
+def _ingest_vmem_feasible(fused_key) -> bool:
+    """Prune the fused-ingest candidate through the L009 VMEM
+    evaluator before the roofline race (the decode.py
+    ``_split_vmem_feasible`` pattern).  The ingest launcher's own
+    binding (``prefill.fused_ingest``) registers the launch but its
+    scratch shapes hinge on launch statics the key does not carry —
+    per the binding's contract the compile-feasibility proof rides the
+    ``fused_prefill.blocks`` evaluation of the shared chunk/tile
+    shapes, priced at the (block_q, pages_per_chunk) tactic the ingest
+    launch would actually run with (same key, same tuner lookup and
+    default as plan()).  The evaluator is a LOWER bound, so False is a
+    proof of infeasibility; anything unresolvable (or any analysis
+    failure) keeps the candidate — pruning must never be a guess."""
+    try:
+        from flashinfer_tpu.analysis.core import Project
+        from flashinfer_tpu.analysis.vmem_budget import (KNOB_LAUNCHES,
+                                                         _estimate)
+        from flashinfer_tpu.autotuner import AutoTuner
+        from flashinfer_tpu.obs import hwspec
+        from flashinfer_tpu.ops import paged_prefill as _pp
+
+        if not _INGEST_PROJECT_CACHE:
+            _INGEST_PROJECT_CACHE.append(
+                Project.from_paths([_pp.__file__]))
+        page_size = int(fused_key[5])
+        bq, ppc = AutoTuner.get().lookup(
+            "fused_prefill.blocks", fused_key,
+            default=(128, max(1, 128 // page_size)))
+        est = _estimate(
+            _INGEST_PROJECT_CACHE[0],
+            KNOB_LAUNCHES["fused_prefill.blocks"],
+            (int(bq), int(ppc)), [str(f) for f in fused_key])
+        if est is None:
+            return True
+        total, declared, _launcher = est
+        budget = declared if declared is not None \
+            else hwspec.current_spec().vmem_bytes
+        return total <= budget
+    except Exception:
+        return True
+
+
 def resolve_prefill_ingest(
     fused_key, *, total_q: int, total_kv: int, num_qo_heads: int,
     num_kv_heads: int, head_dim: int, q_bytes: int = 2,
@@ -452,7 +497,8 @@ def resolve_prefill_ingest(
     use, _ = costmodel.predict_prefill_ingest_win(
         total_q, total_kv, num_qo_heads, num_kv_heads, head_dim,
         hbm_tbps=spec.hbm_tbps, peak_tflops=spec.peak_tflops("bf16"),
-        q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes)
+        q_bytes=q_bytes, kv_bytes=kv_bytes, cache_bytes=cache_bytes,
+        feasible=lambda: _ingest_vmem_feasible(fused_key))
     return use
 
 

@@ -1784,14 +1784,15 @@ def test_wedge_lint_shim_is_retired():
                 "compile_guard must not import the retired shim"
 
 
-# ---------------------------------- driver: all thirteen passes --
+# ---------------------------------- driver: all seventeen passes --
 
 
-def test_driver_runs_all_fifteen_passes():
-    """Registration pin for the grown driver: L001–L015 all behind the
+def test_driver_runs_all_seventeen_passes():
+    """Registration pin for the grown driver: L001–L017 all behind the
     one driver (a pass that exists but is not in PASSES silently never
     runs — exactly the silent-skip failure mode L013 exists to kill)."""
-    from flashinfer_tpu.analysis import (dma_race, donation_lifetime,
+    from flashinfer_tpu.analysis import (chooser_coverage, cost_parity,
+                                         dma_race, donation_lifetime,
                                          kernel_init_guard,
                                          mosaic_lowering, pallas_contract,
                                          registry_coverage, static_flow,
@@ -1799,6 +1800,7 @@ def test_driver_runs_all_fifteen_passes():
 
     for p in (pallas_contract, tracer_leak, vmem_budget,
               kernel_init_guard, donation_lifetime, static_flow,
-              registry_coverage, dma_race, mosaic_lowering):
+              registry_coverage, dma_race, mosaic_lowering,
+              cost_parity, chooser_coverage):
         assert p in analysis.PASSES, p.__name__
-    assert len(analysis.PASSES) == 15
+    assert len(analysis.PASSES) == 17

@@ -785,7 +785,7 @@ def test_perf_report_prefill_ingest_section():
         assert cell["avoided_fraction"] >= 0.20
         assert cell["chips"]
     report = roofline.build_perf_report([])
-    assert report["schema"].endswith("/4")
+    assert report["schema"].endswith("/5")
     assert "prefill_ingest" in report
     text = roofline.render_perf_report(report)
     assert "prefill-ingest" in text
