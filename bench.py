@@ -2391,14 +2391,51 @@ def orchestrate(sweep: bool, bank: bool, phases=None, no_probe=False) -> int:
     else:
         probe = compile_guard.probe(timeout_s=PROBE_TIMEOUT_S)
     print(f"# probe: {probe}", file=sys.stderr)
+    # bring-up quarantine (ISSUE 20): phases a wedge-attributed smoke
+    # rung names are skipped, not re-dispatched into the same wedge
+    try:
+        from flashinfer_tpu.obs import bringup
+
+        poisoned = set(bringup.quarantined_bench_phases())
+    except Exception:
+        bringup, poisoned = None, set()
     if probe["healthy"]:
-        for name in (phases or DEFAULT_PHASES):
+        todo = list(phases or DEFAULT_PHASES)
+        while todo:
+            name = todo.pop(0)
+            if name in poisoned:
+                print(f"# phase {name}: SKIPPED (bring-up quarantine)",
+                      file=sys.stderr)
+                continue
             key = f"{name}_sweep" if sweep else name
             timeout = PHASE_TIMEOUT_S.get(key, PHASE_TIMEOUT_S.get(name, 900))
             rows, ok, detail = _run_phase(name, sweep, timeout)
             all_rows.extend(rows)
-            if not ok:
-                wedged = wedged or "timed out" in detail
+            if not ok and "timed out" in detail:
+                wedged = True
+                # a phase timeout is the wedge signature: re-probe chip
+                # health BEFORE dispatching the next phase, and when the
+                # chip is gone, journal the remainder as pending for
+                # `obs bringup --resume` instead of running every
+                # remaining phase into the wedge (the BENCH_r04/r05
+                # fourteen-hour failure mode)
+                if no_probe:
+                    reprobe = {"healthy": True,
+                               "detail": "skipped (--no-probe)"}
+                else:
+                    reprobe = compile_guard.probe(timeout_s=PROBE_TIMEOUT_S)
+                print(f"# post-timeout probe: {reprobe}", file=sys.stderr)
+                if not reprobe["healthy"]:
+                    pending = [n for n in todo if n not in poisoned]
+                    print(f"# chip unhealthy — {len(pending)} phase(s) "
+                          f"recorded pending: {pending}", file=sys.stderr)
+                    if bringup is not None and pending:
+                        try:
+                            bringup.record_phases_pending(pending, reprobe)
+                        except Exception as e:
+                            print(f"# journal write failed: {e!r}",
+                                  file=sys.stderr)
+                    break
     else:
         wedged = True
 

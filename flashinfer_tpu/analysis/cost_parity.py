@@ -2,7 +2,7 @@
 
 The cost model (`obs/costmodel.py`) is load-bearing: ``choose_decode_
 splits``, ``predict_prefill_ingest_win``, the engine's SLO chunk
-budgeting and the perf/5 drift watchdog all trust its analytic
+budgeting and the perf/6 drift watchdog all trust its analytic
 bytes/FLOPs.  Nothing else checks that those formulas match the DMA
 traffic the Pallas kernels actually issue, so a kernel rewrite (PR 14's
 fused ingest rewrote prefill traffic wholesale) can silently skew every

@@ -34,6 +34,13 @@ and are grandfathered (only the affirmative ``true`` counts — a
 NEW sections with neither are findings — an unlabeled tactic table
 can't be audited against the 0.35x/1.05x poison rules or graduated by
 the hardware session.
+
+Graduation references (ISSUE 20): a ``"measured"`` section must carry
+``journal_id`` (the ``obs bringup`` session that produced it) and
+``banked_row`` (RowAuditor stamp(s) of the BENCH_BANKED.md rows that
+measured it) — the rewrite ``obs bringup --graduate`` emits both, and
+requiring them here makes a hand-edited seed→measured flip a lint
+failure instead of an unfalsifiable claim.
 """
 
 from __future__ import annotations
@@ -147,6 +154,32 @@ def run(project: Project) -> List[Finding]:
                     "grandfathered via their \"seed\": true "
                     "flag) — unlabeled tactics cannot be audited "
                     "or graduated (ROADMAP item 5)"))
+            # a "measured" claim must be auditable (ISSUE 20): the
+            # graduation rewrite carries the session journal id and the
+            # RowAuditor stamps of the banked rows that measured it —
+            # a hand-edited flip without them is unfalsifiable
+            if prov == "measured":
+                jid = sec.get("journal_id")
+                if not (isinstance(jid, str) and jid):
+                    findings.append(Finding(
+                        CODE, path, _key_line(src, section), section,
+                        f"measured section {section!r} carries no "
+                        "journal_id reference — a \"measured\" claim "
+                        "must join to the bring-up session journal "
+                        "that produced it (run `obs bringup "
+                        "--graduate`, don't hand-edit provenance)"))
+                br = sec.get("banked_row")
+                ok_refs = (isinstance(br, str) and br) or (
+                    isinstance(br, list) and br
+                    and all(isinstance(r, str) and r for r in br))
+                if not ok_refs:
+                    findings.append(Finding(
+                        CODE, path, _key_line(src, section), section,
+                        f"measured section {section!r} carries no "
+                        "banked_row reference(s) — a \"measured\" "
+                        "claim must join to BENCH_BANKED.md rows by "
+                        "their RowAuditor stamp (bench_audit."
+                        "row_stamp)"))
         for section, table in _tables(data).items():
             if not isinstance(table, dict):
                 findings.append(Finding(

@@ -330,21 +330,26 @@ def compile_status() -> Dict[str, dict]:
         return {}
 
 
-def probe(timeout_s: float = 240.0) -> dict:
+def probe(timeout_s: float = 240.0, interpret: bool = False) -> dict:
     """Compile a trivial Pallas kernel in a subprocess under a timeout.
 
     Returns ``{"healthy": bool, "elapsed": s, "detail": str}`` — the
-    recovery detector to run after a wedge before resuming kernel work."""
+    recovery detector to run after a wedge before resuming kernel work.
+    ``interpret=True`` probes the interpret path instead (pallas_call on
+    CPU refuses the compiled path outright, so an off-TPU bring-up
+    selftest would read every probe as a wedge without it)."""
     import subprocess
     import sys
 
+    flag = ", interpret=True" if interpret else ""
     code = (
         "import jax, jax.numpy as jnp\n"
         "from jax.experimental import pallas as pl\n"
         "def k(x_ref, o_ref):\n"
         "    o_ref[...] = x_ref[...] * 2.0\n"
         "x = jnp.ones((8, 128), jnp.float32)\n"
-        "y = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)\n"
+        "y = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((8, 128), "
+        f"jnp.float32){flag})(x)\n"
         "jax.block_until_ready(y)\n"
         "print('PROBE_OK')\n"
     )

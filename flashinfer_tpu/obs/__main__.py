@@ -604,6 +604,17 @@ def cmd_doctor(args) -> int:
         "entries": len(q),
         "ops": sorted({i.get("op", "?") for i in q.values()}),
     }
+
+    # hardware bring-up session state (ISSUE 20): journal length, rung
+    # outcomes, wedge quarantine, and which tuning sections still ship
+    # seed tactics — the at-a-glance answer to "where did the chip
+    # session get to"
+    try:
+        from flashinfer_tpu.obs import bringup
+
+        report["bringup"] = bringup.doctor_summary()
+    except Exception as e:
+        report["bringup"] = f"<unavailable: {type(e).__name__}>"
     try:
         from flashinfer_tpu.autotuner import AutoTuner
 
@@ -825,6 +836,15 @@ def cmd_doctor(args) -> int:
     return 0
 
 
+def cmd_bringup(args) -> int:
+    """Hardware graduation session harness (ISSUE 20) — flags are owned
+    by :mod:`flashinfer_tpu.obs.bringup` (``--selftest``, ``--resume``,
+    ``--graduate``, ``--list``, ...)."""
+    from flashinfer_tpu.obs import bringup
+
+    return bringup.main(list(args.rest))
+
+
 def cmd_perf(args) -> int:
     """Roofline doctor over banked bench rows — the VERDICT analysis,
     reproduced mechanically (no jax / no device needed)."""
@@ -852,6 +872,14 @@ def cmd_perf(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "bringup":
+        # delegated wholesale: bringup owns its flags (--selftest,
+        # --resume, --graduate, ...) and argparse REMAINDER cannot
+        # forward leading options through a subparser
+        from flashinfer_tpu.obs import bringup
+
+        return bringup.main(argv[1:])
     p = argparse.ArgumentParser(prog="python -m flashinfer_tpu.obs")
     sub = p.add_subparsers(dest="cmd", required=True)
     sp = sub.add_parser("report", help="metrics snapshot (runs a small "
@@ -930,6 +958,15 @@ def main(argv=None) -> int:
                          "decomposition that misses the measured loop "
                          "wall by > 5% (the CI gate)")
     sp.set_defaults(fn=cmd_steploop)
+    sp = sub.add_parser(
+        "bringup",
+        help="hardware graduation session: mosaic-risk smoke ladder -> "
+             "banked bench -> emit-config sweeps -> provenance "
+             "graduation, journaled and resumable (ISSUE 20); flags "
+             "are owned by obs.bringup (--selftest, --resume, "
+             "--graduate, --list, ...)",
+        add_help=False)
+    sp.set_defaults(fn=cmd_bringup, rest=[])
     args = p.parse_args(argv)
     return args.fn(args)
 

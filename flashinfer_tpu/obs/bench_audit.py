@@ -30,6 +30,7 @@ other bs=64 ctx=4096 decode rows.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -112,8 +113,13 @@ MEASUREMENT_FIELDS = frozenset({
     # idle per step, the host-serialization fraction of the cadence,
     # and the cost model's predicted/measured step-time ratio — all
     # measurements of the same run (the tpot_us/ttft_us precedent),
-    # never identity; perf/5's host_loop section joins on them
+    # never identity; perf/6's host_loop section joins on them
     "host_gap_us", "host_frac", "pred_step_ratio",
+    # configuration-identity digest (ISSUE 20): sha256[:12] of row_key,
+    # stamped by RowAuditor so bring-up journal entries and graduated
+    # tuning sections can reference banked rows; derived from identity,
+    # never part of it (and recomputable for pre-stamp history rows)
+    "row_id",
 })
 
 # primary throughput metric, in preference order; all higher-is-better
@@ -138,6 +144,17 @@ def row_key(row: dict) -> Tuple:
         (k, str(v)) for k, v in row.items()
         if k not in MEASUREMENT_FIELDS
     ))
+
+
+def row_stamp(row: dict) -> str:
+    """12-hex configuration-identity digest (sha256 of :func:`row_key`).
+
+    The join key between the bring-up session journal / graduated tuning
+    sections and banked rows: rows of the same configuration share a
+    stamp across runs, and the stamp is recomputable for history rows
+    banked before RowAuditor started writing ``row_id``."""
+    key = json.dumps(row_key(row))
+    return hashlib.sha256(key.encode()).hexdigest()[:12]
 
 
 # fields obs.roofline.stamp_row always writes alongside pct_roofline —
@@ -254,6 +271,7 @@ class RowAuditor:
         best.  Never raises."""
         try:
             key = row_key(row)
+            row["row_id"] = row_stamp(row)
             pm = primary_metric(row)
             ratio_raw = None
             if pm is not None:

@@ -281,6 +281,12 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...], str]] = {
         "counter", ("phase", "quality"),
         "bench rows emitted per phase, by audited quality stamp "
         "(ok | degraded | poison)"),
+    # -- hardware bring-up observatory (obs.bringup) ----------------------
+    "bringup.rungs": (
+        "counter", ("outcome",),
+        "smoke-ladder rungs executed by `obs bringup`, by outcome "
+        "(pass | fail | wedge) — a wedge means the rung was "
+        "quarantined and the session halted for --resume"),
 }
 
 # histograms whose values are percentages, not microseconds

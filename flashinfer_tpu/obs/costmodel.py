@@ -285,6 +285,20 @@ def predict_decode_seconds(batch: int, ctx: int, num_qo_heads: int,
             + units * DECODE_UNIT_OVERHEAD_S)
 
 
+def _quarantined(op_name: str, tactic) -> bool:
+    """True when the bring-up quarantine blocklists (op, tactic) — the
+    ISSUE 20 wedge-attribution plumbing.  Lazy import and never-raise:
+    the import contract above stays intact (tactics_blocklist only
+    loads when a chooser actually runs), and a broken quarantine file
+    must not take the chooser down with it."""
+    try:
+        from flashinfer_tpu import tactics_blocklist
+
+        return tactics_blocklist.blocked(op_name, tactic)
+    except Exception:
+        return False
+
+
 def choose_decode_splits(batch: int, ctx: int, num_qo_heads: int,
                          num_kv_heads: int, head_dim: int, *,
                          hbm_tbps: float, page_size: int = 16,
@@ -300,13 +314,20 @@ def choose_decode_splits(batch: int, ctx: int, num_qo_heads: int,
     must beat the incumbent by >2% predicted time — on ties (e.g. a
     sub-chunk split degenerating to the same real partition) the
     smaller S wins, so S=1 stays the default wherever splitting has
-    nothing to remove."""
+    nothing to remove.
+
+    Candidates the bring-up quarantine names (a smoke-ladder rung that
+    wedged the chip on this (op, tactic) pair — ISSUE 20) are pruned
+    the same way ``feasible`` rejections are: S=1 always survives, so
+    a fully quarantined sweep degrades to unsplit, never to a wedge."""
     best, best_t = 1, None
     table: Dict[int, dict] = {}
     for S in sorted(set(int(s) for s in candidates)):
         if S < 1:
             continue
         if S > 1 and feasible is not None and not feasible(S):
+            continue
+        if S > 1 and _quarantined("decode.splits", S):
             continue
         cost = decode_split(
             batch, ctx, num_qo_heads, num_kv_heads, head_dim,
@@ -578,6 +599,16 @@ def predict_prefill_ingest_win(
             "bytes_avoided": bd["bytes_avoided"],
             "avoided_fraction": bd["avoided_fraction"],
             "pruned_infeasible": 1.0,
+        }
+    if _quarantined("prefill.fused_ingest", "on"):
+        # a bring-up smoke-ladder rung wedged the chip on the fused
+        # launch (ISSUE 20): the proven separate composition wins
+        # unconditionally until the quarantine is lifted
+        return False, {
+            "separate_s": 0.0, "fused_s": 0.0,
+            "bytes_avoided": bd["bytes_avoided"],
+            "avoided_fraction": bd["avoided_fraction"],
+            "pruned_quarantined": 1.0,
         }
     att = attention(total_q, total_kv, num_qo_heads, num_kv_heads,
                     head_dim, causal=causal)

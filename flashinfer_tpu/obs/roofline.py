@@ -28,6 +28,8 @@ per-serving-phase MFU) from banked rows with no hand math.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import (Dict, Iterable, List, Mapping, Optional, Sequence,
                     Tuple)
 
@@ -605,6 +607,86 @@ def _host_loop(attributed: Sequence[Mapping]) -> dict:
     return out
 
 
+def _graduation(attributed: Sequence[Mapping]) -> dict:
+    """The perf/6 graduation section: per tuning-config section, where
+    it stands in the hardware graduation pipeline —
+
+    - ``measured``: provenance already flipped by ``obs bringup
+      --graduate`` (carries journal_id + banked_row references that
+      L006 requires),
+    - ``quarantined``: a bring-up smoke-ladder rung that feeds this
+      section wedged the chip (the quarantine entry's ``bench_phases``
+      intersect the section's banked phases),
+    - ``pending``: still shipping seed/model-derived tactics.
+
+    Plus the predicted-vs-measured audit join ROADMAP item 1 demands:
+    for each perf/2–perf/4 prediction family, how many banked rows of
+    its measuring phase exist — the count that turns a prediction
+    section from forecast into audit."""
+    try:
+        from flashinfer_tpu.obs import bringup
+        section_phases = bringup.SECTION_BANK_PHASES
+        quarantined_phases = set(bringup.quarantined_bench_phases())
+        cfg_dir = bringup._default_configs_dir()
+    except Exception:
+        return {"sections": [], "audit": {}}
+    sections: List[dict] = []
+    try:
+        cfg_files = sorted(fn for fn in os.listdir(cfg_dir)
+                           if fn.endswith(".json"))
+    except OSError:
+        cfg_files = []
+    for fn in cfg_files:
+        try:
+            cfg = json.loads(open(os.path.join(cfg_dir, fn)).read())
+        except Exception:
+            continue
+        for name, sec in sorted(cfg.items()):
+            if not isinstance(sec, dict) or "tactics" not in sec \
+                    or name == "tactics":
+                continue
+            phases = section_phases.get(name, (name,))
+            if sec.get("provenance") == "measured":
+                status = "measured"
+            elif quarantined_phases.intersection(phases):
+                status = "quarantined"
+            else:
+                status = "pending"
+            entry = {
+                "chip": fn[:-5], "section": name, "status": status,
+                "provenance": sec.get("provenance"),
+                "tactics": len(sec.get("tactics") or {}),
+            }
+            if sec.get("journal_id"):
+                entry["journal_id"] = sec["journal_id"]
+            if sec.get("banked_row"):
+                entry["banked_row"] = sec["banked_row"]
+            sections.append(entry)
+    # audit join: prediction family -> measured banked rows by phase
+    by_phase: Dict[str, int] = {}
+    for a in attributed:
+        ph = a["row"].get("phase")
+        if isinstance(ph, str):
+            by_phase[ph] = by_phase.get(ph, 0) + 1
+    audit = {
+        "serving_ici": {"predicted_schema": "perf/2",
+                        "measured_rows": by_phase.get("serving_sharded", 0)},
+        "serving_disagg": {"predicted_schema": "perf/3",
+                           "measured_rows": by_phase.get(
+                               "serving_disagg", 0)},
+        "prefill_ingest": {"predicted_schema": "perf/4",
+                           "measured_rows": by_phase.get("prefill", 0)},
+        "host_loop": {"predicted_schema": "perf/5",
+                      "measured_rows": sum(
+                          n for ph, n in by_phase.items()
+                          if ph.startswith("serving"))},
+    }
+    counts: Dict[str, int] = {}
+    for s in sections:
+        counts[s["status"]] = counts.get(s["status"], 0) + 1
+    return {"sections": sections, "status_counts": counts, "audit": audit}
+
+
 def build_perf_report(rows: Sequence[Mapping], *,
                       chip: Optional[str] = None) -> dict:
     """The ``obs perf`` report over bench rows (typically the banked
@@ -701,7 +783,7 @@ def build_perf_report(rows: Sequence[Mapping], *,
         })
 
     return {
-        "schema": "flashinfer_tpu.obs.perf/5",
+        "schema": "flashinfer_tpu.obs.perf/6",
         "chips": {name: dataclasses.asdict(s)
                   for name, s in sorted(hwspec.CHIP_SPECS.items())
                   if any(a["res"].chip == name for a in attributed)},
@@ -730,6 +812,11 @@ def build_perf_report(rows: Sequence[Mapping], *,
         # host-gap decomposition + the Amdahl projection, from banked
         # host_frac stamps and (when present) the live steploop ledger
         "host_loop": _host_loop(attributed),
+        # the graduation dimension (perf/6): per tuning-config section,
+        # pending | measured | quarantined in the hardware bring-up
+        # pipeline, plus the predicted-vs-measured audit join of the
+        # perf/2-perf/4 prediction families against banked phases
+        "graduation": _graduation(attributed),
         "headline": _headline(attributed),
     }
 
@@ -861,6 +948,27 @@ def render_perf_report(report: Mapping) -> str:
                 f"{live['worst_phase']}"
                 + (f", drift p50 {drift.get('p50', 0):.3f}"
                    if drift else ""))
+    grad = report.get("graduation")
+    if grad and grad.get("sections"):
+        lines.append("")
+        counts = grad.get("status_counts", {})
+        lines.append(
+            "graduation (hardware bring-up pipeline): "
+            + "  ".join(f"{k} {v}" for k, v in sorted(counts.items())))
+        for s in grad["sections"]:
+            ref = ""
+            if s["status"] == "measured":
+                ref = f"  journal {s.get('journal_id', '?')}"
+            lines.append(
+                f"  {s['chip']:6s} {s['section']:16s} "
+                f"{s['status']:11s} ({s['tactics']} tactic(s)){ref}")
+        audit = grad.get("audit") or {}
+        if audit:
+            lines.append("  predicted-vs-measured audit join:")
+            for fam, a in audit.items():
+                lines.append(
+                    f"    {fam:16s} {a['predicted_schema']:7s} "
+                    f"measured rows: {a['measured_rows']}")
     sc = report.get("scaling_prediction")
     if sc:
         lines.append("")

@@ -8,6 +8,12 @@ hardware.  Ships with built-in entries; extendable via
 ``FLASHINFER_TPU_TACTICS_BLOCKLIST`` (path to a JSON file of
 ``[{"op": ..., "tactic": ...}, ...]``).  A malformed file logs a warning
 (never silently disables the safety net).
+
+A third source is the bring-up quarantine (``bringup_quarantine.json``,
+written by ``obs bringup`` when a smoke-ladder rung wedges the chip):
+entries carrying both ``op`` and ``tactic`` join the blocklist, so the
+autotuner resolver and the choosers skip wedge-proven tactics without any
+extra plumbing at the call sites.
 """
 
 from __future__ import annotations
@@ -50,10 +56,57 @@ def _load_external() -> List[Tuple[str, Any]]:
     return entries
 
 
+# (path, mtime, raw entries) — quarantine reads are on chooser hot paths,
+# so cache by mtime and never let a broken file raise
+_bringup_cache: Optional[Tuple[str, float, List[dict]]] = None
+
+
+def bringup_quarantine_path() -> str:
+    """Where ``obs bringup`` writes wedge attributions.  Defined here (not
+    in obs/) so the blocklist can consult it without importing obs."""
+    p = os.environ.get("FLASHINFER_TPU_BRINGUP_QUARANTINE")
+    if p:
+        return p
+    from flashinfer_tpu import env
+
+    return str(env.cache_dir() / "bringup_quarantine.json")
+
+
+def bringup_entries() -> List[dict]:
+    """Raw quarantine entries (``[]`` when absent/unreadable).  Each is a
+    dict with at least ``rung_id``/``reason``; knob rungs also carry
+    ``op``/``tactic`` (consulted by :func:`blocked`) and ``bench_phases``
+    (consulted by bench.py's orchestrator)."""
+    global _bringup_cache
+    path = bringup_quarantine_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return []
+    if _bringup_cache is not None and _bringup_cache[:2] == (path, mtime):
+        return _bringup_cache[2]
+    entries: List[dict] = []
+    try:
+        data = json.loads(open(path).read())
+        entries = [e for e in data if isinstance(e, dict)]
+    except Exception as e:
+        logging.getLogger("flashinfer_tpu").warning(
+            "bring-up quarantine %r unreadable (%r) — wedge-proven "
+            "tactics from it are NOT being skipped", path, e,
+        )
+    _bringup_cache = (path, mtime, entries)
+    return entries
+
+
+def _bringup_pairs() -> List[Tuple[str, Any]]:
+    return [(e["op"], _normalize(e["tactic"])) for e in bringup_entries()
+            if e.get("op") is not None and "tactic" in e]
+
+
 def blocked(op_name: str, tactic: Any) -> bool:
     """True if (op, tactic) is blocklisted."""
     t = _normalize(tactic)
-    for bop, btac in _BUILTIN + _load_external():
+    for bop, btac in _BUILTIN + _load_external() + _bringup_pairs():
         if bop == op_name and btac == t:
             return True
     return False

@@ -1,12 +1,17 @@
 #!/bin/bash
-# Chip-recovery banking runbook (VERDICT r2 #1: bank BENCH before anything
-# else).  Loops a guarded probe until the wedged chip answers, then banks,
-# in deliverable order:
-#   1. headline bench (decode + serving + sampling + moe + topk + scans),
-#      partial-result JSON either way, committed immediately;
-#   2. full sweep;
-#   3. hardware correctness tier, one pytest process per test under its
-#      own timeout (a Mosaic hang costs one slot, not the run).
+# Chip-recovery runbook (VERDICT r2 #1: bank BENCH before anything else).
+# Loops a guarded probe until the wedged chip answers, then hands the
+# session to the graduation observatory:
+#
+#   obs bringup --resume
+#
+# which continues the journaled session from the exact failed rung —
+# smoke ladder (wedge-attributing, quarantine-writing) -> banked bench
+# -> emit-config sweeps -> provenance graduation.  The fixed
+# quick-bench -> sweep -> hw-tier sequence this script used to hardcode
+# lives inside the harness now, journaled and resumable; see
+# docs/observability.md §"Hardware bring-up observatory".
+#
 # Run from repo root:  nohup bash scripts/recovery_bank.sh &
 set -u
 cd "$(dirname "$0")/.." || exit 1
@@ -16,7 +21,7 @@ ts() { date +%H:%M:%S; }
 while true; do
   out=$(timeout 400 python -m flashinfer_tpu probe --timeout 300 2>&1)
   if echo "$out" | grep -q '"healthy": true'; then
-    echo "[$(ts)] chip HEALTHY — banking begins" >> "$LOG"
+    echo "[$(ts)] chip HEALTHY — resuming bring-up session" >> "$LOG"
     echo "HEALTHY $(ts)" > /tmp/chip_status.txt
     break
   fi
@@ -25,27 +30,24 @@ while true; do
   sleep 420
 done
 
-# ---- 1. headline bench (quick): the round's deliverable ----
-timeout 7200 python bench.py --bank > BENCH_QUICK.json 2>> "$LOG"
-echo "[$(ts)] quick bench rc=$? $(cat BENCH_QUICK.json 2>/dev/null | head -c 300)" >> "$LOG"
-git add -A BENCH_BANKED.md BENCH_QUICK.json 2>> "$LOG"
-git commit -m "Bank hardware benchmark results (post-recovery quick run)" >> "$LOG" 2>&1
+# ---- graduation session, resumed from the journal ----
+# rc=3 means the ladder hit a NEW wedge: the rung is quarantined and the
+# journal holds the remainder as pending — loop back to probing so the
+# next recovery pass continues past it instead of exiting silently.
+timeout 86400 python -m flashinfer_tpu.obs bringup --resume >> "$LOG" 2>&1
+rc=$?
+echo "[$(ts)] bringup --resume rc=$rc" >> "$LOG"
+git add -A BENCH_BANKED.md flashinfer_tpu/tuning_configs 2>> "$LOG"
+git commit -m "Bank hardware bring-up session results" >> "$LOG" 2>&1
+if [ "$rc" = "3" ]; then
+  echo "[$(ts)] new wedge quarantined — relaunch this script after chip "\
+"recovery to continue from the next rung" >> "$LOG"
+  exec bash "$0"
+fi
 
-# ---- 2. full sweep ----
-timeout 14400 python bench.py --sweep --bank > BENCH_SWEEP.json 2>> "$LOG"
-echo "[$(ts)] sweep rc=$?" >> "$LOG"
-git add -A BENCH_BANKED.md BENCH_SWEEP.json 2>> "$LOG"
-git commit -m "Bank full benchmark sweep" >> "$LOG" 2>&1
-
-# ---- 3. hardware tier: one process per test, own timeout ----
-# -n 0 overrides the xdist addopts: two workers double JAX/compile
-# startup on the 1-core host for a single selected test, and CPU contention
-# pushed a cold-cache compile past the old 900s timeout on 2026-07-31
-# (wedge #4 — the timeout kill mid-remote-compile is the known wedge
-# trigger).  1800s clears a worst-case cold compile.  RESUME: a test is
-# skipped only if its LAST recorded rc under the CURRENT git sha is 0 —
-# a new code state starts a fresh tier (no stale green), and a test that
-# failed then passed is not re-run on the next relaunch.
+# ---- hardware correctness tier, one process per test, own timeout ----
+# (unchanged: a Mosaic hang costs one slot, not the run.  RESUME: a test
+# is skipped only if its LAST recorded rc under the CURRENT git sha is 0.)
 SHA=$(git rev-parse --short HEAD)
 touch HW_TIER_LOG.txt
 echo "### tier $SHA $(ts) ###" >> HW_TIER_LOG.txt
@@ -84,10 +86,8 @@ done
 git add HW_TIER_LOG.txt 2>> "$LOG"
 git commit -m "Bank hardware correctness tier log" >> "$LOG" 2>&1
 
-# ---- 4. autotune: tactics straight into the shipped config (the CLI
-# merges after every stage, so a late wedge still leaves a config).
-# Re-probe first: the hw tier above may have ended on a re-wedge, and an
-# hour-long tune against a wedged chip banks nothing. ----
+# ---- autotune: tactics straight into the shipped config.  Re-probe
+# first: the hw tier above may have ended on a re-wedge. ----
 if timeout 400 python -m flashinfer_tpu probe --timeout 300 2>&1 \
     | grep -q '"healthy": true'; then
   timeout 3600 python -m flashinfer_tpu tune >> "$LOG" 2>&1

@@ -934,6 +934,10 @@ def test_l006_provenance_labels_accepted_and_validated(tmp_path):
         "tactics": {},
         "measured_phase": {
             "provenance": "measured",
+            # graduation references (ISSUE 20): a "measured" label must
+            # join to the bring-up journal + banked rows that produced it
+            "journal_id": "bringup-20260807-0",
+            "banked_row": ["abc123def456"],
             "tactics": {"rmsnorm.row_block|64_4096_bfloat16": 256},
         },
         "model_phase": {

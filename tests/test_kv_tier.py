@@ -23,7 +23,7 @@ The contracts under pin:
 - **registration**: knobs choices-validated in KNOWN_KNOBS + resolved
   by EngineConfig.from_knobs, the shipped kv_tier tuning sections
   L006-valid, obs coverage (API_OPS / API_OP_COSTS / SPAN_CATEGORIES /
-  catalog metrics) closed, perf/5 serving_disagg section present.
+  catalog metrics) closed, perf/6 serving_disagg section present.
 """
 
 import dataclasses
@@ -409,7 +409,7 @@ def test_disagg_role_validation(params):
 
 
 # ---------------------------------------------------------------------------
-# Cost model + policy + perf/5
+# Cost model + policy + perf/6
 # ---------------------------------------------------------------------------
 
 
@@ -476,7 +476,7 @@ def test_spill_beats_recompute_directionality(params):
 
 @pytest.mark.quick
 def test_perf3_serving_disagg_section():
-    """perf/5: the report carries the predicted per-request kv_migrate
+    """perf/6: the report carries the predicted per-request kv_migrate
     wire cost and joins measured serving_disagg rows against it."""
     from flashinfer_tpu.obs import hwspec, roofline
     from flashinfer_tpu.obs.costmodel import kv_migrate
@@ -488,7 +488,7 @@ def test_perf3_serving_disagg_section():
                migrate_us=5000.0, us=5000.0)
     roofline.stamp_row(row, cost, 5e-3, hwspec.spec("v5e"))
     rep = roofline.build_perf_report([row])
-    assert rep["schema"] == "flashinfer_tpu.obs.perf/5"
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/6"
     sd = rep["serving_disagg"]
     pred = sd["predicted_kv_migrate"]
     assert pred["ici_bytes_per_request"] > 0

@@ -774,7 +774,7 @@ def test_stamp_row_ingest_identity_and_measurement():
 
 
 def test_perf_report_prefill_ingest_section():
-    """obs perf (perf/5): the prefill_ingest section joins the
+    """obs perf (perf/6): the prefill_ingest section joins the
     predicted byte drop with stamped ingest rows, and the headline
     cells all clear the >= 20% acceptance bar."""
     from flashinfer_tpu.obs import roofline

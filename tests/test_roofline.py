@@ -561,7 +561,7 @@ def test_build_perf_report_sections_on_synthetic_rows():
     rows.append(srow)
 
     rep = roofline.build_perf_report(rows)
-    assert rep["schema"] == "flashinfer_tpu.obs.perf/5"
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/6"
     assert rep["rows_total"] == 4
     assert rep["rows_implausible"] == 1  # the artifact was dropped
     assert rep["rows_attributed"] == 3
@@ -610,7 +610,7 @@ def test_perf_cli_reproduces_round5_headline_fractions():
     )
     assert p.returncode == 0, p.stderr[-2000:]
     rep = json.loads(p.stdout)
-    assert rep["schema"] == "flashinfer_tpu.obs.perf/5"
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/6"
     assert {"chips", "rows_total", "rows_attributed", "ops",
             "worst_offenders", "waste", "serving_phase_mfu",
             "serving_ici", "scaling_prediction", "serving_disagg",

@@ -18,7 +18,7 @@ Pins the mesh tentpole's contracts on the 8-virtual-device CPU mesh:
 - **collective cost family**: hand-computed ICI byte pins (ring
   allreduce 2(p-1)/p, EP a2a, sampling gather), the single-chip fixed
   point, the tp8-shard == banked-shape identity, and the ``obs perf``
-  ICI schema (``flashinfer_tpu.obs.perf/5`` + tp1->tp8 scaling curve);
+  ICI schema (``flashinfer_tpu.obs.perf/6`` + tp1->tp8 scaling curve);
 - **counters**: ``comm.allreduce_bytes`` / ``moe.ep_a2a_bytes`` record
   per-traced-call payloads, zero-overhead with the gate off.
 """
@@ -575,14 +575,14 @@ def test_stamp_row_mesh_identity_and_ici_measurement():
 
 @pytest.mark.quick
 def test_perf_report_ici_schema_and_scaling_curve():
-    """obs perf emits schema perf/5: per-phase predicted collectives
+    """obs perf emits schema perf/6: per-phase predicted collectives
     and a tp1->tp8 scaling prediction for v5e AND v5p, speedups
     monotone and sublinear (ICI eats the linear win)."""
     from flashinfer_tpu.obs import roofline
 
     rows = [dict(phase="decode", bs=64, ctx=4096, us=100.0, tbps=0.5)]
     rep = roofline.build_perf_report(rows)
-    assert rep["schema"] == "flashinfer_tpu.obs.perf/5"
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/6"
     sc = rep["scaling_prediction"]
     assert set(sc) == {"v5e", "v5p"}
     for chip, table in sc.items():

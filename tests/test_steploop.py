@@ -9,7 +9,7 @@ ratio join), the bounded-ring and thread-safety contracts, negative-gap
 and idle-tick semantics, the unified-trace step lanes, the engine /
 ServingStep wiring (sub-phases, device lane, online drift), the
 ``python -m flashinfer_tpu.obs steploop --selftest`` acceptance gate,
-and the perf/5 ``host_loop`` section (banked-row Amdahl projection +
+and the perf/6 report's ``host_loop`` section (banked-row Amdahl projection +
 the live ledger join).
 """
 
@@ -398,7 +398,7 @@ def test_serving_step_wiring_device_lane(gate_on):
     assert s["gap_us"]["count"] == 3  # steady-state pairs
 
 
-# --------------------------------------------------------- CLI + perf/5 --
+# --------------------------------------------------------- CLI + perf/6 --
 
 
 def test_steploop_selftest_cli_acceptance(tmp_path):
@@ -444,7 +444,7 @@ def test_perf5_host_loop_section_and_live_join(fresh_ledger):
                        step_mode="fused")
     _three_step_lane()  # the live ledger side
     rep = roofline.build_perf_report([row])
-    assert rep["schema"] == "flashinfer_tpu.obs.perf/5"
+    assert rep["schema"] == "flashinfer_tpu.obs.perf/6"
     hl = rep["host_loop"]
     assert len(hl["rows"]) == 1
     m = hl["rows"][0]
